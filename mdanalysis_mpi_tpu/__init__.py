@@ -1,0 +1,38 @@
+"""mdanalysis_mpi_tpu — a TPU-native molecular-dynamics trajectory-analysis
+framework.
+
+From-scratch re-design of the capability envelope of the reference
+``i2nico/MDAnalysis-MPI`` (a frame-partitioned MPI RMSF script,
+``/root/reference/RMSF.py``) as a layered framework:
+
+- :mod:`mdanalysis_mpi_tpu.core` — host-side data model: topology,
+  selection DSL, ``Universe``/``AtomGroup`` (reference layer L1,
+  RMSF.py:56-57,77-78).
+- :mod:`mdanalysis_mpi_tpu.io` — trajectory/topology I/O: in-memory
+  ndarray reader (RMSF.py:113 path), XTC/DCD with a C++ decode core
+  (reference layer L2, RMSF.py:56,92,124).
+- :mod:`mdanalysis_mpi_tpu.ops` — JAX compute kernels: Kabsch
+  superposition (replacing qcprot, RMSF.py:43-51), batched streaming
+  moments with Chan merge (RMSF.py:36-41,137-138), RMSD, pair
+  distances, RDF (reference layer L3).
+- :mod:`mdanalysis_mpi_tpu.analysis` — ``AnalysisBase`` template and
+  the analyses themselves (RMSF, RMSD, AverageStructure, AlignTraj,
+  InterRDF, distance arrays) mirroring the serial-oracle API of
+  RMSF.py:1-18 (layer L6/L7).
+- :mod:`mdanalysis_mpi_tpu.parallel` — frame partitioner
+  (generalizing RMSF.py:65-72), executors (serial NumPy oracle /
+  JAX single-chip / JAX mesh), and the TPU-native communication
+  layer: ``jax.lax.psum`` over a device mesh replacing
+  ``comm.Allreduce`` / custom-op ``reduce`` (RMSF.py:110,143)
+  (layers L4/L5).
+- :mod:`mdanalysis_mpi_tpu.utils` — timers, config, logging
+  (reference: absent; SURVEY.md §5).
+"""
+
+from mdanalysis_mpi_tpu.core.universe import Universe
+from mdanalysis_mpi_tpu.core.groups import AtomGroup
+from mdanalysis_mpi_tpu.core.topology import Topology
+
+__version__ = "0.1.0"
+
+__all__ = ["Universe", "AtomGroup", "Topology", "__version__"]

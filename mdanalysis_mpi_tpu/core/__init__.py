@@ -1,0 +1,12 @@
+"""Host-side data model: topology, selections, Universe/AtomGroup.
+
+Reference layer L1 (SURVEY.md §1): the reference reaches this layer through
+MDAnalysis at RMSF.py:27,56-57,77-78,116,120,126.
+"""
+
+from mdanalysis_mpi_tpu.core.topology import Topology
+from mdanalysis_mpi_tpu.core.universe import Universe
+from mdanalysis_mpi_tpu.core.groups import AtomGroup
+from mdanalysis_mpi_tpu.core.selection import select
+
+__all__ = ["Topology", "Universe", "AtomGroup", "select"]

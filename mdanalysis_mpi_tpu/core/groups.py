@@ -1,0 +1,135 @@
+"""AtomGroup: an ordered set of atoms bound to a Universe.
+
+Covers the reference's AtomGroup API surface (SURVEY.md §2.2):
+``.positions`` (RMSF.py:85,95), ``.n_atoms`` (RMSF.py:97,120),
+``.center_of_mass()`` (RMSF.py:84,94 — mass-weighted), plus the set
+algebra and attribute views a framework user expects.  The group's
+``indices`` array is the static gather map handed to TPU kernels.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class AtomGroup:
+    """Ordered atom subset of a Universe, defined by an index array."""
+
+    def __init__(self, universe, indices: np.ndarray):
+        self._universe = universe
+        self._indices = np.asarray(indices, dtype=np.int64)
+        if self._indices.ndim != 1:
+            raise ValueError("indices must be 1-D")
+
+    # ---- identity ----
+
+    @property
+    def universe(self):
+        return self._universe
+
+    @property
+    def indices(self) -> np.ndarray:
+        return self._indices
+
+    @property
+    def n_atoms(self) -> int:
+        return len(self._indices)
+
+    def __len__(self) -> int:
+        return len(self._indices)
+
+    def __getitem__(self, item) -> "AtomGroup":
+        return AtomGroup(self._universe, np.atleast_1d(self._indices[item]))
+
+    def __repr__(self):
+        return f"<AtomGroup with {self.n_atoms} atoms>"
+
+    # ---- static attributes (gathered from topology) ----
+
+    @property
+    def names(self) -> np.ndarray:
+        return self._universe.topology.names[self._indices]
+
+    @property
+    def resnames(self) -> np.ndarray:
+        return self._universe.topology.resnames[self._indices]
+
+    @property
+    def resids(self) -> np.ndarray:
+        return self._universe.topology.resids[self._indices]
+
+    @property
+    def segids(self) -> np.ndarray:
+        return self._universe.topology.segids[self._indices]
+
+    @property
+    def elements(self) -> np.ndarray:
+        return self._universe.topology.elements[self._indices]
+
+    @property
+    def masses(self) -> np.ndarray:
+        return self._universe.topology.masses[self._indices]
+
+    @property
+    def charges(self) -> np.ndarray:
+        ch = self._universe.topology.charges
+        if ch is None:
+            raise AttributeError("topology has no charges")
+        return ch[self._indices]
+
+    # ---- dynamic attributes (gathered from the current Timestep) ----
+
+    @property
+    def positions(self) -> np.ndarray:
+        """float32 (n_atoms, 3) positions at the Universe's current frame
+        (reference: ``ag.positions``, RMSF.py:85,95,137)."""
+        return self._universe.trajectory.ts.positions[self._indices]
+
+    @positions.setter
+    def positions(self, value):
+        self._universe.trajectory.ts.positions[self._indices] = value
+
+    def center_of_mass(self) -> np.ndarray:
+        """Mass-weighted center, float64 (3,) (reference RMSF.py:84,94)."""
+        m = self.masses
+        tot = m.sum()
+        if tot == 0.0:
+            raise ValueError("total mass is zero; cannot compute center_of_mass")
+        return (self.positions.astype(np.float64) * m[:, None]).sum(axis=0) / tot
+
+    def center_of_geometry(self) -> np.ndarray:
+        """Unweighted centroid, float64 (3,)."""
+        return self.positions.astype(np.float64).mean(axis=0)
+
+    centroid = center_of_geometry
+
+    def total_mass(self) -> float:
+        return float(self.masses.sum())
+
+    # ---- refinement & set algebra ----
+
+    def select_atoms(self, selection: str) -> "AtomGroup":
+        """Select within this group (indices stay sorted/unique)."""
+        from mdanalysis_mpi_tpu.core.selection import select_mask
+        mask = select_mask(self._universe.topology, selection)
+        return AtomGroup(self._universe,
+                         self._indices[mask[self._indices]])
+
+    def __and__(self, other: "AtomGroup") -> "AtomGroup":
+        self._check(other)
+        return AtomGroup(self._universe,
+                         np.intersect1d(self._indices, other._indices))
+
+    def __or__(self, other: "AtomGroup") -> "AtomGroup":
+        self._check(other)
+        return AtomGroup(self._universe,
+                         np.union1d(self._indices, other._indices))
+
+    def __sub__(self, other: "AtomGroup") -> "AtomGroup":
+        self._check(other)
+        return AtomGroup(self._universe,
+                         np.setdiff1d(self._indices, other._indices))
+
+    def _check(self, other):
+        if other._universe is not self._universe:
+            raise ValueError("AtomGroups belong to different Universes")

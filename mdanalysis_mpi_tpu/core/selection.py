@@ -1,0 +1,215 @@
+"""Atom-selection DSL: parse MDAnalysis-style selection strings into
+boolean masks / static index arrays.
+
+The reference uses exactly one selection string, ``"protein and name CA"``
+(RMSF.py:77,78,116,120,126,137,138), re-parsed three times per frame in
+its hot loop (quirk Q3, SURVEY.md §2.4).  Here selections are parsed once
+into a boolean mask over atoms; the resulting static ``int32`` index array
+is what the TPU kernels gather with, so the hot path never sees strings.
+
+Grammar (recursive descent)::
+
+    expr     := and_expr ('or' and_expr)*
+    and_expr := not_expr ('and' not_expr)*
+    not_expr := 'not' not_expr | primary
+    primary  := '(' expr ')' | keyword
+    keyword  := 'all' | 'none' | 'protein' | 'backbone' | 'nucleic'
+              | 'nucleicbackbone' | 'water' | 'hydrogen' | 'heavy'
+              | ('name'|'resname'|'segid'|'element'|'type') value+
+              | ('resid'|'resnum') range+
+              | ('index'|'bynum') range+
+              | 'prop' ('mass'|'charge') cmp number
+    value    := token with optional fnmatch globs (* ?)
+    range    := N | N:M | N-M        (inclusive, MDAnalysis convention)
+
+Supported keyword semantics follow the documented MDAnalysis selection
+language for this subset; ``heavy`` = ``not hydrogen`` covers BASELINE
+config 2 ("all heavy atoms").  ``bynum`` is 1-based, ``index`` 0-based,
+matching upstream.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import re
+
+import numpy as np
+
+from mdanalysis_mpi_tpu.core.topology import Topology
+
+_RESERVED = {
+    "and", "or", "not", "(", ")",
+    "all", "none", "protein", "backbone", "nucleic", "nucleicbackbone",
+    "water", "hydrogen", "heavy",
+    "name", "resname", "segid", "element", "type", "resid", "resnum",
+    "index", "bynum", "prop",
+}
+
+_TOKEN_RE = re.compile(r"\(|\)|[^\s()]+")
+_RANGE_RE = re.compile(r"^(-?\d+)(?:[:\-](-?\d+))?$")
+_GLOB_CHARS = re.compile(r"[*?\[\]]")
+
+
+class SelectionError(ValueError):
+    """Raised for malformed selection strings."""
+
+
+class _Parser:
+    def __init__(self, text: str, top: Topology):
+        self.tokens = _TOKEN_RE.findall(text)
+        if not self.tokens:
+            raise SelectionError(f"empty selection string: {text!r}")
+        self.pos = 0
+        self.top = top
+
+    def peek(self) -> str | None:
+        return self.tokens[self.pos] if self.pos < len(self.tokens) else None
+
+    def next(self) -> str:
+        tok = self.peek()
+        if tok is None:
+            raise SelectionError("unexpected end of selection string")
+        self.pos += 1
+        return tok
+
+    # -- grammar --
+
+    def parse(self) -> np.ndarray:
+        mask = self.expr()
+        if self.peek() is not None:
+            raise SelectionError(f"unexpected token {self.peek()!r}")
+        return mask
+
+    def expr(self) -> np.ndarray:
+        mask = self.and_expr()
+        while self.peek() == "or":
+            self.next()
+            mask = mask | self.and_expr()
+        return mask
+
+    def and_expr(self) -> np.ndarray:
+        mask = self.not_expr()
+        while self.peek() == "and":
+            self.next()
+            mask = mask & self.not_expr()
+        return mask
+
+    def not_expr(self) -> np.ndarray:
+        if self.peek() == "not":
+            self.next()
+            return ~self.not_expr()
+        return self.primary()
+
+    def primary(self) -> np.ndarray:
+        tok = self.next()
+        t = self.top
+        if tok == "(":
+            mask = self.expr()
+            if self.next() != ")":
+                raise SelectionError("unbalanced parentheses")
+            return mask
+        if tok == "all":
+            return np.ones(t.n_atoms, dtype=bool)
+        if tok == "none":
+            return np.zeros(t.n_atoms, dtype=bool)
+        if tok == "protein":
+            return t.is_protein.copy()
+        if tok == "nucleic":
+            return t.is_nucleic.copy()
+        if tok == "water":
+            return t.is_water.copy()
+        if tok == "hydrogen":
+            return t.is_hydrogen.copy()
+        if tok == "heavy":
+            return ~t.is_hydrogen
+        if tok == "backbone":
+            return t.is_backbone.copy()
+        if tok == "nucleicbackbone":
+            return t.is_nucleic_backbone.copy()
+        if tok in ("name", "resname", "segid", "element", "type"):
+            attr = {"name": t.names, "resname": t.resnames, "segid": t.segids,
+                    "element": t.elements, "type": t.elements}[tok]
+            return self._string_match(tok, attr)
+        if tok in ("resid", "resnum"):
+            return self._int_match(tok, t.resids)
+        if tok == "index":
+            return self._int_match(tok, np.arange(t.n_atoms))
+        if tok == "bynum":
+            return self._int_match(tok, np.arange(1, t.n_atoms + 1))
+        if tok == "prop":
+            return self._prop()
+        raise SelectionError(f"unknown selection keyword {tok!r}")
+
+    # -- leaf matchers --
+
+    def _values(self, kw: str) -> list[str]:
+        vals = []
+        while True:
+            nxt = self.peek()
+            if nxt is None or nxt in _RESERVED:
+                break
+            vals.append(self.next())
+        if not vals:
+            raise SelectionError(f"{kw!r} requires at least one value")
+        return vals
+
+    def _string_match(self, kw: str, attr: np.ndarray) -> np.ndarray:
+        vals = self._values(kw)
+        upper = np.char.upper(attr)
+        mask = np.zeros(len(attr), dtype=bool)
+        for v in vals:
+            vu = v.upper()
+            if _GLOB_CHARS.search(vu):
+                pat = re.compile(fnmatch.translate(vu))
+                mask |= np.array([bool(pat.match(x)) for x in upper])
+            else:
+                mask |= upper == vu
+        return mask
+
+    def _int_match(self, kw: str, attr: np.ndarray) -> np.ndarray:
+        vals = self._values(kw)
+        mask = np.zeros(len(attr), dtype=bool)
+        for v in vals:
+            m = _RANGE_RE.match(v)
+            if not m:
+                raise SelectionError(f"bad {kw} range {v!r}")
+            lo = int(m.group(1))
+            hi = int(m.group(2)) if m.group(2) is not None else lo
+            mask |= (attr >= lo) & (attr <= hi)
+        return mask
+
+    def _prop(self) -> np.ndarray:
+        t = self.top
+        what = self.next()
+        if what == "mass":
+            arr = t.masses
+        elif what == "charge":
+            if t.charges is None:
+                raise SelectionError("topology has no charges for 'prop charge'")
+            arr = t.charges
+        else:
+            raise SelectionError(f"unsupported prop {what!r}")
+        op = self.next()
+        try:
+            val = float(self.next())
+        except ValueError as e:
+            raise SelectionError(f"prop comparison needs a number: {e}") from e
+        ops = {"<": np.less, "<=": np.less_equal, ">": np.greater,
+               ">=": np.greater_equal, "==": np.equal, "!=": np.not_equal}
+        if op not in ops:
+            raise SelectionError(f"unsupported prop operator {op!r}")
+        return ops[op](arr, val)
+
+
+def select_mask(top: Topology, selection: str) -> np.ndarray:
+    """Parse ``selection`` against ``top`` → boolean mask (n_atoms,)."""
+    return _Parser(selection, top).parse()
+
+
+def select(top: Topology, selection: str) -> np.ndarray:
+    """Parse ``selection`` → sorted static index array (int64).
+
+    This is the once-only compilation step that replaces the reference's
+    3×-per-frame ``select_atoms`` calls (RMSF.py:126,137,138, quirk Q3).
+    """
+    return np.flatnonzero(select_mask(top, selection))

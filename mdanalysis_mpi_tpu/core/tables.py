@@ -1,0 +1,141 @@
+"""Chemical reference tables: element masses, element guessing, residue
+classes.
+
+The reference relies on MDAnalysis' topology attributes for
+``center_of_mass()`` (RMSF.py:84,94 — mass-weighted) and for the
+``"protein"`` selection keyword (RMSF.py:77).  Those semantics live in
+upstream data tables; this module encodes the subset the framework needs,
+from public reference data (IUPAC 2021 standard atomic weights; PDB/CHARMM
+residue naming conventions).
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+# IUPAC standard atomic weights (abridged, conventional values).
+MASSES: dict[str, float] = {
+    "H": 1.008, "D": 2.014, "HE": 4.002602,
+    "LI": 6.94, "BE": 9.0121831, "B": 10.81, "C": 12.011, "N": 14.007,
+    "O": 15.999, "F": 18.998403163, "NE": 20.1797,
+    "NA": 22.98976928, "MG": 24.305, "AL": 26.9815385, "SI": 28.085,
+    "P": 30.973761998, "S": 32.06, "CL": 35.45, "AR": 39.948,
+    "K": 39.0983, "CA": 40.078, "MN": 54.938044, "FE": 55.845,
+    "CO": 58.933194, "NI": 58.6934, "CU": 63.546, "ZN": 65.38,
+    "BR": 79.904, "RB": 85.4678, "SR": 87.62, "MO": 95.95,
+    "I": 126.90447, "CS": 132.90545196, "BA": 137.327,
+    "X": 0.0,  # unknown
+}
+
+# Two-letter element symbols we will recognise when guessing from atom
+# names.  Deliberately excludes CA/CB/CD/... (protein carbon naming) and
+# HG/HD/HE (protein hydrogen naming) unless the whole name matches an ion
+# convention; see guess_element().
+_TWO_LETTER_SAFE = {
+    "CL", "BR", "MG", "MN", "FE", "ZN", "NA", "LI", "RB", "CS", "SR",
+    "BA", "NI", "CU", "MO", "SI", "AL",
+    # NOT here: HE/NE/CO/AR etc. — they shadow common hydrogen ("HE2"),
+    # nitrogen ("NE1"), and carbon naming in arbitrary (ligand) residues;
+    # helium/neon/cobalt reach the two-letter path only via ion resnames.
+}
+
+# Ion atom names that exactly equal a two-letter symbol which would
+# otherwise be shadowed by protein naming (CA = C-alpha vs calcium ion).
+_ION_RESNAMES = {
+    "NA", "NA+", "SOD", "CL", "CL-", "CLA", "K", "K+", "POT", "CA", "CA2",
+    "CA2+", "CAL", "MG", "MG2+", "MGA", "ZN", "ZN2+", "FE", "FE2", "FE3",
+    "LI", "LI+", "RB", "CS", "BA", "MN", "CU", "NI", "IOD", "I", "BR",
+    "CES", "HE", "NE", "AR", "CO",
+}
+
+# Residue-name classes, following MDAnalysis' documented selection keyword
+# semantics (``protein`` matches a fixed residue-name table).
+PROTEIN_RESNAMES = frozenset({
+    # the 20 standard amino acids
+    "ALA", "ARG", "ASN", "ASP", "CYS", "GLN", "GLU", "GLY", "HIS", "ILE",
+    "LEU", "LYS", "MET", "PHE", "PRO", "SER", "THR", "TRP", "TYR", "VAL",
+    # protonation / tautomer variants (CHARMM, AMBER, GROMOS)
+    "HSD", "HSE", "HSP", "HID", "HIE", "HIP", "HIS1", "HIS2", "HISA",
+    "HISB", "HISH", "HISD", "HISE",
+    "ASPH", "ASH", "GLUH", "GLH", "LYSH", "LYN", "CYSH", "CYS1", "CYS2",
+    "CYX", "CYM", "ARGN",
+    # terminal / capped variants
+    "ACE", "NME", "NMA", "NH2", "FOR",
+    # modified / common extras
+    "MSE", "HYP", "SEP", "TPO", "PTR", "CSO", "ALAD", "CME", "DAL", "GLYM",
+    "CALA", "CARG", "CASN", "CASP", "CCYS", "CGLN", "CGLU", "CGLY",
+    "CHID", "CHIE", "CHIP", "CILE", "CLEU", "CLYS", "CMET", "CPHE",
+    "CPRO", "CSER", "CTHR", "CTRP", "CTYR", "CVAL",
+    "NALA", "NARG", "NASN", "NASP", "NCYS", "NGLN", "NGLU", "NGLY",
+    "NHID", "NHIE", "NHIP", "NILE", "NLEU", "NLYS", "NMET", "NPHE",
+    "NPRO", "NSER", "NTHR", "NTRP", "NTYR", "NVAL",
+})
+
+NUCLEIC_RESNAMES = frozenset({
+    "ADE", "URA", "CYT", "GUA", "THY",
+    "DA", "DC", "DG", "DT", "DU", "A", "C", "G", "T", "U",
+    "RA", "RC", "RG", "RU",
+    "DA5", "DC5", "DG5", "DT5", "DA3", "DC3", "DG3", "DT3",
+    "RA5", "RC5", "RG5", "RU5", "RA3", "RC3", "RG3", "RU3",
+})
+
+WATER_RESNAMES = frozenset({
+    "SOL", "WAT", "HOH", "H2O", "TIP", "TIP2", "TIP3", "TIP4", "TIP5",
+    "T3P", "T4P", "T5P", "SPC", "SPCE", "OH2",
+})
+
+# Protein backbone atom names (N-CA-C-O), per the MDAnalysis ``backbone``
+# keyword; nucleic backbone for the ``nucleicbackbone`` keyword.
+PROTEIN_BACKBONE_NAMES = frozenset({"N", "CA", "C", "O", "OXT", "OT1", "OT2"})
+NUCLEIC_BACKBONE_NAMES = frozenset({"P", "O5'", "C5'", "C3'", "O3'",
+                                    "O5*", "C5*", "C3*", "O3*"})
+
+_LEADING_DIGITS = re.compile(r"^\d+")
+
+
+def guess_element(name: str, resname: str | None = None) -> str:
+    """Guess the chemical element from an atom name.
+
+    Mirrors the documented MDAnalysis heuristic: strip leading digits and
+    trailing charge markers, then match the longest prefix that is a known
+    element — but never promote a protein-context name (``CA``/``HG``/...)
+    to a metal unless the residue is an ion residue.  E.g. ``"CA"`` in
+    resname ``"GLY"`` → carbon; ``"CA"`` in resname ``"CAL"`` → calcium;
+    ``"HB2"`` → hydrogen; ``"CL"`` → chlorine; ``"1H5'"`` → hydrogen.
+    """
+    if not name:
+        return "X"
+    n = _LEADING_DIGITS.sub("", name.upper()).strip("+-")
+    if not n:
+        return "X"
+    rn = (resname or "").upper()
+    if rn in _ION_RESNAMES and n in MASSES:
+        return n
+    two = n[:2]
+    if two in _TWO_LETTER_SAFE and not (
+        rn in PROTEIN_RESNAMES or rn in NUCLEIC_RESNAMES or rn in WATER_RESNAMES
+    ):
+        return two
+    one = n[0]
+    if one in ("C", "H", "O", "N", "S", "P", "F", "B", "K", "I", "D"):
+        return one
+    if two in MASSES:
+        return two
+    if one in MASSES:
+        return one
+    return "X"
+
+
+def mass_of(element: str) -> float:
+    """Mass (u) of an element symbol; 0.0 for unknown."""
+    return MASSES.get(element.upper(), 0.0)
+
+
+def guess_masses(names, resnames) -> np.ndarray:
+    """Vector element-and-mass guess for arrays of atom names/resnames."""
+    out = np.empty(len(names), dtype=np.float64)
+    for i, (nm, rn) in enumerate(zip(names, resnames)):
+        out[i] = mass_of(guess_element(nm, rn))
+    return out

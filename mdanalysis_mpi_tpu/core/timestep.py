@@ -1,0 +1,41 @@
+"""Timestep: one frame of a trajectory.
+
+Mirrors the reference's per-frame object (``ts = universe.trajectory[frame]``,
+RMSF.py:92,124) — mutable float32 ``(N, 3)`` positions plus frame metadata.
+In-place edits (the reference rotates all atoms in place, RMSF.py:99-101,133-135)
+are rank/host-private and transient, exactly as upstream: the next read
+overwrites them.  The JAX path never mutates a Timestep; it consumes
+immutable ``(B, N, 3)`` frame batches instead (SURVEY.md §2.2).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class Timestep:
+    """One trajectory frame: positions (float32, (n_atoms, 3)), box, time."""
+
+    __slots__ = ("positions", "frame", "time", "dimensions")
+
+    def __init__(self, positions: np.ndarray, frame: int = 0,
+                 time: float = 0.0, dimensions: np.ndarray | None = None):
+        self.positions = np.asarray(positions, dtype=np.float32)
+        if self.positions.ndim != 2 or self.positions.shape[1] != 3:
+            raise ValueError(f"positions must be (n_atoms, 3), got {self.positions.shape}")
+        self.frame = int(frame)
+        self.time = float(time)
+        # [lx, ly, lz, alpha, beta, gamma] — MDAnalysis convention.
+        self.dimensions = (np.asarray(dimensions, dtype=np.float32)
+                           if dimensions is not None else None)
+
+    @property
+    def n_atoms(self) -> int:
+        return self.positions.shape[0]
+
+    def copy(self) -> "Timestep":
+        return Timestep(self.positions.copy(), self.frame, self.time,
+                        None if self.dimensions is None else self.dimensions.copy())
+
+    def __repr__(self):
+        return f"<Timestep frame={self.frame} n_atoms={self.n_atoms}>"

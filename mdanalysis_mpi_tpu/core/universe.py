@@ -1,0 +1,93 @@
+"""Universe: binds a Topology to a trajectory Reader.
+
+Covers the reference's Universe API surface (SURVEY.md §2.2):
+``Universe(topology, trajectory)`` (RMSF.py:56), ``.copy()`` with an
+independent reader cursor (RMSF.py:57), ``Universe(topology, ndarray)``
+in-memory construction (RMSF.py:113), ``select_atoms`` (RMSF.py:77),
+``.trajectory`` and ``.atoms``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mdanalysis_mpi_tpu.core.groups import AtomGroup
+from mdanalysis_mpi_tpu.core.selection import select_mask
+from mdanalysis_mpi_tpu.core.topology import Topology
+from mdanalysis_mpi_tpu.io.base import ReaderBase
+from mdanalysis_mpi_tpu.io.memory import MemoryReader
+
+
+def _load_topology(source) -> Topology:
+    if isinstance(source, Topology):
+        return source
+    if isinstance(source, (str,)):
+        from mdanalysis_mpi_tpu.io import topology_files
+        return topology_files.parse(source)
+    raise TypeError(f"cannot build a Topology from {type(source).__name__}")
+
+
+def _load_trajectory(source, n_atoms: int) -> ReaderBase:
+    if isinstance(source, ReaderBase):
+        return source
+    if isinstance(source, np.ndarray):
+        return MemoryReader(source)          # RMSF.py:113 path
+    if isinstance(source, (str,)):
+        from mdanalysis_mpi_tpu.io import trajectory_files
+        return trajectory_files.open(source, n_atoms=n_atoms)
+    raise TypeError(f"cannot open a trajectory from {type(source).__name__}")
+
+
+class Universe:
+    """Topology + trajectory, the root object of the data model."""
+
+    def __init__(self, topology, trajectory=None, **kwargs):
+        self.topology = _load_topology(topology)
+        if trajectory is None:
+            # Topology-only universe: a single all-zero frame, like
+            # upstream's coordinate-less construction.
+            src = getattr(self.topology, "_coordinates", None)
+            if src is not None:
+                trajectory = src
+            else:
+                trajectory = np.zeros((1, self.topology.n_atoms, 3),
+                                      dtype=np.float32)
+        self.trajectory = _load_trajectory(trajectory, self.topology.n_atoms)
+        if self.trajectory.n_atoms != self.topology.n_atoms:
+            raise ValueError(
+                f"topology has {self.topology.n_atoms} atoms but trajectory "
+                f"has {self.trajectory.n_atoms}")
+
+    @property
+    def atoms(self) -> AtomGroup:
+        return AtomGroup(self, np.arange(self.topology.n_atoms))
+
+    def select_atoms(self, selection: str) -> AtomGroup:
+        """Selection string → AtomGroup (RMSF.py:77 semantics).
+
+        Parsed once per call; analyses cache the resulting index array in
+        ``_prepare`` instead of re-selecting per frame (fixes quirk Q3).
+        """
+        return AtomGroup(self, np.flatnonzero(
+            select_mask(self.topology, selection)))
+
+    def copy(self) -> "Universe":
+        """Clone with an independent trajectory cursor (RMSF.py:57).
+
+        The topology (immutable) is shared; the reader is re-opened (file
+        readers) or re-wrapped over the same backing array (memory
+        readers) so each copy seeks independently, as each MPI rank's
+        ``universe.copy()`` does upstream.
+        """
+        traj = self.trajectory
+        if not hasattr(traj, "reopen"):
+            raise TypeError(f"{type(traj).__name__} does not support copy()")
+        return Universe(self.topology, traj.reopen())
+
+    @property
+    def dimensions(self):
+        return self.trajectory.ts.dimensions
+
+    def __repr__(self):
+        return (f"<Universe with {self.topology.n_atoms} atoms, "
+                f"{self.trajectory.n_frames} frames>")

@@ -1,0 +1,18 @@
+"""Trajectory and topology I/O (reference layer L2, SURVEY.md §1).
+
+Readers expose the interface the analysis layer depends on:
+
+- ``n_frames``, ``n_atoms``
+- random access ``reader[i] -> Timestep`` (RMSF.py:92,124 semantics:
+  every access re-reads from the backing store; in-place edits to the
+  previous Timestep do not persist)
+- ``read_block(start, stop) -> (positions (B,N,3) float32, boxes)`` —
+  the block-staging primitive the TPU executor feeds on (the reference
+  has no analog; it reads frame-at-a-time)
+- iteration and ``ts`` (current frame)
+"""
+
+from mdanalysis_mpi_tpu.io.memory import MemoryReader
+from mdanalysis_mpi_tpu.io.base import ReaderBase
+
+__all__ = ["MemoryReader", "ReaderBase"]

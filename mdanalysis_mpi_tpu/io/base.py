@@ -1,0 +1,93 @@
+"""Reader protocol shared by all trajectory backends."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mdanalysis_mpi_tpu.core.timestep import Timestep
+
+
+class ReaderBase:
+    """Abstract trajectory reader.
+
+    Subclasses implement ``n_frames``, ``n_atoms`` and ``_read_frame(i)``;
+    the base provides indexing, iteration, the ``ts`` cursor (the
+    reference's ``trajectory.ts``, RMSF.py:80), and a default
+    ``read_block`` built on per-frame reads (native readers override it
+    with a bulk decode path).
+    """
+
+    _ts: Timestep | None = None
+
+    @property
+    def n_frames(self) -> int:  # RMSF.py:65
+        raise NotImplementedError
+
+    @property
+    def n_atoms(self) -> int:   # RMSF.py:89
+        raise NotImplementedError
+
+    def _read_frame(self, i: int) -> Timestep:
+        raise NotImplementedError
+
+    # ---- shared behavior ----
+
+    @property
+    def ts(self) -> Timestep:
+        if self._ts is None:
+            self._ts = self._read_frame(0)
+        return self._ts
+
+    def __len__(self) -> int:
+        return self.n_frames
+
+    def __getitem__(self, i) -> Timestep:
+        if isinstance(i, slice):
+            raise TypeError("slice indexing not supported; use read_block")
+        i = int(i)
+        if i < 0:
+            i += self.n_frames
+        if not 0 <= i < self.n_frames:
+            raise IndexError(f"frame {i} out of range [0, {self.n_frames})")
+        self._ts = self._read_frame(i)
+        return self._ts
+
+    def __iter__(self):
+        for i in range(self.n_frames):
+            yield self[i]
+
+    def rewind(self) -> Timestep:
+        return self[0]
+
+    def read_block(self, start: int, stop: int) -> tuple[np.ndarray, np.ndarray | None]:
+        """Bulk-read frames [start, stop) → (positions (B,N,3) f32, boxes).
+
+        ``boxes`` is (B, 6) float32 ([lx,ly,lz,alpha,beta,gamma]) or None
+        if the trajectory carries no box.  This is the staging primitive
+        for host→HBM block transfer (SURVEY.md §7 layer 2).
+        """
+        if not 0 <= start <= stop <= self.n_frames:
+            raise IndexError(f"block [{start},{stop}) out of range [0,{self.n_frames}]")
+        b = stop - start
+        out = np.empty((b, self.n_atoms, 3), dtype=np.float32)
+        boxes = None
+        for j, i in enumerate(range(start, stop)):
+            ts = self._read_frame(i)
+            out[j] = ts.positions
+            if ts.dimensions is not None:
+                if boxes is None:
+                    # zeros, not empty: frames before the first boxed frame
+                    # must not leak uninitialized memory
+                    boxes = np.zeros((b, 6), dtype=np.float32)
+                boxes[j] = ts.dimensions
+        return out, boxes
+
+    def close(self):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -1,0 +1,73 @@
+"""In-memory ndarray-backed trajectory.
+
+The reference builds one of these implicitly at RMSF.py:113:
+``mda.Universe(GRO, positions.reshape((1, -1, 3)))`` wraps a raw ndarray
+as a single-frame trajectory.  Here it is also the staging format for
+TPU frame blocks and the backbone of synthetic test fixtures.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mdanalysis_mpi_tpu.core.timestep import Timestep
+from mdanalysis_mpi_tpu.io.base import ReaderBase
+
+
+class MemoryReader(ReaderBase):
+    """Trajectory over a coordinate array of shape (n_frames, n_atoms, 3).
+
+    Reads *copy* the stored frame so in-place Timestep edits do not
+    persist across reads — matching the file-backed semantics the
+    reference relies on (pass 2 re-reads pristine frames after pass 1's
+    in-place rotations, RMSF.py:124; SURVEY.md §2.1 "Pass 2").
+    """
+
+    def __init__(self, coordinates: np.ndarray,
+                 dimensions: np.ndarray | None = None,
+                 dt: float = 1.0):
+        coords = np.asarray(coordinates, dtype=np.float32)
+        if coords.ndim == 2:
+            coords = coords[None]
+        if coords.ndim != 3 or coords.shape[2] != 3:
+            raise ValueError(
+                f"coordinates must be (n_frames, n_atoms, 3), got {coords.shape}")
+        self._coords = coords
+        if dimensions is not None:
+            dimensions = np.asarray(dimensions, dtype=np.float32)
+            if dimensions.ndim == 1:
+                dimensions = np.broadcast_to(
+                    dimensions, (coords.shape[0], 6)).copy()
+            if dimensions.shape != (coords.shape[0], 6):
+                raise ValueError(
+                    f"dimensions must be (n_frames, 6), got {dimensions.shape}")
+        self._dims = dimensions
+        self._dt = float(dt)
+
+    @property
+    def n_frames(self) -> int:
+        return self._coords.shape[0]
+
+    @property
+    def n_atoms(self) -> int:
+        return self._coords.shape[1]
+
+    @property
+    def coordinates(self) -> np.ndarray:
+        """The full backing array (n_frames, n_atoms, 3) — zero-copy."""
+        return self._coords
+
+    def _read_frame(self, i: int) -> Timestep:
+        return Timestep(self._coords[i].copy(), frame=i, time=i * self._dt,
+                        dimensions=None if self._dims is None else self._dims[i].copy())
+
+    def reopen(self) -> "MemoryReader":
+        """Independent cursor over the same backing array (zero-copy),
+        supporting ``Universe.copy()`` (RMSF.py:57 semantics)."""
+        return MemoryReader(self._coords, self._dims, self._dt)
+
+    def read_block(self, start: int, stop: int):
+        if not 0 <= start <= stop <= self.n_frames:
+            raise IndexError(f"block [{start},{stop}) out of range [0,{self.n_frames}]")
+        boxes = None if self._dims is None else self._dims[start:stop].copy()
+        return self._coords[start:stop].copy(), boxes

@@ -1,0 +1,40 @@
+"""Topology file-format registry: path → Topology.
+
+Dispatch point for ``Universe("prot.gro", ...)`` (RMSF.py:56 analog).
+Formats register themselves via :func:`register`; parsers live in
+sibling modules (``gro``, ``psf``, ``pdb``).
+"""
+
+from __future__ import annotations
+
+import os
+
+from mdanalysis_mpi_tpu.core.topology import Topology
+
+_PARSERS: dict[str, callable] = {}
+
+
+def register(extension: str, parser) -> None:
+    """Register ``parser(path) -> Topology`` for a file extension."""
+    _PARSERS[extension.lower().lstrip(".")] = parser
+
+
+def parse(path: str) -> Topology:
+    ext = os.path.splitext(path)[1].lower().lstrip(".")
+    _autoload()
+    parser = _PARSERS.get(ext)
+    if parser is None:
+        known = ", ".join(sorted(_PARSERS)) or "(none)"
+        raise ValueError(
+            f"no topology parser for {ext!r} ({path}); known formats: {known}")
+    return parser(path)
+
+
+def _autoload():
+    """Import parser modules lazily so core has no hard format deps."""
+    if _PARSERS:
+        return
+    try:
+        from mdanalysis_mpi_tpu.io import gro, pdb, psf  # noqa: F401  (self-register)
+    except ImportError:
+        pass

@@ -1,0 +1,37 @@
+"""Trajectory file-format registry: path → Reader.
+
+Dispatch point for ``Universe(top, "traj.xtc")`` (RMSF.py:56 analog).
+Formats register via :func:`register`; readers live in sibling modules
+(``xtc``, ``dcd``).
+"""
+
+from __future__ import annotations
+
+import os
+
+_READERS: dict[str, callable] = {}
+
+
+def register(extension: str, opener) -> None:
+    """Register ``opener(path, n_atoms=...) -> ReaderBase``."""
+    _READERS[extension.lower().lstrip(".")] = opener
+
+
+def open(path: str, n_atoms: int | None = None):
+    ext = os.path.splitext(path)[1].lower().lstrip(".")
+    _autoload()
+    opener = _READERS.get(ext)
+    if opener is None:
+        known = ", ".join(sorted(_READERS)) or "(none)"
+        raise ValueError(
+            f"no trajectory reader for {ext!r} ({path}); known formats: {known}")
+    return opener(path, n_atoms=n_atoms)
+
+
+def _autoload():
+    if _READERS:
+        return
+    try:
+        from mdanalysis_mpi_tpu.io import xtc, dcd  # noqa: F401  (self-register)
+    except ImportError:
+        pass

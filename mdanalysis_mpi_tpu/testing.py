@@ -1,0 +1,111 @@
+"""Synthetic fixture generators.
+
+The reference tests itself against MDAnalysisTests data files
+(RMSF.py:34); that package is unavailable offline (SURVEY.md §4), so the
+framework generates its own fixtures: protein-like systems with known
+rigid-body motion + thermal noise, and water boxes for RDF tests.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mdanalysis_mpi_tpu.core.topology import (
+    Topology, concatenate, make_protein_topology, make_water_topology,
+)
+from mdanalysis_mpi_tpu.core.universe import Universe
+from mdanalysis_mpi_tpu.io.memory import MemoryReader
+
+
+def random_rotation_matrices(n: int, rng: np.random.Generator) -> np.ndarray:
+    """(n, 3, 3) uniform random rotations (QR of Gaussian, sign-fixed)."""
+    a = rng.normal(size=(n, 3, 3))
+    q, r = np.linalg.qr(a)
+    d = np.sign(np.diagonal(r, axis1=1, axis2=2))
+    q = q * d[:, None, :]
+    det = np.linalg.det(q)
+    q[:, :, 0] *= det[:, None]
+    return q
+
+
+def make_protein_universe(
+    n_residues: int = 50,
+    n_frames: int = 24,
+    noise: float = 0.3,
+    rigid_motion: bool = True,
+    seed: int = 0,
+    box: float | None = None,
+) -> Universe:
+    """Protein-like universe: a folded-ish random base structure, each
+    frame a rigid rotation+translation of it plus per-atom Gaussian noise.
+
+    With ``noise=0`` and ``rigid_motion=True``, superposition must recover
+    the base exactly → RMSF must be 0 (analytic oracle).  With noise>0 the
+    expected RMSF per atom is ``sqrt(3)·noise·sqrt((k-1)/k)``-ish
+    (sample variance), used as a statistical sanity check.
+    """
+    rng = np.random.default_rng(seed)
+    top = make_protein_topology(n_residues)
+    n = top.n_atoms
+    # compact random coil: random walk of residue centers + local geometry
+    centers = np.cumsum(rng.normal(scale=1.5, size=(n_residues, 3)), axis=0)
+    base = (np.repeat(centers, n // n_residues, axis=0)
+            + rng.normal(scale=0.8, size=(n, 3)))
+    base -= base.mean(axis=0)
+    frames = np.empty((n_frames, n, 3), dtype=np.float32)
+    rots = (random_rotation_matrices(n_frames, rng) if rigid_motion
+            else np.broadcast_to(np.eye(3), (n_frames, 3, 3)))
+    trans = (rng.normal(scale=5.0, size=(n_frames, 3)) if rigid_motion
+             else np.zeros((n_frames, 3)))
+    for f in range(n_frames):
+        frames[f] = (base @ rots[f].T + trans[f]
+                     + rng.normal(scale=noise, size=(n, 3)))
+    dims = None
+    if box is not None:
+        dims = np.array([box, box, box, 90.0, 90.0, 90.0], dtype=np.float32)
+    return Universe(top, MemoryReader(frames, dimensions=dims))
+
+
+def make_water_universe(
+    n_waters: int = 216,
+    n_frames: int = 4,
+    box: float = 18.6,
+    seed: int = 0,
+) -> Universe:
+    """TIP3P-like water box on a jittered lattice inside a cubic box
+    (BASELINE config 4 fixture: InterRDF O-O)."""
+    rng = np.random.default_rng(seed)
+    top = make_water_topology(n_waters)
+    side = int(np.ceil(n_waters ** (1 / 3)))
+    grid = np.stack(np.meshgrid(*[np.arange(side)] * 3, indexing="ij"),
+                    axis=-1).reshape(-1, 3)[:n_waters]
+    spacing = box / side
+    frames = np.empty((n_frames, 3 * n_waters, 3), dtype=np.float32)
+    for f in range(n_frames):
+        o = (grid + 0.5) * spacing + rng.normal(scale=0.25, size=(n_waters, 3))
+        o %= box
+        h1 = o + rng.normal(scale=0.06, size=(n_waters, 3)) + np.array([0.76, 0.59, 0.0])
+        h2 = o + rng.normal(scale=0.06, size=(n_waters, 3)) + np.array([-0.76, 0.59, 0.0])
+        frames[f] = np.stack([o, h1, h2], axis=1).reshape(-1, 3)
+    dims = np.array([box, box, box, 90.0, 90.0, 90.0], dtype=np.float32)
+    return Universe(top, MemoryReader(frames, dimensions=dims))
+
+
+def make_solvated_universe(
+    n_residues: int = 20,
+    n_waters: int = 100,
+    n_frames: int = 8,
+    seed: int = 0,
+    box: float = 40.0,
+) -> Universe:
+    """Protein + water, for selection + heavy-atom RMSF tests
+    (BASELINE config 2 shape: solvated protein)."""
+    rng = np.random.default_rng(seed)
+    ptop = make_protein_topology(n_residues)
+    wtop = make_water_topology(n_waters, start_resid=n_residues + 1)
+    top = concatenate([ptop, wtop])
+    n = top.n_atoms
+    frames = (rng.normal(scale=3.0, size=(1, n, 3))
+              + rng.normal(scale=0.4, size=(n_frames, n, 3))).astype(np.float32)
+    dims = np.array([box, box, box, 90.0, 90.0, 90.0], dtype=np.float32)
+    return Universe(top, MemoryReader(frames, dimensions=dims))

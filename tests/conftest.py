@@ -1,0 +1,17 @@
+"""Test configuration.
+
+Forces JAX onto a virtual 8-device CPU platform *before* jax import so the
+same shard_map/psum code paths as the TPU mesh target are exercised
+without hardware (SURVEY.md §4 "Distributed without a cluster").
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,92 @@
+"""Core data-model tests: Topology, Universe, AtomGroup, MemoryReader."""
+
+import numpy as np
+import pytest
+
+from mdanalysis_mpi_tpu import Universe
+from mdanalysis_mpi_tpu.core.topology import Topology, make_protein_topology
+from mdanalysis_mpi_tpu.io.memory import MemoryReader
+from mdanalysis_mpi_tpu.testing import make_protein_universe
+
+
+def test_topology_basics():
+    top = make_protein_topology(3)
+    assert top.n_atoms == 15
+    assert top.n_residues == 3
+    assert top.is_protein.all()
+    np.testing.assert_array_equal(top.resindices[:6], [0, 0, 0, 0, 0, 1])
+
+
+def test_topology_length_mismatch():
+    with pytest.raises(ValueError):
+        Topology(names=np.array(["A", "B"]), resnames=np.array(["X"]),
+                 resids=np.array([1, 1]))
+
+
+def test_universe_ndarray_construction():
+    # RMSF.py:113 path: Universe(topology, raw ndarray)
+    top = make_protein_topology(2)
+    coords = np.arange(top.n_atoms * 3, dtype=np.float32).reshape(1, -1, 3)
+    u = Universe(top, coords)
+    assert u.trajectory.n_frames == 1
+    np.testing.assert_array_equal(u.atoms.positions, coords[0])
+
+
+def test_memory_reader_random_access_and_isolation():
+    coords = np.random.default_rng(0).normal(size=(5, 4, 3)).astype(np.float32)
+    r = MemoryReader(coords)
+    ts2 = r[2]
+    assert ts2.frame == 2
+    # In-place mutation must NOT persist (RMSF.py:124 semantics).
+    ts2.positions[:] = 0.0
+    np.testing.assert_array_equal(r[2].positions, coords[2])
+    with pytest.raises(IndexError):
+        r[5]
+    assert r[-1].frame == 4
+
+
+def test_read_block():
+    coords = np.random.default_rng(1).normal(size=(7, 3, 3)).astype(np.float32)
+    dims = np.tile(np.array([10, 10, 10, 90, 90, 90], np.float32), (7, 1))
+    r = MemoryReader(coords, dimensions=dims)
+    block, boxes = r.read_block(2, 5)
+    np.testing.assert_array_equal(block, coords[2:5])
+    np.testing.assert_array_equal(boxes, dims[2:5])
+    # empty block is legal (Q2 edge case)
+    empty, _ = r.read_block(3, 3)
+    assert empty.shape == (0, 3, 3)
+
+
+def test_universe_copy_independent_cursor():
+    # RMSF.py:57: the copy seeks independently of the original.
+    u = make_protein_universe(n_residues=4, n_frames=6)
+    ref = u.copy()
+    u.trajectory[3]
+    ref.trajectory[0]
+    assert u.trajectory.ts.frame == 3
+    assert ref.trajectory.ts.frame == 0
+    np.testing.assert_array_equal(ref.atoms.positions,
+                                  u.copy().trajectory[0].positions)
+
+
+def test_center_of_mass_mass_weighted():
+    top = Topology(names=np.array(["C", "O"]),
+                   resnames=np.array(["GLY", "GLY"]),
+                   resids=np.array([1, 1]))
+    coords = np.array([[[0, 0, 0], [1, 0, 0]]], dtype=np.float32)
+    u = Universe(top, coords)
+    com = u.atoms.center_of_mass()
+    expected = 15.999 / (12.011 + 15.999)
+    assert com[0] == pytest.approx(expected)
+    cog = u.atoms.center_of_geometry()
+    assert cog[0] == pytest.approx(0.5)
+
+
+def test_atomgroup_positions_setter():
+    u = make_protein_universe(n_residues=2, n_frames=2)
+    ca = u.select_atoms("name CA")
+    ca.positions = np.zeros((ca.n_atoms, 3))
+    np.testing.assert_array_equal(ca.positions, 0.0)
+    # next read restores
+    u.trajectory[0]
+    assert not np.allclose(ca.positions, 0.0)

@@ -1,0 +1,100 @@
+"""Selection DSL tests — table-driven encoding of upstream's documented
+selection semantics (SURVEY.md §7 hard parts: "Selection correctness
+without MDAnalysis to compare against offline")."""
+
+import numpy as np
+import pytest
+
+from mdanalysis_mpi_tpu.core.selection import SelectionError, select, select_mask
+from mdanalysis_mpi_tpu.core.topology import Topology
+from mdanalysis_mpi_tpu.testing import make_solvated_universe
+
+
+@pytest.fixture(scope="module")
+def top():
+    # 4 residues: GLY (protein), SOL (water), NA ion, DA (nucleic)
+    return Topology(
+        names=np.array(["N", "CA", "C", "O", "HA",
+                        "OW", "HW1", "HW2",
+                        "NA",
+                        "P", "O5'", "C5'", "C1'"]),
+        resnames=np.array(["GLY"] * 5 + ["SOL"] * 3 + ["NA"] + ["DA"] * 4),
+        resids=np.array([1] * 5 + [2] * 3 + [3] + [4] * 4),
+        segids=np.array(["PROT"] * 5 + ["WAT"] * 3 + ["ION"] + ["NUC"] * 4),
+    )
+
+
+CASES = [
+    ("all", list(range(13))),
+    ("none", []),
+    ("protein", [0, 1, 2, 3, 4]),
+    ("water", [5, 6, 7]),
+    ("nucleic", [9, 10, 11, 12]),
+    ("protein and name CA", [1]),           # the reference's selection, RMSF.py:77
+    ("backbone", [0, 1, 2, 3]),
+    ("nucleicbackbone", [9, 10, 11]),
+    ("hydrogen", [4, 6, 7]),
+    ("heavy", [0, 1, 2, 3, 5, 8, 9, 10, 11, 12]),
+    ("not protein", [5, 6, 7, 8, 9, 10, 11, 12]),
+    ("protein or water", [0, 1, 2, 3, 4, 5, 6, 7]),
+    ("name CA C", [1, 2]),
+    ("name HW*", [6, 7]),
+    ("name O5' C5'", [10, 11]),
+    ("resname SOL GLY", [0, 1, 2, 3, 4, 5, 6, 7]),
+    ("resid 2", [5, 6, 7]),
+    ("resid 1:2", [0, 1, 2, 3, 4, 5, 6, 7]),
+    ("resid 2-3", [5, 6, 7, 8]),
+    ("segid PROT ION", [0, 1, 2, 3, 4, 8]),
+    ("element N", [0]),                     # nitrogen only; the NA ion is element NA
+    ("index 0:2", [0, 1, 2]),
+    ("bynum 1:3", [0, 1, 2]),
+    ("(protein or water) and not hydrogen", [0, 1, 2, 3, 5]),
+    ("protein and (name CA or name N)", [0, 1]),
+    ("prop mass > 20", [8, 9]),             # NA (22.99), P (30.97)
+]
+
+
+@pytest.mark.parametrize("sel,expected", CASES, ids=[c[0] for c in CASES])
+def test_selection_table(top, sel, expected):
+    np.testing.assert_array_equal(select(top, sel), expected)
+
+
+def test_na_ion_element_vs_protein_n(top):
+    # 'NA' in resname NA is sodium; 'N' in GLY is nitrogen.
+    assert top.elements[8] == "NA"
+    assert top.elements[0] == "N"
+    assert top.masses[8] == pytest.approx(22.98976928)
+
+
+def test_ca_is_carbon_in_protein(top):
+    assert top.elements[1] == "C"
+    assert top.masses[1] == pytest.approx(12.011)
+
+
+def test_errors(top):
+    for bad in ["", "name", "frobnicate", "(protein", "protein and",
+                "prop mass >", "resid x"]:
+        with pytest.raises(SelectionError):
+            select_mask(top, bad)
+
+
+def test_selection_on_solvated_universe():
+    u = make_solvated_universe(n_residues=5, n_waters=7, n_frames=2)
+    ca = u.select_atoms("protein and name CA")
+    assert ca.n_atoms == 5
+    assert set(ca.names) == {"CA"}
+    water_o = u.select_atoms("water and name OW")
+    assert water_o.n_atoms == 7
+    heavy = u.select_atoms("protein and heavy")
+    assert heavy.n_atoms == 25  # 5 residues x (N,CA,C,O,CB)
+
+
+def test_subgroup_selection_and_set_ops():
+    u = make_solvated_universe(n_residues=4, n_waters=3, n_frames=1)
+    prot = u.select_atoms("protein")
+    ca = prot.select_atoms("name CA")
+    assert ca.n_atoms == 4
+    both = ca | u.select_atoms("name N")
+    assert both.n_atoms == 8
+    assert (ca & prot).n_atoms == 4
+    assert (prot - ca).n_atoms == prot.n_atoms - 4
