@@ -35,4 +35,17 @@ from mdanalysis_mpi_tpu.core.topology import Topology
 
 __version__ = "0.1.0"
 
-__all__ = ["Universe", "AtomGroup", "Topology", "__version__"]
+__all__ = ["Universe", "AtomGroup", "Topology", "analysis", "__version__"]
+
+
+def __getattr__(name):
+    # lazy: importing the analysis/ops layers pulls in JAX, which core
+    # users (topology-only tooling) should not pay for
+    if name in ("analysis", "ops", "parallel", "io", "utils"):
+        import importlib
+        try:
+            return importlib.import_module(f"mdanalysis_mpi_tpu.{name}")
+        except ModuleNotFoundError as e:
+            # keep the module-__getattr__ contract (hasattr/getattr)
+            raise AttributeError(str(e)) from e
+    raise AttributeError(f"module 'mdanalysis_mpi_tpu' has no attribute {name!r}")

@@ -1,0 +1,22 @@
+"""Analyses: the user-facing API mirroring the serial oracle declared in
+the reference's docstring (RMSF.py:1-18) — ``Analysis(...).run()`` →
+``.results.<attr>`` — over the pluggable executor layer.
+
+- :class:`~mdanalysis_mpi_tpu.analysis.rms.RMSF` — fluctuations of an
+  AtomGroup (stock ``rms.RMSF`` oracle, RMSF.py:14-15).
+- :class:`~mdanalysis_mpi_tpu.analysis.rms.RMSD` — time series with
+  optional least-squares superposition (BASELINE config 3).
+- :class:`~mdanalysis_mpi_tpu.analysis.align.AverageStructure` — the
+  reference's pass 1 (RMSF.py:76-113; oracle RMSF.py:9-10).
+- :class:`~mdanalysis_mpi_tpu.analysis.align.AlignTraj` — in-memory
+  trajectory alignment (oracle RMSF.py:12).
+- :class:`~mdanalysis_mpi_tpu.analysis.rms.AlignedRMSF` — the whole
+  reference program as one call (pass 1 + pass 2, RMSF.py:53-149).
+"""
+
+from mdanalysis_mpi_tpu.analysis.base import AnalysisBase, Results
+from mdanalysis_mpi_tpu.analysis.rms import RMSF, RMSD, AlignedRMSF
+from mdanalysis_mpi_tpu.analysis.align import AverageStructure, AlignTraj
+
+__all__ = ["AnalysisBase", "Results", "RMSF", "RMSD", "AlignedRMSF",
+           "AverageStructure", "AlignTraj"]

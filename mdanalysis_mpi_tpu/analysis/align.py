@@ -1,0 +1,220 @@
+"""Structure-alignment analyses: AverageStructure and AlignTraj.
+
+Mirrors the serial-oracle API of the reference docstring:
+``align.AverageStructure(u, u, select=..., ref_frame=0).run()`` →
+``.results.universe`` (RMSF.py:9-10) and ``align.AlignTraj(u, ref,
+select=..., in_memory=True).run()`` (RMSF.py:12), re-implemented as
+batched executor-dispatched analyses (the reference's pass 1,
+RMSF.py:76-113).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mdanalysis_mpi_tpu.analysis.base import AnalysisBase
+from mdanalysis_mpi_tpu.core.universe import Universe
+from mdanalysis_mpi_tpu.ops import host
+from mdanalysis_mpi_tpu.parallel.executors import _f32_precision
+from mdanalysis_mpi_tpu.parallel.partition import iter_batches, pad_batch
+
+
+def _reference_sel_coords(reference: Universe, sel_idx, weights, ref_frame: int):
+    """Centered float64 selection coords + COM of ``ref_frame``, with the
+    cursor save/restore the reference wraps in try/finally
+    (RMSF.py:80-87)."""
+    traj = reference.trajectory
+    current = traj.ts.frame
+    try:
+        ts = traj[ref_frame]
+        sel = ts.positions[sel_idx].astype(np.float64)
+        com = host.weighted_center(sel, weights)
+        return sel - com, com
+    finally:
+        traj[current]
+
+
+class AverageStructure(AnalysisBase):
+    """Time-averaged structure after per-frame superposition.
+
+    The reference's pass 1 (RMSF.py:76-113): superpose every frame onto
+    ``ref_frame`` of ``reference`` using ``select`` (rotation fit on the
+    selection, applied to all atoms — quirk Q5), average, and expose the
+    result as ``.results.positions`` plus an in-memory
+    ``.results.universe`` (the RMSF.py:113 rebuild).
+
+    ``select_only=True`` averages just the selection (lean path: enough
+    for a downstream RMSF of the same selection, and avoids staging
+    100k-atom frames when only Cα are needed).
+    """
+
+    def __init__(self, mobile: Universe, reference: Universe | None = None,
+                 select: str = "all", ref_frame: int = 0,
+                 select_only: bool = False, verbose: bool = False):
+        super().__init__(mobile, verbose)
+        self._reference = reference if reference is not None else mobile
+        self._select = select
+        self._ref_frame = ref_frame
+        self._select_only = select_only
+
+    def _prepare(self):
+        u = self._universe
+        ag = u.select_atoms(self._select)
+        if ag.n_atoms == 0:
+            raise ValueError(f"selection {self._select!r} matched no atoms")
+        self._sel_idx = ag.indices
+        self._weights = ag.masses
+        self._ref_sel_c, self._ref_com = _reference_sel_coords(
+            self._reference, self._sel_idx, self._weights, self._ref_frame)
+        n_out = len(self._sel_idx) if self._select_only else u.topology.n_atoms
+        self._acc = np.zeros((n_out, 3), dtype=np.float64)
+        self._count = 0
+
+    # -- serial path --
+
+    def _single_frame(self, ts):
+        aligned = host.superpose_frame(
+            ts.positions, self._sel_idx, self._weights,
+            self._ref_sel_c, self._ref_com)
+        self._acc += aligned[self._sel_idx] if self._select_only else aligned
+        self._count += 1
+
+    def _serial_summary(self):
+        return (float(self._count), self._acc)
+
+    # -- batch path --
+
+    def _batch_select(self):
+        return self._sel_idx if self._select_only else None
+
+    def _make_batch_kernel(self):
+        import jax.numpy as jnp
+
+        from mdanalysis_mpi_tpu.ops.align import (
+            superpose_batch, superpose_selection_batch)
+
+        sel_idx = jnp.asarray(self._sel_idx)
+        w = jnp.asarray(self._weights, jnp.float32)
+        ref_c = jnp.asarray(self._ref_sel_c, jnp.float32)
+        ref_com = jnp.asarray(self._ref_com, jnp.float32)
+        select_only = self._select_only
+
+        def kernel(batch, mask):
+            if select_only:
+                aligned = superpose_selection_batch(batch, w, ref_c, ref_com)
+            else:
+                aligned = superpose_batch(batch, sel_idx, w, ref_c, ref_com)
+            t = mask.sum()
+            s = jnp.einsum("b,bni->ni", mask, aligned)
+            return (t, s)
+
+        return kernel
+
+    def _combine(self, a, b):
+        return (a[0] + b[0], a[1] + b[1])
+
+    def _device_combine(self, partials, axis_name):
+        import jax
+        return jax.tree.map(lambda x: jax.lax.psum(x, axis_name), partials)
+
+    def _identity_partials(self):
+        return (0.0, np.zeros_like(self._acc))
+
+    def _conclude(self, total):
+        t, s = total
+        if t == 0:
+            raise ValueError("AverageStructure over zero frames")
+        avg = np.asarray(s, np.float64) / t
+        self.results.positions = avg
+        if self._select_only:
+            self.results.universe = None
+        else:
+            # RMSF.py:113: rebuild a single-frame in-memory universe
+            self.results.universe = Universe(
+                self._universe.topology, avg[None].astype(np.float32))
+
+
+class AlignTraj(AnalysisBase):
+    """Align a whole trajectory to a reference frame, in memory.
+
+    Serial-oracle API: ``AlignTraj(u, ref, select=..., in_memory=True)
+    .run()`` (RMSF.py:12).  The mobile Universe's trajectory is replaced
+    by an aligned in-memory copy; per-frame old RMSD values are not
+    tracked (use :class:`~mdanalysis_mpi_tpu.analysis.rms.RMSD`).
+
+    This is a *map* (frame→frame), not a reduction, so it drives the
+    batch kernel directly rather than through the map-reduce executors;
+    ``backend="jax"`` batches through the device, ``"serial"`` uses the
+    host QCP path.
+    """
+
+    def __init__(self, mobile: Universe, reference: Universe | None = None,
+                 select: str = "all", ref_frame: int = 0,
+                 in_memory: bool = True, verbose: bool = False):
+        super().__init__(mobile, verbose)
+        if not in_memory:
+            raise NotImplementedError(
+                "AlignTraj currently supports in_memory=True only")
+        self._reference = reference if reference is not None else mobile
+        self._select = select
+        self._ref_frame = ref_frame
+
+    def run(self, start=None, stop=None, step=None, backend: str = "jax",
+            batch_size: int | None = 64, **kwargs):
+        from mdanalysis_mpi_tpu.io.memory import MemoryReader
+
+        u = self._universe
+        frames = list(self._frames(start, stop, step))
+        self.n_frames = len(frames)
+        ag = u.select_atoms(self._select)
+        if ag.n_atoms == 0:
+            raise ValueError(f"selection {self._select!r} matched no atoms")
+        sel_idx = ag.indices
+        weights = ag.masses
+        ref_sel_c, ref_com = _reference_sel_coords(
+            self._reference, sel_idx, weights, self._ref_frame)
+        n = u.topology.n_atoms
+        out = np.empty((len(frames), n, 3), dtype=np.float32)
+        dims = np.zeros((len(frames), 6), dtype=np.float32)
+        have_dims = False
+
+        if backend == "serial":
+            for j, i in enumerate(frames):
+                ts = u.trajectory[i]
+                if ts.dimensions is not None:
+                    dims[j] = ts.dimensions
+                    have_dims = True
+                out[j] = host.superpose_frame(
+                    ts.positions, sel_idx, weights, ref_sel_c, ref_com)
+        else:
+            import jax
+            import jax.numpy as jnp
+
+            from mdanalysis_mpi_tpu.ops.align import superpose_batch
+
+            bs = batch_size or 64
+            idx_d = jnp.asarray(sel_idx)
+            w_d = jnp.asarray(weights, jnp.float32)
+            refc_d = jnp.asarray(ref_sel_c, jnp.float32)
+            com_d = jnp.asarray(ref_com, jnp.float32)
+            fn = jax.jit(_f32_precision(
+                lambda b: superpose_batch(b, idx_d, w_d, refc_d, com_d)))
+            for a, b in iter_batches(0, len(frames), bs):
+                chunk = frames[a:b]
+                if chunk[-1] - chunk[0] + 1 == len(chunk):
+                    block, boxes = u.trajectory.read_block(chunk[0], chunk[-1] + 1)
+                else:
+                    tss = [u.trajectory[i] for i in chunk]
+                    block = np.stack([ts.positions for ts in tss])
+                    boxes = (np.stack([ts.dimensions for ts in tss])
+                             if tss[0].dimensions is not None else None)
+                if boxes is not None:
+                    dims[a:b] = boxes
+                    have_dims = True
+                padded, mask = pad_batch(block, bs)
+                aligned = np.asarray(fn(jnp.asarray(padded)))
+                out[a:b] = aligned[: b - a]
+
+        u.trajectory = MemoryReader(out, dimensions=dims if have_dims else None)
+        self.results.universe = u
+        return self

@@ -1,0 +1,108 @@
+"""AnalysisBase: the run/prepare/conclude template + backend dispatch.
+
+The central abstraction the reference imports but never uses
+(``from MDAnalysis.analysis import base``, RMSF.py:28 — SURVEY.md calls
+this "a tell that the author intended AnalysisBase integration") and
+BASELINE.json's north_star makes the framework's core: ``run()`` iterates
+the configured frames and only the inner per-frame/per-batch compute
+crosses the executor boundary.
+
+Subclasses implement:
+
+=====================  ========================================================
+``_prepare()``         host setup: compile selections → index arrays, build
+                       reference coords (replaces per-frame selection, Q3)
+``_single_frame(ts)``  serial oracle path: update host accumulators
+``_serial_summary()``  → partials pytree after the serial loop
+``_make_batch_kernel()``  → jittable ``fn(batch (B,S,3) f32, mask (B,))``
+                       → partials pytree (device path)
+``_batch_select()``    indices staged to device (None = all atoms)
+``_combine(a, b)``     host merge of two partials pytrees (float64)
+``_device_combine``    optional ``(partials, axis_name) -> partials`` psum
+                       merge for the mesh backend
+``_identity_partials()``  empty-trajectory partials (Q2)
+``_conclude(total)``   partials → ``self.results``
+=====================  ========================================================
+"""
+
+from __future__ import annotations
+
+from mdanalysis_mpi_tpu.parallel.executors import get_executor
+
+
+class Results(dict):
+    """Attribute-accessible results container (the ``.results`` idiom of
+    the serial oracle, RMSF.py:9-15)."""
+
+    def __getattr__(self, key):
+        try:
+            return self[key]
+        except KeyError:
+            raise AttributeError(
+                f"no result {key!r}; available: {sorted(self)}") from None
+
+    def __setattr__(self, key, value):
+        self[key] = value
+
+
+class AnalysisBase:
+    """Template for trajectory analyses with pluggable backends."""
+
+    _device_combine = None   # subclasses may override with a psum merge
+
+    def __init__(self, universe, verbose: bool = False):
+        self._universe = universe
+        self._verbose = verbose
+        self.results = Results()
+
+    # ---- hooks (see module docstring) ----
+
+    def _prepare(self):
+        pass
+
+    def _single_frame(self, ts):
+        raise NotImplementedError
+
+    def _serial_summary(self):
+        raise NotImplementedError
+
+    def _make_batch_kernel(self):
+        raise NotImplementedError(
+            f"{type(self).__name__} has no batch kernel; use backend='serial'")
+
+    def _batch_select(self):
+        return None
+
+    def _combine(self, a, b):
+        raise NotImplementedError
+
+    def _identity_partials(self):
+        raise NotImplementedError
+
+    def _conclude(self, total):
+        raise NotImplementedError
+
+    # ---- driver ----
+
+    def _frames(self, start, stop, step):
+        n = self._universe.trajectory.n_frames
+        return range(*slice(start, stop, step).indices(n))
+
+    def run(self, start=None, stop=None, step=None,
+            backend: str = "serial", batch_size: int | None = None,
+            **executor_kwargs):
+        """Iterate frames [start:stop:step] on the chosen backend.
+
+        ``backend``: ``"serial"`` (NumPy oracle), ``"jax"``
+        (single-device batched), ``"mesh"`` (sharded over all devices),
+        or an executor instance.  Returns ``self`` (chainable:
+        ``RMSF(ag).run().results.rmsf``, the RMSF.py:15 idiom).
+        """
+        frames = self._frames(start, stop, step)
+        self.n_frames = len(frames)
+        executor = get_executor(backend, **executor_kwargs)
+        self._prepare()
+        total = executor.execute(self, self._universe.trajectory, frames,
+                                 batch_size=batch_size)
+        self._conclude(total)
+        return self

@@ -1,0 +1,277 @@
+"""RMSF / RMSD analyses.
+
+- :class:`RMSF` — per-atom root-mean-square fluctuation of an AtomGroup's
+  coordinates as given (stock ``rms.RMSF`` oracle, RMSF.py:14-15: the
+  user aligns first, e.g. via AlignTraj).
+- :class:`RMSD` — per-frame RMSD time series to a reference frame with
+  optional least-squares superposition (BASELINE config 3; the
+  qcprot use case).
+- :class:`AlignedRMSF` — the entire reference program in one analysis
+  (RMSF.py:53-149): pass 1 average structure, pass 2 aligned Welford
+  moments, Chan/psum merge, ``sqrt(M2.sum(xyz)/T)`` finalize.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mdanalysis_mpi_tpu.analysis.base import AnalysisBase
+from mdanalysis_mpi_tpu.analysis.align import AverageStructure, _reference_sel_coords
+from mdanalysis_mpi_tpu.core.groups import AtomGroup
+from mdanalysis_mpi_tpu.ops import host
+from mdanalysis_mpi_tpu.ops.moments import (
+    merge_moments, psum_moments, rmsf_from_moments,
+)
+
+
+class RMSF(AnalysisBase):
+    """Per-atom RMSF of an AtomGroup: ``RMSF(ag).run().results.rmsf``.
+
+    Computes streaming mean/M2 of the group's coordinates over frames
+    (the reference's pass-2 accumulation, RMSF.py:137-138, minus the
+    alignment — stock ``rms.RMSF`` does not align).  Results:
+    ``rmsf`` (S,), plus ``mean`` (S,3) and ``m2`` (S,3).
+    """
+
+    def __init__(self, atomgroup: AtomGroup, verbose: bool = False):
+        super().__init__(atomgroup.universe, verbose)
+        self._ag = atomgroup
+
+    def _prepare(self):
+        self._idx = self._ag.indices
+        self._stream = host.StreamingMoments((len(self._idx), 3))
+
+    # -- serial path --
+
+    def _single_frame(self, ts):
+        self._stream.update(ts.positions[self._idx].astype(np.float64))
+
+    def _serial_summary(self):
+        return self._stream.summary
+
+    # -- batch path --
+
+    def _batch_select(self):
+        return self._idx
+
+    def _make_batch_kernel(self):
+        from mdanalysis_mpi_tpu.ops.moments import batch_moments
+        return lambda batch, mask: batch_moments(batch, mask)
+
+    def _combine(self, a, b):
+        return merge_moments(a, b)
+
+    def _device_combine(self, partials, axis_name):
+        return psum_moments(*partials, axis_name)
+
+    def _identity_partials(self):
+        z = np.zeros((len(self._idx), 3))
+        return (0.0, z, z.copy())
+
+    def _conclude(self, total):
+        t, mean, m2 = total
+        self.results.mean = np.asarray(mean, np.float64)
+        self.results.m2 = np.asarray(m2, np.float64)
+        self.results.n_frames = int(t)
+        self.results.rmsf = np.asarray(rmsf_from_moments(t, self.results.m2))
+
+
+class RMSD(AnalysisBase):
+    """Per-frame RMSD to a reference frame: ``.results.rmsd`` (n_frames,).
+
+    ``superposition=True`` (default) removes the optimal rigid-body
+    rotation+translation first (the reference's qcprot machinery,
+    RMSF.py:43-51, as used by BASELINE config 3); ``weights="mass"``
+    mass-weights both the fit and the RMSD.
+    """
+
+    def __init__(self, mobile, reference=None, select: str = "all",
+                 ref_frame: int = 0, superposition: bool = True,
+                 weights: str | None = None, verbose: bool = False):
+        universe = mobile.universe if isinstance(mobile, AtomGroup) else mobile
+        super().__init__(universe, verbose)
+        self._mobile = mobile
+        self._reference = reference if reference is not None else universe
+        self._select = select
+        self._ref_frame = ref_frame
+        self._superposition = superposition
+        if weights not in (None, "mass"):
+            raise ValueError(f"weights must be None or 'mass', got {weights!r}")
+        self._weights_mode = weights
+
+    def _prepare(self):
+        if isinstance(self._mobile, AtomGroup):
+            # refine within the group — RMSD(u.select_atoms('segid A'),
+            # select='name CA') must stay restricted to segid A
+            ag = (self._mobile if self._select == "all"
+                  else self._mobile.select_atoms(self._select))
+        else:
+            ag = self._universe.select_atoms(self._select)
+        if ag.n_atoms == 0:
+            raise ValueError(f"selection {self._select!r} matched no atoms")
+        self._idx = ag.indices
+        self._masses = ag.masses
+        self._rmsd_w = (self._masses if self._weights_mode == "mass"
+                        else np.ones(len(self._idx)))
+        self._ref_sel_c, self._ref_com = _reference_sel_coords(
+            self._reference, self._idx, self._masses, self._ref_frame)
+        self._serial_vals: list[float] = []
+
+    # -- serial path --
+
+    def _single_frame(self, ts):
+        sel = ts.positions[self._idx].astype(np.float64)
+        com = host.weighted_center(sel, self._masses)
+        sel_c = sel - com
+        if self._superposition:
+            rot_w = self._masses if self._weights_mode == "mass" else None
+            r = host.qcp_rotation(sel_c, self._ref_sel_c, rot_w)
+            sel_c = sel_c @ r
+        w = self._rmsd_w / self._rmsd_w.sum()
+        d2 = ((sel_c - self._ref_sel_c) ** 2).sum(axis=1)
+        self._serial_vals.append(float(np.sqrt(d2 @ w)))
+
+    def _serial_summary(self):
+        vals = np.asarray(self._serial_vals)
+        return (vals, np.ones(len(vals)))
+
+    # -- batch path --
+
+    def _batch_select(self):
+        return self._idx
+
+    def _make_batch_kernel(self):
+        import jax.numpy as jnp
+
+        from mdanalysis_mpi_tpu.ops.rmsd import rmsd_batch
+
+        masses = jnp.asarray(self._masses, jnp.float32)
+        rmsd_w = jnp.asarray(self._rmsd_w, jnp.float32)
+        ref_c = jnp.asarray(self._ref_sel_c, jnp.float32)
+        superposition = self._superposition
+        rot_w = masses if self._weights_mode == "mass" else None
+
+        def kernel(batch, mask):
+            vals = rmsd_batch(batch, masses, ref_c,
+                              superposition=superposition,
+                              rot_weights=rot_w, rmsd_weights=rmsd_w)
+            return (vals * mask, mask)
+
+        return kernel
+
+    def _combine(self, a, b):
+        # order-preserving concatenation: executors process batches and
+        # device shards in frame order
+        return (np.concatenate([a[0], b[0]]), np.concatenate([a[1], b[1]]))
+
+    _device_combine = None   # keep per-device series, concat on host
+
+    def _identity_partials(self):
+        return (np.empty(0), np.empty(0))
+
+    def _conclude(self, total):
+        vals, mask = total
+        self.results.rmsd = np.asarray(vals)[np.asarray(mask) > 0.5]
+
+
+class AlignedRMSF(AnalysisBase):
+    """The reference program end-to-end: average structure, then RMSF of
+    the selection after superposition onto that average
+    (RMSF.py:53-149; serial oracle RMSF.py:1-18).
+
+    Results: ``rmsf`` (S,), ``average`` (S, 3) — the average selection
+    structure, ``mean``/``m2`` moment arrays.
+    """
+
+    def __init__(self, universe, select: str = "protein and name CA",
+                 ref_frame: int = 0, verbose: bool = False):
+        super().__init__(universe, verbose)
+        self._select = select
+        self._ref_frame = ref_frame
+
+    def run(self, start=None, stop=None, step=None, backend: str = "serial",
+            batch_size: int | None = None, **kwargs):
+        # Pass 1 (RMSF.py:76-113): average of aligned selection coords.
+        # The lean select_only path is exact for pass 2, which only needs
+        # the selection's average (SURVEY.md quirk Q5 discussion).
+        avg = AverageStructure(
+            self._universe, select=self._select, ref_frame=self._ref_frame,
+            select_only=True, verbose=self._verbose,
+        ).run(start, stop, step, backend=backend, batch_size=batch_size,
+              **kwargs)
+        self._avg_sel = avg.results.positions           # (S, 3) float64
+
+        # Pass 2 (RMSF.py:115-143): moments of coords aligned to the average.
+        moments_pass = _MomentsToReference(
+            self._universe, self._select, self._avg_sel, self._verbose)
+        moments_pass.run(start, stop, step, backend=backend,
+                         batch_size=batch_size, **kwargs)
+        t, mean, m2 = moments_pass._total
+        self.n_frames = int(t)
+        self.results.average = self._avg_sel
+        self.results.mean = mean
+        self.results.m2 = m2
+        # RMSF.py:146: sqrt(M2.sum(axis=xyz)/T)
+        self.results.rmsf = np.asarray(rmsf_from_moments(t, m2))
+        return self
+
+
+class _MomentsToReference(AnalysisBase):
+    """Pass 2 of the reference: superpose the selection onto fixed
+    reference coords, accumulate Welford moments (RMSF.py:115-143)."""
+
+    def __init__(self, universe, select, ref_sel_positions, verbose=False):
+        super().__init__(universe, verbose)
+        self._select = select
+        self._ref_sel_positions = ref_sel_positions
+
+    def _prepare(self):
+        ag = self._universe.select_atoms(self._select)
+        self._idx = ag.indices
+        self._masses = ag.masses
+        # center the average-structure reference (RMSF.py:116-118)
+        com = host.weighted_center(self._ref_sel_positions, self._masses)
+        self._ref_sel_c = self._ref_sel_positions - com
+        self._ref_com = com
+        self._stream = host.StreamingMoments((len(self._idx), 3))
+
+    def _single_frame(self, ts):
+        sel = ts.positions[self._idx].astype(np.float64)
+        com = host.weighted_center(sel, self._masses)
+        r = host.qcp_rotation(sel - com, self._ref_sel_c)
+        self._stream.update((sel - com) @ r + self._ref_com)
+
+    def _serial_summary(self):
+        return self._stream.summary
+
+    def _batch_select(self):
+        return self._idx
+
+    def _make_batch_kernel(self):
+        import jax.numpy as jnp
+
+        from mdanalysis_mpi_tpu.ops.align import superpose_selection_batch
+        from mdanalysis_mpi_tpu.ops.moments import batch_moments
+
+        w = jnp.asarray(self._masses, jnp.float32)
+        ref_c = jnp.asarray(self._ref_sel_c, jnp.float32)
+        ref_com = jnp.asarray(self._ref_com, jnp.float32)
+
+        def kernel(batch, mask):
+            aligned = superpose_selection_batch(batch, w, ref_c, ref_com)
+            return batch_moments(aligned, mask)
+
+        return kernel
+
+    def _combine(self, a, b):
+        return merge_moments(a, b)
+
+    def _device_combine(self, partials, axis_name):
+        return psum_moments(*partials, axis_name)
+
+    def _identity_partials(self):
+        z = np.zeros((len(self._idx), 3))
+        return (0.0, z, z.copy())
+
+    def _conclude(self, total):
+        self._total = total

@@ -1,0 +1,14 @@
+"""JAX compute kernels (reference layer L3, SURVEY.md §1).
+
+Pure functions over arrays; everything here is jit/vmap/shard_map-safe:
+static shapes, no Python control flow on traced values.  The NumPy oracle
+twins (independent algorithms, e.g. QCP-by-eigendecomposition instead of
+Kabsch-by-SVD) live in :mod:`mdanalysis_mpi_tpu.ops.host` and back the
+serial executor + differential tests (SURVEY.md §4).
+"""
+
+# Export submodules only — re-exporting functions here would shadow the
+# `rmsd` module with the `rmsd` function.
+from mdanalysis_mpi_tpu.ops import align, host, moments, rmsd
+
+__all__ = ["align", "host", "moments", "rmsd"]

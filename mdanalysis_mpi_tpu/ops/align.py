@@ -1,0 +1,101 @@
+"""Least-squares superposition kernels (JAX).
+
+TPU-native replacement for the reference's per-frame QCP alignment
+(``qcp.CalcRMSDRotationalMatrix`` wrapped at RMSF.py:43-51, applied at
+RMSF.py:99-101/133-135): Kabsch via SVD of the 3x3 correlation matrix,
+vmapped over a frame batch.  SURVEY.md §4 verified Kabsch-SVD yields the
+same optimal rotation/RMSD as QCP's largest-eigenvalue form to ~1e-15.
+
+Conventions (empirically pinned, see tests/test_ops.py):
+coordinates are row vectors; ``H = mobileᵀ @ ref``; the optimal rotation
+is ``R = U @ diag(1,1,d) @ Vᵀ`` with ``d = sign(det(U@Vᵀ))``, applied as
+``aligned = mobile @ R`` — matching the reference's ``np.dot(ts.positions,
+rotation)`` orientation (RMSF.py:100).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# TPU matmuls default to bfloat16 passes — a ~1e-2 relative error that is
+# unacceptable for superposition geometry.  All contractions here have
+# K=3 or K=S·3 with tiny outputs, so full-f32 costs ~nothing (precision
+# policy, SURVEY.md §7 "Precision policy (Q4)").
+_HI = jax.lax.Precision.HIGHEST
+
+
+def weighted_center(x: jax.Array, weights: jax.Array) -> jax.Array:
+    """Mass-weighted center: x (..., N, 3), weights (N,) → (..., 3).
+
+    Reference: ``AtomGroup.center_of_mass()`` at RMSF.py:84,94.
+    """
+    w = weights / weights.sum()
+    return jnp.einsum("...ni,n->...i", x, w, precision=_HI)
+
+
+def kabsch_rotation(mobile: jax.Array, ref: jax.Array,
+                    weights: jax.Array | None = None) -> jax.Array:
+    """Optimal rotation R (3,3) minimizing ||mobile @ R - ref||_w.
+
+    Both inputs must be centered (N, 3).  The 3x3 SVD is tiny and
+    TPU-safe; XLA fuses the surrounding einsums into the MXU.
+    """
+    if weights is not None:
+        H = jnp.einsum("ni,n,nj->ij", mobile, weights, ref, precision=_HI)
+    else:
+        H = jnp.einsum("ni,nj->ij", mobile, ref, precision=_HI)
+    U, _, Vt = jnp.linalg.svd(H, full_matrices=False)
+    d = jnp.sign(jnp.linalg.det(jnp.matmul(U, Vt, precision=_HI)))
+    # fold the det-correction into U's last column instead of a diag matmul
+    U = U.at[:, -1].multiply(d)
+    return jnp.matmul(U, Vt, precision=_HI)
+
+
+kabsch_rotation_batch = jax.vmap(kabsch_rotation, in_axes=(0, None, None))
+
+
+def superpose_batch(
+    coords: jax.Array,            # (B, N, 3) all-atom frame batch
+    sel_idx: jax.Array,           # (S,) int selection indices (static gather)
+    sel_weights: jax.Array,       # (S,) masses of the selection (COM weights)
+    ref_sel_centered: jax.Array,  # (S, 3) centered reference selection coords
+    ref_com: jax.Array,           # (3,) reference center of mass
+    rot_weights: jax.Array | None = None,  # Kabsch weights; None = unweighted
+) -> jax.Array:
+    """Superpose every frame onto the reference via the selection.
+
+    The batched equivalent of the reference's per-frame body
+    (RMSF.py:92-101): gather selection → mass-weighted mobile COM →
+    Kabsch rotation from the selection → rotate ALL atoms → translate
+    onto ref_com (quirk Q5: rotation is fit on the selection but applied
+    to all atoms).  Default ``rot_weights=None`` mirrors the reference's
+    ``CalcRMSDRotationalMatrix(..., weights=None)`` (RMSF.py:48): the
+    COM is mass-weighted but the rotation fit is unweighted.  Returns
+    the aligned (B, N, 3) batch; pure (no in-place mutation, unlike
+    RMSF.py:99-101).
+    """
+    sel = coords[:, sel_idx]                                   # (B,S,3)
+    com = weighted_center(sel, sel_weights)                    # (B,3)
+    sel_c = sel - com[:, None, :]
+    R = kabsch_rotation_batch(sel_c, ref_sel_centered, rot_weights)  # (B,3,3)
+    return jnp.einsum("bni,bij->bnj", coords - com[:, None, :], R, precision=_HI) + ref_com
+
+
+def superpose_selection_batch(
+    sel_coords: jax.Array,        # (B, S, 3) selection-only frame batch
+    sel_weights: jax.Array,       # (S,) COM weights
+    ref_sel_centered: jax.Array,  # (S, 3)
+    ref_com: jax.Array,           # (3,)
+    rot_weights: jax.Array | None = None,
+) -> jax.Array:
+    """Lean path: superpose only the selection atoms (no all-atom gather).
+
+    Used when downstream consumes just the selection (e.g. RMSF pass 2
+    only accumulates Cα moments, RMSF.py:137-138) — avoids streaming the
+    full 100k-atom frames through HBM when S << N.
+    """
+    com = weighted_center(sel_coords, sel_weights)
+    sel_c = sel_coords - com[:, None, :]
+    R = kabsch_rotation_batch(sel_c, ref_sel_centered, rot_weights)
+    return jnp.einsum("bni,bij->bnj", sel_c, R, precision=_HI) + ref_com

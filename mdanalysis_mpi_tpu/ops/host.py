@@ -1,0 +1,98 @@
+"""NumPy oracle kernels for the serial host backend.
+
+Independent implementations of the device kernels — deliberately using
+*different algorithms* where possible (QCP quaternion eigendecomposition
+instead of Kabsch-SVD; per-frame streaming Welford instead of batch
+moments) so the differential tests between backends (SURVEY.md §4) are
+meaningful.  This module is also the stand-in for the reference's 8-rank
+MPI baseline in benchmarks (BASELINE.md: "the 8-rank MPI baseline is
+represented by this repo's own serial/multiprocess NumPy backend").
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def qcp_rotation(mobile: np.ndarray, ref: np.ndarray,
+                 weights: np.ndarray | None = None) -> np.ndarray:
+    """Optimal rotation via Theobald's QCP formulation.
+
+    The same mathematical object the reference gets from
+    ``qcp.CalcRMSDRotationalMatrix`` (RMSF.py:48), computed here by
+    direct symmetric eigendecomposition of the 4x4 quaternion key matrix
+    (host eigh replaces upstream's Newton iteration on the
+    characteristic polynomial — same largest eigenvalue/eigenvector).
+    Inputs centered (N, 3) float64; returns R (3,3) applied as
+    ``mobile @ R`` (the reference's ``np.dot(positions, R)`` orientation,
+    RMSF.py:100).
+    """
+    if weights is not None:
+        m = np.einsum("ni,n,nj->ij", mobile, weights, ref)
+    else:
+        m = mobile.T @ ref
+    sxx, sxy, sxz = m[0]
+    syx, syy, syz = m[1]
+    szx, szy, szz = m[2]
+    k = np.array([
+        [sxx + syy + szz, syz - szy, szx - sxz, sxy - syx],
+        [syz - szy, sxx - syy - szz, sxy + syx, szx + sxz],
+        [szx - sxz, sxy + syx, -sxx + syy - szz, syz + szy],
+        [sxy - syx, szx + sxz, syz + szy, -sxx - syy + szz],
+    ])
+    vals, vecs = np.linalg.eigh(k)
+    q0, q1, q2, q3 = vecs[:, -1]          # eigenvector of λ_max
+    rq = np.array([
+        [q0*q0 + q1*q1 - q2*q2 - q3*q3, 2*(q1*q2 - q0*q3), 2*(q1*q3 + q0*q2)],
+        [2*(q1*q2 + q0*q3), q0*q0 - q1*q1 + q2*q2 - q3*q3, 2*(q2*q3 - q0*q1)],
+        [2*(q1*q3 - q0*q2), 2*(q2*q3 + q0*q1), q0*q0 - q1*q1 - q2*q2 + q3*q3],
+    ])
+    # quaternion matrix rotates column vectors; row-vector convention
+    # needs the transpose (pinned empirically, tests/test_ops.py)
+    return rq.T
+
+
+def weighted_center(x: np.ndarray, weights: np.ndarray) -> np.ndarray:
+    w = weights / weights.sum()
+    return np.einsum("...ni,n->...i", x.astype(np.float64), w)
+
+
+def superpose_frame(
+    coords: np.ndarray,            # (N, 3) one frame, all atoms
+    sel_idx: np.ndarray,
+    sel_weights: np.ndarray,
+    ref_sel_centered: np.ndarray,  # (S, 3) float64
+    ref_com: np.ndarray,
+    rot_weights: np.ndarray | None = None,
+) -> np.ndarray:
+    """Per-frame superposition, the reference's hot-loop body shape
+    (RMSF.py:92-101) without the in-place mutation.  Mass-weighted COM,
+    unweighted rotation by default (RMSF.py:48 ``weights=None``)."""
+    sel = coords[sel_idx].astype(np.float64)
+    com = weighted_center(sel, sel_weights)
+    r = qcp_rotation(sel - com, ref_sel_centered, rot_weights)
+    return (coords.astype(np.float64) - com) @ r + ref_com
+
+
+class StreamingMoments:
+    """Per-frame streaming Welford accumulator, float64.
+
+    The reference's recurrence (RMSF.py:137-138):
+    ``M2 += (k/(k+1))·(x − mean)²; mean = (k·mean + x)/(k+1)`` — the M2
+    update must read the *pre-update* mean (SURVEY.md §3.3).
+    """
+
+    def __init__(self, shape):
+        self.t = 0
+        self.mean = np.zeros(shape, dtype=np.float64)
+        self.m2 = np.zeros(shape, dtype=np.float64)
+
+    def update(self, x: np.ndarray):
+        k = self.t
+        self.m2 += (k / (k + 1.0)) * (x - self.mean) ** 2
+        self.mean = (k * self.mean + x) / (k + 1.0)
+        self.t = k + 1
+
+    @property
+    def summary(self):
+        return self.t, self.mean, self.m2

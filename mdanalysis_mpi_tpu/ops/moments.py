@@ -1,0 +1,103 @@
+"""Streaming-moment kernels: batched Welford + Chan parallel merge.
+
+The reference accumulates per-atom mean and sum-of-squared-deviations one
+frame at a time (Welford form, RMSF.py:137-138) and merges per-rank
+partials with Chan et al.'s pairwise formula (``second_order_moments``,
+RMSF.py:36-41) through a pickled MPI reduce (RMSF.py:143).  Here the
+recurrence is replaced by the algebraically identical *batch* form — one
+masked reduction per frame batch — and the cross-batch / cross-chip merge
+is either the Chan pairwise merge (host, float64) or a two-``psum``
+k-way merge via the law of total variance (device mesh), both exact
+(associativity verified in SURVEY.md §4).
+
+A moment summary is the triple ``(T, mean, M2)``:
+``T`` frames counted (scalar), ``mean`` (..., 3), ``M2`` = sum of squared
+deviations from the mean (..., 3) — exactly the reference's per-rank
+state ``S = [stop-start, mean, sumsquares]`` (RMSF.py:140).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# TPU matmuls default to bfloat16 passes; these reductions are
+# accuracy-critical and tiny-K, so pin them to full float32 (precision
+# policy, SURVEY.md §7 "Precision policy (Q4)").
+_HI = jax.lax.Precision.HIGHEST
+
+
+def batch_moments(x: jax.Array, mask: jax.Array | None = None):
+    """Moments of a frame batch in one pass.
+
+    x: (B, N, 3) aligned coordinates; mask: (B,) 1.0 for valid frames,
+    0.0 for padding (quirk Q2: short/empty blocks are padded, the mask
+    keeps the counts honest).  Returns (T, mean, M2) with mean/M2 of
+    shape (N, 3).  For T == 0, mean and M2 are 0 (a merge with the
+    identity leaves the other operand unchanged).
+    """
+    if mask is None:
+        t = jnp.asarray(x.shape[0], x.dtype)
+        s = x.sum(axis=0)
+        mean = s / jnp.maximum(t, 1.0)
+        m2 = ((x - mean) ** 2).sum(axis=0)
+    else:
+        mask = mask.astype(x.dtype)
+        t = mask.sum()
+        s = jnp.einsum("b,bni->ni", mask, x, precision=_HI)
+        mean = s / jnp.maximum(t, 1.0)
+        m2 = jnp.einsum("b,bni->ni", mask, (x - mean) ** 2, precision=_HI)
+    return t, mean, m2
+
+
+def merge_moments(s1, s2):
+    """Chan pairwise merge of two (T, mean, M2) summaries (RMSF.py:36-41).
+
+    Works on NumPy or JAX arrays.  Safe for empty partials (T==0), unlike
+    the reference which divides by T1+T2 unconditionally (quirk Q2).
+    """
+    t1, mu1, m21 = s1
+    t2, mu2, m22 = s2
+    t = t1 + t2
+    xp = jnp if isinstance(mu1, jax.Array) or isinstance(mu2, jax.Array) else np
+    denom = xp.maximum(t, 1) if xp is jnp else max(t, 1)
+    mu = (t1 * mu1 + t2 * mu2) / denom
+    m2 = m21 + m22 + (t1 * t2 / denom) * (mu2 - mu1) ** 2
+    return t, mu, m2
+
+
+def reduce_moments(summaries):
+    """Fold a list of summaries left-to-right with the Chan merge
+    (host-side, float64 recommended).  Replaces ``comm.reduce(...,
+    op=second_order_moments)`` (RMSF.py:143) for the batch stream."""
+    it = iter(summaries)
+    try:
+        acc = next(it)
+    except StopIteration:
+        raise ValueError("reduce_moments needs at least one summary") from None
+    for s in it:
+        acc = merge_moments(acc, s)
+    return acc
+
+
+def psum_moments(t, mean, m2, axis_name: str):
+    """K-way moment merge across a mesh axis inside shard_map/pmap.
+
+    Law of total variance:
+    ``M2_tot = Σ_k M2_k + Σ_k T_k·(μ_k − μ_tot)²`` — two ``psum``s, no
+    Python-level fold.  This is the TPU-native replacement for the
+    reference's custom-op pickle reduce (RMSF.py:142-143, SURVEY.md
+    §3.4), exact because the merge is associative/commutative.
+    """
+    t_tot = jax.lax.psum(t, axis_name)
+    sum_tot = jax.lax.psum(t * mean, axis_name)
+    mean_tot = sum_tot / jnp.maximum(t_tot, 1.0)
+    m2_tot = jax.lax.psum(m2 + t * (mean - mean_tot) ** 2, axis_name)
+    return t_tot, mean_tot, m2_tot
+
+
+def rmsf_from_moments(t, m2):
+    """Finalize: RMSF_i = sqrt(Σ_xyz M2_i / T) (reference RMSF.py:146)."""
+    xp = jnp if isinstance(m2, jax.Array) else np
+    return xp.sqrt(m2.sum(axis=-1) / xp.maximum(t, 1))

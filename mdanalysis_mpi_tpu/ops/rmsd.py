@@ -1,0 +1,45 @@
+"""RMSD kernels (BASELINE config 3: RMSD time series with least-squares
+superposition to a reference frame)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from mdanalysis_mpi_tpu.ops.align import _HI, kabsch_rotation_batch, weighted_center
+
+
+def rmsd(a: jax.Array, b: jax.Array,
+         weights: jax.Array | None = None) -> jax.Array:
+    """Plain (no-fit) weighted RMSD between conformations a, b (N, 3)."""
+    d2 = ((a - b) ** 2).sum(axis=-1)
+    if weights is None:
+        return jnp.sqrt(d2.mean(axis=-1))
+    w = weights / weights.sum()
+    return jnp.sqrt(jnp.einsum("...n,n->...", d2, w, precision=_HI))
+
+
+def rmsd_batch(
+    coords: jax.Array,            # (B, S, 3) selection coords per frame
+    com_weights: jax.Array,       # (S,) weights for the COM translation
+    ref_sel_centered: jax.Array,  # (S, 3)
+    superposition: bool = True,
+    rot_weights: jax.Array | None = None,   # Kabsch fit weights
+    rmsd_weights: jax.Array | None = None,  # RMSD averaging weights
+) -> jax.Array:
+    """Per-frame RMSD to the reference, optionally after optimal
+    superposition (the reference's qcprot use case, BASELINE config 3).
+
+    Weights are split three ways to express both conventions: the
+    reference's (mass-weighted COM, unweighted fit — RMSF.py:48,94) and
+    fully mass-weighted RMSD (``rot_weights=rmsd_weights=masses``).
+    Returns (B,) float.  The minimized RMSD is computed from the aligned
+    residual (not the QCP eigenvalue shortcut) so the same code serves
+    the superposition=False path.
+    """
+    com = weighted_center(coords, com_weights)
+    cc = coords - com[:, None, :]
+    if superposition:
+        rot = kabsch_rotation_batch(cc, ref_sel_centered, rot_weights)
+        cc = jnp.einsum("bni,bij->bnj", cc, rot, precision=_HI)
+    return rmsd(cc, ref_sel_centered, rmsd_weights)

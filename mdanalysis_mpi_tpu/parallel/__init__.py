@@ -1,0 +1,20 @@
+"""Parallel scheduling + execution (reference layers L4/L5, SURVEY.md §1).
+
+- :mod:`partition` — frame partitioner generalizing the reference's
+  static block decomposition (RMSF.py:65-72) with padding + masks for
+  the short-trajectory edge cases (quirk Q2).
+- :mod:`executors` — the pluggable backend layer the reference lacks
+  (BASELINE.json north_star): serial NumPy oracle, JAX single-device,
+  and JAX mesh (shard_map + psum over the data axis, replacing
+  ``comm.Allreduce``/``comm.reduce``, RMSF.py:110,143).
+"""
+
+from mdanalysis_mpi_tpu.parallel.partition import static_blocks, iter_batches
+from mdanalysis_mpi_tpu.parallel.executors import (
+    SerialExecutor, JaxExecutor, MeshExecutor, get_executor,
+)
+
+__all__ = [
+    "static_blocks", "iter_batches",
+    "SerialExecutor", "JaxExecutor", "MeshExecutor", "get_executor",
+]

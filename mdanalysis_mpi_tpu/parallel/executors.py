@@ -1,0 +1,200 @@
+"""Pluggable execution backends.
+
+The dispatch point BASELINE.json's north_star prescribes: "only the inner
+``_single_frame`` compute crosses the backend boundary".  An analysis
+(:class:`~mdanalysis_mpi_tpu.analysis.base.AnalysisBase`) exposes
+
+- ``_single_frame(ts)`` + ``_serial_summary()``   (host oracle path), and
+- ``_make_batch_kernel() -> fn(batch, mask) -> partials`` with
+  ``_combine(a, b)`` / optional ``_device_combine(partials, axis)``
+  (device batch path),
+
+and the executors below schedule those over the trajectory:
+
+- :class:`SerialExecutor` — per-frame NumPy loop; the reference's
+  single-rank behavior and the differential-test oracle.
+- :class:`JaxExecutor` — single-device: frame blocks staged host→HBM,
+  one jitted batch kernel per block, Chan-merge across blocks on host
+  in float64 (precision policy, SURVEY.md §7 hard parts).
+- :class:`MeshExecutor` — multi-device: batches sharded over the mesh
+  data axis via ``shard_map``; cross-chip merge by the analysis'
+  ``_device_combine`` (``jax.lax.psum``-based — the TPU-native
+  replacement for ``comm.Allreduce``/``comm.reduce``,
+  RMSF.py:110,143).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mdanalysis_mpi_tpu.parallel.partition import iter_batches, pad_batch
+
+
+def _f32_precision(fn):
+    """Trace ``fn`` under full-float32 matmul precision.
+
+    TPU matmuls (including jnp.linalg internals the explicit
+    ``precision=`` pins in ops/ can't reach) default to bfloat16 passes —
+    a ~1e-2 relative error that breaks superposition geometry.  The
+    kernels are bandwidth-bound with tiny contraction dims, so full f32
+    is effectively free (precision policy, SURVEY.md §7 Q4).
+    """
+    import functools
+
+    import jax
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        with jax.default_matmul_precision("float32"):
+            return fn(*args, **kwargs)
+
+    return wrapped
+
+
+def _stage(reader, frames: list[int], sel_idx) -> np.ndarray:
+    """Read ``frames`` → float32 (b, S, 3) with optional host-side
+    selection gather (gathering before device_put slashes host→HBM
+    traffic when S << N)."""
+    if len(frames) == 0:
+        n = reader.n_atoms if sel_idx is None else len(sel_idx)
+        return np.empty((0, n, 3), dtype=np.float32)
+    contiguous = frames[-1] - frames[0] + 1 == len(frames)
+    if contiguous:
+        block, _ = reader.read_block(frames[0], frames[-1] + 1)
+    else:
+        block = np.stack([reader[i].positions for i in frames])
+    return block if sel_idx is None else block[:, sel_idx]
+
+
+class SerialExecutor:
+    """Frame-at-a-time host loop (the reference's per-rank body,
+    RMSF.py:91-103/123-138, minus MPI)."""
+
+    name = "serial"
+
+    def execute(self, analysis, reader, frames, batch_size=None):
+        for i in frames:
+            analysis._single_frame(reader[i])
+        return analysis._serial_summary()
+
+
+class JaxExecutor:
+    """Single-device batch pipeline: stage block → jitted kernel →
+    host float64 Chan merge across blocks."""
+
+    name = "jax"
+
+    def __init__(self, batch_size: int = 128, device=None):
+        self.batch_size = batch_size
+        self.device = device
+
+    def execute(self, analysis, reader, frames, batch_size=None):
+        import jax
+
+        bs = batch_size or self.batch_size
+        kernel = jax.jit(_f32_precision(analysis._make_batch_kernel()))
+        sel_idx = analysis._batch_select()
+        frames = list(frames)
+        total = None
+        for a, b in iter_batches(0, len(frames), bs):
+            block = _stage(reader, frames[a:b], sel_idx)
+            padded, mask = pad_batch(block, bs)
+            partials = kernel(padded, mask)
+            partials = jax.tree.map(lambda x: np.asarray(x, np.float64),
+                                    partials)
+            total = partials if total is None else analysis._combine(total, partials)
+        if total is None:
+            total = analysis._identity_partials()
+        return total
+
+
+class MeshExecutor:
+    """Data-parallel mesh pipeline.
+
+    Frames are sharded over the ``data`` mesh axis; each device runs the
+    batch kernel on its shard and the cross-device merge happens on-chip
+    via the analysis' ``_device_combine`` (psum over ICI).  This is the
+    TPU-native image of the reference's SPMD ranks + collectives
+    (SURVEY.md §2.3 "DP over frames").
+    """
+
+    name = "mesh"
+
+    def __init__(self, batch_size: int = 64, devices=None,
+                 axis_name: str = "data"):
+        self.batch_size = batch_size
+        self.devices = devices
+        self.axis_name = axis_name
+
+    def _build(self, analysis):
+        import jax
+        from jax import shard_map
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        devices = self.devices if self.devices is not None else jax.devices()
+        mesh = Mesh(np.asarray(devices), (self.axis_name,))
+        kernel = _f32_precision(analysis._make_batch_kernel())
+        devcombine = analysis._device_combine
+
+        def shard_fn(batch, mask):
+            partials = kernel(batch, mask)
+            if devcombine is not None:
+                return devcombine(partials, self.axis_name)
+            return partials
+
+        out_specs = P() if devcombine is not None else P(self.axis_name)
+        # check_vma=False: jnp.linalg.svd lowers to an iterative scan on
+        # TPU whose bool carry trips the varying-manual-axes check inside
+        # shard_map (works on CPU, fails on TPU); the kernel is purely
+        # per-shard + explicit psum, so the check adds nothing here.
+        gfn = jax.jit(shard_map(
+            shard_fn, mesh=mesh,
+            in_specs=(P(self.axis_name), P(self.axis_name)),
+            out_specs=out_specs, check_vma=False))
+        sharding = NamedSharding(mesh, P(self.axis_name))
+        return len(devices), gfn, sharding
+
+    def execute(self, analysis, reader, frames, batch_size=None):
+        import jax
+
+        bs = batch_size or self.batch_size
+        n_dev, gfn, sharding = self._build(analysis)
+        global_bs = bs * n_dev
+        sel_idx = analysis._batch_select()
+        frames = list(frames)
+        total = None
+        for a, b in iter_batches(0, len(frames), global_bs):
+            block = _stage(reader, frames[a:b], sel_idx)
+            padded, mask = pad_batch(block, global_bs)
+            padded = jax.device_put(padded, sharding)
+            mask = jax.device_put(mask, sharding)
+            partials = gfn(padded, mask)
+            # With _device_combine, outputs are replicated merged partials;
+            # without, out_specs=P(axis) concatenates per-device outputs
+            # along axis 0 in device (= frame) order — either way one
+            # partials pytree per global batch.
+            part = jax.tree.map(lambda x: np.asarray(x, np.float64), partials)
+            total = part if total is None else analysis._combine(total, part)
+        if total is None:
+            total = analysis._identity_partials()
+        return total
+
+
+_EXECUTORS = {
+    "serial": SerialExecutor,
+    "jax": JaxExecutor,
+    "mesh": MeshExecutor,
+}
+
+
+def get_executor(backend, **kwargs):
+    """Resolve a backend name or instance → executor instance."""
+    if hasattr(backend, "execute"):
+        return backend
+    try:
+        cls = _EXECUTORS[backend]
+    except KeyError:
+        raise ValueError(
+            f"unknown backend {backend!r}; available: {sorted(_EXECUTORS)}"
+        ) from None
+    return cls(**kwargs)

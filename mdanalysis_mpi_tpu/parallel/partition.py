@@ -1,0 +1,69 @@
+"""Frame partitioning.
+
+Generalizes the reference's static block decomposition (RMSF.py:65-72:
+``n_frames // size`` per rank, last rank absorbs the remainder) into a
+balanced partition (block sizes differ by at most 1 — avoids the
+reference's pathological last block) and explicit handling of the
+``size > n_frames`` / ``n_frames == 0`` failure modes (quirk Q2, which
+crashes the reference with ZeroDivisionError).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def static_blocks(n_frames: int, n_blocks: int) -> list[range]:
+    """Partition ``range(n_frames)`` into ``n_blocks`` contiguous blocks.
+
+    Balanced: each block gets ``n_frames // n_blocks`` frames and the
+    first ``n_frames % n_blocks`` blocks get one extra.  Blocks may be
+    empty when ``n_blocks > n_frames`` — callers handle empties via
+    masks, not crashes (Q2).
+    """
+    if n_blocks < 1:
+        raise ValueError(f"n_blocks must be >= 1, got {n_blocks}")
+    if n_frames < 0:
+        raise ValueError(f"n_frames must be >= 0, got {n_frames}")
+    base = n_frames // n_blocks
+    extra = n_frames % n_blocks
+    blocks = []
+    start = 0
+    for i in range(n_blocks):
+        size = base + (1 if i < extra else 0)
+        blocks.append(range(start, start + size))
+        start += size
+    return blocks
+
+
+def iter_batches(start: int, stop: int, batch_size: int):
+    """Yield (a, b) batch bounds covering [start, stop) in chunks of at
+    most ``batch_size`` frames."""
+    if batch_size < 1:
+        raise ValueError(f"batch_size must be >= 1, got {batch_size}")
+    a = start
+    while a < stop:
+        b = min(a + batch_size, stop)
+        yield a, b
+        a = b
+
+
+def pad_batch(batch: np.ndarray, batch_size: int):
+    """Pad a (b, ...) frame batch to ``batch_size`` along axis 0 and
+    return (padded, mask) where mask is float32 (batch_size,) with 1.0
+    for real frames.  Static shapes for XLA (SURVEY.md §7 hard parts);
+    padding rows repeat the last frame (any finite values — the mask
+    zeroes their contribution)."""
+    b = batch.shape[0]
+    if b > batch_size:
+        raise ValueError(f"batch of {b} frames exceeds batch_size {batch_size}")
+    mask = np.zeros(batch_size, dtype=np.float32)
+    mask[:b] = 1.0
+    if b == batch_size:
+        return batch, mask
+    if b == 0:
+        pad = np.zeros((batch_size,) + batch.shape[1:], dtype=batch.dtype)
+        return pad, mask
+    pad = np.concatenate(
+        [batch, np.repeat(batch[-1:], batch_size - b, axis=0)], axis=0)
+    return pad, mask

@@ -1,0 +1,5 @@
+"""Utilities: phase timers, config, logging (SURVEY.md §5).
+
+The reference has no observability beyond one print (RMSF.py:74); this
+package holds the framework's timing/config/logging subsystems.
+"""

@@ -8,10 +8,20 @@ without hardware (SURVEY.md §4 "Distributed without a cluster").
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force, not setdefault: the environment pre-sets JAX_PLATFORMS=axon (TPU),
+# and the axon site hook re-asserts it, so the env var alone is not enough —
+# jax.config.update below is what actually takes effect.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+try:
+    import jax  # noqa: E402
+except ImportError:
+    pass  # core-only tests (topology/selection) don't need JAX
+else:
+    jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,199 @@
+"""Analysis-layer tests: the executable version of the reference's
+"SAME AS" differential strategy (SURVEY.md §4) — serial NumPy oracle vs
+JAX single-device vs 8-device mesh must agree on identical synthetic
+trajectories, plus analytic oracles (rigid motion → RMSF 0)."""
+
+import numpy as np
+import pytest
+
+from mdanalysis_mpi_tpu.analysis import (
+    RMSD, RMSF, AlignedRMSF, AlignTraj, AverageStructure,
+)
+from mdanalysis_mpi_tpu.testing import make_protein_universe
+
+BACKENDS = ["serial", "jax", "mesh"]
+
+
+@pytest.fixture(scope="module")
+def uni():
+    return make_protein_universe(n_residues=12, n_frames=30, noise=0.25, seed=3)
+
+
+# ---------------- AverageStructure ----------------
+
+def test_average_structure_backends_agree(uni):
+    results = {}
+    for b in BACKENDS:
+        avg = AverageStructure(uni, select="protein and name CA").run(
+            backend=b, batch_size=8)
+        results[b] = avg.results.positions
+    np.testing.assert_allclose(results["jax"], results["serial"], atol=2e-4)
+    np.testing.assert_allclose(results["mesh"], results["serial"], atol=2e-4)
+
+
+def test_average_structure_universe_rebuild(uni):
+    avg = AverageStructure(uni, select="protein and name CA").run(backend="jax")
+    u2 = avg.results.universe
+    assert u2.trajectory.n_frames == 1        # RMSF.py:113 analog
+    assert u2.topology is uni.topology
+    np.testing.assert_allclose(u2.atoms.positions, avg.results.positions,
+                               atol=1e-3)
+
+
+def test_average_structure_select_only_matches_wide(uni):
+    wide = AverageStructure(uni, select="protein and name CA").run(backend="jax")
+    lean = AverageStructure(uni, select="protein and name CA",
+                            select_only=True).run(backend="jax")
+    idx = uni.select_atoms("protein and name CA").indices
+    np.testing.assert_allclose(lean.results.positions,
+                               wide.results.positions[idx], atol=2e-4)
+
+
+# ---------------- RMSF ----------------
+
+def test_rmsf_rigid_motion_is_zero():
+    """Analytic oracle: pure rigid motion + alignment → RMSF ≈ 0."""
+    u = make_protein_universe(n_residues=10, n_frames=12, noise=0.0)
+    r = AlignedRMSF(u, select="protein and name CA").run(backend="serial")
+    np.testing.assert_allclose(r.results.rmsf, 0.0, atol=1e-6)
+    r_jax = AlignedRMSF(u, select="protein and name CA").run(
+        backend="jax", batch_size=5)
+    np.testing.assert_allclose(r_jax.results.rmsf, 0.0, atol=1e-3)
+
+
+def test_aligned_rmsf_backends_agree(uni):
+    res = {b: AlignedRMSF(uni, select="protein and name CA").run(
+        backend=b, batch_size=7).results.rmsf for b in BACKENDS}
+    np.testing.assert_allclose(res["jax"], res["serial"], rtol=5e-3, atol=1e-4)
+    np.testing.assert_allclose(res["mesh"], res["serial"], rtol=5e-3, atol=1e-4)
+
+
+def test_aligned_rmsf_statistical_magnitude():
+    """Noise sigma=0.3 → RMSF ≈ sqrt(3)*0.3 within sampling error."""
+    u = make_protein_universe(n_residues=20, n_frames=200, noise=0.3, seed=7)
+    r = AlignedRMSF(u, select="protein and name CA").run(
+        backend="jax", batch_size=64)
+    expected = np.sqrt(3) * 0.3
+    assert abs(np.median(r.results.rmsf) - expected) < 0.1 * expected
+
+
+def test_stock_rmsf_pipeline_oracle(uni):
+    """The docstring oracle (RMSF.py:1-18): AverageStructure → AlignTraj
+    → RMSF equals AlignedRMSF."""
+    u = make_protein_universe(n_residues=8, n_frames=20, noise=0.2, seed=11)
+    sel = "protein and name CA"
+    one_shot = AlignedRMSF(u, select=sel).run(backend="serial")
+
+    u2 = make_protein_universe(n_residues=8, n_frames=20, noise=0.2, seed=11)
+    avg = AverageStructure(u2, select=sel).run(backend="serial")
+    AlignTraj(u2, avg.results.universe, select=sel).run(backend="serial")
+    stock = RMSF(u2.select_atoms(sel)).run(backend="serial")
+    np.testing.assert_allclose(stock.results.rmsf, one_shot.results.rmsf,
+                               rtol=1e-6, atol=1e-9)
+
+
+def test_rmsf_frame_slicing(uni):
+    sub = AlignedRMSF(uni, select="name CA").run(
+        start=4, stop=24, step=2, backend="jax", batch_size=4)
+    assert sub.n_frames == 10
+    serial = AlignedRMSF(uni, select="name CA").run(
+        start=4, stop=24, step=2, backend="serial")
+    np.testing.assert_allclose(sub.results.rmsf, serial.results.rmsf,
+                               rtol=5e-3, atol=1e-4)
+
+
+def test_rmsf_short_trajectory_more_devices_than_frames():
+    """Quirk Q2: the reference ZeroDivisionErrors when ranks > frames;
+    the mesh backend must handle 3 frames over 8 devices."""
+    u = make_protein_universe(n_residues=5, n_frames=3, noise=0.1)
+    r = AlignedRMSF(u, select="name CA").run(backend="mesh", batch_size=2)
+    s = AlignedRMSF(u, select="name CA").run(backend="serial")
+    np.testing.assert_allclose(r.results.rmsf, s.results.rmsf,
+                               rtol=5e-3, atol=1e-5)
+
+
+# ---------------- RMSD ----------------
+
+def test_rmsd_backends_agree(uni):
+    res = {b: RMSD(uni, select="protein and name CA").run(
+        backend=b, batch_size=8).results.rmsd for b in BACKENDS}
+    assert res["serial"].shape == (30,)
+    np.testing.assert_allclose(res["jax"], res["serial"], rtol=1e-3, atol=2e-4)
+    np.testing.assert_allclose(res["mesh"], res["serial"], rtol=1e-3, atol=2e-4)
+
+
+def test_rmsd_superposition_removes_rigid_motion():
+    u = make_protein_universe(n_residues=10, n_frames=8, noise=0.0)
+    fitted = RMSD(u, select="name CA", superposition=True).run(backend="jax")
+    raw = RMSD(u, select="name CA", superposition=False).run(backend="jax")
+    np.testing.assert_allclose(fitted.results.rmsd, 0.0, atol=1e-3)
+    assert raw.results.rmsd[1:].min() > 1.0
+    assert raw.results.rmsd[0] == pytest.approx(0.0, abs=1e-4)
+
+
+def test_rmsd_mass_weighted(uni):
+    mw = RMSD(uni, select="name CA C N", weights="mass").run(backend="jax")
+    uw = RMSD(uni, select="name CA C N").run(backend="jax")
+    assert mw.results.rmsd.shape == uw.results.rmsd.shape
+    assert not np.allclose(mw.results.rmsd[1:], uw.results.rmsd[1:])
+
+
+def test_rmsd_atomgroup_select_refines_within_group(uni):
+    """RMSD(group, select=...) must stay restricted to the group."""
+    half = uni.atoms[: uni.topology.n_atoms // 2]
+    r = RMSD(half, select="name CA")
+    r._prepare()
+    assert set(r._idx).issubset(set(half.indices))
+    assert len(r._idx) < len(uni.select_atoms("name CA").indices)
+
+
+def test_aligntraj_preserves_per_frame_boxes():
+    u = make_protein_universe(n_residues=4, n_frames=6, noise=0.1, box=30.0)
+    # give each frame a distinct box
+    u.trajectory._dims[:, 0] = 30.0 + np.arange(6)
+    expected = u.trajectory._dims.copy()
+    AlignTraj(u, select="name CA").run(backend="jax", batch_size=4)
+    for i in range(6):
+        np.testing.assert_array_equal(u.trajectory[i].dimensions, expected[i])
+
+
+def test_rmsd_atomgroup_input(uni):
+    ag = uni.select_atoms("name CA")
+    r = RMSD(ag).run(backend="serial")
+    r2 = RMSD(uni, select="name CA").run(backend="serial")
+    np.testing.assert_allclose(r.results.rmsd, r2.results.rmsd)
+
+
+# ---------------- AlignTraj ----------------
+
+def test_aligntraj_in_memory(uni):
+    u = make_protein_universe(n_residues=6, n_frames=10, noise=0.1, seed=5)
+    ref_frame0 = u.trajectory[0].positions.copy()
+    AlignTraj(u, select="name CA").run(backend="jax", batch_size=4)
+    # after alignment every frame should be close to frame 0 (noise only)
+    assert u.trajectory.n_frames == 10
+    for i in range(10):
+        d = np.linalg.norm(u.trajectory[i].positions - ref_frame0, axis=1).mean()
+        assert d < 1.0, f"frame {i} misaligned (mean dev {d})"
+
+
+def test_aligntraj_serial_jax_agree():
+    u1 = make_protein_universe(n_residues=6, n_frames=9, noise=0.2, seed=9)
+    u2 = make_protein_universe(n_residues=6, n_frames=9, noise=0.2, seed=9)
+    AlignTraj(u1, select="name CA").run(backend="serial")
+    AlignTraj(u2, select="name CA").run(backend="jax", batch_size=4)
+    for i in range(9):
+        np.testing.assert_allclose(u1.trajectory[i].positions,
+                                   u2.trajectory[i].positions, atol=2e-3)
+
+
+# ---------------- error paths ----------------
+
+def test_empty_selection_raises(uni):
+    with pytest.raises(ValueError, match="matched no atoms"):
+        AverageStructure(uni, select="resname XXX").run()
+
+
+def test_unknown_backend(uni):
+    with pytest.raises(ValueError, match="unknown backend"):
+        RMSD(uni, select="name CA").run(backend="cuda")

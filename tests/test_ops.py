@@ -1,0 +1,182 @@
+"""Kernel tests: Kabsch vs QCP differential, moment algebra, psum merge.
+
+Run on the virtual 8-device CPU platform (conftest.py) so psum paths use
+the same shard_map code as the TPU mesh (SURVEY.md §4).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from mdanalysis_mpi_tpu.ops import align, host, moments, rmsd
+
+
+RNG = np.random.default_rng(42)
+
+
+def _random_rotation():
+    q, r = np.linalg.qr(RNG.normal(size=(3, 3)))
+    q *= np.sign(np.diag(r))
+    if np.linalg.det(q) < 0:
+        q[:, 0] *= -1
+    return q
+
+
+def test_kabsch_recovers_known_rotation():
+    ref = RNG.normal(size=(30, 3))
+    ref -= ref.mean(0)
+    rot_true = _random_rotation()
+    mobile = ref @ rot_true.T          # rotated copy, no noise
+    r = np.asarray(align.kabsch_rotation(jnp.asarray(mobile), jnp.asarray(ref)))
+    np.testing.assert_allclose(mobile @ r, ref, atol=1e-5)
+    assert np.linalg.det(r) == pytest.approx(1.0, abs=1e-5)
+
+
+def test_kabsch_vs_qcp_differential():
+    """Two independent algorithms must give the same optimal rotation."""
+    for seed in range(5):
+        rng = np.random.default_rng(seed)
+        ref = rng.normal(size=(25, 3)); ref -= ref.mean(0)
+        mobile = ref @ _random_rotation().T + rng.normal(scale=0.05, size=(25, 3))
+        mobile -= mobile.mean(0)
+        w = rng.uniform(1, 16, size=25)
+        r_jax = np.asarray(align.kabsch_rotation(
+            jnp.asarray(mobile, jnp.float32), jnp.asarray(ref, jnp.float32),
+            jnp.asarray(w, jnp.float32)))
+        r_qcp = host.qcp_rotation(mobile, ref, w)
+        np.testing.assert_allclose(r_jax, r_qcp, atol=5e-4)
+
+
+def test_kabsch_improper_mirror_guard():
+    """Mirror-image mobile must still yield a proper rotation (det=+1)."""
+    ref = RNG.normal(size=(20, 3)); ref -= ref.mean(0)
+    mobile = ref.copy(); mobile[:, 0] *= -1   # reflection
+    r = np.asarray(align.kabsch_rotation(jnp.asarray(mobile), jnp.asarray(ref)))
+    assert np.linalg.det(r) == pytest.approx(1.0, abs=1e-5)
+
+
+def test_superpose_batch_matches_host_per_frame():
+    b, n, s = 6, 40, 10
+    coords = RNG.normal(size=(b, n, 3)).astype(np.float32)
+    sel_idx = np.sort(RNG.choice(n, size=s, replace=False))
+    w = RNG.uniform(1, 16, size=s)
+    ref = coords[0, sel_idx].astype(np.float64)
+    ref_com = host.weighted_center(ref, w)
+    ref_c = ref - ref_com
+    out = np.asarray(align.superpose_batch(
+        jnp.asarray(coords), jnp.asarray(sel_idx),
+        jnp.asarray(w, jnp.float32), jnp.asarray(ref_c, jnp.float32),
+        jnp.asarray(ref_com, jnp.float32)))
+    for f in range(b):
+        expect = host.superpose_frame(coords[f], sel_idx, w, ref_c, ref_com)
+        np.testing.assert_allclose(out[f], expect, atol=2e-4)
+
+
+def test_batch_moments_vs_streaming_welford():
+    x = RNG.normal(size=(17, 5, 3))
+    t, mean, m2 = moments.batch_moments(jnp.asarray(x))
+    stream = host.StreamingMoments((5, 3))
+    for f in x:
+        stream.update(f)
+    assert int(t) == 17
+    np.testing.assert_allclose(np.asarray(mean), stream.mean, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(m2), stream.m2, atol=1e-4)
+
+
+def test_batch_moments_mask_padding():
+    x = RNG.normal(size=(8, 4, 3))
+    xpad = np.concatenate([x, np.full((3, 4, 3), 1e6)])  # poison padding
+    mask = np.array([1.0] * 8 + [0.0] * 3)
+    t, mean, m2 = moments.batch_moments(jnp.asarray(xpad), jnp.asarray(mask))
+    t0, mean0, m20 = moments.batch_moments(jnp.asarray(x))
+    assert int(t) == 8
+    np.testing.assert_allclose(np.asarray(mean), np.asarray(mean0), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(m2), np.asarray(m20), rtol=1e-5)
+
+
+def test_merge_moments_exact_vs_direct():
+    """Chan merge over the reference's uneven partition (RMSF.py:66-69)
+    equals direct two-pass moments — the SURVEY §4 verification as a test."""
+    n_frames, size = 98, 4
+    x = RNG.normal(size=(n_frames, 7, 3))
+    per = n_frames // size
+    bounds = [(i * per, (i + 1) * per) for i in range(size - 1)]
+    bounds.append(((size - 1) * per, n_frames))
+    parts = []
+    for a, b in bounds:
+        s = host.StreamingMoments((7, 3))
+        for f in x[a:b]:
+            s.update(f)
+        parts.append(s.summary)
+    t, mean, m2 = moments.reduce_moments(parts)
+    assert t == n_frames
+    np.testing.assert_allclose(mean, x.mean(0), atol=1e-13)
+    np.testing.assert_allclose(m2, ((x - x.mean(0)) ** 2).sum(0), atol=1e-11)
+
+
+def test_merge_moments_empty_partial():
+    """Q2 fix: merging an empty partial is the identity, not a crash."""
+    s_empty = (0, np.zeros((3, 3)), np.zeros((3, 3)))
+    x = RNG.normal(size=(5, 3, 3))
+    s = host.StreamingMoments((3, 3))
+    for f in x:
+        s.update(f)
+    for merged in (moments.merge_moments(s_empty, s.summary),
+                   moments.merge_moments(s.summary, s_empty),
+                   moments.merge_moments(s_empty, s_empty)):
+        pass
+    t, mean, m2 = moments.merge_moments(s_empty, s.summary)
+    np.testing.assert_allclose(mean, s.mean)
+    np.testing.assert_allclose(m2, s.m2)
+    t0, _, _ = moments.merge_moments(s_empty, s_empty)
+    assert t0 == 0
+
+
+def test_psum_moments_shard_map():
+    """K-way psum merge across an 8-device mesh == global moments."""
+    from jax import shard_map
+    devices = jax.devices()
+    assert len(devices) == 8, f"conftest should give 8 CPU devices, got {len(devices)}"
+    mesh = Mesh(np.array(devices), ("data",))
+    x = RNG.normal(size=(8 * 5, 6, 3)).astype(np.float32)
+
+    def per_shard(xs):
+        t, mean, m2 = moments.batch_moments(xs)
+        return moments.psum_moments(t, mean, m2, "data")
+
+    f = shard_map(per_shard, mesh=mesh, in_specs=P("data"),
+                  out_specs=(P(), P(), P()))
+    t, mean, m2 = jax.jit(f)(jnp.asarray(x))
+    assert int(t) == 40
+    np.testing.assert_allclose(np.asarray(mean), x.mean(0), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(m2), ((x - x.mean(0)) ** 2).sum(0),
+                               rtol=1e-4)
+
+
+def test_rmsf_from_moments():
+    x = RNG.normal(size=(50, 4, 3))
+    t, mean, m2 = moments.batch_moments(jnp.asarray(x))
+    out = np.asarray(moments.rmsf_from_moments(t, m2))
+    expect = np.sqrt(((x - x.mean(0)) ** 2).sum(axis=(0, 2)) / 50)
+    np.testing.assert_allclose(out, expect, rtol=1e-4)
+
+
+def test_rmsd_batch_superposition():
+    """RMSD of rigidly rotated frames must be ~0 with superposition and
+    >0 without."""
+    ref = RNG.normal(size=(12, 3)); ref -= ref.mean(0)
+    w = RNG.uniform(1, 12, size=12)
+    frames = np.stack([ref @ _random_rotation().T + RNG.normal(scale=3.0, size=3)
+                       for _ in range(5)]).astype(np.float32)
+    ref_com = host.weighted_center(ref, w)
+    ref_c = (ref - ref_com).astype(np.float32)
+    fitted = np.asarray(rmsd.rmsd_batch(
+        jnp.asarray(frames), jnp.asarray(w, jnp.float32),
+        jnp.asarray(ref_c), superposition=True))
+    unfitted = np.asarray(rmsd.rmsd_batch(
+        jnp.asarray(frames), jnp.asarray(w, jnp.float32),
+        jnp.asarray(ref_c), superposition=False))
+    np.testing.assert_allclose(fitted, 0.0, atol=1e-4)
+    assert (unfitted > 0.1).all()
