@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Benchmark: frames/sec/chip on the 100k-atom RMSF (BASELINE.json metric).
+
+Runs the flagship pipeline — AlignedRMSF (average structure + aligned
+Welford moments, the reference program RMSF.py:53-149) — on a synthetic
+100k-atom solvated-protein system with the "all heavy atoms" selection
+(BASELINE config 2) on the real accelerator, and compares against the
+8-rank MPI baseline.
+
+Baseline note (BASELINE.md): the reference publishes no numbers and this
+environment has no MPI, so the baseline is this repo's own serial NumPy
+backend (algorithmically the reference's per-rank loop: QCP rotation +
+rotate + Welford per frame) measured per-process and scaled by 8 for an
+*ideal* 8-rank MPI machine — a deliberately generous stand-in.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Env knobs: BENCH_ATOMS, BENCH_FRAMES, BENCH_BATCH, BENCH_SERIAL_FRAMES.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from mdanalysis_mpi_tpu.core.topology import Topology  # noqa: E402
+from mdanalysis_mpi_tpu.core.universe import Universe  # noqa: E402
+from mdanalysis_mpi_tpu.io.memory import MemoryReader  # noqa: E402
+from mdanalysis_mpi_tpu.analysis import AlignedRMSF    # noqa: E402
+
+N_ATOMS = int(os.environ.get("BENCH_ATOMS", 100_000))
+N_FRAMES = int(os.environ.get("BENCH_FRAMES", 512))
+BATCH = int(os.environ.get("BENCH_BATCH", 128))
+SERIAL_FRAMES = int(os.environ.get("BENCH_SERIAL_FRAMES", 12))
+SELECT = os.environ.get("BENCH_SELECT", "heavy")
+
+
+def make_system(n_atoms: int, n_frames: int, seed: int = 0) -> Universe:
+    """100k-atom solvated-protein-like system: ~50% heavy atoms, rigid
+    tumbling + thermal noise (the BASELINE config-2 shape)."""
+    rng = np.random.default_rng(seed)
+    n_res = n_atoms // 4
+    # residues of (CA, CB, HA, HB) → half heavy, half hydrogen
+    names = np.tile(np.array(["CA", "CB", "HA", "HB"]), n_res)[:n_atoms]
+    resnames = np.full(n_atoms, "ALA")
+    resids = np.arange(n_atoms) // 4 + 1
+    top = Topology(names=names, resnames=resnames, resids=resids)
+
+    base = rng.normal(scale=20.0, size=(n_atoms, 3)).astype(np.float32)
+    base -= base.mean(axis=0)
+    # per-frame small rotations + noise, generated in one vectorized shot
+    angles = rng.normal(scale=0.1, size=n_frames)
+    cos, sin = np.cos(angles), np.sin(angles)
+    rots = np.zeros((n_frames, 3, 3), dtype=np.float32)
+    rots[:, 0, 0] = cos; rots[:, 0, 1] = -sin
+    rots[:, 1, 0] = sin; rots[:, 1, 1] = cos
+    rots[:, 2, 2] = 1.0
+    frames = np.einsum("ni,fij->fnj", base, rots)
+    frames += rng.normal(scale=0.3, size=frames.shape).astype(np.float32)
+    return Universe(top, MemoryReader(frames))
+
+
+def main():
+    u = make_system(N_ATOMS, N_FRAMES)
+
+    # --- accelerator path (one chip unless more are attached) ---
+    import jax
+    n_chips = len(jax.devices())
+    # int16 staging: halves host->HBM wire bytes at ~2e-3 coordinate
+    # resolution (quantize_block docstring) — the honest fast path
+    tdtype = os.environ.get("BENCH_TRANSFER", "int16")
+    # warm-up: compile both passes on a short window
+    AlignedRMSF(u, select=SELECT).run(
+        stop=2 * BATCH, backend="jax", batch_size=BATCH, transfer_dtype=tdtype)
+    t0 = time.perf_counter()
+    r = AlignedRMSF(u, select=SELECT).run(backend="jax", batch_size=BATCH,
+                                          transfer_dtype=tdtype)
+    wall = time.perf_counter() - t0
+    fps_per_chip = N_FRAMES / wall / n_chips
+
+    # --- serial NumPy stand-in for one MPI rank ---
+    t0 = time.perf_counter()
+    s = AlignedRMSF(u, select=SELECT).run(
+        stop=SERIAL_FRAMES, backend="serial")
+    serial_wall = time.perf_counter() - t0
+    serial_fps = SERIAL_FRAMES / serial_wall
+    baseline_fps = 8 * serial_fps          # ideal 8-rank MPI
+
+    # sanity: backends agree on the short window
+    r_short = AlignedRMSF(u, select=SELECT).run(
+        stop=SERIAL_FRAMES, backend="jax", batch_size=SERIAL_FRAMES)
+    err = float(np.abs(r_short.results.rmsf - s.results.rmsf).max())
+    if err > 1e-3:
+        print(f"WARNING: backend divergence {err:.2e}", file=sys.stderr)
+
+    print(json.dumps({
+        "metric": f"frames/sec/chip, {N_ATOMS}-atom heavy-atom AlignedRMSF "
+                  f"({N_FRAMES} frames, batch {BATCH}, {n_chips} chip(s))",
+        "value": round(fps_per_chip, 2),
+        "unit": "frames/s/chip",
+        "vs_baseline": round(fps_per_chip / baseline_fps, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -19,6 +19,41 @@ from mdanalysis_mpi_tpu.parallel.executors import _f32_precision
 from mdanalysis_mpi_tpu.parallel.partition import iter_batches, pad_batch
 
 
+# ---- module-level batch kernels (stable identity → cached compiles) ----
+
+def _avg_all_kernel(params, batch, mask):
+    """Aligned all-atom masked sum: partials (T, Σ aligned) — pass 1 wide
+    path (RMSF.py:89-103)."""
+    import jax.numpy as jnp
+
+    from mdanalysis_mpi_tpu.ops.align import _HI, superpose_batch
+
+    sel_idx, w, ref_c, ref_com = params
+    aligned = superpose_batch(batch, sel_idx, w, ref_c, ref_com)
+    return (mask.sum(), jnp.einsum("b,bni->ni", mask, aligned, precision=_HI))
+
+
+def _avg_sel_kernel(params, batch, mask):
+    """Aligned selection-only masked sum (lean pass-1 path)."""
+    import jax.numpy as jnp
+
+    from mdanalysis_mpi_tpu.ops.align import _HI, superpose_selection_batch
+
+    w, ref_c, ref_com = params
+    aligned = superpose_selection_batch(batch, w, ref_c, ref_com)
+    return (mask.sum(), jnp.einsum("b,bni->ni", mask, aligned, precision=_HI))
+
+
+def _psum_all(partials, axis_name):
+    import jax
+
+    return jax.tree.map(lambda x: jax.lax.psum(x, axis_name), partials)
+
+
+def _add_partials(a, b):
+    return (a[0] + b[0], a[1] + b[1])
+
+
 def _reference_sel_coords(reference: Universe, sel_idx, weights, ref_frame: int):
     """Centered float64 selection coords + COM of ``ref_frame``, with the
     cursor save/restore the reference wraps in try/finally
@@ -87,51 +122,44 @@ class AverageStructure(AnalysisBase):
     def _batch_select(self):
         return self._sel_idx if self._select_only else None
 
-    def _make_batch_kernel(self):
+    def _batch_fn(self):
+        return _avg_sel_kernel if self._select_only else _avg_all_kernel
+
+    def _batch_params(self):
         import jax.numpy as jnp
 
-        from mdanalysis_mpi_tpu.ops.align import (
-            superpose_batch, superpose_selection_batch)
-
-        sel_idx = jnp.asarray(self._sel_idx)
         w = jnp.asarray(self._weights, jnp.float32)
         ref_c = jnp.asarray(self._ref_sel_c, jnp.float32)
         ref_com = jnp.asarray(self._ref_com, jnp.float32)
-        select_only = self._select_only
+        if self._select_only:
+            return (w, ref_c, ref_com)
+        return (jnp.asarray(self._sel_idx), w, ref_c, ref_com)
 
-        def kernel(batch, mask):
-            if select_only:
-                aligned = superpose_selection_batch(batch, w, ref_c, ref_com)
-            else:
-                aligned = superpose_batch(batch, sel_idx, w, ref_c, ref_com)
-            t = mask.sum()
-            s = jnp.einsum("b,bni->ni", mask, aligned)
-            return (t, s)
-
-        return kernel
-
-    def _combine(self, a, b):
-        return (a[0] + b[0], a[1] + b[1])
-
-    def _device_combine(self, partials, axis_name):
-        import jax
-        return jax.tree.map(lambda x: jax.lax.psum(x, axis_name), partials)
+    _device_combine = staticmethod(_psum_all)
+    _device_fold_fn = staticmethod(_add_partials)
 
     def _identity_partials(self):
         return (0.0, np.zeros_like(self._acc))
 
     def _conclude(self, total):
         t, s = total
-        if t == 0:
+        # zero-frame guard via the host-known frame count — float(t) on a
+        # device scalar would synchronize the whole async pipeline here
+        if self.n_frames == 0:
             raise ValueError("AverageStructure over zero frames")
-        avg = np.asarray(s, np.float64) / t
+        # s may live on device; the division stays there — only the wide
+        # path (universe rebuild) forces a host fetch
+        avg = s / t
         self.results.positions = avg
         if self._select_only:
             self.results.universe = None
         else:
-            # RMSF.py:113: rebuild a single-frame in-memory universe
+            # RMSF.py:113: rebuild a single-frame in-memory universe.
+            # Single device fetch (readback is the slow direction).
+            avg_np = np.asarray(avg, np.float64)
+            self.results.positions = avg_np
             self.results.universe = Universe(
-                self._universe.topology, avg[None].astype(np.float32))
+                self._universe.topology, avg_np[None].astype(np.float32))
 
 
 class AlignTraj(AnalysisBase):

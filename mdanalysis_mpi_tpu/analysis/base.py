@@ -14,12 +14,16 @@ Subclasses implement:
                        reference coords (replaces per-frame selection, Q3)
 ``_single_frame(ts)``  serial oracle path: update host accumulators
 ``_serial_summary()``  → partials pytree after the serial loop
-``_make_batch_kernel()``  → jittable ``fn(batch (B,S,3) f32, mask (B,))``
-                       → partials pytree (device path)
+``_batch_fn()``        → a MODULE-LEVEL jittable function
+                       ``f(params, batch (B,S,3) f32, mask (B,)) ->
+                       partials`` (device path).  Module-level (not a
+                       per-run closure) so executors can cache the
+                       compiled kernel across run() calls.
+``_batch_params()``    → params pytree passed to ``_batch_fn``'s function
 ``_batch_select()``    indices staged to device (None = all atoms)
-``_combine(a, b)``     host merge of two partials pytrees (float64)
-``_device_combine``    optional ``(partials, axis_name) -> partials`` psum
-                       merge for the mesh backend
+``_device_combine``    optional module-level ``(partials, axis_name) ->
+                       partials`` psum merge for the mesh backend
+                       (assign with ``staticmethod(...)``)
 ``_identity_partials()``  empty-trajectory partials (Q2)
 ``_conclude(total)``   partials → ``self.results``
 =====================  ========================================================
@@ -48,7 +52,12 @@ class Results(dict):
 class AnalysisBase:
     """Template for trajectory analyses with pluggable backends."""
 
-    _device_combine = None   # subclasses may override with a psum merge
+    _device_combine = None    # subclasses may override with a psum merge
+    # module-level (total, partials) -> total merge executed on device once
+    # per batch, so partials never cross device→host per batch (slow on
+    # tunneled TPUs); None → partials are concatenated on device instead
+    # (time-series analyses)
+    _device_fold_fn = None
 
     def __init__(self, universe, verbose: bool = False):
         self._universe = universe
@@ -66,15 +75,15 @@ class AnalysisBase:
     def _serial_summary(self):
         raise NotImplementedError
 
-    def _make_batch_kernel(self):
+    def _batch_fn(self):
         raise NotImplementedError(
             f"{type(self).__name__} has no batch kernel; use backend='serial'")
 
+    def _batch_params(self):
+        return ()
+
     def _batch_select(self):
         return None
-
-    def _combine(self, a, b):
-        raise NotImplementedError
 
     def _identity_partials(self):
         raise NotImplementedError

@@ -24,6 +24,52 @@ from mdanalysis_mpi_tpu.ops.moments import (
 )
 
 
+# ---- module-level batch kernels (stable identity → cached compiles) ----
+
+def _moments_kernel(params, batch, mask):
+    """Plain batched moments of the staged selection (stock RMSF)."""
+    from mdanalysis_mpi_tpu.ops.moments import batch_moments
+
+    del params
+    return batch_moments(batch, mask)
+
+
+def _aligned_moments_kernel(params, batch, mask):
+    """Superpose the selection onto fixed reference coords, then batched
+    moments — the reference's pass-2 body (RMSF.py:124-138)."""
+    from mdanalysis_mpi_tpu.ops.align import superpose_selection_batch
+    from mdanalysis_mpi_tpu.ops.moments import batch_moments
+
+    w, ref_c, ref_com = params
+    aligned = superpose_selection_batch(batch, w, ref_c, ref_com)
+    return batch_moments(aligned, mask)
+
+
+def _rmsd_kernel(params, batch, mask):
+    """Per-frame RMSD with superposition (BASELINE config 3)."""
+    from mdanalysis_mpi_tpu.ops.rmsd import rmsd_batch
+
+    masses, rot_w, rmsd_w, ref_c = params
+    vals = rmsd_batch(batch, masses, ref_c, superposition=True,
+                      rot_weights=rot_w, rmsd_weights=rmsd_w)
+    return (vals * mask, mask)
+
+
+def _rmsd_nofit_kernel(params, batch, mask):
+    """Per-frame RMSD without superposition."""
+    from mdanalysis_mpi_tpu.ops.rmsd import rmsd_batch
+
+    masses, rot_w, rmsd_w, ref_c = params
+    del rot_w
+    vals = rmsd_batch(batch, masses, ref_c, superposition=False,
+                      rmsd_weights=rmsd_w)
+    return (vals * mask, mask)
+
+
+def _psum_moments_partials(partials, axis_name):
+    return psum_moments(*partials, axis_name)
+
+
 class RMSF(AnalysisBase):
     """Per-atom RMSF of an AtomGroup: ``RMSF(ag).run().results.rmsf``.
 
@@ -54,15 +100,11 @@ class RMSF(AnalysisBase):
     def _batch_select(self):
         return self._idx
 
-    def _make_batch_kernel(self):
-        from mdanalysis_mpi_tpu.ops.moments import batch_moments
-        return lambda batch, mask: batch_moments(batch, mask)
+    def _batch_fn(self):
+        return _moments_kernel
 
-    def _combine(self, a, b):
-        return merge_moments(a, b)
-
-    def _device_combine(self, partials, axis_name):
-        return psum_moments(*partials, axis_name)
+    _device_combine = staticmethod(_psum_moments_partials)
+    _device_fold_fn = staticmethod(merge_moments)
 
     def _identity_partials(self):
         z = np.zeros((len(self._idx), 3))
@@ -70,10 +112,13 @@ class RMSF(AnalysisBase):
 
     def _conclude(self, total):
         t, mean, m2 = total
-        self.results.mean = np.asarray(mean, np.float64)
-        self.results.m2 = np.asarray(m2, np.float64)
-        self.results.n_frames = int(t)
-        self.results.rmsf = np.asarray(rmsf_from_moments(t, self.results.m2))
+        # mean/m2 may be device arrays — keep them resident (device→host
+        # readback is the expensive direction on tunneled TPUs); fetch
+        # only the small final RMSF vector
+        self.results.mean = mean
+        self.results.m2 = m2
+        self.results.n_frames = self.n_frames
+        self.results.rmsf = np.asarray(rmsf_from_moments(t, m2), np.float64)
 
 
 class RMSD(AnalysisBase):
@@ -140,31 +185,21 @@ class RMSD(AnalysisBase):
     def _batch_select(self):
         return self._idx
 
-    def _make_batch_kernel(self):
+    def _batch_fn(self):
+        return _rmsd_kernel if self._superposition else _rmsd_nofit_kernel
+
+    def _batch_params(self):
         import jax.numpy as jnp
 
-        from mdanalysis_mpi_tpu.ops.rmsd import rmsd_batch
-
         masses = jnp.asarray(self._masses, jnp.float32)
-        rmsd_w = jnp.asarray(self._rmsd_w, jnp.float32)
-        ref_c = jnp.asarray(self._ref_sel_c, jnp.float32)
-        superposition = self._superposition
         rot_w = masses if self._weights_mode == "mass" else None
+        return (masses, rot_w,
+                jnp.asarray(self._rmsd_w, jnp.float32),
+                jnp.asarray(self._ref_sel_c, jnp.float32))
 
-        def kernel(batch, mask):
-            vals = rmsd_batch(batch, masses, ref_c,
-                              superposition=superposition,
-                              rot_weights=rot_w, rmsd_weights=rmsd_w)
-            return (vals * mask, mask)
-
-        return kernel
-
-    def _combine(self, a, b):
-        # order-preserving concatenation: executors process batches and
-        # device shards in frame order
-        return (np.concatenate([a[0], b[0]]), np.concatenate([a[1], b[1]]))
-
-    _device_combine = None   # keep per-device series, concat on host
+    # no _device_fold_fn: per-batch (vals, mask) series are concatenated
+    # on device by the executor in batch/shard order = frame order
+    _device_combine = None
 
     def _identity_partials(self):
         return (np.empty(0), np.empty(0))
@@ -191,6 +226,16 @@ class AlignedRMSF(AnalysisBase):
 
     def run(self, start=None, stop=None, step=None, backend: str = "serial",
             batch_size: int | None = None, **kwargs):
+        # Both passes iterate the same frames with the same selection, so
+        # share one HBM block cache: pass 2 reads device-resident blocks
+        # instead of re-staging (the reference re-decodes every frame in
+        # pass 2, RMSF.py:124 — this is the TPU-native fix).
+        if isinstance(backend, str) and backend != "serial":
+            from mdanalysis_mpi_tpu.parallel.executors import (
+                DeviceBlockCache, get_executor)
+            cache = kwargs.pop("block_cache", None) or DeviceBlockCache()
+            backend = get_executor(backend, block_cache=cache, **kwargs)
+            kwargs = {}
         # Pass 1 (RMSF.py:76-113): average of aligned selection coords.
         # The lean select_only path is exact for pass 2, which only needs
         # the selection's average (SURVEY.md quirk Q5 discussion).
@@ -207,12 +252,14 @@ class AlignedRMSF(AnalysisBase):
         moments_pass.run(start, stop, step, backend=backend,
                          batch_size=batch_size, **kwargs)
         t, mean, m2 = moments_pass._total
-        self.n_frames = int(t)
+        self.n_frames = moments_pass.n_frames
+        # average/mean/m2 may be device-resident (np.asarray() to fetch);
+        # only the small final RMSF is materialized on host
         self.results.average = self._avg_sel
         self.results.mean = mean
         self.results.m2 = m2
         # RMSF.py:146: sqrt(M2.sum(axis=xyz)/T)
-        self.results.rmsf = np.asarray(rmsf_from_moments(t, m2))
+        self.results.rmsf = np.asarray(rmsf_from_moments(t, m2), np.float64)
         return self
 
 
@@ -226,13 +273,28 @@ class _MomentsToReference(AnalysisBase):
         self._ref_sel_positions = ref_sel_positions
 
     def _prepare(self):
+        import jax
+
         ag = self._universe.select_atoms(self._select)
         self._idx = ag.indices
         self._masses = ag.masses
-        # center the average-structure reference (RMSF.py:116-118)
-        com = host.weighted_center(self._ref_sel_positions, self._masses)
-        self._ref_sel_c = self._ref_sel_positions - com
-        self._ref_com = com
+        # center the average-structure reference (RMSF.py:116-118); if the
+        # reference came out of a device-resident pass 1, keep the whole
+        # centering on device (no host round-trip)
+        ref = self._ref_sel_positions
+        if isinstance(ref, jax.Array):
+            import jax.numpy as jnp
+
+            from mdanalysis_mpi_tpu.ops.align import weighted_center
+
+            ref32 = jnp.asarray(ref, jnp.float32)
+            com = weighted_center(ref32, jnp.asarray(self._masses, jnp.float32))
+            self._ref_sel_c = ref32 - com
+            self._ref_com = com
+        else:
+            com = host.weighted_center(ref, self._masses)
+            self._ref_sel_c = ref - com
+            self._ref_com = com
         self._stream = host.StreamingMoments((len(self._idx), 3))
 
     def _single_frame(self, ts):
@@ -247,27 +309,18 @@ class _MomentsToReference(AnalysisBase):
     def _batch_select(self):
         return self._idx
 
-    def _make_batch_kernel(self):
+    def _batch_fn(self):
+        return _aligned_moments_kernel
+
+    def _batch_params(self):
         import jax.numpy as jnp
 
-        from mdanalysis_mpi_tpu.ops.align import superpose_selection_batch
-        from mdanalysis_mpi_tpu.ops.moments import batch_moments
+        return (jnp.asarray(self._masses, jnp.float32),
+                jnp.asarray(self._ref_sel_c, jnp.float32),
+                jnp.asarray(self._ref_com, jnp.float32))
 
-        w = jnp.asarray(self._masses, jnp.float32)
-        ref_c = jnp.asarray(self._ref_sel_c, jnp.float32)
-        ref_com = jnp.asarray(self._ref_com, jnp.float32)
-
-        def kernel(batch, mask):
-            aligned = superpose_selection_batch(batch, w, ref_c, ref_com)
-            return batch_moments(aligned, mask)
-
-        return kernel
-
-    def _combine(self, a, b):
-        return merge_moments(a, b)
-
-    def _device_combine(self, partials, axis_name):
-        return psum_moments(*partials, axis_name)
+    _device_combine = staticmethod(_psum_moments_partials)
+    _device_fold_fn = staticmethod(merge_moments)
 
     def _identity_partials(self):
         z = np.zeros((len(self._idx), 3))

@@ -59,9 +59,14 @@ class ReaderBase:
     def rewind(self) -> Timestep:
         return self[0]
 
-    def read_block(self, start: int, stop: int) -> tuple[np.ndarray, np.ndarray | None]:
-        """Bulk-read frames [start, stop) → (positions (B,N,3) f32, boxes).
+    def read_block(self, start: int, stop: int,
+                   sel: np.ndarray | None = None
+                   ) -> tuple[np.ndarray, np.ndarray | None]:
+        """Bulk-read frames [start, stop) → (positions (B,S,3) f32, boxes).
 
+        ``sel`` (optional int index array) gathers a subset of atoms
+        during the read — one copy instead of read-then-gather, which
+        matters when staging 100k-atom frames for a small selection.
         ``boxes`` is (B, 6) float32 ([lx,ly,lz,alpha,beta,gamma]) or None
         if the trajectory carries no box.  This is the staging primitive
         for host→HBM block transfer (SURVEY.md §7 layer 2).
@@ -69,11 +74,12 @@ class ReaderBase:
         if not 0 <= start <= stop <= self.n_frames:
             raise IndexError(f"block [{start},{stop}) out of range [0,{self.n_frames}]")
         b = stop - start
-        out = np.empty((b, self.n_atoms, 3), dtype=np.float32)
+        n = self.n_atoms if sel is None else len(sel)
+        out = np.empty((b, n, 3), dtype=np.float32)
         boxes = None
         for j, i in enumerate(range(start, stop)):
             ts = self._read_frame(i)
-            out[j] = ts.positions
+            out[j] = ts.positions if sel is None else ts.positions[sel]
             if ts.dimensions is not None:
                 if boxes is None:
                     # zeros, not empty: frames before the first boxed frame

@@ -66,8 +66,11 @@ class MemoryReader(ReaderBase):
         supporting ``Universe.copy()`` (RMSF.py:57 semantics)."""
         return MemoryReader(self._coords, self._dims, self._dt)
 
-    def read_block(self, start: int, stop: int):
+    def read_block(self, start: int, stop: int, sel=None):
         if not 0 <= start <= stop <= self.n_frames:
             raise IndexError(f"block [{start},{stop}) out of range [0,{self.n_frames}]")
         boxes = None if self._dims is None else self._dims[start:stop].copy()
-        return self._coords[start:stop].copy(), boxes
+        if sel is None:
+            return self._coords[start:stop].copy(), boxes
+        # slice + advanced index = a single gather copy
+        return self._coords[start:stop, sel], boxes

@@ -11,16 +11,26 @@ The dispatch point BASELINE.json's north_star prescribes: "only the inner
 
 and the executors below schedule those over the trajectory:
 
-- :class:`SerialExecutor` — per-frame NumPy loop; the reference's
-  single-rank behavior and the differential-test oracle.
+- :class:`SerialExecutor` — per-frame NumPy loop in float64; the
+  reference's single-rank behavior and the differential-test oracle.
 - :class:`JaxExecutor` — single-device: frame blocks staged host→HBM,
-  one jitted batch kernel per block, Chan-merge across blocks on host
-  in float64 (precision policy, SURVEY.md §7 hard parts).
+  one jitted batch kernel per block, cross-block merge folded ON DEVICE
+  in float32 (``_device_fold_fn``, e.g. the Chan moment merge).
 - :class:`MeshExecutor` — multi-device: batches sharded over the mesh
   data axis via ``shard_map``; cross-chip merge by the analysis'
   ``_device_combine`` (``jax.lax.psum``-based — the TPU-native
   replacement for ``comm.Allreduce``/``comm.reduce``,
   RMSF.py:110,143).
+
+Precision policy (SURVEY.md §7 Q4): within-batch math runs in full f32
+(see ``_f32_precision``); the cross-batch fold also runs in f32 *on
+device* — per-batch device→host readback for a host f64 merge costs
+seconds on tunneled TPU targets and was removed.  Error analysis for
+the f32 Chan fold: with T ≤ 1e6 frames and coordinate scale ~1e2, the
+dominant term ``T·μ`` stays ≤ 1e8 where f32 carries ~7 significant
+digits, giving ≲1e-5 relative drift in mean/M2 — inside the framework's
+stated f32 tolerance (differential tests pin it).  The serial backend
+remains the exact f64 oracle.
 """
 
 from __future__ import annotations
@@ -51,19 +61,195 @@ def _f32_precision(fn):
     return wrapped
 
 
+# Module-level jit/mesh caches.  Analyses hand executors *module-level*
+# kernel functions plus a params pytree (instead of per-run closures), so
+# the compile cache survives across run() calls — a fresh closure per run
+# would force XLA recompilation every time (~tens of seconds for the
+# superposition kernels, observed on TPU).
+_JIT_CACHE: dict = {}
+_MESH_CACHE: dict = {}
+
+
+def _jit_kernel(f):
+    fn = _JIT_CACHE.get(f)
+    if fn is None:
+        import jax
+
+        fn = jax.jit(_f32_precision(f))
+        _JIT_CACHE[f] = fn
+    return fn
+
+
 def _stage(reader, frames: list[int], sel_idx) -> np.ndarray:
-    """Read ``frames`` → float32 (b, S, 3) with optional host-side
-    selection gather (gathering before device_put slashes host→HBM
+    """Read ``frames`` → float32 (b, S, 3) with optional selection gather
+    pushed into the reader (one copy; slashes host work and host→HBM
     traffic when S << N)."""
     if len(frames) == 0:
         n = reader.n_atoms if sel_idx is None else len(sel_idx)
         return np.empty((0, n, 3), dtype=np.float32)
     contiguous = frames[-1] - frames[0] + 1 == len(frames)
     if contiguous:
-        block, _ = reader.read_block(frames[0], frames[-1] + 1)
-    else:
-        block = np.stack([reader[i].positions for i in frames])
+        block, _ = reader.read_block(frames[0], frames[-1] + 1, sel=sel_idx)
+        return block
+    block = np.stack([reader[i].positions for i in frames])
     return block if sel_idx is None else block[:, sel_idx]
+
+
+_DEQUANT_WRAPPERS: dict = {}
+
+
+def _dequant_wrapper(fn):
+    """Wrap kernel ``fn(params, batch_f32, mask)`` as
+    ``g((sel, params), batch_i16, inv_scale, mask)``: dequantize on
+    device and, when ``sel`` is not None, gather the selection on device
+    too (full-frame staging skips the host-side fancy-index gather —
+    cheaper for wide selections on a single staging core).  Cached per
+    fn so the jit cache stays stable."""
+    g = _DEQUANT_WRAPPERS.get(fn)
+    if g is None:
+        import jax.numpy as jnp
+
+        def g(wrapped_params, q, inv_scale, mask):
+            sel, params = wrapped_params
+            x = q.astype(jnp.float32) * inv_scale
+            if sel is not None:
+                x = x[:, sel]
+            return fn(params, x, mask)
+
+        _DEQUANT_WRAPPERS[fn] = g
+    return g
+
+
+# Selections wider than this fraction of the system are gathered on
+# device (full-frame staging) instead of on the host staging core.
+# Worth enabling (~0.25) when the host link is fast (PCIe-attached TPU)
+# and the single staging core is the bottleneck; disabled by default
+# because on tunneled targets (axon) wire bytes dominate and host
+# gather halves them (measured: 130 → 46 fps when staging full frames).
+# Override via MDTPU_DEVICE_GATHER_FRACTION.
+import os as _os
+
+_DEVICE_GATHER_FRACTION = float(
+    _os.environ.get("MDTPU_DEVICE_GATHER_FRACTION", "1.1"))
+
+
+def quantize_block(block: np.ndarray):
+    """Quantize an (B, S, 3) float32 block to int16 + inverse scale.
+
+    One symmetric scale per block: resolution = max|x| / 32000 (e.g.
+    0.002 Å for a 60 Å system) — far below thermal fluctuation scales,
+    and bounded relative error ~6e-5 of the coordinate range.  Halves
+    host→device wire bytes, which is the dominant cost when staging
+    100k-atom frames through a slow link (SURVEY.md §7 "Host I/O vs TPU
+    throughput").
+    """
+    m = float(np.abs(block).max()) if block.size else 1.0
+    scale = 32000.0 / max(m, 1e-30)
+    q = np.round(block * scale).astype(np.int16)
+    return q, np.float32(1.0 / scale)
+
+
+class DeviceBlockCache:
+    """HBM-resident staged-block cache shared across trajectory passes.
+
+    The reference re-reads (re-decodes) every frame in pass 2
+    (RMSF.py:124); on TPU the analogous waste is re-staging the same
+    (B, S, 3) blocks host→device.  Multi-pass analyses (AlignedRMSF)
+    share one cache so pass 2 reads HBM-resident blocks.  Bounded by
+    ``max_bytes`` (default 4 GiB ≈ a quarter of a v5e chip's HBM);
+    blocks beyond the cap are simply re-staged.
+    """
+
+    def __init__(self, max_bytes: int = 4 << 30):
+        self._store: dict = {}
+        self._bytes = 0
+        self.max_bytes = max_bytes
+
+    def get(self, key):
+        return self._store.get(key)
+
+    def put(self, key, value, nbytes: int):
+        if self._bytes + nbytes <= self.max_bytes:
+            self._store[key] = value
+            self._bytes += nbytes
+
+
+def _run_batches(analysis, reader, frames, bs, call, sel_idx,
+                 device_put_fn=None, cache: "DeviceBlockCache | None" = None,
+                 quantize: bool = False):
+    """Shared batch loop: stage → kernel → DEVICE-side accumulation.
+
+    Partials never leave the device per batch: results are either folded
+    on-device with the analysis' module-level ``_device_fold_fn`` (one
+    jitted merge per batch, e.g. the Chan moment merge) or collected and
+    concatenated on-device at the end (time-series analyses).  The
+    single final pytree is what ``_conclude`` sees — it fetches what it
+    needs once.  Rationale: on tunneled TPU targets (axon) device→host
+    readback is orders of magnitude slower than host→device
+    (~0.3 MB/s vs ~1.5 GB/s measured), so per-batch fetches dominated
+    the wall clock; device-side folding removes them entirely.
+    """
+    from concurrent.futures import ThreadPoolExecutor
+
+    fold = analysis._device_fold_fn
+    fold_j = _jit_kernel(fold) if fold is not None else None
+    total = None
+    parts_list = []
+    bounds = list(iter_batches(0, len(frames), bs))
+
+    # Selection fingerprint for cache keys: a shared DeviceBlockCache must
+    # never serve blocks staged for a different selection, stride, batch
+    # size, or transfer dtype.
+    if sel_idx is None:
+        sel_fp = None
+    else:
+        sel_fp = (len(sel_idx), int(sel_idx[0]) if len(sel_idx) else -1,
+                  int(sel_idx[-1]) if len(sel_idx) else -1,
+                  int(np.asarray(sel_idx).sum()))
+
+    def prepare(ab):
+        """Host side of one batch: read+gather (+quantize) and enqueue
+        the device transfer.  Runs on the prefetch thread so the next
+        batch stages while the device consumes the current one (the
+        double-buffering from SURVEY.md §7 layer 5; NumPy releases the
+        GIL for the big copies)."""
+        a, b = ab
+        key = (tuple(frames[a:b]), bs, quantize, sel_fp)
+        staged = cache.get(key) if cache is not None else None
+        if staged is not None:
+            return staged
+        block = _stage(reader, frames[a:b], sel_idx)
+        if quantize:
+            block, inv_scale = quantize_block(block)
+        padded, mask = pad_batch(block, bs)
+        if device_put_fn is not None:
+            padded, mask = device_put_fn(padded, mask)
+        staged = (padded, inv_scale, mask) if quantize else (padded, mask)
+        if cache is not None:
+            cache.put(key, staged, padded.nbytes)
+        return staged
+
+    with ThreadPoolExecutor(max_workers=1) as pool:
+        fut = pool.submit(prepare, bounds[0]) if bounds else None
+        for i in range(len(bounds)):
+            staged = fut.result()
+            if i + 1 < len(bounds):
+                fut = pool.submit(prepare, bounds[i + 1])
+            partials = call(*staged)
+            if fold_j is not None:
+                total = partials if total is None else fold_j(total, partials)
+            else:
+                parts_list.append(partials)
+    if fold is not None:
+        return total if total is not None else analysis._identity_partials()
+    if not parts_list:
+        return analysis._identity_partials()
+    if len(parts_list) == 1:
+        return parts_list[0]
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree.map(lambda *xs: jnp.concatenate(xs, axis=0), *parts_list)
 
 
 class SerialExecutor:
@@ -84,28 +270,45 @@ class JaxExecutor:
 
     name = "jax"
 
-    def __init__(self, batch_size: int = 128, device=None):
+    def __init__(self, batch_size: int = 128, device=None,
+                 block_cache: DeviceBlockCache | None = None,
+                 transfer_dtype: str = "float32"):
+        if transfer_dtype not in ("float32", "int16"):
+            raise ValueError(
+                f"transfer_dtype must be 'float32' or 'int16', got {transfer_dtype!r}")
         self.batch_size = batch_size
         self.device = device
+        self.block_cache = block_cache
+        self.transfer_dtype = transfer_dtype
 
     def execute(self, analysis, reader, frames, batch_size=None):
         import jax
 
         bs = batch_size or self.batch_size
-        kernel = jax.jit(_f32_precision(analysis._make_batch_kernel()))
+        quantize = self.transfer_dtype == "int16"
+        f = analysis._batch_fn()
+        kernel = _jit_kernel(_dequant_wrapper(f) if quantize else f)
+        params = analysis._batch_params()
         sel_idx = analysis._batch_select()
         frames = list(frames)
-        total = None
-        for a, b in iter_batches(0, len(frames), bs):
-            block = _stage(reader, frames[a:b], sel_idx)
-            padded, mask = pad_batch(block, bs)
-            partials = kernel(padded, mask)
-            partials = jax.tree.map(lambda x: np.asarray(x, np.float64),
-                                    partials)
-            total = partials if total is None else analysis._combine(total, partials)
-        if total is None:
-            total = analysis._identity_partials()
-        return total
+        if quantize:
+            # wide selection → stage full frames, gather on device
+            if (sel_idx is not None and
+                    len(sel_idx) > _DEVICE_GATHER_FRACTION * reader.n_atoms):
+                import jax.numpy as jnp
+
+                params = (jnp.asarray(sel_idx), params)
+                sel_idx = None
+            else:
+                params = (None, params)
+
+        def put(padded, mask):
+            return jax.device_put(padded, self.device), jax.device_put(mask, self.device)
+
+        return _run_batches(
+            analysis, reader, frames, bs,
+            lambda *staged: kernel(params, *staged), sel_idx,
+            device_put_fn=put, cache=self.block_cache, quantize=quantize)
 
 
 class MeshExecutor:
@@ -121,10 +324,17 @@ class MeshExecutor:
     name = "mesh"
 
     def __init__(self, batch_size: int = 64, devices=None,
-                 axis_name: str = "data"):
+                 axis_name: str = "data",
+                 block_cache: DeviceBlockCache | None = None,
+                 transfer_dtype: str = "float32"):
+        if transfer_dtype not in ("float32", "int16"):
+            raise ValueError(
+                f"transfer_dtype must be 'float32' or 'int16', got {transfer_dtype!r}")
         self.batch_size = batch_size
         self.devices = devices
         self.axis_name = axis_name
+        self.block_cache = block_cache
+        self.transfer_dtype = transfer_dtype
 
     def _build(self, analysis):
         import jax
@@ -132,52 +342,76 @@ class MeshExecutor:
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
         devices = self.devices if self.devices is not None else jax.devices()
-        mesh = Mesh(np.asarray(devices), (self.axis_name,))
-        kernel = _f32_precision(analysis._make_batch_kernel())
+        quantize = self.transfer_dtype == "int16"
+        f = analysis._batch_fn()
+        if quantize:
+            f = _dequant_wrapper(f)
         devcombine = analysis._device_combine
+        key = (f, devcombine, tuple(devices), self.axis_name)
+        cached = _MESH_CACHE.get(key)
+        if cached is not None:
+            return cached
 
-        def shard_fn(batch, mask):
-            partials = kernel(batch, mask)
+        mesh = Mesh(np.asarray(devices), (self.axis_name,))
+        kernel = _f32_precision(f)
+        axis = self.axis_name
+
+        def shard_fn(params, *staged):
+            partials = kernel(params, *staged)
             if devcombine is not None:
-                return devcombine(partials, self.axis_name)
+                return devcombine(partials, axis)
             return partials
 
-        out_specs = P() if devcombine is not None else P(self.axis_name)
+        out_specs = P() if devcombine is not None else P(axis)
+        # staged is (batch, mask) or (batch_i16, inv_scale, mask); the
+        # inv_scale scalar is replicated
+        in_specs = ((P(), P(axis), P(), P(axis)) if quantize
+                    else (P(), P(axis), P(axis)))
         # check_vma=False: jnp.linalg.svd lowers to an iterative scan on
         # TPU whose bool carry trips the varying-manual-axes check inside
         # shard_map (works on CPU, fails on TPU); the kernel is purely
         # per-shard + explicit psum, so the check adds nothing here.
         gfn = jax.jit(shard_map(
             shard_fn, mesh=mesh,
-            in_specs=(P(self.axis_name), P(self.axis_name)),
+            in_specs=in_specs,
             out_specs=out_specs, check_vma=False))
-        sharding = NamedSharding(mesh, P(self.axis_name))
-        return len(devices), gfn, sharding
+        sharding = NamedSharding(mesh, P(axis))
+        result = (len(devices), gfn, sharding)
+        _MESH_CACHE[key] = result
+        return result
 
     def execute(self, analysis, reader, frames, batch_size=None):
         import jax
 
         bs = batch_size or self.batch_size
         n_dev, gfn, sharding = self._build(analysis)
+        params = analysis._batch_params()
         global_bs = bs * n_dev
         sel_idx = analysis._batch_select()
         frames = list(frames)
-        total = None
-        for a, b in iter_batches(0, len(frames), global_bs):
-            block = _stage(reader, frames[a:b], sel_idx)
-            padded, mask = pad_batch(block, global_bs)
-            padded = jax.device_put(padded, sharding)
-            mask = jax.device_put(mask, sharding)
-            partials = gfn(padded, mask)
-            # With _device_combine, outputs are replicated merged partials;
-            # without, out_specs=P(axis) concatenates per-device outputs
-            # along axis 0 in device (= frame) order — either way one
-            # partials pytree per global batch.
-            part = jax.tree.map(lambda x: np.asarray(x, np.float64), partials)
-            total = part if total is None else analysis._combine(total, part)
-        if total is None:
-            total = analysis._identity_partials()
-        return total
+        if self.transfer_dtype == "int16":
+            if (sel_idx is not None and
+                    len(sel_idx) > _DEVICE_GATHER_FRACTION * reader.n_atoms):
+                import jax.numpy as jnp
+
+                params = (jnp.asarray(sel_idx), params)
+                sel_idx = None
+            else:
+                params = (None, params)
+
+        def put(padded, mask):
+            return (jax.device_put(padded, sharding),
+                    jax.device_put(mask, sharding))
+
+        # With _device_combine, gfn outputs replicated merged partials;
+        # without, out_specs=P(axis) concatenates per-device outputs along
+        # axis 0 in device (= frame) order — either way one partials
+        # pytree per global batch, accumulated on device by _run_batches.
+        return _run_batches(
+            analysis, reader, frames, global_bs,
+            lambda *staged: gfn(params, *staged), sel_idx,
+            device_put_fn=put, cache=self.block_cache,
+            quantize=self.transfer_dtype == "int16")
 
 
 _EXECUTORS = {

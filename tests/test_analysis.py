@@ -138,6 +138,27 @@ def test_rmsd_mass_weighted(uni):
     assert not np.allclose(mw.results.rmsd[1:], uw.results.rmsd[1:])
 
 
+def test_int16_transfer_accuracy(uni):
+    """Quantized staging must stay within its documented resolution
+    (~max|x|/32000 per coordinate) of the exact f32 path."""
+    exact = AlignedRMSF(uni, select="protein and name CA").run(
+        backend="jax", batch_size=8).results.rmsf
+    quant = AlignedRMSF(uni, select="protein and name CA").run(
+        backend="jax", batch_size=8, transfer_dtype="int16").results.rmsf
+    coord_range = np.abs(uni.trajectory.coordinates).max()
+    assert np.abs(quant - exact).max() < 5 * coord_range / 32000
+    # mesh path too
+    qm = AlignedRMSF(uni, select="protein and name CA").run(
+        backend="mesh", batch_size=4, transfer_dtype="int16").results.rmsf
+    assert np.abs(qm - exact).max() < 5 * coord_range / 32000
+
+
+def test_bad_transfer_dtype(uni):
+    with pytest.raises(ValueError, match="transfer_dtype"):
+        AlignedRMSF(uni, select="name CA").run(backend="jax",
+                                               transfer_dtype="int8")
+
+
 def test_rmsd_atomgroup_select_refines_within_group(uni):
     """RMSD(group, select=...) must stay restricted to the group."""
     half = uni.atoms[: uni.topology.n_atoms // 2]
