@@ -236,6 +236,11 @@ class AlignedRMSF(AnalysisBase):
             cache = kwargs.pop("block_cache", None) or DeviceBlockCache()
             backend = get_executor(backend, block_cache=cache, **kwargs)
             kwargs = {}
+        elif getattr(backend, "block_cache", False) is None:
+            # executor instance without a cache: attach one so pass 2
+            # still reuses pass 1's staged blocks
+            from mdanalysis_mpi_tpu.parallel.executors import DeviceBlockCache
+            backend.block_cache = DeviceBlockCache()
         # Pass 1 (RMSF.py:76-113): average of aligned selection coords.
         # The lean select_only path is exact for pass 2, which only needs
         # the selection's average (SURVEY.md quirk Q5 discussion).

@@ -1,0 +1,46 @@
+"""Simulation-box conversions.
+
+``dimensions`` convention (MDAnalysis-compatible): ``[lx, ly, lz, alpha,
+beta, gamma]`` — lengths in Å, angles in degrees.  Trajectory formats
+store a 3x3 triclinic vector matrix (XTC) or a 6-element unit cell (DCD);
+these helpers convert both ways.  Also used by the PBC minimum-image
+distance kernels (BASELINE configs 4-5).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def box_to_vectors(dim: np.ndarray) -> np.ndarray:
+    """[lx,ly,lz,alpha,beta,gamma] → lower-triangular 3x3 box matrix (Å).
+
+    Standard crystallographic construction: a along x; b in the xy
+    plane; c completes the triclinic cell.
+    """
+    lx, ly, lz, alpha, beta, gamma = (float(x) for x in dim[:6])
+    if lx == 0 and ly == 0 and lz == 0:
+        return np.zeros((3, 3))
+    ca, cb, cg = (np.cos(np.radians(a)) for a in (alpha, beta, gamma))
+    sg = np.sin(np.radians(gamma))
+    m = np.zeros((3, 3))
+    m[0, 0] = lx
+    m[1, 0] = ly * cg
+    m[1, 1] = ly * sg
+    m[2, 0] = lz * cb
+    m[2, 1] = lz * (ca - cb * cg) / sg
+    m[2, 2] = np.sqrt(max(lz * lz - m[2, 0] ** 2 - m[2, 1] ** 2, 0.0))
+    return m
+
+
+def vectors_to_box(m: np.ndarray) -> np.ndarray:
+    """Lower-triangular (or general) 3x3 box matrix → [lx,ly,lz,α,β,γ]."""
+    m = np.asarray(m, dtype=np.float64)
+    a, b, c = m[0], m[1], m[2]
+    la, lb, lc = (np.linalg.norm(v) for v in (a, b, c))
+    if la == 0 or lb == 0 or lc == 0:
+        return np.zeros(6, dtype=np.float32)
+    alpha = np.degrees(np.arccos(np.clip(b @ c / (lb * lc), -1, 1)))
+    beta = np.degrees(np.arccos(np.clip(a @ c / (la * lc), -1, 1)))
+    gamma = np.degrees(np.arccos(np.clip(a @ b / (la * lb), -1, 1)))
+    return np.array([la, lb, lc, alpha, beta, gamma], dtype=np.float32)

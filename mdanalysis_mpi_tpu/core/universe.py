@@ -44,11 +44,12 @@ class Universe:
     def __init__(self, topology, trajectory=None, **kwargs):
         self.topology = _load_topology(topology)
         if trajectory is None:
-            # Topology-only universe: a single all-zero frame, like
-            # upstream's coordinate-less construction.
+            # Topology-only universe: coordinates embedded in the
+            # topology file (GRO/PDB) if present, else one zero frame.
             src = getattr(self.topology, "_coordinates", None)
+            dims = getattr(self.topology, "_dimensions", None)
             if src is not None:
-                trajectory = src
+                trajectory = MemoryReader(src, dimensions=dims)
             else:
                 trajectory = np.zeros((1, self.topology.n_atoms, 3),
                                       dtype=np.float32)

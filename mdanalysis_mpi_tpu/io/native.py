@@ -1,0 +1,88 @@
+"""ctypes loader for the native trajio codec (libtrajio.so).
+
+Builds the shared object on first use (g++ via the adjacent Makefile —
+the toolchain is a baked-in dependency of this framework's environment;
+SURVEY.md §7 layer 2 calls for C++ where the reference's I/O stack is
+native).  pybind11 is unavailable here, hence the plain C ABI + ctypes.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "native")
+_SO = os.path.join(_DIR, "libtrajio.so")
+_SRC = os.path.join(_DIR, "trajio.cpp")
+
+_lock = threading.Lock()
+_lib = None
+
+_f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+_f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+_i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+_i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+
+
+def _build():
+    try:
+        subprocess.run(
+            ["make", "-s", "-C", _DIR],
+            check=True, capture_output=True, text=True)
+    except (subprocess.CalledProcessError, FileNotFoundError) as e:
+        detail = getattr(e, "stderr", "") or str(e)
+        raise RuntimeError(
+            f"failed to build native trajio library in {_DIR}: {detail}"
+        ) from e
+
+
+def load() -> ctypes.CDLL:
+    """Load (building if needed) the native library; thread-safe."""
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if (not os.path.exists(_SO)
+                or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+            _build()
+        lib = ctypes.CDLL(_SO)
+
+        lib.xtc_scan.restype = ctypes.c_long
+        lib.xtc_scan.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int),
+            ctypes.c_void_p, ctypes.c_long]
+
+        lib.xtc_read_frames.restype = ctypes.c_int
+        lib.xtc_read_frames.argtypes = [
+            ctypes.c_char_p, _i64p, ctypes.c_long, ctypes.c_int,
+            _f32p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+
+        lib.xtc_write.restype = ctypes.c_int
+        lib.xtc_write.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_long, _f32p,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_float]
+
+        lib.dcd_scan.restype = ctypes.c_long
+        lib.dcd_scan.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_long),
+            ctypes.POINTER(ctypes.c_long)]
+
+        lib.dcd_read_frames.restype = ctypes.c_int
+        lib.dcd_read_frames.argtypes = [
+            ctypes.c_char_p, _i64p, ctypes.c_long, ctypes.c_int,
+            ctypes.c_int, ctypes.c_long, ctypes.c_long, _f32p,
+            ctypes.c_void_p]
+
+        lib.dcd_write.restype = ctypes.c_int
+        lib.dcd_write.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_long, _f32p,
+            ctypes.c_void_p, ctypes.c_double]
+
+        _lib = lib
+        return _lib

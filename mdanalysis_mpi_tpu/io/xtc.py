@@ -1,0 +1,163 @@
+"""XTC trajectory reader/writer over the native codec.
+
+The reference's trajectory layer is MDAnalysis' Cython/C XTC stack
+(random access via a frame-offset index, RMSF.py:56,92,124 — SURVEY.md
+§2.2); here the decode core is C++ (io/native/trajio.cpp) and this
+module adds the offset index with on-disk caching, the ``ReaderBase``
+interface, bulk ``read_block`` staging, and Å↔nm unit conversion
+(XTC stores nm; the framework's coordinate unit is Å).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+from mdanalysis_mpi_tpu.core.box import box_to_vectors, vectors_to_box
+from mdanalysis_mpi_tpu.core.timestep import Timestep
+from mdanalysis_mpi_tpu.io import native, trajectory_files
+from mdanalysis_mpi_tpu.io.base import ReaderBase
+
+_NM_TO_A = 10.0
+
+
+def _offset_cache_path(path: str) -> str:
+    return path + ".mdtpu_offsets.npz"
+
+
+def _scan(path: str):
+    """Frame offsets + natoms, with an mtime-validated on-disk cache
+    (upstream builds and caches the same index — SURVEY.md §2.2)."""
+    cache = _offset_cache_path(path)
+    mtime = os.path.getmtime(path)
+    if os.path.exists(cache):
+        try:
+            z = np.load(cache)
+            if float(z["mtime"]) == mtime:
+                return z["offsets"].astype(np.int64), int(z["natoms"])
+        except Exception:
+            pass
+    lib = native.load()
+    natoms = ctypes.c_int(-1)
+    n = lib.xtc_scan(path.encode(), ctypes.byref(natoms), None, 0)
+    if n < 0:
+        raise IOError(f"cannot scan XTC file {path!r} (error {n})")
+    offsets = np.zeros(n, dtype=np.int64)
+    n2 = lib.xtc_scan(path.encode(), ctypes.byref(natoms),
+                      offsets.ctypes.data_as(ctypes.c_void_p), n)
+    if n2 != n:
+        raise IOError(f"inconsistent XTC scan of {path!r}")
+    try:
+        np.savez(cache, offsets=offsets, natoms=natoms.value, mtime=mtime)
+    except OSError:
+        pass  # read-only directory: index just isn't cached
+    return offsets, natoms.value
+
+
+class XTCReader(ReaderBase):
+    """Random-access XTC reader (coordinates in Å, box as dimensions)."""
+
+    def __init__(self, path: str, n_atoms: int | None = None):
+        self._path = path
+        self._offsets, self._natoms = _scan(path)
+        if n_atoms is not None and n_atoms != self._natoms:
+            raise ValueError(
+                f"XTC {path!r} has {self._natoms} atoms, expected {n_atoms}")
+        self._lib = native.load()
+
+    @property
+    def n_frames(self) -> int:
+        return len(self._offsets)
+
+    @property
+    def n_atoms(self) -> int:
+        return self._natoms
+
+    def reopen(self) -> "XTCReader":
+        return XTCReader(self._path)
+
+    def _read_range(self, idx: np.ndarray):
+        n = len(idx)
+        coords = np.empty((n, self._natoms, 3), dtype=np.float32)
+        box = np.empty((n, 9), dtype=np.float32)
+        times = np.empty(n, dtype=np.float32)
+        steps = np.empty(n, dtype=np.int32)
+        rc = self._lib.xtc_read_frames(
+            self._path.encode(), self._offsets[idx], n, self._natoms,
+            coords, box.ctypes.data_as(ctypes.c_void_p),
+            times.ctypes.data_as(ctypes.c_void_p),
+            steps.ctypes.data_as(ctypes.c_void_p))
+        if rc != 0:
+            raise IOError(f"XTC decode failed for {self._path!r} (error {rc})")
+        coords *= _NM_TO_A
+        return coords, box, times, steps
+
+    def _read_frame(self, i: int) -> Timestep:
+        coords, box, times, steps = self._read_range(np.array([i]))
+        dims = vectors_to_box(box[0].reshape(3, 3) * _NM_TO_A)
+        if not dims[:3].any():
+            dims = None
+        return Timestep(coords[0], frame=i, time=float(times[0]),
+                        dimensions=dims)
+
+    def read_block(self, start: int, stop: int, sel=None):
+        if not 0 <= start <= stop <= self.n_frames:
+            raise IndexError(
+                f"block [{start},{stop}) out of range [0,{self.n_frames}]")
+        if start == stop:
+            n = self._natoms if sel is None else len(sel)
+            return np.empty((0, n, 3), np.float32), None
+        coords, box, _, _ = self._read_range(np.arange(start, stop))
+        if sel is not None:
+            coords = np.ascontiguousarray(coords[:, sel])
+        boxes = np.stack([
+            vectors_to_box(b.reshape(3, 3) * _NM_TO_A) for b in box])
+        if not boxes[:, :3].any():
+            boxes = None
+        return coords, boxes
+
+
+def write_xtc(path: str, coordinates: np.ndarray,
+              dimensions: np.ndarray | None = None,
+              times: np.ndarray | None = None,
+              steps: np.ndarray | None = None,
+              precision: float = 1000.0) -> None:
+    """Write (n_frames, n_atoms, 3) Å coordinates as a compressed XTC.
+
+    ``precision`` is in the XTC convention (positions quantized to
+    1/precision nm; 1000 ≈ 0.001 nm = 0.01 Å resolution).  This is the
+    fixture *writer* SURVEY.md §4 requires (no MDAnalysisTests data
+    offline).
+    """
+    coords = np.ascontiguousarray(
+        np.asarray(coordinates, dtype=np.float32) / _NM_TO_A)
+    if coords.ndim != 3 or coords.shape[2] != 3:
+        raise ValueError(f"coordinates must be (F, N, 3), got {coords.shape}")
+    nframes, natoms = coords.shape[:2]
+    boxp = None
+    if dimensions is not None:
+        dimensions = np.asarray(dimensions)
+        if dimensions.ndim == 1:
+            dimensions = np.broadcast_to(dimensions, (nframes, 6))
+        box = np.stack([
+            box_to_vectors(d) / _NM_TO_A for d in dimensions]
+        ).astype(np.float32).reshape(nframes, 9)
+        box = np.ascontiguousarray(box)
+        boxp = box.ctypes.data_as(ctypes.c_void_p)
+    timesp = stepsp = None
+    if times is not None:
+        times = np.ascontiguousarray(times, dtype=np.float32)
+        timesp = times.ctypes.data_as(ctypes.c_void_p)
+    if steps is not None:
+        steps = np.ascontiguousarray(steps, dtype=np.int32)
+        stepsp = steps.ctypes.data_as(ctypes.c_void_p)
+    rc = native.load().xtc_write(path.encode(), natoms, nframes, coords,
+                                 boxp, timesp, stepsp,
+                                 ctypes.c_float(precision))
+    if rc != 0:
+        raise IOError(f"XTC write failed for {path!r} (error {rc})")
+
+
+trajectory_files.register("xtc", XTCReader)

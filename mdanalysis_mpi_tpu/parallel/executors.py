@@ -120,6 +120,27 @@ def _dequant_wrapper(fn):
     return g
 
 
+def _validate_transfer_dtype(transfer_dtype: str) -> None:
+    if transfer_dtype not in ("float32", "int16"):
+        raise ValueError(
+            f"transfer_dtype must be 'float32' or 'int16', got {transfer_dtype!r}")
+
+
+def _wrap_for_transfer(params, sel_idx, n_atoms: int, transfer_dtype: str):
+    """Shared int16-staging setup for Jax/Mesh executors: wrap params as
+    ``(device_gather_sel, params)`` for the dequant wrapper, moving the
+    selection gather onto the device for wide selections (see
+    ``_DEVICE_GATHER_FRACTION``).  Returns (params, sel_idx)."""
+    if transfer_dtype != "int16":
+        return params, sel_idx
+    if (sel_idx is not None
+            and len(sel_idx) > _DEVICE_GATHER_FRACTION * n_atoms):
+        import jax.numpy as jnp
+
+        return (jnp.asarray(sel_idx), params), None
+    return (None, params), sel_idx
+
+
 # Selections wider than this fraction of the system are gathered on
 # device (full-frame staging) instead of on the host staging core.
 # Worth enabling (~0.25) when the host link is fast (PCIe-attached TPU)
@@ -197,15 +218,15 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
     parts_list = []
     bounds = list(iter_batches(0, len(frames), bs))
 
-    # Selection fingerprint for cache keys: a shared DeviceBlockCache must
-    # never serve blocks staged for a different selection, stride, batch
+    # Cache-key namespace: a shared DeviceBlockCache must never serve
+    # blocks staged for a different selection (exact content hash), a
+    # different trajectory (reader path or identity), stride, batch
     # size, or transfer dtype.
     if sel_idx is None:
         sel_fp = None
     else:
-        sel_fp = (len(sel_idx), int(sel_idx[0]) if len(sel_idx) else -1,
-                  int(sel_idx[-1]) if len(sel_idx) else -1,
-                  int(np.asarray(sel_idx).sum()))
+        sel_fp = hash(np.ascontiguousarray(sel_idx).tobytes())
+    reader_fp = getattr(reader, "_path", None) or id(reader)
 
     def prepare(ab):
         """Host side of one batch: read+gather (+quantize) and enqueue
@@ -214,7 +235,7 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
         double-buffering from SURVEY.md §7 layer 5; NumPy releases the
         GIL for the big copies)."""
         a, b = ab
-        key = (tuple(frames[a:b]), bs, quantize, sel_fp)
+        key = (reader_fp, tuple(frames[a:b]), bs, quantize, sel_fp)
         staged = cache.get(key) if cache is not None else None
         if staged is not None:
             return staged
@@ -273,9 +294,7 @@ class JaxExecutor:
     def __init__(self, batch_size: int = 128, device=None,
                  block_cache: DeviceBlockCache | None = None,
                  transfer_dtype: str = "float32"):
-        if transfer_dtype not in ("float32", "int16"):
-            raise ValueError(
-                f"transfer_dtype must be 'float32' or 'int16', got {transfer_dtype!r}")
+        _validate_transfer_dtype(transfer_dtype)
         self.batch_size = batch_size
         self.device = device
         self.block_cache = block_cache
@@ -288,19 +307,10 @@ class JaxExecutor:
         quantize = self.transfer_dtype == "int16"
         f = analysis._batch_fn()
         kernel = _jit_kernel(_dequant_wrapper(f) if quantize else f)
-        params = analysis._batch_params()
-        sel_idx = analysis._batch_select()
+        params, sel_idx = _wrap_for_transfer(
+            analysis._batch_params(), analysis._batch_select(),
+            reader.n_atoms, self.transfer_dtype)
         frames = list(frames)
-        if quantize:
-            # wide selection → stage full frames, gather on device
-            if (sel_idx is not None and
-                    len(sel_idx) > _DEVICE_GATHER_FRACTION * reader.n_atoms):
-                import jax.numpy as jnp
-
-                params = (jnp.asarray(sel_idx), params)
-                sel_idx = None
-            else:
-                params = (None, params)
 
         def put(padded, mask):
             return jax.device_put(padded, self.device), jax.device_put(mask, self.device)
@@ -327,9 +337,7 @@ class MeshExecutor:
                  axis_name: str = "data",
                  block_cache: DeviceBlockCache | None = None,
                  transfer_dtype: str = "float32"):
-        if transfer_dtype not in ("float32", "int16"):
-            raise ValueError(
-                f"transfer_dtype must be 'float32' or 'int16', got {transfer_dtype!r}")
+        _validate_transfer_dtype(transfer_dtype)
         self.batch_size = batch_size
         self.devices = devices
         self.axis_name = axis_name
@@ -385,19 +393,11 @@ class MeshExecutor:
 
         bs = batch_size or self.batch_size
         n_dev, gfn, sharding = self._build(analysis)
-        params = analysis._batch_params()
         global_bs = bs * n_dev
-        sel_idx = analysis._batch_select()
+        params, sel_idx = _wrap_for_transfer(
+            analysis._batch_params(), analysis._batch_select(),
+            reader.n_atoms, self.transfer_dtype)
         frames = list(frames)
-        if self.transfer_dtype == "int16":
-            if (sel_idx is not None and
-                    len(sel_idx) > _DEVICE_GATHER_FRACTION * reader.n_atoms):
-                import jax.numpy as jnp
-
-                params = (jnp.asarray(sel_idx), params)
-                sel_idx = None
-            else:
-                params = (None, params)
 
         def put(padded, mask):
             return (jax.device_put(padded, sharding),
