@@ -21,9 +21,10 @@ from mdanalysis_mpi_tpu.parallel.partition import iter_batches, pad_batch
 
 # ---- module-level batch kernels (stable identity → cached compiles) ----
 
-def _avg_all_kernel(params, batch, mask):
+def _avg_all_kernel(params, batch, boxes, mask):
     """Aligned all-atom masked sum: partials (T, Σ aligned) — pass 1 wide
     path (RMSF.py:89-103)."""
+    del boxes
     import jax.numpy as jnp
 
     from mdanalysis_mpi_tpu.ops.align import _HI, superpose_batch
@@ -33,8 +34,9 @@ def _avg_all_kernel(params, batch, mask):
     return (mask.sum(), jnp.einsum("b,bni->ni", mask, aligned, precision=_HI))
 
 
-def _avg_sel_kernel(params, batch, mask):
+def _avg_sel_kernel(params, batch, boxes, mask):
     """Aligned selection-only masked sum (lean pass-1 path)."""
+    del boxes
     import jax.numpy as jnp
 
     from mdanalysis_mpi_tpu.ops.align import _HI, superpose_selection_batch

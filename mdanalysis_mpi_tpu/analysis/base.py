@@ -15,7 +15,7 @@ Subclasses implement:
 ``_single_frame(ts)``  serial oracle path: update host accumulators
 ``_serial_summary()``  → partials pytree after the serial loop
 ``_batch_fn()``        → a MODULE-LEVEL jittable function
-                       ``f(params, batch (B,S,3) f32, mask (B,)) ->
+                       ``f(params, batch (B,S,3) f32, boxes (B,6) f32, mask (B,)) ->
                        partials`` (device path).  Module-level (not a
                        per-run closure) so executors can cache the
                        compiled kernel across run() calls.

@@ -1,0 +1,130 @@
+"""Distance-based analyses: contact maps and per-frame distance
+matrices (BASELINE config 5: ``distances.self_distance_array`` /
+contact map, per frame)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mdanalysis_mpi_tpu.analysis.base import AnalysisBase
+from mdanalysis_mpi_tpu.core.groups import AtomGroup
+from mdanalysis_mpi_tpu.ops import host
+
+
+def _contact_kernel(params, batch, boxes, mask):
+    from mdanalysis_mpi_tpu.ops.distances import contact_fraction_batch
+
+    (cutoff,) = params      # traced scalar; used only in comparisons
+    return contact_fraction_batch(batch, boxes, mask, cutoff)
+
+
+def _add2(a, b):
+    return (a[0] + b[0], a[1] + b[1])
+
+
+def _psum2(partials, axis_name):
+    import jax
+
+    return jax.tree.map(lambda x: jax.lax.psum(x, axis_name), partials)
+
+
+class ContactMap(AnalysisBase):
+    """Time-averaged contact map of an AtomGroup.
+
+    ``.results.contact_fraction`` is the (S, S) fraction of frames in
+    which each pair sits within ``cutoff`` (minimum-image if the
+    trajectory has a box); ``.results.contact_map`` thresholds it at
+    ``persistence``.  Materializes (S, S) per frame — selection-sized
+    groups (Cα, residues); use the RDF/histogram kernels for full
+    systems.
+    """
+
+    def __init__(self, atomgroup: AtomGroup, cutoff: float = 8.0,
+                 persistence: float = 0.5, verbose: bool = False):
+        super().__init__(atomgroup.universe, verbose)
+        self._ag = atomgroup
+        self._cutoff = float(cutoff)
+        self._persistence = float(persistence)
+
+    def _prepare(self):
+        if self._ag.n_atoms == 0:
+            raise ValueError("ContactMap over an empty AtomGroup")
+        self._idx = self._ag.indices
+        s = len(self._idx)
+        self._acc = np.zeros((s, s), dtype=np.float64)
+        self._t = 0
+
+    # -- serial path --
+
+    def _single_frame(self, ts):
+        x = ts.positions[self._idx].astype(np.float64)
+        box = None if ts.dimensions is None else ts.dimensions.astype(np.float64)
+        d = host.distance_array(x, x, box)
+        self._acc += d < self._cutoff
+        self._t += 1
+
+    def _serial_summary(self):
+        return (self._acc, float(self._t))
+
+    # -- batch path --
+
+    def _batch_select(self):
+        return self._idx
+
+    def _batch_fn(self):
+        return _contact_kernel
+
+    def _batch_params(self):
+        return (self._cutoff,)
+
+    _device_fold_fn = staticmethod(_add2)
+    _device_combine = staticmethod(_psum2)
+
+    def _identity_partials(self):
+        s = len(self._idx)
+        return (np.zeros((s, s)), 0.0)
+
+    def _conclude(self, total):
+        acc, t = total
+        t = float(t)
+        if t == 0:
+            raise ValueError("ContactMap over zero frames")
+        frac = np.asarray(acc, np.float64) / t
+        self.results.contact_fraction = frac
+        self.results.contact_map = frac >= self._persistence
+        self.results.n_frames = int(t)
+
+
+class PairwiseDistances(AnalysisBase):
+    """Per-frame condensed self-distance arrays of an AtomGroup.
+
+    ``.results.distances`` is (n_frames, S·(S-1)/2) in upstream's
+    ``self_distance_array`` order.  Memory scales with frames ×
+    pairs — a per-frame map, so it runs serially over frames on host
+    (the heavy per-pair work is NumPy-vectorized; use :class:`ContactMap`
+    or RDF kernels for reductions at scale).
+    """
+
+    def __init__(self, atomgroup: AtomGroup, verbose: bool = False):
+        super().__init__(atomgroup.universe, verbose)
+        self._ag = atomgroup
+
+    def _prepare(self):
+        if self._ag.n_atoms < 2:
+            raise ValueError("PairwiseDistances needs at least 2 atoms")
+        self._idx = self._ag.indices
+        self._rows: list[np.ndarray] = []
+
+    def _single_frame(self, ts):
+        x = ts.positions[self._idx].astype(np.float64)
+        box = None if ts.dimensions is None else ts.dimensions.astype(np.float64)
+        d = host.distance_array(x, x, box)
+        iu, ju = np.triu_indices(len(self._idx), k=1)
+        self._rows.append(d[iu, ju])
+
+    def _serial_summary(self):
+        return np.asarray(self._rows)
+
+    def _conclude(self, total):
+        self.results.distances = np.asarray(total)
+        self.results.n_frames = len(self.results.distances)

@@ -26,17 +26,19 @@ from mdanalysis_mpi_tpu.ops.moments import (
 
 # ---- module-level batch kernels (stable identity → cached compiles) ----
 
-def _moments_kernel(params, batch, mask):
+def _moments_kernel(params, batch, boxes, mask):
     """Plain batched moments of the staged selection (stock RMSF)."""
+    del boxes
     from mdanalysis_mpi_tpu.ops.moments import batch_moments
 
     del params
     return batch_moments(batch, mask)
 
 
-def _aligned_moments_kernel(params, batch, mask):
+def _aligned_moments_kernel(params, batch, boxes, mask):
     """Superpose the selection onto fixed reference coords, then batched
     moments — the reference's pass-2 body (RMSF.py:124-138)."""
+    del boxes
     from mdanalysis_mpi_tpu.ops.align import superpose_selection_batch
     from mdanalysis_mpi_tpu.ops.moments import batch_moments
 
@@ -45,8 +47,9 @@ def _aligned_moments_kernel(params, batch, mask):
     return batch_moments(aligned, mask)
 
 
-def _rmsd_kernel(params, batch, mask):
+def _rmsd_kernel(params, batch, boxes, mask):
     """Per-frame RMSD with superposition (BASELINE config 3)."""
+    del boxes
     from mdanalysis_mpi_tpu.ops.rmsd import rmsd_batch
 
     masses, rot_w, rmsd_w, ref_c = params
@@ -55,8 +58,9 @@ def _rmsd_kernel(params, batch, mask):
     return (vals * mask, mask)
 
 
-def _rmsd_nofit_kernel(params, batch, mask):
+def _rmsd_nofit_kernel(params, batch, boxes, mask):
     """Per-frame RMSD without superposition."""
+    del boxes
     from mdanalysis_mpi_tpu.ops.rmsd import rmsd_batch
 
     masses, rot_w, rmsd_w, ref_c = params

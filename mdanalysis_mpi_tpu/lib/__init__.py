@@ -1,0 +1,4 @@
+"""Library-level helpers mirroring the upstream ``MDAnalysis.lib``
+surface the reference's capability envelope touches (SURVEY.md §2.2:
+``lib.distances``/``c_distances``; ``lib.qcprot`` is covered by
+:mod:`mdanalysis_mpi_tpu.ops.align`/:mod:`~mdanalysis_mpi_tpu.ops.host`)."""

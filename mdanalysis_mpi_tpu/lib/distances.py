@@ -1,0 +1,97 @@
+"""Distance functions mirroring ``MDAnalysis.lib.distances`` (the
+C/Cython layer in upstream — SURVEY.md §2.2; BASELINE config 5 names
+``distances.self_distance_array``).
+
+``backend="numpy"`` (default) runs the float64 host oracle;
+``backend="jax"`` dispatches to the device kernels (f32) — worthwhile
+for repeated large calls, not single small ones.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _dims_of(box):
+    if box is None:
+        return None
+    box = np.asarray(box, dtype=np.float64)
+    if box.shape == (6,):
+        return box
+    if box.shape == (3,):
+        return np.concatenate([box, [90.0, 90.0, 90.0]])
+    raise ValueError(f"box must be (3,) lengths or (6,) dimensions, got {box.shape}")
+
+
+def distance_array(reference, configuration, box=None,
+                   backend: str = "numpy") -> np.ndarray:
+    """(N, M) pair distances between two coordinate sets."""
+    a = np.asarray(reference, dtype=np.float64).reshape(-1, 3)
+    b = np.asarray(configuration, dtype=np.float64).reshape(-1, 3)
+    dims = _dims_of(box)
+    if backend == "numpy":
+        from mdanalysis_mpi_tpu.ops import host
+
+        return host.distance_array(a, b, dims)
+    if backend == "jax":
+        import jax.numpy as jnp
+
+        from mdanalysis_mpi_tpu.ops import distances as d
+
+        return np.asarray(d.distance_array(
+            jnp.asarray(a, jnp.float32), jnp.asarray(b, jnp.float32),
+            None if dims is None else jnp.asarray(dims, jnp.float32)),
+            dtype=np.float64)
+    raise ValueError(f"backend must be 'numpy' or 'jax', got {backend!r}")
+
+
+def self_distance_array(reference, box=None,
+                        backend: str = "numpy") -> np.ndarray:
+    """Condensed upper-triangle distances, length N(N-1)/2, in upstream's
+    (i<j) row-major order (BASELINE config 5)."""
+    a = np.asarray(reference, dtype=np.float64).reshape(-1, 3)
+    dims = _dims_of(box)
+    if backend == "jax":
+        import jax.numpy as jnp
+
+        from mdanalysis_mpi_tpu.ops import distances as d
+
+        # condensed on device — one small fetch instead of the full matrix
+        return np.asarray(d.self_distance_array(
+            jnp.asarray(a, jnp.float32),
+            None if dims is None else jnp.asarray(dims, jnp.float32)),
+            dtype=np.float64)
+    d = distance_array(a, a, box=box, backend=backend)
+    iu, ju = np.triu_indices(len(a), k=1)
+    return d[iu, ju]
+
+
+def calc_bonds(coords1, coords2, box=None, backend: str = "numpy") -> np.ndarray:
+    """Pairwise (row-wise) distances between two equal-length sets."""
+    if backend not in ("numpy", "jax"):
+        raise ValueError(f"backend must be 'numpy' or 'jax', got {backend!r}")
+    a = np.asarray(coords1, dtype=np.float64).reshape(-1, 3)
+    b = np.asarray(coords2, dtype=np.float64).reshape(-1, 3)
+    if a.shape != b.shape:
+        raise ValueError(f"shape mismatch {a.shape} vs {b.shape}")
+    dims = _dims_of(box)
+    if backend == "jax":
+        import jax.numpy as jnp
+
+        from mdanalysis_mpi_tpu.ops import distances as d
+
+        disp = d.minimum_image(
+            jnp.asarray(a - b, jnp.float32),
+            None if dims is None else jnp.asarray(dims, jnp.float32))
+        return np.asarray(jnp.sqrt((disp ** 2).sum(-1)), dtype=np.float64)
+    from mdanalysis_mpi_tpu.ops import host
+
+    disp = host.minimum_image(a - b, dims)
+    return np.sqrt((disp ** 2).sum(-1))
+
+
+def contact_matrix(coords, cutoff: float = 15.0, box=None,
+                   backend: str = "numpy") -> np.ndarray:
+    """Boolean (N, N) contact map at ``cutoff`` (BASELINE config 5)."""
+    a = np.asarray(coords, dtype=np.float64).reshape(-1, 3)
+    return distance_array(a, a, box=box, backend=backend) < cutoff

@@ -1,0 +1,28 @@
+"""JAX twin of :mod:`mdanalysis_mpi_tpu.core.box` (traceable, no host
+branching): dimensions → lower-triangular box matrix."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def box_to_matrix(dim: jax.Array) -> jax.Array:
+    """[lx,ly,lz,alpha,beta,gamma] → (3,3) lower-triangular box matrix.
+
+    Zero-length boxes yield the zero matrix (volume 0).  Angles in
+    degrees; traceable under jit/vmap.
+    """
+    lx, ly, lz = dim[0], dim[1], dim[2]
+    alpha, beta, gamma = (jnp.radians(dim[i]) for i in (3, 4, 5))
+    ca, cb, cg = jnp.cos(alpha), jnp.cos(beta), jnp.cos(gamma)
+    sg = jnp.sin(gamma)
+    safe_sg = jnp.where(jnp.abs(sg) < 1e-9, 1.0, sg)
+    m10 = ly * cg
+    m11 = ly * sg
+    m20 = lz * cb
+    m21 = lz * (ca - cb * cg) / safe_sg
+    m22 = jnp.sqrt(jnp.maximum(lz * lz - m20 ** 2 - m21 ** 2, 0.0))
+    return jnp.array([[lx, 0.0, 0.0],
+                      [m10, m11, 0.0],
+                      [m20, m21, m22]])

@@ -1,0 +1,178 @@
+"""Pair-distance kernels: PBC minimum image, tiled pair distances, RDF
+histograms (JAX).
+
+The reference's dependency closure reaches these through
+``MDAnalysis.lib.distances`` / ``InterRDF`` (C/Cython upstream —
+SURVEY.md §2.2 last row; BASELINE configs 4-5).  TPU-native design per
+SURVEY.md §5.7: a 100k² pair matrix (~40 GB) must never materialize, so
+the histogram/contact kernels are *blockwise* — tile over atom chunks
+with ``lax.map``, reduce per tile (structurally the blockwise-attention
+trick), and merge partials with the same fold/psum machinery as the
+moment kernels.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_HI = jax.lax.Precision.HIGHEST
+
+
+def minimum_image(disp: jax.Array, box: jax.Array | None) -> jax.Array:
+    """Apply the minimum-image convention to displacement vectors.
+
+    disp: (..., 3); box: dimensions ``[lx,ly,lz,alpha,beta,gamma]`` or
+    None (no PBC).  Orthorhombic boxes use the cheap per-axis wrap;
+    triclinic boxes go through fractional coordinates of the box matrix.
+    """
+    if box is None:
+        return disp
+    lengths = box[..., :3]
+    has_box = jnp.any(lengths > 0)
+    ortho = jnp.all(jnp.abs(box[..., 3:] - 90.0) < 1e-4)
+
+    def _ortho(d):
+        safe = jnp.where(lengths > 0, lengths, 1.0)
+        shift = jnp.round(d / safe) * safe
+        return jnp.where(lengths > 0, d - shift, d)
+
+    def _triclinic(d):
+        from mdanalysis_mpi_tpu.ops._boxmat import box_to_matrix
+
+        m = box_to_matrix(box)                       # (3,3) lower tri
+        # guard the inverse so a degenerate traced box can't inject NaNs
+        safe_m = m + jnp.eye(3) * jnp.where(jnp.abs(m[0, 0]) < 1e-9, 1.0, 0.0)
+        inv = jnp.linalg.inv(safe_m)
+        frac = jnp.einsum("...i,ij->...j", d, inv, precision=_HI)
+        frac = frac - jnp.round(frac)
+        return jnp.einsum("...i,ij->...j", frac, m, precision=_HI)
+
+    def _with_box(d):
+        return jax.lax.cond(ortho, _ortho, _triclinic, d)
+
+    return jax.lax.cond(has_box, _with_box, lambda d: d, disp)
+
+
+def distance_array(a: jax.Array, b: jax.Array,
+                   box: jax.Array | None = None) -> jax.Array:
+    """Full (N, M) distance matrix (materializes — modest sizes only;
+    the blockwise kernels below are the scalable path)."""
+    disp = a[:, None, :] - b[None, :, :]
+    disp = minimum_image(disp, box)
+    return jnp.sqrt((disp ** 2).sum(-1))
+
+
+def self_distance_array(a: jax.Array,
+                        box: jax.Array | None = None) -> jax.Array:
+    """Condensed upper-triangle distances, length N(N-1)/2, in the
+    (i<j) row-major order of the upstream API."""
+    n = a.shape[0]
+    d = distance_array(a, a, box)
+    iu, ju = jnp.triu_indices(n, k=1)
+    return d[iu, ju]
+
+
+def _pad_tiles(x: jax.Array, tile: int):
+    n = x.shape[0]
+    n_tiles = -(-n // tile)
+    pad = n_tiles * tile - n
+    xp = jnp.pad(x, ((0, pad), (0, 0)))
+    valid = jnp.pad(jnp.ones(n, x.dtype), (0, pad))
+    return xp.reshape(n_tiles, tile, 3), valid.reshape(n_tiles, tile)
+
+
+def pair_histogram(
+    a: jax.Array,                 # (N, 3) group-A coordinates
+    b: jax.Array,                 # (M, 3) group-B coordinates
+    edges: jax.Array,             # (nbins+1,) monotonically increasing
+    box: jax.Array | None = None,
+    exclude_self: bool = False,   # True when a and b are the same group
+    tile: int = 1024,
+) -> jax.Array:
+    """Blockwise histogram of pair distances — the RDF inner kernel.
+
+    Tiles group B into chunks of ``tile`` atoms; each chunk forms an
+    (N, tile) distance block, is bucketized against ``edges`` and
+    scatter-added into the (nbins,) histogram.  Peak memory is
+    O(N·tile), never O(N·M) (SURVEY.md §5.7).  ``exclude_self`` drops
+    i==j pairs (self-RDF); for identical groups every pair is counted
+    twice (i→j and j→i), which the RDF normalization accounts for.
+    """
+    nbins = edges.shape[0] - 1
+    bt, bvalid = _pad_tiles(b, tile)
+    n_tiles = bt.shape[0]
+
+    def one_tile(t):
+        bc, bv = bt[t], bvalid[t]
+        disp = a[:, None, :] - bc[None, :, :]
+        disp = minimum_image(disp, box)
+        d = jnp.sqrt((disp ** 2).sum(-1))            # (N, tile)
+        w = bv[None, :] * jnp.ones((a.shape[0], 1), a.dtype)
+        if exclude_self:
+            ia = jnp.arange(a.shape[0])[:, None]
+            ib = t * tile + jnp.arange(tile)[None, :]
+            w = w * (ia != ib)
+        # bucketize; out-of-range pairs land in bin index nbins (dropped)
+        idx = jnp.searchsorted(edges, d.ravel(), side="right") - 1
+        idx = jnp.where((d.ravel() >= edges[0]) & (d.ravel() < edges[-1]),
+                        idx, nbins)
+        return jax.ops.segment_sum(w.ravel(), idx, num_segments=nbins + 1)[:-1]
+
+    hists = jax.lax.map(one_tile, jnp.arange(n_tiles))
+    return hists.sum(axis=0)
+
+
+def pair_histogram_batch(
+    coords_a: jax.Array,          # (B, N, 3)
+    coords_b: jax.Array,          # (B, M, 3)
+    boxes: jax.Array,             # (B, 6); zero box = no PBC
+    mask: jax.Array,              # (B,)
+    edges: jax.Array,
+    exclude_self: bool = False,
+    tile: int = 1024,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Per-frame-batch RDF partials: (counts (nbins,), Σ volume, T).
+
+    Volume uses the orthorhombic product for zero-angle boxes and the
+    triclinic determinant otherwise; frames with no box get volume 0
+    (the RDF analysis rejects that combination up front).
+    """
+    from mdanalysis_mpi_tpu.ops._boxmat import box_to_matrix
+
+    def per_frame(args):
+        a, b, box6 = args
+        # minimum_image handles zero boxes (no wrap) itself
+        h = pair_histogram(a, b, edges, box=box6,
+                           exclude_self=exclude_self, tile=tile)
+        vol = jnp.abs(jnp.linalg.det(box_to_matrix(box6)))
+        return h, vol
+
+    hists, vols = jax.lax.map(per_frame, (coords_a, coords_b, boxes))
+    counts = jnp.einsum("b,bn->n", mask, hists, precision=_HI)
+    vol_sum = (vols * mask).sum()
+    return counts, vol_sum, mask.sum()
+
+
+def contact_fraction_batch(
+    coords: jax.Array,            # (B, S, 3)
+    boxes: jax.Array,             # (B, 6)
+    mask: jax.Array,              # (B,)
+    cutoff: float,
+) -> tuple[jax.Array, jax.Array]:
+    """Per-pair contact counts over a frame batch: (counts (S,S), T).
+
+    Materializes (S, S) per frame — intended for selection-sized groups
+    (contact maps of residues/Cα, BASELINE config 5); the blockwise
+    histogram kernels are the path for full systems.
+    """
+    def per_frame(args):
+        x, box6 = args
+        disp = x[:, None, :] - x[None, :, :]
+        disp = minimum_image(disp, box6)
+        d2 = (disp ** 2).sum(-1)
+        return (d2 < cutoff * cutoff).astype(jnp.float32)
+
+    contacts = jax.lax.map(per_frame, (coords, boxes))
+    return (jnp.einsum("b,bij->ij", mask, contacts, precision=_HI),
+            mask.sum())

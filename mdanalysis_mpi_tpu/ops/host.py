@@ -74,6 +74,37 @@ def superpose_frame(
     return (coords.astype(np.float64) - com) @ r + ref_com
 
 
+def minimum_image(disp: np.ndarray, box: np.ndarray | None) -> np.ndarray:
+    """NumPy oracle twin of ops.distances.minimum_image."""
+    if box is None or not np.any(box[:3] > 0):
+        return disp
+    if np.all(np.abs(box[3:] - 90.0) < 1e-4):
+        lengths = box[:3].astype(np.float64)
+        return disp - np.round(disp / lengths) * lengths
+    from mdanalysis_mpi_tpu.core.box import box_to_vectors
+
+    m = box_to_vectors(box)
+    frac = disp @ np.linalg.inv(m)
+    return (frac - np.round(frac)) @ m
+
+
+def distance_array(a: np.ndarray, b: np.ndarray,
+                   box: np.ndarray | None = None) -> np.ndarray:
+    """NumPy (N, M) pair distances with minimum image."""
+    disp = a[:, None, :].astype(np.float64) - b[None, :, :]
+    disp = minimum_image(disp, box)
+    return np.sqrt((disp ** 2).sum(-1))
+
+
+def pair_histogram(a, b, edges, box=None, exclude_self=False) -> np.ndarray:
+    """NumPy oracle for the RDF histogram kernel."""
+    d = distance_array(a, b, box)
+    if exclude_self:
+        n = min(d.shape)
+        d[np.arange(n), np.arange(n)] = -1.0   # below every edge
+    return np.histogram(d.ravel(), bins=edges)[0].astype(np.float64)
+
+
 class StreamingMoments:
     """Per-frame streaming Welford accumulator, float64.
 

@@ -80,41 +80,51 @@ def _jit_kernel(f):
     return fn
 
 
-def _stage(reader, frames: list[int], sel_idx) -> np.ndarray:
-    """Read ``frames`` → float32 (b, S, 3) with optional selection gather
-    pushed into the reader (one copy; slashes host work and host→HBM
-    traffic when S << N)."""
+def _stage(reader, frames: list[int], sel_idx):
+    """Read ``frames`` → (float32 (b, S, 3), boxes (b, 6) or None) with
+    the selection gather pushed into the reader (one copy; slashes host
+    work and host→HBM traffic when S << N)."""
     if len(frames) == 0:
         n = reader.n_atoms if sel_idx is None else len(sel_idx)
-        return np.empty((0, n, 3), dtype=np.float32)
+        return np.empty((0, n, 3), dtype=np.float32), None
     contiguous = frames[-1] - frames[0] + 1 == len(frames)
     if contiguous:
-        block, _ = reader.read_block(frames[0], frames[-1] + 1, sel=sel_idx)
-        return block
-    block = np.stack([reader[i].positions for i in frames])
-    return block if sel_idx is None else block[:, sel_idx]
+        return reader.read_block(frames[0], frames[-1] + 1, sel=sel_idx)
+    tss = [reader[i] for i in frames]
+    block = np.stack([ts.positions for ts in tss])
+    # per-frame optional boxes: zeros for boxless frames, None only when
+    # no frame carries one (matches the contiguous read_block contract)
+    boxes = None
+    for j, ts in enumerate(tss):
+        if ts.dimensions is not None:
+            if boxes is None:
+                boxes = np.zeros((len(tss), 6), dtype=np.float32)
+            boxes[j] = ts.dimensions
+    if sel_idx is not None:
+        block = block[:, sel_idx]
+    return block, boxes
 
 
 _DEQUANT_WRAPPERS: dict = {}
 
 
 def _dequant_wrapper(fn):
-    """Wrap kernel ``fn(params, batch_f32, mask)`` as
-    ``g((sel, params), batch_i16, inv_scale, mask)``: dequantize on
-    device and, when ``sel`` is not None, gather the selection on device
-    too (full-frame staging skips the host-side fancy-index gather —
-    cheaper for wide selections on a single staging core).  Cached per
-    fn so the jit cache stays stable."""
+    """Wrap kernel ``fn(params, batch_f32, boxes, mask)`` as
+    ``g((sel, params), batch_i16, inv_scale, boxes, mask)``: dequantize
+    on device and, when ``sel`` is not None, gather the selection on
+    device too (full-frame staging skips the host-side fancy-index
+    gather — cheaper for wide selections on a single staging core).
+    Cached per fn so the jit cache stays stable."""
     g = _DEQUANT_WRAPPERS.get(fn)
     if g is None:
         import jax.numpy as jnp
 
-        def g(wrapped_params, q, inv_scale, mask):
+        def g(wrapped_params, q, inv_scale, boxes, mask):
             sel, params = wrapped_params
             x = q.astype(jnp.float32) * inv_scale
             if sel is not None:
                 x = x[:, sel]
-            return fn(params, x, mask)
+            return fn(params, x, boxes, mask)
 
         _DEQUANT_WRAPPERS[fn] = g
     return g
@@ -239,13 +249,17 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
         staged = cache.get(key) if cache is not None else None
         if staged is not None:
             return staged
-        block = _stage(reader, frames[a:b], sel_idx)
+        block, boxes = _stage(reader, frames[a:b], sel_idx)
+        if boxes is None:
+            boxes = np.zeros((block.shape[0], 6), dtype=np.float32)
         if quantize:
             block, inv_scale = quantize_block(block)
         padded, mask = pad_batch(block, bs)
+        boxes_p, _ = pad_batch(np.ascontiguousarray(boxes, np.float32), bs)
         if device_put_fn is not None:
-            padded, mask = device_put_fn(padded, mask)
-        staged = (padded, inv_scale, mask) if quantize else (padded, mask)
+            padded, boxes_p, mask = device_put_fn(padded, boxes_p, mask)
+        staged = ((padded, inv_scale, boxes_p, mask) if quantize
+                  else (padded, boxes_p, mask))
         if cache is not None:
             cache.put(key, staged, padded.nbytes)
         return staged
@@ -312,8 +326,10 @@ class JaxExecutor:
             reader.n_atoms, self.transfer_dtype)
         frames = list(frames)
 
-        def put(padded, mask):
-            return jax.device_put(padded, self.device), jax.device_put(mask, self.device)
+        def put(padded, boxes, mask):
+            return (jax.device_put(padded, self.device),
+                    jax.device_put(boxes, self.device),
+                    jax.device_put(mask, self.device))
 
         return _run_batches(
             analysis, reader, frames, bs,
@@ -371,10 +387,10 @@ class MeshExecutor:
             return partials
 
         out_specs = P() if devcombine is not None else P(axis)
-        # staged is (batch, mask) or (batch_i16, inv_scale, mask); the
-        # inv_scale scalar is replicated
-        in_specs = ((P(), P(axis), P(), P(axis)) if quantize
-                    else (P(), P(axis), P(axis)))
+        # staged is (batch, boxes, mask) or (batch_i16, inv_scale, boxes,
+        # mask); the inv_scale scalar is replicated
+        in_specs = ((P(), P(axis), P(), P(axis), P(axis)) if quantize
+                    else (P(), P(axis), P(axis), P(axis)))
         # check_vma=False: jnp.linalg.svd lowers to an iterative scan on
         # TPU whose bool carry trips the varying-manual-axes check inside
         # shard_map (works on CPU, fails on TPU); the kernel is purely
@@ -399,8 +415,9 @@ class MeshExecutor:
             reader.n_atoms, self.transfer_dtype)
         frames = list(frames)
 
-        def put(padded, mask):
+        def put(padded, boxes, mask):
             return (jax.device_put(padded, sharding),
+                    jax.device_put(boxes, sharding),
                     jax.device_put(mask, sharding))
 
         # With _device_combine, gfn outputs replicated merged partials;

@@ -1,0 +1,243 @@
+"""Distance/RDF kernel + analysis tests (BASELINE configs 4-5)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mdanalysis_mpi_tpu.analysis import ContactMap, InterRDF, PairwiseDistances
+from mdanalysis_mpi_tpu.lib import distances as libdist
+from mdanalysis_mpi_tpu.ops import distances as opsdist
+from mdanalysis_mpi_tpu.ops import host
+from mdanalysis_mpi_tpu.testing import make_water_universe, make_protein_universe
+
+RNG = np.random.default_rng(21)
+
+
+# ---------------- minimum image / distance kernels ----------------
+
+def test_minimum_image_orthorhombic():
+    box = np.array([10.0, 10.0, 10.0, 90.0, 90.0, 90.0])
+    disp = np.array([[6.0, -7.0, 4.9], [0.1, 0.0, -0.1]])
+    out = host.minimum_image(disp.copy(), box)
+    np.testing.assert_allclose(out, [[-4.0, 3.0, 4.9], [0.1, 0.0, -0.1]])
+    jout = np.asarray(opsdist.minimum_image(
+        jnp.asarray(disp, jnp.float32), jnp.asarray(box, jnp.float32)))
+    np.testing.assert_allclose(jout, out, atol=1e-5)
+
+
+def test_minimum_image_triclinic_vs_numpy():
+    box = np.array([10.0, 12.0, 9.0, 75.0, 85.0, 95.0])
+    disp = RNG.normal(scale=8.0, size=(40, 3))
+    out = host.minimum_image(disp.copy(), box)
+    jout = np.asarray(opsdist.minimum_image(
+        jnp.asarray(disp, jnp.float32), jnp.asarray(box, jnp.float32)))
+    np.testing.assert_allclose(jout, out, atol=2e-4)
+    # the minimum-image displacement can never exceed half the diagonal
+    assert (np.linalg.norm(out, axis=1) < np.linalg.norm(box[:3])).all()
+
+
+def test_minimum_image_no_box_passthrough():
+    disp = RNG.normal(size=(5, 3))
+    np.testing.assert_array_equal(host.minimum_image(disp.copy(), None), disp)
+    zero = np.zeros(6, dtype=np.float32)
+    jout = np.asarray(opsdist.minimum_image(
+        jnp.asarray(disp, jnp.float32), jnp.asarray(zero)))
+    np.testing.assert_allclose(jout, disp, atol=1e-6)
+    assert np.isfinite(jout).all()
+
+
+def test_distance_array_differential():
+    a = RNG.normal(scale=5.0, size=(17, 3))
+    b = RNG.normal(scale=5.0, size=(11, 3))
+    box = np.array([12.0, 12.0, 12.0, 90.0, 90.0, 90.0])
+    d_np = libdist.distance_array(a, b, box=box, backend="numpy")
+    d_jx = libdist.distance_array(a, b, box=box, backend="jax")
+    np.testing.assert_allclose(d_jx, d_np, atol=1e-4)
+    assert d_np.shape == (17, 11)
+
+
+def test_self_distance_array_order():
+    a = np.array([[0.0, 0, 0], [1.0, 0, 0], [0, 2.0, 0]])
+    d = libdist.self_distance_array(a)
+    # upstream order: (0,1), (0,2), (1,2)
+    np.testing.assert_allclose(d, [1.0, 2.0, np.sqrt(5)])
+
+
+def test_calc_bonds_and_contact_matrix():
+    a = np.array([[0.0, 0, 0], [5.0, 0, 0]])
+    b = np.array([[9.0, 0, 0], [5.5, 0, 0]])
+    box = np.array([10.0, 10.0, 10.0])
+    np.testing.assert_allclose(libdist.calc_bonds(a, b, box=box), [1.0, 0.5])
+    np.testing.assert_allclose(
+        libdist.calc_bonds(a, b, box=box, backend="jax"), [1.0, 0.5],
+        atol=1e-5)
+    with pytest.raises(ValueError, match="backend"):
+        libdist.calc_bonds(a, b, backend="gpu")
+    cm = libdist.contact_matrix(np.vstack([a, b]), cutoff=1.1, box=box)
+    assert cm[0, 2] and cm[1, 3] and not cm[0, 1]
+
+
+def test_self_distance_array_jax_backend():
+    a = RNG.normal(scale=4.0, size=(23, 3))
+    box = np.array([9.0, 9.0, 9.0, 90.0, 90.0, 90.0])
+    d_np = libdist.self_distance_array(a, box=box)
+    d_jx = libdist.self_distance_array(a, box=box, backend="jax")
+    np.testing.assert_allclose(d_jx, d_np, atol=1e-4)
+
+
+def test_stage_mixed_boxes_strided():
+    """Strided (non-contiguous) staging over a trajectory where only
+    some frames carry a box must not crash or drop PBC."""
+    from mdanalysis_mpi_tpu.core.timestep import Timestep
+    from mdanalysis_mpi_tpu.io.memory import MemoryReader
+    from mdanalysis_mpi_tpu.parallel.executors import _stage
+
+    class MixedBoxReader(MemoryReader):
+        def _read_frame(self, i):
+            ts = super()._read_frame(i)
+            if i % 2 == 0:
+                ts.dimensions = None      # boxless even frames
+            return ts
+
+    coords = RNG.normal(size=(6, 4, 3)).astype(np.float32)
+    dims = np.tile(np.array([9, 9, 9, 90, 90, 90], np.float32), (6, 1))
+    r = MixedBoxReader(coords, dimensions=dims)
+    block, boxes = _stage(r, [0, 1, 3], None)       # non-contiguous
+    assert block.shape == (3, 4, 3)
+    np.testing.assert_array_equal(boxes[0], 0.0)    # boxless -> zeros
+    np.testing.assert_allclose(boxes[1][:3], 9.0)
+    block2, boxes2 = _stage(r, [0, 2, 4], None)     # all boxless
+    assert boxes2 is None
+
+
+def test_pair_histogram_blockwise_vs_numpy():
+    """Tiled device histogram == dense NumPy histogram, incl. tiles that
+    don't divide the group size."""
+    a = RNG.uniform(0, 20, size=(57, 3))
+    b = RNG.uniform(0, 20, size=(83, 3))
+    box = np.array([20.0, 20.0, 20.0, 90.0, 90.0, 90.0])
+    edges = np.linspace(0.0, 10.0, 31)
+    expect = host.pair_histogram(a, b, edges, box=box)
+    got = np.asarray(opsdist.pair_histogram(
+        jnp.asarray(a, jnp.float32), jnp.asarray(b, jnp.float32),
+        jnp.asarray(edges, jnp.float32), box=jnp.asarray(box, jnp.float32),
+        tile=16))
+    np.testing.assert_allclose(got, expect, atol=2)  # bin-edge f32 jitter
+    assert got.sum() == pytest.approx(expect.sum(), abs=2)
+
+
+def test_pair_histogram_exclude_self():
+    a = RNG.uniform(0, 10, size=(20, 3))
+    edges = np.linspace(0.0, 30.0, 20)
+    with_self = np.asarray(opsdist.pair_histogram(
+        jnp.asarray(a, jnp.float32), jnp.asarray(a, jnp.float32),
+        jnp.asarray(edges, jnp.float32), tile=7, exclude_self=False))
+    no_self = np.asarray(opsdist.pair_histogram(
+        jnp.asarray(a, jnp.float32), jnp.asarray(a, jnp.float32),
+        jnp.asarray(edges, jnp.float32), tile=7, exclude_self=True))
+    assert with_self.sum() - no_self.sum() == pytest.approx(20)  # the diagonal
+
+
+# ---------------- InterRDF ----------------
+
+@pytest.fixture(scope="module")
+def water():
+    return make_water_universe(n_waters=64, n_frames=3, box=15.0)
+
+
+def test_interrdf_backends_agree(water):
+    ow = water.select_atoms("name OW")
+    res = {}
+    for b in ("serial", "jax", "mesh"):
+        r = InterRDF(ow, ow, nbins=40, range=(0.0, 7.0), tile=32).run(
+            backend=b, batch_size=2)
+        res[b] = r
+    np.testing.assert_allclose(res["jax"].results.count,
+                               res["serial"].results.count, atol=3)
+    np.testing.assert_allclose(res["mesh"].results.count,
+                               res["serial"].results.count, atol=3)
+    np.testing.assert_allclose(res["jax"].results.rdf,
+                               res["serial"].results.rdf, rtol=0.1, atol=0.05)
+
+
+def test_interrdf_ideal_gas_normalization():
+    """For uniformly random points, g(r) ≈ 1 away from r=0."""
+    from mdanalysis_mpi_tpu.core.topology import make_water_topology
+    from mdanalysis_mpi_tpu.core.universe import Universe
+    from mdanalysis_mpi_tpu.io.memory import MemoryReader
+
+    rng = np.random.default_rng(3)
+    n_w, box = 300, 20.0
+    top = make_water_topology(n_w)
+    frames = rng.uniform(0, box, size=(4, top.n_atoms, 3)).astype(np.float32)
+    dims = np.array([box, box, box, 90, 90, 90], np.float32)
+    u = Universe(top, MemoryReader(frames, dimensions=dims))
+    ow = u.select_atoms("name OW")
+    r = InterRDF(ow, ow, nbins=20, range=(2.0, 9.0), tile=64).run(
+        backend="jax", batch_size=2)
+    assert np.abs(np.median(r.results.rdf) - 1.0) < 0.2
+
+
+def test_interrdf_water_structure(water):
+    """Real-ish water box: strong first peak near the OO distance,
+    g → ~1 at long range."""
+    ow = water.select_atoms("name OW")
+    r = InterRDF(ow, ow, nbins=40, range=(0.5, 7.0)).run(backend="jax",
+                                                         batch_size=2)
+    assert r.results.rdf.max() > 1.5
+    assert r.results.bins.shape == (40,)
+
+
+def test_interrdf_cross_groups(water):
+    ow = water.select_atoms("name OW")
+    hw = water.select_atoms("name HW1 HW2")
+    r = InterRDF(ow, hw, nbins=30, range=(0.5, 6.0), tile=32).run(
+        backend="jax", batch_size=2)
+    s = InterRDF(ow, hw, nbins=30, range=(0.5, 6.0)).run(backend="serial")
+    np.testing.assert_allclose(r.results.count, s.results.count, atol=3)
+
+
+def test_interrdf_requires_box():
+    u = make_protein_universe(n_residues=4, n_frames=2)
+    ca = u.select_atoms("name CA")
+    with pytest.raises(ValueError, match="periodic box"):
+        InterRDF(ca, ca).run()
+
+
+def test_interrdf_different_universes(water):
+    other = make_water_universe(n_waters=8, n_frames=1)
+    with pytest.raises(ValueError, match="same Universe"):
+        InterRDF(water.select_atoms("name OW"),
+                 other.select_atoms("name OW"))
+
+
+# ---------------- ContactMap / PairwiseDistances ----------------
+
+def test_contact_map_backends_agree():
+    u = make_protein_universe(n_residues=15, n_frames=10, noise=0.4, seed=5)
+    ca = u.select_atoms("name CA")
+    r = ContactMap(ca, cutoff=8.0).run(backend="jax", batch_size=4)
+    s = ContactMap(ca, cutoff=8.0).run(backend="serial")
+    np.testing.assert_allclose(r.results.contact_fraction,
+                               s.results.contact_fraction, atol=0.01)
+    assert r.results.contact_map.diagonal().all()   # self-contacts
+    assert r.results.contact_fraction.shape == (15, 15)
+
+
+def test_contact_map_mesh():
+    u = make_protein_universe(n_residues=8, n_frames=9, noise=0.3)
+    ca = u.select_atoms("name CA")
+    r = ContactMap(ca, cutoff=10.0).run(backend="mesh", batch_size=2)
+    s = ContactMap(ca, cutoff=10.0).run(backend="serial")
+    np.testing.assert_allclose(r.results.contact_fraction,
+                               s.results.contact_fraction, atol=0.01)
+
+
+def test_pairwise_distances():
+    u = make_protein_universe(n_residues=5, n_frames=6, noise=0.2)
+    ca = u.select_atoms("name CA")
+    r = PairwiseDistances(ca).run()
+    assert r.results.distances.shape == (6, 10)     # 5*4/2 pairs
+    d0 = libdist.self_distance_array(
+        u.trajectory[0].positions[ca.indices])
+    np.testing.assert_allclose(r.results.distances[0], d0, atol=1e-4)
