@@ -1,0 +1,12 @@
+"""CLI entry: ``python -m mdanalysis_mpi_tpu <analysis> <topology> [traj]``.
+
+The reference's only invocation is ``mpirun -np N python RMSF.py`` with
+every knob hardcoded (RMSF.py:34,56,63,77); this exposes the same
+pipeline (and the rest of the analyses) as a proper command.
+"""
+
+import sys
+
+from mdanalysis_mpi_tpu.utils.config import main
+
+sys.exit(main())

@@ -46,14 +46,7 @@ def _avg_sel_kernel(params, batch, boxes, mask):
     return (mask.sum(), jnp.einsum("b,bni->ni", mask, aligned, precision=_HI))
 
 
-def _psum_all(partials, axis_name):
-    import jax
-
-    return jax.tree.map(lambda x: jax.lax.psum(x, axis_name), partials)
-
-
-def _add_partials(a, b):
-    return (a[0] + b[0], a[1] + b[1])
+from mdanalysis_mpi_tpu.analysis.base import tree_add, tree_psum
 
 
 def _reference_sel_coords(reference: Universe, sel_idx, weights, ref_frame: int):
@@ -137,8 +130,8 @@ class AverageStructure(AnalysisBase):
             return (w, ref_c, ref_com)
         return (jnp.asarray(self._sel_idx), w, ref_c, ref_com)
 
-    _device_combine = staticmethod(_psum_all)
-    _device_fold_fn = staticmethod(_add_partials)
+    _device_combine = staticmethod(tree_psum)
+    _device_fold_fn = staticmethod(tree_add)
 
     def _identity_partials(self):
         return (0.0, np.zeros_like(self._acc))

@@ -34,6 +34,23 @@ from __future__ import annotations
 from mdanalysis_mpi_tpu.parallel.executors import get_executor
 
 
+def tree_add(a, b):
+    """Elementwise pytree sum — the generic ``_device_fold_fn`` for
+    analyses whose partials merge by addition."""
+    import jax
+
+    return jax.tree.map(lambda x, y: x + y, a, b)
+
+
+def tree_psum(partials, axis_name):
+    """psum every leaf across the mesh axis — the generic
+    ``_device_combine`` (the TPU image of ``comm.Allreduce(MPI.SUM)``,
+    RMSF.py:110)."""
+    import jax
+
+    return jax.tree.map(lambda x: jax.lax.psum(x, axis_name), partials)
+
+
 class Results(dict):
     """Attribute-accessible results container (the ``.results`` idiom of
     the serial oracle, RMSF.py:9-15)."""
@@ -107,11 +124,27 @@ class AnalysisBase:
         or an executor instance.  Returns ``self`` (chainable:
         ``RMSF(ag).run().results.rmsf``, the RMSF.py:15 idiom).
         """
+        import time
+
+        from mdanalysis_mpi_tpu.utils.timers import TIMERS
+
+        t0 = time.perf_counter()
         frames = self._frames(start, stop, step)
         self.n_frames = len(frames)
         executor = get_executor(backend, **executor_kwargs)
-        self._prepare()
-        total = executor.execute(self, self._universe.trajectory, frames,
-                                 batch_size=batch_size)
-        self._conclude(total)
+        with TIMERS.phase("prepare"):
+            self._prepare()
+        with TIMERS.phase("execute"):
+            total = executor.execute(self, self._universe.trajectory, frames,
+                                     batch_size=batch_size)
+        with TIMERS.phase("conclude"):
+            self._conclude(total)
+        if self._verbose:
+            from mdanalysis_mpi_tpu.utils.log import log_event
+
+            wall = time.perf_counter() - t0
+            log_event("run", analysis=type(self).__name__,
+                      backend=getattr(executor, "name", type(executor).__name__),
+                      n_frames=self.n_frames, wall_s=round(wall, 4),
+                      fps=round(self.n_frames / wall, 2) if wall > 0 else None)
         return self

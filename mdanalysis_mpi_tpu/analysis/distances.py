@@ -18,14 +18,7 @@ def _contact_kernel(params, batch, boxes, mask):
     return contact_fraction_batch(batch, boxes, mask, cutoff)
 
 
-def _add2(a, b):
-    return (a[0] + b[0], a[1] + b[1])
-
-
-def _psum2(partials, axis_name):
-    import jax
-
-    return jax.tree.map(lambda x: jax.lax.psum(x, axis_name), partials)
+from mdanalysis_mpi_tpu.analysis.base import tree_add, tree_psum
 
 
 class ContactMap(AnalysisBase):
@@ -77,8 +70,8 @@ class ContactMap(AnalysisBase):
     def _batch_params(self):
         return (self._cutoff,)
 
-    _device_fold_fn = staticmethod(_add2)
-    _device_combine = staticmethod(_psum2)
+    _device_fold_fn = staticmethod(tree_add)
+    _device_combine = staticmethod(tree_psum)
 
     def _identity_partials(self):
         s = len(self._idx)
@@ -113,14 +106,14 @@ class PairwiseDistances(AnalysisBase):
         if self._ag.n_atoms < 2:
             raise ValueError("PairwiseDistances needs at least 2 atoms")
         self._idx = self._ag.indices
+        self._triu = np.triu_indices(len(self._idx), k=1)
         self._rows: list[np.ndarray] = []
 
     def _single_frame(self, ts):
         x = ts.positions[self._idx].astype(np.float64)
         box = None if ts.dimensions is None else ts.dimensions.astype(np.float64)
         d = host.distance_array(x, x, box)
-        iu, ju = np.triu_indices(len(self._idx), k=1)
-        self._rows.append(d[iu, ju])
+        self._rows.append(d[self._triu])
 
     def _serial_summary(self):
         return np.asarray(self._rows)

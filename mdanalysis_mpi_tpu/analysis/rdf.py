@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from mdanalysis_mpi_tpu.analysis.base import AnalysisBase
+from mdanalysis_mpi_tpu.analysis.base import AnalysisBase, tree_add, tree_psum
 from mdanalysis_mpi_tpu.core.groups import AtomGroup
 from mdanalysis_mpi_tpu.ops import host
 
@@ -30,24 +30,27 @@ import functools
 @functools.lru_cache(maxsize=None)
 def _rdf_kernel(exclude_self: bool, tile: int):
     def kernel(params, batch, boxes, mask):
+        import jax.numpy as jnp
+
+        from mdanalysis_mpi_tpu.ops._boxmat import box_to_matrix
         from mdanalysis_mpi_tpu.ops.distances import pair_histogram_batch
 
         loc_a, loc_b, edges = params
-        return pair_histogram_batch(
+        counts, vol_sum, t = pair_histogram_batch(
             batch[:, loc_a], batch[:, loc_b], boxes, mask, edges,
             exclude_self=exclude_self, tile=tile)
+        # n_boxed: frames carrying a real (non-zero-volume) box.  A frame
+        # without a box is staged as a zero box, which would silently
+        # deflate <V> and unwrap distances — _conclude rejects runs where
+        # n_boxed != T (the batch-path image of the serial per-frame check).
+        import jax
+
+        vols = jax.vmap(lambda b6: jnp.abs(jnp.linalg.det(box_to_matrix(b6))))(
+            boxes)
+        n_boxed = ((vols > 0.0) * mask).sum()
+        return counts, vol_sum, t, n_boxed
 
     return kernel
-
-
-def _add3(a, b):
-    return (a[0] + b[0], a[1] + b[1], a[2] + b[2])
-
-
-def _psum3(partials, axis_name):
-    import jax
-
-    return jax.tree.map(lambda x: jax.lax.psum(x, axis_name), partials)
 
 
 class InterRDF(AnalysisBase):
@@ -88,19 +91,26 @@ class InterRDF(AnalysisBase):
     # -- serial path --
 
     def _single_frame(self, ts):
-        box = ts.dimensions
-        a = ts.positions[self._g1.indices].astype(np.float64)
-        b = ts.positions[self._g2.indices].astype(np.float64)
         from mdanalysis_mpi_tpu.core.box import box_to_vectors
 
+        box = ts.dimensions
+        vol = (0.0 if box is None
+               else abs(np.linalg.det(box_to_vectors(box))))
+        if vol == 0.0:
+            raise ValueError(
+                f"InterRDF: frame {ts.frame} has no periodic box; every "
+                "frame must carry one for g(r) normalization")
+        a = ts.positions[self._g1.indices].astype(np.float64)
+        b = ts.positions[self._g2.indices].astype(np.float64)
         self._counts += host.pair_histogram(
             a, b, self._edges, box=box.astype(np.float64),
             exclude_self=self._identical)
-        self._vol_sum += abs(np.linalg.det(box_to_vectors(box)))
+        self._vol_sum += vol
         self._t += 1
 
     def _serial_summary(self):
-        return (self._counts, self._vol_sum, float(self._t))
+        # serial path raises per frame on a missing box, so n_boxed == T
+        return (self._counts, self._vol_sum, float(self._t), float(self._t))
 
     # -- batch path --
 
@@ -116,17 +126,23 @@ class InterRDF(AnalysisBase):
         return (jnp.asarray(self._loc_a), jnp.asarray(self._loc_b),
                 jnp.asarray(self._edges, jnp.float32))
 
-    _device_fold_fn = staticmethod(_add3)
-    _device_combine = staticmethod(_psum3)
+    _device_fold_fn = staticmethod(tree_add)
+    _device_combine = staticmethod(tree_psum)
 
     def _identity_partials(self):
-        return (np.zeros(self._nbins), 0.0, 0.0)
+        return (np.zeros(self._nbins), 0.0, 0.0, 0.0)
 
     def _conclude(self, total):
         counts, vol_sum, t = (np.asarray(total[0], np.float64),
                               float(total[1]), float(total[2]))
         if t == 0:
             raise ValueError("InterRDF over zero frames")
+        n_boxed = float(total[3])
+        if n_boxed != t:
+            raise ValueError(
+                f"InterRDF: {int(t - n_boxed)} of {int(t)} frames have no "
+                "periodic box; every frame must carry one for g(r) "
+                "normalization")
         edges = self._edges
         vols = 4.0 / 3.0 * np.pi * (edges[1:] ** 3 - edges[:-1] ** 3)
         n_a, n_b = self._g1.n_atoms, self._g2.n_atoms

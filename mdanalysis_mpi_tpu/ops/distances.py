@@ -136,7 +136,8 @@ def pair_histogram_batch(
 
     Volume uses the orthorhombic product for zero-angle boxes and the
     triclinic determinant otherwise; frames with no box get volume 0
-    (the RDF analysis rejects that combination up front).
+    (the RDF analysis counts boxed frames and rejects mixed runs in
+    ``_conclude``).
     """
     from mdanalysis_mpi_tpu.ops._boxmat import box_to_matrix
 

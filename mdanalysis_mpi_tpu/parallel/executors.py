@@ -38,6 +38,7 @@ from __future__ import annotations
 import numpy as np
 
 from mdanalysis_mpi_tpu.parallel.partition import iter_batches, pad_batch
+from mdanalysis_mpi_tpu.utils.timers import TIMERS
 
 
 def _f32_precision(fn):
@@ -249,7 +250,11 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
         staged = cache.get(key) if cache is not None else None
         if staged is not None:
             return staged
-        block, boxes = _stage(reader, frames[a:b], sel_idx)
+        with TIMERS.phase("stage"):
+            return _prepare_uncached(frames[a:b], key)
+
+    def _prepare_uncached(batch_frames, key):
+        block, boxes = _stage(reader, batch_frames, sel_idx)
         if boxes is None:
             boxes = np.zeros((block.shape[0], 6), dtype=np.float32)
         if quantize:
@@ -270,12 +275,21 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
             staged = fut.result()
             if i + 1 < len(bounds):
                 fut = pool.submit(prepare, bounds[i + 1])
-            partials = call(*staged)
-            if fold_j is not None:
-                total = partials if total is None else fold_j(total, partials)
-            else:
-                parts_list.append(partials)
+            with TIMERS.phase("dispatch"):
+                partials = call(*staged)
+                if fold_j is not None:
+                    total = (partials if total is None
+                             else fold_j(total, partials))
+                else:
+                    parts_list.append(partials)
     if fold is not None:
+        if fold_j is not None and total is not None:
+            import jax
+
+            # block here so "execute" cleanly separates device time from
+            # the _conclude fetch in the phase report
+            with TIMERS.phase("device_wait"):
+                jax.block_until_ready(total)
         return total if total is not None else analysis._identity_partials()
     if not parts_list:
         return analysis._identity_partials()

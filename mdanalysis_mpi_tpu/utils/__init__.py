@@ -3,3 +3,11 @@
 The reference has no observability beyond one print (RMSF.py:74); this
 package holds the framework's timing/config/logging subsystems.
 """
+
+from mdanalysis_mpi_tpu.utils.timers import PhaseTimers, TIMERS
+from mdanalysis_mpi_tpu.utils.log import get_logger, log_event
+from mdanalysis_mpi_tpu.utils.config import (
+    AnalysisConfig, build_analysis, run_config)
+
+__all__ = ["PhaseTimers", "TIMERS", "get_logger", "log_event",
+           "AnalysisConfig", "build_analysis", "run_config"]

@@ -1,0 +1,155 @@
+"""Config dataclass + CLI (SURVEY.md §5.6).
+
+The reference hardcodes everything — files (RMSF.py:34,56), reference
+frame (63), selection (77), partition policy (66-69) — and takes no
+arguments.  The framework exposes those knobs as a dataclass and a thin
+CLI: ``python -m mdanalysis_mpi_tpu rmsf top.gro traj.xtc --select
+"protein and name CA" --backend jax``.
+
+Output (Q7 — the reference computes the RMSF then drops it,
+RMSF.py:146-147): results are written as ``.npz`` when ``--output`` is
+given, and a one-line JSON summary (result shapes, frames/sec, phase
+timer report) always goes to stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+import time
+
+import numpy as np
+
+ANALYSES = ("rmsf", "aligned-rmsf", "rmsd", "average-structure", "rdf",
+            "contacts", "pairwise-distances")
+
+
+@dataclasses.dataclass
+class AnalysisConfig:
+    """Everything the reference hardcodes, as data."""
+
+    analysis: str = "aligned-rmsf"
+    topology: str = ""
+    trajectory: str | None = None
+    select: str = "protein and name CA"
+    select2: str | None = None          # rdf second group (defaults to select)
+    start: int | None = None
+    stop: int | None = None
+    step: int | None = None
+    ref_frame: int = 0                  # RMSF.py:63
+    backend: str = "serial"
+    batch_size: int | None = None
+    transfer_dtype: str = "float32"
+    nbins: int = 75                     # rdf
+    r_max: float = 15.0                 # rdf range upper edge
+    cutoff: float = 8.0                 # contacts
+    output: str | None = None
+
+    def validate(self) -> None:
+        if self.analysis not in ANALYSES:
+            raise ValueError(
+                f"unknown analysis {self.analysis!r}; available: {ANALYSES}")
+        if not self.topology:
+            raise ValueError("a topology file is required")
+
+
+def build_analysis(cfg: AnalysisConfig, universe=None):
+    """Config → constructed (not yet run) analysis object."""
+    from mdanalysis_mpi_tpu import Universe, analysis as ana
+
+    cfg.validate()
+    u = universe if universe is not None else Universe(
+        cfg.topology, cfg.trajectory)
+    if cfg.analysis == "rmsf":
+        return ana.RMSF(u.select_atoms(cfg.select))
+    if cfg.analysis == "aligned-rmsf":
+        return ana.AlignedRMSF(u, select=cfg.select, ref_frame=cfg.ref_frame)
+    if cfg.analysis == "rmsd":
+        return ana.RMSD(u, select=cfg.select, ref_frame=cfg.ref_frame)
+    if cfg.analysis == "average-structure":
+        return ana.AverageStructure(u, select=cfg.select,
+                                    ref_frame=cfg.ref_frame)
+    if cfg.analysis == "rdf":
+        g1 = u.select_atoms(cfg.select)
+        g2 = u.select_atoms(cfg.select2 or cfg.select)
+        return ana.InterRDF(g1, g2, nbins=cfg.nbins, range=(0.0, cfg.r_max))
+    if cfg.analysis == "contacts":
+        return ana.ContactMap(u.select_atoms(cfg.select), cutoff=cfg.cutoff)
+    if cfg.analysis == "pairwise-distances":
+        return ana.PairwiseDistances(u.select_atoms(cfg.select))
+    raise AssertionError(cfg.analysis)
+
+
+def run_config(cfg: AnalysisConfig, universe=None):
+    """Build + run per config; returns the finished analysis object."""
+    a = build_analysis(cfg, universe=universe)
+    kwargs = {}
+    if cfg.backend in ("jax", "mesh") and cfg.batch_size is not None:
+        kwargs["batch_size"] = cfg.batch_size
+    if cfg.backend in ("jax", "mesh") and cfg.transfer_dtype != "float32":
+        kwargs["transfer_dtype"] = cfg.transfer_dtype
+    return a.run(start=cfg.start, stop=cfg.stop, step=cfg.step,
+                 backend=cfg.backend, **kwargs)
+
+
+def _parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="mdanalysis_mpi_tpu",
+        description="TPU-native trajectory analysis "
+                    "(RMSF/RMSD/RDF/distances over pluggable backends)")
+    p.add_argument("analysis", choices=ANALYSES)
+    p.add_argument("topology", help="GRO/PSF/PDB topology file")
+    p.add_argument("trajectory", nargs="?", default=None,
+                   help="XTC/DCD/TRR trajectory (omit for topology coords)")
+    p.add_argument("--select", default="protein and name CA")
+    p.add_argument("--select2", default=None, help="RDF second selection")
+    p.add_argument("--start", type=int, default=None)
+    p.add_argument("--stop", type=int, default=None)
+    p.add_argument("--step", type=int, default=None)
+    p.add_argument("--ref-frame", type=int, default=0)
+    p.add_argument("--backend", default="serial",
+                   choices=("serial", "jax", "mesh"))
+    p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--transfer-dtype", default="float32",
+                   choices=("float32", "int16"))
+    p.add_argument("--nbins", type=int, default=75)
+    p.add_argument("--r-max", type=float, default=15.0)
+    p.add_argument("--cutoff", type=float, default=8.0)
+    p.add_argument("--output", default=None, help="write results to .npz")
+    return p
+
+
+def main(argv=None) -> int:
+    from mdanalysis_mpi_tpu.utils.timers import TIMERS
+
+    ns = _parser().parse_args(argv)
+    cfg = AnalysisConfig(
+        analysis=ns.analysis, topology=ns.topology, trajectory=ns.trajectory,
+        select=ns.select, select2=ns.select2, start=ns.start, stop=ns.stop,
+        step=ns.step, ref_frame=ns.ref_frame, backend=ns.backend,
+        batch_size=ns.batch_size, transfer_dtype=ns.transfer_dtype,
+        nbins=ns.nbins, r_max=ns.r_max, cutoff=ns.cutoff, output=ns.output)
+    TIMERS.reset()
+    t0 = time.perf_counter()
+    a = run_config(cfg)
+    wall = time.perf_counter() - t0
+
+    arrays = {k: np.asarray(v) for k, v in a.results.items()
+              if isinstance(v, (np.ndarray, list, tuple, float, int))
+              or hasattr(v, "shape")}
+    if cfg.output:
+        np.savez(cfg.output, **arrays)
+    print(json.dumps({
+        "analysis": cfg.analysis, "backend": cfg.backend,
+        "n_frames": a.n_frames, "wall_s": round(wall, 4),
+        "frames_per_sec": round(a.n_frames / wall, 2) if wall > 0 else None,
+        "results": {k: list(v.shape) for k, v in arrays.items()},
+        "output": cfg.output, "phases": TIMERS.report(),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

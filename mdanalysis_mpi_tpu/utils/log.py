@@ -1,0 +1,44 @@
+"""Structured logging (SURVEY.md §5.5).
+
+Plain-text logs via stdlib ``logging`` plus an optional JSON-lines
+event stream for machine consumption (the bench driver, notebooks).
+Level is controlled by ``MDTPU_LOG`` (default WARNING, so library use
+is silent); ``MDTPU_LOG_JSON=1`` switches events to one-JSON-per-line.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+
+_CONFIGURED = False
+
+
+def get_logger(name: str = "mdtpu") -> logging.Logger:
+    global _CONFIGURED
+    if not _CONFIGURED:
+        level = os.environ.get("MDTPU_LOG", "WARNING").upper()
+        h = logging.StreamHandler(sys.stderr)
+        h.setFormatter(logging.Formatter(
+            "%(asctime)s %(name)s %(levelname)s %(message)s"))
+        root = logging.getLogger("mdtpu")
+        root.addHandler(h)
+        root.setLevel(getattr(logging, level, logging.WARNING))
+        _CONFIGURED = True
+    return logging.getLogger(name)
+
+
+def log_event(event: str, **fields) -> None:
+    """Emit a structured event.
+
+    JSON line on stderr when ``MDTPU_LOG_JSON=1``; otherwise a normal
+    INFO log record (visible when ``MDTPU_LOG=INFO``).
+    """
+    if os.environ.get("MDTPU_LOG_JSON") == "1":
+        print(json.dumps({"event": event, **fields}, default=str),
+              file=sys.stderr, flush=True)
+    else:
+        get_logger().info("%s %s", event,
+                          " ".join(f"{k}={v}" for k, v in fields.items()))

@@ -1,0 +1,72 @@
+"""Phase timers (SURVEY.md §5.1).
+
+The reference's only observability is one per-rank print of its frame
+range (RMSF.py:74) and its only performance control is BLAS thread
+pinning (RMSF.py:20-25).  The framework replaces that with named phase
+accumulators so a run can be decomposed into host I/O / staging /
+kernel dispatch / conclude time.
+
+Notes on interpreting the numbers:
+
+- Staging runs on a prefetch thread concurrently with device compute
+  (double buffering), so phase sums may legitimately exceed the
+  end-to-end wall time.
+- JAX dispatch is asynchronous: the ``dispatch`` phase measures host
+  time to enqueue a batch kernel, not device execution.  Device time
+  shows up as the tail of ``run`` (the final blocking fetch in
+  ``_conclude``).
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+
+
+class PhaseTimers:
+    """Accumulating named wall-clock phase timers.
+
+    >>> t = PhaseTimers()
+    >>> with t.phase("stage"):
+    ...     pass
+    >>> t.report()["stage"]["calls"]
+    1
+    """
+
+    def __init__(self):
+        self._acc: dict[str, float] = {}
+        self._calls: dict[str, int] = {}
+
+    @contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self._acc[name] = self._acc.get(name, 0.0) + dt
+            self._calls[name] = self._calls.get(name, 0) + 1
+
+    def add(self, name: str, seconds: float) -> None:
+        """Record an externally measured duration under ``name``."""
+        self._acc[name] = self._acc.get(name, 0.0) + seconds
+        self._calls[name] = self._calls.get(name, 0) + 1
+
+    def seconds(self, name: str) -> float:
+        return self._acc.get(name, 0.0)
+
+    def report(self) -> dict:
+        """{phase: {"seconds": total, "calls": n}} sorted by cost."""
+        return {
+            k: {"seconds": round(self._acc[k], 6), "calls": self._calls[k]}
+            for k in sorted(self._acc, key=self._acc.get, reverse=True)
+        }
+
+    def reset(self) -> None:
+        self._acc.clear()
+        self._calls.clear()
+
+
+#: Process-global default registry.  Executors and ``AnalysisBase.run``
+#: record into this unless handed an explicit ``PhaseTimers``.
+TIMERS = PhaseTimers()

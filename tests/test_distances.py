@@ -204,6 +204,28 @@ def test_interrdf_requires_box():
         InterRDF(ca, ca).run()
 
 
+def test_interrdf_rejects_partially_boxed_trajectory():
+    """Frames with a zero box must fail loudly on both paths, not
+    silently deflate <V> (frame 0 boxed lets _prepare's fast check
+    pass — the per-frame/batch validation has to catch it)."""
+    from mdanalysis_mpi_tpu.core.topology import make_water_topology
+    from mdanalysis_mpi_tpu.core.universe import Universe
+    from mdanalysis_mpi_tpu.io.memory import MemoryReader
+
+    rng = np.random.default_rng(7)
+    top = make_water_topology(27)
+    frames = rng.uniform(0, 10.0, size=(4, top.n_atoms, 3)).astype(np.float32)
+    dims = np.tile(np.array([10, 10, 10, 90, 90, 90], np.float32), (4, 1))
+    dims[2] = 0.0                       # frame 2 loses its box
+    u = Universe(top, MemoryReader(frames, dimensions=dims))
+    ow = u.select_atoms("name OW")
+    with pytest.raises(ValueError, match="no periodic box"):
+        InterRDF(ow, ow, nbins=10, range=(0.0, 5.0)).run(backend="serial")
+    with pytest.raises(ValueError, match="no periodic box"):
+        InterRDF(ow, ow, nbins=10, range=(0.0, 5.0), tile=32).run(
+            backend="jax", batch_size=2)
+
+
 def test_interrdf_different_universes(water):
     other = make_water_universe(n_waters=8, n_frames=1)
     with pytest.raises(ValueError, match="same Universe"):

@@ -1,0 +1,120 @@
+"""Tests for the utils subsystem: phase timers, config, CLI.
+
+The reference has none of this (observability = one print, RMSF.py:74;
+config = hardcoded constants, RMSF.py:34,56,63,77); these tests pin the
+framework's replacements (SURVEY.md §5.1/5.5/5.6).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from mdanalysis_mpi_tpu.testing import make_protein_universe
+from mdanalysis_mpi_tpu.utils import AnalysisConfig, run_config, TIMERS
+from mdanalysis_mpi_tpu.utils.timers import PhaseTimers
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestPhaseTimers:
+    def test_accumulates(self):
+        t = PhaseTimers()
+        with t.phase("a"):
+            pass
+        with t.phase("a"):
+            pass
+        with t.phase("b"):
+            pass
+        rep = t.report()
+        assert rep["a"]["calls"] == 2
+        assert rep["b"]["calls"] == 1
+        assert rep["a"]["seconds"] >= 0
+
+    def test_add_and_reset(self):
+        t = PhaseTimers()
+        t.add("x", 1.5)
+        assert t.seconds("x") == 1.5
+        t.reset()
+        assert t.report() == {}
+
+    def test_records_on_exception(self):
+        t = PhaseTimers()
+        with pytest.raises(RuntimeError):
+            with t.phase("boom"):
+                raise RuntimeError
+        assert t.report()["boom"]["calls"] == 1
+
+    def test_run_populates_global_timers(self):
+        from mdanalysis_mpi_tpu.analysis import RMSF
+
+        TIMERS.reset()
+        u = make_protein_universe(n_residues=4, n_frames=6, seed=3)
+        RMSF(u.select_atoms("name CA")).run(backend="serial")
+        rep = TIMERS.report()
+        assert "prepare" in rep and "execute" in rep and "conclude" in rep
+
+
+class TestConfig:
+    def test_validate_rejects_unknown_analysis(self):
+        with pytest.raises(ValueError, match="unknown analysis"):
+            AnalysisConfig(analysis="nope", topology="x.gro").validate()
+
+    def test_validate_requires_topology(self):
+        with pytest.raises(ValueError, match="topology"):
+            AnalysisConfig(analysis="rmsf").validate()
+
+    def test_run_config_rmsf_matches_direct(self):
+        from mdanalysis_mpi_tpu.analysis import AlignedRMSF
+
+        u = make_protein_universe(n_residues=6, n_frames=8, seed=1)
+        cfg = AnalysisConfig(analysis="aligned-rmsf", topology="mem",
+                             select="name CA", backend="serial")
+        a = run_config(cfg, universe=u)
+        direct = AlignedRMSF(u, select="name CA").run(backend="serial")
+        np.testing.assert_allclose(
+            a.results.rmsf, direct.results.rmsf, atol=1e-12)
+
+    def test_run_config_rdf(self):
+        from mdanalysis_mpi_tpu.testing import make_water_universe
+
+        u = make_water_universe(n_waters=30, n_frames=3, seed=2)
+        cfg = AnalysisConfig(analysis="rdf", topology="mem",
+                             select="name OW", nbins=20, r_max=8.0,
+                             backend="serial")
+        a = run_config(cfg, universe=u)
+        assert a.results.bins.shape == (20,)
+
+
+class TestCLI:
+    def test_end_to_end_on_files(self, tmp_path):
+        """Write a GRO+XTC fixture, run the CLI, check the npz output."""
+        from mdanalysis_mpi_tpu.io.gro import write_gro
+        from mdanalysis_mpi_tpu.io.xtc import write_xtc
+
+        u = make_protein_universe(n_residues=5, n_frames=7, seed=4)
+        n = u.trajectory.n_frames
+        coords = np.stack([u.trajectory[i].positions for i in range(n)])
+        gro = str(tmp_path / "top.gro")
+        xtc = str(tmp_path / "traj.xtc")
+        out = str(tmp_path / "out.npz")
+        write_gro(gro, u.topology, coords[0])
+        write_xtc(xtc, coords)
+
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+        proc = subprocess.run(
+            [sys.executable, "-m", "mdanalysis_mpi_tpu", "aligned-rmsf",
+             gro, xtc, "--select", "name CA", "--backend", "serial",
+             "--output", out],
+            capture_output=True, text=True, env=env, cwd=str(tmp_path))
+        assert proc.returncode == 0, proc.stderr
+        summary = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert summary["n_frames"] == 7
+        assert "phases" in summary
+        data = np.load(out)
+        assert data["rmsf"].shape == (5,)
+        assert np.isfinite(data["rmsf"]).all()
