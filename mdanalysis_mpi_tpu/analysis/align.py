@@ -48,6 +48,17 @@ def _avg_sel_kernel(params, batch, boxes, mask):
 
 from mdanalysis_mpi_tpu.analysis.base import tree_add, tree_psum
 
+_DIV_JIT = None
+
+
+def _div_jit(s, t):
+    global _DIV_JIT
+    if _DIV_JIT is None:
+        import jax
+
+        _DIV_JIT = jax.jit(lambda s, t: s / t)
+    return _DIV_JIT(s, t)
+
 
 def _reference_sel_coords(reference: Universe, sel_idx, weights, ref_frame: int):
     """Centered float64 selection coords + COM of ``ref_frame``, with the
@@ -143,8 +154,14 @@ class AverageStructure(AnalysisBase):
         if self.n_frames == 0:
             raise ValueError("AverageStructure over zero frames")
         # s may live on device; the division stays there — only the wide
-        # path (universe rebuild) forces a host fetch
-        avg = s / t
+        # path (universe rebuild) forces a host fetch.  Jitted: one eager
+        # op costs ~150 ms of dispatch latency on tunneled TPU targets.
+        import jax
+
+        if isinstance(s, jax.Array):
+            avg = _div_jit(s, t)
+        else:
+            avg = s / t
         self.results.positions = avg
         if self._select_only:
             self.results.universe = None

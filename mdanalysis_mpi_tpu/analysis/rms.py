@@ -272,6 +272,28 @@ class AlignedRMSF(AnalysisBase):
         return self
 
 
+_CENTER_REF_JIT = None
+
+
+def _center_ref_jit(ref, masses32):
+    """(ref (S,3), masses (S,)) → (centered f32 ref, COM) in one jitted
+    dispatch (device-resident path of ``_MomentsToReference._prepare``)."""
+    global _CENTER_REF_JIT
+    if _CENTER_REF_JIT is None:
+        import jax
+        import jax.numpy as jnp
+
+        from mdanalysis_mpi_tpu.ops.align import weighted_center
+
+        def f(ref, m):
+            ref32 = ref.astype(jnp.float32)
+            com = weighted_center(ref32, m)
+            return ref32 - com, com
+
+        _CENTER_REF_JIT = jax.jit(f)
+    return _CENTER_REF_JIT(ref, masses32)
+
+
 class _MomentsToReference(AnalysisBase):
     """Pass 2 of the reference: superpose the selection onto fixed
     reference coords, accumulate Welford moments (RMSF.py:115-143)."""
@@ -289,17 +311,15 @@ class _MomentsToReference(AnalysisBase):
         self._masses = ag.masses
         # center the average-structure reference (RMSF.py:116-118); if the
         # reference came out of a device-resident pass 1, keep the whole
-        # centering on device (no host round-trip)
+        # centering on device — as ONE jitted call: eager jnp ops on a
+        # tunneled TPU cost ~150 ms dispatch latency EACH (measured), so
+        # an eager centering chain dominated the whole pass.
         ref = self._ref_sel_positions
         if isinstance(ref, jax.Array):
             import jax.numpy as jnp
 
-            from mdanalysis_mpi_tpu.ops.align import weighted_center
-
-            ref32 = jnp.asarray(ref, jnp.float32)
-            com = weighted_center(ref32, jnp.asarray(self._masses, jnp.float32))
-            self._ref_sel_c = ref32 - com
-            self._ref_com = com
+            self._ref_sel_c, self._ref_com = _center_ref_jit(
+                jnp.asarray(ref), np.asarray(self._masses, np.float32))
         else:
             com = host.weighted_center(ref, self._masses)
             self._ref_sel_c = ref - com

@@ -88,6 +88,31 @@ class ReaderBase:
                 boxes[j] = ts.dimensions
         return out, boxes
 
+    def stage_block(self, start: int, stop: int,
+                    sel: np.ndarray | None = None, quantize: bool = False):
+        """Staging primitive: ``read_block`` plus optional fused int16
+        quantization → (block, boxes, inv_scale).
+
+        ``inv_scale`` is None on the float32 path.  Quantization runs in
+        the native C++ codec when available (single fused max+round pass
+        — the host staging core is the throughput bottleneck, SURVEY.md
+        §7) and falls back to the NumPy reference implementation
+        (``parallel.executors.quantize_block``) otherwise; both produce
+        bit-identical outputs.
+        """
+        block, boxes = self.read_block(start, stop, sel=sel)
+        if not quantize:
+            return block, boxes, None
+        try:
+            from mdanalysis_mpi_tpu.io import native
+
+            q, inv_scale = native.stage_gather_quantize(block, None)
+        except Exception:
+            from mdanalysis_mpi_tpu.parallel.executors import quantize_block
+
+            q, inv_scale = quantize_block(block)
+        return q, boxes, inv_scale
+
     def close(self):
         pass
 

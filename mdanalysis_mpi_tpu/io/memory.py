@@ -74,3 +74,31 @@ class MemoryReader(ReaderBase):
             return self._coords[start:stop].copy(), boxes
         # slice + advanced index = a single gather copy
         return self._coords[start:stop, sel], boxes
+
+    def stage_block(self, start: int, stop: int, sel=None,
+                    quantize: bool = False):
+        """Gather (+quantize) straight from the backing array in C++ —
+        no intermediate ``read_block`` copy.  In-memory trajectories are
+        the staging fast path (the reference's RMSF.py:113 in-memory
+        universe generalized to the TPU feed), so this one fused pass is
+        where the single staging core's cycles go."""
+        if not 0 <= start <= stop <= self.n_frames:
+            raise IndexError(
+                f"block [{start},{stop}) out of range [0,{self.n_frames}]")
+        boxes = None if self._dims is None else self._dims[start:stop].copy()
+        view = self._coords[start:stop]
+        try:
+            from mdanalysis_mpi_tpu.io import native
+
+            if quantize:
+                q, inv_scale = native.stage_gather_quantize(view, sel)
+                return q, boxes, inv_scale
+            return native.stage_gather(view, sel), boxes, None
+        except Exception:
+            block = view[:, sel] if sel is not None else view.copy()
+            if not quantize:
+                return block, boxes, None
+            from mdanalysis_mpi_tpu.parallel.executors import quantize_block
+
+            q, inv_scale = quantize_block(block)
+            return q, boxes, inv_scale

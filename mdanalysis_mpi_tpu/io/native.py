@@ -24,6 +24,7 @@ _lib = None
 
 _f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
 _f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+_i16p = np.ctypeslib.ndpointer(np.int16, flags="C_CONTIGUOUS")
 _i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
 _i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
 
@@ -84,5 +85,59 @@ def load() -> ctypes.CDLL:
             ctypes.c_char_p, ctypes.c_int, ctypes.c_long, _f32p,
             ctypes.c_void_p, ctypes.c_double]
 
+        lib.stage_gather_quantize_i16.restype = ctypes.c_int
+        lib.stage_gather_quantize_i16.argtypes = [
+            _f32p, ctypes.c_long, ctypes.c_long, ctypes.c_void_p,
+            ctypes.c_long, _i16p, ctypes.POINTER(ctypes.c_float)]
+
+        lib.stage_gather_f32.restype = ctypes.c_int
+        lib.stage_gather_f32.argtypes = [
+            _f32p, ctypes.c_long, ctypes.c_long, ctypes.c_void_p,
+            ctypes.c_long, _f32p]
+
         _lib = lib
         return _lib
+
+
+def stage_gather_quantize(src: np.ndarray, sel=None):
+    """Fused selection-gather + block int16 quantization in C++.
+
+    ``src`` is (B, N, 3) float32 C-contiguous; ``sel`` an int array into
+    the atom axis or None for all atoms.  Returns (q (B, S, 3) int16,
+    inv_scale float32) — bit-identical to
+    ``parallel.executors.quantize_block(src[:, sel])``.
+    """
+    lib = load()
+    b, n = src.shape[0], src.shape[1]
+    if sel is None:
+        s = n
+        idx_p = None
+    else:
+        idx = np.ascontiguousarray(sel, dtype=np.int32)
+        s = len(idx)
+        idx_p = idx.ctypes.data_as(ctypes.c_void_p)
+    out = np.empty((b, s, 3), dtype=np.int16)
+    inv = ctypes.c_float(0.0)
+    rc = lib.stage_gather_quantize_i16(
+        src, b, n, idx_p, s, out, ctypes.byref(inv))
+    if rc != 0:
+        raise RuntimeError(f"stage_gather_quantize_i16 failed (rc={rc})")
+    return out, np.float32(inv.value)
+
+
+def stage_gather(src: np.ndarray, sel=None) -> np.ndarray:
+    """Selection gather ``src[:, sel]`` in C++ (float32 staging path)."""
+    lib = load()
+    b, n = src.shape[0], src.shape[1]
+    if sel is None:
+        s = n
+        idx_p = None
+    else:
+        idx = np.ascontiguousarray(sel, dtype=np.int32)
+        s = len(idx)
+        idx_p = idx.ctypes.data_as(ctypes.c_void_p)
+    out = np.empty((b, s, 3), dtype=np.float32)
+    rc = lib.stage_gather_f32(src, b, n, idx_p, s, out)
+    if rc != 0:
+        raise RuntimeError(f"stage_gather_f32 failed (rc={rc})")
+    return out

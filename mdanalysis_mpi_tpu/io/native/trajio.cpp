@@ -18,6 +18,7 @@
 // C ABI only (consumed via ctypes).  All coordinate buffers are
 // caller-allocated.  Functions return 0 on success, negative on error.
 
+#include <algorithm>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -770,6 +771,92 @@ int dcd_write(const char* path, int natoms, long nframes,
         }
     }
     fclose(f);
+    return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Staging kernels: fused selection-gather + int16 quantization of a frame
+// block.  The hot host-side path when feeding the accelerator (the staging
+// pipeline is the framework's whole performance game on a single staging
+// core — SURVEY.md §7 "Host I/O vs TPU throughput").  Semantics match the
+// Python reference implementation `quantize_block` in
+// parallel/executors.py exactly: one symmetric scale per block,
+// scale = 32000 / max|x| (double), q = nearbyint(x * scale) (round half to
+// even, like np.round), inv_scale = float(1/scale).
+// ---------------------------------------------------------------------------
+
+// src: (n_frames, n_atoms, 3) float32; idx: (n_sel,) int32 selection into
+// the atom axis, or nullptr for all atoms; out: (n_frames, n_sel, 3) int16.
+// Writes 1/scale to *inv_scale_out.  Two passes over the selected data
+// (max, then quantize) — both touch only the selection's bytes.
+int stage_gather_quantize_i16(const float* src, long n_frames, long n_atoms,
+                              const int32_t* idx, long n_sel,
+                              int16_t* out, float* inv_scale_out) {
+    if (n_frames < 0 || n_atoms < 0 || n_sel < 0) return -1;
+    if (idx == nullptr) n_sel = n_atoms;
+    float vmax = 0.0f;
+    for (long f = 0; f < n_frames; f++) {
+        const float* fr = src + (size_t)f * n_atoms * 3;
+        if (idx == nullptr) {
+            const size_t n3 = (size_t)n_atoms * 3;
+            for (size_t k = 0; k < n3; k++) {
+                float a = std::fabs(fr[k]);
+                if (a > vmax) vmax = a;
+            }
+        } else {
+            for (long s = 0; s < n_sel; s++) {
+                const float* p = fr + (size_t)idx[s] * 3;
+                for (int d = 0; d < 3; d++) {
+                    float a = std::fabs(p[d]);
+                    if (a > vmax) vmax = a;
+                }
+            }
+        }
+    }
+    const double m = (n_frames * n_sel > 0) ? (double)vmax : 1.0;
+    const double scale = 32000.0 / std::max(m, 1e-30);
+    // float multiply, not double: NumPy promotes f32-array * python-float
+    // to float32 (NEP 50), so matching the reference path bit-for-bit
+    // requires the same f32 product before round-half-to-even.
+    const float scalef = (float)scale;
+    for (long f = 0; f < n_frames; f++) {
+        const float* fr = src + (size_t)f * n_atoms * 3;
+        int16_t* o = out + (size_t)f * n_sel * 3;
+        if (idx == nullptr) {
+            const size_t n3 = (size_t)n_atoms * 3;
+            for (size_t k = 0; k < n3; k++)
+                o[k] = (int16_t)std::nearbyintf(fr[k] * scalef);
+        } else {
+            for (long s = 0; s < n_sel; s++) {
+                const float* p = fr + (size_t)idx[s] * 3;
+                for (int d = 0; d < 3; d++)
+                    o[s * 3 + d] = (int16_t)std::nearbyintf(p[d] * scalef);
+            }
+        }
+    }
+    *inv_scale_out = (float)(1.0 / scale);
+    return 0;
+}
+
+// Plain selection gather into float32 (the transfer_dtype="float32"
+// staging path): out (n_frames, n_sel, 3) = src[:, idx].
+int stage_gather_f32(const float* src, long n_frames, long n_atoms,
+                     const int32_t* idx, long n_sel, float* out) {
+    if (n_frames < 0 || n_atoms < 0 || n_sel < 0) return -1;
+    if (idx == nullptr) {
+        std::memcpy(out, src, (size_t)n_frames * n_atoms * 3 * 4);
+        return 0;
+    }
+    for (long f = 0; f < n_frames; f++) {
+        const float* fr = src + (size_t)f * n_atoms * 3;
+        float* o = out + (size_t)f * n_sel * 3;
+        for (long s = 0; s < n_sel; s++) {
+            const float* p = fr + (size_t)idx[s] * 3;
+            o[s * 3 + 0] = p[0];
+            o[s * 3 + 1] = p[1];
+            o[s * 3 + 2] = p[2];
+        }
+    }
     return 0;
 }
 

@@ -206,6 +206,43 @@ class DeviceBlockCache:
             self._bytes += nbytes
 
 
+class _InlinePool:
+    """Degenerate 'pool' that runs submissions inline on the caller.
+
+    On a single-core host (the measurement environment: 1 CPU feeding a
+    tunneled TPU) a real prefetch thread only adds GIL/scheduler
+    contention and run-to-run variance — staging, the tunnel client,
+    and dispatch all want the same core.  Multi-core hosts keep the
+    genuine double-buffering thread.  Override via MDTPU_PREFETCH=0/1.
+    """
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def submit(self, fn, *args):
+        result = fn(*args)
+
+        class _Done:
+            def result(self):
+                return result
+
+        return _Done()
+
+
+def _staging_pool():
+    from concurrent.futures import ThreadPoolExecutor
+
+    pref = _os.environ.get("MDTPU_PREFETCH")
+    if pref is not None:
+        use_thread = pref not in ("0", "false", "no")
+    else:
+        use_thread = (_os.cpu_count() or 1) > 1
+    return ThreadPoolExecutor(max_workers=1) if use_thread else _InlinePool()
+
+
 def _run_batches(analysis, reader, frames, bs, call, sel_idx,
                  device_put_fn=None, cache: "DeviceBlockCache | None" = None,
                  quantize: bool = False):
@@ -216,13 +253,11 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
     jitted merge per batch, e.g. the Chan moment merge) or collected and
     concatenated on-device at the end (time-series analyses).  The
     single final pytree is what ``_conclude`` sees — it fetches what it
-    needs once.  Rationale: on tunneled TPU targets (axon) device→host
-    readback is orders of magnitude slower than host→device
-    (~0.3 MB/s vs ~1.5 GB/s measured), so per-batch fetches dominated
-    the wall clock; device-side folding removes them entirely.
+    needs once.  Rationale: on tunneled TPU targets (axon) every
+    device→host fetch pays ~100-200 ms of fixed round-trip latency
+    (measured; size-independent below ~1 MB), so per-batch fetches
+    dominated the wall clock; device-side folding removes them entirely.
     """
-    from concurrent.futures import ThreadPoolExecutor
-
     fold = analysis._device_fold_fn
     fold_j = _jit_kernel(fold) if fold is not None else None
     total = None
@@ -254,11 +289,20 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
             return _prepare_uncached(frames[a:b], key)
 
     def _prepare_uncached(batch_frames, key):
-        block, boxes = _stage(reader, batch_frames, sel_idx)
+        contiguous = (len(batch_frames) > 0
+                      and batch_frames[-1] - batch_frames[0] + 1
+                      == len(batch_frames))
+        if contiguous and hasattr(reader, "stage_block"):
+            # fused native gather(+quantize) — the fast path
+            block, boxes, inv_scale = reader.stage_block(
+                batch_frames[0], batch_frames[-1] + 1, sel_idx, quantize)
+        else:
+            block, boxes = _stage(reader, batch_frames, sel_idx)
+            inv_scale = None
+            if quantize:
+                block, inv_scale = quantize_block(block)
         if boxes is None:
             boxes = np.zeros((block.shape[0], 6), dtype=np.float32)
-        if quantize:
-            block, inv_scale = quantize_block(block)
         padded, mask = pad_batch(block, bs)
         boxes_p, _ = pad_batch(np.ascontiguousarray(boxes, np.float32), bs)
         if device_put_fn is not None:
@@ -269,7 +313,7 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
             cache.put(key, staged, padded.nbytes)
         return staged
 
-    with ThreadPoolExecutor(max_workers=1) as pool:
+    with _staging_pool() as pool:
         fut = pool.submit(prepare, bounds[0]) if bounds else None
         for i in range(len(bounds)):
             staged = fut.result()
