@@ -28,17 +28,31 @@ import functools
 
 
 @functools.lru_cache(maxsize=None)
-def _rdf_kernel(exclude_self: bool, tile: int):
+def _rdf_kernel(exclude_self: bool, tile: int, engine: str,
+                static_edges: tuple | None = None):
+    """``engine``: 'xla' (generic searchsorted+segment_sum path;
+    params carry the traced edges array, ``static_edges`` is None) or
+    'pallas' (fused TPU kernel — uniform bins, orthorhombic boxes; bin
+    edges are compile-time constants baked into the cache key, and
+    ``tile`` is 0 since the kernel has its own fixed tiling)."""
     def kernel(params, batch, boxes, mask):
         import jax.numpy as jnp
 
         from mdanalysis_mpi_tpu.ops._boxmat import box_to_matrix
         from mdanalysis_mpi_tpu.ops.distances import pair_histogram_batch
 
-        loc_a, loc_b, edges = params
-        counts, vol_sum, t = pair_histogram_batch(
-            batch[:, loc_a], batch[:, loc_b], boxes, mask, edges,
-            exclude_self=exclude_self, tile=tile)
+        if engine == "pallas":
+            from mdanalysis_mpi_tpu.ops import pallas_distances
+
+            loc_a, loc_b = params
+            counts, vol_sum, t = pallas_distances.pair_histogram_batch(
+                batch[:, loc_a], batch[:, loc_b], boxes, mask,
+                np.asarray(static_edges), exclude_self=exclude_self)
+        else:
+            loc_a, loc_b, edges = params
+            counts, vol_sum, t = pair_histogram_batch(
+                batch[:, loc_a], batch[:, loc_b], boxes, mask, edges,
+                exclude_self=exclude_self, tile=tile)
         # n_boxed: frames carrying a real (non-zero-volume) box.  A frame
         # without a box is staged as a zero box, which would silently
         # deflate <V> and unwrap distances — _conclude rejects runs where
@@ -58,15 +72,20 @@ class InterRDF(AnalysisBase):
 
     def __init__(self, g1: AtomGroup, g2: AtomGroup, nbins: int = 75,
                  range: tuple[float, float] = (0.0, 15.0),
-                 tile: int = 1024, verbose: bool = False):
+                 tile: int = 1024, engine: str = "auto",
+                 verbose: bool = False):
         if g1.universe is not g2.universe:
             raise ValueError("g1 and g2 must belong to the same Universe")
+        if engine not in ("auto", "pallas", "xla"):
+            raise ValueError(
+                f"engine must be 'auto', 'pallas' or 'xla', got {engine!r}")
         super().__init__(g1.universe, verbose)
         self._g1 = g1
         self._g2 = g2
         self._nbins = int(nbins)
         self._range = (float(range[0]), float(range[1]))
         self._tile = int(tile)
+        self._engine = engine
 
     def _prepare(self):
         if self._g1.n_atoms == 0 or self._g2.n_atoms == 0:
@@ -87,6 +106,39 @@ class InterRDF(AnalysisBase):
         self._counts = np.zeros(self._nbins, dtype=np.float64)
         self._vol_sum = 0.0
         self._t = 0
+        self._resolved_engine = None     # per-run; see _resolve_engine
+
+    def _resolve_engine(self) -> str:
+        """Pick the device histogram engine.  Deferred to the batch
+        path (the serial/NumPy path must not touch jax at all): the
+        fused Pallas kernel needs uniform bins (always true here:
+        linspace) + an orthorhombic or absent box.  'auto' takes it
+        only on real TPU backends (interpret mode is correctness-only);
+        a triclinic current-frame box forces the XLA path — and frames
+        that are triclinic anyway are NaN-poisoned by the kernel and
+        rejected in ``_conclude`` rather than silently mis-wrapped.
+        Resolved once per analysis (cached): the kernel arity and the
+        params tuple must agree even if env/backend state shifts
+        between the executor's ``_batch_fn``/``_batch_params`` calls."""
+        cached = getattr(self, "_resolved_engine", None)
+        if cached is not None:
+            return cached
+        if self._engine != "auto":
+            self._resolved_engine = self._engine
+            return self._engine
+        from mdanalysis_mpi_tpu.ops import pallas_distances
+
+        dims = self._universe.trajectory.ts.dimensions
+        # rtol=0: the default rtol adds ~9e-4 deg of slack at 90 deg,
+        # 10x looser than minimum_image's 1e-4 ortho classification
+        ortho = dims is None or np.allclose(dims[3:], 90.0,
+                                            rtol=0.0, atol=1e-4)
+        self._resolved_engine = (
+            "pallas" if (pallas_distances.use_pallas() and ortho
+                         and self._nbins <= pallas_distances.MAX_NBINS
+                         and pallas_distances.uniform_edges(self._edges))
+            else "xla")
+        return self._resolved_engine
 
     # -- serial path --
 
@@ -118,13 +170,18 @@ class InterRDF(AnalysisBase):
         return self._union
 
     def _batch_fn(self):
-        return _rdf_kernel(self._identical, self._tile)
+        if self._resolve_engine() == "pallas":
+            return _rdf_kernel(self._identical, 0, "pallas",
+                               tuple(float(e) for e in self._edges))
+        return _rdf_kernel(self._identical, self._tile, "xla")
 
     def _batch_params(self):
         import jax.numpy as jnp
 
-        return (jnp.asarray(self._loc_a), jnp.asarray(self._loc_b),
-                jnp.asarray(self._edges, jnp.float32))
+        locs = (jnp.asarray(self._loc_a), jnp.asarray(self._loc_b))
+        if self._resolve_engine() == "pallas":
+            return locs      # edges are compile-time constants
+        return locs + (jnp.asarray(self._edges, jnp.float32),)
 
     _device_fold_fn = staticmethod(tree_add)
     _device_combine = staticmethod(tree_psum)
@@ -137,6 +194,16 @@ class InterRDF(AnalysisBase):
                               float(total[1]), float(total[2]))
         if t == 0:
             raise ValueError("InterRDF over zero frames")
+        if not np.isfinite(counts).all():
+            if getattr(self, "_resolved_engine", None) == "pallas":
+                raise ValueError(
+                    "InterRDF: non-finite histogram counts — the Pallas "
+                    "engine NaN-poisons frames with triclinic boxes (its "
+                    "minimum-image wrap is orthorhombic-only); rerun with "
+                    "engine='xla'")
+            raise ValueError(
+                "InterRDF: non-finite histogram counts — check the "
+                "trajectory for NaN/inf coordinates or box dimensions")
         n_boxed = float(total[3])
         if n_boxed != t:
             raise ValueError(

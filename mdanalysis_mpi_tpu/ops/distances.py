@@ -123,6 +123,33 @@ def pair_histogram(
     return hists.sum(axis=0)
 
 
+def histogram_batch_from(per_frame_hist):
+    """Lift a per-frame histogram fn ``(a, b, box6) -> (nbins,)`` into
+    the frame-batch RDF partial reducer shared by every engine:
+    ``(coords_a (B,N,3), coords_b (B,M,3), boxes (B,6), mask (B,)) ->
+    (counts (nbins,), Σ volume, T)``.
+
+    Volume uses the box-matrix determinant (orthorhombic product for
+    zero-angle boxes); frames with no box get volume 0 (the RDF
+    analysis counts boxed frames and rejects mixed runs in
+    ``_conclude``).
+    """
+    from mdanalysis_mpi_tpu.ops._boxmat import box_to_matrix
+
+    def batch(coords_a, coords_b, boxes, mask):
+        def per_frame(args):
+            a, b, box6 = args
+            vol = jnp.abs(jnp.linalg.det(box_to_matrix(box6)))
+            return per_frame_hist(a, b, box6), vol
+
+        hists, vols = jax.lax.map(per_frame, (coords_a, coords_b, boxes))
+        counts = jnp.einsum("b,bn->n", mask, hists, precision=_HI)
+        vol_sum = (vols * mask).sum()
+        return counts, vol_sum, mask.sum()
+
+    return batch
+
+
 def pair_histogram_batch(
     coords_a: jax.Array,          # (B, N, 3)
     coords_b: jax.Array,          # (B, M, 3)
@@ -134,25 +161,11 @@ def pair_histogram_batch(
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Per-frame-batch RDF partials: (counts (nbins,), Σ volume, T).
 
-    Volume uses the orthorhombic product for zero-angle boxes and the
-    triclinic determinant otherwise; frames with no box get volume 0
-    (the RDF analysis counts boxed frames and rejects mixed runs in
-    ``_conclude``).
-    """
-    from mdanalysis_mpi_tpu.ops._boxmat import box_to_matrix
-
-    def per_frame(args):
-        a, b, box6 = args
-        # minimum_image handles zero boxes (no wrap) itself
-        h = pair_histogram(a, b, edges, box=box6,
-                           exclude_self=exclude_self, tile=tile)
-        vol = jnp.abs(jnp.linalg.det(box_to_matrix(box6)))
-        return h, vol
-
-    hists, vols = jax.lax.map(per_frame, (coords_a, coords_b, boxes))
-    counts = jnp.einsum("b,bn->n", mask, hists, precision=_HI)
-    vol_sum = (vols * mask).sum()
-    return counts, vol_sum, mask.sum()
+    XLA engine; ``minimum_image`` handles zero and triclinic boxes."""
+    return histogram_batch_from(
+        lambda a, b, box6: pair_histogram(
+            a, b, edges, box=box6, exclude_self=exclude_self, tile=tile)
+    )(coords_a, coords_b, boxes, mask)
 
 
 def contact_fraction_batch(

@@ -1,0 +1,245 @@
+"""Pallas TPU kernel for the blockwise pair-distance histogram.
+
+The RDF inner loop (BASELINE config 4; reference dependency
+``MDAnalysis.analysis.rdf.InterRDF`` / ``lib.distances`` — SURVEY.md
+§2.2 last row) is an O(N·M) pair sweep that must never materialize the
+pair matrix (SURVEY.md §5.7).  The generic XLA path
+(:func:`mdanalysis_mpi_tpu.ops.distances.pair_histogram`) bucketizes
+with ``searchsorted`` + ``segment_sum``; on TPU the scatter-add inside
+``segment_sum`` serializes badly.  This module is the TPU-native
+engine: a single fused Pallas kernel that
+
+- tiles both atom groups into ``(3, TILE)`` VMEM blocks over a 2-D
+  grid (one grid cell per pair of tiles — the blockwise-attention
+  shape),
+- computes the minimum-image squared distances for one
+  ``(TILE_A, TILE_B)`` block on the VPU (orthorhombic wrap:
+  ``d -= L*round(d/L)``; a zero box row disables wrapping),
+- bin-indexes pairs against a *uniform* grid (``InterRDF`` bins are
+  always ``np.linspace``) and accumulates the histogram through a
+  chunked one-hot × weight matmul on the MXU — no scatter anywhere,
+- folds every grid cell into one VMEM-resident ``(8, NBINS_pad)``
+  accumulator (TPU grids execute sequentially, so revisiting the same
+  output block is the standard reduction pattern).
+
+Constraints: uniform bin edges (callers gate on :func:`uniform_edges`)
+and orthorhombic (or absent) boxes — :func:`pair_histogram_batch`
+NaN-poisons frames with triclinic boxes so misuse fails loudly, and
+the RDF analysis' auto engine selection routes triclinic systems to
+the XLA path.  Counts accumulate in f32 — identical precision policy
+to the XLA engine (executors module docstring).
+
+On non-TPU backends the kernel runs in Pallas interpret mode, which is
+how the CPU test suite exercises it bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import numpy as np
+
+TILE_A = 256
+TILE_B = 256
+_CHUNK = 2048          # pairs per one-hot matmul chunk (f32 VMEM: 1 MB)
+
+
+def _engine_env() -> str:
+    return os.environ.get("MDTPU_PALLAS", "auto")
+
+
+def use_pallas() -> bool:
+    """Resolve the MDTPU_PALLAS env knob: '1'/'0' force, 'auto' → only
+    on real TPU backends (interpret mode is correctness-only)."""
+    env = _engine_env()
+    if env in ("0", "false", "no"):
+        return False
+    if env in ("1", "true", "yes"):
+        return True
+    import jax
+
+    return jax.default_backend() == "tpu"
+
+
+def uniform_edges(edges: np.ndarray, rtol: float = 1e-6) -> bool:
+    """True when ``edges`` is an affine (linspace) grid — the only bin
+    layout the Pallas engine supports."""
+    e = np.asarray(edges, dtype=np.float64)
+    if e.ndim != 1 or e.shape[0] < 2:
+        return False
+    d = np.diff(e)
+    return bool(d.min() > 0 and
+                (d.max() - d.min()) <= rtol * max(d.max(), 1e-30))
+
+
+def _ceil_to(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+MAX_NBINS = 512     # per-bin unrolled loop: kernel size is linear in nbins
+
+
+@functools.lru_cache(maxsize=None)
+def _build_kernel(nbins: int, exclude_self: bool, interpret: bool):
+    """Compile-cached pallas_call builder for a given static config."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if not 0 < nbins <= MAX_NBINS:
+        raise ValueError(
+            f"pallas pair_histogram supports 1..{MAX_NBINS} bins "
+            f"(got {nbins}); use the XLA engine for finer histograms")
+    nb_pad = _ceil_to(nbins, 128)
+
+    def kernel(scal_ref, a_ref, b_ref, out_ref):
+        i = pl.program_id(0)
+        j = pl.program_id(1)
+        r0 = scal_ref[0, 0]
+        inv_dr = scal_ref[0, 1]
+        na = scal_ref[1, 0].astype(jnp.int32)
+        nb = scal_ref[1, 1].astype(jnp.int32)
+
+        # -- minimum-image squared distances for this (TILE_A, TILE_B)
+        # block, one axis at a time (VPU; no (TA,TB,3) intermediate) --
+        d2 = jnp.zeros((TILE_A, TILE_B), jnp.float32)
+        for ax in range(3):
+            length = scal_ref[0, 2 + ax]
+            inv_len = scal_ref[0, 5 + ax]       # 0 when no box on this axis
+            diff = (a_ref[ax, :].reshape(TILE_A, 1)
+                    - b_ref[ax, :].reshape(1, TILE_B))
+            diff = diff - length * jnp.round(diff * inv_len)
+            d2 = d2 + diff * diff
+        dist = jnp.sqrt(d2)
+
+        # -- uniform-grid bin index; invalid pairs (padding, self,
+        # out-of-range) are routed to a sentinel bin the count loop
+        # never reads, so no weight multiply is needed --
+        idx = jnp.floor((dist - r0) * inv_dr).astype(jnp.int32)
+        ia = i * TILE_A + jax.lax.broadcasted_iota(
+            jnp.int32, (TILE_A, TILE_B), 0)
+        ib = j * TILE_B + jax.lax.broadcasted_iota(
+            jnp.int32, (TILE_A, TILE_B), 1)
+        valid = ((ia < na) & (ib < nb) & (idx >= 0) & (idx < nbins))
+        if exclude_self:
+            valid = valid & (ia != ib)
+        idx = jnp.where(valid, jnp.clip(idx, 0, nbins - 1), nbins)
+
+        # -- per-bin masked counts, statically unrolled.  Mosaic TC
+        # kernels reject the reshapes/scatters every other histogram
+        # formulation needs (value dynamic_slice, (TA,TB)→(P,1) shape
+        # casts, segment_sum); the equality-count loop is pure 2-D VPU
+        # work.  Cost is pairs×nbins compares — the same asymptotic
+        # cost a one-hot matmul would pay building its operand --
+        @pl.when((i == 0) & (j == 0))
+        def _():
+            out_ref[:] = jnp.zeros_like(out_ref)
+
+        counts = [jnp.sum((idx == k).astype(jnp.float32), keepdims=True)
+                  for k in range(nbins)]
+        counts.append(jnp.zeros((1, nb_pad - nbins), jnp.float32))
+        out_ref[0:1, :] += jnp.concatenate(counts, axis=1)
+
+    def call(scal, a_t, b_t):
+        n_pad_a = a_t.shape[1]
+        n_pad_b = b_t.shape[1]
+        grid = (n_pad_a // TILE_A, n_pad_b // TILE_B)
+        return pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((2, 8), lambda i, j: (0, 0),
+                             memory_space=pltpu.SMEM),
+                pl.BlockSpec((3, TILE_A), lambda i, j: (0, i),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((3, TILE_B), lambda i, j: (0, j),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((8, nb_pad), lambda i, j: (0, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((8, nb_pad), jnp.float32),
+            interpret=interpret,
+        )(scal, a_t, b_t)
+
+    return call
+
+
+def _pack_scalars(r0, inv_dr, box):
+    """(2, 8) f32 scalar block: row 0 = [r0, inv_dr, Lx, Ly, Lz, iLx,
+    iLy, iLz]; row 1 = [n_a, n_b, ...].  Zero lengths (no box / boxless
+    frame) get inverse 0, which disables the wrap term in-kernel."""
+    import jax.numpy as jnp
+
+    lengths = (jnp.zeros(3, jnp.float32) if box is None
+               else box[:3].astype(jnp.float32))
+    inv_len = jnp.where(lengths > 0, 1.0 / jnp.where(lengths > 0, lengths, 1.0),
+                        0.0)
+    return lengths, inv_len, jnp.float32(r0), jnp.float32(inv_dr)
+
+
+def pair_histogram(a, b, r0: float, dr: float, nbins: int,
+                   box=None, exclude_self: bool = False,
+                   interpret: bool | None = None):
+    """Histogram of pair distances on a uniform grid — Pallas engine.
+
+    a: (N, 3) f32; b: (M, 3) f32; bins are ``r0 + k*dr`` for
+    ``k = 0..nbins``; ``box``: (6,) dimensions (orthorhombic; lengths 0
+    = no PBC) or None.  Returns (nbins,) f32 counts.  ``r0``/``dr`` may
+    be traced scalars; shapes and ``nbins`` are static.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n_a, n_b = a.shape[0], b.shape[0]
+    a_t = jnp.pad(a.astype(jnp.float32),
+                  ((0, _ceil_to(n_a, TILE_A) - n_a), (0, 0))).T
+    b_t = jnp.pad(b.astype(jnp.float32),
+                  ((0, _ceil_to(n_b, TILE_B) - n_b), (0, 0))).T
+    lengths, inv_len, r0f, inv_drf = _pack_scalars(
+        r0, 1.0 / jnp.float32(dr), box)
+    scal = jnp.zeros((2, 8), jnp.float32)
+    scal = scal.at[0, 0].set(r0f).at[0, 1].set(inv_drf)
+    scal = scal.at[0, 2:5].set(lengths).at[0, 5:8].set(inv_len)
+    scal = scal.at[1, 0].set(n_a).at[1, 1].set(n_b)
+    call = _build_kernel(int(nbins), bool(exclude_self), bool(interpret))
+    out = call(scal, a_t, b_t)
+    return out[0, :nbins]
+
+
+def pair_histogram_batch(coords_a, coords_b, boxes, mask, edges,
+                         exclude_self: bool = False,
+                         interpret: bool | None = None):
+    """Batch twin of :func:`mdanalysis_mpi_tpu.ops.distances.
+    pair_histogram_batch` on the Pallas engine: per-frame-batch RDF
+    partials ``(counts (nbins,), Σ volume, T)``.
+
+    ``edges`` must be uniform (checked by the caller via
+    :func:`uniform_edges`).  The kernel's wrap is orthorhombic-only, so
+    any frame with a triclinic box has its histogram poisoned with NaN
+    — the consuming analysis turns non-finite counts into a clear
+    error instead of a silently wrong g(r).
+    """
+    import jax.numpy as jnp
+
+    from mdanalysis_mpi_tpu.ops.distances import histogram_batch_from
+
+    e = np.asarray(edges, dtype=np.float64)
+    r0 = float(e[0])
+    dr = float((e[-1] - e[0]) / (e.shape[0] - 1))
+    nbins = int(e.shape[0] - 1)
+
+    def per_frame(a, b, box6):
+        h = pair_histogram(a, b, r0, dr, nbins, box=box6,
+                           exclude_self=exclude_self, interpret=interpret)
+        # same 1e-4-degree cut minimum_image uses to classify a box as
+        # orthorhombic, so no box can be ortho-wrapped here that the
+        # XLA engine would have triclinic-wrapped
+        triclinic = jnp.any((jnp.abs(box6[3:] - 90.0) >= 1e-4)
+                            & (box6[:3].min() > 0))
+        return jnp.where(triclinic, jnp.nan, h)
+
+    return histogram_batch_from(per_frame)(coords_a, coords_b, boxes, mask)
