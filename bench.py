@@ -75,7 +75,11 @@ def main():
     # int16 staging: halves host->HBM wire bytes at ~2e-3 coordinate
     # resolution (quantize_block docstring) — the honest fast path
     tdtype = os.environ.get("BENCH_TRANSFER", "int16")
-    # warm-up: compile both passes on a short window
+    # warm-up: compile both passes on a short window.  No result is read
+    # back anywhere before the timed runs finish: on this tunneled TPU a
+    # single device→host fetch collapses host→device throughput ~40× for
+    # the rest of the process (analysis.base.Deferred), which would turn
+    # the measurement into a measurement of the collapsed link.
     AlignedRMSF(u, select=SELECT).run(
         stop=2 * BATCH, backend="jax", batch_size=BATCH, transfer_dtype=tdtype)
     # median of REPEATS: the tunneled TPU target shows multi-x run-to-run
@@ -85,6 +89,8 @@ def main():
         t0 = time.perf_counter()
         r = AlignedRMSF(u, select=SELECT).run(backend="jax", batch_size=BATCH,
                                               transfer_dtype=tdtype)
+        # drain the async dispatch queue (device-side wait, not a fetch)
+        jax.block_until_ready(r.results["rmsf"])
         walls.append(time.perf_counter() - t0)
     wall = float(np.median(walls))
     fps_per_chip = N_FRAMES / wall / n_chips

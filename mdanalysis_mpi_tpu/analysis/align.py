@@ -166,12 +166,18 @@ class AverageStructure(AnalysisBase):
         if self._select_only:
             self.results.universe = None
         else:
-            # RMSF.py:113: rebuild a single-frame in-memory universe.
-            # Single device fetch (readback is the slow direction).
-            avg_np = np.asarray(avg, np.float64)
-            self.results.positions = avg_np
-            self.results.universe = Universe(
-                self._universe.topology, avg_np[None].astype(np.float32))
+            # RMSF.py:113: rebuild a single-frame in-memory universe —
+            # deferred: the rebuild needs a device fetch, which must not
+            # happen inside run() (base.Deferred rationale)
+            from mdanalysis_mpi_tpu.analysis.base import Deferred
+
+            topology = self._universe.topology
+
+            def _build_universe():
+                avg_np = np.asarray(avg, np.float64)
+                return Universe(topology, avg_np[None].astype(np.float32))
+
+            self.results.universe = Deferred(_build_universe)
 
 
 class AlignTraj(AnalysisBase):

@@ -51,19 +51,92 @@ def tree_psum(partials, axis_name):
     return jax.tree.map(lambda x: jax.lax.psum(x, axis_name), partials)
 
 
+class Deferred:
+    """A result computed (and cached) on first attribute access.
+
+    ``_conclude`` stores one of these instead of fetching device values:
+    on tunneled TPU targets a single device→host readback — even 4
+    bytes — collapses host→device transfer throughput ~40× for the rest
+    of the process (measured: 1.6 GB/s → 35 MB/s; the tunnel drops out
+    of its streaming mode), so ``run()`` must never read back.  The
+    fetch happens when the *user* touches ``.results.<key>``, after all
+    timed/pipelined work.
+    """
+
+    __slots__ = ("thunk",)
+
+    def __init__(self, thunk):
+        self.thunk = thunk
+
+
+def _materialize(value):
+    if isinstance(value, Deferred):
+        return _materialize(value.thunk())
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is not None and isinstance(value, jax.Array):
+        import numpy as np
+
+        return np.asarray(value)
+    return value
+
+
+def deferred_group(finalize):
+    """Deferreds over the keys of one shared memoized ``finalize()``.
+
+    ``finalize`` computes a dict of results in a single (expensive,
+    device-fetching) pass; ``deferred_group(finalize)["key"]`` is a
+    :class:`Deferred` that runs it at most once and picks out ``key``.
+    The common ``_conclude`` shape: several result keys, one readback.
+    """
+    state = {}
+
+    def _run():
+        if not state:
+            state.update(finalize())
+        return state
+
+    class _Group(dict):
+        def __missing__(self, key):
+            d = Deferred(lambda: _run()[key])
+            self[key] = d
+            return d
+
+    return _Group()
+
+
 class Results(dict):
     """Attribute-accessible results container (the ``.results`` idiom of
-    the serial oracle, RMSF.py:9-15)."""
+    the serial oracle, RMSF.py:9-15).
+
+    Attribute access *materializes*: device arrays are fetched to NumPy
+    and :class:`Deferred` thunks are evaluated, then cached back.  Plain
+    ``results["key"]`` indexing returns the raw stored value (device
+    arrays stay resident — what internal multi-pass pipelines want).
+    """
 
     def __getattr__(self, key):
         try:
-            return self[key]
+            value = self[key]
         except KeyError:
             raise AttributeError(
                 f"no result {key!r}; available: {sorted(self)}") from None
+        materialized = _materialize(value)
+        if materialized is not value:
+            self[key] = materialized
+        return materialized
 
     def __setattr__(self, key, value):
         self[key] = value
+
+    def materialize(self):
+        """Force every entry: evaluate Deferreds, fetch device arrays.
+        Returns self.  One deliberate readback point for callers (CLI,
+        serialization) that need plain host values."""
+        for key in list(self):
+            getattr(self, key)
+        return self
 
 
 class AnalysisBase:

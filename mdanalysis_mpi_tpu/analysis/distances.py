@@ -78,14 +78,28 @@ class ContactMap(AnalysisBase):
         return (np.zeros((s, s)), 0.0)
 
     def _conclude(self, total):
-        acc, t = total
-        t = float(t)
-        if t == 0:
+        if self.n_frames == 0:
             raise ValueError("ContactMap over zero frames")
-        frac = np.asarray(acc, np.float64) / t
-        self.results.contact_fraction = frac
-        self.results.contact_map = frac >= self._persistence
-        self.results.n_frames = int(t)
+        acc, t = total
+        persistence = self._persistence
+
+        def _finalize():
+            # fetching acc/t is a device readback — deferred to first
+            # result access (base.Deferred rationale)
+            t_host = float(t)
+            if t_host == 0:
+                raise ValueError("ContactMap over zero frames")
+            frac = np.asarray(acc, np.float64) / t_host
+            return {"contact_fraction": frac,
+                    "contact_map": frac >= persistence,
+                    "n_frames": int(t_host)}
+
+        from mdanalysis_mpi_tpu.analysis.base import deferred_group
+
+        group = deferred_group(_finalize)
+        self.results.contact_fraction = group["contact_fraction"]
+        self.results.contact_map = group["contact_map"]
+        self.results.n_frames = group["n_frames"]
 
 
 class PairwiseDistances(AnalysisBase):

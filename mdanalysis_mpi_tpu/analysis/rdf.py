@@ -190,33 +190,49 @@ class InterRDF(AnalysisBase):
         return (np.zeros(self._nbins), 0.0, 0.0, 0.0)
 
     def _conclude(self, total):
-        counts, vol_sum, t = (np.asarray(total[0], np.float64),
-                              float(total[1]), float(total[2]))
-        if t == 0:
+        if self.n_frames == 0:
             raise ValueError("InterRDF over zero frames")
-        if not np.isfinite(counts).all():
-            if getattr(self, "_resolved_engine", None) == "pallas":
-                raise ValueError(
-                    "InterRDF: non-finite histogram counts — the Pallas "
-                    "engine NaN-poisons frames with triclinic boxes (its "
-                    "minimum-image wrap is orthorhombic-only); rerun with "
-                    "engine='xla'")
-            raise ValueError(
-                "InterRDF: non-finite histogram counts — check the "
-                "trajectory for NaN/inf coordinates or box dimensions")
-        n_boxed = float(total[3])
-        if n_boxed != t:
-            raise ValueError(
-                f"InterRDF: {int(t - n_boxed)} of {int(t)} frames have no "
-                "periodic box; every frame must carry one for g(r) "
-                "normalization")
         edges = self._edges
-        vols = 4.0 / 3.0 * np.pi * (edges[1:] ** 3 - edges[:-1] ** 3)
-        n_a, n_b = self._g1.n_atoms, self._g2.n_atoms
-        n_pairs = n_a * n_b - (n_a if self._identical else 0)
-        avg_vol = vol_sum / t
-        density = n_pairs / avg_vol
-        self.results.count = counts
         self.results.bins = 0.5 * (edges[1:] + edges[:-1])
         self.results.edges = edges
-        self.results.rdf = counts / (density * vols * t)
+
+        # The normalization needs the histogram on host — a device fetch
+        # that must not happen inside run() (base.Deferred rationale), so
+        # the whole finalize (including its diagnostics) runs on first
+        # access of .results.count / .results.rdf.
+        resolved_engine = getattr(self, "_resolved_engine", None)
+        identical = self._identical
+        n_a, n_b = self._g1.n_atoms, self._g2.n_atoms
+
+        def _finalize():
+            counts, vol_sum, t = (np.asarray(total[0], np.float64),
+                                  float(total[1]), float(total[2]))
+            if t == 0:
+                raise ValueError("InterRDF over zero frames")
+            if not np.isfinite(counts).all():
+                if resolved_engine == "pallas":
+                    raise ValueError(
+                        "InterRDF: non-finite histogram counts — the "
+                        "Pallas engine NaN-poisons frames with "
+                        "triclinic boxes (its minimum-image wrap is "
+                        "orthorhombic-only); rerun with engine='xla'")
+                raise ValueError(
+                    "InterRDF: non-finite histogram counts — check the "
+                    "trajectory for NaN/inf coordinates or box "
+                    "dimensions")
+            n_boxed = float(total[3])
+            if n_boxed != t:
+                raise ValueError(
+                    f"InterRDF: {int(t - n_boxed)} of {int(t)} frames "
+                    "have no periodic box; every frame must carry one "
+                    "for g(r) normalization")
+            vols = 4.0 / 3.0 * np.pi * (edges[1:] ** 3 - edges[:-1] ** 3)
+            n_pairs = n_a * n_b - (n_a if identical else 0)
+            density = n_pairs / (vol_sum / t)
+            return {"count": counts, "rdf": counts / (density * vols * t)}
+
+        from mdanalysis_mpi_tpu.analysis.base import deferred_group
+
+        group = deferred_group(_finalize)
+        self.results.count = group["count"]
+        self.results.rdf = group["rdf"]

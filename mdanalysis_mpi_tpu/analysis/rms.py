@@ -116,13 +116,14 @@ class RMSF(AnalysisBase):
 
     def _conclude(self, total):
         t, mean, m2 = total
-        # mean/m2 may be device arrays — keep them resident (device→host
-        # readback is the expensive direction on tunneled TPUs); fetch
-        # only the small final RMSF vector
+        # mean/m2/rmsf may be device arrays — keep them resident; ANY
+        # readback here would collapse the tunnel's host→device
+        # throughput for the rest of the process (see base.Deferred).
+        # Results materializes them on user access.
         self.results.mean = mean
         self.results.m2 = m2
         self.results.n_frames = self.n_frames
-        self.results.rmsf = np.asarray(rmsf_from_moments(t, m2), np.float64)
+        self.results.rmsf = rmsf_from_moments(t, m2)
 
 
 class RMSD(AnalysisBase):
@@ -210,7 +211,15 @@ class RMSD(AnalysisBase):
 
     def _conclude(self, total):
         vals, mask = total
-        self.results.rmsd = np.asarray(vals)[np.asarray(mask) > 0.5]
+
+        def _finalize():
+            # mask filtering is dynamic-shape → host-side, deferred so
+            # run() stays readback-free (base.Deferred rationale)
+            return np.asarray(vals)[np.asarray(mask) > 0.5]
+
+        from mdanalysis_mpi_tpu.analysis.base import Deferred
+
+        self.results.rmsd = Deferred(_finalize)
 
 
 class AlignedRMSF(AnalysisBase):
@@ -253,7 +262,9 @@ class AlignedRMSF(AnalysisBase):
             select_only=True, verbose=self._verbose,
         ).run(start, stop, step, backend=backend, batch_size=batch_size,
               **kwargs)
-        self._avg_sel = avg.results.positions           # (S, 3) float64
+        # raw dict access: keep the average device-resident between
+        # passes (attribute access would fetch it to host)
+        self._avg_sel = avg.results["positions"]        # (S, 3)
 
         # Pass 2 (RMSF.py:115-143): moments of coords aligned to the average.
         moments_pass = _MomentsToReference(
@@ -262,13 +273,14 @@ class AlignedRMSF(AnalysisBase):
                          batch_size=batch_size, **kwargs)
         t, mean, m2 = moments_pass._total
         self.n_frames = moments_pass.n_frames
-        # average/mean/m2 may be device-resident (np.asarray() to fetch);
-        # only the small final RMSF is materialized on host
+        # all results may be device-resident; Results materializes on
+        # user access (run() itself must stay readback-free — a single
+        # fetch collapses tunneled host→device throughput, base.Deferred)
         self.results.average = self._avg_sel
         self.results.mean = mean
         self.results.m2 = m2
         # RMSF.py:146: sqrt(M2.sum(axis=xyz)/T)
-        self.results.rmsf = np.asarray(rmsf_from_moments(t, m2), np.float64)
+        self.results.rmsf = rmsf_from_moments(t, m2)
         return self
 
 

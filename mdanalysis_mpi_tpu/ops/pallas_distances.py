@@ -16,8 +16,10 @@ engine: a single fused Pallas kernel that
   ``(TILE_A, TILE_B)`` block on the VPU (orthorhombic wrap:
   ``d -= L*round(d/L)``; a zero box row disables wrapping),
 - bin-indexes pairs against a *uniform* grid (``InterRDF`` bins are
-  always ``np.linspace``) and accumulates the histogram through a
-  chunked one-hot × weight matmul on the MXU — no scatter anywhere,
+  always ``np.linspace``) and accumulates the histogram with a
+  statically unrolled per-bin equality-count loop on the VPU — no
+  scatter anywhere (see the counts-loop comment in the kernel for why
+  the matmul/scatter formulations lose),
 - folds every grid cell into one VMEM-resident ``(8, NBINS_pad)``
   accumulator (TPU grids execute sequentially, so revisiting the same
   output block is the standard reduction pattern).
@@ -42,7 +44,6 @@ import numpy as np
 
 TILE_A = 256
 TILE_B = 256
-_CHUNK = 2048          # pairs per one-hot matmul chunk (f32 VMEM: 1 MB)
 
 
 def _engine_env() -> str:
@@ -167,9 +168,10 @@ def _build_kernel(nbins: int, exclude_self: bool, interpret: bool):
 
 
 def _pack_scalars(r0, inv_dr, box):
-    """(2, 8) f32 scalar block: row 0 = [r0, inv_dr, Lx, Ly, Lz, iLx,
-    iLy, iLz]; row 1 = [n_a, n_b, ...].  Zero lengths (no box / boxless
-    frame) get inverse 0, which disables the wrap term in-kernel."""
+    """Scalar ingredients for the kernel's SMEM block: (box lengths,
+    inverse lengths, r0, 1/dr) as f32.  Zero lengths (no box / boxless
+    frame) get inverse 0, which disables the wrap term in-kernel.
+    ``pair_histogram`` assembles these into the (2, 8) scalar block."""
     import jax.numpy as jnp
 
     lengths = (jnp.zeros(3, jnp.float32) if box is None
@@ -201,6 +203,8 @@ def pair_histogram(a, b, r0: float, dr: float, nbins: int,
                   ((0, _ceil_to(n_b, TILE_B) - n_b), (0, 0))).T
     lengths, inv_len, r0f, inv_drf = _pack_scalars(
         r0, 1.0 / jnp.float32(dr), box)
+    # (2, 8) f32 SMEM scalar block: row 0 = [r0, inv_dr, Lx, Ly, Lz,
+    # iLx, iLy, iLz]; row 1 = [n_a, n_b, unused...]
     scal = jnp.zeros((2, 8), jnp.float32)
     scal = scal.at[0, 0].set(r0f).at[0, 1].set(inv_drf)
     scal = scal.at[0, 2:5].set(lengths).at[0, 5:8].set(inv_len)

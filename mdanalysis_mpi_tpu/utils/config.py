@@ -136,6 +136,9 @@ def main(argv=None) -> int:
     a = run_config(cfg)
     wall = time.perf_counter() - t0
 
+    # force deferred finalizers + device fetches (also surfaces deferred
+    # validation errors) before filtering for serializable arrays
+    a.results.materialize()
     arrays = {k: np.asarray(v) for k, v in a.results.items()
               if isinstance(v, (np.ndarray, list, tuple, float, int))
               or hasattr(v, "shape")}

@@ -218,3 +218,29 @@ def test_empty_selection_raises(uni):
 def test_unknown_backend(uni):
     with pytest.raises(ValueError, match="unknown backend"):
         RMSD(uni, select="name CA").run(backend="cuda")
+
+
+def test_results_lazy_materialization():
+    """run() must stay readback-free on device paths: Deferred thunks and
+    device arrays materialize (and cache) on attribute access only; raw
+    dict indexing returns the stored value untouched."""
+    import jax.numpy as jnp
+
+    from mdanalysis_mpi_tpu.analysis.base import Deferred, Results
+
+    calls = []
+    r = Results()
+    r.lazy_val = Deferred(lambda: calls.append(1) or np.arange(3))
+    assert isinstance(r["lazy_val"], Deferred)       # raw access: untouched
+    np.testing.assert_array_equal(r.lazy_val, np.arange(3))
+    np.testing.assert_array_equal(r.lazy_val, np.arange(3))
+    assert calls == [1]                              # evaluated exactly once
+
+    r.dev = jnp.ones(4)
+    out = r.dev
+    assert isinstance(out, np.ndarray)
+    assert isinstance(r["dev"], np.ndarray)          # cached back
+
+    # nested: a Deferred returning a device array materializes fully
+    r.nested = Deferred(lambda: jnp.zeros(2))
+    assert isinstance(r.nested, np.ndarray)

@@ -221,9 +221,12 @@ def test_interrdf_rejects_partially_boxed_trajectory():
     ow = u.select_atoms("name OW")
     with pytest.raises(ValueError, match="no periodic box"):
         InterRDF(ow, ow, nbins=10, range=(0.0, 5.0)).run(backend="serial")
+    # batch path: run() stays readback-free (base.Deferred), so the
+    # validation fires on first result access instead
+    r = InterRDF(ow, ow, nbins=10, range=(0.0, 5.0), tile=32).run(
+        backend="jax", batch_size=2)
     with pytest.raises(ValueError, match="no periodic box"):
-        InterRDF(ow, ow, nbins=10, range=(0.0, 5.0), tile=32).run(
-            backend="jax", batch_size=2)
+        r.results.rdf
 
 
 def test_interrdf_different_universes(water):

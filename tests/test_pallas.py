@@ -160,9 +160,12 @@ class TestInterRDFEngines:
         dims = np.tile(np.array([20, 20, 20, 80, 90, 90], np.float32), (2, 1))
         u = Universe(top, MemoryReader(coords, dimensions=dims))
         ow = u.select_atoms("name OW")
-        with pytest.raises(ValueError, match="triclinic"):
-            InterRDF(ow, ow, nbins=10, range=(0.0, 8.0),
+        # run() stays readback-free (base.Deferred): the NaN-poison
+        # diagnostic fires on first result access
+        r = InterRDF(ow, ow, nbins=10, range=(0.0, 8.0),
                      engine="pallas").run(backend="jax", batch_size=2)
+        with pytest.raises(ValueError, match="triclinic"):
+            r.results.rdf
 
     def test_mesh_backend_pallas(self):
         from mdanalysis_mpi_tpu.analysis.rdf import InterRDF
