@@ -1,0 +1,263 @@
+"""TRR (GROMACS full-precision) trajectory reader/writer.
+
+Completes the GROMACS trajectory pair next to XTC (SURVEY.md §2.2 "and
+TRR if cheap" — it is: TRR is plain big-endian XDR with no bit-packed
+compression, so NumPy ``frombuffer`` decodes at memcpy speed and no C++
+codec is needed).  Layout per frame (libxdrfile ``t_trnheader``
+semantics, reimplemented from the on-disk format):
+
+- magic ``1993`` (i4), version-string length ``13`` (i4), XDR string
+  ``"GMX_trn_file"`` (i4 length + 12 bytes),
+- 13 i4 fields: ir/e/box/vir/pres/top/sym sizes, x/v/f sizes, natoms,
+  step, nre,
+- time + lambda in the frame's float width (4 or 8 bytes, inferred
+  from box_size/x_size as upstream does),
+- payload: box (3×3), virial, pressure, positions, velocities, forces
+  — each present iff its size field is nonzero.
+
+Frame byte size is fully determined by the header, so the offset index
+is a cheap header-hop scan (cached to disk like the XTC index).  Only
+positions and box are returned; velocities/forces are skipped by
+offset.  Coordinates convert nm→Å at the boundary, matching the rest
+of the io layer.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from mdanalysis_mpi_tpu.core.box import box_to_vectors, vectors_to_box
+from mdanalysis_mpi_tpu.core.timestep import Timestep
+from mdanalysis_mpi_tpu.io import trajectory_files
+from mdanalysis_mpi_tpu.io.base import ReaderBase
+
+_NM_TO_A = 10.0
+_MAGIC = 1993
+_TAG = b"GMX_trn_file"
+# magic + slen + (strlen + 12 tag bytes) + 13 i4 header fields
+_HEAD_INTS = 13
+_HEAD_BYTES = 4 + 4 + (4 + len(_TAG)) + 4 * _HEAD_INTS
+
+
+class _Header:
+    __slots__ = ("sizes", "natoms", "step", "flsize", "payload_start",
+                 "frame_bytes", "x_off")
+
+    # order of the 13 i4 fields after the version tag
+    _FIELDS = ("ir_size", "e_size", "box_size", "vir_size", "pres_size",
+               "top_size", "sym_size", "x_size", "v_size", "f_size",
+               "natoms", "step", "nre")
+
+
+def _parse_header(buf: bytes, offset: int, path: str) -> _Header:
+    if len(buf) - offset < _HEAD_BYTES:
+        raise IOError(f"truncated TRR header in {path!r} at byte {offset}")
+    ints = np.frombuffer(buf, dtype=">i4", count=2, offset=offset)
+    if ints[0] != _MAGIC:
+        raise IOError(
+            f"bad TRR magic {int(ints[0])} in {path!r} at byte {offset}")
+    if ints[1] != len(_TAG) + 1:
+        raise IOError(f"bad TRR version-string length in {path!r}")
+    strlen = int(np.frombuffer(buf, ">i4", 1, offset + 8)[0])
+    if strlen != len(_TAG) or buf[offset + 12:offset + 12 + strlen] != _TAG:
+        raise IOError(f"bad TRR version tag in {path!r}")
+    fields = np.frombuffer(buf, ">i4", _HEAD_INTS,
+                           offset + 12 + len(_TAG))
+    h = _Header()
+    h.sizes = dict(zip(_Header._FIELDS, (int(v) for v in fields)))
+    h.natoms = h.sizes["natoms"]
+    h.step = h.sizes["step"]
+    # float width inferred exactly as upstream nFloatSize()
+    if h.sizes["box_size"]:
+        h.flsize = h.sizes["box_size"] // 9
+    elif h.sizes["x_size"]:
+        h.flsize = h.sizes["x_size"] // (3 * h.natoms)
+    elif h.sizes["v_size"]:
+        h.flsize = h.sizes["v_size"] // (3 * h.natoms)
+    elif h.sizes["f_size"]:
+        h.flsize = h.sizes["f_size"] // (3 * h.natoms)
+    else:
+        h.flsize = 4
+    if h.flsize not in (4, 8):
+        raise IOError(f"unsupported TRR float width {h.flsize} in {path!r}")
+    s = h.sizes
+    h.payload_start = offset + _HEAD_BYTES + 2 * h.flsize   # after t, lambda
+    h.x_off = (h.payload_start + s["box_size"] + s["vir_size"]
+               + s["pres_size"])
+    h.frame_bytes = (h.x_off - offset + s["x_size"] + s["v_size"]
+                     + s["f_size"])
+    return h
+
+
+def _offset_cache_path(path: str) -> str:
+    return path + ".mdtpu_offsets.npz"
+
+
+def _scan(path: str):
+    """Header-hop offset scan with the same mtime-validated cache scheme
+    as the XTC index (SURVEY.md §2.2 random-access requirement)."""
+    cache = _offset_cache_path(path)
+    mtime = os.path.getmtime(path)
+    if os.path.exists(cache):
+        try:
+            z = np.load(cache)
+            if float(z["mtime"]) == mtime:
+                return z["offsets"].astype(np.int64), int(z["natoms"])
+        except Exception:
+            pass
+    with open(path, "rb") as f:
+        buf = f.read()
+    offsets = []
+    natoms = -1
+    pos = 0
+    while pos < len(buf):
+        h = _parse_header(buf, pos, path)
+        if natoms == -1:
+            natoms = h.natoms
+        elif h.natoms != natoms:
+            raise IOError(
+                f"TRR {path!r}: frame {len(offsets)} has {h.natoms} atoms, "
+                f"expected {natoms}")
+        offsets.append(pos)
+        pos += h.frame_bytes
+    offsets = np.asarray(offsets, dtype=np.int64)
+    try:
+        np.savez(cache, offsets=offsets, natoms=natoms, mtime=mtime)
+    except OSError:
+        pass  # read-only directory: index just isn't cached
+    return offsets, natoms
+
+
+class TRRReader(ReaderBase):
+    """Random-access TRR reader (positions in Å, box as dimensions).
+
+    Frames without positions (``x_size == 0`` — TRR interleaves
+    energy-only frames in some workflows) raise on access rather than
+    returning garbage.
+    """
+
+    def __init__(self, path: str, n_atoms: int | None = None):
+        self._path = path
+        self._offsets, self._natoms = _scan(path)
+        if n_atoms is not None and n_atoms != self._natoms:
+            raise ValueError(
+                f"TRR {path!r} has {self._natoms} atoms, expected {n_atoms}")
+        self._file = open(path, "rb")
+
+    @property
+    def n_frames(self) -> int:
+        return len(self._offsets)
+
+    @property
+    def n_atoms(self) -> int:
+        return self._natoms
+
+    def reopen(self) -> "TRRReader":
+        return TRRReader(self._path)
+
+    def close(self):
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+    def _read_frame(self, i: int) -> Timestep:
+        off = int(self._offsets[i])
+        # read just this frame's bytes (header declares the exact size)
+        self._file.seek(off)
+        head = self._file.read(_HEAD_BYTES + 16)
+        h = _parse_header(head, 0, self._path)
+        self._file.seek(off)
+        buf = self._file.read(h.frame_bytes)
+        if h.sizes["x_size"] == 0:
+            raise IOError(
+                f"TRR {self._path!r} frame {i} carries no positions")
+        fl = ">f4" if h.flsize == 4 else ">f8"
+        x = np.frombuffer(buf, fl, 3 * h.natoms, h.x_off)
+        coords = np.ascontiguousarray(
+            x.astype(np.float32).reshape(h.natoms, 3)) * _NM_TO_A
+        dims = None
+        if h.sizes["box_size"]:
+            vecs = np.frombuffer(buf, fl, 9, h.payload_start)
+            dims = vectors_to_box(vecs.astype(np.float64).reshape(3, 3)
+                                  * _NM_TO_A)
+            if not dims[:3].any():
+                dims = None
+        t = float(np.frombuffer(buf, fl, 1, _HEAD_BYTES)[0])
+        return Timestep(coords, frame=i, time=t, dimensions=dims)
+
+    def read_block(self, start: int, stop: int, sel=None):
+        if not 0 <= start <= stop <= self.n_frames:
+            raise IndexError(
+                f"block [{start},{stop}) out of range [0,{self.n_frames}]")
+        n_out = self._natoms if sel is None else len(sel)
+        if start == stop:
+            return np.empty((0, n_out, 3), np.float32), None
+        # one contiguous file read for the whole block, then per-frame
+        # frombuffer views (the bulk staging path, SURVEY.md §7 layer 2)
+        first = int(self._offsets[start])
+        self._file.seek(first)
+        if stop < self.n_frames:
+            nbytes = int(self._offsets[stop]) - first
+            buf = self._file.read(nbytes)
+        else:
+            buf = self._file.read()
+        out = np.empty((stop - start, n_out, 3), dtype=np.float32)
+        boxes = None
+        for j, i in enumerate(range(start, stop)):
+            base = int(self._offsets[i]) - first
+            # header fields parsed at `base` yield offsets relative to buf
+            h = _parse_header(buf, base, self._path)
+            if h.sizes["x_size"] == 0:
+                raise IOError(
+                    f"TRR {self._path!r} frame {i} carries no positions")
+            fl = ">f4" if h.flsize == 4 else ">f8"
+            x = np.frombuffer(buf, fl, 3 * h.natoms, h.x_off)
+            frame = x.astype(np.float32).reshape(h.natoms, 3)
+            out[j] = (frame if sel is None else frame[sel])
+            if h.sizes["box_size"]:
+                if boxes is None:
+                    boxes = np.zeros((stop - start, 6), dtype=np.float32)
+                vecs = np.frombuffer(buf, fl, 9, h.payload_start)
+                boxes[j] = vectors_to_box(
+                    vecs.astype(np.float64).reshape(3, 3) * _NM_TO_A)
+        out *= _NM_TO_A
+        return out, boxes
+
+
+def write_trr(path: str, coordinates: np.ndarray,
+              dimensions: np.ndarray | None = None,
+              times: np.ndarray | None = None,
+              steps: np.ndarray | None = None) -> None:
+    """Write (n_frames, n_atoms, 3) Å coordinates as a float32 TRR
+    (positions + optional box; no velocities/forces) — the fixture
+    writer counterpart of :func:`TRRReader` (SURVEY.md §4)."""
+    coords = np.asarray(coordinates, dtype=np.float32) / _NM_TO_A
+    if coords.ndim != 3 or coords.shape[2] != 3:
+        raise ValueError(f"coordinates must be (F, N, 3), got {coords.shape}")
+    nframes, natoms = coords.shape[:2]
+    if dimensions is not None:
+        dimensions = np.asarray(dimensions)
+        if dimensions.ndim == 1:
+            dimensions = np.broadcast_to(dimensions, (nframes, 6))
+    with open(path, "wb") as f:
+        for i in range(nframes):
+            box_size = 36 if dimensions is not None else 0
+            x_size = 12 * natoms
+            head = np.array([_MAGIC, len(_TAG) + 1], dtype=">i4").tobytes()
+            head += np.array([len(_TAG)], dtype=">i4").tobytes() + _TAG
+            fields = [0, 0, box_size, 0, 0, 0, 0, x_size, 0, 0,
+                      natoms, int(steps[i]) if steps is not None else i, 0]
+            head += np.asarray(fields, dtype=">i4").tobytes()
+            t = float(times[i]) if times is not None else 0.0
+            head += np.asarray([t, 0.0], dtype=">f4").tobytes()
+            f.write(head)
+            if dimensions is not None:
+                vecs = box_to_vectors(dimensions[i]) / _NM_TO_A
+                f.write(np.asarray(vecs, dtype=">f4").tobytes())
+            f.write(np.ascontiguousarray(coords[i], np.float32)
+                    .astype(">f4").tobytes())
+
+
+trajectory_files.register("trr", TRRReader)

@@ -7,14 +7,20 @@
   (BASELINE.json north_star): serial NumPy oracle, JAX single-device,
   and JAX mesh (shard_map + psum over the data axis, replacing
   ``comm.Allreduce``/``comm.reduce``, RMSF.py:110,143).
+- :mod:`mpi` — the mpi4py+NumPy host path the north_star keeps as a
+  peer executor (optional dependency; communicator injectable).
+- :mod:`distributed` — multi-host (DCN) bring-up helpers around
+  ``jax.distributed`` + per-process frame sharding.
 """
 
 from mdanalysis_mpi_tpu.parallel.partition import static_blocks, iter_batches
 from mdanalysis_mpi_tpu.parallel.executors import (
     SerialExecutor, JaxExecutor, MeshExecutor, get_executor,
 )
+from mdanalysis_mpi_tpu.parallel.mpi import MPIExecutor, ThreadComm
 
 __all__ = [
     "static_blocks", "iter_batches",
-    "SerialExecutor", "JaxExecutor", "MeshExecutor", "get_executor",
+    "SerialExecutor", "JaxExecutor", "MeshExecutor", "MPIExecutor",
+    "ThreadComm", "get_executor",
 ]

@@ -1,0 +1,99 @@
+"""Multi-host (DCN) bring-up for the mesh executor.
+
+The reference scales across nodes with ``mpirun`` + MPI collectives
+(RMSF.py:59-61,110,143 — SURVEY.md §5.8).  The TPU-native image is
+multi-controller JAX: one Python process per host, each seeing only its
+local chips, joined into one global mesh by ``jax.distributed`` over
+DCN; in-program reductions stay ``psum`` over ICI/DCN exactly as on a
+single host (the MeshExecutor kernel is unchanged — only array
+placement differs).
+
+Division of labor on multi-host (SURVEY.md §5.8 "host-side staging uses
+no collectives"):
+
+- every process calls :func:`initialize` once, before any other JAX
+  call;
+- every process opens the SAME trajectory files (the reference's
+  pattern: N ranks, N independent reader handles, RMSF.py:56);
+- :func:`process_frame_shard` tells each process which contiguous
+  frame block its local chips are responsible for — frames are
+  sharded host-first so each host's block is contiguous on disk
+  (sequential decode, SURVEY.md §7 "Host I/O vs TPU throughput");
+- per-host staged blocks become one global array via
+  :func:`jax.make_array_from_process_local_data`.
+
+Only chip-count-preserving facts are encoded here; the mesh/psum logic
+lives in :class:`~mdanalysis_mpi_tpu.parallel.executors.MeshExecutor`.
+This environment exposes one process with one chip, so multi-host wiring
+is validated structurally (unit tests over the shard math + the
+single-process degenerate path); the mesh collectives themselves are
+exercised on the 8-device virtual CPU mesh (tests/conftest.py).
+"""
+
+from __future__ import annotations
+
+from mdanalysis_mpi_tpu.parallel.partition import static_blocks
+
+
+def initialize(coordinator_address: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None) -> None:
+    """Join (or single-process-degenerate) the multi-host JAX runtime.
+
+    Call once per process before any other JAX API.  With no arguments
+    on a single host this is a no-op; on TPU pods the three values are
+    normally auto-detected from the TPU environment, and on other
+    fabrics they come from the launcher (one process per host).
+    """
+    if num_processes is not None and num_processes == 1:
+        return
+    import jax
+
+    if (coordinator_address is None and num_processes is None
+            and process_id is None):
+        try:
+            jax.distributed.initialize()
+        except ValueError:
+            # no cluster environment to auto-detect: single process
+            return
+    else:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id)
+
+
+def process_frame_shard(n_frames: int, process_id: int | None = None,
+                        num_processes: int | None = None) -> range:
+    """The contiguous frame block this process stages for its chips.
+
+    Balanced static decomposition (the reference's RMSF.py:65-69 block
+    partition, one level up: hosts instead of ranks).  Defaults to the
+    live ``jax.process_index()/process_count()``.
+    """
+    import jax
+
+    pid = jax.process_index() if process_id is None else process_id
+    n = jax.process_count() if num_processes is None else num_processes
+    return static_blocks(n_frames, n)[pid]
+
+
+def global_batch_from_local(local_batch, mesh, axis_name: str = "data"):
+    """Assemble per-process staged blocks into one mesh-sharded global
+    array (the multi-host twin of the MeshExecutor's ``device_put``).
+
+    ``local_batch``: this process's (B_local, ...) staged frames —
+    B_local = B_global / process_count, matching
+    :func:`process_frame_shard` order so global frame order is
+    preserved.  Single-process meshes take the fast path (plain
+    ``device_put`` with the sharding).
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(mesh, P(axis_name))
+    if jax.process_count() == 1:
+        return jax.device_put(local_batch, sharding)
+    global_shape = (local_batch.shape[0] * jax.process_count(),
+                    *local_batch.shape[1:])
+    return jax.make_array_from_process_local_data(
+        sharding, local_batch, global_shape)

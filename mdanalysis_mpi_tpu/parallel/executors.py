@@ -489,10 +489,15 @@ class MeshExecutor:
             quantize=self.transfer_dtype == "int16")
 
 
+from mdanalysis_mpi_tpu.parallel.mpi import MPIExecutor  # noqa: E402
+# (module import is cheap and mpi4py itself stays lazy — it loads only
+# when MPIExecutor() is built without an explicit communicator)
+
 _EXECUTORS = {
     "serial": SerialExecutor,
     "jax": JaxExecutor,
     "mesh": MeshExecutor,
+    "mpi": MPIExecutor,
 }
 
 

@@ -1,0 +1,111 @@
+"""Chunk-level checkpoint/resume for reduction analyses.
+
+The reference has none (SURVEY.md §5.4): a crash at frame 9,999 of
+10,000 loses everything, and any rank failure deadlocks the collectives
+(RMSF.py:110,143).  The framework's partials make recovery nearly free:
+every reduction analysis' per-chunk summary (e.g. the moment triple
+``[T, mean, M2]``, RMSF.py:140) is mergeable and idempotent to
+regenerate, so a checkpoint is just "frames processed so far + folded
+partials", and resume is "fold saved partials with the rest".
+
+Scope: batch backends (``jax``/``mesh``) and analyses with a
+``_device_fold_fn`` (RMSF, AverageStructure, InterRDF, ContactMap — the
+map-reduce family).  Serial streaming state lives inside the analysis
+object and is not checkpointable from outside; time-series analyses
+(RMSD) have order-dependent concatenation partials — both raise.
+
+Cost note: each checkpoint fetches the partials device→host.  On
+tunneled TPU targets a fetch collapses host→device throughput for the
+remaining process lifetime (analysis.base.Deferred), so chunk size
+trades durability against throughput — checkpoint rarely (the default
+chunk is 4096 frames), or run checkpoint-free when the link matters
+more than crash recovery.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from mdanalysis_mpi_tpu.parallel.executors import get_executor
+from mdanalysis_mpi_tpu.parallel.partition import iter_batches
+
+
+def _save(path: str, frames_done: int, partials) -> None:
+    import jax
+
+    leaves = [np.asarray(x) for x in jax.tree.leaves(partials)]
+    tmp = path + ".tmp.npz"     # np.savez appends .npz to bare names
+    np.savez(tmp, frames_done=np.int64(frames_done),
+             **{f"leaf_{i}": v for i, v in enumerate(leaves)})
+    os.replace(tmp, path)       # atomic: a crash never half-writes
+
+
+def _load(path: str, structure):
+    import jax
+
+    with np.load(path) as z:
+        frames_done = int(z["frames_done"])
+        leaves = [z[f"leaf_{i}"] for i in range(len(z.files) - 1)]
+    treedef = jax.tree.structure(structure)
+    if treedef.num_leaves != len(leaves):
+        raise ValueError(
+            f"checkpoint {path!r} has {len(leaves)} leaves but the "
+            f"analysis' partials have {treedef.num_leaves} — wrong "
+            "checkpoint for this analysis/selection?")
+    return frames_done, jax.tree.unflatten(treedef, leaves)
+
+
+def run_checkpointed(analysis, path: str, chunk_frames: int = 4096,
+                     start=None, stop=None, step=None,
+                     backend: str = "jax", batch_size: int | None = None,
+                     **executor_kwargs):
+    """``analysis.run(...)`` with durable progress in ``path``.
+
+    Processes frames in ``chunk_frames`` chunks; after each, folds the
+    chunk's partials into the running total and atomically rewrites the
+    checkpoint.  If ``path`` exists, already-covered frames are skipped
+    and the saved partials seed the total — re-running the same call
+    after a crash (or the driver killing the process) continues where
+    it stopped.  Deletes the checkpoint on successful completion and
+    returns the analysis (``.results`` populated as usual).
+    """
+    fold = analysis._device_fold_fn
+    if fold is None:
+        raise ValueError(
+            f"{type(analysis).__name__} has no mergeable partials "
+            "(_device_fold_fn is None); checkpointing applies to "
+            "reduction analyses only")
+    if backend == "serial":
+        raise ValueError(
+            "checkpointing needs per-chunk partials; the serial backend "
+            "accumulates inside the analysis — use backend='jax' or "
+            "'mesh' (the serial oracle is for short differential runs)")
+    executor = get_executor(backend, **executor_kwargs)
+
+    frames = list(analysis._frames(start, stop, step))
+    analysis.n_frames = len(frames)
+    analysis._prepare()
+
+    total = None
+    done = 0
+    if os.path.exists(path):
+        done, total = _load(path, analysis._identity_partials())
+        if done > len(frames):
+            raise ValueError(
+                f"checkpoint {path!r} covers {done} frames but this run "
+                f"has {len(frames)} — frame window mismatch")
+
+    for a, b in iter_batches(done, len(frames), chunk_frames):
+        partials = executor.execute(analysis, analysis._universe.trajectory,
+                                    frames[a:b], batch_size=batch_size)
+        total = partials if total is None else fold(total, partials)
+        _save(path, b, total)
+
+    if total is None:
+        total = analysis._identity_partials()
+    analysis._conclude(total)
+    if os.path.exists(path):
+        os.remove(path)
+    return analysis

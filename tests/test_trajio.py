@@ -201,3 +201,114 @@ class TestDCD:
         s = AlignedRMSF(u, select="protein and name CA").run(backend="serial")
         np.testing.assert_allclose(r.results.rmsf, s.results.rmsf,
                                    rtol=5e-3, atol=1e-4)
+
+
+# ---------------- TRR ----------------
+
+class TestTRR:
+    def test_round_trip(self, tmp_path):
+        from mdanalysis_mpi_tpu.io.trr import TRRReader, write_trr
+
+        coords = _traj()
+        dims = np.array([40.0, 40.0, 40.0, 90.0, 90.0, 90.0])
+        path = str(tmp_path / "t.trr")
+        write_trr(path, coords, dimensions=dims,
+                  times=np.arange(6, dtype=np.float32) * 2.0,
+                  steps=np.arange(6) * 100)
+        r = TRRReader(path)
+        assert r.n_frames == 6
+        assert r.n_atoms == 50
+        for i in range(6):
+            ts = r[i]
+            # TRR is uncompressed f32 in nm: only nm->A f32 rounding
+            np.testing.assert_allclose(ts.positions, coords[i], atol=1e-4)
+            np.testing.assert_allclose(ts.dimensions, dims, atol=1e-3)
+            assert ts.time == pytest.approx(2.0 * i)
+
+    def test_boxless(self, tmp_path):
+        from mdanalysis_mpi_tpu.io.trr import TRRReader, write_trr
+
+        path = str(tmp_path / "nb.trr")
+        coords = _traj(f=3, n=7)
+        write_trr(path, coords)
+        r = TRRReader(path)
+        assert r[0].dimensions is None
+        block, boxes = r.read_block(0, 3)
+        assert boxes is None
+        np.testing.assert_allclose(block, coords, atol=1e-4)
+
+    def test_read_block_with_selection(self, tmp_path):
+        from mdanalysis_mpi_tpu.io.trr import TRRReader, write_trr
+
+        coords = _traj(f=5, n=30)
+        path = str(tmp_path / "sel.trr")
+        write_trr(path, coords,
+                  dimensions=np.array([50, 50, 50, 90, 90, 90.0]))
+        r = TRRReader(path)
+        sel = np.array([0, 3, 29])
+        block, boxes = r.read_block(1, 4, sel=sel)
+        assert block.shape == (3, 3, 3)
+        np.testing.assert_allclose(block, coords[1:4][:, sel], atol=1e-4)
+        assert boxes.shape == (3, 6)
+
+    def test_offset_cache_reused(self, tmp_path):
+        from mdanalysis_mpi_tpu.io import trr as trr_mod
+
+        coords = _traj(f=4, n=10)
+        path = str(tmp_path / "c.trr")
+        trr_mod.write_trr(path, coords)
+        r1 = trr_mod.TRRReader(path)
+        assert len(r1._offsets) == 4
+        import os
+        assert os.path.exists(trr_mod._offset_cache_path(path))
+        r2 = trr_mod.TRRReader(path)        # loads via cache
+        np.testing.assert_array_equal(r1._offsets, r2._offsets)
+
+    def test_double_precision_frames(self, tmp_path):
+        """f64 TRR (box_size=72, x_size=24N) decodes through the same
+        width-inference path as upstream nFloatSize()."""
+        from mdanalysis_mpi_tpu.io.trr import _MAGIC, _TAG, TRRReader
+
+        coords = RNG.normal(scale=2.0, size=(2, 4, 3))
+        box = np.diag([4.0, 4.0, 4.0])
+        path = str(tmp_path / "d.trr")
+        with open(path, "wb") as f:
+            for i in range(2):
+                head = np.array([_MAGIC, len(_TAG) + 1], dtype=">i4").tobytes()
+                head += np.array([len(_TAG)], dtype=">i4").tobytes() + _TAG
+                fields = [0, 0, 72, 0, 0, 0, 0, 24 * 4, 0, 0, 4, i, 0]
+                head += np.asarray(fields, dtype=">i4").tobytes()
+                head += np.asarray([0.5 * i, 0.0], dtype=">f8").tobytes()
+                f.write(head)
+                f.write(np.asarray(box, dtype=">f8").tobytes())
+                f.write(np.asarray(coords[i], dtype=">f8").tobytes())
+        r = TRRReader(path)
+        assert r.n_frames == 2
+        np.testing.assert_allclose(r[1].positions, coords[1] * 10.0,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(r[1].dimensions[:3], [40, 40, 40],
+                                   atol=1e-6)
+
+    def test_universe_integration(self, tmp_path):
+        from mdanalysis_mpi_tpu.io.gro import write_gro
+        from mdanalysis_mpi_tpu.io.trr import write_trr
+
+        top = make_protein_topology(n_residues=5)
+        coords = _traj(f=4, n=top.n_atoms, scale=5.0)
+        gro = str(tmp_path / "u.gro")
+        trr = str(tmp_path / "u.trr")
+        write_gro(gro, top, coords[0])
+        write_trr(trr, coords)
+        u = Universe(gro, trr)
+        assert u.trajectory.n_frames == 4
+        ca = u.select_atoms("name CA")
+        assert ca.n_atoms == 5
+
+    def test_bad_magic_rejected(self, tmp_path):
+        path = str(tmp_path / "bad.trr")
+        with open(path, "wb") as f:
+            f.write(b"\x00" * 64)
+        from mdanalysis_mpi_tpu.io.trr import TRRReader
+
+        with pytest.raises(IOError, match="magic"):
+            TRRReader(path)
