@@ -175,6 +175,16 @@ class AnalysisBase:
     def _batch_select(self):
         return None
 
+    # True when the batch kernel uses in-kernel mesh collectives (ring
+    # engines) and therefore cannot run on the single-device backend
+    _mesh_only = False
+
+    def _batch_specs(self, axis_name):
+        """Optional shard_map partition specs for atom-axis-sharded
+        kernels: ``(params_spec, batch_spec, boxes_spec, mask_spec)``
+        or None (default) for frame sharding."""
+        return None
+
     def _identity_partials(self):
         raise NotImplementedError
 

@@ -67,6 +67,28 @@ def _rdf_kernel(exclude_self: bool, tile: int, engine: str,
     return kernel
 
 
+# Ring-engine atom padding: the union atom array is padded to a multiple
+# of this so it divides evenly across any power-of-two mesh (shard_map
+# needs exact divisibility; padded entries carry weight 0 and vanish).
+_RING_PAD = 512
+
+
+@functools.lru_cache(maxsize=None)
+def _rdf_ring_kernel(exclude_self: bool, tile: int, axis_name: str):
+    """Atom-sharded ring engine (ops.ring): the staged union batch is
+    sharded over the mesh's atom axis; group membership travels as
+    weights; ppermute rotates the B side around the ring."""
+    def kernel(params, batch, boxes, mask):
+        from mdanalysis_mpi_tpu.ops.ring import ring_rdf_batch
+
+        w_a, w_b, edges = params
+        return ring_rdf_batch(batch, w_a, w_b, boxes, mask, edges,
+                              axis_name, exclude_self=exclude_self,
+                              tile=tile)
+
+    return kernel
+
+
 class InterRDF(AnalysisBase):
     """Radial distribution function g(r) between two groups."""
 
@@ -76,9 +98,10 @@ class InterRDF(AnalysisBase):
                  verbose: bool = False):
         if g1.universe is not g2.universe:
             raise ValueError("g1 and g2 must belong to the same Universe")
-        if engine not in ("auto", "pallas", "xla"):
+        if engine not in ("auto", "pallas", "xla", "ring"):
             raise ValueError(
-                f"engine must be 'auto', 'pallas' or 'xla', got {engine!r}")
+                f"engine must be 'auto', 'pallas', 'xla' or 'ring', "
+                f"got {engine!r}")
         super().__init__(g1.universe, verbose)
         self._g1 = g1
         self._g2 = g2
@@ -97,7 +120,19 @@ class InterRDF(AnalysisBase):
                                   self._nbins + 1)
         # union staging: both groups gathered once, local indices within
         union = np.union1d(self._g1.indices, self._g2.indices)
-        self._union = union
+        if self._engine == "ring":
+            # pad the union so it divides across any power-of-two atom
+            # mesh; padded slots restage atom 0 with weight 0 (ops.ring)
+            pad = (-len(union)) % _RING_PAD
+            self._union = np.concatenate(
+                [union, np.zeros(pad, dtype=union.dtype)])
+            w_a = np.zeros(len(self._union), dtype=np.float32)
+            w_b = np.zeros(len(self._union), dtype=np.float32)
+            w_a[np.searchsorted(union, self._g1.indices)] = 1.0
+            w_b[np.searchsorted(union, self._g2.indices)] = 1.0
+            self._ring_weights = (w_a, w_b)
+        else:
+            self._union = union
         self._loc_a = np.searchsorted(union, self._g1.indices)
         self._loc_b = np.searchsorted(union, self._g2.indices)
         self._identical = (len(self._g1.indices) == len(self._g2.indices)
@@ -170,7 +205,10 @@ class InterRDF(AnalysisBase):
         return self._union
 
     def _batch_fn(self):
-        if self._resolve_engine() == "pallas":
+        engine = self._resolve_engine()
+        if engine == "ring":
+            return _rdf_ring_kernel(self._identical, self._tile, "data")
+        if engine == "pallas":
             return _rdf_kernel(self._identical, 0, "pallas",
                                tuple(float(e) for e in self._edges))
         return _rdf_kernel(self._identical, self._tile, "xla")
@@ -178,10 +216,31 @@ class InterRDF(AnalysisBase):
     def _batch_params(self):
         import jax.numpy as jnp
 
+        if self._resolve_engine() == "ring":
+            w_a, w_b = self._ring_weights
+            return (jnp.asarray(w_a), jnp.asarray(w_b),
+                    jnp.asarray(self._edges, jnp.float32))
         locs = (jnp.asarray(self._loc_a), jnp.asarray(self._loc_b))
         if self._resolve_engine() == "pallas":
             return locs      # edges are compile-time constants
         return locs + (jnp.asarray(self._edges, jnp.float32),)
+
+    @property
+    def _mesh_only(self):
+        return self._engine == "ring"
+
+    def _batch_specs(self, axis_name):
+        if self._resolve_engine() != "ring":
+            return None
+        from jax.sharding import PartitionSpec as P
+
+        if axis_name != "data":
+            raise ValueError(
+                "InterRDF ring engine bakes the mesh axis name 'data' "
+                f"into its kernel; got axis {axis_name!r}")
+        # params (w_a, w_b, edges); batch (B, N, 3); boxes; mask
+        return ((P(axis_name), P(axis_name), P()),
+                P(None, axis_name), P(), P())
 
     _device_fold_fn = staticmethod(tree_add)
     _device_combine = staticmethod(tree_psum)

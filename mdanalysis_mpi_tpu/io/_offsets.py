@@ -1,0 +1,38 @@
+"""Shared frame-offset index cache (XTC + TRR readers).
+
+Upstream builds a frame-offset index on first open and caches it beside
+the trajectory (SURVEY.md §2.2 random-access requirement); both XDR
+readers here use this one mtime-validated npz scheme.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def cache_path(path: str) -> str:
+    return path + ".mdtpu_offsets.npz"
+
+
+def load(path: str):
+    """Cached (offsets, natoms) for ``path``, or None if absent/stale."""
+    cache = cache_path(path)
+    if not os.path.exists(cache):
+        return None
+    try:
+        z = np.load(cache)
+        if float(z["mtime"]) == os.path.getmtime(path):
+            return z["offsets"].astype(np.int64), int(z["natoms"])
+    except Exception:
+        pass
+    return None
+
+
+def save(path: str, offsets: np.ndarray, natoms: int) -> None:
+    try:
+        np.savez(cache_path(path), offsets=offsets, natoms=natoms,
+                 mtime=os.path.getmtime(path))
+    except OSError:
+        pass  # read-only directory: index just isn't cached

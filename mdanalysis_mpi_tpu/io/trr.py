@@ -91,42 +91,39 @@ def _parse_header(buf: bytes, offset: int, path: str) -> _Header:
     return h
 
 
-def _offset_cache_path(path: str) -> str:
-    return path + ".mdtpu_offsets.npz"
+from mdanalysis_mpi_tpu.io import _offsets
+
+_offset_cache_path = _offsets.cache_path    # shared scheme with XTC
 
 
 def _scan(path: str):
-    """Header-hop offset scan with the same mtime-validated cache scheme
-    as the XTC index (SURVEY.md §2.2 random-access requirement)."""
-    cache = _offset_cache_path(path)
-    mtime = os.path.getmtime(path)
-    if os.path.exists(cache):
-        try:
-            z = np.load(cache)
-            if float(z["mtime"]) == mtime:
-                return z["offsets"].astype(np.int64), int(z["natoms"])
-        except Exception:
-            pass
-    with open(path, "rb") as f:
-        buf = f.read()
+    """Header-hop offset scan: seek+read ~90 bytes per frame (never the
+    payload — a full-precision TRR can be tens of GB), with the shared
+    mtime-validated cache (SURVEY.md §2.2 random-access requirement)."""
+    cached = _offsets.load(path)
+    if cached is not None:
+        return cached
+    size = os.path.getsize(path)
     offsets = []
     natoms = -1
-    pos = 0
-    while pos < len(buf):
-        h = _parse_header(buf, pos, path)
-        if natoms == -1:
-            natoms = h.natoms
-        elif h.natoms != natoms:
-            raise IOError(
-                f"TRR {path!r}: frame {len(offsets)} has {h.natoms} atoms, "
-                f"expected {natoms}")
-        offsets.append(pos)
-        pos += h.frame_bytes
+    with open(path, "rb") as f:
+        pos = 0
+        while pos < size:
+            f.seek(pos)
+            # header + t/lambda at their widest (2×f8): enough to size
+            # the whole frame without touching its payload
+            head = f.read(_HEAD_BYTES + 16)
+            h = _parse_header(head, 0, path)
+            if natoms == -1:
+                natoms = h.natoms
+            elif h.natoms != natoms:
+                raise IOError(
+                    f"TRR {path!r}: frame {len(offsets)} has {h.natoms} "
+                    f"atoms, expected {natoms}")
+            offsets.append(pos)
+            pos += h.frame_bytes
     offsets = np.asarray(offsets, dtype=np.int64)
-    try:
-        np.savez(cache, offsets=offsets, natoms=natoms, mtime=mtime)
-    except OSError:
-        pass  # read-only directory: index just isn't cached
+    _offsets.save(path, offsets, natoms)
     return offsets, natoms
 
 

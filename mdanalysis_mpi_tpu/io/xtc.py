@@ -23,22 +23,17 @@ from mdanalysis_mpi_tpu.io.base import ReaderBase
 _NM_TO_A = 10.0
 
 
-def _offset_cache_path(path: str) -> str:
-    return path + ".mdtpu_offsets.npz"
+from mdanalysis_mpi_tpu.io import _offsets
+
+_offset_cache_path = _offsets.cache_path    # shared scheme with TRR
 
 
 def _scan(path: str):
-    """Frame offsets + natoms, with an mtime-validated on-disk cache
-    (upstream builds and caches the same index — SURVEY.md §2.2)."""
-    cache = _offset_cache_path(path)
-    mtime = os.path.getmtime(path)
-    if os.path.exists(cache):
-        try:
-            z = np.load(cache)
-            if float(z["mtime"]) == mtime:
-                return z["offsets"].astype(np.int64), int(z["natoms"])
-        except Exception:
-            pass
+    """Frame offsets + natoms, with the shared mtime-validated on-disk
+    cache (upstream builds and caches the same index — SURVEY.md §2.2)."""
+    cached = _offsets.load(path)
+    if cached is not None:
+        return cached
     lib = native.load()
     natoms = ctypes.c_int(-1)
     n = lib.xtc_scan(path.encode(), ctypes.byref(natoms), None, 0)
@@ -49,10 +44,7 @@ def _scan(path: str):
                       offsets.ctypes.data_as(ctypes.c_void_p), n)
     if n2 != n:
         raise IOError(f"inconsistent XTC scan of {path!r}")
-    try:
-        np.savez(cache, offsets=offsets, natoms=natoms.value, mtime=mtime)
-    except OSError:
-        pass  # read-only directory: index just isn't cached
+    _offsets.save(path, offsets, natoms.value)
     return offsets, natoms.value
 
 

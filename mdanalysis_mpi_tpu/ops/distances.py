@@ -79,7 +79,8 @@ def _pad_tiles(x: jax.Array, tile: int):
     pad = n_tiles * tile - n
     xp = jnp.pad(x, ((0, pad), (0, 0)))
     valid = jnp.pad(jnp.ones(n, x.dtype), (0, pad))
-    return xp.reshape(n_tiles, tile, 3), valid.reshape(n_tiles, tile)
+    return (xp.reshape(n_tiles, tile, x.shape[1]),
+            valid.reshape(n_tiles, tile))
 
 
 def pair_histogram(
@@ -89,6 +90,10 @@ def pair_histogram(
     box: jax.Array | None = None,
     exclude_self: bool = False,   # True when a and b are the same group
     tile: int = 1024,
+    a_offset=0,                   # global index of a[0] (sharded callers)
+    b_offset=0,                   # global index of b[0]
+    a_weights: jax.Array | None = None,   # (N,) per-atom pair weights
+    b_weights: jax.Array | None = None,   # (M,)
 ) -> jax.Array:
     """Blockwise histogram of pair distances — the RDF inner kernel.
 
@@ -98,20 +103,33 @@ def pair_histogram(
     O(N·tile), never O(N·M) (SURVEY.md §5.7).  ``exclude_self`` drops
     i==j pairs (self-RDF); for identical groups every pair is counted
     twice (i→j and j→i), which the RDF normalization accounts for.
+
+    The offset/weight parameters exist for the atom-sharded ring engine
+    (``ops.ring``): a pair contributes ``a_weights[i]·b_weights[j]``
+    (group membership and padding validity in one number — 0 weights
+    fall out exactly), and ``exclude_self`` compares *global* indices
+    ``a_offset+i == b_offset+j`` so each mesh shard sees its true
+    position in the global atom order.  Offsets may be traced scalars.
     """
     nbins = edges.shape[0] - 1
     bt, bvalid = _pad_tiles(b, tile)
     n_tiles = bt.shape[0]
+    if b_weights is not None:
+        bw, _ = _pad_tiles(b_weights[:, None], tile)
+        bw = bw[..., 0]
 
     def one_tile(t):
         bc, bv = bt[t], bvalid[t]
         disp = a[:, None, :] - bc[None, :, :]
         disp = minimum_image(disp, box)
         d = jnp.sqrt((disp ** 2).sum(-1))            # (N, tile)
-        w = bv[None, :] * jnp.ones((a.shape[0], 1), a.dtype)
+        wb = bv if b_weights is None else bv * bw[t]
+        wa = (jnp.ones((a.shape[0],), a.dtype) if a_weights is None
+              else a_weights)
+        w = wa[:, None] * wb[None, :]
         if exclude_self:
-            ia = jnp.arange(a.shape[0])[:, None]
-            ib = t * tile + jnp.arange(tile)[None, :]
+            ia = a_offset + jnp.arange(a.shape[0])[:, None]
+            ib = b_offset + t * tile + jnp.arange(tile)[None, :]
             w = w * (ia != ib)
         # bucketize; out-of-range pairs land in bin index nbins (dropped)
         idx = jnp.searchsorted(edges, d.ravel(), side="right") - 1

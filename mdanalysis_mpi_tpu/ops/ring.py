@@ -1,0 +1,106 @@
+"""Ring-rotated atom-axis sharding for O(N²) pair kernels.
+
+The sequence/context-parallel analog SURVEY.md §2.3/§5.7 identifies:
+the reference's only axis is frames (time), but the O(N²) pair kernels
+(RDF, distance arrays — BASELINE configs 4-5) scale with *atoms*, and a
+single chip's tile stream is the bottleneck once N is large.  The
+TPU-native fix is structurally ring attention: shard the atom axis over
+the mesh, keep each device's block resident, and rotate the "key" side
+block-by-block around the ring with ``jax.lax.ppermute`` over ICI —
+after P steps every device has histogrammed its atom block against all
+N atoms, and a single ``psum`` merges the partial histograms.  Nothing
+ever materializes more than O((N/P)·tile) distances per device.
+
+Group structure rides along as *weights*: both RDF groups live in one
+union atom array; a pair contributes ``w_a[i]·w_b[j]``, so subset
+groups, overlap, and shard padding (weight 0) all fall out of the same
+multiply.  The weight vector of the rotating side travels with the
+coordinates (concatenated as a 4th column) so weights and positions
+can never desynchronize mid-ring.
+
+These functions are *shard_map-inner*: they use ``axis_index``/
+``axis_size``/``ppermute`` and must run inside ``shard_map`` over
+``axis_name`` (the MeshExecutor provides that context; see
+``InterRDF(engine='ring')``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from mdanalysis_mpi_tpu.ops.distances import _HI, pair_histogram
+
+
+def ring_union_histogram(x_blk: jax.Array,    # (n_l, 3) local atom block
+                         w_a: jax.Array,      # (n_l,) group-A weights
+                         w_b: jax.Array,      # (n_l,) group-B weights
+                         edges: jax.Array,
+                         box: jax.Array | None,
+                         axis_name: str,
+                         exclude_self: bool = False,
+                         tile: int = 1024) -> jax.Array:
+    """One frame's pair histogram, atom-sharded: every device holds a
+    contiguous block of the (padded) union atom array and returns its
+    partial (nbins,) histogram — callers ``psum`` across the ring.
+    """
+    p = jax.lax.axis_size(axis_name)
+    me = jax.lax.axis_index(axis_name)
+    n_l = x_blk.shape[0]
+    nbins = edges.shape[0] - 1
+    # rotating payload: B-side coords + weights, welded together
+    rot0 = jnp.concatenate([x_blk, w_b[:, None]], axis=1)     # (n_l, 4)
+
+    def step(k, carry):
+        rot, hist = carry
+        src = jnp.mod(me - k, p)       # whose block we hold at step k
+        hist = hist + pair_histogram(
+            x_blk, rot[:, :3], edges, box=box,
+            exclude_self=exclude_self, tile=tile,
+            a_offset=me * n_l, b_offset=src * n_l,
+            a_weights=w_a, b_weights=rot[:, 3])
+        rot = jax.lax.ppermute(
+            rot, axis_name, [(i, (i + 1) % p) for i in range(p)])
+        return rot, hist
+
+    _, hist = jax.lax.fori_loop(
+        0, p, step, (rot0, jnp.zeros(nbins, x_blk.dtype)))
+    return hist
+
+
+def ring_rdf_batch(batch_blk: jax.Array,     # (B, n_l, 3) local blocks
+                   w_a: jax.Array,           # (n_l,)
+                   w_b: jax.Array,           # (n_l,)
+                   boxes: jax.Array,         # (B, 6) replicated
+                   mask: jax.Array,          # (B,) replicated
+                   edges: jax.Array,
+                   axis_name: str,
+                   exclude_self: bool = False,
+                   tile: int = 1024):
+    """Frame-batch RDF partials on the atom-sharded ring:
+    ``(counts, Σ volume, T, n_boxed)`` with the same contract as the
+    frame-sharded engines.
+
+    boxes/mask are replicated across the atom axis, so the scalar
+    partials are divided by the ring size — the analysis' ``psum``
+    merge (tree_psum) then restores the true totals, keeping one merge
+    path for every engine.
+    """
+    from mdanalysis_mpi_tpu.ops._boxmat import box_to_matrix
+
+    p = jax.lax.axis_size(axis_name)
+
+    def per_frame(args):
+        x, box6 = args
+        vol = jnp.abs(jnp.linalg.det(box_to_matrix(box6)))
+        hist = ring_union_histogram(
+            x, w_a, w_b, edges, box6, axis_name,
+            exclude_self=exclude_self, tile=tile)
+        return hist, vol
+
+    hists, vols = jax.lax.map(per_frame, (batch_blk, boxes))
+    counts = jnp.einsum("b,bn->n", mask, hists, precision=_HI)
+    vol_sum = (vols * mask).sum() / p
+    t = mask.sum() / p
+    n_boxed = ((vols > 0.0) * mask).sum() / p
+    return counts, vol_sum, t, n_boxed

@@ -375,6 +375,10 @@ class JaxExecutor:
     def execute(self, analysis, reader, frames, batch_size=None):
         import jax
 
+        if getattr(analysis, "_mesh_only", False):
+            raise ValueError(
+                f"{type(analysis).__name__} uses an atom-sharded ring "
+                "kernel (mesh collectives); run it with backend='mesh'")
         bs = batch_size or self.batch_size
         quantize = self.transfer_dtype == "int16"
         f = analysis._batch_fn()
@@ -425,10 +429,18 @@ class MeshExecutor:
 
         devices = self.devices if self.devices is not None else jax.devices()
         quantize = self.transfer_dtype == "int16"
+        custom = analysis._batch_specs(self.axis_name)
+        if custom is not None and quantize:
+            raise ValueError(
+                "atom-sharded (ring) kernels support transfer_dtype="
+                "'float32' only")
         f = analysis._batch_fn()
         if quantize:
             f = _dequant_wrapper(f)
         devcombine = analysis._device_combine
+        if custom is not None and devcombine is None:
+            raise ValueError(
+                "atom-sharded kernels need a _device_combine psum merge")
         key = (f, devcombine, tuple(devices), self.axis_name)
         cached = _MESH_CACHE.get(key)
         if cached is not None:
@@ -444,11 +456,22 @@ class MeshExecutor:
                 return devcombine(partials, axis)
             return partials
 
-        out_specs = P() if devcombine is not None else P(axis)
-        # staged is (batch, boxes, mask) or (batch_i16, inv_scale, boxes,
-        # mask); the inv_scale scalar is replicated
-        in_specs = ((P(), P(axis), P(), P(axis), P(axis)) if quantize
-                    else (P(), P(axis), P(axis), P(axis)))
+        if custom is not None:
+            # atom-sharded: analysis declares every spec; frames are NOT
+            # sharded (each device sees the full batch, its atom block)
+            params_spec, batch_spec, boxes_spec, mask_spec = custom
+            in_specs = (params_spec, batch_spec, boxes_spec, mask_spec)
+            out_specs = P()
+            put_specs = (batch_spec, boxes_spec, mask_spec)
+            frames_per_batch_factor = 1
+        else:
+            out_specs = P() if devcombine is not None else P(axis)
+            # staged is (batch, boxes, mask) or (batch_i16, inv_scale,
+            # boxes, mask); the inv_scale scalar is replicated
+            in_specs = ((P(), P(axis), P(), P(axis), P(axis)) if quantize
+                        else (P(), P(axis), P(axis), P(axis)))
+            put_specs = (P(axis), P(axis), P(axis))
+            frames_per_batch_factor = len(devices)
         # check_vma=False: jnp.linalg.svd lowers to an iterative scan on
         # TPU whose bool carry trips the varying-manual-axes check inside
         # shard_map (works on CPU, fails on TPU); the kernel is purely
@@ -457,8 +480,8 @@ class MeshExecutor:
             shard_fn, mesh=mesh,
             in_specs=in_specs,
             out_specs=out_specs, check_vma=False))
-        sharding = NamedSharding(mesh, P(axis))
-        result = (len(devices), gfn, sharding)
+        shardings = tuple(NamedSharding(mesh, s) for s in put_specs)
+        result = (frames_per_batch_factor, gfn, shardings)
         _MESH_CACHE[key] = result
         return result
 
@@ -466,17 +489,17 @@ class MeshExecutor:
         import jax
 
         bs = batch_size or self.batch_size
-        n_dev, gfn, sharding = self._build(analysis)
-        global_bs = bs * n_dev
+        bs_factor, gfn, shardings = self._build(analysis)
+        global_bs = bs * bs_factor
         params, sel_idx = _wrap_for_transfer(
             analysis._batch_params(), analysis._batch_select(),
             reader.n_atoms, self.transfer_dtype)
         frames = list(frames)
 
         def put(padded, boxes, mask):
-            return (jax.device_put(padded, sharding),
-                    jax.device_put(boxes, sharding),
-                    jax.device_put(mask, sharding))
+            return (jax.device_put(padded, shardings[0]),
+                    jax.device_put(boxes, shardings[1]),
+                    jax.device_put(mask, shardings[2]))
 
         # With _device_combine, gfn outputs replicated merged partials;
         # without, out_specs=P(axis) concatenates per-device outputs along
