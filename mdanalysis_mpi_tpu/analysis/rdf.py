@@ -207,7 +207,10 @@ class InterRDF(AnalysisBase):
     def _batch_fn(self):
         engine = self._resolve_engine()
         if engine == "ring":
-            return _rdf_ring_kernel(self._identical, self._tile, "data")
+            # axis name recorded by _batch_specs (the executor calls it
+            # first); "data" only as the pre-dispatch default
+            return _rdf_ring_kernel(self._identical, self._tile,
+                                    getattr(self, "_ring_axis", "data"))
         if engine == "pallas":
             return _rdf_kernel(self._identical, 0, "pallas",
                                tuple(float(e) for e in self._edges))
@@ -234,10 +237,7 @@ class InterRDF(AnalysisBase):
             return None
         from jax.sharding import PartitionSpec as P
 
-        if axis_name != "data":
-            raise ValueError(
-                "InterRDF ring engine bakes the mesh axis name 'data' "
-                f"into its kernel; got axis {axis_name!r}")
+        self._ring_axis = axis_name     # consumed by _batch_fn
         # params (w_a, w_b, edges); batch (B, N, 3); boxes; mask
         return ((P(axis_name), P(axis_name), P()),
                 P(None, axis_name), P(), P())

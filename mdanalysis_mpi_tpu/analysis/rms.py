@@ -339,10 +339,16 @@ class _MomentsToReference(AnalysisBase):
         self._stream = host.StreamingMoments((len(self._idx), 3))
 
     def _single_frame(self, ts):
-        sel = ts.positions[self._idx].astype(np.float64)
-        com = host.weighted_center(sel, self._masses)
-        r = host.qcp_rotation(sel - com, self._ref_sel_c)
-        self._stream.update((sel - com) @ r + self._ref_com)
+        ref_np = getattr(self, "_ref_np", None)
+        if ref_np is None:
+            # one conversion for the whole pass (the reference may be a
+            # device array when pass 1 ran on an accelerator backend)
+            ref_np = (np.asarray(self._ref_sel_c, np.float64),
+                      np.asarray(self._ref_com, np.float64))
+            self._ref_np = ref_np
+        host.superpose_moments_frame(
+            ts.positions, self._idx, self._masses,
+            ref_np[0], ref_np[1], self._stream)
 
     def _serial_summary(self):
         return self._stream.summary

@@ -30,9 +30,13 @@ def load(path: str):
     return None
 
 
-def save(path: str, offsets: np.ndarray, natoms: int) -> None:
+def save(path: str, offsets: np.ndarray, natoms: int,
+         mtime: float) -> None:
+    """``mtime`` must be captured BEFORE the scan: a trajectory appended
+    to mid-scan then fails validation next open (rescan) instead of
+    serving a stale index forever."""
     try:
         np.savez(cache_path(path), offsets=offsets, natoms=natoms,
-                 mtime=os.path.getmtime(path))
+                 mtime=mtime)
     except OSError:
         pass  # read-only directory: index just isn't cached

@@ -95,6 +95,16 @@ def load() -> ctypes.CDLL:
             _f32p, ctypes.c_long, ctypes.c_long, ctypes.c_void_p,
             ctypes.c_long, _f32p]
 
+        lib.qcp_superpose_apply.restype = ctypes.c_int
+        lib.qcp_superpose_apply.argtypes = [
+            _f32p, ctypes.c_long, _i64p, ctypes.c_long, _f64p,
+            _f64p, _f64p, _f64p, ctypes.c_void_p]
+
+        lib.qcp_superpose_moments.restype = ctypes.c_int
+        lib.qcp_superpose_moments.argtypes = [
+            _f32p, ctypes.c_long, _i64p, ctypes.c_long, _f64p,
+            _f64p, _f64p, ctypes.c_long, _f64p, _f64p]
+
         _lib = lib
         return _lib
 
@@ -141,3 +151,47 @@ def stage_gather(src: np.ndarray, sel=None) -> np.ndarray:
     if rc != 0:
         raise RuntimeError(f"stage_gather_f32 failed (rc={rc})")
     return out
+
+
+def qcp_superpose_apply(coords: np.ndarray, sel: np.ndarray,
+                        weights: np.ndarray, ref_c: np.ndarray,
+                        ref_com: np.ndarray, want_rot: bool = False):
+    """Native full-frame QCP superposition (see trajio.cpp).
+
+    coords (N,3) f32 C-contiguous; returns aligned (N,3) f64 (and R
+    (3,3) f64 when ``want_rot``) with the ops.host conventions.
+    """
+    import ctypes as _ct
+
+    lib = load()
+    n = coords.shape[0]
+    sel = np.ascontiguousarray(sel, dtype=np.int64)
+    weights = np.ascontiguousarray(weights, dtype=np.float64)
+    ref_c = np.ascontiguousarray(ref_c, dtype=np.float64)
+    ref_com = np.ascontiguousarray(ref_com, dtype=np.float64)
+    out = np.empty((n, 3), dtype=np.float64)
+    rot = np.empty((3, 3), dtype=np.float64) if want_rot else None
+    rc = lib.qcp_superpose_apply(
+        coords, n, sel, len(sel), weights, ref_c, ref_com, out,
+        rot.ctypes.data_as(_ct.c_void_p) if want_rot else None)
+    if rc != 0:
+        raise RuntimeError(f"qcp_superpose_apply failed (rc={rc})")
+    return (out, rot) if want_rot else out
+
+
+def qcp_superpose_moments(coords: np.ndarray, sel: np.ndarray,
+                          weights: np.ndarray, ref_c: np.ndarray,
+                          ref_com: np.ndarray, k: int,
+                          mean: np.ndarray, m2: np.ndarray) -> None:
+    """Native fused superpose-selection + streaming Welford update
+    (mean/m2 (S,3) f64 updated in place; caller advances the count)."""
+    lib = load()
+    sel = np.ascontiguousarray(sel, dtype=np.int64)
+    weights = np.ascontiguousarray(weights, dtype=np.float64)
+    ref_c = np.ascontiguousarray(ref_c, dtype=np.float64)
+    ref_com = np.ascontiguousarray(ref_com, dtype=np.float64)
+    rc = lib.qcp_superpose_moments(
+        coords, coords.shape[0], sel, len(sel), weights, ref_c, ref_com,
+        k, mean, m2)
+    if rc != 0:
+        raise RuntimeError(f"qcp_superpose_moments failed (rc={rc})")

@@ -861,3 +861,168 @@ int stage_gather_f32(const float* src, long n_frames, long n_atoms,
 }
 
 }  // extern "C"
+
+// ============================================================================
+// QCP host kernels (SURVEY.md §2.2: the reference's per-rank hot loop runs
+// C qcprot + BLAS; these give the serial/MPI host backend the same native
+// weight class).  Rotation convention matches ops/host.py:qcp_rotation —
+// the quaternion matrix rq rotates column vectors; callers apply row
+// vectors, so aligned = (x - com) · rqᵀ.
+// ============================================================================
+
+extern "C" {
+
+// Cyclic Jacobi eigensolver for a symmetric 4x4: a is destroyed, v gets
+// the eigenvectors (columns).  ~8 sweeps reach f64 machine precision.
+static void jacobi4(double a[4][4], double v[4][4]) {
+    for (int i = 0; i < 4; i++)
+        for (int j = 0; j < 4; j++) v[i][j] = (i == j) ? 1.0 : 0.0;
+    for (int sweep = 0; sweep < 32; sweep++) {
+        double off = 0.0;
+        for (int p = 0; p < 3; p++)
+            for (int q = p + 1; q < 4; q++) off += a[p][q] * a[p][q];
+        if (off < 1e-30) break;
+        for (int p = 0; p < 3; p++) {
+            for (int q = p + 1; q < 4; q++) {
+                double apq = a[p][q];
+                if (apq == 0.0) continue;
+                double theta = (a[q][q] - a[p][p]) / (2.0 * apq);
+                double t = (theta >= 0 ? 1.0 : -1.0)
+                           / (std::fabs(theta) + std::sqrt(theta * theta + 1.0));
+                double c = 1.0 / std::sqrt(t * t + 1.0);
+                double s = t * c;
+                for (int k = 0; k < 4; k++) {
+                    double akp = a[k][p], akq = a[k][q];
+                    a[k][p] = c * akp - s * akq;
+                    a[k][q] = s * akp + c * akq;
+                }
+                for (int k = 0; k < 4; k++) {
+                    double apk = a[p][k], aqk = a[q][k];
+                    a[p][k] = c * apk - s * aqk;
+                    a[q][k] = s * apk + c * aqk;
+                }
+                for (int k = 0; k < 4; k++) {
+                    double vkp = v[k][p], vkq = v[k][q];
+                    v[k][p] = c * vkp - s * vkq;
+                    v[k][q] = s * vkp + c * vkq;
+                }
+            }
+        }
+    }
+}
+
+// Quaternion key matrix -> rq (3x3) from the correlation matrix m.
+static void qcp_rq_from_m(const double m[3][3], double rq[3][3]) {
+    double sxx = m[0][0], sxy = m[0][1], sxz = m[0][2];
+    double syx = m[1][0], syy = m[1][1], syz = m[1][2];
+    double szx = m[2][0], szy = m[2][1], szz = m[2][2];
+    double k4[4][4] = {
+        {sxx + syy + szz, syz - szy, szx - sxz, sxy - syx},
+        {syz - szy, sxx - syy - szz, sxy + syx, szx + sxz},
+        {szx - sxz, sxy + syx, -sxx + syy - szz, syz + szy},
+        {sxy - syx, szx + sxz, syz + szy, -sxx - syy + szz},
+    };
+    double vecs[4][4];
+    jacobi4(k4, vecs);
+    int best = 0;
+    for (int i = 1; i < 4; i++) if (k4[i][i] > k4[best][best]) best = i;
+    double q0 = vecs[0][best], q1 = vecs[1][best];
+    double q2 = vecs[2][best], q3 = vecs[3][best];
+    rq[0][0] = q0*q0 + q1*q1 - q2*q2 - q3*q3;
+    rq[0][1] = 2.0 * (q1*q2 - q0*q3);
+    rq[0][2] = 2.0 * (q1*q3 + q0*q2);
+    rq[1][0] = 2.0 * (q1*q2 + q0*q3);
+    rq[1][1] = q0*q0 - q1*q1 + q2*q2 - q3*q3;
+    rq[1][2] = 2.0 * (q2*q3 - q0*q1);
+    rq[2][0] = 2.0 * (q1*q3 - q0*q2);
+    rq[2][1] = 2.0 * (q2*q3 + q0*q1);
+    rq[2][2] = q0*q0 - q1*q1 - q2*q2 + q3*q3;
+}
+
+// Shared setup: weighted selection COM + unweighted correlation vs the
+// centered reference (weights=None rotation, the reference's RMSF.py:48
+// path).  Returns 0 or a negative error.
+static int qcp_setup(const float* coords, long n_atoms,
+                     const int64_t* sel, long n_sel, const double* sel_w,
+                     const double* ref_c,
+                     double com[3], double rq[3][3]) {
+    if (n_sel <= 0 || n_atoms <= 0) return -1;
+    double wsum = 0.0;
+    com[0] = com[1] = com[2] = 0.0;
+    for (long s = 0; s < n_sel; s++) {
+        int64_t i = sel[s];
+        if (i < 0 || i >= n_atoms) return -2;
+        double w = sel_w[s];
+        wsum += w;
+        const float* p = coords + (size_t)i * 3;
+        com[0] += w * p[0]; com[1] += w * p[1]; com[2] += w * p[2];
+    }
+    if (wsum == 0.0) return -3;
+    com[0] /= wsum; com[1] /= wsum; com[2] /= wsum;
+    double m[3][3] = {{0, 0, 0}, {0, 0, 0}, {0, 0, 0}};
+    for (long s = 0; s < n_sel; s++) {
+        const float* p = coords + (size_t)sel[s] * 3;
+        double d0 = p[0] - com[0], d1 = p[1] - com[1], d2 = p[2] - com[2];
+        const double* r = ref_c + (size_t)s * 3;
+        m[0][0] += d0 * r[0]; m[0][1] += d0 * r[1]; m[0][2] += d0 * r[2];
+        m[1][0] += d1 * r[0]; m[1][1] += d1 * r[1]; m[1][2] += d1 * r[2];
+        m[2][0] += d2 * r[0]; m[2][1] += d2 * r[1]; m[2][2] += d2 * r[2];
+    }
+    qcp_rq_from_m(m, rq);
+    return 0;
+}
+
+// Superpose one full frame: out (n_atoms,3) f64 = (coords - com)·rqᵀ + ref_com.
+// rot_out (9, optional) receives R = rqᵀ (the matrix for row-vector apply).
+int qcp_superpose_apply(const float* coords, long n_atoms,
+                        const int64_t* sel, long n_sel, const double* sel_w,
+                        const double* ref_c, const double* ref_com,
+                        double* out, double* rot_out) {
+    double com[3], rq[3][3];
+    int rc = qcp_setup(coords, n_atoms, sel, n_sel, sel_w, ref_c, com, rq);
+    if (rc != 0) return rc;
+    for (long i = 0; i < n_atoms; i++) {
+        const float* p = coords + (size_t)i * 3;
+        double d0 = p[0] - com[0], d1 = p[1] - com[1], d2 = p[2] - com[2];
+        double* o = out + (size_t)i * 3;
+        o[0] = d0 * rq[0][0] + d1 * rq[0][1] + d2 * rq[0][2] + ref_com[0];
+        o[1] = d0 * rq[1][0] + d1 * rq[1][1] + d2 * rq[1][2] + ref_com[1];
+        o[2] = d0 * rq[2][0] + d1 * rq[2][1] + d2 * rq[2][2] + ref_com[2];
+    }
+    if (rot_out != nullptr)
+        for (int j = 0; j < 3; j++)
+            for (int k = 0; k < 3; k++)
+                rot_out[j * 3 + k] = rq[k][j];
+    return 0;
+}
+
+// Superpose the selection only and fold it into the streaming Welford
+// state (the reference's pass-2 body, RMSF.py:124-138, in one native
+// pass): m2 += (k/(k+1))·(x−mean)²; mean = (k·mean+x)/(k+1).
+int qcp_superpose_moments(const float* coords, long n_atoms,
+                          const int64_t* sel, long n_sel, const double* sel_w,
+                          const double* ref_c, const double* ref_com,
+                          long k, double* mean, double* m2) {
+    double com[3], rq[3][3];
+    int rc = qcp_setup(coords, n_atoms, sel, n_sel, sel_w, ref_c, com, rq);
+    if (rc != 0) return rc;
+    double kf = (double)k, kp1 = kf + 1.0, ratio = kf / kp1;
+    for (long s = 0; s < n_sel; s++) {
+        const float* p = coords + (size_t)sel[s] * 3;
+        double d0 = p[0] - com[0], d1 = p[1] - com[1], d2 = p[2] - com[2];
+        double x[3];
+        x[0] = d0 * rq[0][0] + d1 * rq[0][1] + d2 * rq[0][2] + ref_com[0];
+        x[1] = d0 * rq[1][0] + d1 * rq[1][1] + d2 * rq[1][2] + ref_com[1];
+        x[2] = d0 * rq[2][0] + d1 * rq[2][1] + d2 * rq[2][2] + ref_com[2];
+        double* mu = mean + (size_t)s * 3;
+        double* mm = m2 + (size_t)s * 3;
+        for (int j = 0; j < 3; j++) {
+            double diff = x[j] - mu[j];
+            mm[j] += ratio * diff * diff;
+            mu[j] = (kf * mu[j] + x[j]) / kp1;
+        }
+    }
+    return 0;
+}
+
+}  // extern "C"

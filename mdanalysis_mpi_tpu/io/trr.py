@@ -103,6 +103,7 @@ def _scan(path: str):
     cached = _offsets.load(path)
     if cached is not None:
         return cached
+    mtime = os.path.getmtime(path)     # BEFORE the scan (_offsets.save)
     size = os.path.getsize(path)
     offsets = []
     natoms = -1
@@ -123,7 +124,7 @@ def _scan(path: str):
             offsets.append(pos)
             pos += h.frame_bytes
     offsets = np.asarray(offsets, dtype=np.int64)
-    _offsets.save(path, offsets, natoms)
+    _offsets.save(path, offsets, natoms, mtime)
     return offsets, natoms
 
 

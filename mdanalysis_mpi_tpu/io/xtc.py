@@ -34,6 +34,7 @@ def _scan(path: str):
     cached = _offsets.load(path)
     if cached is not None:
         return cached
+    mtime = os.path.getmtime(path)     # BEFORE the scan (_offsets.save)
     lib = native.load()
     natoms = ctypes.c_int(-1)
     n = lib.xtc_scan(path.encode(), ctypes.byref(natoms), None, 0)
@@ -44,7 +45,7 @@ def _scan(path: str):
                       offsets.ctypes.data_as(ctypes.c_void_p), n)
     if n2 != n:
         raise IOError(f"inconsistent XTC scan of {path!r}")
-    _offsets.save(path, offsets, natoms.value)
+    _offsets.save(path, offsets, natoms.value, mtime)
     return offsets, natoms.value
 
 

@@ -57,6 +57,36 @@ def weighted_center(x: np.ndarray, weights: np.ndarray) -> np.ndarray:
     return np.einsum("...ni,n->...i", x.astype(np.float64), w)
 
 
+def _native_host():
+    """The native QCP module, or None (build failure / MDTPU_NATIVE_HOST=0).
+
+    The reference's per-rank hot loop runs C (qcprot) + BLAS; the C++
+    kernels give this host backend — which doubles as the MPI-baseline
+    stand-in — the same native weight class (SURVEY.md §2.2).  The
+    NumPy implementations below stay as the fallback and the
+    differential-test twin.
+    """
+    import os
+
+    global _NATIVE
+    if _NATIVE is not None:
+        return _NATIVE or None
+    if os.environ.get("MDTPU_NATIVE_HOST", "1") in ("0", "false", "no"):
+        _NATIVE = False
+        return None
+    try:
+        from mdanalysis_mpi_tpu.io import native
+
+        native.load()
+        _NATIVE = native
+    except Exception:
+        _NATIVE = False
+    return _NATIVE or None
+
+
+_NATIVE = None
+
+
 def superpose_frame(
     coords: np.ndarray,            # (N, 3) one frame, all atoms
     sel_idx: np.ndarray,
@@ -68,10 +98,40 @@ def superpose_frame(
     """Per-frame superposition, the reference's hot-loop body shape
     (RMSF.py:92-101) without the in-place mutation.  Mass-weighted COM,
     unweighted rotation by default (RMSF.py:48 ``weights=None``)."""
+    native = _native_host()
+    if (native is not None and rot_weights is None
+            and coords.dtype == np.float32 and coords.flags.c_contiguous):
+        return native.qcp_superpose_apply(
+            coords, sel_idx, sel_weights, ref_sel_centered, ref_com)
     sel = coords[sel_idx].astype(np.float64)
     com = weighted_center(sel, sel_weights)
     r = qcp_rotation(sel - com, ref_sel_centered, rot_weights)
     return (coords.astype(np.float64) - com) @ r + ref_com
+
+
+def superpose_moments_frame(
+    coords: np.ndarray,            # (N, 3) one frame, all atoms (f32)
+    sel_idx: np.ndarray,
+    sel_weights: np.ndarray,
+    ref_sel_centered: np.ndarray,
+    ref_com: np.ndarray,
+    stream: "StreamingMoments",
+) -> None:
+    """Superpose the selection onto the reference and fold it into
+    ``stream`` — the reference's entire pass-2 body (RMSF.py:124-138)
+    as one call, native when available."""
+    native = _native_host()
+    if (native is not None and coords.dtype == np.float32
+            and coords.flags.c_contiguous):
+        native.qcp_superpose_moments(
+            coords, sel_idx, sel_weights, ref_sel_centered, ref_com,
+            stream.t, stream.mean, stream.m2)
+        stream.t += 1
+        return
+    sel = coords[sel_idx].astype(np.float64)
+    com = weighted_center(sel, sel_weights)
+    r = qcp_rotation(sel - com, ref_sel_centered)
+    stream.update((sel - com) @ r + ref_com)
 
 
 def minimum_image(disp: np.ndarray, box: np.ndarray | None) -> np.ndarray:

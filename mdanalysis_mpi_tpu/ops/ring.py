@@ -47,7 +47,8 @@ def ring_union_histogram(x_blk: jax.Array,    # (n_l, 3) local atom block
     p = jax.lax.axis_size(axis_name)
     me = jax.lax.axis_index(axis_name)
     n_l = x_blk.shape[0]
-    nbins = edges.shape[0] - 1
+    tile = min(tile, n_l)    # a tile wider than the rotating block is
+    nbins = edges.shape[0] - 1    # pure zero-weight padding FLOPs
     # rotating payload: B-side coords + weights, welded together
     rot0 = jnp.concatenate([x_blk, w_b[:, None]], axis=1)     # (n_l, 4)
 
