@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""Benchmark suite: one JSON line per BASELINE.json config (1-5).
+
+`bench.py` at the repo root is the driver's single headline metric
+(config 2); this suite covers the full config matrix on synthetic
+fixtures so regressions in any analysis path are visible:
+
+1. RMSF of Ca atoms, ADK-sized system (3341 atoms, 98 frames, DCD file)
+2. RMSF of all heavy atoms, 100k-atom solvated protein  (= bench.py)
+3. RMSD time series with superposition to frame 0
+4. InterRDF O-O, TIP3P-like water box
+5. Contact map over Ca (pairwise distance reduction)
+
+Each line: {"config", "metric", "value", "unit", "backend"} — value is
+frames/sec on the accelerator backend, median of BENCH_REPEATS runs.
+Timed regions are readback-free (synchronized on the raw device
+partials, ``analysis._last_total``), and ALL serial cross-checks run
+only after every config has been timed: on tunneled TPU targets a
+single device→host fetch collapses host→device throughput for the rest
+of the process (analysis/base.py Deferred rationale), so one early
+check would poison every later measurement.  Scale knob:
+BENCH_SUITE_SCALE (default 1.0) multiplies frame counts.
+"""
+
+import contextlib
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mdanalysis_mpi_tpu.core.universe import Universe            # noqa: E402
+from mdanalysis_mpi_tpu.analysis import (                        # noqa: E402
+    AlignedRMSF, ContactMap, InterRDF, RMSD,
+)
+from mdanalysis_mpi_tpu.io.dcd import write_dcd                  # noqa: E402
+from mdanalysis_mpi_tpu.testing import (                         # noqa: E402
+    make_protein_universe, make_water_universe,
+)
+
+SCALE = float(os.environ.get("BENCH_SUITE_SCALE", "1.0"))
+REPEATS = int(os.environ.get("BENCH_REPEATS", "3"))
+TOL = 1e-3
+
+
+def _timed(make_analysis, n_frames, run_kwargs):
+    """Median frames/sec over REPEATS accelerator runs.  Synchronizes on
+    the raw device partials — never on materialized results, which would
+    fetch (see module docstring).  Returns (fps, last_analysis)."""
+    import jax
+
+    make_analysis().run(**run_kwargs)              # compile warm-up
+    walls = []
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        a = make_analysis().run(**run_kwargs)
+        jax.block_until_ready(a._last_total)
+        walls.append(time.perf_counter() - t0)
+    return n_frames / float(np.median(walls)), a
+
+
+def config1(stack):
+    """ADK-sized Ca RMSF from an actual DCD file (I/O in the loop)."""
+    n_res = 3341 // 4
+    u0 = make_protein_universe(n_residues=n_res, n_frames=int(98 * SCALE),
+                               noise=0.3, seed=1)
+    tmp = stack.enter_context(tempfile.TemporaryDirectory())
+    dcd = os.path.join(tmp, "adk.dcd")
+    frames, _ = u0.trajectory.read_block(0, u0.trajectory.n_frames)
+    write_dcd(dcd, frames)
+    u = Universe(u0.topology, dcd)
+    fps, a = _timed(lambda: AlignedRMSF(u, select="name CA"),
+                    u.trajectory.n_frames, dict(backend="jax", batch_size=32))
+
+    def check():
+        s = AlignedRMSF(u, select="name CA").run(backend="serial")
+        err = float(np.abs(a.results.rmsf - s.results.rmsf).max())
+        assert err < TOL, f"config1 divergence {err}"
+
+    return {"config": 1, "metric": "Ca RMSF, 3341-atom ADK-size, DCD",
+            "value": round(fps, 2), "unit": "frames/s",
+            "backend": "jax"}, check
+
+
+def config2(stack):
+    """Headline config — defer to bench.py's number (same fixture)."""
+    del stack
+    return {"config": 2,
+            "metric": "heavy-atom RMSF, 100k atoms (see bench.py)",
+            "value": None, "unit": "frames/s", "backend": "jax"}, None
+
+
+def config3(stack):
+    del stack
+    u = make_protein_universe(n_residues=500, n_frames=int(256 * SCALE),
+                              noise=0.4, seed=3)
+    fps, a = _timed(lambda: RMSD(u.select_atoms("name CA")),
+                    u.trajectory.n_frames, dict(backend="jax", batch_size=64))
+
+    def check():
+        s = RMSD(u.select_atoms("name CA")).run(backend="serial")
+        err = float(np.abs(a.results.rmsd - s.results.rmsd).max())
+        assert err < TOL, f"config3 divergence {err}"
+
+    return {"config": 3, "metric": "superposed RMSD series, 2000 atoms",
+            "value": round(fps, 2), "unit": "frames/s",
+            "backend": "jax"}, check
+
+
+def config4(stack):
+    del stack
+    u = make_water_universe(n_waters=2000, n_frames=int(32 * SCALE), seed=4)
+    ow = u.select_atoms("name OW")
+    fps, a = _timed(lambda: InterRDF(ow, ow, nbins=75, range=(0.0, 10.0)),
+                    u.trajectory.n_frames, dict(backend="jax", batch_size=8))
+
+    def check():
+        s = InterRDF(ow, ow, nbins=75, range=(0.0, 10.0)).run(
+            backend="serial")
+        err = float(np.abs(a.results.rdf - s.results.rdf).max())
+        assert err < 0.05, f"config4 divergence {err}"
+
+    return {"config": 4, "metric": "O-O RDF, 2000-water box",
+            "value": round(fps, 2), "unit": "frames/s",
+            "backend": "jax"}, check
+
+
+def config5(stack):
+    del stack
+    u = make_protein_universe(n_residues=500, n_frames=int(128 * SCALE),
+                              noise=0.4, seed=5)
+    fps, a = _timed(lambda: ContactMap(u.select_atoms("name CA"), cutoff=8.0),
+                    u.trajectory.n_frames, dict(backend="jax", batch_size=32))
+
+    def check():
+        s = ContactMap(u.select_atoms("name CA"), cutoff=8.0).run(
+            backend="serial")
+        err = float(np.abs(a.results.contact_fraction
+                           - s.results.contact_fraction).max())
+        assert err < TOL, f"config5 divergence {err}"
+
+    return {"config": 5, "metric": "Ca contact map, 500 residues",
+            "value": round(fps, 2), "unit": "frames/s",
+            "backend": "jax"}, check
+
+
+def main():
+    with contextlib.ExitStack() as stack:
+        rows = []
+        for fn in (config1, config2, config3, config4, config5):
+            try:
+                rows.append(fn(stack))
+            except Exception as e:                 # keep the suite going
+                rows.append(({"config": fn.__name__, "error": str(e)}, None))
+        # checks LAST: the first result fetch collapses the tunnel
+        for rec, check in rows:
+            if check is not None:
+                try:
+                    check()
+                except Exception as e:
+                    rec["check_error"] = str(e)
+            print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -220,6 +220,10 @@ class AnalysisBase:
         with TIMERS.phase("execute"):
             total = executor.execute(self, self._universe.trajectory, frames,
                                      batch_size=batch_size)
+        # raw partials handle: a fetch-free synchronization point for
+        # benchmarks (jax.block_until_ready drains the device queue
+        # without the readback that collapses tunneled links)
+        self._last_total = total
         with TIMERS.phase("conclude"):
             self._conclude(total)
         if self._verbose:

@@ -272,6 +272,7 @@ class AlignedRMSF(AnalysisBase):
         moments_pass.run(start, stop, step, backend=backend,
                          batch_size=batch_size, **kwargs)
         t, mean, m2 = moments_pass._total
+        self._last_total = moments_pass._total    # fetch-free sync point
         self.n_frames = moments_pass.n_frames
         # all results may be device-resident; Results materializes on
         # user access (run() itself must stay readback-free — a single

@@ -17,6 +17,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import os
 import sys
 import time
 
@@ -118,6 +119,9 @@ def _parser() -> argparse.ArgumentParser:
     p.add_argument("--r-max", type=float, default=15.0)
     p.add_argument("--cutoff", type=float, default=8.0)
     p.add_argument("--output", default=None, help="write results to .npz")
+    p.add_argument("--trace", default=None, metavar="DIR",
+                   help="write a jax.profiler trace (TensorBoard format) "
+                        "of the run to DIR")
     return p
 
 
@@ -131,13 +135,17 @@ def main(argv=None) -> int:
         step=ns.step, ref_frame=ns.ref_frame, backend=ns.backend,
         batch_size=ns.batch_size, transfer_dtype=ns.transfer_dtype,
         nbins=ns.nbins, r_max=ns.r_max, cutoff=ns.cutoff, output=ns.output)
+    from mdanalysis_mpi_tpu.utils.timers import device_trace
+
     TIMERS.reset()
     t0 = time.perf_counter()
-    a = run_config(cfg)
-    # force deferred finalizers + device fetches (also surfaces deferred
-    # validation errors) before filtering for serializable arrays — inside
-    # the timed window so wall_s stays an honest end-to-end number
-    a.results.materialize()
+    with device_trace(ns.trace or os.environ.get("MDTPU_TRACE")):
+        a = run_config(cfg)
+        # force deferred finalizers + device fetches (also surfaces
+        # deferred validation errors) before filtering for serializable
+        # arrays — inside the timed window so wall_s stays an honest
+        # end-to-end number
+        a.results.materialize()
     wall = time.perf_counter() - t0
     arrays = {k: np.asarray(v) for k, v in a.results.items()
               if isinstance(v, (np.ndarray, list, tuple, float, int))

@@ -70,3 +70,22 @@ class PhaseTimers:
 #: Process-global default registry.  Executors and ``AnalysisBase.run``
 #: record into this unless handed an explicit ``PhaseTimers``.
 TIMERS = PhaseTimers()
+
+
+@contextmanager
+def device_trace(trace_dir: str | None):
+    """Optional ``jax.profiler`` trace around a region (SURVEY.md §5.1
+    "optional jax.profiler trace hooks").
+
+    ``trace_dir`` None → no-op.  Otherwise writes a TensorBoard-loadable
+    trace (host + device timelines) under ``trace_dir``; view with
+    ``tensorboard --logdir <dir>`` or xprof.  Env twin: callers pass
+    ``os.environ.get("MDTPU_TRACE")`` — the CLI's ``--trace`` flag does.
+    """
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(trace_dir):
+        yield
