@@ -85,6 +85,27 @@ class Universe:
             raise TypeError(f"{type(traj).__name__} does not support copy()")
         return Universe(self.topology, traj.reopen())
 
+    def transfer_to_memory(self, start=None, stop=None, step=None) -> None:
+        """Replace the trajectory with an in-memory copy (upstream's
+        ``Universe.transfer_to_memory`` idiom, the explicit form of the
+        serial oracle's ``in_memory=True``, RMSF.py:12).
+
+        Decodes frames ``[start:stop:step]`` once via the bulk block
+        reader; afterwards every pass is a RAM slice — the host-side
+        analog of the HBM block cache used on the device path.
+        """
+        n = self.trajectory.n_frames
+        frames = range(*slice(start, stop, step).indices(n))
+        if len(frames) == 0:
+            raise ValueError(
+                f"transfer_to_memory[{start}:{stop}:{step}] selects no "
+                f"frames (trajectory has {n})")
+        coords, boxes = self.trajectory.read_block(
+            frames.start, frames.stop, step=frames.step)
+        times = self.trajectory.frame_times(frames)
+        self.trajectory.close()
+        self.trajectory = MemoryReader(coords, dimensions=boxes, times=times)
+
     @property
     def dimensions(self):
         return self.trajectory.ts.dimensions

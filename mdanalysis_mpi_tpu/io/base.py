@@ -60,9 +60,10 @@ class ReaderBase:
         return self[0]
 
     def read_block(self, start: int, stop: int,
-                   sel: np.ndarray | None = None
+                   sel: np.ndarray | None = None, step: int = 1
                    ) -> tuple[np.ndarray, np.ndarray | None]:
-        """Bulk-read frames [start, stop) → (positions (B,S,3) f32, boxes).
+        """Bulk-read frames [start:stop:step] → (positions (B,S,3) f32,
+        boxes).
 
         ``sel`` (optional int index array) gathers a subset of atoms
         during the read — one copy instead of read-then-gather, which
@@ -73,11 +74,14 @@ class ReaderBase:
         """
         if not 0 <= start <= stop <= self.n_frames:
             raise IndexError(f"block [{start},{stop}) out of range [0,{self.n_frames}]")
-        b = stop - start
+        if step < 1:
+            raise ValueError(f"step must be >= 1, got {step}")
+        frames = range(start, stop, step)
+        b = len(frames)
         n = self.n_atoms if sel is None else len(sel)
         out = np.empty((b, n, 3), dtype=np.float32)
         boxes = None
-        for j, i in enumerate(range(start, stop)):
+        for j, i in enumerate(frames):
             ts = self._read_frame(i)
             out[j] = ts.positions if sel is None else ts.positions[sel]
             if ts.dimensions is not None:
@@ -87,6 +91,13 @@ class ReaderBase:
                     boxes = np.zeros((b, 6), dtype=np.float32)
                 boxes[j] = ts.dimensions
         return out, boxes
+
+    def frame_times(self, frames) -> np.ndarray | None:
+        """Per-frame times for ``frames`` (an iterable of indices), or
+        None when the format carries no time metadata the reader can
+        fetch without decoding coordinates.  Used by
+        ``Universe.transfer_to_memory`` to preserve times."""
+        return None
 
     def stage_block(self, start: int, stop: int,
                     sel: np.ndarray | None = None, quantize: bool = False):

@@ -85,14 +85,16 @@ class DCDReader(ReaderBase):
         dims = _cell_to_dimensions(box[0]) if box is not None else None
         return Timestep(coords[0], frame=i, time=float(i), dimensions=dims)
 
-    def read_block(self, start: int, stop: int, sel=None):
+    def read_block(self, start: int, stop: int, sel=None, step: int = 1):
         if not 0 <= start <= stop <= self.n_frames:
             raise IndexError(
                 f"block [{start},{stop}) out of range [0,{self.n_frames}]")
+        if step < 1:
+            raise ValueError(f"step must be >= 1, got {step}")
         if start == stop:
             n = self._natoms if sel is None else len(sel)
             return np.empty((0, n, 3), np.float32), None
-        coords, box = self._read_range(np.arange(start, stop))
+        coords, box = self._read_range(np.arange(start, stop, step))
         if sel is not None:
             coords = np.ascontiguousarray(coords[:, sel])
         boxes = (np.stack([_cell_to_dimensions(b) for b in box])

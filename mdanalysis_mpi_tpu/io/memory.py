@@ -25,7 +25,7 @@ class MemoryReader(ReaderBase):
 
     def __init__(self, coordinates: np.ndarray,
                  dimensions: np.ndarray | None = None,
-                 dt: float = 1.0):
+                 dt: float = 1.0, times: np.ndarray | None = None):
         coords = np.asarray(coordinates, dtype=np.float32)
         if coords.ndim == 2:
             coords = coords[None]
@@ -43,6 +43,12 @@ class MemoryReader(ReaderBase):
                     f"dimensions must be (n_frames, 6), got {dimensions.shape}")
         self._dims = dimensions
         self._dt = float(dt)
+        if times is not None:
+            times = np.asarray(times, dtype=np.float64)
+            if times.shape != (coords.shape[0],):
+                raise ValueError(
+                    f"times must be ({coords.shape[0]},), got {times.shape}")
+        self._times = times
 
     @property
     def n_frames(self) -> int:
@@ -58,22 +64,33 @@ class MemoryReader(ReaderBase):
         return self._coords
 
     def _read_frame(self, i: int) -> Timestep:
-        return Timestep(self._coords[i].copy(), frame=i, time=i * self._dt,
+        t = (i * self._dt) if self._times is None else float(self._times[i])
+        return Timestep(self._coords[i].copy(), frame=i, time=t,
                         dimensions=None if self._dims is None else self._dims[i].copy())
 
     def reopen(self) -> "MemoryReader":
         """Independent cursor over the same backing array (zero-copy),
         supporting ``Universe.copy()`` (RMSF.py:57 semantics)."""
-        return MemoryReader(self._coords, self._dims, self._dt)
+        return MemoryReader(self._coords, self._dims, self._dt,
+                            times=self._times)
 
-    def read_block(self, start: int, stop: int, sel=None):
+    def frame_times(self, frames) -> np.ndarray:
+        idx = np.asarray(list(frames), dtype=np.int64)
+        if self._times is not None:
+            return self._times[idx]
+        return idx.astype(np.float64) * self._dt
+
+    def read_block(self, start: int, stop: int, sel=None, step: int = 1):
         if not 0 <= start <= stop <= self.n_frames:
             raise IndexError(f"block [{start},{stop}) out of range [0,{self.n_frames}]")
-        boxes = None if self._dims is None else self._dims[start:stop].copy()
+        if step < 1:
+            raise ValueError(f"step must be >= 1, got {step}")
+        boxes = (None if self._dims is None
+                 else self._dims[start:stop:step].copy())
         if sel is None:
-            return self._coords[start:stop].copy(), boxes
+            return self._coords[start:stop:step].copy(), boxes
         # slice + advanced index = a single gather copy
-        return self._coords[start:stop, sel], boxes
+        return self._coords[start:stop:step, sel], boxes
 
     def stage_block(self, start: int, stop: int, sel=None,
                     quantize: bool = False):

@@ -185,10 +185,23 @@ class TRRReader(ReaderBase):
         t = float(np.frombuffer(buf, fl, 1, _HEAD_BYTES)[0])
         return Timestep(coords, frame=i, time=t, dimensions=dims)
 
-    def read_block(self, start: int, stop: int, sel=None):
+    def frame_times(self, frames) -> np.ndarray:
+        idx = np.asarray(list(frames), dtype=np.int64)
+        times = np.empty(len(idx), dtype=np.float64)
+        for j, i in enumerate(idx):
+            self._file.seek(int(self._offsets[i]))
+            head = self._file.read(_HEAD_BYTES + 16)
+            h = _parse_header(head, 0, self._path)
+            fl = ">f4" if h.flsize == 4 else ">f8"
+            times[j] = np.frombuffer(head, fl, 1, _HEAD_BYTES)[0]
+        return times
+
+    def read_block(self, start: int, stop: int, sel=None, step: int = 1):
         if not 0 <= start <= stop <= self.n_frames:
             raise IndexError(
                 f"block [{start},{stop}) out of range [0,{self.n_frames}]")
+        if step < 1:
+            raise ValueError(f"step must be >= 1, got {step}")
         n_out = self._natoms if sel is None else len(sel)
         if start == stop:
             return np.empty((0, n_out, 3), np.float32), None
@@ -201,9 +214,10 @@ class TRRReader(ReaderBase):
             buf = self._file.read(nbytes)
         else:
             buf = self._file.read()
-        out = np.empty((stop - start, n_out, 3), dtype=np.float32)
+        frames = range(start, stop, step)
+        out = np.empty((len(frames), n_out, 3), dtype=np.float32)
         boxes = None
-        for j, i in enumerate(range(start, stop)):
+        for j, i in enumerate(frames):
             base = int(self._offsets[i]) - first
             # header fields parsed at `base` yield offsets relative to buf
             h = _parse_header(buf, base, self._path)
@@ -216,7 +230,7 @@ class TRRReader(ReaderBase):
             out[j] = (frame if sel is None else frame[sel])
             if h.sizes["box_size"]:
                 if boxes is None:
-                    boxes = np.zeros((stop - start, 6), dtype=np.float32)
+                    boxes = np.zeros((len(frames), 6), dtype=np.float32)
                 vecs = np.frombuffer(buf, fl, 9, h.payload_start)
                 boxes[j] = vectors_to_box(
                     vecs.astype(np.float64).reshape(3, 3) * _NM_TO_A)

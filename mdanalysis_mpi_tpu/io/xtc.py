@@ -95,14 +95,27 @@ class XTCReader(ReaderBase):
         return Timestep(coords[0], frame=i, time=float(times[0]),
                         dimensions=dims)
 
-    def read_block(self, start: int, stop: int, sel=None):
+    def frame_times(self, frames) -> np.ndarray:
+        # XTC frame layout: magic, natoms, step, time (XDR big-endian) —
+        # time sits 12 bytes into each frame, readable without decoding
+        idx = np.asarray(list(frames), dtype=np.int64)
+        times = np.empty(len(idx), dtype=np.float64)
+        with open(self._path, "rb") as f:
+            for j, i in enumerate(idx):
+                f.seek(int(self._offsets[i]) + 12)
+                times[j] = np.frombuffer(f.read(4), ">f4")[0]
+        return times
+
+    def read_block(self, start: int, stop: int, sel=None, step: int = 1):
         if not 0 <= start <= stop <= self.n_frames:
             raise IndexError(
                 f"block [{start},{stop}) out of range [0,{self.n_frames}]")
+        if step < 1:
+            raise ValueError(f"step must be >= 1, got {step}")
         if start == stop:
             n = self._natoms if sel is None else len(sel)
             return np.empty((0, n, 3), np.float32), None
-        coords, box, _, _ = self._read_range(np.arange(start, stop))
+        coords, box, _, _ = self._read_range(np.arange(start, stop, step))
         if sel is not None:
             coords = np.ascontiguousarray(coords[:, sel])
         boxes = np.stack([

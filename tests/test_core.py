@@ -90,3 +90,53 @@ def test_atomgroup_positions_setter():
     # next read restores
     u.trajectory[0]
     assert not np.allclose(ca.positions, 0.0)
+
+
+def test_transfer_to_memory():
+    """Universe.transfer_to_memory: the upstream in_memory idiom — file
+    (or any) trajectory replaced by a RAM copy, frames/boxes intact."""
+    from mdanalysis_mpi_tpu.io.memory import MemoryReader
+    from mdanalysis_mpi_tpu.testing import make_water_universe
+
+    u = make_water_universe(n_waters=20, n_frames=5, seed=2)
+    before = [u.trajectory[i].positions.copy() for i in range(5)]
+    dims_before = u.trajectory[0].dimensions.copy()
+    u.transfer_to_memory()
+    assert isinstance(u.trajectory, MemoryReader)
+    assert u.trajectory.n_frames == 5
+    for i in range(5):
+        np.testing.assert_array_equal(u.trajectory[i].positions, before[i])
+    np.testing.assert_allclose(u.trajectory[0].dimensions, dims_before)
+
+    # windowed + strided form preserves per-frame times
+    u2 = make_water_universe(n_waters=20, n_frames=6, seed=3)
+    expect = [u2.trajectory[i].positions.copy() for i in (1, 3, 5)]
+    u2.transfer_to_memory(start=1, stop=6, step=2)
+    assert u2.trajectory.n_frames == 3
+    for j, (i, x) in enumerate(zip((1, 3, 5), expect)):
+        np.testing.assert_array_equal(u2.trajectory[j].positions, x)
+        assert u2.trajectory[j].time == pytest.approx(float(i))
+
+    # empty windows fail loudly instead of leaving a 0-frame universe
+    u3 = make_water_universe(n_waters=20, n_frames=4, seed=4)
+    with pytest.raises(ValueError, match="no .*frames"):
+        u3.transfer_to_memory(start=4)
+    assert u3.trajectory.n_frames == 4          # untouched
+
+
+def test_transfer_to_memory_preserves_file_times(tmp_path):
+    """XTC frame times survive transfer_to_memory (read from the frame
+    headers without a coordinate decode)."""
+    from mdanalysis_mpi_tpu.io.xtc import write_xtc
+    from mdanalysis_mpi_tpu.testing import make_protein_universe
+
+    src = make_protein_universe(n_residues=4, n_frames=5, seed=5)
+    coords = src.trajectory.read_block(0, 5)[0]
+    path = str(tmp_path / "t.xtc")
+    times = np.array([0.0, 2.5, 5.0, 7.5, 10.0], np.float32)
+    write_xtc(path, coords, times=times)
+    u = Universe(src.topology, path)
+    u.transfer_to_memory(step=2)
+    assert u.trajectory.n_frames == 3
+    for j, t in enumerate((0.0, 5.0, 10.0)):
+        assert u.trajectory[j].time == pytest.approx(t)
