@@ -106,12 +106,25 @@ class AtomGroup:
     def total_mass(self) -> float:
         return float(self.masses.sum())
 
+    def radius_of_gyration(self) -> float:
+        """Mass-weighted radius of gyration, float64 (upstream
+        ``AtomGroup.radius_of_gyration``): sqrt(Σ mᵢ·|rᵢ−COM|² / Σ mᵢ)."""
+        m = self.masses
+        d = self.positions.astype(np.float64) - self.center_of_mass()
+        return float(np.sqrt((m * (d ** 2).sum(axis=1)).sum() / m.sum()))
+
     # ---- refinement & set algebra ----
 
     def select_atoms(self, selection: str) -> "AtomGroup":
         """Select within this group (indices stay sorted/unique)."""
         from mdanalysis_mpi_tpu.core.selection import select_mask
-        mask = select_mask(self._universe.topology, selection)
+
+        def coords():
+            ts = self._universe.trajectory.ts
+            return ts.positions, ts.dimensions
+
+        mask = select_mask(self._universe.topology, selection,
+                           positions=coords)
         return AtomGroup(self._universe,
                          self._indices[mask[self._indices]])
 

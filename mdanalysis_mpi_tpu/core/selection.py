@@ -12,7 +12,7 @@ Grammar (recursive descent)::
     expr     := and_expr ('or' and_expr)*
     and_expr := not_expr ('and' not_expr)*
     not_expr := 'not' not_expr | primary
-    primary  := '(' expr ')' | keyword
+    primary  := '(' expr ')' | 'around' number not_expr | keyword
     keyword  := 'all' | 'none' | 'protein' | 'backbone' | 'nucleic'
               | 'nucleicbackbone' | 'water' | 'hydrogen' | 'heavy'
               | ('name'|'resname'|'segid'|'element'|'type') value+
@@ -21,6 +21,13 @@ Grammar (recursive descent)::
               | 'prop' ('mass'|'charge') cmp number
     value    := token with optional fnmatch globs (* ?)
     range    := N | N:M | N-M        (inclusive, MDAnalysis convention)
+
+``around R inner`` selects atoms within R Å of any atom matching
+``inner`` (minimum-image under the current box when one is present),
+excluding ``inner`` itself — upstream's geometric AroundSelection.  It
+is the one keyword that needs coordinates: masks are evaluated against
+the Universe's *current* frame, so re-select after seeking if the
+geometry matters (upstream behaves the same way).
 
 Supported keyword semantics follow the documented MDAnalysis selection
 language for this subset; ``heavy`` = ``not hydrogen`` covers BASELINE
@@ -42,7 +49,7 @@ _RESERVED = {
     "all", "none", "protein", "backbone", "nucleic", "nucleicbackbone",
     "water", "hydrogen", "heavy",
     "name", "resname", "segid", "element", "type", "resid", "resnum",
-    "index", "bynum", "prop",
+    "index", "bynum", "prop", "around",
 }
 
 _TOKEN_RE = re.compile(r"\(|\)|[^\s()]+")
@@ -55,12 +62,24 @@ class SelectionError(ValueError):
 
 
 class _Parser:
-    def __init__(self, text: str, top: Topology):
+    def __init__(self, text: str, top: Topology,
+                 positions: np.ndarray | None = None,
+                 box: np.ndarray | None = None):
         self.tokens = _TOKEN_RE.findall(text)
         if not self.tokens:
             raise SelectionError(f"empty selection string: {text!r}")
         self.pos = 0
         self.top = top
+        # (n_atoms, 3) current frame + (6,) box — may be a zero-arg
+        # callable so topology-only selections never force a frame
+        # decode (resolved lazily the first time 'around' needs them)
+        self._positions = positions
+        self._box = box
+
+    def _coords(self):
+        if callable(self._positions):
+            self._positions, self._box = self._positions()
+        return self._positions, self._box
 
     def peek(self) -> str | None:
         return self.tokens[self.pos] if self.pos < len(self.tokens) else None
@@ -108,6 +127,15 @@ class _Parser:
             if self.next() != ")":
                 raise SelectionError("unbalanced parentheses")
             return mask
+        if tok == "around":
+            try:
+                cutoff = float(self.next())
+            except ValueError as e:
+                raise SelectionError(
+                    f"'around' needs a numeric cutoff: {e}") from e
+            if cutoff < 0:
+                raise SelectionError(f"negative 'around' cutoff {cutoff}")
+            return self._around(cutoff, self.not_expr())
         if tok == "all":
             return np.ones(t.n_atoms, dtype=bool)
         if tok == "none":
@@ -139,6 +167,44 @@ class _Parser:
         if tok == "prop":
             return self._prop()
         raise SelectionError(f"unknown selection keyword {tok!r}")
+
+    def _around(self, cutoff: float, inner: np.ndarray) -> np.ndarray:
+        """Atoms within ``cutoff`` of any atom in ``inner`` (exclusive).
+
+        Blockwise minimum-image distances (never materializes the full
+        N×M matrix — the same discipline as the device pair kernels,
+        SURVEY.md §5.7), float32, on host: selections are a setup-time
+        operation, not a hot path.
+        """
+        positions, box = self._coords()
+        if positions is None:
+            raise SelectionError(
+                "'around' is a geometric selection and needs coordinates; "
+                "select through a Universe/AtomGroup (not bare select_mask "
+                "on a Topology)")
+        if not inner.any():
+            return np.zeros_like(inner)
+        from mdanalysis_mpi_tpu.ops.host import minimum_image
+
+        pos = np.asarray(positions, dtype=np.float32)
+        ref = pos[inner]
+        c2 = np.float32(cutoff * cutoff)
+        box = None if box is None else np.asarray(box, np.float64)
+        within = np.zeros(len(pos), dtype=bool)
+        # block sizes bound the peak temporaries: minimum_image upcasts
+        # to f64, so each (A, B, 3) block costs ~A·B·24 B ≈ 25 MB here
+        A_CHUNK, B_CHUNK = 2048, 512
+        for a0 in range(0, len(pos), A_CHUNK):
+            chunk = pos[a0:a0 + A_CHUNK]
+            hit = np.zeros(len(chunk), dtype=bool)
+            for b0 in range(0, len(ref), B_CHUNK):
+                rc = ref[b0:b0 + B_CHUNK]
+                disp = chunk[:, None, :] - rc[None, :, :]
+                disp = minimum_image(disp, box)
+                d2 = np.einsum("abi,abi->ab", disp, disp)
+                hit |= (d2 <= c2).any(axis=1)
+            within[a0:a0 + A_CHUNK] = hit
+        return within & ~inner
 
     # -- leaf matchers --
 
@@ -201,15 +267,25 @@ class _Parser:
         return ops[op](arr, val)
 
 
-def select_mask(top: Topology, selection: str) -> np.ndarray:
-    """Parse ``selection`` against ``top`` → boolean mask (n_atoms,)."""
-    return _Parser(selection, top).parse()
+def select_mask(top: Topology, selection: str,
+                positions: np.ndarray | None = None,
+                box: np.ndarray | None = None) -> np.ndarray:
+    """Parse ``selection`` against ``top`` → boolean mask (n_atoms,).
+
+    ``positions``/``box`` (the current frame) enable the geometric
+    keywords (``around``); topology-only selections ignore them.
+    ``positions`` may be a zero-arg callable returning ``(positions,
+    box)`` — evaluated lazily only if a geometric keyword is reached.
+    """
+    return _Parser(selection, top, positions=positions, box=box).parse()
 
 
-def select(top: Topology, selection: str) -> np.ndarray:
+def select(top: Topology, selection: str,
+           positions: np.ndarray | None = None,
+           box: np.ndarray | None = None) -> np.ndarray:
     """Parse ``selection`` → sorted static index array (int64).
 
     This is the once-only compilation step that replaces the reference's
     3×-per-frame ``select_atoms`` calls (RMSF.py:126,137,138, quirk Q3).
     """
-    return np.flatnonzero(select_mask(top, selection))
+    return np.flatnonzero(select_mask(top, selection, positions, box))

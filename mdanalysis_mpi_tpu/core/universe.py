@@ -68,9 +68,15 @@ class Universe:
 
         Parsed once per call; analyses cache the resulting index array in
         ``_prepare`` instead of re-selecting per frame (fixes quirk Q3).
+        Geometric keywords (``around``) see the current frame — fetched
+        lazily, so topology-only selections never decode one.
         """
+        def coords():
+            ts = self.trajectory.ts
+            return ts.positions, ts.dimensions
+
         return AtomGroup(self, np.flatnonzero(
-            select_mask(self.topology, selection)))
+            select_mask(self.topology, selection, positions=coords)))
 
     def copy(self) -> "Universe":
         """Clone with an independent trajectory cursor (RMSF.py:57).

@@ -85,6 +85,11 @@ class DCDReader(ReaderBase):
         dims = _cell_to_dimensions(box[0]) if box is not None else None
         return Timestep(coords[0], frame=i, time=float(i), dimensions=dims)
 
+    def frame_times(self, frames) -> np.ndarray:
+        # matches _read_frame's time=float(i) so transfer_to_memory
+        # preserves exactly what direct reads report
+        return np.asarray(list(frames), dtype=np.float64)
+
     def read_block(self, start: int, stop: int, sel=None, step: int = 1):
         if not 0 <= start <= stop <= self.n_frames:
             raise IndexError(

@@ -81,6 +81,26 @@ def _jit_kernel(f):
     return fn
 
 
+def _uniform_stride(frames) -> int | None:
+    """The constant positive stride of ``frames``, or None.  Strided
+    windows (``run(step=N)``) then ride the readers' bulk ``read_block``
+    instead of per-frame reads."""
+    if len(frames) < 2:
+        return 1
+    step = frames[1] - frames[0]
+    if step < 1:
+        return None
+    if frames[-1] - frames[0] == step * (len(frames) - 1):
+        it = iter(frames)
+        prev = next(it)
+        for f in it:
+            if f - prev != step:
+                return None
+            prev = f
+        return step
+    return None
+
+
 def _stage(reader, frames: list[int], sel_idx):
     """Read ``frames`` → (float32 (b, S, 3), boxes (b, 6) or None) with
     the selection gather pushed into the reader (one copy; slashes host
@@ -88,9 +108,10 @@ def _stage(reader, frames: list[int], sel_idx):
     if len(frames) == 0:
         n = reader.n_atoms if sel_idx is None else len(sel_idx)
         return np.empty((0, n, 3), dtype=np.float32), None
-    contiguous = frames[-1] - frames[0] + 1 == len(frames)
-    if contiguous:
-        return reader.read_block(frames[0], frames[-1] + 1, sel=sel_idx)
+    stride = _uniform_stride(frames)
+    if stride is not None:
+        return reader.read_block(frames[0], frames[-1] + 1, sel=sel_idx,
+                                 step=stride)
     tss = [reader[i] for i in frames]
     block = np.stack([ts.positions for ts in tss])
     # per-frame optional boxes: zeros for boxless frames, None only when

@@ -86,11 +86,13 @@ def test_self_distance_array_jax_backend():
 
 
 def test_stage_mixed_boxes_strided():
-    """Strided (non-contiguous) staging over a trajectory where only
-    some frames carry a box must not crash or drop PBC."""
+    """Irregularly-strided staging over a trajectory where only some
+    frames carry a box must not crash or drop PBC.  (Uniform strides now
+    ride the readers' bulk ``read_block(step=...)``; the per-frame path
+    here is reached by NON-uniform frame lists.)"""
     from mdanalysis_mpi_tpu.core.timestep import Timestep
     from mdanalysis_mpi_tpu.io.memory import MemoryReader
-    from mdanalysis_mpi_tpu.parallel.executors import _stage
+    from mdanalysis_mpi_tpu.parallel.executors import _stage, _uniform_stride
 
     class MixedBoxReader(MemoryReader):
         def _read_frame(self, i):
@@ -99,15 +101,35 @@ def test_stage_mixed_boxes_strided():
                 ts.dimensions = None      # boxless even frames
             return ts
 
-    coords = RNG.normal(size=(6, 4, 3)).astype(np.float32)
-    dims = np.tile(np.array([9, 9, 9, 90, 90, 90], np.float32), (6, 1))
+    coords = RNG.normal(size=(8, 4, 3)).astype(np.float32)
+    dims = np.tile(np.array([9, 9, 9, 90, 90, 90], np.float32), (8, 1))
     r = MixedBoxReader(coords, dimensions=dims)
-    block, boxes = _stage(r, [0, 1, 3], None)       # non-contiguous
+    assert _uniform_stride([0, 1, 3]) is None
+    block, boxes = _stage(r, [0, 1, 3], None)       # non-uniform stride
     assert block.shape == (3, 4, 3)
     np.testing.assert_array_equal(boxes[0], 0.0)    # boxless -> zeros
     np.testing.assert_allclose(boxes[1][:3], 9.0)
-    block2, boxes2 = _stage(r, [0, 2, 4], None)     # all boxless
+    assert _uniform_stride([0, 2, 6]) is None
+    block2, boxes2 = _stage(r, [0, 2, 6], None)     # all boxless
     assert boxes2 is None
+
+
+def test_stage_uniform_stride_uses_bulk_reader():
+    """step=N frame lists take the bulk read_block path and match the
+    per-frame reference."""
+    from mdanalysis_mpi_tpu.io.memory import MemoryReader
+    from mdanalysis_mpi_tpu.parallel.executors import _stage, _uniform_stride
+
+    coords = RNG.normal(size=(9, 5, 3)).astype(np.float32)
+    dims = np.tile(np.array([7, 7, 7, 90, 90, 90], np.float32), (9, 1))
+    r = MemoryReader(coords, dimensions=dims)
+    assert _uniform_stride([1, 4, 7]) == 3
+    block, boxes = _stage(r, [1, 4, 7], None)
+    np.testing.assert_array_equal(block, coords[[1, 4, 7]])
+    np.testing.assert_allclose(boxes[:, :3], 7.0)
+    sel = np.array([0, 4])
+    blk_sel, _ = _stage(r, [0, 2, 4], sel)
+    np.testing.assert_array_equal(blk_sel, coords[[0, 2, 4]][:, sel])
 
 
 def test_pair_histogram_blockwise_vs_numpy():

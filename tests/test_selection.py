@@ -98,3 +98,77 @@ def test_subgroup_selection_and_set_ops():
     assert both.n_atoms == 8
     assert (ca & prot).n_atoms == 4
     assert (prot - ca).n_atoms == prot.n_atoms - 4
+
+
+class TestAroundSelection:
+    def _universe(self):
+        from mdanalysis_mpi_tpu.core.topology import Topology
+        from mdanalysis_mpi_tpu.core.universe import Universe
+        from mdanalysis_mpi_tpu.io.memory import MemoryReader
+
+        # 3 "protein" CA atoms at x=0, plus waters at controlled distances
+        names = np.array(["CA", "CA", "CA", "OW", "OW", "OW"])
+        resnames = np.array(["ALA", "ALA", "ALA", "SOL", "SOL", "SOL"])
+        resids = np.array([1, 2, 3, 4, 5, 6])
+        top = Topology(names=names, resnames=resnames, resids=resids)
+        pos = np.array([
+            [0.0, 0.0, 0.0],
+            [0.0, 3.0, 0.0],
+            [0.0, 6.0, 0.0],
+            [2.0, 0.0, 0.0],     # 2 A from CA1 -> inside 3 A
+            [5.0, 0.0, 0.0],     # 5 A -> outside 3 A
+            [19.0, 0.0, 0.0],    # 19 A, but 1 A via PBC (box 20)
+        ], dtype=np.float32)
+        dims = np.array([20, 20, 20, 90, 90, 90], np.float32)
+        return Universe(top, MemoryReader(pos[None], dimensions=dims))
+
+    def test_around_basic_and_exclusion(self):
+        u = self._universe()
+        near = u.select_atoms("around 3.0 protein")
+        # CA atoms themselves are excluded; OW at 2 A and (via PBC) 1 A hit
+        assert list(near.indices) == [3, 5]
+
+    def test_around_respects_minimum_image(self):
+        u = self._universe()
+        # without the box the 19 A water would be outside; with it, inside
+        far = u.select_atoms("around 3.0 protein")
+        assert 5 in far.indices
+
+    def test_around_composes_with_booleans(self):
+        u = self._universe()
+        ag = u.select_atoms("resname SOL and around 3.0 protein")
+        assert list(ag.indices) == [3, 5]
+        none = u.select_atoms("protein and around 3.0 protein")
+        assert none.n_atoms == 0                # exclusion of the inner set
+
+    def test_around_requires_coordinates(self):
+        from mdanalysis_mpi_tpu.core.selection import SelectionError, select_mask
+
+        u = self._universe()
+        with pytest.raises(SelectionError, match="coordinates"):
+            select_mask(u.topology, "around 3.0 protein")
+
+    def test_around_bad_cutoff(self):
+        from mdanalysis_mpi_tpu.core.selection import SelectionError
+
+        u = self._universe()
+        with pytest.raises(SelectionError, match="numeric cutoff"):
+            u.select_atoms("around protein")
+        with pytest.raises(SelectionError, match="negative"):
+            u.select_atoms("around -1 protein")
+
+
+def test_radius_of_gyration():
+    """Hand-computed fixture: two atoms, masses 1 and 3, 4 A apart.
+    COM sits 3 A from the light atom; Rg = sqrt((1*9 + 3*1)/4) = sqrt(3).
+    """
+    from mdanalysis_mpi_tpu.core.topology import Topology
+    from mdanalysis_mpi_tpu.core.universe import Universe
+
+    top = Topology(names=np.array(["X1", "X2"]),
+                   resnames=np.array(["AAA", "AAA"]),
+                   resids=np.array([1, 1]),
+                   masses=np.array([1.0, 3.0]))
+    pos = np.array([[0.0, 0, 0], [4.0, 0, 0]], np.float32)
+    u = Universe(top, pos[None])
+    assert u.atoms.radius_of_gyration() == pytest.approx(np.sqrt(3.0))
