@@ -36,7 +36,7 @@ N_FRAMES = int(os.environ.get("BENCH_FRAMES", 512))
 BATCH = int(os.environ.get("BENCH_BATCH", 64))
 SERIAL_FRAMES = int(os.environ.get("BENCH_SERIAL_FRAMES", 32))
 SELECT = os.environ.get("BENCH_SELECT", "heavy")
-REPEATS = int(os.environ.get("BENCH_REPEATS", 5))
+REPEATS = int(os.environ.get("BENCH_REPEATS", 7))
 
 
 def make_system(n_atoms: int, n_frames: int, seed: int = 0) -> Universe:

@@ -116,15 +116,28 @@ class AtomGroup:
     # ---- refinement & set algebra ----
 
     def select_atoms(self, selection: str) -> "AtomGroup":
-        """Select within this group (indices stay sorted/unique)."""
+        """Select within this group (indices stay sorted/unique).
+
+        The whole string is evaluated against the group (upstream
+        semantics): geometric keywords' inner selections see only group
+        atoms, so ``waters.select_atoms("around 3 protein")`` is empty
+        when the group holds no protein.
+        """
         from mdanalysis_mpi_tpu.core.selection import select_mask
+
+        top = self._universe.topology
 
         def coords():
             ts = self._universe.trajectory.ts
             return ts.positions, ts.dimensions
 
-        mask = select_mask(self._universe.topology, selection,
-                           positions=coords)
+        n = top.n_atoms
+        if len(self._indices) == n:
+            scope = None                 # whole universe: no restriction
+        else:
+            scope = np.zeros(n, dtype=bool)
+            scope[self._indices] = True
+        mask = select_mask(top, selection, positions=coords, scope=scope)
         return AtomGroup(self._universe,
                          self._indices[mask[self._indices]])
 

@@ -64,12 +64,20 @@ class SelectionError(ValueError):
 class _Parser:
     def __init__(self, text: str, top: Topology,
                  positions: np.ndarray | None = None,
-                 box: np.ndarray | None = None):
+                 box: np.ndarray | None = None,
+                 scope: np.ndarray | None = None):
         self.tokens = _TOKEN_RE.findall(text)
         if not self.tokens:
             raise SelectionError(f"empty selection string: {text!r}")
         self.pos = 0
         self.top = top
+        # group-scoped evaluation (AtomGroup.select_atoms): geometric
+        # keywords see only scope atoms — upstream evaluates the whole
+        # string against the group, so `waters.select_atoms("around 3
+        # protein")` is empty when the group holds no protein.  Plain
+        # keyword masks don't need it (callers intersect the final mask
+        # with the group anyway).
+        self.scope = scope
         # (n_atoms, 3) current frame + (6,) box — may be a zero-arg
         # callable so topology-only selections never force a frame
         # decode (resolved lazily the first time 'around' needs them)
@@ -182,6 +190,8 @@ class _Parser:
                 "'around' is a geometric selection and needs coordinates; "
                 "select through a Universe/AtomGroup (not bare select_mask "
                 "on a Topology)")
+        if self.scope is not None:
+            inner = inner & self.scope
         if not inner.any():
             return np.zeros_like(inner)
         from mdanalysis_mpi_tpu.ops.host import minimum_image
@@ -269,15 +279,18 @@ class _Parser:
 
 def select_mask(top: Topology, selection: str,
                 positions: np.ndarray | None = None,
-                box: np.ndarray | None = None) -> np.ndarray:
+                box: np.ndarray | None = None,
+                scope: np.ndarray | None = None) -> np.ndarray:
     """Parse ``selection`` against ``top`` → boolean mask (n_atoms,).
 
     ``positions``/``box`` (the current frame) enable the geometric
     keywords (``around``); topology-only selections ignore them.
     ``positions`` may be a zero-arg callable returning ``(positions,
     box)`` — evaluated lazily only if a geometric keyword is reached.
+    ``scope`` (boolean mask) restricts geometric keywords to a group.
     """
-    return _Parser(selection, top, positions=positions, box=box).parse()
+    return _Parser(selection, top, positions=positions, box=box,
+                   scope=scope).parse()
 
 
 def select(top: Topology, selection: str,

@@ -71,12 +71,7 @@ class Universe:
         Geometric keywords (``around``) see the current frame — fetched
         lazily, so topology-only selections never decode one.
         """
-        def coords():
-            ts = self.trajectory.ts
-            return ts.positions, ts.dimensions
-
-        return AtomGroup(self, np.flatnonzero(
-            select_mask(self.topology, selection, positions=coords)))
+        return self.atoms.select_atoms(selection)
 
     def copy(self) -> "Universe":
         """Clone with an independent trajectory cursor (RMSF.py:57).

@@ -45,6 +45,7 @@ class AnalysisConfig:
     transfer_dtype: str = "float32"
     nbins: int = 75                     # rdf
     r_max: float = 15.0                 # rdf range upper edge
+    engine: str = "auto"                # rdf histogram engine
     cutoff: float = 8.0                 # contacts
     output: str | None = None
 
@@ -75,7 +76,8 @@ def build_analysis(cfg: AnalysisConfig, universe=None):
     if cfg.analysis == "rdf":
         g1 = u.select_atoms(cfg.select)
         g2 = u.select_atoms(cfg.select2 or cfg.select)
-        return ana.InterRDF(g1, g2, nbins=cfg.nbins, range=(0.0, cfg.r_max))
+        return ana.InterRDF(g1, g2, nbins=cfg.nbins, range=(0.0, cfg.r_max),
+                            engine=cfg.engine)
     if cfg.analysis == "contacts":
         return ana.ContactMap(u.select_atoms(cfg.select), cutoff=cfg.cutoff)
     if cfg.analysis == "pairwise-distances":
@@ -116,6 +118,9 @@ def _parser() -> argparse.ArgumentParser:
     p.add_argument("--transfer-dtype", default="float32",
                    choices=("float32", "int16"))
     p.add_argument("--nbins", type=int, default=75)
+    p.add_argument("--engine", default="auto",
+                   choices=("auto", "xla", "pallas", "ring"),
+                   help="RDF histogram engine (ring needs --backend mesh)")
     p.add_argument("--r-max", type=float, default=15.0)
     p.add_argument("--cutoff", type=float, default=8.0)
     p.add_argument("--output", default=None, help="write results to .npz")
@@ -134,7 +139,8 @@ def main(argv=None) -> int:
         select=ns.select, select2=ns.select2, start=ns.start, stop=ns.stop,
         step=ns.step, ref_frame=ns.ref_frame, backend=ns.backend,
         batch_size=ns.batch_size, transfer_dtype=ns.transfer_dtype,
-        nbins=ns.nbins, r_max=ns.r_max, cutoff=ns.cutoff, output=ns.output)
+        nbins=ns.nbins, r_max=ns.r_max, cutoff=ns.cutoff, output=ns.output,
+        engine=ns.engine)
     from mdanalysis_mpi_tpu.utils.timers import device_trace
 
     TIMERS.reset()

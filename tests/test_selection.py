@@ -172,3 +172,23 @@ def test_radius_of_gyration():
     pos = np.array([[0.0, 0, 0], [4.0, 0, 0]], np.float32)
     u = Universe(top, pos[None])
     assert u.atoms.radius_of_gyration() == pytest.approx(np.sqrt(3.0))
+
+
+def test_around_group_scoped_inner():
+    """Upstream semantics: a subgroup's 'around' inner selection sees
+    only group atoms — waters.select_atoms('around R protein') is empty
+    when the group holds no protein."""
+    from mdanalysis_mpi_tpu.testing import make_solvated_universe
+
+    u = make_solvated_universe(n_residues=6, n_waters=40, n_frames=2, seed=2)
+    waters = u.select_atoms("water")
+    assert waters.select_atoms("around 5.0 protein").n_atoms == 0
+    # whole-universe query still sees the protein
+    assert u.select_atoms("water and around 5.0 protein").n_atoms > 0
+    # a group that contains protein works scoped
+    both = u.select_atoms("protein or water")
+    scoped = both.select_atoms("around 5.0 protein")
+    globl = u.select_atoms("around 5.0 protein")
+    np.testing.assert_array_equal(scoped.indices,
+                                  globl.indices[np.isin(globl.indices,
+                                                        both.indices)])
