@@ -35,6 +35,9 @@ def load_universe():
     args = [a for a in sys.argv[1:] if not a.startswith("--")]
     if len(args) == 2:
         return Universe(args[0], args[1])            # RMSF.py:56
+    if args:
+        sys.exit(f"need BOTH a topology and a trajectory, got {args!r} "
+                 "(or no files for the synthetic demo system)")
     from mdanalysis_mpi_tpu.testing import make_solvated_universe
 
     return make_solvated_universe(n_residues=30, n_waters=200, n_frames=24)

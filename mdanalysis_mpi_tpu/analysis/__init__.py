@@ -19,7 +19,8 @@ from mdanalysis_mpi_tpu.analysis.rms import RMSF, RMSD, AlignedRMSF
 from mdanalysis_mpi_tpu.analysis.align import AverageStructure, AlignTraj
 from mdanalysis_mpi_tpu.analysis.rdf import InterRDF
 from mdanalysis_mpi_tpu.analysis.distances import ContactMap, PairwiseDistances
+from mdanalysis_mpi_tpu.analysis.rgyr import RadiusOfGyration
 
 __all__ = ["AnalysisBase", "Results", "RMSF", "RMSD", "AlignedRMSF",
            "AverageStructure", "AlignTraj", "InterRDF", "ContactMap",
-           "PairwiseDistances"]
+           "PairwiseDistances", "RadiusOfGyration"]

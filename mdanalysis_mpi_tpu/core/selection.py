@@ -201,11 +201,16 @@ class _Parser:
         c2 = np.float32(cutoff * cutoff)
         box = None if box is None else np.asarray(box, np.float64)
         within = np.zeros(len(pos), dtype=bool)
+        # candidates: only scope atoms can survive the caller's group
+        # intersection, so don't compute distances for the rest
+        cand = np.flatnonzero(self.scope) if self.scope is not None \
+            else np.arange(len(pos))
         # block sizes bound the peak temporaries: minimum_image upcasts
         # to f64, so each (A, B, 3) block costs ~A·B·24 B ≈ 25 MB here
         A_CHUNK, B_CHUNK = 2048, 512
-        for a0 in range(0, len(pos), A_CHUNK):
-            chunk = pos[a0:a0 + A_CHUNK]
+        for a0 in range(0, len(cand), A_CHUNK):
+            idx = cand[a0:a0 + A_CHUNK]
+            chunk = pos[idx]
             hit = np.zeros(len(chunk), dtype=bool)
             for b0 in range(0, len(ref), B_CHUNK):
                 rc = ref[b0:b0 + B_CHUNK]
@@ -213,7 +218,7 @@ class _Parser:
                 disp = minimum_image(disp, box)
                 d2 = np.einsum("abi,abi->ab", disp, disp)
                 hit |= (d2 <= c2).any(axis=1)
-            within[a0:a0 + A_CHUNK] = hit
+            within[idx] = hit
         return within & ~inner
 
     # -- leaf matchers --

@@ -12,7 +12,6 @@ from __future__ import annotations
 import numpy as np
 
 from mdanalysis_mpi_tpu.core.groups import AtomGroup
-from mdanalysis_mpi_tpu.core.selection import select_mask
 from mdanalysis_mpi_tpu.core.topology import Topology
 from mdanalysis_mpi_tpu.io.base import ReaderBase
 from mdanalysis_mpi_tpu.io.memory import MemoryReader

@@ -24,7 +24,7 @@ import time
 import numpy as np
 
 ANALYSES = ("rmsf", "aligned-rmsf", "rmsd", "average-structure", "rdf",
-            "contacts", "pairwise-distances")
+            "contacts", "pairwise-distances", "rgyr")
 
 
 @dataclasses.dataclass
@@ -82,6 +82,8 @@ def build_analysis(cfg: AnalysisConfig, universe=None):
         return ana.ContactMap(u.select_atoms(cfg.select), cutoff=cfg.cutoff)
     if cfg.analysis == "pairwise-distances":
         return ana.PairwiseDistances(u.select_atoms(cfg.select))
+    if cfg.analysis == "rgyr":
+        return ana.RadiusOfGyration(u.select_atoms(cfg.select))
     raise AssertionError(cfg.analysis)
 
 

@@ -244,3 +244,54 @@ def test_results_lazy_materialization():
     # nested: a Deferred returning a device array materializes fully
     r.nested = Deferred(lambda: jnp.zeros(2))
     assert isinstance(r.nested, np.ndarray)
+
+
+class TestRadiusOfGyration:
+    def test_backends_agree(self):
+        from mdanalysis_mpi_tpu.analysis import RadiusOfGyration
+        from mdanalysis_mpi_tpu.testing import make_protein_universe
+
+        u = make_protein_universe(n_residues=12, n_frames=9, seed=7)
+        ag = u.select_atoms("protein")
+        s = RadiusOfGyration(ag).run(backend="serial")
+        j = RadiusOfGyration(ag).run(backend="jax", batch_size=4)
+        m = RadiusOfGyration(ag).run(backend="mesh", batch_size=2)
+        assert len(s.results.rgyr) == 9
+        np.testing.assert_allclose(j.results.rgyr, s.results.rgyr, rtol=1e-5)
+        np.testing.assert_allclose(m.results.rgyr, s.results.rgyr, rtol=1e-5)
+
+    def test_hand_computed(self):
+        """Two atoms (masses 1 and 3) 4 A apart -> Rg = sqrt(3); second
+        frame scaled x2 -> 2*sqrt(3)."""
+        from mdanalysis_mpi_tpu.analysis import RadiusOfGyration
+        from mdanalysis_mpi_tpu.core.topology import Topology
+        from mdanalysis_mpi_tpu.core.universe import Universe
+
+        top = Topology(names=np.array(["X1", "X2"]),
+                       resnames=np.array(["AAA", "AAA"]),
+                       resids=np.array([1, 1]),
+                       masses=np.array([1.0, 3.0]))
+        pos = np.array([[[0.0, 0, 0], [4.0, 0, 0]],
+                        [[0.0, 0, 0], [8.0, 0, 0]]], np.float32)
+        u = Universe(top, pos)
+        r = RadiusOfGyration(u.atoms).run(backend="jax", batch_size=2)
+        np.testing.assert_allclose(
+            r.results.rgyr, [np.sqrt(3.0), 2 * np.sqrt(3.0)], rtol=1e-6)
+
+    def test_matches_atomgroup_method(self):
+        from mdanalysis_mpi_tpu.analysis import RadiusOfGyration
+        from mdanalysis_mpi_tpu.testing import make_protein_universe
+
+        u = make_protein_universe(n_residues=6, n_frames=3, seed=8)
+        ag = u.select_atoms("name CA")
+        r = RadiusOfGyration(ag).run(backend="serial")
+        u.trajectory[2]
+        assert r.results.rgyr[2] == pytest.approx(ag.radius_of_gyration())
+
+    def test_empty_group_raises(self):
+        from mdanalysis_mpi_tpu.analysis import RadiusOfGyration
+        from mdanalysis_mpi_tpu.testing import make_protein_universe
+
+        u = make_protein_universe(n_residues=3, n_frames=2)
+        with pytest.raises(ValueError, match="non-empty"):
+            RadiusOfGyration(u.select_atoms("name ZZ")).run()
