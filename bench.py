@@ -26,6 +26,14 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+# The axon site hook re-asserts JAX_PLATFORMS=axon, so an env-var request
+# for the virtual-CPU platform (multi-chip mesh validation without
+# hardware) must be re-pinned via jax.config (same as __graft_entry__.py)
+if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
 from mdanalysis_mpi_tpu.core.topology import Topology  # noqa: E402
 from mdanalysis_mpi_tpu.core.universe import Universe  # noqa: E402
 from mdanalysis_mpi_tpu.io.memory import MemoryReader  # noqa: E402
@@ -81,11 +89,12 @@ def main():
     serial_fps = SERIAL_FRAMES / float(np.median(serial_walls))
     baseline_fps = 8 * serial_fps          # ideal 8-rank MPI
 
-    # --- accelerator path: backend="jax" runs on exactly ONE chip, so
-    # frames/sec/chip divides by 1 regardless of how many are visible
-    # (use backend="mesh" + n_chips=len(devices) for multi-chip runs) ---
-    import jax  # noqa: F401  (ensures the platform is initialized)
-    n_chips = 1
+    # --- accelerator path: single chip → backend="jax"; more chips →
+    # backend="mesh" over all of them, value normalized per chip ---
+    import jax
+
+    n_chips = len(jax.devices())
+    accel_backend = "jax" if n_chips == 1 else "mesh"
     # float32 staging wins on a clean (non-collapsed) tunnel: the host
     # quantize pass costs more than the halved wire bytes save (measured
     # 1255 vs 952 f/s at batch 64/128).  int16 remains the right knob
@@ -97,13 +106,15 @@ def main():
     # the rest of the process (analysis.base.Deferred), which would turn
     # the measurement into a measurement of the collapsed link.
     AlignedRMSF(u, select=SELECT).run(
-        stop=2 * BATCH, backend="jax", batch_size=BATCH, transfer_dtype=tdtype)
+        stop=2 * BATCH, backend=accel_backend, batch_size=BATCH,
+        transfer_dtype=tdtype)
     # median of REPEATS: the tunneled TPU target shows multi-x run-to-run
     # variance (shared link), so a single sample is mostly noise
     walls = []
     for _ in range(REPEATS):
         t0 = time.perf_counter()
-        r = AlignedRMSF(u, select=SELECT).run(backend="jax", batch_size=BATCH,
+        r = AlignedRMSF(u, select=SELECT).run(backend=accel_backend,
+                                              batch_size=BATCH,
                                               transfer_dtype=tdtype)
         # drain the async dispatch queue (device-side wait, not a fetch)
         jax.block_until_ready(r.results["rmsf"])
@@ -113,7 +124,8 @@ def main():
 
     # sanity: backends agree on the short window
     r_short = AlignedRMSF(u, select=SELECT).run(
-        stop=SERIAL_FRAMES, backend="jax", batch_size=SERIAL_FRAMES)
+        stop=SERIAL_FRAMES, backend=accel_backend,
+        batch_size=SERIAL_FRAMES)
     err = float(np.abs(r_short.results.rmsf - s.results.rmsf).max())
     if err > 1e-3:
         print(f"WARNING: backend divergence {err:.2e}", file=sys.stderr)

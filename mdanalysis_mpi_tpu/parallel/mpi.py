@@ -15,6 +15,15 @@ communicator — the image of the reference's two collectives:
   with the full result (the reference leaves ranks != 0 with garbage
   and a dead ``MPI.Op`` handle, quirk Q1; we use the fold directly).
 
+Failure semantics (SURVEY.md §5.3): like the reference, a rank that
+dies before its collective leaves the others blocked in
+``allreduce``/``allgather`` — MPI offers no cheap liveness detection,
+so this path fails fast only on *raising* ranks (the exception
+propagates before the collective).  For crash-durable long runs use the
+single-controller backends with
+:func:`~mdanalysis_mpi_tpu.utils.checkpoint.run_checkpointed`, whose
+mergeable partials make block-level recovery free.
+
 mpi4py is an *optional* dependency: :class:`MPIExecutor` accepts any
 object with the tiny communicator surface it uses (``Get_rank``,
 ``Get_size``, ``allreduce(obj, op)``, ``allgather(obj)``), so tests
