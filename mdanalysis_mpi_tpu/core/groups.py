@@ -113,6 +113,35 @@ class AtomGroup:
         d = self.positions.astype(np.float64) - self.center_of_mass()
         return float(np.sqrt((m * (d ** 2).sum(axis=1)).sum() / m.sum()))
 
+    # ---- residue/segment structure ----
+
+    @property
+    def resindices(self) -> np.ndarray:
+        return self._universe.topology.resindices[self._indices]
+
+    @property
+    def residues(self) -> "ResidueGroup":
+        """The residues these atoms belong to (upstream idiom)."""
+        return ResidueGroup(self._universe, self.resindices)
+
+    def split(self, level: str = "residue") -> list["AtomGroup"]:
+        """Split into per-residue or per-segment AtomGroups (upstream
+        ``AtomGroup.split``), preserving this group's atom order within
+        each part — e.g. per-residue RMSF aggregation::
+
+            parts = u.select_atoms("protein").split("residue")
+        """
+        if level == "residue":
+            keys = self.resindices
+        elif level == "segment":
+            keys = self.segids
+        else:
+            raise ValueError(
+                f"level must be 'residue' or 'segment', got {level!r}")
+        uniq, inverse = np.unique(keys, return_inverse=True)
+        return [AtomGroup(self._universe, self._indices[inverse == k])
+                for k in range(len(uniq))]
+
     # ---- refinement & set algebra ----
 
     def select_atoms(self, selection: str) -> "AtomGroup":
@@ -159,3 +188,60 @@ class AtomGroup:
     def _check(self, other):
         if other._universe is not self._universe:
             raise ValueError("AtomGroups belong to different Universes")
+
+
+class ResidueGroup:
+    """Residue-level view over a set of residues (upstream's
+    ``u.residues`` / ``AtomGroup.residues``): per-residue attribute
+    arrays plus the way back down to atoms.
+
+    Residues are identified by the topology's ``resindices`` (0-based,
+    assigned in file order whenever (resid, segid) changes — the
+    standard convention); attributes are taken from each residue's
+    first atom.
+    """
+
+    def __init__(self, universe, resindices: np.ndarray):
+        self._universe = universe
+        self._resindices = np.unique(np.asarray(resindices, dtype=np.int64))
+        top = universe.topology
+        # first atom of every residue in the topology (index by resindex)
+        _, first = np.unique(top.resindices, return_index=True)
+        self._first_atom = first[self._resindices]
+
+    @property
+    def universe(self):
+        return self._universe
+
+    @property
+    def resindices(self) -> np.ndarray:
+        return self._resindices
+
+    @property
+    def n_residues(self) -> int:
+        return len(self._resindices)
+
+    def __len__(self) -> int:
+        return self.n_residues
+
+    def __repr__(self):
+        return f"<ResidueGroup with {self.n_residues} residues>"
+
+    @property
+    def resids(self) -> np.ndarray:
+        return self._universe.topology.resids[self._first_atom]
+
+    @property
+    def resnames(self) -> np.ndarray:
+        return self._universe.topology.resnames[self._first_atom]
+
+    @property
+    def segids(self) -> np.ndarray:
+        return self._universe.topology.segids[self._first_atom]
+
+    @property
+    def atoms(self) -> AtomGroup:
+        """All atoms belonging to these residues, in topology order."""
+        top = self._universe.topology
+        mask = np.isin(top.resindices, self._resindices)
+        return AtomGroup(self._universe, np.flatnonzero(mask))

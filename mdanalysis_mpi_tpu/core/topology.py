@@ -90,6 +90,15 @@ class Topology:
         else:
             self.resindices = _check_len(
                 np.asarray(self.resindices, dtype=np.int64), "resindices")
+            # residue machinery indexes arrays positionally by resindex,
+            # so user-supplied values must be 0-based and gap-free
+            if len(self.resindices):
+                uniq = np.unique(self.resindices)
+                if uniq[0] != 0 or uniq[-1] != len(uniq) - 1:
+                    raise ValueError(
+                        "resindices must be 0-based and contiguous "
+                        f"(got values spanning {uniq[0]}..{uniq[-1]} with "
+                        f"{len(uniq)} distinct)")
         if self.bonds is not None:
             self.bonds = np.asarray(self.bonds, dtype=np.int64).reshape(-1, 2)
 
@@ -100,6 +109,16 @@ class Topology:
     @property
     def n_residues(self) -> int:
         return int(self.resindices[-1]) + 1 if self.n_atoms else 0
+
+    @property
+    def residue_first_atom(self) -> np.ndarray:
+        """First atom index of each residue, indexed by resindex
+        (cached: static per topology, used by every ResidueGroup)."""
+        m = self._derived.get("residue_first_atom")
+        if m is None:
+            _, m = np.unique(self.resindices, return_index=True)
+            self._derived["residue_first_atom"] = m
+        return m
 
     # ---- cached boolean masks used by the selection DSL ----
 

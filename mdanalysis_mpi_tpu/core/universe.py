@@ -62,6 +62,13 @@ class Universe:
     def atoms(self) -> AtomGroup:
         return AtomGroup(self, np.arange(self.topology.n_atoms))
 
+    @property
+    def residues(self):
+        """All residues (upstream's ``u.residues``)."""
+        from mdanalysis_mpi_tpu.core.groups import ResidueGroup
+
+        return ResidueGroup(self, self.topology.resindices)
+
     def select_atoms(self, selection: str) -> AtomGroup:
         """Selection string → AtomGroup (RMSF.py:77 semantics).
 

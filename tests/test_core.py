@@ -140,3 +140,58 @@ def test_transfer_to_memory_preserves_file_times(tmp_path):
     assert u.trajectory.n_frames == 3
     for j, t in enumerate((0.0, 5.0, 10.0)):
         assert u.trajectory[j].time == pytest.approx(t)
+
+
+class TestResidues:
+    def test_universe_residues(self):
+        from mdanalysis_mpi_tpu.testing import make_solvated_universe
+
+        u = make_solvated_universe(n_residues=5, n_waters=3, n_frames=1)
+        res = u.residues
+        assert res.n_residues == 8                  # 5 protein + 3 water
+        assert list(res.resnames[:5]) != []         # attribute arrays align
+        assert len(res.resids) == 8
+        assert res.atoms.n_atoms == u.atoms.n_atoms
+
+    def test_atomgroup_residues_subset(self):
+        from mdanalysis_mpi_tpu.testing import make_solvated_universe
+
+        u = make_solvated_universe(n_residues=4, n_waters=5, n_frames=1)
+        ca = u.select_atoms("protein and name CA")
+        res = ca.residues
+        assert res.n_residues == 4
+        # back to atoms: whole residues, not just the CA atoms
+        assert res.atoms.n_atoms == u.select_atoms("protein").n_atoms
+
+    def test_split_by_residue(self):
+        from mdanalysis_mpi_tpu.testing import make_protein_universe
+
+        u = make_protein_universe(n_residues=6, n_frames=1)
+        parts = u.select_atoms("protein").split("residue")
+        assert len(parts) == 6
+        assert sum(p.n_atoms for p in parts) == u.atoms.n_atoms
+        for p in parts:
+            assert len(set(p.resids)) == 1          # one residue per part
+
+    def test_split_by_segment_and_errors(self):
+        from mdanalysis_mpi_tpu.testing import make_solvated_universe
+
+        u = make_solvated_universe(n_residues=3, n_waters=2, n_frames=1)
+        segs = u.atoms.split("segment")
+        assert sum(p.n_atoms for p in segs) == u.atoms.n_atoms
+        with pytest.raises(ValueError, match="residue' or 'segment"):
+            u.atoms.split("chain")
+
+    def test_per_residue_rmsf_aggregation(self):
+        """The idiom residues exist for: aggregate atomic RMSF by residue."""
+        from mdanalysis_mpi_tpu.analysis import RMSF
+        from mdanalysis_mpi_tpu.testing import make_protein_universe
+
+        u = make_protein_universe(n_residues=5, n_frames=8, seed=3)
+        prot = u.select_atoms("protein")
+        r = RMSF(prot).run(backend="serial")
+        resindices = prot.resindices
+        per_res = [r.results.rmsf[resindices == i].mean()
+                   for i in np.unique(resindices)]
+        assert len(per_res) == 5
+        assert all(np.isfinite(per_res))
