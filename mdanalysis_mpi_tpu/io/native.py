@@ -90,6 +90,12 @@ def load() -> ctypes.CDLL:
             _f32p, ctypes.c_long, ctypes.c_long, ctypes.c_void_p,
             ctypes.c_long, _i16p, ctypes.POINTER(ctypes.c_float)]
 
+        lib.stage_gather_quantize_i16_scaled.restype = ctypes.c_int
+        lib.stage_gather_quantize_i16_scaled.argtypes = [
+            _f32p, ctypes.c_long, ctypes.c_long, ctypes.c_void_p,
+            ctypes.c_long, ctypes.c_float, _i16p,
+            ctypes.POINTER(ctypes.c_float)]
+
         lib.stage_gather_f32.restype = ctypes.c_int
         lib.stage_gather_f32.argtypes = [
             _f32p, ctypes.c_long, ctypes.c_long, ctypes.c_void_p,
@@ -114,8 +120,10 @@ def stage_gather_quantize(src: np.ndarray, sel=None):
 
     ``src`` is (B, N, 3) float32 C-contiguous; ``sel`` an int array into
     the atom axis or None for all atoms.  Returns (q (B, S, 3) int16,
-    inv_scale float32) — bit-identical to
-    ``parallel.executors.quantize_block(src[:, sel])``.
+    inv_scale float32) — this exact-scale kernel is bit-identical to
+    ``parallel.executors.quantize_block(src[:, sel])`` (the adaptive
+    one-pass ``stage_gather_quantize_scaled`` below is not: it trades
+    exact per-block scales for half the memory traffic).
     """
     lib = load()
     b, n = src.shape[0], src.shape[1]
@@ -133,6 +141,31 @@ def stage_gather_quantize(src: np.ndarray, sel=None):
     if rc != 0:
         raise RuntimeError(f"stage_gather_quantize_i16 failed (rc={rc})")
     return out, np.float32(inv.value)
+
+
+def stage_gather_quantize_scaled(src: np.ndarray, sel, scale: float):
+    """One-pass fused gather + int16 quantize with a caller-provided
+    ``scale`` (see trajio.cpp: halves the two-pass kernel's memory
+    traffic).  Returns ``(q, max_abs, overflowed)``: ``overflowed`` True
+    means the scale would have clipped real data — the caller must
+    discard ``q`` and re-quantize with a scale from ``max_abs``.
+    """
+    lib = load()
+    b, n = src.shape[0], src.shape[1]
+    if sel is None:
+        s = n
+        idx_p = None
+    else:
+        idx = np.ascontiguousarray(sel, dtype=np.int32)
+        s = len(idx)
+        idx_p = idx.ctypes.data_as(ctypes.c_void_p)
+    out = np.empty((b, s, 3), dtype=np.int16)
+    vmax = ctypes.c_float(0.0)
+    rc = lib.stage_gather_quantize_i16_scaled(
+        src, b, n, idx_p, s, ctypes.c_float(scale), out, ctypes.byref(vmax))
+    if rc < 0:
+        raise RuntimeError(f"stage_gather_quantize_i16_scaled failed (rc={rc})")
+    return out, float(vmax.value), rc == 1
 
 
 def stage_gather(src: np.ndarray, sel=None) -> np.ndarray:

@@ -202,7 +202,10 @@ def quantize_block(block: np.ndarray):
     return q, np.float32(1.0 / scale)
 
 
-class DeviceBlockCache:
+from mdanalysis_mpi_tpu.io.base import BlockCache  # noqa: E402
+
+
+class DeviceBlockCache(BlockCache):
     """HBM-resident staged-block cache shared across trajectory passes.
 
     The reference re-reads (re-decodes) every frame in pass 2
@@ -214,17 +217,7 @@ class DeviceBlockCache:
     """
 
     def __init__(self, max_bytes: int = 4 << 30):
-        self._store: dict = {}
-        self._bytes = 0
-        self.max_bytes = max_bytes
-
-    def get(self, key):
-        return self._store.get(key)
-
-    def put(self, key, value, nbytes: int):
-        if self._bytes + nbytes <= self.max_bytes:
-            self._store[key] = value
-            self._bytes += nbytes
+        super().__init__(max_bytes)
 
 
 class _InlinePool:
@@ -289,10 +282,9 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
     # blocks staged for a different selection (exact content hash), a
     # different trajectory (reader path or identity), stride, batch
     # size, or transfer dtype.
-    if sel_idx is None:
-        sel_fp = None
-    else:
-        sel_fp = hash(np.ascontiguousarray(sel_idx).tobytes())
+    from mdanalysis_mpi_tpu.io.base import sel_fingerprint
+
+    sel_fp = sel_fingerprint(sel_idx)
     reader_fp = getattr(reader, "_path", None) or id(reader)
 
     def prepare(ab):
@@ -313,9 +305,11 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
         contiguous = (len(batch_frames) > 0
                       and batch_frames[-1] - batch_frames[0] + 1
                       == len(batch_frames))
-        if contiguous and hasattr(reader, "stage_block"):
-            # fused native gather(+quantize) — the fast path
-            block, boxes, inv_scale = reader.stage_block(
+        stage = getattr(reader, "stage_cached", None)
+        if contiguous and stage is not None:
+            # fused native gather(+quantize) through the reader's host
+            # block cache — repeat passes pay only wire serialization
+            block, boxes, inv_scale = stage(
                 batch_frames[0], batch_frames[-1] + 1, sel_idx, quantize)
         else:
             block, boxes = _stage(reader, batch_frames, sel_idx)

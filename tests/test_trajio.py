@@ -312,3 +312,150 @@ class TestTRR:
 
         with pytest.raises(IOError, match="magic"):
             TRRReader(path)
+
+
+class TestHostStageCache:
+    """Host staged-block cache (ReaderBase.stage_cached): re-running an
+    analysis over the same (trajectory, selection) must not re-pay the
+    gather/quantize on the single staging core."""
+
+    def _reader(self):
+        from mdanalysis_mpi_tpu.io.memory import MemoryReader
+
+        rng = np.random.default_rng(7)
+        return MemoryReader(rng.normal(size=(8, 40, 3)).astype(np.float32))
+
+    def test_hit_returns_identical_blocks(self):
+        r = self._reader()
+        sel = np.array([1, 5, 9, 30])
+        a = r.stage_cached(0, 4, sel=sel, quantize=False)
+        b = r.stage_cached(0, 4, sel=sel, quantize=False)
+        assert b[0] is a[0]  # cached object, no re-gather
+        cache = r.__dict__["_host_stage_cache"]
+        assert cache.hits == 1 and cache.misses == 1
+        ref, _ = r.read_block(0, 4, sel=sel)
+        np.testing.assert_array_equal(a[0], ref)
+
+    def test_keys_separate_selection_window_and_dtype(self):
+        r = self._reader()
+        sel = np.array([1, 5])
+        base = r.stage_cached(0, 4, sel=sel)
+        assert r.stage_cached(0, 4, sel=np.array([2, 6]))[0] is not base[0]
+        assert r.stage_cached(4, 8, sel=sel)[0] is not base[0]
+        q = r.stage_cached(0, 4, sel=sel, quantize=True)
+        assert q[0].dtype == np.int16 and base[0].dtype == np.float32
+        # dequantized cached block matches an uncached quantize pass
+        # within resolution (scales may differ: adaptive one-pass path)
+        q2 = r.stage_block(0, 4, sel=sel, quantize=True)
+        np.testing.assert_allclose(
+            q[0].astype(np.float32) * q[2],
+            q2[0].astype(np.float32) * q2[2], atol=1e-3)
+
+    def test_env_disables(self, monkeypatch):
+        monkeypatch.setenv("MDTPU_HOST_STAGE_CACHE_MB", "0")
+        r = self._reader()
+        a = r.stage_cached(0, 4)
+        b = r.stage_cached(0, 4)
+        assert a[0] is not b[0]
+        assert "_host_stage_cache" not in r.__dict__
+
+    def test_cap_stops_insertion(self, monkeypatch):
+        # cap below one block: nothing is stored, results still correct
+        monkeypatch.setenv("MDTPU_HOST_STAGE_CACHE_MB", "0.0001")
+        r = self._reader()
+        a = r.stage_cached(0, 8)
+        b = r.stage_cached(0, 8)
+        assert a[0] is not b[0]
+        np.testing.assert_array_equal(a[0], b[0])
+
+    def test_executor_path_uses_cache(self):
+        """A second jax-backend run over the same universe+selection
+        serves staging from the host cache."""
+        from mdanalysis_mpi_tpu.analysis import RMSF
+
+        from mdanalysis_mpi_tpu.core.topology import make_protein_topology
+        top = make_protein_topology(n_residues=8)
+        rng = np.random.default_rng(3)
+        coords = rng.normal(size=(6, top.n_atoms, 3)).astype(np.float32)
+        from mdanalysis_mpi_tpu.io.memory import MemoryReader
+        u = Universe(top, MemoryReader(coords))
+        ag = u.select_atoms("name CA")
+        r1 = RMSF(ag).run(backend="jax", batch_size=4)
+        cache = u.trajectory.__dict__.get("_host_stage_cache")
+        assert cache is not None and cache.misses >= 1
+        hits_before = cache.hits
+        r2 = RMSF(ag).run(backend="jax", batch_size=4)
+        assert cache.hits > hits_before
+        np.testing.assert_allclose(r1.results.rmsf, r2.results.rmsf)
+
+
+class TestAdaptiveQuantize:
+    """One-pass scaled int16 staging (stage_gather_quantize_i16_scaled):
+    later blocks quantize in a single streaming pass against the first
+    block's range; range growth falls back to the exact two-pass kernel."""
+
+    def _reader(self, coords):
+        from mdanalysis_mpi_tpu.io.memory import MemoryReader
+
+        return MemoryReader(coords)
+
+    def test_scaled_path_matches_resolution(self):
+        rng = np.random.default_rng(0)
+        r = self._reader(rng.normal(scale=10, size=(8, 100, 3)).astype(np.float32))
+        sel = np.arange(0, 100, 2)
+        q1, _, s1 = r.stage_block(0, 4, sel=sel, quantize=True)  # seeds hint
+        assert max(r.__dict__.get("_quant_max_hints", {}).values(),
+                   default=0.0) > 0.0
+        q2, _, s2 = r.stage_block(4, 8, sel=sel, quantize=True)  # one-pass
+        blk2, _ = r.read_block(4, 8, sel=sel)
+        err = np.abs(q2.astype(np.float32) * s2 - blk2).max()
+        # resolution = max|x| * 1.05 / 32000 ≈ 1e-3 for this range
+        assert err < 2e-3
+
+    def test_overflow_requantizes_exactly(self):
+        rng = np.random.default_rng(1)
+        small = rng.normal(scale=10, size=(4, 100, 3)).astype(np.float32)
+        big = rng.normal(scale=300, size=(4, 100, 3)).astype(np.float32)
+        r = self._reader(np.concatenate([small, big]))
+        sel = np.arange(100)
+        r.stage_block(0, 4, sel=sel, quantize=True)
+        hints = r.__dict__["_quant_max_hints"]
+        hint_before = max(hints.values())
+        q, _, s = r.stage_block(4, 8, sel=sel, quantize=True)
+        blk, _ = r.read_block(4, 8, sel=sel)
+        err = np.abs(q.astype(np.float32) * s - blk).max()
+        assert err < 0.05          # exact per-block scale, NOT clipped
+        assert max(hints.values()) > hint_before
+
+    def test_hints_scoped_per_selection(self):
+        """A wide-coordinate selection must not coarsen the quantization
+        resolution of a narrow one on the same reader."""
+        rng = np.random.default_rng(3)
+        coords = rng.normal(scale=1.0, size=(8, 100, 3)).astype(np.float32)
+        coords[:, 50:] *= 1000.0          # atoms 50+ span a huge range
+        r = self._reader(coords)
+        wide = np.arange(100)
+        narrow = np.arange(50)
+        r.stage_block(0, 4, sel=wide, quantize=True)    # seeds wide hint
+        r.stage_block(0, 4, sel=narrow, quantize=True)  # seeds narrow hint
+        q, _, s = r.stage_block(4, 8, sel=narrow, quantize=True)
+        blk, _ = r.read_block(4, 8, sel=narrow)
+        err = np.abs(q.astype(np.float32) * s - blk).max()
+        # resolution follows the narrow selection's own ~5 A range
+        # (~2e-4), not the wide selection's ~5000 A range (~0.2)
+        assert err < 2e-3
+
+    def test_matches_numpy_fallback_semantics(self):
+        """Native exact kernel == NumPy quantize_block bit-for-bit (the
+        seeding path); the scaled path dequantizes to the same values
+        within its coarser-by-5% resolution."""
+        from mdanalysis_mpi_tpu.io import native
+        from mdanalysis_mpi_tpu.parallel.executors import quantize_block
+
+        rng = np.random.default_rng(2)
+        src = rng.normal(scale=25, size=(3, 64, 3)).astype(np.float32)
+        sel = np.arange(0, 64, 4)
+        qn, sn = native.stage_gather_quantize(src, sel)
+        qp, sp = quantize_block(src[:, sel])
+        np.testing.assert_array_equal(qn, qp)
+        assert sn == sp
