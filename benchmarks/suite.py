@@ -47,12 +47,25 @@ REPEATS = int(os.environ.get("BENCH_REPEATS", "3"))
 TOL = 1e-3
 
 
+def _serial_fps(make_analysis, n_frames, max_frames: int = 64) -> float:
+    """Frames/sec of the serial f64 oracle on a capped window — the
+    per-config regression reference (measured BEFORE the accelerator
+    timing so the tunnel client's CPU use does not depress it)."""
+    stop = min(n_frames, max_frames)
+    make_analysis().run(stop=min(stop, 2), backend="serial")   # warm-up
+    t0 = time.perf_counter()
+    make_analysis().run(stop=stop, backend="serial")
+    return stop / (time.perf_counter() - t0)
+
+
 def _timed(make_analysis, n_frames, run_kwargs):
     """Median frames/sec over REPEATS accelerator runs.  Synchronizes on
     the raw device partials — never on materialized results, which would
-    fetch (see module docstring).  Returns (fps, last_analysis)."""
+    fetch (see module docstring).  Returns (fps, serial_fps,
+    last_analysis)."""
     import jax
 
+    serial = _serial_fps(make_analysis, n_frames)
     make_analysis().run(**run_kwargs)              # compile warm-up
     walls = []
     for _ in range(REPEATS):
@@ -60,7 +73,7 @@ def _timed(make_analysis, n_frames, run_kwargs):
         a = make_analysis().run(**run_kwargs)
         jax.block_until_ready(a._last_total)
         walls.append(time.perf_counter() - t0)
-    return n_frames / float(np.median(walls)), a
+    return n_frames / float(np.median(walls)), serial, a
 
 
 def config1(stack):
@@ -73,7 +86,7 @@ def config1(stack):
     frames, _ = u0.trajectory.read_block(0, u0.trajectory.n_frames)
     write_dcd(dcd, frames)
     u = Universe(u0.topology, dcd)
-    fps, a = _timed(lambda: AlignedRMSF(u, select="name CA"),
+    fps, serial, a = _timed(lambda: AlignedRMSF(u, select="name CA"),
                     u.trajectory.n_frames, dict(backend="jax", batch_size=32))
 
     def check():
@@ -82,8 +95,9 @@ def config1(stack):
         assert err < TOL, f"config1 divergence {err}"
 
     return {"config": 1, "metric": "Ca RMSF, 3341-atom ADK-size, DCD",
-            "value": round(fps, 2), "unit": "frames/s",
-            "backend": "jax"}, check
+            "value": round(fps, 2), "unit": "frames/s", "backend": "jax",
+            "serial_fps": round(serial, 2),
+            "vs_serial": round(fps / serial, 2)}, check
 
 
 def config2(stack):
@@ -98,7 +112,7 @@ def config3(stack):
     del stack
     u = make_protein_universe(n_residues=500, n_frames=int(256 * SCALE),
                               noise=0.4, seed=3)
-    fps, a = _timed(lambda: RMSD(u.select_atoms("name CA")),
+    fps, serial, a = _timed(lambda: RMSD(u.select_atoms("name CA")),
                     u.trajectory.n_frames, dict(backend="jax", batch_size=64))
 
     def check():
@@ -107,16 +121,18 @@ def config3(stack):
         assert err < TOL, f"config3 divergence {err}"
 
     return {"config": 3, "metric": "superposed RMSD series, 2000 atoms",
-            "value": round(fps, 2), "unit": "frames/s",
-            "backend": "jax"}, check
+            "value": round(fps, 2), "unit": "frames/s", "backend": "jax",
+            "serial_fps": round(serial, 2),
+            "vs_serial": round(fps / serial, 2)}, check
 
 
 def config4(stack):
     del stack
     u = make_water_universe(n_waters=2000, n_frames=int(32 * SCALE), seed=4)
     ow = u.select_atoms("name OW")
-    fps, a = _timed(lambda: InterRDF(ow, ow, nbins=75, range=(0.0, 10.0)),
-                    u.trajectory.n_frames, dict(backend="jax", batch_size=8))
+    fps, serial, a = _timed(
+        lambda: InterRDF(ow, ow, nbins=75, range=(0.0, 10.0)),
+        u.trajectory.n_frames, dict(backend="jax", batch_size=8))
 
     def check():
         s = InterRDF(ow, ow, nbins=75, range=(0.0, 10.0)).run(
@@ -125,16 +141,18 @@ def config4(stack):
         assert err < 0.05, f"config4 divergence {err}"
 
     return {"config": 4, "metric": "O-O RDF, 2000-water box",
-            "value": round(fps, 2), "unit": "frames/s",
-            "backend": "jax"}, check
+            "value": round(fps, 2), "unit": "frames/s", "backend": "jax",
+            "serial_fps": round(serial, 2),
+            "vs_serial": round(fps / serial, 2)}, check
 
 
 def config5(stack):
     del stack
     u = make_protein_universe(n_residues=500, n_frames=int(128 * SCALE),
                               noise=0.4, seed=5)
-    fps, a = _timed(lambda: ContactMap(u.select_atoms("name CA"), cutoff=8.0),
-                    u.trajectory.n_frames, dict(backend="jax", batch_size=32))
+    fps, serial, a = _timed(
+        lambda: ContactMap(u.select_atoms("name CA"), cutoff=8.0),
+        u.trajectory.n_frames, dict(backend="jax", batch_size=32))
 
     def check():
         s = ContactMap(u.select_atoms("name CA"), cutoff=8.0).run(
@@ -144,8 +162,9 @@ def config5(stack):
         assert err < TOL, f"config5 divergence {err}"
 
     return {"config": 5, "metric": "Ca contact map, 500 residues",
-            "value": round(fps, 2), "unit": "frames/s",
-            "backend": "jax"}, check
+            "value": round(fps, 2), "unit": "frames/s", "backend": "jax",
+            "serial_fps": round(serial, 2),
+            "vs_serial": round(fps / serial, 2)}, check
 
 
 def main():
