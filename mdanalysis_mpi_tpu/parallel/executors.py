@@ -259,7 +259,8 @@ def _staging_pool():
 
 def _run_batches(analysis, reader, frames, bs, call, sel_idx,
                  device_put_fn=None, cache: "DeviceBlockCache | None" = None,
-                 quantize: bool = False):
+                 quantize: bool = False, local_divisor: int = 1,
+                 local_index: int = 0):
     """Shared batch loop: stage → kernel → DEVICE-side accumulation.
 
     Partials never leave the device per batch: results are either folded
@@ -271,6 +272,18 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
     device→host fetch pays ~100-200 ms of fixed round-trip latency
     (measured; size-independent below ~1 MB), so per-batch fetches
     dominated the wall clock; device-side folding removes them entirely.
+
+    Multi-host (``local_divisor`` = process count > 1): batch bounds
+    stay GLOBAL — every process walks the same batch schedule (the jit
+    dispatches must agree across controllers) — but each process stages
+    only its own ``1/local_divisor`` slice of every batch (the
+    ``local_index``-th sub-block, matching its addressable devices'
+    position in the mesh), padded to the local batch size; the
+    executor's ``device_put_fn`` assembles the slices into one global
+    sharded array (``distributed.global_batch_from_local``).  This is
+    the reference's N-independent-reader-handles pattern (RMSF.py:56)
+    one level up: hosts instead of ranks, no cross-host staging traffic
+    (SURVEY.md §5.8).
     """
     fold = analysis._device_fold_fn
     fold_j = _jit_kernel(fold) if fold is not None else None
@@ -302,6 +315,12 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
             return _prepare_uncached(frames[a:b], key)
 
     def _prepare_uncached(batch_frames, key):
+        pad_to = bs
+        if local_divisor > 1:
+            # stage only this process's slice of the global batch
+            pad_to = bs // local_divisor
+            batch_frames = batch_frames[local_index * pad_to:
+                                        (local_index + 1) * pad_to]
         contiguous = (len(batch_frames) > 0
                       and batch_frames[-1] - batch_frames[0] + 1
                       == len(batch_frames))
@@ -318,14 +337,17 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
                 block, inv_scale = quantize_block(block)
         if boxes is None:
             boxes = np.zeros((block.shape[0], 6), dtype=np.float32)
-        padded, mask = pad_batch(block, bs)
-        boxes_p, _ = pad_batch(np.ascontiguousarray(boxes, np.float32), bs)
+        padded, mask = pad_batch(block, pad_to)
+        boxes_p, _ = pad_batch(np.ascontiguousarray(boxes, np.float32),
+                               pad_to)
         if device_put_fn is not None:
             padded, boxes_p, mask = device_put_fn(padded, boxes_p, mask)
         staged = ((padded, inv_scale, boxes_p, mask) if quantize
                   else (padded, boxes_p, mask))
         if cache is not None:
-            cache.put(key, staged, padded.nbytes)
+            # charge this process's resident share: a global sharded
+            # array holds only 1/local_divisor of its bytes per host
+            cache.put(key, staged, padded.nbytes // local_divisor)
         return staged
 
     with _staging_pool() as pool:
@@ -510,6 +532,51 @@ class MeshExecutor:
             analysis._batch_params(), analysis._batch_select(),
             reader.n_atoms, self.transfer_dtype)
         frames = list(frames)
+
+        n_proc = jax.process_count()
+        if n_proc > 1:
+            # Multi-controller (DCN) path: every process runs this same
+            # execute() over the same global frame schedule, stages only
+            # its own slice of each batch (see _run_batches), and the
+            # slices assemble into one global mesh-sharded array.  The
+            # kernel + psum merge are IDENTICAL to the single-host path.
+            if analysis._batch_specs(self.axis_name) is not None:
+                raise NotImplementedError(
+                    "atom-sharded (ring) kernels are single-controller "
+                    "for now; run frame-sharded analyses multi-host")
+            if self.transfer_dtype == "int16":
+                # each process quantizes its own slice with its own
+                # adaptive scale; a single per-batch inv_scale cannot
+                # represent that — float32 staging multi-host until a
+                # globally agreed scale is plumbed through
+                raise NotImplementedError(
+                    "transfer_dtype='int16' is single-controller for "
+                    "now; multi-host runs stage float32")
+            if analysis._device_combine is None:
+                # time-series analyses (out_specs=P(axis)) return arrays
+                # sharded across ALL hosts' devices; _conclude on one
+                # controller cannot fetch non-addressable shards — needs
+                # a process allgather before this family goes multi-host
+                raise NotImplementedError(
+                    f"{type(analysis).__name__} returns per-frame series "
+                    "(no _device_combine psum merge); multi-host support "
+                    "for time-series analyses is not yet implemented")
+            from mdanalysis_mpi_tpu.parallel.distributed import (
+                global_batch_from_local)
+
+            mesh = shardings[0].mesh
+
+            def put(padded, boxes, mask):
+                return (global_batch_from_local(padded, mesh, self.axis_name),
+                        global_batch_from_local(boxes, mesh, self.axis_name),
+                        global_batch_from_local(mask, mesh, self.axis_name))
+
+            return _run_batches(
+                analysis, reader, frames, global_bs,
+                lambda *staged: gfn(params, *staged), sel_idx,
+                device_put_fn=put, cache=self.block_cache,
+                quantize=False,      # int16 rejected above (global scale)
+                local_divisor=n_proc, local_index=jax.process_index())
 
         def put(padded, boxes, mask):
             return (jax.device_put(padded, shardings[0]),
