@@ -25,3 +25,10 @@ except ImportError:
     pass  # core-only tests (topology/selection) don't need JAX
 else:
     jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "tpu: on-chip hardware smoke tests (run with `pytest -m tpu` "
+        "or MDTPU_TPU_TESTS=1; skipped otherwise)")

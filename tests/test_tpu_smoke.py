@@ -1,0 +1,85 @@
+"""On-chip smoke test (`pytest -m tpu`) — VERDICT r1 missing #2.
+
+SURVEY.md §4 names "single-chip end-to-end runs as the hardware
+integration test".  The normal suite pins JAX to a virtual CPU platform
+(tests/conftest.py), so the real accelerator is exercised in a
+subprocess with the default environment: AlignedRMSF (both transfer
+dtypes) and the COMPILED Pallas RDF kernel (interpret mode is
+auto-disabled on TPU backends, ops/pallas_distances.py) are differenced
+against the serial f64 oracle on the chip.
+
+Selection: runs under ``pytest -m tpu`` or ``MDTPU_TPU_TESTS=1``;
+otherwise skipped (keeps the default suite hardware-independent and
+immune to accelerator-link weather).  Skips cleanly when no TPU is
+attached.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CHILD = r"""
+import sys
+sys.path.insert(0, sys.argv[1])
+import jax
+
+if jax.default_backend() not in ("tpu", "axon") and not any(
+        d.platform in ("tpu", "axon") for d in jax.devices()):
+    print("NO_TPU")
+    sys.exit(42)
+
+import numpy as np
+from mdanalysis_mpi_tpu.testing import make_solvated_universe, make_water_universe
+from mdanalysis_mpi_tpu.analysis import AlignedRMSF, InterRDF
+
+# --- AlignedRMSF on-chip vs serial oracle, both staging dtypes ---
+u = make_solvated_universe(n_frames=24)
+s = AlignedRMSF(u, select="protein and name CA").run(backend="serial")
+for tdtype, tol in (("float32", 1e-4), ("int16", 1e-3)):
+    a = AlignedRMSF(u, select="protein and name CA").run(
+        backend="jax", batch_size=8, transfer_dtype=tdtype)
+    err = float(np.abs(a.results.rmsf - s.results.rmsf).max())
+    assert err < tol, f"AlignedRMSF[{tdtype}] diverged on chip: {err:.2e}"
+    print(f"aligned_rmsf {tdtype} err {err:.2e}")
+
+# --- compiled Pallas RDF (interpret auto-off on TPU) vs serial ---
+uw = make_water_universe(n_waters=300, n_frames=4, seed=9)
+ow = uw.select_atoms("name OW")
+rp = InterRDF(ow, ow, nbins=50, range=(0.0, 8.0), engine="pallas").run(
+    backend="jax", batch_size=4)
+rs = InterRDF(ow, ow, nbins=50, range=(0.0, 8.0)).run(backend="serial")
+err = float(np.abs(rp.results.rdf - rs.results.rdf).max())
+assert err < 0.05, f"pallas RDF diverged on chip: {err:.2e}"
+print(f"pallas_rdf err {err:.2e}")
+print("TPU_SMOKE_OK")
+"""
+
+
+def _tpu_selected(config) -> bool:
+    if os.environ.get("MDTPU_TPU_TESTS") == "1":
+        return True
+    m = config.getoption("-m") or ""
+    return "tpu" in m and "not tpu" not in m
+
+
+@pytest.mark.tpu
+def test_on_chip_smoke(request, tmp_path):
+    if not _tpu_selected(request.config):
+        pytest.skip("on-chip smoke runs under 'pytest -m tpu' or "
+                    "MDTPU_TPU_TESTS=1")
+    script = tmp_path / "tpu_child.py"
+    script.write_text(CHILD)
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    proc = subprocess.run(
+        [sys.executable, str(script), REPO], env=env,
+        capture_output=True, text=True, timeout=540)
+    out = proc.stdout + proc.stderr
+    if proc.returncode == 42:
+        pytest.skip("no TPU attached")
+    assert proc.returncode == 0, f"on-chip smoke failed:\n{out[-4000:]}"
+    assert "TPU_SMOKE_OK" in proc.stdout
