@@ -138,9 +138,14 @@ class AtomGroup:
         else:
             raise ValueError(
                 f"level must be 'residue' or 'segment', got {level!r}")
-        uniq, inverse = np.unique(keys, return_inverse=True)
+        uniq, first, inverse = np.unique(keys, return_index=True,
+                                         return_inverse=True)
+        # parts in order of first occurrence (upstream split semantics),
+        # not np.unique's sorted-label order — matters for segids, which
+        # need not appear alphabetically
+        order = np.argsort(first, kind="stable")
         return [AtomGroup(self._universe, self._indices[inverse == k])
-                for k in range(len(uniq))]
+                for k in order]
 
     # ---- refinement & set algebra ----
 
@@ -205,9 +210,8 @@ class ResidueGroup:
         self._universe = universe
         self._resindices = np.unique(np.asarray(resindices, dtype=np.int64))
         top = universe.topology
-        # first atom of every residue in the topology (index by resindex)
-        _, first = np.unique(top.resindices, return_index=True)
-        self._first_atom = first[self._resindices]
+        # first atom of every residue (cached on the topology)
+        self._first_atom = top.residue_first_atom[self._resindices]
 
     @property
     def universe(self):

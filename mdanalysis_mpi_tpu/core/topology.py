@@ -90,9 +90,16 @@ class Topology:
         else:
             self.resindices = _check_len(
                 np.asarray(self.resindices, dtype=np.int64), "resindices")
-            # residue machinery indexes arrays positionally by resindex,
-            # so user-supplied values must be 0-based and gap-free
+            # residue machinery indexes arrays positionally by resindex
+            # and assumes a residue's atoms are contiguous in file order
+            # (n_residues = resindices[-1]+1, first-atom lookups), so
+            # user-supplied values must be 0-based, gap-free, AND
+            # non-decreasing
             if len(self.resindices):
+                if np.any(np.diff(self.resindices) < 0):
+                    raise ValueError(
+                        "resindices must be non-decreasing (each "
+                        "residue's atoms contiguous in file order)")
                 uniq = np.unique(self.resindices)
                 if uniq[0] != 0 or uniq[-1] != len(uniq) - 1:
                     raise ValueError(

@@ -249,10 +249,26 @@ def write_trr(path: str, coordinates: np.ndarray,
     if coords.ndim != 3 or coords.shape[2] != 3:
         raise ValueError(f"coordinates must be (F, N, 3), got {coords.shape}")
     nframes, natoms = coords.shape[:2]
+    # validate ALL per-frame metadata up front: a length mismatch
+    # surfacing as an IndexError mid-loop would leave a partial file
+    if times is not None and len(times) != nframes:
+        raise ValueError(
+            f"times has {len(times)} entries for {nframes} frames")
+    if steps is not None and len(steps) != nframes:
+        raise ValueError(
+            f"steps has {len(steps)} entries for {nframes} frames")
     if dimensions is not None:
         dimensions = np.asarray(dimensions)
         if dimensions.ndim == 1:
+            if dimensions.shape != (6,):
+                raise ValueError(
+                    f"dimensions must be (6,) or ({nframes}, 6), got "
+                    f"{dimensions.shape}")
             dimensions = np.broadcast_to(dimensions, (nframes, 6))
+        elif dimensions.shape != (nframes, 6):
+            raise ValueError(
+                f"dimensions must be (6,) or ({nframes}, 6), got "
+                f"{dimensions.shape}")
     with open(path, "wb") as f:
         for i in range(nframes):
             box_size = 36 if dimensions is not None else 0

@@ -24,30 +24,64 @@ more than crash recovery.
 
 from __future__ import annotations
 
+import hashlib
 import os
 
 import numpy as np
 
-from mdanalysis_mpi_tpu.parallel.executors import get_executor
+from mdanalysis_mpi_tpu.parallel.executors import (
+    JaxExecutor, MeshExecutor, get_executor,
+)
 from mdanalysis_mpi_tpu.parallel.partition import iter_batches
 
 
-def _save(path: str, frames_done: int, partials) -> None:
+def _fingerprint(analysis, frames) -> str:
+    """Stable identity of (analysis class, trajectory, frame window,
+    selection): a checkpoint written for anything else must refuse to
+    resume — same-shaped partials from a different run would merge
+    silently into wrong results.  sha256, not hash(): Python's string
+    hashing is salted per process and resume is by definition a new
+    process."""
+    reader = analysis._universe.trajectory
+    path = getattr(reader, "_path", None)
+    if path:
+        traj = f"{path}:{os.path.getmtime(path)}"
+    else:
+        traj = f"mem:{reader.n_frames}x{reader.n_atoms}"
+    h = hashlib.sha256()
+    h.update(type(analysis).__name__.encode())
+    h.update(traj.encode())
+    h.update(np.asarray(list(frames), dtype=np.int64).tobytes())
+    sel = analysis._batch_select()
+    if sel is not None:
+        h.update(np.ascontiguousarray(sel, dtype=np.int64).tobytes())
+    return h.hexdigest()
+
+
+def _save(path: str, frames_done: int, partials, fingerprint: str) -> None:
     import jax
 
     leaves = [np.asarray(x) for x in jax.tree.leaves(partials)]
     tmp = path + ".tmp.npz"     # np.savez appends .npz to bare names
     np.savez(tmp, frames_done=np.int64(frames_done),
+             fingerprint=np.str_(fingerprint),
              **{f"leaf_{i}": v for i, v in enumerate(leaves)})
     os.replace(tmp, path)       # atomic: a crash never half-writes
 
 
-def _load(path: str, structure):
+def _load(path: str, structure, fingerprint: str):
     import jax
 
     with np.load(path) as z:
+        saved_fp = str(z["fingerprint"]) if "fingerprint" in z.files else None
+        if saved_fp != fingerprint:
+            raise ValueError(
+                f"checkpoint {path!r} was written for a different "
+                "analysis/trajectory/frame window/selection — refusing "
+                "to resume (delete it to start over)")
         frames_done = int(z["frames_done"])
-        leaves = [z[f"leaf_{i}"] for i in range(len(z.files) - 1)]
+        leaves = [z[f"leaf_{i}"]
+                  for i in range(len(z.files) - 2)]   # - frames_done, fp
     treedef = jax.tree.structure(structure)
     if treedef.num_leaves != len(leaves):
         raise ValueError(
@@ -77,21 +111,26 @@ def run_checkpointed(analysis, path: str, chunk_frames: int = 4096,
             f"{type(analysis).__name__} has no mergeable partials "
             "(_device_fold_fn is None); checkpointing applies to "
             "reduction analyses only")
-    if backend == "serial":
-        raise ValueError(
-            "checkpointing needs per-chunk partials; the serial backend "
-            "accumulates inside the analysis — use backend='jax' or "
-            "'mesh' (the serial oracle is for short differential runs)")
     executor = get_executor(backend, **executor_kwargs)
+    if not isinstance(executor, (JaxExecutor, MeshExecutor)):
+        # whitelist, not blacklist: only the batch executors return
+        # per-call partials.  Serial AND MPI executors accumulate inside
+        # the analysis (each chunk's "partials" would contain all prior
+        # chunks, double-counting on fold).
+        raise ValueError(
+            "checkpointing needs an executor whose execute() returns "
+            "per-call partials — backend='jax' or 'mesh' (serial/mpi "
+            "backends accumulate inside the analysis)")
 
     frames = list(analysis._frames(start, stop, step))
     analysis.n_frames = len(frames)
     analysis._prepare()
+    fp = _fingerprint(analysis, frames)
 
     total = None
     done = 0
     if os.path.exists(path):
-        done, total = _load(path, analysis._identity_partials())
+        done, total = _load(path, analysis._identity_partials(), fp)
         if done > len(frames):
             raise ValueError(
                 f"checkpoint {path!r} covers {done} frames but this run "
@@ -101,7 +140,7 @@ def run_checkpointed(analysis, path: str, chunk_frames: int = 4096,
         partials = executor.execute(analysis, analysis._universe.trajectory,
                                     frames[a:b], batch_size=batch_size)
         total = partials if total is None else fold(total, partials)
-        _save(path, b, total)
+        _save(path, b, total, fp)
 
     if total is None:
         total = analysis._identity_partials()

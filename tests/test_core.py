@@ -195,3 +195,40 @@ class TestResidues:
                    for i in np.unique(resindices)]
         assert len(per_res) == 5
         assert all(np.isfinite(per_res))
+
+
+class TestAdviceR1Fixes:
+    """Regression pins for the round-1 advisor findings."""
+
+    def test_split_segment_order_of_appearance(self):
+        """split('segment') parts follow first occurrence in the group,
+        not alphabetical segid order (upstream AtomGroup.split)."""
+        from mdanalysis_mpi_tpu.core.topology import Topology
+        from mdanalysis_mpi_tpu.core.universe import Universe
+        from mdanalysis_mpi_tpu.io.memory import MemoryReader
+
+        names = np.array(["CA"] * 6)
+        segids = np.array(["ZZZ", "ZZZ", "AAA", "AAA", "MMM", "MMM"])
+        top = Topology(names=names, resnames=np.full(6, "ALA"),
+                       resids=np.array([1, 1, 2, 2, 3, 3]), segids=segids)
+        u = Universe(top, MemoryReader(np.zeros((1, 6, 3), np.float32)))
+        parts = u.atoms.split("segment")
+        assert [p.segids[0] for p in parts] == ["ZZZ", "AAA", "MMM"]
+
+    def test_nonmonotonic_resindices_rejected(self):
+        from mdanalysis_mpi_tpu.core.topology import Topology
+
+        with pytest.raises(ValueError, match="non-decreasing"):
+            Topology(names=np.array(["CA", "CB", "CC"]),
+                     resnames=np.full(3, "ALA"),
+                     resids=np.array([1, 2, 1]),
+                     resindices=np.array([0, 1, 0]))
+
+    def test_residue_group_uses_topology_cache(self):
+        from mdanalysis_mpi_tpu.testing import make_protein_universe
+
+        u = make_protein_universe(n_residues=4, n_frames=1)
+        res = u.select_atoms("protein").residues
+        top = u.topology
+        np.testing.assert_array_equal(
+            res._first_atom, top.residue_first_atom[res.resindices])

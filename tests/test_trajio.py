@@ -459,3 +459,21 @@ class TestAdaptiveQuantize:
         qp, sp = quantize_block(src[:, sel])
         np.testing.assert_array_equal(qn, qp)
         assert sn == sp
+
+
+class TestTRRWriteValidation:
+    """write_trr validates per-frame metadata lengths up front so a
+    mismatch cannot leave a partially written file (ADVICE r1)."""
+
+    def test_short_times_rejected_before_write(self, tmp_path):
+        from mdanalysis_mpi_tpu.io.trr import write_trr
+
+        path = tmp_path / "x.trr"
+        coords = np.zeros((4, 3, 3), np.float32)
+        with pytest.raises(ValueError, match="times"):
+            write_trr(str(path), coords, times=np.zeros(2))
+        with pytest.raises(ValueError, match="steps"):
+            write_trr(str(path), coords, steps=np.arange(3))
+        with pytest.raises(ValueError, match="dimensions"):
+            write_trr(str(path), coords, dimensions=np.zeros((2, 6)))
+        assert not path.exists()
