@@ -12,7 +12,10 @@ Grammar (recursive descent)::
     expr     := and_expr ('or' and_expr)*
     and_expr := not_expr ('and' not_expr)*
     not_expr := 'not' not_expr | primary
-    primary  := '(' expr ')' | 'around' number not_expr | keyword
+    primary  := '(' expr ')' | 'around' number not_expr
+              | 'sphzone' number not_expr | 'point' x y z number
+              | 'byres' not_expr | 'same' attr 'as' not_expr
+              | 'global' not_expr | keyword
     keyword  := 'all' | 'none' | 'protein' | 'backbone' | 'nucleic'
               | 'nucleicbackbone' | 'water' | 'hydrogen' | 'heavy'
               | ('name'|'resname'|'segid'|'element'|'type') value+
@@ -25,9 +28,24 @@ Grammar (recursive descent)::
 ``around R inner`` selects atoms within R Å of any atom matching
 ``inner`` (minimum-image under the current box when one is present),
 excluding ``inner`` itself — upstream's geometric AroundSelection.  It
-is the one keyword that needs coordinates: masks are evaluated against
-the Universe's *current* frame, so re-select after seeking if the
-geometry matters (upstream behaves the same way).
+needs coordinates: masks are evaluated against the Universe's *current*
+frame, so re-select after seeking if the geometry matters (upstream
+behaves the same way).  The other expansion keywords follow upstream's
+documented semantics (the dependency of RMSF.py:77 — users combine
+them with ``around`` constantly):
+
+- ``sphzone R inner`` — atoms within R Å of the center of geometry of
+  ``inner`` (inclusive: ``inner`` atoms inside the sphere stay).
+- ``point x y z R`` — atoms within R Å of the fixed point (x, y, z).
+- ``byres inner`` — expand to every atom of any residue containing an
+  ``inner`` atom.
+- ``same ATTR as inner`` — atoms whose ATTR (name, type, resname,
+  resid, resnum, segid, residue, mass, charge) equals that of any
+  ``inner`` atom.
+- ``global inner`` — evaluate ``inner`` against the whole universe even
+  inside ``AtomGroup.select_atoms`` (escapes group scoping, e.g.
+  ``waters.select_atoms("around 3.5 global protein")``); the final
+  result is still restricted to the group, as upstream does.
 
 Supported keyword semantics follow the documented MDAnalysis selection
 language for this subset; ``heavy`` = ``not hydrogen`` covers BASELINE
@@ -50,6 +68,7 @@ _RESERVED = {
     "water", "hydrogen", "heavy",
     "name", "resname", "segid", "element", "type", "resid", "resnum",
     "index", "bynum", "prop", "around",
+    "byres", "same", "as", "sphzone", "point", "global",
 }
 
 _TOKEN_RE = re.compile(r"\(|\)|[^\s()]+")
@@ -59,6 +78,12 @@ _GLOB_CHARS = re.compile(r"[*?\[\]]")
 
 class SelectionError(ValueError):
     """Raised for malformed selection strings."""
+
+
+class _GlobalMask(np.ndarray):
+    """Marker subclass: a mask produced by ``global`` — consumers
+    (``around``/``byres``/``same``/``sphzone``) must NOT re-intersect it
+    with the group scope."""
 
 
 class _Parser:
@@ -136,14 +161,33 @@ class _Parser:
                 raise SelectionError("unbalanced parentheses")
             return mask
         if tok == "around":
+            return self._around(self._cutoff(tok), self.not_expr())
+        if tok == "sphzone":
+            return self._sphzone(self._cutoff(tok), self.not_expr())
+        if tok == "point":
             try:
-                cutoff = float(self.next())
+                x, y, z = (float(self.next()) for _ in range(3))
             except ValueError as e:
                 raise SelectionError(
-                    f"'around' needs a numeric cutoff: {e}") from e
-            if cutoff < 0:
-                raise SelectionError(f"negative 'around' cutoff {cutoff}")
-            return self._around(cutoff, self.not_expr())
+                    f"'point' needs x y z coordinates: {e}") from e
+            return self._point(np.array([x, y, z], np.float32),
+                               self._cutoff(tok))
+        if tok == "byres":
+            return self._byres(self.not_expr())
+        if tok == "same":
+            return self._same()
+        if tok == "global":
+            # escape group scoping for the operand (upstream 'global'):
+            # inner sub-selections see the whole universe AND the result
+            # is marked so enclosing geometric/expansion keywords skip
+            # their own scope intersection; the caller's final group
+            # intersection still applies
+            saved = self.scope
+            self.scope = None
+            try:
+                return self.not_expr().view(_GlobalMask)
+            finally:
+                self.scope = saved
         if tok == "all":
             return np.ones(t.n_atoms, dtype=bool)
         if tok == "none":
@@ -176,6 +220,87 @@ class _Parser:
             return self._prop()
         raise SelectionError(f"unknown selection keyword {tok!r}")
 
+    def _cutoff(self, kw: str) -> float:
+        try:
+            cutoff = float(self.next())
+        except ValueError as e:
+            raise SelectionError(f"{kw!r} needs a numeric cutoff: {e}") from e
+        if cutoff < 0:
+            raise SelectionError(f"negative {kw!r} cutoff {cutoff}")
+        return cutoff
+
+    def _scoped(self, inner: np.ndarray) -> np.ndarray:
+        """Group-scope an inner sub-selection mask — unless it came from
+        ``global`` (see :class:`_GlobalMask`)."""
+        if self.scope is not None and not isinstance(inner, _GlobalMask):
+            return inner & self.scope
+        return np.asarray(inner)
+
+    def _byres(self, inner: np.ndarray) -> np.ndarray:
+        """Expand to whole residues (upstream ByResSelection): every atom
+        of any residue with an ``inner`` atom."""
+        inner = self._scoped(inner)
+        hit = np.unique(self.top.resindices[inner])
+        return np.isin(self.top.resindices, hit)
+
+    _SAME_ATTRS = ("name", "type", "resname", "resid", "resnum", "segid",
+                   "residue", "segment", "mass", "charge")
+
+    def _same(self) -> np.ndarray:
+        """``same ATTR as inner`` (upstream SameSubSelection): atoms
+        whose ATTR equals that of any ``inner`` atom."""
+        what = self.next()
+        if what not in self._SAME_ATTRS:
+            raise SelectionError(
+                f"'same {what} as' unsupported; attrs: "
+                f"{', '.join(self._SAME_ATTRS)}")
+        if self.next() != "as":
+            raise SelectionError(f"'same {what}' must be followed by 'as'")
+        t = self.top
+        if what == "charge" and t.charges is None:
+            raise SelectionError("topology has no charges for 'same charge as'")
+        attr = {"name": t.names, "type": t.elements, "resname": t.resnames,
+                "resid": t.resids, "resnum": t.resids, "segid": t.segids,
+                "residue": t.resindices, "segment": t.segids,
+                "mass": t.masses, "charge": t.charges}[what]
+        inner = self._scoped(self.not_expr())
+        if not inner.any():
+            return np.zeros_like(inner)
+        return np.isin(attr, np.unique(attr[inner]))
+
+    def _sphere(self, center: np.ndarray, cutoff: float) -> np.ndarray:
+        """Atoms within ``cutoff`` of ``center`` (minimum image)."""
+        positions, box = self._coords()
+        if positions is None:
+            raise SelectionError(
+                "geometric selections need coordinates; select through a "
+                "Universe/AtomGroup (not bare select_mask on a Topology)")
+        from mdanalysis_mpi_tpu.ops.host import minimum_image
+
+        pos = np.asarray(positions, dtype=np.float32)
+        box = None if box is None else np.asarray(box, np.float64)
+        disp = minimum_image(pos - np.asarray(center, np.float32), box)
+        d2 = np.einsum("ai,ai->a", disp, disp)
+        return d2 <= np.float64(cutoff) ** 2
+
+    def _sphzone(self, cutoff: float, inner: np.ndarray) -> np.ndarray:
+        """Atoms within ``cutoff`` of the center of geometry of ``inner``
+        (upstream SphericalZoneSelection — inclusive of ``inner``)."""
+        inner = self._scoped(inner)
+        if not inner.any():
+            return np.zeros_like(inner)
+        positions, _ = self._coords()
+        if positions is None:
+            raise SelectionError(
+                "'sphzone' is a geometric selection and needs coordinates")
+        center = np.asarray(positions, np.float64)[inner].mean(axis=0)
+        return self._sphere(center, cutoff)
+
+    def _point(self, xyz: np.ndarray, cutoff: float) -> np.ndarray:
+        """Atoms within ``cutoff`` of a fixed point (upstream
+        PointSelection)."""
+        return self._sphere(xyz, cutoff)
+
     def _around(self, cutoff: float, inner: np.ndarray) -> np.ndarray:
         """Atoms within ``cutoff`` of any atom in ``inner`` (exclusive).
 
@@ -190,8 +315,7 @@ class _Parser:
                 "'around' is a geometric selection and needs coordinates; "
                 "select through a Universe/AtomGroup (not bare select_mask "
                 "on a Topology)")
-        if self.scope is not None:
-            inner = inner & self.scope
+        inner = self._scoped(inner)
         if not inner.any():
             return np.zeros_like(inner)
         from mdanalysis_mpi_tpu.ops.host import minimum_image

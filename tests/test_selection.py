@@ -192,3 +192,99 @@ def test_around_group_scoped_inner():
     np.testing.assert_array_equal(scoped.indices,
                                   globl.indices[np.isin(globl.indices,
                                                         both.indices)])
+
+
+# ---- expansion keywords (byres / same..as / sphzone / point / global) ----
+
+BYRES_SAME_CASES = [
+    # byres expands to whole residues (upstream ByResSelection)
+    ("byres name CA", [0, 1, 2, 3, 4]),           # GLY residue via its CA
+    ("byres name OW", [5, 6, 7]),                 # the water residue
+    ("byres (name CA or name P)", [0, 1, 2, 3, 4, 9, 10, 11, 12]),
+    ("byres none", []),
+    # same ATTR as (upstream SameSubSelection)
+    ("same resname as name OW", [5, 6, 7]),
+    ("same resid as name HA", [0, 1, 2, 3, 4]),
+    ("same segid as name P", [9, 10, 11, 12]),
+    ("same residue as name C5'", [9, 10, 11, 12]),
+    ("same name as index 1", [1]),                # only one CA here
+    ("same mass as name HW1", [4, 6, 7]),         # every hydrogen
+    ("same resname as none", []),
+]
+
+
+@pytest.mark.parametrize("sel,expected", BYRES_SAME_CASES,
+                         ids=[c[0] for c in BYRES_SAME_CASES])
+def test_expansion_table(top, sel, expected):
+    np.testing.assert_array_equal(select(top, sel), expected)
+
+
+def test_same_errors(top):
+    with pytest.raises(SelectionError, match="unsupported"):
+        select(top, "same bogus as name CA")
+    with pytest.raises(SelectionError, match="'as'"):
+        select(top, "same resid name CA")
+    with pytest.raises(SelectionError, match="charges"):
+        select(top, "same charge as name CA")
+
+
+class TestGeometricZones:
+    def _universe(self):
+        from mdanalysis_mpi_tpu.core.topology import Topology
+        from mdanalysis_mpi_tpu.core.universe import Universe
+        from mdanalysis_mpi_tpu.io.memory import MemoryReader
+
+        names = np.array(["CA", "CA", "OW", "OW", "OW"])
+        resnames = np.array(["ALA", "ALA", "SOL", "SOL", "SOL"])
+        resids = np.array([1, 2, 3, 4, 5])
+        top = Topology(names=names, resnames=resnames, resids=resids)
+        pos = np.array([
+            [1.0, 0.0, 0.0],
+            [3.0, 0.0, 0.0],     # protein cog = (2, 0, 0)
+            [4.0, 0.0, 0.0],     # 2 A from cog
+            [9.0, 0.0, 0.0],     # 7 A from cog
+            [19.5, 0.0, 0.0],    # 2.5 A from cog via PBC (box 20)
+        ], dtype=np.float32)
+        dims = np.array([20, 20, 20, 90, 90, 90], np.float32)
+        return Universe(top, MemoryReader(pos[None], dimensions=dims))
+
+    def test_sphzone_inclusive_of_inner(self):
+        u = self._universe()
+        # sphere of 3 A around protein cog (2,0,0): both CA (1 and 1 A),
+        # OW at 2 A, OW at 2.5 A via the periodic image
+        got = u.select_atoms("sphzone 3.0 protein")
+        assert list(got.indices) == [0, 1, 2, 4]
+
+    def test_point_fixed_center(self):
+        u = self._universe()
+        got = u.select_atoms("point 9.0 0.0 0.0 1.5")
+        assert list(got.indices) == [3]
+        # periodic wrap: point near the box edge reaches across
+        got = u.select_atoms("point 0.0 0.0 0.0 2.0")
+        assert list(got.indices) == [0, 4]
+
+    def test_sphzone_requires_coordinates(self, ):
+        from mdanalysis_mpi_tpu.core.selection import select as bare_select
+        from mdanalysis_mpi_tpu.core.topology import Topology
+        t = Topology(names=np.array(["CA"]), resnames=np.array(["ALA"]),
+                     resids=np.array([1]))
+        with pytest.raises(SelectionError, match="coordinates"):
+            bare_select(t, "sphzone 3.0 name CA")
+
+    def test_global_escapes_group_scope(self):
+        u = self._universe()
+        waters = u.select_atoms("resname SOL")
+        # scoped: no protein inside the group -> empty
+        assert waters.select_atoms("around 3.0 protein").n_atoms == 0
+        # global: the inner selection sees the whole universe; result is
+        # still restricted to the group (upstream semantics)
+        got = waters.select_atoms("around 3.0 global protein")
+        assert list(got.indices) == [2, 4]
+
+    def test_byres_scoped_to_group(self):
+        u = self._universe()
+        waters = u.select_atoms("resname SOL")
+        # inner 'name CA' matches nothing inside the group
+        assert waters.select_atoms("byres name CA").n_atoms == 0
+        assert waters.select_atoms("byres global name CA").n_atoms == 0  # CA residues hold no waters
+        assert list(waters.select_atoms("byres name OW").indices) == [2, 3, 4]
