@@ -20,6 +20,15 @@ is a cheap header-hop scan (cached to disk like the XTC index).  Only
 positions and box are returned; velocities/forces are skipped by
 offset.  Coordinates convert nm→Å at the boundary, matching the rest
 of the io layer.
+
+Throughput class (measured, 100 frames × 50k atoms, this host):
+``read_block`` decodes one contiguous file read via vectorized
+``np.frombuffer``/``astype`` — 1004 frames/s vs 356 f/s for the C++
+XTC codec (which pays 3dfcoord bit-unpacking) and 2229 f/s for C++
+DCD; random single-frame reads 0.5 ms vs XTC's 3 ms.  The NumPy
+decode is NOT the slow path of the trio, so no native codec is
+warranted; the cost is the big-endian→native byteswap at memory
+bandwidth.  TRR files are ~2.1× larger than XTC for the same data.
 """
 
 from __future__ import annotations
