@@ -30,11 +30,43 @@ def parse(path: str) -> Topology:
     return parser(path)
 
 
+def _tpr(path: str) -> Topology:
+    """TPR (GROMACS portable run input) — documented conversion path.
+
+    The serial oracle's docstring opens ``mda.Universe(TPR, XTC)``
+    (RMSF.py:8).  TPR is a versioned binary serialization of the whole
+    run input (tpx body: full mtop, force field, integrator state)
+    whose layout changes between GROMACS releases; a parser that cannot
+    be validated against real files from multiple GROMACS versions
+    would be worse than none.  Convert once next to your trajectory —
+    every GROMACS install can do it — and open the result:
+
+        gmx editconf -f topol.tpr -o topol.gro     # coordinates+names
+        # or, for a PDB with chain ids:
+        gmx editconf -f topol.tpr -o topol.pdb
+
+    then ``Universe("topol.gro", "traj.xtc")``.
+    """
+    raise ValueError(
+        f"TPR files are not parsed directly ({path}); convert once with "
+        "'gmx editconf -f topol.tpr -o topol.gro' (or -o topol.pdb) and "
+        "open the GRO/PDB — see io/topology_files.py:_tpr for why")
+
+
+_autoloaded = False
+
+
 def _autoload():
-    """Import parser modules lazily so core has no hard format deps."""
-    if _PARSERS:
+    """Import parser modules lazily so core has no hard format deps.
+    Guarded by a flag, not ``_PARSERS`` truthiness: a format module
+    imported directly self-registers before the first ``parse`` call,
+    which must not suppress the remaining registrations."""
+    global _autoloaded
+    if _autoloaded:
         return
+    _autoloaded = True
     try:
         from mdanalysis_mpi_tpu.io import gro, pdb, psf  # noqa: F401  (self-register)
     except ImportError:
         pass
+    register("tpr", _tpr)

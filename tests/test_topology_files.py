@@ -140,3 +140,14 @@ class TestPDB:
         p.write_text("END\n")
         with pytest.raises(ValueError, match="no ATOM"):
             parse_pdb(str(p))
+
+
+def test_tpr_conversion_path_documented(tmp_path):
+    """TPR (RMSF.py:8) resolves to an actionable conversion message, not
+    an unknown-format error."""
+    from mdanalysis_mpi_tpu.io import topology_files
+
+    p = tmp_path / "topol.tpr"
+    p.write_bytes(b"\x00" * 16)
+    with pytest.raises(ValueError, match="gmx editconf"):
+        topology_files.parse(str(p))
