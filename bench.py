@@ -2,19 +2,41 @@
 """Benchmark: frames/sec/chip on the 100k-atom RMSF (BASELINE.json metric).
 
 Runs the flagship pipeline — AlignedRMSF (average structure + aligned
-Welford moments, the reference program RMSF.py:53-149) — on a synthetic
-100k-atom solvated-protein system with the "all heavy atoms" selection
-(BASELINE config 2) on the real accelerator, and compares against the
-8-rank MPI baseline.
+Welford moments, the reference program RMSF.py:53-149) — at BASELINE
+config 2's stated scale: a 100k-atom solvated-protein-like system,
+"all heavy atoms" selection, **10k-frame XTC read from disk** through
+the C++ decoder (the reference's dominant per-frame cost is exactly
+this re-decode, RMSF.py:92,124), on the real accelerator.
 
-Baseline note (BASELINE.md): the reference publishes no numbers and this
-environment has no MPI, so the baseline is this repo's own serial NumPy
-backend (algorithmically the reference's per-rank loop: QCP rotation +
-rotate + Welford per frame) measured per-process and scaled by 8 for an
-*ideal* 8-rank MPI machine — a deliberately generous stand-in.
+Three numbers, one stable series (VERDICT r2 "stabilize the metric
+series"):
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-Env knobs: BENCH_ATOMS, BENCH_FRAMES, BENCH_BATCH, BENCH_SERIAL_FRAMES.
+- ``value`` (headline) — steady-state frames/s/chip with the staged
+  int16 blocks HBM-resident in a DeviceBlockCache shared across run()
+  calls (disclosed in the metric string).  This is the re-analysis
+  workload — the staging-layer image of the upstream oracle's
+  ``in_memory=True`` idiom (RMSF.py:12) — and it is deliberately
+  independent of host-link weather: repeat runs move no host→device
+  bytes, so the 0.2-vs-2 GB/s tunnel variance that swung rounds 1-2
+  cannot touch it.
+- ``cold_value`` — the same file-backed run with every cache empty:
+  XTC decode + gather/quantize + wire + compute; what a one-shot user
+  pays first.
+- ``f32_nocache_value`` — the round-1-comparable leg: 512-frame
+  in-memory trajectory, float32 staging, host cache cleared per run,
+  no cross-run device cache.  Comparable to BENCH_r01.json's number.
+
+Baseline note (BASELINE.md): the reference publishes no numbers and
+this environment has no MPI, so ``vs_baseline`` keeps the r01/r02
+definition — 8 × this repo's serial NumPy backend on an IN-MEMORY
+trajectory (ideal 8-rank MPI machine with free I/O; deliberately
+generous to the reference).  ``file_baseline_fps`` additionally
+reports 8 × the serial rank on the real XTC (decode included — what
+the reference's ranks actually pay, RMSF.py:92,124).
+
+Prints ONE JSON line.  Env knobs: BENCH_ATOMS, BENCH_FRAMES,
+BENCH_BATCH, BENCH_SERIAL_FRAMES, BENCH_REPEATS, BENCH_TRANSFER,
+BENCH_SOURCE=file|memory.
 """
 
 import json
@@ -40,121 +62,241 @@ from mdanalysis_mpi_tpu.io.memory import MemoryReader  # noqa: E402
 from mdanalysis_mpi_tpu.analysis import AlignedRMSF    # noqa: E402
 
 N_ATOMS = int(os.environ.get("BENCH_ATOMS", 100_000))
-N_FRAMES = int(os.environ.get("BENCH_FRAMES", 512))
+N_FRAMES = int(os.environ.get("BENCH_FRAMES", 10_000))
 BATCH = int(os.environ.get("BENCH_BATCH", 64))
 SERIAL_FRAMES = int(os.environ.get("BENCH_SERIAL_FRAMES", 32))
 SELECT = os.environ.get("BENCH_SELECT", "heavy")
 REPEATS = int(os.environ.get("BENCH_REPEATS", 7))
+SOURCE = os.environ.get("BENCH_SOURCE", "file")   # file | memory
+R01_FRAMES = 512                                  # the r01 leg's window
+DATA_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        ".bench_data")
+# Bump when the fixture generator (_frame_chunk params, base scale,
+# write precision) changes — part of the on-disk fixture's cache key so
+# a stale trajectory is never silently reused across generator edits.
+FIXTURE_GEN = 1
 
 
-def make_system(n_atoms: int, n_frames: int, seed: int = 0) -> Universe:
-    """100k-atom solvated-protein-like system: ~50% heavy atoms, rigid
-    tumbling + thermal noise (the BASELINE config-2 shape)."""
-    rng = np.random.default_rng(seed)
+def _note(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def make_topology(n_atoms: int) -> Topology:
+    """Residues of (CA, CB, HA, HB) → half heavy, half hydrogen — the
+    BASELINE config-2 'all heavy atoms' selection shape."""
     n_res = n_atoms // 4
-    # residues of (CA, CB, HA, HB) → half heavy, half hydrogen
-    names = np.tile(np.array(["CA", "CB", "HA", "HB"]), n_res)[:n_atoms]
+    names = np.tile(np.array(["CA", "CB", "HA", "HB"]), n_res + 1)[:n_atoms]
     resnames = np.full(n_atoms, "ALA")
     resids = np.arange(n_atoms) // 4 + 1
-    top = Topology(names=names, resnames=resnames, resids=resids)
+    return Topology(names=names, resnames=resnames, resids=resids)
 
-    base = rng.normal(scale=20.0, size=(n_atoms, 3)).astype(np.float32)
-    base -= base.mean(axis=0)
-    # per-frame small rotations + noise, generated in one vectorized shot
-    angles = rng.normal(scale=0.1, size=n_frames)
+
+def _frame_chunk(base: np.ndarray, lo: int, hi: int,
+                 rng: np.random.Generator) -> np.ndarray:
+    """Frames [lo, hi): rigid tumbling + thermal noise (vectorized)."""
+    angles = rng.normal(scale=0.1, size=hi - lo)
     cos, sin = np.cos(angles), np.sin(angles)
-    rots = np.zeros((n_frames, 3, 3), dtype=np.float32)
+    rots = np.zeros((hi - lo, 3, 3), dtype=np.float32)
     rots[:, 0, 0] = cos; rots[:, 0, 1] = -sin
     rots[:, 1, 0] = sin; rots[:, 1, 1] = cos
     rots[:, 2, 2] = 1.0
     frames = np.einsum("ni,fij->fnj", base, rots)
     frames += rng.normal(scale=0.3, size=frames.shape).astype(np.float32)
-    return Universe(top, MemoryReader(frames))
+    return frames
 
 
-def main():
-    u = make_system(N_ATOMS, N_FRAMES)
+def make_system(n_atoms: int, n_frames: int, seed: int = 0) -> Universe:
+    """In-memory 100k-atom system (the r01-comparable leg's source)."""
+    rng = np.random.default_rng(seed)
+    base = rng.normal(scale=20.0, size=(n_atoms, 3)).astype(np.float32)
+    base -= base.mean(axis=0)
+    frames = _frame_chunk(base, 0, n_frames, rng)
+    return Universe(make_topology(n_atoms), MemoryReader(frames))
 
-    # --- serial NumPy stand-in for one MPI rank, measured FIRST: once
-    # the accelerator path runs, the tunnel client process competes for
-    # this host's single core and the serial number swings 3-4x.
-    # Median of 3 with a one-frame warm-up (page-in, native lib build).
+
+def ensure_flagship_xtc(n_atoms: int, n_frames: int, seed: int = 0) -> str:
+    """Write (once, cached on disk) the flagship trajectory as a real
+    XTC so the timed cold path includes the C++ XDR/3dfcoord decode —
+    the reference's per-frame cost (RMSF.py:92,124).  Streamed in
+    chunks: XTC frames are self-delimiting, so chunk files concatenate
+    byte-wise into one valid trajectory."""
+    from mdanalysis_mpi_tpu.io.xtc import write_xtc
+
+    os.makedirs(DATA_DIR, exist_ok=True)
+    path = os.path.join(
+        DATA_DIR,
+        f"flagship_{n_atoms}a_{n_frames}f_s{seed}_g{FIXTURE_GEN}.xtc")
+    if os.path.exists(path):
+        return path
+    _note(f"[bench] generating {n_frames}-frame {n_atoms}-atom XTC "
+          f"fixture at {path} (one-time)...")
+    t0 = time.perf_counter()
+    rng = np.random.default_rng(seed)
+    base = rng.normal(scale=20.0, size=(n_atoms, 3)).astype(np.float32)
+    base -= base.mean(axis=0)
+    dims = np.array([120.0, 120.0, 120.0, 90.0, 90.0, 90.0])
+    tmp = path + ".part"
+    chunk_tmp = path + ".chunk"
+    chunk = 500
+    try:
+        with open(tmp, "wb") as out:
+            for lo in range(0, n_frames, chunk):
+                hi = min(lo + chunk, n_frames)
+                frames = _frame_chunk(base, lo, hi, rng)
+                write_xtc(chunk_tmp, frames, dimensions=dims,
+                          times=np.arange(lo, hi, dtype=np.float32),
+                          steps=np.arange(lo, hi, dtype=np.int32))
+                with open(chunk_tmp, "rb") as f:
+                    out.write(f.read())
+        os.replace(tmp, path)
+    finally:
+        for p in (tmp, chunk_tmp):
+            if os.path.exists(p):
+                os.remove(p)
+    _note(f"[bench] fixture written in {time.perf_counter() - t0:.0f}s "
+          f"({os.path.getsize(path) / 1e6:.0f} MB)")
+    return path
+
+
+def open_flagship(n_atoms: int, n_frames: int) -> Universe:
+    if SOURCE == "memory":
+        return make_system(n_atoms, n_frames)
+    from mdanalysis_mpi_tpu.io.xtc import XTCReader
+
+    path = ensure_flagship_xtc(n_atoms, n_frames)
+    reader = XTCReader(path)
+    if reader.n_frames != n_frames:
+        raise RuntimeError(
+            f"fixture {path} has {reader.n_frames} frames, expected "
+            f"{n_frames}; delete it to regenerate")
+    return Universe(make_topology(n_atoms), reader)
+
+
+def clear_host_caches(u: Universe) -> None:
+    u.trajectory.__dict__.pop("_host_stage_cache", None)
+    u.trajectory.__dict__.pop("_quant_max_hints", None)
+
+
+def timed_serial(u: Universe, repeats: int = 3):
+    """Median serial-backend wall over a SERIAL_FRAMES window (one
+    warm-up frame first: page-in + native lib load)."""
     AlignedRMSF(u, select=SELECT).run(stop=1, backend="serial")
-    serial_walls = []
-    for _ in range(3):
+    walls = []
+    s = None
+    for _ in range(repeats):
         t0 = time.perf_counter()
         s = AlignedRMSF(u, select=SELECT).run(
             stop=SERIAL_FRAMES, backend="serial")
-        serial_walls.append(time.perf_counter() - t0)
-    serial_fps = SERIAL_FRAMES / float(np.median(serial_walls))
-    baseline_fps = 8 * serial_fps          # ideal 8-rank MPI
+        walls.append(time.perf_counter() - t0)
+    return SERIAL_FRAMES / float(np.median(walls)), s
 
-    # --- accelerator path: single chip → backend="jax"; more chips →
-    # backend="mesh" over all of them, value normalized per chip ---
+
+def main():
     import jax
 
     n_chips = len(jax.devices())
     accel_backend = "jax" if n_chips == 1 else "mesh"
-    # int16 staging is the default: with the host staged-block cache
-    # (io/base.py:HostStageCache) the gather+quantize is paid once per
-    # (trajectory, selection) and steady-state staging is pure wire
-    # serialization — where int16's halved bytes win in BOTH link-weather
-    # regimes (measured round 2: 3366 f/s int16 vs 581-1255 f/s f32; see
-    # PERF.md for the full phase decomposition).
     tdtype = os.environ.get("BENCH_TRANSFER", "int16")
-    # warm-up: compile both passes on a short window.  No result is read
-    # back anywhere before the timed runs finish: on this tunneled TPU a
-    # single device→host fetch collapses host→device throughput ~40× for
-    # the rest of the process (analysis.base.Deferred), which would turn
-    # the measurement into a measurement of the collapsed link.
-    AlignedRMSF(u, select=SELECT).run(
+
+    # --- serial NumPy stand-ins for one MPI rank, measured FIRST: once
+    # the accelerator path runs, the tunnel client process competes for
+    # this host's single core and the serial number swings 3-4x. ---
+    u_mem = make_system(N_ATOMS, R01_FRAMES)
+    serial_fps, _ = timed_serial(u_mem)
+    baseline_fps = 8 * serial_fps          # ideal 8-rank MPI, free I/O
+    _note(f"[bench] serial (in-memory) {serial_fps:.1f} f/s -> baseline "
+          f"{baseline_fps:.1f}")
+
+    u_file = open_flagship(N_ATOMS, N_FRAMES)
+    serial_file_fps, s_oracle = timed_serial(u_file)
+    file_baseline_fps = 8 * serial_file_fps   # ranks that decode XTC
+    _note(f"[bench] serial (file-backed) {serial_file_fps:.1f} f/s")
+
+    # --- r01-comparable leg: f32 staging, host cache cleared per run,
+    # fresh per-run device cache (AlignedRMSF default), in-memory 512
+    # frames — the BENCH_r01.json configuration. ---
+    AlignedRMSF(u_mem, select=SELECT).run(          # compile warm-up
+        stop=2 * BATCH, backend=accel_backend, batch_size=BATCH,
+        transfer_dtype="float32")
+    r01_walls = []
+    for _ in range(3):
+        clear_host_caches(u_mem)
+        t0 = time.perf_counter()
+        r = AlignedRMSF(u_mem, select=SELECT).run(
+            backend=accel_backend, batch_size=BATCH,
+            transfer_dtype="float32")
+        jax.block_until_ready(r.results["rmsf"])
+        r01_walls.append(time.perf_counter() - t0)
+    f32_nocache_fps = R01_FRAMES / float(np.median(r01_walls)) / n_chips
+    _note(f"[bench] r01-comparable f32 no-cache: {f32_nocache_fps:.1f} "
+          f"f/s/chip")
+
+    # --- flagship, file-backed.  One persistent HBM DeviceBlockCache is
+    # shared across every run below (VERDICT r2 next-round #1): the cold
+    # run populates it (so cold honestly includes that overhead) and the
+    # steady-state repeats read staged int16 blocks from HBM — no decode,
+    # no gather, no wire. ---
+    from mdanalysis_mpi_tpu.parallel.executors import DeviceBlockCache
+
+    dev_cache = DeviceBlockCache(max_bytes=8 << 30)
+    # int16-path compile warm-up on a short window (throwaway cache so
+    # the persistent one stays cold for the timed cold run)
+    AlignedRMSF(u_file, select=SELECT).run(
         stop=2 * BATCH, backend=accel_backend, batch_size=BATCH,
         transfer_dtype=tdtype)
-    # cold run: host stage cache cleared (compiles stay warm) — the
-    # first-analysis cost a one-shot user pays, reported alongside the
-    # steady-state headline so the cache's contribution is explicit
-    u.trajectory.__dict__.pop("_host_stage_cache", None)
-    u.trajectory.__dict__.pop("_quant_max_hint", None)
+    clear_host_caches(u_file)
+
+    # cold: every cache empty; decode + stage + wire + compute.  No
+    # result is read back inside any timed region: on this tunneled TPU
+    # a single device→host fetch collapses host→device throughput ~40×
+    # for the rest of the process (analysis.base.Deferred).
     t0 = time.perf_counter()
-    r = AlignedRMSF(u, select=SELECT).run(backend=accel_backend,
-                                          batch_size=BATCH,
-                                          transfer_dtype=tdtype)
+    r = AlignedRMSF(u_file, select=SELECT).run(
+        backend=accel_backend, batch_size=BATCH, transfer_dtype=tdtype,
+        block_cache=dev_cache)
     jax.block_until_ready(r.results["rmsf"])
     cold_fps = N_FRAMES / (time.perf_counter() - t0) / n_chips
-    # median of REPEATS: the tunneled TPU target shows multi-x run-to-run
-    # variance (shared link), so a single sample is mostly noise.
-    # Steady state: repeat runs over the same (trajectory, selection)
-    # serve gather+quantize from the reader's HostStageCache and pay
-    # only wire serialization + compute (BASELINE.md methodology).
+    _note(f"[bench] cold (file-backed, {tdtype}): {cold_fps:.1f} f/s/chip")
+
+    # steady state: HBM-resident staged blocks (shared DeviceBlockCache),
+    # median of REPEATS — by construction independent of link weather.
     walls = []
     for _ in range(REPEATS):
         t0 = time.perf_counter()
-        r = AlignedRMSF(u, select=SELECT).run(backend=accel_backend,
-                                              batch_size=BATCH,
-                                              transfer_dtype=tdtype)
-        # drain the async dispatch queue (device-side wait, not a fetch)
+        r = AlignedRMSF(u_file, select=SELECT).run(
+            backend=accel_backend, batch_size=BATCH,
+            transfer_dtype=tdtype, block_cache=dev_cache)
         jax.block_until_ready(r.results["rmsf"])
         walls.append(time.perf_counter() - t0)
-    wall = float(np.median(walls))
-    fps_per_chip = N_FRAMES / wall / n_chips
+    fps_per_chip = N_FRAMES / float(np.median(walls)) / n_chips
+    _note(f"[bench] steady (HBM-resident): {fps_per_chip:.1f} f/s/chip; "
+          f"cache hits/misses: {dev_cache.hits}/{dev_cache.misses}")
 
     # sanity: accelerator backend (same transfer dtype as the timed path)
-    # must agree with the serial f64 oracle.  A wrong-but-fast kernel must
-    # not score: divergence is a hard failure the driver's JSON parse and
-    # exit code both see (VERDICT r1 weak #3).
-    r_short = AlignedRMSF(u, select=SELECT).run(
-        stop=SERIAL_FRAMES, backend=accel_backend,
-        batch_size=SERIAL_FRAMES, transfer_dtype=tdtype)
-    err = float(np.abs(r_short.results.rmsf - s.results.rmsf).max())
+    # must agree with the serial f64 oracle over the same window.  A
+    # wrong-but-fast kernel must not score: divergence is a hard failure
+    # the driver's JSON parse and exit code both see (VERDICT r1 weak #3).
+    r_short = AlignedRMSF(u_file, select=SELECT).run(
+        stop=SERIAL_FRAMES, backend=accel_backend, batch_size=BATCH,
+        transfer_dtype=tdtype)
+    err = float(np.abs(r_short.results.rmsf - s_oracle.results.rmsf).max())
     result = {
         "metric": f"frames/sec/chip, {N_ATOMS}-atom heavy-atom AlignedRMSF "
-                  f"({N_FRAMES} frames, batch {BATCH}, {n_chips} chip(s), "
-                  f"{tdtype} staging, steady-state)",
+                  f"({N_FRAMES}-frame file-backed XTC, batch {BATCH}, "
+                  f"{n_chips} chip(s), {tdtype} staging, steady-state: "
+                  f"staged blocks HBM-resident across runs)",
         "value": round(fps_per_chip, 2),
         "unit": "frames/s/chip",
         "vs_baseline": round(fps_per_chip / baseline_fps, 2),
         "cold_value": round(cold_fps, 2),
         "cold_vs_baseline": round(cold_fps / baseline_fps, 2),
+        "f32_nocache_value": round(f32_nocache_fps, 2),
+        "f32_nocache_vs_baseline": round(f32_nocache_fps / baseline_fps, 2),
+        "serial_fps": round(serial_fps, 2),
+        "serial_file_fps": round(serial_file_fps, 2),
+        "baseline_fps": round(baseline_fps, 2),
+        "file_baseline_fps": round(file_baseline_fps, 2),
+        "cold_vs_file_baseline": round(cold_fps / file_baseline_fps, 2),
         "divergence": err,
     }
     # "not (err <= tol)": NaN must fail the gate, not sail through it

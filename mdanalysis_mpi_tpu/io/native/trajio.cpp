@@ -107,35 +107,37 @@ struct BitWriter {
 };
 
 struct BitReader {
+    // 64-bit accumulator bit reader (MSB-first stream).  The stream's
+    // next unread bit sits at bit 63 of `acc`; refills are greedy
+    // (top up to >56 valid bits whenever a read finds too few), so the
+    // per-call cost is a shift/mask pair instead of the byte-at-a-time
+    // fetch loop the format's reference decoders use — the decode hot
+    // path (receiveints) calls this for every 8-bit digit.  Reads past
+    // the end deliver zero bits and flip `ok` (checked per atom group).
     const unsigned char* data;
     size_t n;
-    size_t cnt = 0;
-    unsigned int lastbits = 0;
-    unsigned int lastbyte = 0;
+    size_t pos = 0;
+    uint64_t acc = 0;
+    int navail = 0;
+    size_t consumed = 0;     // bits handed out
     bool ok = true;
 
-    unsigned int bits(int nbits) {
-        unsigned int num = 0;
-        unsigned int mask =
-            nbits < 32 ? (1u << nbits) - 1 : 0xffffffffu;
-        while (nbits >= 8) {
-            lastbyte = (lastbyte << 8) | next();
-            num |= (lastbyte >> lastbits) << (nbits - 8);
-            nbits -= 8;
+    inline unsigned int bits(int nbits) {
+        if (nbits <= 0) return 0;
+        if (navail < nbits) {
+            do {
+                uint64_t byte = pos < n ? data[pos] : 0;
+                ++pos;
+                acc |= byte << (56 - navail);
+                navail += 8;
+            } while (navail <= 56);
         }
-        if (nbits > 0) {
-            if ((int)lastbits < nbits) {
-                lastbits += 8;
-                lastbyte = (lastbyte << 8) | next();
-            }
-            lastbits -= nbits;
-            num |= (lastbyte >> lastbits) & ((1u << nbits) - 1);
-        }
-        return num & mask;
-    }
-    unsigned char next() {
-        if (cnt >= n) { ok = false; return 0; }
-        return data[cnt++];
+        unsigned int out = (unsigned int)(acc >> (64 - nbits));
+        acc <<= nbits;
+        navail -= nbits;
+        consumed += (size_t)nbits;
+        if (consumed > 8 * n) ok = false;
+        return out;
     }
 };
 
@@ -222,25 +224,33 @@ static void sendints(BitWriter& bw, int nints, int nbits,
 
 static void receiveints(BitReader& br, int nints, int nbits,
                         const unsigned int sizes[], int nums[]) {
-    unsigned int bytes[32] = {0, 0, 0, 0};
-    int nbytes = 0;
+    // Mixed-radix big-int decode.  Base-256 digits arrive LSB-first in
+    // the bit stream; every XTC group fits 128 bits (the triple-int
+    // packing caps each size below 2^25, so nbits <= ~75), so the whole
+    // value assembles into one __int128 and each int peels off with a
+    // single div/mod — replacing the per-byte long-division loop of the
+    // format's reference decoders (the decode hot path: one call per
+    // absolute coordinate triple and one per small-run triple).
+    unsigned __int128 v = 0;
+    int shift = 0;
     while (nbits > 8) {
-        bytes[nbytes++] = br.bits(8);
+        v |= (unsigned __int128)br.bits(8) << shift;
+        shift += 8;
         nbits -= 8;
     }
-    if (nbits > 0) bytes[nbytes++] = br.bits(nbits);
+    if (nbits > 0) v |= (unsigned __int128)br.bits(nbits) << shift;
     for (int i = nints - 1; i > 0; i--) {
-        unsigned int num = 0;
-        for (int j = nbytes - 1; j >= 0; j--) {
-            num = (num << 8) | bytes[j];
-            unsigned int p = num / sizes[i];
-            bytes[j] = p;
-            num = num - p * sizes[i];
+        unsigned int s = sizes[i];
+        if ((uint64_t)(v >> 64) == 0) {       // 64-bit fast lane
+            uint64_t lo = (uint64_t)v;
+            nums[i] = (int)(lo % s);
+            v = lo / s;
+        } else {
+            nums[i] = (int)(uint64_t)(v % s);
+            v /= s;
         }
-        nums[i] = (int)num;
     }
-    nums[0] = (int)(bytes[0] | (bytes[1] << 8) | (bytes[2] << 16) |
-                    (bytes[3] << 24));
+    nums[0] = (int)(uint64_t)v;
 }
 
 // ---------------------------------------------------------------------
