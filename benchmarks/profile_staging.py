@@ -87,6 +87,42 @@ def main():
     host["gathered_block_mb"] = gathered_mb
     report["host_staging_b64"] = {k: round(v, 3) for k, v in host.items()}
 
+    # ---- 1b. gather+quantize thread scaling (VERDICT r2 next-round #5:
+    # put numbers under the v5e-8 projection's staging-core assumption).
+    # The ctypes FFI releases the GIL for the C++ kernels, so on a
+    # multi-core host T staging threads should approach T× one core's
+    # gather+quantize rate; report cpu_count so a 1-core measurement is
+    # read as serialization, not a scaling refutation. ----
+    from concurrent.futures import ThreadPoolExecutor
+
+    n_blocks = 8
+    views = [coords[i * B:(i + 1) * B] for i in range(n_blocks)
+             if (i + 1) * B <= len(coords)]
+    if not views:                       # short BENCH_FRAMES: one block
+        views = [coords[:min(B, len(coords))]]
+    scaling = {"cpu_count": os.cpu_count(), "blocks": len(views),
+               "block_mb": round(views[0].nbytes / 1e6, 1)}
+    for T in (1, 2, 4):
+        def run_threads(T=T):
+            with ThreadPoolExecutor(max_workers=T) as ex:
+                list(ex.map(lambda v: native.stage_gather_quantize(v, sel),
+                            views))
+        t = median_time(run_threads, reps=3)
+        scaling[f"threads_{T}"] = {
+            "wall_ms": round(t * 1e3, 1),
+            "blocks_per_s": round(len(views) / t, 2),
+            "gather_gbps": round(
+                len(views) * views[0][:, sel].nbytes / t / 1e9, 2)}
+    base = scaling["threads_1"]["blocks_per_s"]
+    for T in (2, 4):
+        scaling[f"threads_{T}"]["speedup"] = round(
+            scaling[f"threads_{T}"]["blocks_per_s"] / base, 2)
+    report["gather_quantize_thread_scaling"] = scaling
+
+    if os.environ.get("PROFILE_HOST_ONLY"):
+        print(json.dumps(report, indent=1))
+        return
+
     # ---- 2. device_put throughput by dtype / size ----
     dev = jax.devices()[0]
     puts = {}
@@ -161,6 +197,41 @@ def main():
                 "phases": TIMERS.report(),
             }
     report["aligned_rmsf_runs"] = runs
+
+    # ---- 5. prefetch-thread overlap (VERDICT r2 weak #6: the
+    # double-buffering path's benefit was never measured).  Same int16
+    # b64 run with the staging pool forced inline vs forced to a real
+    # thread; on a 1-core host expect parity-or-worse (nothing to
+    # overlap with), on multi-core hosts the thread pays. ----
+    overlap = {"cpu_count": os.cpu_count()}
+    saved = {k: os.environ.get(k)
+             for k in ("MDTPU_PREFETCH", "MDTPU_HOST_STAGE_CACHE_MB")}
+    # the host stage cache must be OFF here: with it warm (section 4
+    # leaves it populated) both legs serve gather+quantize from cache
+    # and there is no staging work left for the prefetch thread to
+    # overlap — the measurement would compare pad+device_put only
+    os.environ["MDTPU_HOST_STAGE_CACHE_MB"] = "0"
+    try:
+        for pref in ("0", "1"):
+            os.environ["MDTPU_PREFETCH"] = pref
+            AlignedRMSF(u, select=SELECT).run(
+                stop=2 * 64, backend=backend, batch_size=64,
+                transfer_dtype="int16")
+            t0 = time.perf_counter()
+            r = AlignedRMSF(u, select=SELECT).run(
+                backend=backend, batch_size=64, transfer_dtype="int16")
+            jax.block_until_ready(r._last_total)
+            wall = time.perf_counter() - t0
+            overlap[f"prefetch_{pref}"] = {
+                "wall_ms": round(wall * 1e3, 1),
+                "fps": round(N_FRAMES / wall, 1)}
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    report["prefetch_overlap"] = overlap
 
     print(json.dumps(report, indent=1))
 

@@ -14,6 +14,9 @@ Grammar (recursive descent)::
     not_expr := 'not' not_expr | primary
     primary  := '(' expr ')' | 'around' number not_expr
               | 'sphzone' number not_expr | 'point' x y z number
+              | 'cyzone' rExt zMax zMin not_expr
+              | 'cylayer' rIn rExt zMax zMin not_expr
+              | 'bonded' not_expr
               | 'byres' not_expr | 'same' attr 'as' not_expr
               | 'global' not_expr | keyword
     keyword  := 'all' | 'none' | 'protein' | 'backbone' | 'nucleic'
@@ -21,7 +24,7 @@ Grammar (recursive descent)::
               | ('name'|'resname'|'segid'|'element'|'type') value+
               | ('resid'|'resnum') range+
               | ('index'|'bynum') range+
-              | 'prop' ('mass'|'charge') cmp number
+              | 'prop' ['abs'] ('mass'|'charge'|'x'|'y'|'z') cmp number
     value    := token with optional fnmatch globs (* ?)
     range    := N | N:M | N-M        (inclusive, MDAnalysis convention)
 
@@ -46,6 +49,17 @@ them with ``around`` constantly):
   inside ``AtomGroup.select_atoms`` (escapes group scoping, e.g.
   ``waters.select_atoms("around 3.5 global protein")``); the final
   result is still restricted to the group, as upstream does.
+- ``cyzone rExt zMax zMin inner`` — cylindrical zone: atoms whose xy
+  distance from the z-axis through ``inner``'s center of geometry is
+  ≤ rExt and whose z offset from that center is in [zMin, zMax]
+  (upstream CylindricalZoneSelection; inclusive of ``inner``).
+- ``cylayer rIn rExt zMax zMin inner`` — cylindrical annulus: as
+  cyzone but additionally beyond rIn from the axis.
+- ``bonded inner`` — atoms sharing a topology bond with an ``inner``
+  atom (requires bonds, e.g. a PSF topology).
+- ``prop [abs] x|y|z op value`` — per-axis coordinate comparisons
+  against the current frame (``prop abs z <= 8``), alongside
+  ``prop mass``/``prop charge``.
 
 Supported keyword semantics follow the documented MDAnalysis selection
 language for this subset; ``heavy`` = ``not hydrogen`` covers BASELINE
@@ -69,6 +83,7 @@ _RESERVED = {
     "name", "resname", "segid", "element", "type", "resid", "resnum",
     "index", "bynum", "prop", "around",
     "byres", "same", "as", "sphzone", "point", "global",
+    "cyzone", "cylayer", "bonded",
 }
 
 _TOKEN_RE = re.compile(r"\(|\)|[^\s()]+")
@@ -172,6 +187,17 @@ class _Parser:
                     f"'point' needs x y z coordinates: {e}") from e
             return self._point(np.array([x, y, z], np.float32),
                                self._cutoff(tok))
+        if tok == "cyzone":
+            r_ext = self._cutoff(tok)
+            zmax, zmin = self._z_bounds(tok)
+            return self._cylinder(None, r_ext, zmin, zmax, self.not_expr())
+        if tok == "cylayer":
+            r_in = self._cutoff(tok)
+            r_ext = self._cutoff(tok)
+            zmax, zmin = self._z_bounds(tok)
+            return self._cylinder(r_in, r_ext, zmin, zmax, self.not_expr())
+        if tok == "bonded":
+            return self._bonded(self.not_expr())
         if tok == "byres":
             return self._byres(self.not_expr())
         if tok == "same":
@@ -301,6 +327,69 @@ class _Parser:
         PointSelection)."""
         return self._sphere(xyz, cutoff)
 
+    def _z_bounds(self, kw: str) -> tuple[float, float]:
+        """Parse the ``externalZ lowerZ`` pair of cyzone/cylayer (upstream
+        order: zMax then zMin, both relative to the inner selection's
+        center of geometry; zMin may be negative)."""
+        try:
+            zmax = float(self.next())
+            zmin = float(self.next())
+        except ValueError as e:
+            raise SelectionError(f"{kw!r} needs zMax zMin bounds: {e}") from e
+        if zmin > zmax:
+            raise SelectionError(f"{kw!r} zMin {zmin} exceeds zMax {zmax}")
+        return zmax, zmin
+
+    def _cylinder(self, r_in: float | None, r_ext: float, zmin: float,
+                  zmax: float, inner: np.ndarray) -> np.ndarray:
+        """``cyzone``/``cylayer`` (upstream CylindricalZone/-Layer): atoms
+        whose xy-distance from the z-axis through the center of geometry
+        of ``inner`` is within r_ext (and, for cylayer, beyond r_in) and
+        whose z offset from that center lies in [zmin, zmax].
+        Minimum-image under the current box, like the other geometric
+        keywords; inclusive of ``inner`` atoms inside the volume."""
+        if r_in is not None and r_in >= r_ext:
+            raise SelectionError(
+                f"cylayer inner radius {r_in} must be below outer {r_ext}")
+        inner = self._scoped(inner)
+        if not inner.any():
+            return np.zeros_like(inner)
+        positions, box = self._coords()
+        if positions is None:
+            raise SelectionError(
+                "'cyzone'/'cylayer' are geometric selections and need "
+                "coordinates")
+        from mdanalysis_mpi_tpu.ops.host import minimum_image
+
+        pos = np.asarray(positions, dtype=np.float32)
+        center = np.asarray(pos, np.float64)[inner].mean(axis=0)
+        box = None if box is None else np.asarray(box, np.float64)
+        disp = minimum_image(pos - center.astype(np.float32), box)
+        r2 = disp[:, 0] ** 2 + disp[:, 1] ** 2
+        mask = r2 <= np.float64(r_ext) ** 2
+        if r_in is not None:
+            mask &= r2 > np.float64(r_in) ** 2
+        mask &= (disp[:, 2] >= zmin) & (disp[:, 2] <= zmax)
+        return mask
+
+    def _bonded(self, inner: np.ndarray) -> np.ndarray:
+        """``bonded inner`` (upstream BondedSelection): atoms sharing a
+        bond with any ``inner`` atom (the inner atoms themselves only if
+        they bond to another inner atom)."""
+        t = self.top
+        if t.bonds is None or len(t.bonds) == 0:
+            raise SelectionError(
+                "topology has no bonds for 'bonded' (load a PSF or attach "
+                "bonds to the Topology)")
+        inner = self._scoped(inner)
+        if not inner.any():
+            return np.zeros_like(inner)
+        mask = np.zeros_like(inner)
+        a, b = t.bonds[:, 0], t.bonds[:, 1]
+        mask[a[inner[b]]] = True
+        mask[b[inner[a]]] = True
+        return mask
+
     def _around(self, cutoff: float, inner: np.ndarray) -> np.ndarray:
         """Atoms within ``cutoff`` of any atom in ``inner`` (exclusive).
 
@@ -386,14 +475,27 @@ class _Parser:
     def _prop(self) -> np.ndarray:
         t = self.top
         what = self.next()
+        use_abs = False
+        if what == "abs":               # upstream: 'prop abs z <= 8'
+            use_abs = True
+            what = self.next()
         if what == "mass":
             arr = t.masses
         elif what == "charge":
             if t.charges is None:
                 raise SelectionError("topology has no charges for 'prop charge'")
             arr = t.charges
+        elif what in ("x", "y", "z"):
+            positions, _ = self._coords()
+            if positions is None:
+                raise SelectionError(
+                    f"'prop {what}' needs coordinates; select through a "
+                    "Universe/AtomGroup (not bare select_mask on a Topology)")
+            arr = np.asarray(positions, np.float64)[:, "xyz".index(what)]
         else:
             raise SelectionError(f"unsupported prop {what!r}")
+        if use_abs:
+            arr = np.abs(arr)
         op = self.next()
         try:
             val = float(self.next())
