@@ -199,15 +199,12 @@ def timed_serial(u: Universe, repeats: int = 3):
 
 
 def main():
-    import jax
-
-    n_chips = len(jax.devices())
-    accel_backend = "jax" if n_chips == 1 else "mesh"
     tdtype = os.environ.get("BENCH_TRANSFER", "int16")
 
-    # --- serial NumPy stand-ins for one MPI rank, measured FIRST: once
-    # the accelerator path runs, the tunnel client process competes for
-    # this host's single core and the serial number swings 3-4x. ---
+    # --- serial NumPy stand-ins for one MPI rank, measured FIRST —
+    # before ANY jax/accelerator touch: once the tunnel client starts it
+    # competes for this host's single core and the serial number swings
+    # 3-4x (r01/r02 measurement protocol, BASELINE.md). ---
     u_mem = make_system(N_ATOMS, R01_FRAMES)
     serial_fps, _ = timed_serial(u_mem)
     baseline_fps = 8 * serial_fps          # ideal 8-rank MPI, free I/O
@@ -220,6 +217,11 @@ def main():
     serial_file_fps, s_oracle = timed_serial(u_file)
     file_baseline_fps = 8 * serial_file_fps   # ranks that decode XTC
     _note(f"[bench] serial ({src_label}) {serial_file_fps:.1f} f/s")
+
+    import jax
+
+    n_chips = len(jax.devices())
+    accel_backend = "jax" if n_chips == 1 else "mesh"
 
     # --- r01-comparable leg: f32 staging, host cache cleared per run,
     # fresh per-run device cache (AlignedRMSF default), in-memory 512

@@ -558,7 +558,8 @@ class MeshExecutor:
             in_specs=in_specs,
             out_specs=out_specs, check_vma=False))
         shardings = tuple(NamedSharding(mesh, s) for s in put_specs)
-        result = (frames_per_batch_factor, gfn, shardings)
+        result = (frames_per_batch_factor, gfn, shardings,
+                  custom[0] if custom is not None else None)
         _MESH_CACHE[key] = result
         return result
 
@@ -566,7 +567,7 @@ class MeshExecutor:
         import jax
 
         bs = batch_size or self.batch_size
-        bs_factor, gfn, shardings = self._build(analysis)
+        bs_factor, gfn, shardings, params_specs = self._build(analysis)
         global_bs = bs * bs_factor
         params, sel_idx = _wrap_for_transfer(
             analysis._batch_params(), analysis._batch_select(),
@@ -576,19 +577,20 @@ class MeshExecutor:
         n_proc = jax.process_count()
         if n_proc > 1:
             # Multi-controller (DCN) path: every process runs this same
-            # execute() over the same global frame schedule, stages only
-            # its own slice of each batch (see _run_batches), and the
-            # slices assemble into one global mesh-sharded array.  The
+            # execute() over the same global frame schedule; frame-
+            # sharded analyses stage only their own slice of each batch
+            # (see _run_batches) and the slices assemble into one global
+            # mesh-sharded array; atom-sharded (ring) analyses replicate
+            # frames and slice the ATOM axis per process instead.  The
             # kernel + psum merge are IDENTICAL to the single-host path;
             # time-series outputs are all_gathered to replicated and
             # int16 scales travel per-frame (see _build) — every
             # analysis family the reference could run at N ranks
-            # (RMSF.py:59-61) runs at N controllers, except the
-            # atom-sharded ring kernels below.
-            if analysis._batch_specs(self.axis_name) is not None:
-                raise NotImplementedError(
-                    "atom-sharded (ring) kernels are single-controller "
-                    "for now; run frame-sharded analyses multi-host")
+            # (RMSF.py:59-61) runs at N controllers.
+            if params_specs is not None:
+                return self._execute_ring_multihost(
+                    analysis, reader, frames, bs, gfn, shardings,
+                    params_specs, params, sel_idx, n_proc)
             from mdanalysis_mpi_tpu.parallel.distributed import (
                 global_batch_from_local)
 
@@ -621,6 +623,90 @@ class MeshExecutor:
             lambda *staged: gfn(params, *staged), sel_idx,
             device_put_fn=put, cache=self.block_cache,
             quantize=self.transfer_dtype == "int16")
+
+    def _execute_ring_multihost(self, analysis, reader, frames, bs, gfn,
+                                shardings, params_specs, params, sel_idx,
+                                n_proc):
+        """Atom-sharded (ring) kernels at N controllers: frames are
+        replicated (every process stages the same frame batches), the
+        union ATOM axis is process-sliced — each process stages and
+        holds only its devices' contiguous atom block — and atom-sharded
+        params (the ring weight vectors) assemble the same way.  The
+        ppermute ring then rotates blocks across process boundaries over
+        DCN exactly as it does over ICI single-host (SURVEY.md §5.7)."""
+        import jax
+        from jax.sharding import NamedSharding
+
+        mesh = shardings[0].mesh
+        axis = self.axis_name
+        pid = jax.process_index()
+
+        def globalize(x, spec):
+            """Per-process slice of ``x`` along the axis ``spec`` shards
+            (if any) → one global array on the multi-host mesh."""
+            x = np.asarray(x)
+            local = x
+            for dim, s in enumerate(spec):
+                if s == axis:
+                    if x.shape[dim] % n_proc:
+                        raise ValueError(
+                            f"axis {dim} of shape {x.shape} does not "
+                            f"divide across {n_proc} processes")
+                    per = x.shape[dim] // n_proc
+                    sl = [slice(None)] * x.ndim
+                    sl[dim] = slice(pid * per, (pid + 1) * per)
+                    local = x[tuple(sl)]
+                    break
+            return jax.make_array_from_process_local_data(
+                NamedSharding(mesh, spec), np.ascontiguousarray(local),
+                x.shape)
+
+        # the union atom axis must split evenly over processes (device
+        # divisibility is already guaranteed by the analysis' ring
+        # padding) — checked before any globalization so the failure
+        # names the actual remedy
+        n_union = len(sel_idx)
+        if n_union % n_proc:
+            raise ValueError(
+                f"ring union of {n_union} atoms does not divide across "
+                f"{n_proc} processes; run with a process count that "
+                f"divides it (the union is padded to multiples of the "
+                f"ring pad)")
+        # ring params are a flat tuple zipped against their specs
+        # (PartitionSpec is itself a tuple — tree.map would recurse
+        # into it).  Globalizing fetches device-held params to host
+        # once; memoized per (mesh, processes) on the analysis so
+        # repeat run() calls skip the round trip.
+        pkey = (id(mesh), n_proc, axis)
+        cached = getattr(analysis, "_ring_global_params", None)
+        if cached is not None and cached[0] == pkey:
+            params = cached[1]
+        else:
+            params = tuple(globalize(x, spec)
+                           for x, spec in zip(params, params_specs))
+            analysis._ring_global_params = (pkey, params)
+        # stage only this process's contiguous atom block of the union
+        per = n_union // n_proc
+        local_sel = np.asarray(sel_idx)[pid * per:(pid + 1) * per]
+        batch_spec, boxes_spec, mask_spec = (s.spec for s in shardings)
+
+        def put(staged):
+            block, boxes, mask = staged
+            return (globalize_block(block),
+                    globalize(boxes, boxes_spec),
+                    globalize(mask, mask_spec))
+
+        def globalize_block(block):
+            # local (B, per, 3) → global (B, n_union, 3) atom-sharded
+            return jax.make_array_from_process_local_data(
+                NamedSharding(mesh, batch_spec),
+                np.ascontiguousarray(block),
+                (block.shape[0], n_union) + block.shape[2:])
+
+        return _run_batches(
+            analysis, reader, frames, bs,
+            lambda *staged: gfn(params, *staged), local_sel,
+            device_put_fn=put, cache=self.block_cache, quantize=False)
 
 
 from mdanalysis_mpi_tpu.parallel.mpi import MPIExecutor  # noqa: E402

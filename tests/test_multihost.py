@@ -9,7 +9,7 @@ own slice of every global batch (``process_frame_shard`` semantics
 inside ``MeshExecutor``), and the psum merge runs across both — the
 same code path a v5e pod slice takes over DCN+ICI.
 
-Round 3 closes the carve-outs: the child asserts multi-controller
+Round 3 closes every carve-out: the child asserts multi-controller
 *parity* (not refusal) for
 
 - AlignedRMSF with float32 staging (psum-merged moments),
@@ -17,7 +17,10 @@ Round 3 closes the carve-outs: the child asserts multi-controller
   the batch),
 - **RMSD** — a time-series analysis (no psum merge; per-shard series
   all_gathered to replicated so every controller can fetch them) —
-  BASELINE config 3 at 2 processes.
+  BASELINE config 3 at 2 processes,
+- **InterRDF engine='ring'** — the atom-sharded ppermute ring with the
+  union atom axis process-sliced (frames replicated), so the ring
+  crosses the process boundary the way it crosses ICI single-host.
 
 The child script writes process 0's results; the parent compares them
 against the serial f64 oracle computed in-process.
@@ -68,23 +71,20 @@ r = RMSD(u.select_atoms("name CA")).run(backend="mesh", batch_size=2)
 rmsd = r.results.rmsd
 assert rmsd.shape == ({n_frames},), rmsd.shape
 
-# atom-sharded ring kernels are the one documented multi-controller
-# carve-out: they must REFUSE (not silently mis-reduce) at 2 processes
+# atom-sharded ring kernels at 2 controllers: frames replicated, the
+# union atom axis process-sliced, ppermute crossing the process
+# boundary (executors._execute_ring_multihost)
 from mdanalysis_mpi_tpu.analysis import InterRDF
 ub = make_protein_universe(n_residues={n_res}, n_frames=4, noise=0.3,
                            seed=11, box=40.0)
 ca = ub.select_atoms("name CA")
-try:
-    InterRDF(ca, ca, nbins=8, range=(0.0, 10.0),
+g = InterRDF(ca, ca, nbins=8, range=(0.0, 10.0),
              engine="ring").run(backend="mesh", batch_size=2)
-except NotImplementedError:
-    pass
-else:
-    raise AssertionError("multi-host ring run should refuse")
+rdf_ring = g.results.rdf
 
 if pid == 0:
     np.savez({out!r}, rmsf=a.results.rmsf, rmsf_i16=q.results.rmsf,
-             rmsd=rmsd)
+             rmsd=rmsd, rdf_ring=rdf_ring)
 """
 
 
@@ -136,4 +136,14 @@ class TestTwoProcessMesh:
         np.testing.assert_allclose(got["rmsf_i16"], s.results.rmsf,
                                    atol=1e-3)   # int16 staging tolerance
         np.testing.assert_allclose(got["rmsd"], sr.results.rmsd, atol=1e-4)
+
+        from mdanalysis_mpi_tpu.analysis import InterRDF
+
+        ub = make_protein_universe(n_residues=N_RES, n_frames=4, noise=0.3,
+                                   seed=11, box=40.0)
+        ca = ub.select_atoms("name CA")
+        sg = InterRDF(ca, ca, nbins=8, range=(0.0, 10.0)).run(
+            backend="serial")
+        np.testing.assert_allclose(got["rdf_ring"], sg.results.rdf,
+                                   atol=1e-3)
 
