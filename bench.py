@@ -198,6 +198,45 @@ def timed_serial(u: Universe, repeats: int = 3):
     return SERIAL_FRAMES / float(np.median(walls)), s
 
 
+def _accelerator_or_die(timeout_s: float | None = None) -> int:
+    """Initialize the accelerator with a watchdog.
+
+    ``import jax`` under the axon platform blocks indefinitely while the
+    tunnel to the TPU pool is down (observed: hours), which would leave
+    the driver with NO artifact at all.  Run the import + device query
+    on a daemon thread; if it does not come up within
+    BENCH_TPU_TIMEOUT seconds (default 900 — first contact on a healthy
+    tunnel takes ~1-2 min), emit a parseable JSON error line and exit
+    nonzero instead of hanging.  Returns the device count."""
+    import threading
+
+    timeout_s = timeout_s if timeout_s is not None else float(
+        os.environ.get("BENCH_TPU_TIMEOUT", "900"))
+    box: dict = {}
+
+    def probe():
+        try:
+            import jax
+
+            box["n"] = len(jax.devices())
+        except Exception as e:          # pragma: no cover - env-specific
+            box["err"] = repr(e)
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if "n" in box:
+        return box["n"]
+    err = box.get("err", f"accelerator unreachable after {timeout_s:.0f}s "
+                         "(tunnel down?)")
+    print(json.dumps({
+        "metric": f"frames/sec/chip, {N_ATOMS}-atom heavy-atom "
+                  f"AlignedRMSF ({N_FRAMES} frames, source={SOURCE})",
+        "value": None, "unit": "frames/s/chip", "vs_baseline": None,
+        "error": err}))
+    sys.exit(1)
+
+
 def main():
     tdtype = os.environ.get("BENCH_TRANSFER", "int16")
 
@@ -218,9 +257,9 @@ def main():
     file_baseline_fps = 8 * serial_file_fps   # ranks that decode XTC
     _note(f"[bench] serial ({src_label}) {serial_file_fps:.1f} f/s")
 
+    n_chips = _accelerator_or_die()
     import jax
 
-    n_chips = len(jax.devices())
     accel_backend = "jax" if n_chips == 1 else "mesh"
 
     # --- r01-comparable leg: f32 staging, host cache cleared per run,

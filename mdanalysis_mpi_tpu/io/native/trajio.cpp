@@ -189,37 +189,24 @@ static int sizeofints(int nints, const unsigned int sizes[]) {
     return nbits + nbytes * 8;
 }
 
-static void sendints(BitWriter& bw, int nints, int nbits,
+static void sendints(BitWriter& bw, int nbits,
                      const unsigned int sizes[], const unsigned int nums[]) {
-    unsigned int bytes[32];
-    unsigned int nbytes = 0, bytecnt, tmp;
-    tmp = nums[0];
-    do {
-        bytes[nbytes++] = tmp & 0xff;
-        tmp >>= 8;
-    } while (tmp != 0);
-    for (int i = 1; i < nints; i++) {
-        tmp = nums[i];
-        for (bytecnt = 0; bytecnt < nbytes; bytecnt++) {
-            tmp = bytes[bytecnt] * sizes[i] + tmp;
-            bytes[bytecnt] = tmp & 0xff;
-            tmp >>= 8;
-        }
-        while (tmp != 0) {
-            bytes[bytecnt++] = tmp & 0xff;
-            tmp >>= 8;
-        }
-        nbytes = bytecnt;
+    // Mixed-radix pack of one coordinate triple: the value fits 128
+    // bits (sizes < 2^25 → < 2^75), so one Horner evaluation replaces
+    // the per-digit long-multiplication loop of the format's reference
+    // encoders, and the base-256 digits stream out LSB-first in <=8-bit
+    // fields — emitting exactly ``nbits`` total, identical layout to
+    // (and round-trip-fuzzed against) the digit-buffer formulation.
+    unsigned __int128 v =
+        ((unsigned __int128)nums[0] * sizes[1] + nums[1]) * sizes[2]
+        + nums[2];
+    while (nbits > 8) {
+        bw.bits(8, (unsigned int)((uint64_t)v & 0xff));
+        v >>= 8;
+        nbits -= 8;
     }
-    if (nbits >= (int)nbytes * 8) {
-        for (bytecnt = 0; bytecnt < nbytes; bytecnt++)
-            bw.bits(8, bytes[bytecnt]);
-        bw.bits(nbits - nbytes * 8, 0);
-    } else {
-        for (bytecnt = 0; bytecnt < nbytes - 1; bytecnt++)
-            bw.bits(8, bytes[bytecnt]);
-        bw.bits(nbits - (nbytes - 1) * 8, bytes[bytecnt]);
-    }
+    if (nbits > 0)
+        bw.bits(nbits, (unsigned int)((uint64_t)v & ((1u << nbits) - 1)));
 }
 
 static void receiveints(BitReader& br, int nints, int nbits,
@@ -456,7 +443,7 @@ static int xtc_encode_coords(Writer& w, int lsize, const float* in,
             if (bitsize == 0)
                 for (int k = 0; k < 3; k++) bw.bits(bitsizeint[k], abs3[k]);
             else
-                sendints(bw, 3, bitsize, sizeint, abs3);
+                sendints(bw, bitsize, sizeint, abs3);
             bw.bits(1, 1);
             bw.bits(5, (unsigned int)(m * 3 + 1));  // is_smaller enc = 0
             // small atoms in decoder chain order
@@ -467,7 +454,7 @@ static int xtc_encode_coords(Writer& w, int lsize, const float* in,
                 for (int k = 0; k < 3; k++)
                     d3[k] = (unsigned int)(lip[src * 3 + k] - prev[k] +
                                            smallnum);
-                sendints(bw, 3, smallidx, sizesmall, d3);
+                sendints(bw, smallidx, sizesmall, d3);
                 if (t == 0) {
                     prev = &lip[i * 3];  // decoder's prevcoord = s0 after swap
                     src = i + 2;
@@ -484,7 +471,7 @@ static int xtc_encode_coords(Writer& w, int lsize, const float* in,
             if (bitsize == 0)
                 for (int k = 0; k < 3; k++) bw.bits(bitsizeint[k], abs3[k]);
             else
-                sendints(bw, 3, bitsize, sizeint, abs3);
+                sendints(bw, bitsize, sizeint, abs3);
             bw.bits(1, 0);
             i += 1;
         }

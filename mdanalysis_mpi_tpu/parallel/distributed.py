@@ -77,23 +77,40 @@ def process_frame_shard(n_frames: int, process_id: int | None = None,
     return static_blocks(n_frames, n)[pid]
 
 
-def global_batch_from_local(local_batch, mesh, axis_name: str = "data"):
-    """Assemble per-process staged blocks into one mesh-sharded global
-    array (the multi-host twin of the MeshExecutor's ``device_put``).
+def global_from_local(local, mesh, spec, global_shape=None):
+    """Assemble per-process local blocks into one mesh-sharded global
+    array — the single definition of the "process ``pid`` owns the
+    ``pid``-th contiguous block of every ``spec``-sharded axis"
+    invariant (shared by the frame-sharded and atom-sharded multi-host
+    paths so they cannot drift).
 
-    ``local_batch``: this process's (B_local, ...) staged frames —
-    B_local = B_global / process_count, matching
-    :func:`process_frame_shard` order so global frame order is
-    preserved.  Single-process meshes take the fast path (plain
-    ``device_put`` with the sharding).
+    ``local``: this process's block — for each axis ``spec`` shards,
+    1/process_count of the global extent, in process order; replicated
+    axes (and fully replicated ``spec=P()`` arrays) carry the full,
+    process-identical data.  ``global_shape`` is inferred by scaling
+    the sharded axes by process_count when omitted.  Single-process
+    meshes take the fast path (plain ``device_put``).
     """
     import jax
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import NamedSharding
 
-    sharding = NamedSharding(mesh, P(axis_name))
-    if jax.process_count() == 1:
-        return jax.device_put(local_batch, sharding)
-    global_shape = (local_batch.shape[0] * jax.process_count(),
-                    *local_batch.shape[1:])
+    sharding = NamedSharding(mesh, spec)
+    n_proc = jax.process_count()
+    if n_proc == 1:
+        return jax.device_put(local, sharding)
+    if global_shape is None:
+        global_shape = tuple(
+            d * n_proc if dim < len(spec) and spec[dim] is not None else d
+            for dim, d in enumerate(local.shape))
     return jax.make_array_from_process_local_data(
-        sharding, local_batch, global_shape)
+        sharding, local, global_shape)
+
+
+def global_batch_from_local(local_batch, mesh, axis_name: str = "data"):
+    """Frame-axis convenience wrapper over :func:`global_from_local`:
+    this process's (B_local, ...) staged frames — B_local = B_global /
+    process_count, matching :func:`process_frame_shard` order so global
+    frame order is preserved."""
+    from jax.sharding import PartitionSpec as P
+
+    return global_from_local(local_batch, mesh, P(axis_name))

@@ -368,9 +368,12 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
         if device_put_fn is not None:
             staged = device_put_fn(staged)
         if cache is not None:
-            # charge this process's resident share: a global sharded
-            # array holds only 1/local_divisor of its bytes per host
-            cache.put(key, staged, padded.nbytes // local_divisor)
+            # charge this process's resident share of the cached entry:
+            # ``padded`` is the HOST-side block this process staged —
+            # already the 1/local_divisor slice on multi-host — and a
+            # global sharded array keeps exactly those bytes resident
+            # per host, so its nbytes IS the per-host charge
+            cache.put(key, staged, padded.nbytes)
         return staged
 
     with _staging_pool() as pool:
@@ -635,15 +638,18 @@ class MeshExecutor:
         ppermute ring then rotates blocks across process boundaries over
         DCN exactly as it does over ICI single-host (SURVEY.md §5.7)."""
         import jax
-        from jax.sharding import NamedSharding
+
+        from mdanalysis_mpi_tpu.parallel.distributed import global_from_local
 
         mesh = shardings[0].mesh
         axis = self.axis_name
         pid = jax.process_index()
 
         def globalize(x, spec):
-            """Per-process slice of ``x`` along the axis ``spec`` shards
-            (if any) → one global array on the multi-host mesh."""
+            """Per-process slice of full ``x`` along the axis ``spec``
+            shards (if any) → one global array on the multi-host mesh
+            (assembly itself is the shared distributed.global_from_local
+            invariant)."""
             x = np.asarray(x)
             local = x
             for dim, s in enumerate(spec):
@@ -657,9 +663,9 @@ class MeshExecutor:
                     sl[dim] = slice(pid * per, (pid + 1) * per)
                     local = x[tuple(sl)]
                     break
-            return jax.make_array_from_process_local_data(
-                NamedSharding(mesh, spec), np.ascontiguousarray(local),
-                x.shape)
+            return global_from_local(mesh=mesh, spec=spec,
+                                     local=np.ascontiguousarray(local),
+                                     global_shape=x.shape)
 
         # the union atom axis must split evenly over processes (device
         # divisibility is already guaranteed by the analysis' ring
@@ -698,10 +704,9 @@ class MeshExecutor:
 
         def globalize_block(block):
             # local (B, per, 3) → global (B, n_union, 3) atom-sharded
-            return jax.make_array_from_process_local_data(
-                NamedSharding(mesh, batch_spec),
-                np.ascontiguousarray(block),
-                (block.shape[0], n_union) + block.shape[2:])
+            return global_from_local(
+                np.ascontiguousarray(block), mesh, batch_spec,
+                global_shape=(block.shape[0], n_union) + block.shape[2:])
 
         return _run_batches(
             analysis, reader, frames, bs,
