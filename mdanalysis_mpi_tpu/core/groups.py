@@ -156,22 +156,45 @@ class AtomGroup:
         semantics): geometric keywords' inner selections see only group
         atoms, so ``waters.select_atoms("around 3 protein")`` is empty
         when the group holds no protein.
+
+        Topology-only selections are memoized on the Universe: the
+        topology is immutable, so a parse that never touched the current
+        frame's coordinates yields the same mask forever.  The lazy
+        coords callable doubles as the purity witness — geometric
+        selections resolve it and are never cached (they must see the
+        current frame, upstream semantics).  Spares the per-``run()``
+        re-parse of multi-pass analyses at large atom counts (the
+        run-level echo of quirk Q3).
         """
         from mdanalysis_mpi_tpu.core.selection import select_mask
 
         top = self._universe.topology
-
-        def coords():
-            ts = self._universe.trajectory.ts
-            return ts.positions, ts.dimensions
-
         n = top.n_atoms
-        if len(self._indices) == n:
-            scope = None                 # whole universe: no restriction
-        else:
-            scope = np.zeros(n, dtype=bool)
-            scope[self._indices] = True
-        mask = select_mask(top, selection, positions=coords, scope=scope)
+        whole = len(self._indices) == n
+        # exact bytes as the scope key (a 64-bit hash could collide and
+        # silently serve another subgroup's mask)
+        key = (selection, None if whole else self._indices.tobytes())
+        cache = self._universe.__dict__.setdefault("_selection_cache", {})
+        mask = cache.get(key)
+        if mask is None:
+            if whole:
+                scope = None             # whole universe: no restriction
+            else:
+                scope = np.zeros(n, dtype=bool)
+                scope[self._indices] = True
+            touched_frame = []
+
+            def coords():
+                touched_frame.append(True)
+                ts = self._universe.trajectory.ts
+                return ts.positions, ts.dimensions
+
+            mask = select_mask(top, selection, positions=coords,
+                               scope=scope)
+            if not touched_frame:
+                if len(cache) >= 256:    # bound stale-string buildup
+                    cache.clear()
+                cache[key] = mask
         return AtomGroup(self._universe,
                          self._indices[mask[self._indices]])
 

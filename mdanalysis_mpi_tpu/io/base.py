@@ -74,12 +74,13 @@ def sel_fingerprint(sel) -> int | None:
 
 def _host_stage_cache_bytes() -> int:
     """Host cache cap in bytes (env ``MDTPU_HOST_STAGE_CACHE_MB``;
-    0 disables).  Default 2 GB — large enough for the flagship staging
-    shapes (a 10k-frame / 50k-atom int16 selection view is ~3 GB per
-    analysis window; whatever exceeds the cap is simply re-staged),
-    small enough not to crowd a modest host running one-shot analyses.
+    0 disables).  Default 4 GB — sized so the flagship working set
+    (BASELINE config 2 at stated scale: 10k frames × 50k selected
+    atoms × int16 = ~3 GB) fits whole; whatever exceeds the cap is
+    simply re-staged, and any host analyzing that workload holds the
+    12 GB raw trajectory anyway, so the cache is not the constraint.
     Read per call so tests/benches can toggle it without reloads."""
-    return int(float(os.environ.get("MDTPU_HOST_STAGE_CACHE_MB", "2048"))
+    return int(float(os.environ.get("MDTPU_HOST_STAGE_CACHE_MB", "4096"))
                * 1e6)
 
 

@@ -360,3 +360,31 @@ class TestCylinderBondedProp:
     def test_prop_xyz_requires_coordinates(self, top):
         with pytest.raises(SelectionError, match="coordinates"):
             select(top, "prop x > 0")
+
+
+class TestSelectionMemoization:
+    """Topology-only selections are memoized per Universe; geometric
+    (frame-dependent) selections never are (core/groups.py)."""
+
+    def test_topology_only_cached_and_stable(self):
+        u = make_solvated_universe(n_frames=4)
+        a = u.select_atoms("protein and name CA")
+        b = u.select_atoms("protein and name CA")
+        np.testing.assert_array_equal(a.indices, b.indices)
+        cache = u.__dict__["_selection_cache"]
+        assert ("protein and name CA", None) in cache
+
+    def test_geometric_not_cached(self):
+        u = make_solvated_universe(n_frames=4)
+        u.select_atoms("around 5.0 protein")
+        cache = u.__dict__.get("_selection_cache", {})
+        assert all("around" not in k[0] for k in cache)
+
+    def test_subgroup_scope_keys_distinct(self):
+        u = make_solvated_universe(n_frames=4)
+        whole = u.select_atoms("name CA")
+        sub = u.select_atoms("protein").select_atoms("name CA")
+        np.testing.assert_array_equal(whole.indices, sub.indices)
+        cache = u.__dict__["_selection_cache"]
+        keys = [k for k in cache if k[0] == "name CA"]
+        assert len(keys) == 2           # whole-universe + scoped entry
