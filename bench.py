@@ -48,13 +48,9 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-# The axon site hook re-asserts JAX_PLATFORMS=axon, so an env-var request
-# for the virtual-CPU platform (multi-chip mesh validation without
-# hardware) must be re-pinned via jax.config (same as __graft_entry__.py)
-if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
-    import jax
+from mdanalysis_mpi_tpu.utils.platform import honor_cpu_request
 
-    jax.config.update("jax_platforms", "cpu")
+honor_cpu_request()
 
 from mdanalysis_mpi_tpu.core.topology import Topology  # noqa: E402
 from mdanalysis_mpi_tpu.core.universe import Universe  # noqa: E402

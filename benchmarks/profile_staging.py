@@ -31,10 +31,9 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
-    import jax
+from mdanalysis_mpi_tpu.utils.platform import honor_cpu_request
 
-    jax.config.update("jax_platforms", "cpu")
+honor_cpu_request()
 
 N_ATOMS = int(os.environ.get("BENCH_ATOMS", 100_000))
 N_FRAMES = int(os.environ.get("BENCH_FRAMES", 512))

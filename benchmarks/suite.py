@@ -33,12 +33,9 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# The axon site hook re-asserts JAX_PLATFORMS=axon; honor an explicit
-# cpu request via jax.config (same workaround as bench.py / conftest)
-if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
-    import jax
+from mdanalysis_mpi_tpu.utils.platform import honor_cpu_request
 
-    jax.config.update("jax_platforms", "cpu")
+honor_cpu_request()
 
 from mdanalysis_mpi_tpu.core.universe import Universe            # noqa: E402
 from mdanalysis_mpi_tpu.analysis import (                        # noqa: E402

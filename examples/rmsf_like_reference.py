@@ -21,6 +21,10 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from mdanalysis_mpi_tpu.utils.platform import honor_cpu_request
+
+honor_cpu_request()
+
 import numpy as np
 
 from mdanalysis_mpi_tpu import Universe

@@ -7,6 +7,10 @@ pipeline (and the rest of the analyses) as a proper command.
 
 import sys
 
+from mdanalysis_mpi_tpu.utils.platform import honor_cpu_request
+
+honor_cpu_request()
+
 from mdanalysis_mpi_tpu.utils.config import main
 
 sys.exit(main())

@@ -166,15 +166,21 @@ class AtomGroup:
         re-parse of multi-pass analyses at large atom counts (the
         run-level echo of quirk Q3).
         """
-        from mdanalysis_mpi_tpu.core.selection import select_mask
+        from mdanalysis_mpi_tpu.core.selection import select_mask_info
 
         top = self._universe.topology
         n = top.n_atoms
         whole = len(self._indices) == n
+        udict = self._universe.__dict__
+        cache = udict.setdefault("_selection_cache", {})
+        # strings whose parse provably never consulted a group scope:
+        # their masks are shared by every subgroup under (selection, None)
+        insensitive = udict.setdefault("_selection_scope_insensitive",
+                                       set())
         # exact bytes as the scope key (a 64-bit hash could collide and
         # silently serve another subgroup's mask)
-        key = (selection, None if whole else self._indices.tobytes())
-        cache = self._universe.__dict__.setdefault("_selection_cache", {})
+        key = (selection, None if whole or selection in insensitive
+               else self._indices.tobytes())
         mask = cache.get(key)
         if mask is None:
             if whole:
@@ -189,9 +195,12 @@ class AtomGroup:
                 ts = self._universe.trajectory.ts
                 return ts.positions, ts.dimensions
 
-            mask = select_mask(top, selection, positions=coords,
-                               scope=scope)
+            mask, scope_consulted = select_mask_info(
+                top, selection, positions=coords, scope=scope)
             if not touched_frame:
+                if not whole and not scope_consulted:
+                    insensitive.add(selection)
+                    key = (selection, None)
                 if len(cache) >= 256:    # bound stale-string buildup
                     cache.clear()
                 cache[key] = mask

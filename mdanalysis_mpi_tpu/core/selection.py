@@ -118,6 +118,10 @@ class _Parser:
         # keyword masks don't need it (callers intersect the final mask
         # with the group anyway).
         self.scope = scope
+        # purity witness for callers' caches: True once any node
+        # actually consulted the group scope (scope-insensitive parses
+        # under a scope yield the same mask as unscoped ones)
+        self.scope_consulted = False
         # (n_atoms, 3) current frame + (6,) box — may be a zero-arg
         # callable so topology-only selections never force a frame
         # decode (resolved lazily the first time 'around' needs them)
@@ -258,8 +262,10 @@ class _Parser:
     def _scoped(self, inner: np.ndarray) -> np.ndarray:
         """Group-scope an inner sub-selection mask — unless it came from
         ``global`` (see :class:`_GlobalMask`)."""
-        if self.scope is not None and not isinstance(inner, _GlobalMask):
-            return inner & self.scope
+        if self.scope is not None:
+            self.scope_consulted = True
+            if not isinstance(inner, _GlobalMask):
+                return inner & self.scope
         return np.asarray(inner)
 
     def _byres(self, inner: np.ndarray) -> np.ndarray:
@@ -416,8 +422,11 @@ class _Parser:
         within = np.zeros(len(pos), dtype=bool)
         # candidates: only scope atoms can survive the caller's group
         # intersection, so don't compute distances for the rest
-        cand = np.flatnonzero(self.scope) if self.scope is not None \
-            else np.arange(len(pos))
+        if self.scope is not None:
+            self.scope_consulted = True
+            cand = np.flatnonzero(self.scope)
+        else:
+            cand = np.arange(len(pos))
         # block sizes bound the peak temporaries: minimum_image upcasts
         # to f64, so each (A, B, 3) block costs ~A·B·24 B ≈ 25 MB here
         A_CHUNK, B_CHUNK = 2048, 512
@@ -522,6 +531,20 @@ def select_mask(top: Topology, selection: str,
     """
     return _Parser(selection, top, positions=positions, box=box,
                    scope=scope).parse()
+
+
+def select_mask_info(top: Topology, selection: str,
+                     positions: np.ndarray | None = None,
+                     box: np.ndarray | None = None,
+                     scope: np.ndarray | None = None
+                     ) -> tuple[np.ndarray, bool]:
+    """:func:`select_mask` plus the scope-purity witness:
+    ``(mask, scope_consulted)``.  ``scope_consulted`` False means the
+    parse never looked at ``scope`` — the mask is valid for ANY scope of
+    the same topology (what group-level selection caches key on)."""
+    p = _Parser(selection, top, positions=positions, box=box, scope=scope)
+    mask = p.parse()
+    return mask, p.scope_consulted
 
 
 def select(top: Topology, selection: str,

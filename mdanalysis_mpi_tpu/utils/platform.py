@@ -1,0 +1,21 @@
+"""Entry-point platform pinning shared by every executable surface."""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_cpu_request() -> None:
+    """Re-pin JAX to CPU when the environment asked for it.
+
+    The axon site hook re-asserts ``JAX_PLATFORMS=axon`` at interpreter
+    start, clobbering an explicit env request for the virtual-CPU
+    platform (how multi-chip sharding is validated without hardware).
+    ``jax.config`` outranks the env var, so every entry point calls this
+    before its first JAX use instead of each re-implementing the check.
+    No-op unless "cpu" appears in ``JAX_PLATFORMS``.
+    """
+    if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")

@@ -32,3 +32,6 @@ def pytest_configure(config):
         "markers",
         "tpu: on-chip hardware smoke tests (run with `pytest -m tpu` "
         "or MDTPU_TPU_TESTS=1; skipped otherwise)")
+    config.addinivalue_line(
+        "markers",
+        "slow: subprocess/end-to-end tests on the order of a minute")

@@ -380,11 +380,23 @@ class TestSelectionMemoization:
         cache = u.__dict__.get("_selection_cache", {})
         assert all("around" not in k[0] for k in cache)
 
-    def test_subgroup_scope_keys_distinct(self):
+    def test_scope_insensitive_strings_share_one_entry(self):
+        # plain keyword selections ignore scope: a subgroup parse proves
+        # it (scope never consulted) and shares the (selection, None)
+        # entry instead of burning one cache slot per subgroup
         u = make_solvated_universe(n_frames=4)
-        whole = u.select_atoms("name CA")
         sub = u.select_atoms("protein").select_atoms("name CA")
+        whole = u.select_atoms("name CA")
         np.testing.assert_array_equal(whole.indices, sub.indices)
         cache = u.__dict__["_selection_cache"]
-        keys = [k for k in cache if k[0] == "name CA"]
+        assert [k for k in cache if k[0] == "name CA"] == [("name CA", None)]
+
+    def test_scope_sensitive_strings_keyed_per_subgroup(self):
+        # byres consults the scope: a subgroup's mask must NOT be shared
+        u = make_solvated_universe(n_frames=4)
+        whole = u.select_atoms("byres name OW")
+        sub = u.select_atoms("not protein").select_atoms("byres name OW")
+        cache = u.__dict__["_selection_cache"]
+        keys = [k for k in cache if k[0] == "byres name OW"]
         assert len(keys) == 2           # whole-universe + scoped entry
+        assert set(sub.indices) <= set(whole.indices)
