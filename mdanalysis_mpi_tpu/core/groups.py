@@ -203,6 +203,8 @@ class AtomGroup:
                     key = (selection, None)
                 if len(cache) >= 256:    # bound stale-string buildup
                     cache.clear()
+                if len(insensitive) >= 256:   # same bound, same reason
+                    insensitive.clear()
                 cache[key] = mask
         return AtomGroup(self._universe,
                          self._indices[mask[self._indices]])

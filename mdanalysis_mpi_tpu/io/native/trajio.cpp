@@ -21,7 +21,9 @@
 #include <algorithm>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
+#include <thread>
 #include <cmath>
 #include <vector>
 
@@ -558,13 +560,16 @@ long xtc_scan(const char* path, int* natoms_out, long* offsets,
 
 // Read n frames at the given byte offsets into coords (n*natoms*3).
 // box (n*9, may be null), times (n, may be null), steps (n, may be null).
-int xtc_read_frames(const char* path, const long* offsets, long n,
-                    int natoms, float* coords, float* box, float* times,
-                    int* steps) {
+// One worker's slice of an xtc_read_frames call: frames are fully
+// independent (every frame is self-delimiting at its known offset), so
+// each worker opens its own FILE* and decodes a contiguous range.
+static int xtc_read_range(const char* path, const long* offsets,
+                          long lo, long hi, int natoms, float* coords,
+                          float* box, float* times, int* steps) {
     FILE* f = fopen(path, "rb");
     if (!f) return -1;
     Reader r{f};
-    for (long i = 0; i < n; i++) {
+    for (long i = lo; i < hi; i++) {
         if (fseek(f, offsets[i], SEEK_SET) != 0) { fclose(f); return -2; }
         XtcHeader h;
         if (xtc_read_header(r, h) != 0) { fclose(f); return -3; }
@@ -578,6 +583,48 @@ int xtc_read_frames(const char* path, const long* offsets, long n,
         if (steps) steps[i] = h.step;
     }
     fclose(f);
+    return 0;
+}
+
+// Frame-parallel decode: MDTPU_DECODE_THREADS workers (default 1; the
+// decode is compute-bound bit-twiddling, so threads scale ~linearly on
+// multi-core hosts — the v5e-8 target — while a single-core host keeps
+// the sequential path with zero thread overhead).  Correctness is
+// thread-count-independent: workers write disjoint frame ranges.
+int xtc_read_frames(const char* path, const long* offsets, long n,
+                    int natoms, float* coords, float* box, float* times,
+                    int* steps) {
+    long nthreads = 1;
+    if (const char* env = getenv("MDTPU_DECODE_THREADS")) {
+        nthreads = atol(env);
+        if (nthreads < 1) nthreads = 1;
+        // clamp near real parallelism: more workers than cores cannot
+        // help (the decode is compute-bound) and unbounded counts
+        // would risk std::thread construction failure, which must not
+        // unwind across this C ABI.  The small floor keeps the
+        // threaded path testable on 1-core hosts.
+        long hw = (long)std::thread::hardware_concurrency();
+        long cap = hw >= 4 ? hw : 4;
+        if (nthreads > cap) nthreads = cap;
+    }
+    if (nthreads > n) nthreads = n > 0 ? n : 1;
+    if (nthreads == 1)
+        return xtc_read_range(path, offsets, 0, n, natoms, coords, box,
+                              times, steps);
+    std::vector<std::thread> workers;
+    std::vector<int> rcs((size_t)nthreads, 0);
+    long per = n / nthreads, extra = n % nthreads, lo = 0;
+    for (long t = 0; t < nthreads; t++) {
+        long hi = lo + per + (t < extra ? 1 : 0);
+        workers.emplace_back([=, &rcs]() {
+            rcs[(size_t)t] = xtc_read_range(path, offsets, lo, hi, natoms,
+                                            coords, box, times, steps);
+        });
+        lo = hi;
+    }
+    for (auto& w : workers) w.join();
+    for (int rc : rcs)
+        if (rc != 0) return rc;
     return 0;
 }
 

@@ -33,10 +33,11 @@ def test_bench_json_contract():
         # at this scale, globbed away in the finally block below)
         BENCH_SOURCE="file",
     )
-    proc = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
-                          env=env, capture_output=True, text=True,
-                          timeout=600)
     try:
+        proc = subprocess.run([sys.executable,
+                               os.path.join(REPO, "bench.py")],
+                              env=env, capture_output=True, text=True,
+                              timeout=600)
         assert proc.returncode == 0, proc.stderr[-3000:]
         line = proc.stdout.strip().splitlines()[-1]
         rec = json.loads(line)

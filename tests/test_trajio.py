@@ -477,3 +477,24 @@ class TestTRRWriteValidation:
         with pytest.raises(ValueError, match="dimensions"):
             write_trr(str(path), coords, dimensions=np.zeros((2, 6)))
         assert not path.exists()
+
+
+def test_xtc_decode_thread_count_independent(tmp_path, monkeypatch):
+    """Frame-parallel decode (MDTPU_DECODE_THREADS) must be bit-identical
+    to the sequential path — workers decode disjoint frame ranges from
+    independent file handles."""
+    import numpy as np
+
+    from mdanalysis_mpi_tpu.io.xtc import XTCReader, write_xtc
+
+    rng = np.random.default_rng(7)
+    frames = rng.normal(scale=8.0, size=(13, 500, 3)).astype(np.float32)
+    path = str(tmp_path / "t.xtc")
+    write_xtc(path, frames, dimensions=np.array([40.0, 40, 40, 90, 90, 90]))
+    r = XTCReader(path)
+    seq, seq_box = r.read_block(0, 13)
+    for n in ("3", "16"):               # uneven split; threads > frames
+        monkeypatch.setenv("MDTPU_DECODE_THREADS", n)
+        thr, thr_box = r.read_block(0, 13)
+        np.testing.assert_array_equal(seq, thr)
+        np.testing.assert_array_equal(seq_box, thr_box)
