@@ -1,0 +1,44 @@
+#!/bin/bash
+# One-shot round-N artifact recorder (run on the real chip when the
+# tunnel is up).  Produces, next to the driver's BENCH_r{N}.json:
+#   SUITE_r{N}.json      — the 5-config matrix with serial windows
+#   TPUSMOKE_r{N}.json   — on-chip pytest -m tpu result (VERDICT r2 #8)
+#   PROFILE_r{N}.json    — staging phase decomposition for PERF.md
+# Usage: benchmarks/record_round.sh <round-number>
+set -u
+N="${1:?usage: record_round.sh <round-number>}"
+cd "$(dirname "$0")/.."
+
+echo "[record] on-chip smoke..." >&2
+MDTPU_TPU_TESTS=1 python -m pytest tests/ -m tpu -q > /tmp/tpusmoke.txt 2>&1
+rc=$?
+python - "$N" "$rc" <<'EOF'
+import json, sys
+n, rc = sys.argv[1], int(sys.argv[2])
+txt = open("/tmp/tpusmoke.txt").read()
+json.dump({"round": int(n), "rc": rc, "tail": txt[-2000:]},
+          open(f"TPUSMOKE_r{n.zfill(2)}.json", "w"), indent=1)
+EOF
+
+echo "[record] suite..." >&2
+python benchmarks/suite.py > "/tmp/suite_rows.jsonl" 2>/tmp/suite_err.txt
+python - "$N" <<'EOF'
+import json, sys
+n = sys.argv[1]
+rows = [json.loads(l) for l in open("/tmp/suite_rows.jsonl")
+        if l.strip().startswith("{")]
+json.dump({"round": int(n),
+           "hardware": "1x TPU v5 lite (tunneled), 1 host core",
+           "note": ("value = accelerator frames/s (median, readback-free "
+                    "timing); serial_fps measured first on an adaptive "
+                    "window (serial_frames) stable to ~10%"),
+           "rows": rows},
+          open(f"SUITE_r{n.zfill(2)}.json", "w"), indent=1)
+EOF
+
+echo "[record] staging profile..." >&2
+python benchmarks/profile_staging.py > "PROFILE_r$(printf %02d "$N").json" \
+    2>/tmp/profile_err.txt
+
+echo "[record] bench (informational run; the driver records its own)..." >&2
+python bench.py

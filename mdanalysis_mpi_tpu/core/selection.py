@@ -21,7 +21,7 @@ Grammar (recursive descent)::
               | 'global' not_expr | keyword
     keyword  := 'all' | 'none' | 'protein' | 'backbone' | 'nucleic'
               | 'nucleicbackbone' | 'water' | 'hydrogen' | 'heavy'
-              | ('name'|'resname'|'segid'|'element'|'type') value+
+              | ('name'|'resname'|'segid'|'chainID'|'element'|'type') value+
               | ('resid'|'resnum') range+
               | ('index'|'bynum') range+
               | 'prop' ['abs'] ('mass'|'charge'|'x'|'y'|'z') cmp number
@@ -80,7 +80,8 @@ _RESERVED = {
     "and", "or", "not", "(", ")",
     "all", "none", "protein", "backbone", "nucleic", "nucleicbackbone",
     "water", "hydrogen", "heavy",
-    "name", "resname", "segid", "element", "type", "resid", "resnum",
+    "name", "resname", "segid", "chainID", "chainid", "element", "type",
+    "resid", "resnum",
     "index", "bynum", "prop", "around",
     "byres", "same", "as", "sphzone", "point", "global",
     "cyzone", "cylayer", "bonded",
@@ -236,6 +237,10 @@ class _Parser:
             return t.is_backbone.copy()
         if tok == "nucleicbackbone":
             return t.is_nucleic_backbone.copy()
+        if tok in ("chainID", "chainid"):
+            # chainID aliases segid: this topology model folds PDB chain
+            # ids into the segment-id column (io/pdb.py)
+            return self._string_match(tok, t.segids)
         if tok in ("name", "resname", "segid", "element", "type"):
             attr = {"name": t.names, "resname": t.resnames, "segid": t.segids,
                     "element": t.elements, "type": t.elements}[tok]

@@ -45,6 +45,8 @@ CASES = [
     ("resid 1:2", [0, 1, 2, 3, 4, 5, 6, 7]),
     ("resid 2-3", [5, 6, 7, 8]),
     ("segid PROT ION", [0, 1, 2, 3, 4, 8]),
+    ("chainID PROT", [0, 1, 2, 3, 4]),     # chainID aliases segid (PDB chains fold there)
+    ("chainid NUC", [9, 10, 11, 12]),
     ("element N", [0]),                     # nitrogen only; the NA ion is element NA
     ("index 0:2", [0, 1, 2]),
     ("bynum 1:3", [0, 1, 2]),
