@@ -21,7 +21,12 @@ json.dump({"round": int(n), "rc": rc, "tail": txt[-2000:]},
 EOF
 
 echo "[record] suite..." >&2
-python benchmarks/suite.py > "/tmp/suite_rows.jsonl" 2>/tmp/suite_err.txt
+if ! python benchmarks/suite.py > "/tmp/suite_rows.jsonl" \
+        2>/tmp/suite_err.txt; then
+    echo "[record] SUITE FAILED:" >&2
+    tail -5 /tmp/suite_err.txt >&2
+    exit 1
+fi
 python - "$N" <<'EOF'
 import json, sys
 n = sys.argv[1]
@@ -37,8 +42,12 @@ json.dump({"round": int(n),
 EOF
 
 echo "[record] staging profile..." >&2
-python benchmarks/profile_staging.py > "PROFILE_r$(printf %02d "$N").json" \
-    2>/tmp/profile_err.txt
+if ! python benchmarks/profile_staging.py \
+        > "PROFILE_r$(printf %02d "$N").json" 2>/tmp/profile_err.txt; then
+    echo "[record] PROFILE FAILED:" >&2
+    tail -5 /tmp/profile_err.txt >&2
+    exit 1
+fi
 
 echo "[record] bench (informational run; the driver records its own)..." >&2
 python bench.py

@@ -89,6 +89,26 @@ class AtomGroup:
     def positions(self, value):
         self._universe.trajectory.ts.positions[self._indices] = value
 
+    @property
+    def velocities(self) -> np.ndarray:
+        """float32 (n_atoms, 3) velocities (Å/ps) at the current frame;
+        raises if the trajectory format carries none (upstream
+        ``ag.velocities`` contract — TRR has them, XTC/DCD do not)."""
+        v = self._universe.trajectory.ts.velocities
+        if v is None:
+            raise AttributeError(
+                "this trajectory's frames carry no velocities")
+        return v[self._indices]
+
+    @property
+    def forces(self) -> np.ndarray:
+        """float32 (n_atoms, 3) forces (kJ/(mol·Å)) at the current
+        frame; raises if the format carries none."""
+        f = self._universe.trajectory.ts.forces
+        if f is None:
+            raise AttributeError("this trajectory's frames carry no forces")
+        return f[self._indices]
+
     def center_of_mass(self) -> np.ndarray:
         """Mass-weighted center, float64 (3,) (reference RMSF.py:84,94)."""
         m = self.masses

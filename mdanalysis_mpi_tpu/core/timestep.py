@@ -14,12 +14,21 @@ import numpy as np
 
 
 class Timestep:
-    """One trajectory frame: positions (float32, (n_atoms, 3)), box, time."""
+    """One trajectory frame: positions (float32, (n_atoms, 3)), box, time.
 
-    __slots__ = ("positions", "frame", "time", "dimensions")
+    ``velocities`` (Å/ps) and ``forces`` (kJ/(mol·Å)) are optional —
+    None unless the format carries them (TRR does; XTC/DCD do not) —
+    matching the upstream Timestep's optional attributes and unit
+    conventions.
+    """
+
+    __slots__ = ("positions", "frame", "time", "dimensions",
+                 "velocities", "forces")
 
     def __init__(self, positions: np.ndarray, frame: int = 0,
-                 time: float = 0.0, dimensions: np.ndarray | None = None):
+                 time: float = 0.0, dimensions: np.ndarray | None = None,
+                 velocities: np.ndarray | None = None,
+                 forces: np.ndarray | None = None):
         self.positions = np.asarray(positions, dtype=np.float32)
         if self.positions.ndim != 2 or self.positions.shape[1] != 3:
             raise ValueError(f"positions must be (n_atoms, 3), got {self.positions.shape}")
@@ -28,14 +37,25 @@ class Timestep:
         # [lx, ly, lz, alpha, beta, gamma] — MDAnalysis convention.
         self.dimensions = (np.asarray(dimensions, dtype=np.float32)
                            if dimensions is not None else None)
+        for name, arr in (("velocities", velocities), ("forces", forces)):
+            if arr is not None:
+                arr = np.asarray(arr, dtype=np.float32)
+                if arr.shape != self.positions.shape:
+                    raise ValueError(
+                        f"{name} must match positions shape "
+                        f"{self.positions.shape}, got {arr.shape}")
+            setattr(self, name, arr)
 
     @property
     def n_atoms(self) -> int:
         return self.positions.shape[0]
 
     def copy(self) -> "Timestep":
-        return Timestep(self.positions.copy(), self.frame, self.time,
-                        None if self.dimensions is None else self.dimensions.copy())
+        return Timestep(
+            self.positions.copy(), self.frame, self.time,
+            None if self.dimensions is None else self.dimensions.copy(),
+            None if self.velocities is None else self.velocities.copy(),
+            None if self.forces is None else self.forces.copy())
 
     def __repr__(self):
         return f"<Timestep frame={self.frame} n_atoms={self.n_atoms}>"

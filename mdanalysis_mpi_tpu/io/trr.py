@@ -16,10 +16,12 @@ semantics, reimplemented from the on-disk format):
   — each present iff its size field is nonzero.
 
 Frame byte size is fully determined by the header, so the offset index
-is a cheap header-hop scan (cached to disk like the XTC index).  Only
-positions and box are returned; velocities/forces are skipped by
-offset.  Coordinates convert nm→Å at the boundary, matching the rest
-of the io layer.
+is a cheap header-hop scan (cached to disk like the XTC index).
+Single-frame reads expose velocities/forces on the Timestep when the
+frame carries them (upstream units: Å/ps, kJ/(mol·Å)); the bulk
+``read_block`` staging path reads positions+box only (the analysis
+kernels consume coordinates).  Coordinates convert nm→Å at the
+boundary, matching the rest of the io layer.
 
 Throughput class (measured, 100 frames × 50k atoms, this host):
 ``read_block`` decodes one contiguous file read via vectorized
@@ -191,8 +193,21 @@ class TRRReader(ReaderBase):
                                   * _NM_TO_A)
             if not dims[:3].any():
                 dims = None
+        # velocities (nm/ps → Å/ps) and forces (kJ/mol/nm → kJ/mol/Å)
+        # when the frame carries them — upstream Timestep units
+        vel = frc = None
+        if h.sizes["v_size"]:
+            v = np.frombuffer(buf, fl, 3 * h.natoms,
+                              h.x_off + h.sizes["x_size"])
+            vel = v.astype(np.float32).reshape(h.natoms, 3) * _NM_TO_A
+        if h.sizes["f_size"]:
+            fo = np.frombuffer(buf, fl, 3 * h.natoms,
+                               h.x_off + h.sizes["x_size"]
+                               + h.sizes["v_size"])
+            frc = fo.astype(np.float32).reshape(h.natoms, 3) / _NM_TO_A
         t = float(np.frombuffer(buf, fl, 1, _HEAD_BYTES)[0])
-        return Timestep(coords, frame=i, time=t, dimensions=dims)
+        return Timestep(coords, frame=i, time=t, dimensions=dims,
+                        velocities=vel, forces=frc)
 
     def frame_times(self, frames) -> np.ndarray:
         idx = np.asarray(list(frames), dtype=np.int64)
@@ -250,10 +265,13 @@ class TRRReader(ReaderBase):
 def write_trr(path: str, coordinates: np.ndarray,
               dimensions: np.ndarray | None = None,
               times: np.ndarray | None = None,
-              steps: np.ndarray | None = None) -> None:
+              steps: np.ndarray | None = None,
+              velocities: np.ndarray | None = None,
+              forces: np.ndarray | None = None) -> None:
     """Write (n_frames, n_atoms, 3) Å coordinates as a float32 TRR
-    (positions + optional box; no velocities/forces) — the fixture
-    writer counterpart of :func:`TRRReader` (SURVEY.md §4)."""
+    (positions + optional box/velocities/forces) — the fixture writer
+    counterpart of :func:`TRRReader` (SURVEY.md §4).  ``velocities`` in
+    Å/ps and ``forces`` in kJ/(mol·Å), the upstream Timestep units."""
     coords = np.asarray(coordinates, dtype=np.float32) / _NM_TO_A
     if coords.ndim != 3 or coords.shape[2] != 3:
         raise ValueError(f"coordinates must be (F, N, 3), got {coords.shape}")
@@ -266,6 +284,20 @@ def write_trr(path: str, coordinates: np.ndarray,
     if steps is not None and len(steps) != nframes:
         raise ValueError(
             f"steps has {len(steps)} entries for {nframes} frames")
+    def _per_atom(name, arr, scale):
+        """Shape-check + unit-convert in one place (the only copy of
+        the Å↔nm scale factors)."""
+        if arr is None:
+            return None
+        arr = np.asarray(arr, np.float32)
+        if arr.shape != coords.shape:
+            raise ValueError(
+                f"{name} must be shaped like coordinates {coords.shape}, "
+                f"got {arr.shape}")
+        return arr * np.float32(scale)
+
+    vel = _per_atom("velocities", velocities, 1.0 / _NM_TO_A)  # Å/ps→nm/ps
+    frc = _per_atom("forces", forces, _NM_TO_A)                # /Å → /nm
     if dimensions is not None:
         dimensions = np.asarray(dimensions)
         if dimensions.ndim == 1:
@@ -282,9 +314,11 @@ def write_trr(path: str, coordinates: np.ndarray,
         for i in range(nframes):
             box_size = 36 if dimensions is not None else 0
             x_size = 12 * natoms
+            v_size = x_size if vel is not None else 0
+            f_size = x_size if frc is not None else 0
             head = np.array([_MAGIC, len(_TAG) + 1], dtype=">i4").tobytes()
             head += np.array([len(_TAG)], dtype=">i4").tobytes() + _TAG
-            fields = [0, 0, box_size, 0, 0, 0, 0, x_size, 0, 0,
+            fields = [0, 0, box_size, 0, 0, 0, 0, x_size, v_size, f_size,
                       natoms, int(steps[i]) if steps is not None else i, 0]
             head += np.asarray(fields, dtype=">i4").tobytes()
             t = float(times[i]) if times is not None else 0.0
@@ -295,6 +329,12 @@ def write_trr(path: str, coordinates: np.ndarray,
                 f.write(np.asarray(vecs, dtype=">f4").tobytes())
             f.write(np.ascontiguousarray(coords[i], np.float32)
                     .astype(">f4").tobytes())
+            if vel is not None:
+                f.write(np.ascontiguousarray(vel[i], np.float32)
+                        .astype(">f4").tobytes())
+            if frc is not None:
+                f.write(np.ascontiguousarray(frc[i], np.float32)
+                        .astype(">f4").tobytes())
 
 
 trajectory_files.register("trr", TRRReader)

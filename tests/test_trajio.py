@@ -498,3 +498,32 @@ def test_xtc_decode_thread_count_independent(tmp_path, monkeypatch):
         thr, thr_box = r.read_block(0, 13)
         np.testing.assert_array_equal(seq, thr)
         np.testing.assert_array_equal(seq_box, thr_box)
+
+
+def test_trr_velocities_forces_roundtrip(tmp_path):
+    """TRR frames carrying velocities/forces expose them on the Timestep
+    in upstream units (A/ps, kJ/(mol.A)); frames without them read None."""
+    import numpy as np
+
+    from mdanalysis_mpi_tpu.io.trr import TRRReader, write_trr
+
+    rng = np.random.default_rng(3)
+    x = rng.normal(scale=5.0, size=(4, 60, 3)).astype(np.float32)
+    v = rng.normal(scale=0.5, size=x.shape).astype(np.float32)
+    fo = rng.normal(scale=50.0, size=x.shape).astype(np.float32)
+    path = str(tmp_path / "vf.trr")
+    write_trr(path, x, dimensions=np.array([30.0, 30, 30, 90, 90, 90]),
+              velocities=v, forces=fo)
+    r = TRRReader(path)
+    ts = r[2]
+    np.testing.assert_allclose(ts.positions, x[2], atol=2e-3)
+    np.testing.assert_allclose(ts.velocities, v[2], rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(ts.forces, fo[2], rtol=1e-5, atol=1e-4)
+    # position-only file: attributes stay None
+    path2 = str(tmp_path / "xonly.trr")
+    write_trr(path2, x)
+    ts2 = TRRReader(path2)[0]
+    assert ts2.velocities is None and ts2.forces is None
+    # copy() carries them
+    c = ts.copy()
+    np.testing.assert_array_equal(c.velocities, ts.velocities)
