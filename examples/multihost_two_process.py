@@ -72,7 +72,14 @@ def main() -> None:
     procs = [subprocess.Popen(
         [sys.executable, __file__, "--worker", str(i), coordinator],
         env=env) for i in range(2)]
-    rcs = [p.wait(timeout=300) for p in procs]
+    try:
+        rcs = [p.wait(timeout=300) for p in procs]
+    finally:
+        # one worker dying leaves its peer blocked in the collective —
+        # never orphan it
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
     if any(rcs):
         sys.exit(f"worker exit codes: {rcs}")
 

@@ -229,6 +229,34 @@ class AtomGroup:
         return AtomGroup(self._universe,
                          self._indices[mask[self._indices]])
 
+    def write(self, path: str) -> None:
+        """Write this group's current-frame coordinates (+ subset
+        topology) to ``path`` — format chosen by extension (.gro, .pdb,
+        .psf), the upstream ``ag.write`` idiom.  Bonds internal to the
+        group survive with remapped indices (``Topology.subset``)."""
+        import os
+
+        ext = os.path.splitext(path)[1].lstrip(".").lower()
+        top = self._universe.topology.subset(self._indices)
+        ts = self._universe.trajectory.ts
+        dims = ts.dimensions
+        if ext == "gro":
+            from mdanalysis_mpi_tpu.io.gro import write_gro
+
+            write_gro(path, top, self.positions, dimensions=dims)
+        elif ext == "pdb":
+            from mdanalysis_mpi_tpu.io.pdb import write_pdb
+
+            write_pdb(path, top, self.positions, dimensions=dims)
+        elif ext == "psf":
+            from mdanalysis_mpi_tpu.io.psf import write_psf
+
+            write_psf(path, top)
+        else:
+            raise ValueError(
+                f"unsupported extension {ext!r} for AtomGroup.write "
+                "(supported: gro, pdb, psf)")
+
     def __and__(self, other: "AtomGroup") -> "AtomGroup":
         self._check(other)
         return AtomGroup(self._universe,

@@ -38,6 +38,38 @@ class Topology:
     bonds: np.ndarray | None = None        # (n_bonds, 2) int atom indices
     _derived: dict = field(default_factory=dict, repr=False)
 
+    def subset(self, indices: np.ndarray) -> "Topology":
+        """New Topology restricted to ``indices`` (atom order preserved).
+
+        Bonds survive iff BOTH endpoints are selected, remapped to the
+        subset's 0-based numbering — what ``AtomGroup.write`` and
+        subset-universe construction need.
+        """
+        idx = np.asarray(indices, dtype=np.int64)
+        bonds = None
+        if self.bonds is not None and len(self.bonds):
+            remap = np.full(self.n_atoms, -1, dtype=np.int64)
+            remap[idx] = np.arange(len(idx))
+            b = remap[self.bonds]
+            bonds = b[(b >= 0).all(axis=1)]
+        # carry residue identity explicitly: recomputing boundaries from
+        # (resid, segid) change-points would merge distinct residues that
+        # subsetting makes adjacent (e.g. wrapped resids).  Parent
+        # resindices are validated monotonic, so np.unique's inverse IS
+        # the dense 0-based renumbering in first-occurrence order.
+        _, dense = np.unique(self.resindices[idx], return_inverse=True)
+        return Topology(
+            names=self.names[idx],
+            resnames=self.resnames[idx],
+            resids=self.resids[idx],
+            segids=None if self.segids is None else self.segids[idx],
+            elements=None if self.elements is None else self.elements[idx],
+            masses=None if self.masses is None else self.masses[idx],
+            charges=None if self.charges is None else self.charges[idx],
+            resindices=dense,
+            bonds=bonds,
+        )
+
     def __post_init__(self):
         self.names = np.asarray(self.names, dtype=np.str_)
         self.resnames = np.asarray(self.resnames, dtype=np.str_)

@@ -232,3 +232,48 @@ class TestAdviceR1Fixes:
         top = u.topology
         np.testing.assert_array_equal(
             res._first_atom, top.residue_first_atom[res.resindices])
+
+
+class TestTopologySubsetAndWrite:
+    def test_subset_remaps_bonds(self):
+        from mdanalysis_mpi_tpu.core.topology import Topology
+
+        top = Topology(names=np.array(["A", "B", "C", "D"]),
+                       resnames=np.array(["R"] * 4),
+                       resids=np.array([1, 1, 2, 2]),
+                       bonds=np.array([[0, 1], [1, 2], [2, 3]]))
+        sub = top.subset(np.array([1, 2, 3]))
+        assert sub.n_atoms == 3
+        assert list(sub.names) == ["B", "C", "D"]
+        # bond 0-1 dropped (atom 0 absent); 1-2 -> 0-1; 2-3 -> 1-2
+        np.testing.assert_array_equal(sub.bonds, [[0, 1], [1, 2]])
+
+    def test_atomgroup_write_roundtrip(self, tmp_path):
+        from mdanalysis_mpi_tpu.core.universe import Universe
+        from mdanalysis_mpi_tpu.testing import make_solvated_universe
+
+        u = make_solvated_universe(n_residues=4, n_waters=6, n_frames=2)
+        ca = u.select_atoms("protein and name CA")
+        for ext in ("gro", "pdb"):
+            path = str(tmp_path / f"ca.{ext}")
+            ca.write(path)
+            u2 = Universe(path)
+            assert u2.atoms.n_atoms == ca.n_atoms
+            assert list(u2.atoms.names) == list(ca.names)
+            np.testing.assert_allclose(u2.trajectory[0].positions,
+                                       ca.positions, atol=2e-2)
+        with pytest.raises(ValueError, match="unsupported extension"):
+            ca.write(str(tmp_path / "ca.xyz"))
+
+    def test_subset_preserves_distinct_adjacent_residues(self):
+        """Wrapped/reused resids: subsetting must not merge residues
+        that become adjacent (resindices carried, not recomputed)."""
+        from mdanalysis_mpi_tpu.core.topology import Topology
+
+        top = Topology(names=np.array(["A1", "B1", "A2"]),
+                       resnames=np.array(["R", "S", "R"]),
+                       resids=np.array([1, 2, 1]),       # resid 1 reused
+                       resindices=np.array([0, 1, 2]))
+        sub = top.subset(np.array([0, 2]))               # drop middle res
+        np.testing.assert_array_equal(sub.resindices, [0, 1])
+        assert sub.n_residues == 2
