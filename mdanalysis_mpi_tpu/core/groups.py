@@ -243,7 +243,10 @@ class AtomGroup:
         if ext == "gro":
             from mdanalysis_mpi_tpu.io.gro import write_gro
 
-            write_gro(path, top, self.positions, dimensions=dims)
+            vel = (None if ts.velocities is None
+                   else ts.velocities[self._indices])
+            write_gro(path, top, self.positions, dimensions=dims,
+                      velocities=vel)
         elif ext == "pdb":
             from mdanalysis_mpi_tpu.io.pdb import write_pdb
 

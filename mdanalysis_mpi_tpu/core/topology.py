@@ -54,10 +54,20 @@ class Topology:
             bonds = b[(b >= 0).all(axis=1)]
         # carry residue identity explicitly: recomputing boundaries from
         # (resid, segid) change-points would merge distinct residues that
-        # subsetting makes adjacent (e.g. wrapped resids).  Parent
-        # resindices are validated monotonic, so np.unique's inverse IS
-        # the dense 0-based renumbering in first-occurrence order.
-        _, dense = np.unique(self.resindices[idx], return_inverse=True)
+        # subsetting makes adjacent (e.g. wrapped resids).  Each
+        # contiguous run of one parent residue becomes one residue —
+        # equal to a plain dense renumber for sorted selections, and for
+        # reordered/scattered groups (``u.atoms[[6, 0, 1]].write(...)``)
+        # it keeps this model's residues-are-contiguous invariant while
+        # preserving the group's atom order and per-atom resid labels.
+        parent_res = self.resindices[idx]
+        if len(parent_res):
+            change = np.empty(len(parent_res), dtype=bool)
+            change[0] = True
+            change[1:] = parent_res[1:] != parent_res[:-1]
+            dense = np.cumsum(change) - 1
+        else:
+            dense = parent_res.copy()
         return Topology(
             names=self.names[idx],
             resnames=self.resnames[idx],

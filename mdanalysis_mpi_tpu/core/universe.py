@@ -47,8 +47,10 @@ class Universe:
             # topology file (GRO/PDB) if present, else one zero frame.
             src = getattr(self.topology, "_coordinates", None)
             dims = getattr(self.topology, "_dimensions", None)
+            vels = getattr(self.topology, "_velocities", None)
             if src is not None:
-                trajectory = MemoryReader(src, dimensions=dims)
+                trajectory = MemoryReader(src, dimensions=dims,
+                                          velocities=vels)
             else:
                 trajectory = np.zeros((1, self.topology.n_atoms, 3),
                                       dtype=np.float32)

@@ -39,6 +39,10 @@ def parse_gro(path: str) -> Topology:
     resnames = np.empty(n, dtype="U5")
     names = np.empty(n, dtype="U5")
     coords = np.empty((n, 3), dtype=np.float32)
+    # optional velocity columns (chars 44-68, nm/ps): present on every
+    # atom line or treated as absent (the format is all-or-nothing)
+    vels = np.zeros((n, 3), dtype=np.float32)
+    have_vels = True
     for i in range(n):
         ln = lines[i + 2]
         resids[i] = int(ln[0:5])
@@ -47,6 +51,12 @@ def parse_gro(path: str) -> Topology:
         coords[i, 0] = float(ln[20:28])
         coords[i, 1] = float(ln[28:36])
         coords[i, 2] = float(ln[36:44])
+        if have_vels and len(ln.rstrip()) >= 68:
+            vels[i, 0] = float(ln[44:52])
+            vels[i, 1] = float(ln[52:60])
+            vels[i, 2] = float(ln[60:68])
+        else:
+            have_vels = False
     coords *= _NM_TO_A
 
     box_fields = [float(x) for x in lines[n + 2].split()]
@@ -62,27 +72,41 @@ def parse_gro(path: str) -> Topology:
     top = Topology(names=names, resnames=resnames, resids=resids)
     top._coordinates = coords[None]       # single-frame fallback trajectory
     top._dimensions = dims
+    # Å/ps, the upstream Timestep convention (nm/ps in the file)
+    top._velocities = vels[None] * _NM_TO_A if have_vels else None
     return top
 
 
 def write_gro(path: str, topology: Topology, coordinates: np.ndarray,
               dimensions: np.ndarray | None = None,
+              velocities: np.ndarray | None = None,
               title: str = "written by mdanalysis_mpi_tpu") -> None:
-    """Write one frame of Å coordinates as a GRO file (fixture writer)."""
+    """Write one frame of Å coordinates (and optional Å/ps velocities)
+    as a GRO file (fixture writer)."""
     coords = np.asarray(coordinates, dtype=np.float64) / _NM_TO_A
     if coords.ndim == 3:
         coords = coords[0]
     n = topology.n_atoms
     if coords.shape != (n, 3):
         raise ValueError(f"coordinates must be ({n}, 3), got {coords.shape}")
+    if velocities is not None:
+        velocities = np.asarray(velocities, dtype=np.float64) / _NM_TO_A
+        if velocities.ndim == 3:
+            velocities = velocities[0]
+        if velocities.shape != (n, 3):
+            raise ValueError(
+                f"velocities must be ({n}, 3), got {velocities.shape}")
     with open(path, "w") as fh:
         fh.write(title + "\n")
         fh.write(f"{n:5d}\n")
         for i in range(n):
-            fh.write("%5d%-5s%5s%5d%8.3f%8.3f%8.3f\n" % (
+            line = "%5d%-5s%5s%5d%8.3f%8.3f%8.3f" % (
                 topology.resids[i] % 100000, topology.resnames[i][:5],
                 topology.names[i][:5], (i + 1) % 100000,
-                coords[i, 0], coords[i, 1], coords[i, 2]))
+                coords[i, 0], coords[i, 1], coords[i, 2])
+            if velocities is not None:
+                line += "%8.4f%8.4f%8.4f" % tuple(velocities[i])
+            fh.write(line + "\n")
         if dimensions is None:
             fh.write("   0.00000   0.00000   0.00000\n")
         else:

@@ -25,7 +25,8 @@ class MemoryReader(ReaderBase):
 
     def __init__(self, coordinates: np.ndarray,
                  dimensions: np.ndarray | None = None,
-                 dt: float = 1.0, times: np.ndarray | None = None):
+                 dt: float = 1.0, times: np.ndarray | None = None,
+                 velocities: np.ndarray | None = None):
         coords = np.asarray(coordinates, dtype=np.float32)
         if coords.ndim == 2:
             coords = coords[None]
@@ -33,6 +34,15 @@ class MemoryReader(ReaderBase):
             raise ValueError(
                 f"coordinates must be (n_frames, n_atoms, 3), got {coords.shape}")
         self._coords = coords
+        if velocities is not None:
+            velocities = np.asarray(velocities, dtype=np.float32)
+            if velocities.ndim == 2:
+                velocities = velocities[None]
+            if velocities.shape != coords.shape:
+                raise ValueError(
+                    f"velocities must match coordinates {coords.shape}, "
+                    f"got {velocities.shape}")
+        self._vels = velocities
         if dimensions is not None:
             dimensions = np.asarray(dimensions, dtype=np.float32)
             if dimensions.ndim == 1:
@@ -65,14 +75,16 @@ class MemoryReader(ReaderBase):
 
     def _read_frame(self, i: int) -> Timestep:
         t = (i * self._dt) if self._times is None else float(self._times[i])
-        return Timestep(self._coords[i].copy(), frame=i, time=t,
-                        dimensions=None if self._dims is None else self._dims[i].copy())
+        return Timestep(
+            self._coords[i].copy(), frame=i, time=t,
+            dimensions=None if self._dims is None else self._dims[i].copy(),
+            velocities=None if self._vels is None else self._vels[i].copy())
 
     def reopen(self) -> "MemoryReader":
         """Independent cursor over the same backing array (zero-copy),
         supporting ``Universe.copy()`` (RMSF.py:57 semantics)."""
         return MemoryReader(self._coords, self._dims, self._dt,
-                            times=self._times)
+                            times=self._times, velocities=self._vels)
 
     def frame_times(self, frames) -> np.ndarray:
         idx = np.asarray(list(frames), dtype=np.int64)

@@ -277,3 +277,33 @@ class TestTopologySubsetAndWrite:
         sub = top.subset(np.array([0, 2]))               # drop middle res
         np.testing.assert_array_equal(sub.resindices, [0, 1])
         assert sub.n_residues == 2
+
+    def test_subset_reordered_group(self):
+        """Reordered selections (u.atoms[[2, 0]]) subset and write:
+        contiguous runs become residues, atom order preserved."""
+        from mdanalysis_mpi_tpu.core.topology import Topology
+
+        top = Topology(names=np.array(["A", "B", "C"]),
+                       resnames=np.array(["R", "R", "S"]),
+                       resids=np.array([1, 1, 2]))
+        sub = top.subset(np.array([2, 0, 1]))
+        assert list(sub.names) == ["C", "A", "B"]
+        np.testing.assert_array_equal(sub.resindices, [0, 1, 1])
+
+    def test_write_gro_carries_velocities(self, tmp_path):
+        from mdanalysis_mpi_tpu.core.universe import Universe
+        from mdanalysis_mpi_tpu.io.gro import write_gro
+        from mdanalysis_mpi_tpu.testing import make_solvated_universe
+
+        u0 = make_solvated_universe(n_residues=3, n_waters=2, n_frames=1)
+        v = np.full((u0.atoms.n_atoms, 3), 1.5, np.float32)
+        src = str(tmp_path / "src.gro")
+        write_gro(src, u0.topology, u0.trajectory[0].positions,
+                  velocities=v)
+        u = Universe(src)
+        out = str(tmp_path / "sel.gro")
+        u.select_atoms("protein").write(out)
+        u2 = Universe(out)
+        np.testing.assert_allclose(u2.atoms.velocities,
+                                   v[u.select_atoms("protein").indices],
+                                   atol=2e-3)

@@ -151,3 +151,30 @@ def test_tpr_conversion_path_documented(tmp_path):
     p.write_bytes(b"\x00" * 16)
     with pytest.raises(ValueError, match="gmx editconf"):
         topology_files.parse(str(p))
+
+
+def test_gro_velocities_roundtrip(tmp_path):
+    """GRO velocity columns (nm/ps in-file) surface as A/ps on the
+    single-frame universe; files without them read velocities=None."""
+    import numpy as np
+
+    from mdanalysis_mpi_tpu.core.topology import Topology
+    from mdanalysis_mpi_tpu.core.universe import Universe
+    from mdanalysis_mpi_tpu.io.gro import write_gro
+
+    top = Topology(names=np.array(["CA", "CB"]),
+                   resnames=np.array(["ALA", "ALA"]),
+                   resids=np.array([1, 1]))
+    x = np.array([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]], np.float32)
+    v = np.array([[0.5, -0.25, 0.0], [1.25, 0.0, -2.0]], np.float32)
+    path = str(tmp_path / "v.gro")
+    write_gro(path, top, x, velocities=v)
+    u = Universe(path)
+    ts = u.trajectory[0]
+    np.testing.assert_allclose(ts.velocities, v, atol=2e-3)
+    np.testing.assert_allclose(u.atoms.velocities, v, atol=2e-3)
+    # velocity-free file: None (and the AtomGroup accessor raises)
+    path2 = str(tmp_path / "nov.gro")
+    write_gro(path2, top, x)
+    u2 = Universe(path2)
+    assert u2.trajectory[0].velocities is None
