@@ -16,11 +16,13 @@ the reference's docstring (RMSF.py:1-18) — ``Analysis(...).run()`` →
 
 from mdanalysis_mpi_tpu.analysis.base import AnalysisBase, Results
 from mdanalysis_mpi_tpu.analysis.rms import RMSF, RMSD, AlignedRMSF
-from mdanalysis_mpi_tpu.analysis.align import AverageStructure, AlignTraj
+from mdanalysis_mpi_tpu.analysis.align import (AverageStructure, AlignTraj,
+                                               alignto, rotation_matrix)
 from mdanalysis_mpi_tpu.analysis.rdf import InterRDF
 from mdanalysis_mpi_tpu.analysis.distances import ContactMap, PairwiseDistances
 from mdanalysis_mpi_tpu.analysis.rgyr import RadiusOfGyration
 
 __all__ = ["AnalysisBase", "Results", "RMSF", "RMSD", "AlignedRMSF",
-           "AverageStructure", "AlignTraj", "InterRDF", "ContactMap",
+           "AverageStructure", "AlignTraj", "alignto", "rotation_matrix",
+           "InterRDF", "ContactMap",
            "PairwiseDistances", "RadiusOfGyration"]

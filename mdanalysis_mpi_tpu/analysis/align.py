@@ -264,3 +264,87 @@ class AlignTraj(AnalysisBase):
         u.trajectory = MemoryReader(out, dimensions=dims if have_dims else None)
         self.results.universe = u
         return self
+
+
+def rotation_matrix(mobile: np.ndarray, reference: np.ndarray,
+                    weights: np.ndarray | None = None):
+    """Optimal least-squares rotation of ``mobile`` onto ``reference``.
+
+    The public form of the reference's ``get_rotation_matrix`` wrapper
+    (RMSF.py:43-51, upstream ``align.rotation_matrix``): both inputs are
+    (N, 3) coordinates, ALREADY CENTERED on their (weighted) origins as
+    upstream requires.  Returns ``(R, rmsd)`` with ``R`` the (3, 3)
+    matrix such that ``mobile @ R`` best fits ``reference``, and
+    ``rmsd`` the minimal (weighted) RMSD after rotation.
+    """
+    mobile = np.asarray(mobile, np.float64)
+    reference = np.asarray(reference, np.float64)
+    if mobile.shape != reference.shape or mobile.ndim != 2 \
+            or mobile.shape[1] != 3:
+        raise ValueError(
+            f"mobile/reference must both be (N, 3), got {mobile.shape} "
+            f"vs {reference.shape}")
+    r = host.qcp_rotation(mobile, reference, weights)
+    diff = mobile @ r - reference
+    if weights is None:
+        rmsd = float(np.sqrt((diff ** 2).sum() / len(mobile)))
+    else:
+        w = np.asarray(weights, np.float64)
+        rmsd = float(np.sqrt((w @ (diff ** 2).sum(axis=1)) / w.sum()))
+    return r, rmsd
+
+
+def _fit_group(obj, select: str):
+    """Universe-or-AtomGroup → the selection to fit on, respecting group
+    membership (``select`` refines WITHIN a passed group, upstream
+    semantics)."""
+    from mdanalysis_mpi_tpu.core.groups import AtomGroup
+
+    if isinstance(obj, AtomGroup):
+        return obj if select == "all" else obj.select_atoms(select)
+    return obj.select_atoms(select)
+
+
+def alignto(mobile, reference, select: str = "all",
+            weights: str | None = "mass"):
+    """Superpose the mobile Universe/AtomGroup's CURRENT frame onto the
+    reference (upstream ``align.alignto``): fit on ``select`` (refined
+    within passed AtomGroups), apply the transform to ALL of the mobile
+    universe's atoms in place (the reference's per-frame body,
+    RMSF.py:99-101, as a one-shot).  Returns ``(old_rmsd, new_rmsd)``
+    over the selection.  ``reference`` is required — aligning a frame
+    onto itself is always a silent no-op."""
+    from mdanalysis_mpi_tpu.core.groups import AtomGroup
+
+    mob_u = mobile.universe if isinstance(mobile, AtomGroup) else mobile
+    ag = _fit_group(mobile, select)
+    ref_ag = _fit_group(reference, select)
+    if ag.n_atoms == 0:
+        raise ValueError(f"selection {select!r} matched no atoms")
+    if ref_ag.n_atoms != ag.n_atoms:
+        raise ValueError(
+            f"selection {select!r} sizes differ: mobile {ag.n_atoms} vs "
+            f"reference {ref_ag.n_atoms}")
+    if weights not in (None, "mass"):
+        raise ValueError(f"weights must be None or 'mass', got {weights!r}")
+    w = ag.masses if weights == "mass" else None
+    wv = w if w is not None else np.ones(ag.n_atoms)
+    ref_sel = ref_ag.positions.astype(np.float64)
+    ref_com = host.weighted_center(ref_sel, wv)
+    ref_c = ref_sel - ref_com
+    ts = mob_u.trajectory.ts
+    mob_sel = ts.positions[ag.indices].astype(np.float64)
+    mob_c = mob_sel - host.weighted_center(mob_sel, wv)
+
+    def _rmsd(x):
+        d2 = ((x - ref_c) ** 2).sum(axis=1)
+        return float(np.sqrt((wv @ d2) / wv.sum()))
+
+    old = _rmsd(mob_c)
+    _, new = rotation_matrix(mob_c, ref_c, w)
+    # the one superposition pipeline (COM, QCP rotation, apply-to-all —
+    # ops/host.superpose_frame, with its native fast path)
+    ts.positions = host.superpose_frame(
+        ts.positions, ag.indices, wv, ref_c, ref_com,
+        rot_weights=w).astype(np.float32)
+    return old, new
