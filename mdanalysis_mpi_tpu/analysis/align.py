@@ -273,9 +273,12 @@ def rotation_matrix(mobile: np.ndarray, reference: np.ndarray,
     The public form of the reference's ``get_rotation_matrix`` wrapper
     (RMSF.py:43-51, upstream ``align.rotation_matrix``): both inputs are
     (N, 3) coordinates, ALREADY CENTERED on their (weighted) origins as
-    upstream requires.  Returns ``(R, rmsd)`` with ``R`` the (3, 3)
-    matrix such that ``mobile @ R`` best fits ``reference``, and
-    ``rmsd`` the minimal (weighted) RMSD after rotation.
+    upstream requires.  Returns ``(R, rmsd)`` in the upstream
+    convention — ``R`` acts on column vectors (``x' = R·x``), so the
+    row-vector application is ``mobile @ R.T`` — and ``rmsd`` is the
+    minimal (weighted) RMSD after rotation.  Drop-in for the canonical
+    upstream recipe ``R, rmsd = rotation_matrix(mob0, ref0);
+    positions = positions @ R.T + ref_com``.
     """
     mobile = np.asarray(mobile, np.float64)
     reference = np.asarray(reference, np.float64)
@@ -291,7 +294,7 @@ def rotation_matrix(mobile: np.ndarray, reference: np.ndarray,
     else:
         w = np.asarray(weights, np.float64)
         rmsd = float(np.sqrt((w @ (diff ** 2).sum(axis=1)) / w.sum()))
-    return r, rmsd
+    return r.T, rmsd
 
 
 def _fit_group(obj, select: str):
@@ -306,14 +309,19 @@ def _fit_group(obj, select: str):
 
 
 def alignto(mobile, reference, select: str = "all",
-            weights: str | None = "mass"):
+            weights: str | None = None):
     """Superpose the mobile Universe/AtomGroup's CURRENT frame onto the
     reference (upstream ``align.alignto``): fit on ``select`` (refined
     within passed AtomGroups), apply the transform to ALL of the mobile
     universe's atoms in place (the reference's per-frame body,
     RMSF.py:99-101, as a one-shot).  Returns ``(old_rmsd, new_rmsd)``
     over the selection.  ``reference`` is required — aligning a frame
-    onto itself is always a silent no-op."""
+    onto itself is always a silent no-op.  ``weights=None`` (upstream
+    default): unweighted centering, fit, and RMSD; ``weights="mass"``
+    mass-weights all three.  (The trajectory-level classes
+    AlignTraj/AverageStructure keep the reference script's own
+    convention instead: mass COM + unweighted rotation, RMSF.py:84,48.)
+    """
     from mdanalysis_mpi_tpu.core.groups import AtomGroup
 
     mob_u = mobile.universe if isinstance(mobile, AtomGroup) else mobile

@@ -346,7 +346,8 @@ class TestAlignHelpers:
         rot = random_rotation_matrices(1, rng)[0]
         r, rmsd = rotation_matrix(x @ rot, x)
         assert rmsd < 1e-12
-        np.testing.assert_allclose((x @ rot) @ r, x, atol=1e-12)
+        # upstream convention: R acts on column vectors -> rows @ R.T
+        np.testing.assert_allclose((x @ rot) @ r.T, x, atol=1e-12)
 
     def test_rotation_matrix_weighted(self):
         from mdanalysis_mpi_tpu.analysis import rotation_matrix
@@ -356,7 +357,7 @@ class TestAlignHelpers:
         b = rng.normal(size=(20, 3)); b -= b.mean(axis=0)
         w = rng.uniform(0.5, 2.0, size=20)
         r, rmsd = rotation_matrix(a, b, weights=w)
-        d2 = (((a @ r) - b) ** 2).sum(axis=1)
+        d2 = (((a @ r.T) - b) ** 2).sum(axis=1)
         np.testing.assert_allclose(rmsd, np.sqrt((w @ d2) / w.sum()),
                                    rtol=1e-10)
 
@@ -399,9 +400,15 @@ class TestAlignHelpers:
         ref.trajectory[1]
         u.trajectory[0]
         # passing protein groups fits on protein only (select='all'
-        # refines within the groups, not over the whole universe)
-        old, new = alignto(u.select_atoms("protein"),
-                           ref.select_atoms("protein"))
+        # refines within the groups, not over the whole universe) —
+        # pinned by a reference universe that HAS no waters: a
+        # regression to whole-universe selection cannot match sizes
+        from mdanalysis_mpi_tpu.core.universe import Universe
+
+        prot = ref.select_atoms("protein")
+        ref_only = Universe(ref.topology.subset(prot.indices),
+                            prot.positions[None])
+        old, new = alignto(u.select_atoms("protein"), ref_only.atoms)
         assert new <= old
 
     def test_alignto_requires_reference(self):
