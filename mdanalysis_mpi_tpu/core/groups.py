@@ -144,6 +144,12 @@ class AtomGroup:
         """The residues these atoms belong to (upstream idiom)."""
         return ResidueGroup(self._universe, self.resindices)
 
+    @property
+    def segments(self) -> "SegmentGroup":
+        """Segments containing this group's atoms (upstream idiom)."""
+        return SegmentGroup(self._universe,
+                            self._universe.topology.segids[self._indices])
+
     def split(self, level: str = "residue") -> list["AtomGroup"]:
         """Split into per-residue or per-segment AtomGroups (upstream
         ``AtomGroup.split``), preserving this group's atom order within
@@ -334,3 +340,53 @@ class ResidueGroup:
         top = self._universe.topology
         mask = np.isin(top.resindices, self._resindices)
         return AtomGroup(self._universe, np.flatnonzero(mask))
+
+
+class SegmentGroup:
+    """Segment-level view (upstream's ``u.segments`` /
+    ``AtomGroup.segments``): unique segment ids in first-occurrence
+    order plus the way back down to atoms — completing the
+    Atom/Residue/Segment hierarchy of the upstream data model
+    (SURVEY.md §2.2 Universe row)."""
+
+    def __init__(self, universe, segids: np.ndarray):
+        self._universe = universe
+        wanted = set(np.asarray(segids, dtype=np.str_).tolist())
+        # normalize to TOPOLOGY first-occurrence order regardless of the
+        # group's atom order, mirroring ResidueGroup's normalization —
+        # so segids zip consistently with topology-ordered per-segment
+        # views (e.g. segs.atoms.split("segment"))
+        top_segids = universe.topology.segids
+        _, first = np.unique(top_segids, return_index=True)
+        order = top_segids[np.sort(first)]
+        self._segids = np.array([s for s in order if s in wanted],
+                                dtype=np.str_)
+
+    @property
+    def universe(self):
+        return self._universe
+
+    @property
+    def segids(self) -> np.ndarray:
+        return self._segids
+
+    @property
+    def n_segments(self) -> int:
+        return len(self._segids)
+
+    def __len__(self) -> int:
+        return self.n_segments
+
+    def __repr__(self):
+        return f"<SegmentGroup with {self.n_segments} segments>"
+
+    @property
+    def atoms(self) -> AtomGroup:
+        """All atoms of these segments, in topology order."""
+        top = self._universe.topology
+        mask = np.isin(top.segids, self._segids)
+        return AtomGroup(self._universe, np.flatnonzero(mask))
+
+    @property
+    def residues(self) -> ResidueGroup:
+        return self.atoms.residues

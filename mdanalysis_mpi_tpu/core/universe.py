@@ -71,6 +71,13 @@ class Universe:
 
         return ResidueGroup(self, self.topology.resindices)
 
+    @property
+    def segments(self):
+        """All segments (upstream's ``u.segments``)."""
+        from mdanalysis_mpi_tpu.core.groups import SegmentGroup
+
+        return SegmentGroup(self, self.topology.segids)
+
     def select_atoms(self, selection: str) -> AtomGroup:
         """Selection string → AtomGroup (RMSF.py:77 semantics).
 

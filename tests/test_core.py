@@ -307,3 +307,22 @@ class TestTopologySubsetAndWrite:
         np.testing.assert_allclose(u2.atoms.velocities,
                                    v[u.select_atoms("protein").indices],
                                    atol=2e-3)
+
+
+def test_segment_group():
+    """SegmentGroup completes the Atom/Residue/Segment hierarchy."""
+    from mdanalysis_mpi_tpu.testing import make_solvated_universe
+
+    u = make_solvated_universe(n_residues=3, n_waters=4, n_frames=1)
+    segs = u.segments
+    assert segs.n_segments == 2
+    assert list(segs.segids) == ["PROT", "WAT"]
+    assert segs.atoms.n_atoms == u.atoms.n_atoms
+    prot_segs = u.select_atoms("protein").segments
+    assert list(prot_segs.segids) == ["PROT"]
+    assert prot_segs.residues.n_residues == 3
+    # segment-level split already exists on AtomGroup; consistency:
+    assert len(u.atoms.split("segment")) == segs.n_segments
+    # topology-order normalization: a reversed group reports the same
+    # segid order as the topology (zips safely with split("segment"))
+    assert list(u.atoms[::-1].segments.segids) == ["PROT", "WAT"]
