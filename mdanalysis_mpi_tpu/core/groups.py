@@ -147,8 +147,7 @@ class AtomGroup:
     @property
     def segments(self) -> "SegmentGroup":
         """Segments containing this group's atoms (upstream idiom)."""
-        return SegmentGroup(self._universe,
-                            self._universe.topology.segids[self._indices])
+        return SegmentGroup(self._universe, self.segids)
 
     def split(self, level: str = "residue") -> list["AtomGroup"]:
         """Split into per-residue or per-segment AtomGroups (upstream

@@ -95,3 +95,70 @@ def contact_matrix(coords, cutoff: float = 15.0, box=None,
     """Boolean (N, N) contact map at ``cutoff`` (BASELINE config 5)."""
     a = np.asarray(coords, dtype=np.float64).reshape(-1, 3)
     return distance_array(a, a, box=box, backend=backend) < cutoff
+
+
+def capped_distance(reference, configuration, max_cutoff: float,
+                    min_cutoff: float | None = None, box=None,
+                    return_distances: bool = True, _self_upper=False):
+    """Pairs within ``max_cutoff`` (upstream ``lib.distances
+    .capped_distance``): returns ``(pairs, distances)`` — pairs is
+    (K, 2) int ``[i_reference, j_configuration]`` — or just ``pairs``
+    with ``return_distances=False``.
+
+    Blockwise over the reference axis so the full N×M matrix never
+    materializes (the same discipline as the device pair kernels,
+    SURVEY.md §5.7); minimum image under ``box`` when given.
+    ``_self_upper`` (internal, :func:`self_capped_distance`) keeps only
+    j > i pairs per block, before accumulation.
+    """
+    a = np.asarray(reference, dtype=np.float64).reshape(-1, 3)
+    b = np.asarray(configuration, dtype=np.float64).reshape(-1, 3)
+    if max_cutoff <= 0:
+        raise ValueError(f"max_cutoff must be positive, got {max_cutoff}")
+    if min_cutoff is not None and min_cutoff >= max_cutoff:
+        raise ValueError(
+            f"min_cutoff {min_cutoff} must be below max_cutoff {max_cutoff}")
+    dims = _dims_of(box)
+    from mdanalysis_mpi_tpu.ops import host
+
+    pairs_i, pairs_j, dists = [], [], []
+    # element budget sized for the REAL peak: disp (block·M·3 f64) plus
+    # minimum_image's copies of the same shape and d2 — ~9 arrays of
+    # block·M elements ≈ 160 MB at this setting
+    block = max(1, int(2.2e6) // max(1, len(b)))
+    c2 = float(max_cutoff) ** 2
+    m2 = None if min_cutoff is None else float(min_cutoff) ** 2
+    for lo in range(0, len(a), block):
+        chunk = a[lo:lo + block]
+        disp = chunk[:, None, :] - b[None, :, :]
+        disp = host.minimum_image(disp, dims)
+        d2 = np.einsum("abi,abi->ab", disp, disp)
+        hit = d2 <= c2
+        if m2 is not None:
+            hit &= d2 > m2
+        if _self_upper:
+            hit &= (np.arange(len(b))[None, :]
+                    > np.arange(lo, lo + len(chunk))[:, None])
+        ii, jj = np.nonzero(hit)
+        pairs_i.append(ii + lo)
+        pairs_j.append(jj)
+        if return_distances:
+            dists.append(np.sqrt(d2[ii, jj]))
+    pairs = np.stack([np.concatenate(pairs_i) if pairs_i else np.empty(0, np.int64),
+                      np.concatenate(pairs_j) if pairs_j else np.empty(0, np.int64)],
+                     axis=1)
+    if return_distances:
+        d = (np.concatenate(dists) if dists else np.empty(0))
+        return pairs, d
+    return pairs
+
+
+def self_capped_distance(reference, max_cutoff: float,
+                         min_cutoff: float | None = None, box=None,
+                         return_distances: bool = True):
+    """Unique i<j pairs within ``max_cutoff`` of each other (upstream
+    ``lib.distances.self_capped_distance``)."""
+    return capped_distance(reference, reference, max_cutoff,
+                           min_cutoff=min_cutoff, box=box,
+                           return_distances=return_distances,
+                           _self_upper=True)

@@ -288,3 +288,57 @@ def test_pairwise_distances():
     d0 = libdist.self_distance_array(
         u.trajectory[0].positions[ca.indices])
     np.testing.assert_allclose(r.results.distances[0], d0, atol=1e-4)
+
+
+class TestCappedDistance:
+    """lib.distances.capped_distance / self_capped_distance parity."""
+
+    def test_matches_distance_array(self):
+        from mdanalysis_mpi_tpu.lib.distances import (
+            capped_distance, distance_array)
+
+        rng = np.random.default_rng(0)
+        a = rng.uniform(0, 20, size=(40, 3))
+        b = rng.uniform(0, 20, size=(55, 3))
+        box = np.array([20.0, 20, 20, 90, 90, 90])
+        pairs, d = capped_distance(a, b, 5.0, box=box)
+        full = distance_array(a, b, box=box)
+        ref = np.argwhere(full <= 5.0)
+        # row-wise comparison (lexsorted) so i-j association is pinned
+        def rows(p):
+            return p[np.lexsort((p[:, 1], p[:, 0]))]
+        np.testing.assert_array_equal(rows(pairs), rows(ref))
+        np.testing.assert_allclose(d, full[pairs[:, 0], pairs[:, 1]])
+
+    def test_min_cutoff_and_no_distances(self):
+        from mdanalysis_mpi_tpu.lib.distances import capped_distance
+
+        rng = np.random.default_rng(1)
+        a = rng.uniform(0, 10, size=(30, 3))
+        pairs, d = capped_distance(a, a, 4.0, min_cutoff=1.0)
+        assert ((d > 1.0) & (d <= 4.0)).all()
+        only_pairs = capped_distance(a, a, 4.0, min_cutoff=1.0,
+                                     return_distances=False)
+        np.testing.assert_array_equal(only_pairs, pairs)
+
+    def test_self_capped_unique_pairs(self):
+        from mdanalysis_mpi_tpu.lib.distances import (
+            self_capped_distance, self_distance_array)
+
+        rng = np.random.default_rng(2)
+        a = rng.uniform(0, 12, size=(25, 3))
+        pairs, d = self_capped_distance(a, 6.0)
+        assert (pairs[:, 0] < pairs[:, 1]).all()
+        condensed = self_distance_array(a)
+        iu, ju = np.triu_indices(25, k=1)
+        expect = condensed[condensed <= 6.0]
+        np.testing.assert_allclose(np.sort(d), np.sort(expect))
+
+    def test_errors(self):
+        from mdanalysis_mpi_tpu.lib.distances import capped_distance
+
+        with pytest.raises(ValueError, match="positive"):
+            capped_distance(np.zeros((2, 3)), np.zeros((2, 3)), -1.0)
+        with pytest.raises(ValueError, match="below max_cutoff"):
+            capped_distance(np.zeros((2, 3)), np.zeros((2, 3)), 1.0,
+                            min_cutoff=2.0)
