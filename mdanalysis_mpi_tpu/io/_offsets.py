@@ -34,9 +34,25 @@ def save(path: str, offsets: np.ndarray, natoms: int,
          mtime: float) -> None:
     """``mtime`` must be captured BEFORE the scan: a trajectory appended
     to mid-scan then fails validation next open (rescan) instead of
-    serving a stale index forever."""
+    serving a stale index forever.
+
+    Atomic (tmp + rename): N processes opening the same trajectory at
+    once — the reference's N-independent-readers pattern (RMSF.py:56)
+    and every multi-controller run here — may all scan and save; a
+    concurrent reader must only ever see a complete index.  (A torn
+    read would merely trigger a rescan via ``load``'s guard, but
+    never-torn is cheaper than sometimes-rescan.)"""
+    cache = cache_path(path)
+    tmp = f"{cache}.tmp.{os.getpid()}"
     try:
-        np.savez(cache_path(path), offsets=offsets, natoms=natoms,
-                 mtime=mtime)
+        np.savez(tmp, offsets=offsets, natoms=natoms, mtime=mtime)
+        # np.savez appends .npz when the name lacks it
+        os.replace(tmp if tmp.endswith(".npz") else tmp + ".npz", cache)
     except OSError:
-        pass  # read-only directory: index just isn't cached
+        # read-only directory: index just isn't cached
+        for cand in (tmp, tmp + ".npz"):
+            if os.path.exists(cand):
+                try:
+                    os.remove(cand)
+                except OSError:
+                    pass
