@@ -234,6 +234,24 @@ class AtomGroup:
         return AtomGroup(self._universe,
                          self._indices[mask[self._indices]])
 
+    def wrap(self) -> np.ndarray:
+        """Wrap this group's atoms into the primary unit cell (upstream
+        ``AtomGroup.wrap(compound='atoms')``): positions map to
+        fractional coordinates in [0, 1) and back, in place on the
+        current Timestep.  Returns the wrapped positions.  Requires a
+        box on the current frame."""
+        ts = self._universe.trajectory.ts
+        if ts.dimensions is None or not np.any(ts.dimensions[:3] > 0):
+            raise ValueError("wrap() needs a periodic box on this frame")
+        from mdanalysis_mpi_tpu.core.box import box_to_vectors
+
+        m = box_to_vectors(ts.dimensions.astype(np.float64))
+        pos = ts.positions[self._indices].astype(np.float64)
+        frac = pos @ np.linalg.inv(m)
+        wrapped = ((frac - np.floor(frac)) @ m).astype(np.float32)
+        ts.positions[self._indices] = wrapped
+        return wrapped
+
     def write(self, path: str) -> None:
         """Write this group's current-frame coordinates (+ subset
         topology) to ``path`` — format chosen by extension (.gro, .pdb,

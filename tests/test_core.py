@@ -326,3 +326,32 @@ def test_segment_group():
     # topology-order normalization: a reversed group reports the same
     # segid order as the topology (zips safely with split("segment"))
     assert list(u.atoms[::-1].segments.segids) == ["PROT", "WAT"]
+
+
+def test_atomgroup_wrap():
+    """ag.wrap(): atoms map into the primary cell; distances to wrapped
+    images are preserved under minimum image."""
+    from mdanalysis_mpi_tpu.core.topology import Topology
+    from mdanalysis_mpi_tpu.core.universe import Universe
+    from mdanalysis_mpi_tpu.ops.host import minimum_image
+
+    top = Topology(names=np.array(["A", "B", "C"]),
+                   resnames=np.array(["R"] * 3), resids=np.array([1, 2, 3]))
+    pos = np.array([[25.0, -3.0, 7.0], [5.0, 5.0, 5.0],
+                    [-11.0, 42.0, 19.9]], np.float32)
+    dims = np.array([20, 20, 20, 90, 90, 90], np.float32)
+    u = Universe(top, pos[None])
+    u.trajectory[0].dimensions = dims
+    ts = u.trajectory.ts
+    before = ts.positions.copy()
+    wrapped = u.atoms.wrap()
+    assert (wrapped >= 0).all() and (wrapped < 20).all()
+    # wrap is a lattice translation: min-image displacement is zero
+    d = minimum_image((wrapped - before).astype(np.float64), dims.astype(np.float64))
+    assert np.abs(d).max() < 1e-3
+    # in place on the Timestep
+    np.testing.assert_array_equal(ts.positions, wrapped)
+    # boxless frame refuses
+    u2 = Universe(top, pos[None])
+    with pytest.raises(ValueError, match="periodic box"):
+        u2.atoms.wrap()
