@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Cookbook: every BASELINE config through the framework's API.
+
+Synthetic systems stand in for the reference's test data (RMSF.py:34);
+swap in ``Universe("topol.gro", "traj.xtc")`` for real files.  Each
+recipe runs on the accelerator backend and cross-checks the serial f64
+oracle — the reference's own "SAME AS" verification pattern
+(RMSF.py:1-18), executable.
+
+Run: python examples/analysis_cookbook.py  (add JAX_PLATFORMS=cpu to
+stay off the TPU; the compute is identical).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mdanalysis_mpi_tpu.utils.platform import honor_cpu_request
+
+honor_cpu_request()
+
+import numpy as np
+
+from mdanalysis_mpi_tpu.analysis import (
+    AlignedRMSF, ContactMap, InterRDF, RMSD, alignto,
+)
+from mdanalysis_mpi_tpu.lib.distances import self_capped_distance
+from mdanalysis_mpi_tpu.testing import (
+    make_protein_universe, make_water_universe,
+)
+
+
+def check(name, accel, serial, tol=1e-3):
+    err = float(np.abs(np.asarray(accel) - np.asarray(serial)).max())
+    status = "ok" if err <= tol else "DIVERGED"
+    print(f"  {name:34s} max|accel-serial| = {err:.2e}  {status}")
+    assert err <= tol
+
+
+def main():
+    # -- configs 1+2: aligned RMSF (the reference program end-to-end) --
+    u = make_protein_universe(n_residues=200, n_frames=64, noise=0.3)
+    a = AlignedRMSF(u, select="name CA").run(backend="jax", batch_size=16)
+    s = AlignedRMSF(u, select="name CA").run(backend="serial")
+    print("AlignedRMSF  (avg structure -> superpose -> Welford moments)")
+    check("rmsf", a.results.rmsf, s.results.rmsf)
+
+    # -- config 3: superposed RMSD time series --
+    ca = u.select_atoms("name CA")
+    r = RMSD(ca).run(backend="jax", batch_size=16)
+    rs = RMSD(ca).run(backend="serial")
+    print("RMSD         (per-frame, least-squares superposed)")
+    check("rmsd series", r.results.rmsd, rs.results.rmsd)
+
+    # -- config 4: O-O radial distribution for a water box --
+    w = make_water_universe(n_waters=500, n_frames=8)
+    ow = w.select_atoms("name OW")
+    g = InterRDF(ow, ow, nbins=50, range=(0.0, 10.0)).run(
+        backend="jax", batch_size=4)
+    gs = InterRDF(ow, ow, nbins=50, range=(0.0, 10.0)).run(backend="serial")
+    print("InterRDF     (tiled pair histogram, minimum image)")
+    check("g(r)", g.results.rdf, gs.results.rdf, tol=5e-2)
+
+    # -- config 5: contact map over Ca --
+    c = ContactMap(ca, cutoff=8.0).run(backend="jax", batch_size=16)
+    cs = ContactMap(ca, cutoff=8.0).run(backend="serial")
+    print("ContactMap   (blockwise pair distances, fraction of frames)")
+    check("contact fraction", c.results.contact_fraction,
+          cs.results.contact_fraction)
+
+    # -- one-shot helpers --
+    mob = u.copy()
+    mob.trajectory[0]
+    u.trajectory[32]
+    old, new = alignto(mob, u, select="name CA")
+    print(f"alignto      frame 0 -> frame 32: RMSD {old:.2f} -> {new:.2f} A")
+
+    pairs, d = self_capped_distance(ow.positions, 3.5, box=w.dimensions)
+    print(f"neighbors    {len(pairs)} O-O pairs within 3.5 A "
+          f"(capped_distance)")
+    print("all recipes agree with the serial oracle")
+
+
+if __name__ == "__main__":
+    main()
