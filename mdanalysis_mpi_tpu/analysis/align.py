@@ -205,12 +205,12 @@ class AlignTraj(AnalysisBase):
         self._select = select
         self._ref_frame = ref_frame
 
-    def run(self, start=None, stop=None, step=None, backend: str = "jax",
-            batch_size: int | None = 64, **kwargs):
+    def run(self, start=None, stop=None, step=None, frames=None,
+            backend: str = "jax", batch_size: int | None = 64, **kwargs):
         from mdanalysis_mpi_tpu.io.memory import MemoryReader
 
         u = self._universe
-        frames = list(self._frames(start, stop, step))
+        frames = list(self._frames(start, stop, step, frames))
         self.n_frames = len(frames)
         ag = u.select_atoms(self._select)
         if ag.n_atoms == 0:

@@ -31,6 +31,8 @@ Subclasses implement:
 
 from __future__ import annotations
 
+import numpy as np
+
 from mdanalysis_mpi_tpu.parallel.executors import get_executor
 
 
@@ -193,14 +195,38 @@ class AnalysisBase:
 
     # ---- driver ----
 
-    def _frames(self, start, stop, step):
+    def _frames(self, start, stop, step, frames=None):
         n = self._universe.trajectory.n_frames
+        if frames is not None:
+            if start is not None or stop is not None or step is not None:
+                raise ValueError(
+                    "pass either frames= or start/stop/step, not both")
+            idx = np.asarray(frames)
+            if idx.ndim != 1:
+                raise ValueError(f"frames must be 1-D, got shape {idx.shape}")
+            if idx.dtype == bool:
+                # upstream also accepts a length-n boolean mask
+                if len(idx) != n:
+                    raise ValueError(
+                        f"boolean frames mask has {len(idx)} entries for a "
+                        f"{n}-frame trajectory")
+                return np.flatnonzero(idx).tolist()
+            if not np.issubdtype(idx.dtype, np.integer):
+                raise TypeError(
+                    f"frames must be integer indices or a boolean mask, "
+                    f"got dtype {idx.dtype}")
+            if len(idx) and (int(idx.min()) < -n or int(idx.max()) >= n):
+                raise IndexError(
+                    f"frames out of range for {n}-frame trajectory")
+            return (idx.astype(np.int64) % n).tolist()
         return range(*slice(start, stop, step).indices(n))
 
-    def run(self, start=None, stop=None, step=None,
+    def run(self, start=None, stop=None, step=None, frames=None,
             backend: str = "serial", batch_size: int | None = None,
             **executor_kwargs):
-        """Iterate frames [start:stop:step] on the chosen backend.
+        """Iterate frames [start:stop:step] — or an explicit ``frames``
+        index list (upstream's ``run(frames=...)``) — on the chosen
+        backend.
 
         ``backend``: ``"serial"`` (NumPy oracle), ``"jax"``
         (single-device batched), ``"mesh"`` (sharded over all devices),
@@ -212,7 +238,7 @@ class AnalysisBase:
         from mdanalysis_mpi_tpu.utils.timers import TIMERS
 
         t0 = time.perf_counter()
-        frames = self._frames(start, stop, step)
+        frames = self._frames(start, stop, step, frames)
         self.n_frames = len(frames)
         executor = get_executor(backend, **executor_kwargs)
         with TIMERS.phase("prepare"):

@@ -237,8 +237,9 @@ class AlignedRMSF(AnalysisBase):
         self._select = select
         self._ref_frame = ref_frame
 
-    def run(self, start=None, stop=None, step=None, backend: str = "serial",
-            batch_size: int | None = None, **kwargs):
+    def run(self, start=None, stop=None, step=None, frames=None,
+            backend: str = "serial", batch_size: int | None = None,
+            **kwargs):
         # Both passes iterate the same frames with the same selection, so
         # share one HBM block cache: pass 2 reads device-resident blocks
         # instead of re-staging (the reference re-decodes every frame in
@@ -260,8 +261,8 @@ class AlignedRMSF(AnalysisBase):
         avg = AverageStructure(
             self._universe, select=self._select, ref_frame=self._ref_frame,
             select_only=True, verbose=self._verbose,
-        ).run(start, stop, step, backend=backend, batch_size=batch_size,
-              **kwargs)
+        ).run(start, stop, step, frames=frames, backend=backend,
+              batch_size=batch_size, **kwargs)
         # raw dict access: keep the average device-resident between
         # passes (attribute access would fetch it to host)
         self._avg_sel = avg.results["positions"]        # (S, 3)
@@ -269,7 +270,7 @@ class AlignedRMSF(AnalysisBase):
         # Pass 2 (RMSF.py:115-143): moments of coords aligned to the average.
         moments_pass = _MomentsToReference(
             self._universe, self._select, self._avg_sel, self._verbose)
-        moments_pass.run(start, stop, step, backend=backend,
+        moments_pass.run(start, stop, step, frames=frames, backend=backend,
                          batch_size=batch_size, **kwargs)
         t, mean, m2 = moments_pass._total
         self._last_total = moments_pass._total    # fetch-free sync point

@@ -92,7 +92,7 @@ def _load(path: str, structure, fingerprint: str):
 
 
 def run_checkpointed(analysis, path: str, chunk_frames: int = 4096,
-                     start=None, stop=None, step=None,
+                     start=None, stop=None, step=None, frames=None,
                      backend: str = "jax", batch_size: int | None = None,
                      **executor_kwargs):
     """``analysis.run(...)`` with durable progress in ``path``.
@@ -122,7 +122,7 @@ def run_checkpointed(analysis, path: str, chunk_frames: int = 4096,
             "per-call partials — backend='jax' or 'mesh' (serial/mpi "
             "backends accumulate inside the analysis)")
 
-    frames = list(analysis._frames(start, stop, step))
+    frames = list(analysis._frames(start, stop, step, frames))
     analysis.n_frames = len(frames)
     analysis._prepare()
     fp = _fingerprint(analysis, frames)

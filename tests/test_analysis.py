@@ -418,3 +418,60 @@ class TestAlignHelpers:
         u = make_protein_universe(n_residues=4, n_frames=2)
         with pytest.raises(TypeError):
             alignto(u)
+
+
+class TestExplicitFramesAPI:
+    """run(frames=[...]) — upstream's explicit frame-list form."""
+
+    def test_frames_list_matches_slice(self):
+        from mdanalysis_mpi_tpu.testing import make_protein_universe
+
+        u = make_protein_universe(n_residues=10, n_frames=20, noise=0.3)
+        ag = u.select_atoms("name CA")
+        a = RMSF(ag).run(frames=[2, 5, 8, 11, 14], backend="serial")
+        b = RMSF(ag).run(start=2, stop=15, step=3, backend="serial")
+        np.testing.assert_allclose(a.results.rmsf, b.results.rmsf)
+        # non-uniform list on the device path (per-frame staging branch)
+        c = RMSF(ag).run(frames=[0, 1, 7, 19], backend="jax", batch_size=3)
+        s = RMSF(ag).run(frames=[0, 1, 7, 19], backend="serial")
+        np.testing.assert_allclose(c.results.rmsf, s.results.rmsf,
+                                   atol=2e-4)
+        # negative indices wrap (numpy convention)
+        d = RMSF(ag).run(frames=[-1, -2], backend="serial")
+        e = RMSF(ag).run(frames=[19, 18], backend="serial")
+        np.testing.assert_allclose(d.results.rmsf, e.results.rmsf)
+        # boolean mask form (upstream-compatible)
+        mask = np.zeros(20, dtype=bool)
+        mask[[2, 5, 8, 11, 14]] = True
+        f = RMSF(ag).run(frames=mask, backend="serial")
+        g = RMSF(ag).run(frames=[2, 5, 8, 11, 14], backend="serial")
+        np.testing.assert_allclose(f.results.rmsf, g.results.rmsf)
+
+    def test_frames_validation(self):
+        from mdanalysis_mpi_tpu.testing import make_protein_universe
+
+        u = make_protein_universe(n_residues=4, n_frames=6)
+        ag = u.select_atoms("name CA")
+        with pytest.raises(ValueError, match="not both"):
+            RMSF(ag).run(frames=[0, 1], stop=3)
+        with pytest.raises(IndexError, match="out of range"):
+            RMSF(ag).run(frames=[99])
+        with pytest.raises(ValueError, match="boolean frames mask"):
+            RMSF(ag).run(frames=np.ones(3, dtype=bool))
+        with pytest.raises(TypeError, match="integer indices"):
+            RMSF(ag).run(frames=[1.5, 2.5])
+
+    def test_frames_through_aligned_rmsf_and_aligntraj(self):
+        from mdanalysis_mpi_tpu.testing import make_protein_universe
+        from mdanalysis_mpi_tpu.analysis import AlignedRMSF, AlignTraj
+
+        u = make_protein_universe(n_residues=8, n_frames=12, noise=0.3)
+        a = AlignedRMSF(u, select="name CA").run(frames=[1, 3, 5, 7],
+                                                 backend="serial")
+        b = AlignedRMSF(u, select="name CA").run(start=1, stop=8, step=2,
+                                                 backend="serial")
+        np.testing.assert_allclose(a.results.rmsf, b.results.rmsf)
+        u2 = u.copy()
+        AlignTraj(u2, u, select="name CA").run(frames=[0, 2, 4],
+                                               backend="serial")
+        assert u2.trajectory.n_frames == 3
