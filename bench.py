@@ -201,13 +201,13 @@ def _accelerator_or_die(timeout_s: float | None = None) -> int:
     tunnel to the TPU pool is down (observed: hours), which would leave
     the driver with NO artifact at all.  Run the import + device query
     on a daemon thread; if it does not come up within
-    BENCH_TPU_TIMEOUT seconds (default 900 — first contact on a healthy
+    BENCH_TPU_TIMEOUT seconds (default 600 — first contact on a healthy
     tunnel takes ~1-2 min), emit a parseable JSON error line and exit
     nonzero instead of hanging.  Returns the device count."""
     import threading
 
     timeout_s = timeout_s if timeout_s is not None else float(
-        os.environ.get("BENCH_TPU_TIMEOUT", "900"))
+        os.environ.get("BENCH_TPU_TIMEOUT", "600"))
     box: dict = {}
 
     def probe():
