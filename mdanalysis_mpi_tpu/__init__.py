@@ -41,6 +41,11 @@ __all__ = ["Universe", "AtomGroup", "Topology", "analysis", "__version__"]
 def __getattr__(name):
     # lazy: importing the analysis/ops layers pulls in JAX, which core
     # users (topology-only tooling) should not pay for
+    if name == "Writer":
+        # upstream `mda.Writer(filename, n_atoms)` factory
+        from mdanalysis_mpi_tpu.io.writer import Writer
+
+        return Writer
     if name in ("analysis", "ops", "parallel", "io", "utils"):
         import importlib
         try:

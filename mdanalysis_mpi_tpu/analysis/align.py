@@ -181,12 +181,19 @@ class AverageStructure(AnalysisBase):
 
 
 class AlignTraj(AnalysisBase):
-    """Align a whole trajectory to a reference frame, in memory.
+    """Align a whole trajectory to a reference frame.
 
     Serial-oracle API: ``AlignTraj(u, ref, select=..., in_memory=True)
-    .run()`` (RMSF.py:12).  The mobile Universe's trajectory is replaced
-    by an aligned in-memory copy; per-frame old RMSD values are not
-    tracked (use :class:`~mdanalysis_mpi_tpu.analysis.rms.RMSD`).
+    .run()`` (RMSF.py:12).  With ``in_memory=True`` the mobile
+    Universe's trajectory is replaced by an aligned in-memory copy; with
+    ``in_memory=False`` (the upstream default workflow) the aligned
+    frames are **streamed to ``filename``** in batches through
+    :class:`~mdanalysis_mpi_tpu.io.writer.TrajectoryWriter` — never more
+    than one batch on the host — and ``.results.universe`` opens the
+    written file (``filename`` defaults to ``prefix`` + the mobile
+    trajectory's basename, upstream convention).  Per-frame old RMSD
+    values are not tracked (use
+    :class:`~mdanalysis_mpi_tpu.analysis.rms.RMSD`).
 
     This is a *map* (frame→frame), not a reduction, so it drives the
     batch kernel directly rather than through the map-reduce executors;
@@ -196,14 +203,25 @@ class AlignTraj(AnalysisBase):
 
     def __init__(self, mobile: Universe, reference: Universe | None = None,
                  select: str = "all", ref_frame: int = 0,
-                 in_memory: bool = True, verbose: bool = False):
+                 in_memory: bool = True, filename: str | None = None,
+                 prefix: str = "rmsfit_", verbose: bool = False):
         super().__init__(mobile, verbose)
-        if not in_memory:
-            raise NotImplementedError(
-                "AlignTraj currently supports in_memory=True only")
         self._reference = reference if reference is not None else mobile
         self._select = select
         self._ref_frame = ref_frame
+        self._in_memory = in_memory
+        if not in_memory and filename is None:
+            src = getattr(mobile.trajectory, "filename", None)
+            if src is None:
+                raise ValueError(
+                    "in_memory=False needs filename= (the mobile "
+                    "trajectory is not file-backed, so there is no name "
+                    f"to derive from {prefix!r})")
+            import os
+
+            head, tail = os.path.split(src)
+            filename = os.path.join(head, prefix + tail)
+        self.filename = filename
 
     def run(self, start=None, stop=None, step=None, frames=None,
             backend: str = "jax", batch_size: int | None = 64, **kwargs):
@@ -220,49 +238,133 @@ class AlignTraj(AnalysisBase):
         ref_sel_c, ref_com = _reference_sel_coords(
             self._reference, sel_idx, weights, self._ref_frame)
         n = u.topology.n_atoms
-        out = np.empty((len(frames), n, 3), dtype=np.float32)
+        writer = None
+        if self._in_memory:
+            out = np.empty((len(frames), n, 3), dtype=np.float32)
+        else:
+            if not frames:
+                raise ValueError(
+                    "AlignTraj(in_memory=False) selected zero frames — "
+                    "nothing to write")
+            import os
+
+            src = getattr(u.trajectory, "filename", None)
+            if src is not None and os.path.abspath(self.filename) \
+                    == os.path.abspath(src):
+                raise ValueError(
+                    f"output filename {self.filename!r} is the source "
+                    "trajectory itself — opening it for writing would "
+                    "destroy the input")
+            from mdanalysis_mpi_tpu.io.writer import TrajectoryWriter
+
+            writer = TrajectoryWriter(self.filename, n_atoms=n)
         dims = np.zeros((len(frames), 6), dtype=np.float32)
         have_dims = False
 
-        if backend == "serial":
-            for j, i in enumerate(frames):
-                ts = u.trajectory[i]
-                if ts.dimensions is not None:
-                    dims[j] = ts.dimensions
-                    have_dims = True
-                out[j] = host.superpose_frame(
-                    ts.positions, sel_idx, weights, ref_sel_c, ref_com)
-        else:
-            import jax
-            import jax.numpy as jnp
-
-            from mdanalysis_mpi_tpu.ops.align import superpose_batch
-
-            bs = batch_size or 64
-            idx_d = jnp.asarray(sel_idx)
-            w_d = jnp.asarray(weights, jnp.float32)
-            refc_d = jnp.asarray(ref_sel_c, jnp.float32)
-            com_d = jnp.asarray(ref_com, jnp.float32)
-            fn = jax.jit(_f32_precision(
-                lambda b: superpose_batch(b, idx_d, w_d, refc_d, com_d)))
-            for a, b in iter_batches(0, len(frames), bs):
-                chunk = frames[a:b]
-                if chunk[-1] - chunk[0] + 1 == len(chunk):
-                    block, boxes = u.trajectory.read_block(chunk[0], chunk[-1] + 1)
-                else:
-                    tss = [u.trajectory[i] for i in chunk]
-                    block = np.stack([ts.positions for ts in tss])
-                    boxes = (np.stack([ts.dimensions for ts in tss])
-                             if tss[0].dimensions is not None else None)
+        def emit(a, b, aligned, boxes):
+            # writer times/steps keep the writer's running 0..n-1 default
+            # so file-backed output matches the in-memory MemoryReader's
+            # frame numbering (the two modes must be interchangeable)
+            nonlocal have_dims
+            if writer is None:
                 if boxes is not None:
                     dims[a:b] = boxes
                     have_dims = True
-                padded, mask = pad_batch(block, bs)
-                aligned = np.asarray(fn(jnp.asarray(padded)))
-                out[a:b] = aligned[: b - a]
+                out[a:b] = aligned
+            else:
+                writer.write(aligned, dimensions=boxes)
 
-        u.trajectory = MemoryReader(out, dimensions=dims if have_dims else None)
-        self.results.universe = u
+        try:
+            if backend == "serial":
+                if writer is None:
+                    for j, i in enumerate(frames):
+                        ts = u.trajectory[i]
+                        if ts.dimensions is not None:
+                            dims[j] = ts.dimensions
+                            have_dims = True
+                        out[j] = host.superpose_frame(
+                            ts.positions, sel_idx, weights, ref_sel_c,
+                            ref_com)
+                else:
+                    # buffer per-frame output so the file path writes
+                    # chunk-at-a-time (one temp-file splice per flush,
+                    # not per frame)
+                    flush = min(256, len(frames))
+                    buf = np.empty((flush, n, 3), np.float32)
+                    bboxes = np.zeros((flush, 6), np.float32)
+                    any_box = False
+                    lo = 0
+                    for j, i in enumerate(frames):
+                        ts = u.trajectory[i]
+                        if ts.dimensions is not None:
+                            bboxes[j - lo] = ts.dimensions
+                            any_box = True
+                        buf[j - lo] = host.superpose_frame(
+                            ts.positions, sel_idx, weights, ref_sel_c,
+                            ref_com)
+                        if j - lo + 1 == flush or j == len(frames) - 1:
+                            emit(lo, j + 1, buf[: j - lo + 1],
+                                 bboxes[: j - lo + 1] if any_box else None)
+                            lo = j + 1
+                            any_box = False
+                            bboxes[:] = 0   # no stale boxes next window
+            else:
+                import jax
+                import jax.numpy as jnp
+
+                from mdanalysis_mpi_tpu.ops.align import superpose_batch
+
+                bs = batch_size or 64
+                idx_d = jnp.asarray(sel_idx)
+                w_d = jnp.asarray(weights, jnp.float32)
+                refc_d = jnp.asarray(ref_sel_c, jnp.float32)
+                com_d = jnp.asarray(ref_com, jnp.float32)
+                fn = jax.jit(_f32_precision(
+                    lambda b: superpose_batch(b, idx_d, w_d, refc_d, com_d)))
+                for a, b in iter_batches(0, len(frames), bs):
+                    chunk = frames[a:b]
+                    if chunk[-1] - chunk[0] + 1 == len(chunk):
+                        block, boxes = u.trajectory.read_block(
+                            chunk[0], chunk[-1] + 1)
+                    else:
+                        tss = [u.trajectory[i] for i in chunk]
+                        block = np.stack([ts.positions for ts in tss])
+                        if any(ts.dimensions is not None for ts in tss):
+                            # frames without a box contribute zeros (the
+                            # same value the readers map to dims=None)
+                            boxes = np.stack([
+                                ts.dimensions if ts.dimensions is not None
+                                else np.zeros(6, np.float32) for ts in tss])
+                        else:
+                            boxes = None
+                    padded, mask = pad_batch(block, bs)
+                    aligned = np.asarray(fn(jnp.asarray(padded)))
+                    emit(a, b, aligned[: b - a], boxes)
+        except BaseException:
+            if writer is not None:
+                # never leave a truncated-but-self-consistent file behind:
+                # close() patches the DCD frame count, which would make a
+                # partial alignment indistinguishable from a complete one
+                writer.close()
+                import os
+
+                if os.path.exists(self.filename):
+                    os.remove(self.filename)
+            raise
+        else:
+            if writer is not None:
+                writer.close()
+
+        if self._in_memory:
+            u.trajectory = MemoryReader(
+                out, dimensions=dims if have_dims else None)
+            self.results.universe = u
+        else:
+            from mdanalysis_mpi_tpu.io import trajectory_files
+
+            self.results.filename = self.filename
+            self.results.universe = Universe(
+                u.topology, trajectory_files.open(self.filename))
         return self
 
 

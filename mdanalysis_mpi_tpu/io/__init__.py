@@ -14,5 +14,6 @@ Readers expose the interface the analysis layer depends on:
 
 from mdanalysis_mpi_tpu.io.memory import MemoryReader
 from mdanalysis_mpi_tpu.io.base import ReaderBase
+from mdanalysis_mpi_tpu.io.writer import TrajectoryWriter, Writer
 
-__all__ = ["MemoryReader", "ReaderBase"]
+__all__ = ["MemoryReader", "ReaderBase", "TrajectoryWriter", "Writer"]

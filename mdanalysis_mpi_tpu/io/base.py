@@ -107,6 +107,13 @@ class ReaderBase:
     def _read_frame(self, i: int) -> Timestep:
         raise NotImplementedError
 
+    @property
+    def filename(self) -> str | None:
+        """Backing file path, or None for non-file readers (the public
+        contract consumers like AlignTraj's default-output naming use;
+        file-backed subclasses store it as ``_path``)."""
+        return getattr(self, "_path", None)
+
     # ---- shared behavior ----
 
     @property
