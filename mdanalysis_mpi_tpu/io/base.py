@@ -107,6 +107,20 @@ class ReaderBase:
     def _read_frame(self, i: int) -> Timestep:
         raise NotImplementedError
 
+    # Adaptive int16 staging-scale policy — ONE copy of the numbers
+    # (io/xtc.py's fused path and _quantize_staged below must quantize
+    # with bit-identical scales): target 32000 of the int16 range,
+    # ×1.05 drift margin on the previous max, float64 scale arithmetic.
+    QUANT_TARGET = 32000.0
+    QUANT_MARGIN = 1.05
+
+    def _quant_hints(self) -> dict:
+        """Per-selection max-|coordinate| hints for the adaptive
+        one-pass int16 quantizers (scoped per selection content so one
+        wide-coordinate selection cannot coarsen another's
+        resolution)."""
+        return self.__dict__.setdefault("_quant_max_hints", {})
+
     @property
     def filename(self) -> str | None:
         """Backing file path, or None for non-file readers (the public
@@ -224,11 +238,11 @@ class ReaderBase:
         try:
             from mdanalysis_mpi_tpu.io import native
 
-            hints = self.__dict__.setdefault("_quant_max_hints", {})
+            hints = self._quant_hints()
             key = sel_fp if sel_fp is not None else sel_fingerprint(sel)
             hint = hints.get(key, 0.0)
             if hint > 0.0:
-                scale = 32000.0 / (hint * 1.05)
+                scale = self.QUANT_TARGET / (hint * self.QUANT_MARGIN)
                 q, vmax, overflowed = native.stage_gather_quantize_scaled(
                     src, sel, scale)
                 if vmax > hint:
@@ -238,7 +252,7 @@ class ReaderBase:
             q, inv_scale = native.stage_gather_quantize(src, sel)
             # the exact kernel's scale encodes the block max: seed the hint
             hints[key] = max(hints.get(key, 0.0),
-                             float(inv_scale) * 32000.0)
+                             float(inv_scale) * self.QUANT_TARGET)
             return q, inv_scale
         except Exception:
             from mdanalysis_mpi_tpu.parallel.executors import quantize_block

@@ -62,6 +62,17 @@ def load() -> ctypes.CDLL:
             ctypes.c_char_p, _i64p, ctypes.c_long, ctypes.c_int,
             _f32p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
 
+        lib.xtc_stage_i16.restype = ctypes.c_int
+        lib.xtc_stage_i16.argtypes = [
+            ctypes.c_char_p, _i64p, ctypes.c_long, ctypes.c_int,
+            ctypes.c_void_p, ctypes.c_long, ctypes.c_float, _i16p,
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_float)]
+
+        lib.xtc_stage_f32.restype = ctypes.c_int
+        lib.xtc_stage_f32.argtypes = [
+            ctypes.c_char_p, _i64p, ctypes.c_long, ctypes.c_int,
+            ctypes.c_void_p, ctypes.c_long, _f32p, ctypes.c_void_p]
+
         lib.xtc_write.restype = ctypes.c_int
         lib.xtc_write.argtypes = [
             ctypes.c_char_p, ctypes.c_int, ctypes.c_long, _f32p,
@@ -166,6 +177,66 @@ def stage_gather_quantize_scaled(src: np.ndarray, sel, scale: float):
     if rc < 0:
         raise RuntimeError(f"stage_gather_quantize_i16_scaled failed (rc={rc})")
     return out, float(vmax.value), rc == 1
+
+
+def xtc_stage_i16(path: str, offsets: np.ndarray, natoms: int, sel,
+                  scale: float, want_box: bool = True):
+    """Fused XTC decode → selection gather → nm→Å → int16 quantize.
+
+    Never materializes the full-system float32 block (each frame decodes
+    into a cache-hot per-worker scratch; only the selection's int16
+    bytes reach DRAM) — the cold-path staging kernel.  Returns
+    ``(q (F, S, 3) int16, box (F, 9) nm float32 | None, max_abs_Å,
+    overflowed)``; on ``overflowed`` the caller re-runs with an exact
+    scale from ``max_abs_Å`` (same contract as
+    :func:`stage_gather_quantize_scaled`).
+    """
+    lib = load()
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    n = len(offsets)
+    if sel is None:
+        s = natoms
+        idx_p = None
+    else:
+        idx = np.ascontiguousarray(sel, dtype=np.int32)
+        s = len(idx)
+        idx_p = idx.ctypes.data_as(ctypes.c_void_p)
+    out = np.empty((n, s, 3), dtype=np.int16)
+    box = np.empty((n, 9), dtype=np.float32) if want_box else None
+    vmax = ctypes.c_float(0.0)
+    rc = lib.xtc_stage_i16(
+        path.encode(), offsets, n, natoms, idx_p, s,
+        ctypes.c_float(scale), out,
+        box.ctypes.data_as(ctypes.c_void_p) if want_box else None,
+        ctypes.byref(vmax))
+    if rc < 0:
+        raise IOError(f"xtc_stage_i16 failed for {path!r} (rc={rc})")
+    return out, box, float(vmax.value), rc == 1
+
+
+def xtc_stage_f32(path: str, offsets: np.ndarray, natoms: int, sel,
+                  want_box: bool = True):
+    """Fused XTC decode → selection gather → nm→Å (float32 staging
+    path); see :func:`xtc_stage_i16`.  Returns ``(coords (F, S, 3) Å,
+    box (F, 9) nm | None)``."""
+    lib = load()
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    n = len(offsets)
+    if sel is None:
+        s = natoms
+        idx_p = None
+    else:
+        idx = np.ascontiguousarray(sel, dtype=np.int32)
+        s = len(idx)
+        idx_p = idx.ctypes.data_as(ctypes.c_void_p)
+    out = np.empty((n, s, 3), dtype=np.float32)
+    box = np.empty((n, 9), dtype=np.float32) if want_box else None
+    rc = lib.xtc_stage_f32(
+        path.encode(), offsets, n, natoms, idx_p, s, out,
+        box.ctypes.data_as(ctypes.c_void_p) if want_box else None)
+    if rc != 0:
+        raise IOError(f"xtc_stage_f32 failed for {path!r} (rc={rc})")
+    return out, box
 
 
 def stage_gather(src: np.ndarray, sel=None) -> np.ndarray:

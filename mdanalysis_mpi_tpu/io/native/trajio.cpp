@@ -591,23 +591,28 @@ static int xtc_read_range(const char* path, const long* offsets,
 // multi-core hosts — the v5e-8 target — while a single-core host keeps
 // the sequential path with zero thread overhead).  Correctness is
 // thread-count-independent: workers write disjoint frame ranges.
-int xtc_read_frames(const char* path, const long* offsets, long n,
-                    int natoms, float* coords, float* box, float* times,
-                    int* steps) {
+// Worker-count policy shared by the decode/stage entry points: clamp
+// near real parallelism — more workers than cores cannot help (the
+// decode is compute-bound) and unbounded counts would risk std::thread
+// construction failure, which must not unwind across this C ABI.  The
+// small floor keeps the threaded path testable on 1-core hosts.
+static long xtc_nthreads(long n) {
     long nthreads = 1;
     if (const char* env = getenv("MDTPU_DECODE_THREADS")) {
         nthreads = atol(env);
         if (nthreads < 1) nthreads = 1;
-        // clamp near real parallelism: more workers than cores cannot
-        // help (the decode is compute-bound) and unbounded counts
-        // would risk std::thread construction failure, which must not
-        // unwind across this C ABI.  The small floor keeps the
-        // threaded path testable on 1-core hosts.
         long hw = (long)std::thread::hardware_concurrency();
         long cap = hw >= 4 ? hw : 4;
         if (nthreads > cap) nthreads = cap;
     }
     if (nthreads > n) nthreads = n > 0 ? n : 1;
+    return nthreads;
+}
+
+int xtc_read_frames(const char* path, const long* offsets, long n,
+                    int natoms, float* coords, float* box, float* times,
+                    int* steps) {
+    long nthreads = xtc_nthreads(n);
     if (nthreads == 1)
         return xtc_read_range(path, offsets, 0, n, natoms, coords, box,
                               times, steps);
@@ -619,6 +624,162 @@ int xtc_read_frames(const char* path, const long* offsets, long n,
         workers.emplace_back([=, &rcs]() {
             rcs[(size_t)t] = xtc_read_range(path, offsets, lo, hi, natoms,
                                             coords, box, times, steps);
+        });
+        lo = hi;
+    }
+    for (auto& w : workers) w.join();
+    for (int rc : rcs)
+        if (rc != 0) return rc;
+    return 0;
+}
+
+// ---------------------------------------------------------------------
+// Fused cold-path staging: decode → gather → unit-convert (→ quantize)
+// without ever materializing the full-system float32 block.  The plain
+// cold path writes natoms*3 floats per frame into a (B, N, 3) block,
+// re-reads it to gather the selection, and (int16 path) streams it once
+// more to quantize — at 100k atoms / 50k selected that is ~3.6 MB of
+// DRAM traffic per frame on top of the decode itself.  Here each frame
+// decodes into a per-worker scratch that stays cache-hot and only the
+// selection's int16/f32 bytes ever reach DRAM.  Same thread model as
+// xtc_read_frames (disjoint frame ranges, MDTPU_DECODE_THREADS).
+// ---------------------------------------------------------------------
+
+// One worker's range.  sel may be null (all atoms).  out is int16
+// (n_sel*3 per frame, quantized Å at `scale`); box (9 per frame, nm) may
+// be null.  Tracks the worker's max |x| in Å; clamps on overflow (the
+// caller rejects via the vmax*scale criterion, like
+// stage_gather_quantize_i16_scaled).
+static int xtc_stage_range_i16(const char* path, const long* offsets,
+                               long lo, long hi, int natoms,
+                               const int32_t* sel, long n_sel, float scale,
+                               int16_t* out, float* box, float* vmax_out) {
+    FILE* f = fopen(path, "rb");
+    if (!f) return -1;
+    Reader r{f};
+    std::vector<float> scratch((size_t)natoms * 3);
+    float vmax = 0.0f;
+    for (long i = lo; i < hi; i++) {
+        if (fseek(f, offsets[i], SEEK_SET) != 0) { fclose(f); return -2; }
+        XtcHeader h;
+        if (xtc_read_header(r, h) != 0) { fclose(f); return -3; }
+        if (h.natoms != natoms) { fclose(f); return -4; }
+        int lsize = r.i32();
+        if (!r.ok || lsize != natoms) { fclose(f); return -5; }
+        int rc = xtc_decode_coords(r, lsize, scratch.data());
+        if (rc != 0) { fclose(f); return rc; }
+        int16_t* o = out + (size_t)i * n_sel * 3;
+        for (long s = 0; s < n_sel; s++) {
+            const float* p = scratch.data()
+                + (size_t)(sel ? sel[s] : s) * 3;
+            for (int d = 0; d < 3; d++) {
+                // nm → Å as a float32 multiply, then the same f32
+                // product + round-half-even as the block quantizers
+                // (bit-compatible with the decode-then-quantize path)
+                float x = p[d] * 10.0f;
+                float a = std::fabs(x);
+                if (a > vmax) vmax = a;
+                float q = std::nearbyintf(x * scale);
+                if (q > 32767.0f) q = 32767.0f;
+                if (q < -32767.0f) q = -32767.0f;
+                o[s * 3 + d] = (int16_t)q;
+            }
+        }
+        if (box) std::memcpy(box + i * 9, h.box, 9 * sizeof(float));
+    }
+    fclose(f);
+    *vmax_out = vmax;
+    return 0;
+}
+
+// float32 variant: decode → gather → nm→Å, selection bytes only.
+static int xtc_stage_range_f32(const char* path, const long* offsets,
+                               long lo, long hi, int natoms,
+                               const int32_t* sel, long n_sel,
+                               float* out, float* box) {
+    FILE* f = fopen(path, "rb");
+    if (!f) return -1;
+    Reader r{f};
+    std::vector<float> scratch((size_t)natoms * 3);
+    for (long i = lo; i < hi; i++) {
+        if (fseek(f, offsets[i], SEEK_SET) != 0) { fclose(f); return -2; }
+        XtcHeader h;
+        if (xtc_read_header(r, h) != 0) { fclose(f); return -3; }
+        if (h.natoms != natoms) { fclose(f); return -4; }
+        int lsize = r.i32();
+        if (!r.ok || lsize != natoms) { fclose(f); return -5; }
+        int rc = xtc_decode_coords(r, lsize, scratch.data());
+        if (rc != 0) { fclose(f); return rc; }
+        float* o = out + (size_t)i * n_sel * 3;
+        for (long s = 0; s < n_sel; s++) {
+            const float* p = scratch.data()
+                + (size_t)(sel ? sel[s] : s) * 3;
+            o[s * 3 + 0] = p[0] * 10.0f;
+            o[s * 3 + 1] = p[1] * 10.0f;
+            o[s * 3 + 2] = p[2] * 10.0f;
+        }
+        if (box) std::memcpy(box + i * 9, h.box, 9 * sizeof(float));
+    }
+    fclose(f);
+    return 0;
+}
+
+// Fused decode+stage, int16: returns 0 ok, 1 = the provided scale would
+// have clipped real data (max_abs_out holds the true max in Å; caller
+// re-runs with an exact scale), negative = decode error.
+int xtc_stage_i16(const char* path, const long* offsets, long n,
+                  int natoms, const int32_t* sel, long n_sel, float scale,
+                  int16_t* out, float* box, float* max_abs_out) {
+    if (n < 0 || natoms < 0 || n_sel < 0 || !(scale > 0.0f)) return -9;
+    if (sel == nullptr) n_sel = natoms;
+    long nthreads = xtc_nthreads(n);
+    float vmax = 0.0f;
+    if (nthreads == 1) {
+        int rc = xtc_stage_range_i16(path, offsets, 0, n, natoms, sel,
+                                     n_sel, scale, out, box, &vmax);
+        if (rc != 0) return rc;
+    } else {
+        std::vector<std::thread> workers;
+        std::vector<int> rcs((size_t)nthreads, 0);
+        std::vector<float> vmaxs((size_t)nthreads, 0.0f);
+        long per = n / nthreads, extra = n % nthreads, lo = 0;
+        for (long t = 0; t < nthreads; t++) {
+            long hi = lo + per + (t < extra ? 1 : 0);
+            workers.emplace_back([=, &rcs, &vmaxs]() {
+                rcs[(size_t)t] = xtc_stage_range_i16(
+                    path, offsets, lo, hi, natoms, sel, n_sel, scale,
+                    out, box, &vmaxs[(size_t)t]);
+            });
+            lo = hi;
+        }
+        for (auto& w : workers) w.join();
+        for (int rc : rcs)
+            if (rc != 0) return rc;
+        for (float v : vmaxs)
+            if (v > vmax) vmax = v;
+    }
+    *max_abs_out = vmax;
+    return ((double)vmax * (double)scale > 32767.0) ? 1 : 0;
+}
+
+// Fused decode+stage, float32 (Å out).
+int xtc_stage_f32(const char* path, const long* offsets, long n,
+                  int natoms, const int32_t* sel, long n_sel,
+                  float* out, float* box) {
+    if (n < 0 || natoms < 0 || n_sel < 0) return -9;
+    if (sel == nullptr) n_sel = natoms;
+    long nthreads = xtc_nthreads(n);
+    if (nthreads == 1)
+        return xtc_stage_range_f32(path, offsets, 0, n, natoms, sel,
+                                   n_sel, out, box);
+    std::vector<std::thread> workers;
+    std::vector<int> rcs((size_t)nthreads, 0);
+    long per = n / nthreads, extra = n % nthreads, lo = 0;
+    for (long t = 0; t < nthreads; t++) {
+        long hi = lo + per + (t < extra ? 1 : 0);
+        workers.emplace_back([=, &rcs]() {
+            rcs[(size_t)t] = xtc_stage_range_f32(
+                path, offsets, lo, hi, natoms, sel, n_sel, out, box);
         });
         lo = hi;
     }

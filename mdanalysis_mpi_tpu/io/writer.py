@@ -129,6 +129,10 @@ class TrajectoryWriter:
                 and self.format != "trr":
             raise ValueError(
                 f"{self.format} cannot store velocities/forces (use trr)")
+        if (times is not None or steps is not None) and self.format == "dcd":
+            raise ValueError(
+                "dcd stores no per-frame times/steps (only a fixed dt in "
+                "the header — pass dt= to the writer instead)")
         lo = self.frames_written
         if times is None:
             times = np.arange(lo, lo + nf, dtype=np.float32) * self._dt

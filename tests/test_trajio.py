@@ -568,3 +568,112 @@ class TestCodecHypothesisFuzz:
         # near-cap magnitudes (~|x| * 2^-23)
         tol = 10.0 / precision * 0.51 + amp * 2.5e-7 + 1e-4
         assert np.abs(blk - frames).max() <= tol
+
+
+# ---------------- fused decode→stage (cold path) ----------------
+
+class TestFusedXTCStage:
+    """xtc_stage_f32/xtc_stage_i16: decode+gather(+quantize) without
+    materializing the full-system block (trajio.cpp)."""
+
+    def _fixture(self, tmp_path, f=9, n=120, box=True):
+        coords = _traj(f=f, n=n)
+        dims = (np.array([40.0, 40.0, 40.0, 90.0, 90.0, 90.0])
+                if box else None)
+        path = str(tmp_path / "t.xtc")
+        write_xtc(path, coords, dimensions=dims)
+        return path, coords
+
+    def test_read_block_selection_matches_full_decode(self, tmp_path):
+        path, _ = self._fixture(tmp_path)
+        r = XTCReader(path)
+        sel = np.array([0, 3, 7, 118], dtype=np.int64)
+        full, boxes_full = r.read_block(0, 9)          # sel=None: old path
+        got, boxes = r.read_block(0, 9, sel=sel)       # fused path
+        np.testing.assert_array_equal(got, full[:, sel])
+        np.testing.assert_allclose(boxes, boxes_full, atol=1e-4)
+
+    def test_read_block_selection_strided(self, tmp_path):
+        path, _ = self._fixture(tmp_path)
+        r = XTCReader(path)
+        sel = np.arange(0, 120, 5)
+        full, _ = r.read_block(1, 9, step=3)
+        got, _ = r.read_block(1, 9, sel=sel, step=3)
+        np.testing.assert_array_equal(got, full[:, sel])
+
+    def test_boxless_block_keeps_none_contract(self, tmp_path):
+        path, _ = self._fixture(tmp_path, box=False)
+        r = XTCReader(path)
+        got, boxes = r.read_block(0, 9, sel=np.array([1, 2]))
+        assert boxes is None
+        assert got.shape == (9, 2, 3)
+
+    def test_stage_block_first_call_bit_identical_to_reference(self, tmp_path):
+        """First block (no hint) must match the NumPy exact-scale
+        quantizer bit for bit."""
+        from mdanalysis_mpi_tpu.parallel.executors import quantize_block
+
+        path, _ = self._fixture(tmp_path)
+        r = XTCReader(path)
+        sel = np.array([2, 5, 50, 99], dtype=np.int64)
+        q, boxes, inv = r.stage_block(0, 9, sel=sel, quantize=True)
+        block, _ = XTCReader(path).read_block(0, 9, sel=sel)
+        q_ref, inv_ref = quantize_block(block)
+        np.testing.assert_array_equal(q, q_ref)
+        assert np.float32(inv) == np.float32(inv_ref)
+
+    def test_stage_block_hinted_fused_path_matches_resolution(self, tmp_path):
+        """Second block takes the fused decode→int16 kernel; dequantized
+        output must agree with the f32 block to quantization resolution."""
+        path, coords = self._fixture(tmp_path, f=12)
+        r = XTCReader(path)
+        sel = np.arange(0, 120, 3)
+        r.stage_block(0, 6, sel=sel, quantize=True)          # seeds hint
+        assert r.__dict__["_quant_max_hints"]                # hint present
+        q, boxes, inv = r.stage_block(6, 12, sel=sel, quantize=True)
+        assert q.dtype == np.int16
+        block, _ = XTCReader(path).read_block(6, 12, sel=sel)
+        np.testing.assert_allclose(q.astype(np.float32) * inv, block,
+                                   atol=2.0 * float(inv))
+        assert boxes is not None
+
+    def test_stage_block_overflow_requantizes_exactly(self, tmp_path):
+        """A later block with much larger coordinates must trip the
+        hinted scale and come back at the fresh exact scale."""
+        f, n = 4, 64
+        small = _traj(f=f, n=n, scale=5.0)
+        big = _traj(f=f, n=n, scale=5.0) * 40.0
+        path = str(tmp_path / "grow.xtc")
+        write_xtc(path, np.concatenate([small, big]))
+        r = XTCReader(path)
+        sel = np.arange(n)
+        r.stage_block(0, f, sel=sel, quantize=True)          # small hint
+        q, _, inv = r.stage_block(f, 2 * f, sel=sel, quantize=True)
+        block, _ = XTCReader(path).read_block(f, 2 * f, sel=sel)
+        # no clipping: the requantized block must cover the true range
+        np.testing.assert_allclose(q.astype(np.float32) * inv, block,
+                                   atol=2.0 * float(inv))
+        assert float(np.abs(block).max()) <= 32767.5 * float(inv)
+
+    def test_stage_block_bounds_checked_on_hinted_path(self, tmp_path):
+        path, _ = self._fixture(tmp_path)
+        r = XTCReader(path)
+        sel = np.array([0, 1])
+        r.stage_block(0, 4, sel=sel, quantize=True)      # seeds hint
+        with pytest.raises(IndexError):
+            r.stage_block(-4, 4, sel=sel, quantize=True)
+        with pytest.raises(IndexError):
+            r.stage_block(0, 99, sel=sel, quantize=True)
+
+    def test_threaded_fused_stage_identical(self, tmp_path, monkeypatch):
+        path, _ = self._fixture(tmp_path, f=11)
+        sel = np.arange(0, 120, 2)
+        r1 = XTCReader(path)
+        r1.stage_block(0, 5, sel=sel, quantize=True)
+        q1, _, inv1 = r1.stage_block(5, 11, sel=sel, quantize=True)
+        monkeypatch.setenv("MDTPU_DECODE_THREADS", "3")
+        r2 = XTCReader(path)
+        r2.stage_block(0, 5, sel=sel, quantize=True)
+        q2, _, inv2 = r2.stage_block(5, 11, sel=sel, quantize=True)
+        np.testing.assert_array_equal(q1, q2)
+        assert np.float32(inv1) == np.float32(inv2)

@@ -143,6 +143,9 @@ def test_velocities_rejected_for_formats_that_drop_them(tmp_path):
         with TrajectoryWriter(str(tmp_path / f"o.{ext}")) as w:
             with pytest.raises(ValueError, match="velocities"):
                 w.write(coords, velocities=coords)
+    with TrajectoryWriter(str(tmp_path / "o.dcd")) as w:
+        with pytest.raises(ValueError, match="times"):
+            w.write(coords, times=np.array([1.0, 2.0]))
     with TrajectoryWriter(str(tmp_path / "o.trr")) as w:
         w.write(coords, velocities=coords)     # trr stores them
     from mdanalysis_mpi_tpu.io.trr import TRRReader
