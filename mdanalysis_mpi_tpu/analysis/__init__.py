@@ -21,8 +21,9 @@ from mdanalysis_mpi_tpu.analysis.align import (AverageStructure, AlignTraj,
 from mdanalysis_mpi_tpu.analysis.rdf import InterRDF
 from mdanalysis_mpi_tpu.analysis.distances import ContactMap, PairwiseDistances
 from mdanalysis_mpi_tpu.analysis.rgyr import RadiusOfGyration
+from mdanalysis_mpi_tpu.analysis.pca import PCA
 
 __all__ = ["AnalysisBase", "Results", "RMSF", "RMSD", "AlignedRMSF",
            "AverageStructure", "AlignTraj", "alignto", "rotation_matrix",
            "InterRDF", "ContactMap",
-           "PairwiseDistances", "RadiusOfGyration"]
+           "PairwiseDistances", "RadiusOfGyration", "PCA"]

@@ -1,0 +1,300 @@
+"""Principal component analysis of trajectory coordinates.
+
+Upstream-API mirror (``MDAnalysis.analysis.pca.PCA``): fit principal
+components of the (3S)-dimensional coordinate distribution of a
+selection over frames — ``PCA(u, select=...).run()`` →
+``results.p_components`` (3S, k), ``results.variance``,
+``results.cumulated_variance``, ``results.mean`` — plus
+``transform(ag)`` to project frames onto the components.  The reference
+program itself has no PCA, but its capability envelope (AnalysisBase
+over pluggable executors, SURVEY.md §3.5 / BASELINE north_star) is
+exactly what this plugs into.
+
+TPU-first shape: the covariance accumulation is a batched rank-B update
+``Σ xᵀx`` — one (B, 3S)ᵀ·(B, 3S) matmul per staged block, the op class
+the MXU systolic array is built for — merged across batches with the
+device fold and across chips/hosts with ``psum`` (frame-DP, the same
+mesh axis as every other analysis here).  The mean rides in the same
+partial tuple, so a single sweep yields (T, Σx, Σxᵀx) and the
+covariance ``(Σxᵀx − Σx·Σxᵀ/T)/(T−1)`` needs no second pass.  With
+``align=True`` the fit runs as two passes like AlignedRMSF
+(RMSF.py:76-143): pass 1 computes the average structure of the
+selection, pass 2 least-squares-superposes every frame onto it before
+accumulating — rigid-body motion must not masquerade as variance.  The
+eigendecomposition happens on-device in one jitted call so ``run()``
+stays readback-free (tunneled-link rationale, ``analysis.base``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mdanalysis_mpi_tpu.analysis.base import AnalysisBase, tree_add, tree_psum
+from mdanalysis_mpi_tpu.core.universe import Universe
+from mdanalysis_mpi_tpu.ops import host
+
+
+# ---- module-level batch kernels (stable identity → cached compiles) ----
+
+def _cov_kernel(params, batch, boxes, mask):
+    """Partials (T, Σx (3S,), Σxᵀx (3S, 3S)) of the staged selection."""
+    del boxes
+    import jax.numpy as jnp
+
+    from mdanalysis_mpi_tpu.ops.align import _HI
+
+    del params
+    b = batch.shape[0]
+    x = batch.reshape(b, -1)
+    xm = x * mask[:, None]
+    return (mask.sum(),
+            jnp.einsum("bi->i", xm, precision=_HI),
+            jnp.einsum("bi,bj->ij", xm, x, precision=_HI))
+
+
+def _aligned_cov_kernel(params, batch, boxes, mask):
+    """Superpose the selection onto the average structure, then the
+    covariance partials (align=True path)."""
+    del boxes
+    import jax.numpy as jnp
+
+    from mdanalysis_mpi_tpu.ops.align import _HI, superpose_selection_batch
+
+    w, ref_c, ref_com = params
+    aligned = superpose_selection_batch(batch, w, ref_c, ref_com)
+    b = aligned.shape[0]
+    x = aligned.reshape(b, -1)
+    xm = x * mask[:, None]
+    return (mask.sum(),
+            jnp.einsum("bi->i", xm, precision=_HI),
+            jnp.einsum("bi,bj->ij", xm, x, precision=_HI))
+
+
+_EIG_JIT = None
+
+
+def _eig_jit(t, sx, sxx):
+    """Device-side covariance → eigendecomposition (descending order),
+    jitted once; keeps ``run()`` readback-free on tunneled targets."""
+    global _EIG_JIT
+    if _EIG_JIT is None:
+        import jax
+        import jax.numpy as jnp
+
+        def f(t, sx, sxx):
+            mean = sx / t
+            cov = (sxx - jnp.outer(sx, sx) / t) / (t - 1.0)
+            vals, vecs = jnp.linalg.eigh(cov)
+            return mean, cov, vals[::-1], vecs[:, ::-1]
+
+        _EIG_JIT = jax.jit(f)
+    return _EIG_JIT(t, sx, sxx)
+
+
+class PCA(AnalysisBase):
+    """``PCA(u, select='name CA', align=True).run()``.
+
+    Results: ``p_components`` (3S, k), ``variance`` (descending
+    eigenvalues, Å²), ``cumulated_variance`` (fractions of total),
+    ``mean`` (S, 3), ``cov`` (3S, 3S).  ``transform(ag)`` projects
+    frames onto the fitted components.  The covariance is (3S)² — size
+    the selection accordingly (upstream's practical contract too: PCA
+    is for Cα/backbone-scale selections, not full solvated systems).
+    """
+
+    def __init__(self, universe: Universe, select: str = "all",
+                 align: bool = False, ref_frame: int = 0,
+                 n_components: int | None = None, verbose: bool = False):
+        super().__init__(universe, verbose)
+        self._select = select
+        self._align = align
+        self._ref_frame = ref_frame
+        self._n_components = n_components
+        self._ref_sel = None          # set by run() on the align path
+
+    def run(self, start=None, stop=None, step=None, frames=None,
+            backend: str = "serial", batch_size: int | None = None,
+            **kwargs):
+        if not self._align:
+            return super().run(start, stop, step, frames=frames,
+                               backend=backend, batch_size=batch_size,
+                               **kwargs)
+        # two passes over the same frames/selection → share one HBM
+        # block cache, exactly like AlignedRMSF (pass 2 reads
+        # device-resident blocks instead of re-staging)
+        if isinstance(backend, str) and backend != "serial":
+            from mdanalysis_mpi_tpu.parallel.executors import (
+                DeviceBlockCache, get_executor)
+
+            cache = kwargs.pop("block_cache", None) or DeviceBlockCache()
+            backend = get_executor(backend, block_cache=cache, **kwargs)
+            kwargs = {}
+        from mdanalysis_mpi_tpu.analysis.align import AverageStructure
+
+        avg = AverageStructure(
+            self._universe, select=self._select, ref_frame=self._ref_frame,
+            select_only=True, verbose=self._verbose,
+        ).run(start, stop, step, frames=frames, backend=backend,
+              batch_size=batch_size, **kwargs)
+        # raw dict access: keep a device-resident average on device
+        self._ref_sel = avg.results["positions"]
+        return super().run(start, stop, step, frames=frames,
+                           backend=backend, batch_size=batch_size, **kwargs)
+
+    def _prepare(self):
+        u = self._universe
+        ag = u.select_atoms(self._select)
+        if ag.n_atoms == 0:
+            raise ValueError(f"selection {self._select!r} matched no atoms")
+        self._idx = ag.indices
+        self._weights = ag.masses
+        dim = 3 * len(self._idx)
+        if dim > 24_000:
+            raise ValueError(
+                f"selection spans {len(self._idx)} atoms -> a "
+                f"{dim}x{dim} covariance; PCA is meant for "
+                "Cα/backbone-scale selections (reduce the selection)")
+        if self._align:
+            import jax
+
+            ref = self._ref_sel
+            if isinstance(ref, jax.Array):
+                from mdanalysis_mpi_tpu.analysis.rms import _center_ref_jit
+
+                self._ref_c, self._ref_com = _center_ref_jit(
+                    ref, np.asarray(self._weights, np.float32))
+            else:
+                ref = np.asarray(ref, np.float64)
+                com = host.weighted_center(ref, self._weights)
+                self._ref_c = ref - com
+                self._ref_com = com
+        self._t = 0.0
+        self._sx = np.zeros(dim, dtype=np.float64)
+        self._sxx = np.zeros((dim, dim), dtype=np.float64)
+
+    # -- serial path --
+
+    def _single_frame(self, ts):
+        x = ts.positions[self._idx].astype(np.float64)
+        if self._align:
+            ref_np = getattr(self, "_ref_np", None)
+            if ref_np is None:
+                ref_np = (np.asarray(self._ref_c, np.float64),
+                          np.asarray(self._ref_com, np.float64))
+                self._ref_np = ref_np
+            com = host.weighted_center(x, self._weights)
+            xc = x - com
+            r = host.qcp_rotation(xc, ref_np[0])
+            x = xc @ r + ref_np[1]
+        v = x.reshape(-1)
+        self._t += 1.0
+        self._sx += v
+        self._sxx += np.outer(v, v)
+
+    def _serial_summary(self):
+        return (self._t, self._sx, self._sxx)
+
+    # -- batch path --
+
+    def _batch_select(self):
+        return self._idx
+
+    def _batch_fn(self):
+        return _aligned_cov_kernel if self._align else _cov_kernel
+
+    def _batch_params(self):
+        if not self._align:
+            return None
+        import jax.numpy as jnp
+
+        return (jnp.asarray(self._weights, jnp.float32),
+                jnp.asarray(self._ref_c, jnp.float32),
+                jnp.asarray(self._ref_com, jnp.float32))
+
+    _device_combine = staticmethod(tree_psum)
+    _device_fold_fn = staticmethod(tree_add)
+
+    def _identity_partials(self):
+        dim = 3 * len(self._idx)
+        return (0.0, np.zeros(dim), np.zeros((dim, dim)))
+
+    def _conclude(self, total):
+        t, sx, sxx = total
+        if self.n_frames < 2:
+            raise ValueError("PCA needs at least 2 frames")
+        import jax
+
+        k = self._n_components or 3 * len(self._idx)
+        if isinstance(sxx, jax.Array):
+            import jax.numpy as jnp
+
+            mean, cov, vals, vecs = _eig_jit(t, sx, sxx)
+            c = jnp.cumsum(vals)
+            cumulated = (c / c[-1])[:k]
+            mean = mean.reshape(len(self._idx), 3)
+        else:
+            mean = (sx / t).reshape(len(self._idx), 3)
+            cov = (sxx - np.outer(sx, sx) / t) / (t - 1.0)
+            vals, vecs = np.linalg.eigh(cov)
+            vals = vals[::-1].copy()
+            vecs = vecs[:, ::-1].copy()
+            c = np.cumsum(vals)
+            cumulated = (c / c[-1])[:k]
+        self.results.mean = mean
+        self.results.cov = cov
+        self.results.variance = vals[:k]
+        self.results.cumulated_variance = cumulated
+        self.results.p_components = vecs[:, :k]
+
+    def transform(self, atomgroup, n_components: int | None = None,
+                  start=None, stop=None, step=None,
+                  batch_size: int = 64) -> np.ndarray:
+        """Project ``atomgroup``'s frames onto the fitted components →
+        (n_frames, k) float32.  One (B, 3S)·(3S, k) matmul per block,
+        jitted; frames are aligned the same way the fit was."""
+        if "p_components" not in self.results:
+            raise RuntimeError("run() the PCA before transform()")
+        u = atomgroup.universe
+        idx = atomgroup.indices
+        if len(idx) != len(self._idx):
+            raise ValueError(
+                f"atomgroup has {len(idx)} atoms, PCA was fitted on "
+                f"{len(self._idx)}")
+        import jax
+        import jax.numpy as jnp
+
+        comps = jnp.asarray(self.results.p_components)
+        k = n_components or comps.shape[1]
+        comps = comps[:, :k]
+        mean_flat = jnp.asarray(self.results.mean,
+                                jnp.float32).reshape(-1)
+        align = self._align
+        if align:
+            params = (jnp.asarray(self._weights, jnp.float32),
+                      jnp.asarray(self._ref_c, jnp.float32),
+                      jnp.asarray(self._ref_com, jnp.float32))
+
+        @jax.jit
+        def project(batch):
+            if align:
+                from mdanalysis_mpi_tpu.ops.align import (
+                    superpose_selection_batch,
+                )
+
+                batch = superpose_selection_batch(batch, *params)
+            x = batch.reshape(batch.shape[0], -1) - mean_flat
+            return x @ comps
+
+        traj = u.trajectory
+        # window over the TARGET group's trajectory (which may differ
+        # from the fitted universe's)
+        frames = list(range(*slice(start, stop, step).indices(traj.n_frames)))
+        out = np.empty((len(frames), k), dtype=np.float32)
+        for a in range(0, len(frames), batch_size):
+            chunk = frames[a:a + batch_size]
+            if chunk and chunk[-1] - chunk[0] + 1 == len(chunk):
+                block, _ = traj.read_block(chunk[0], chunk[-1] + 1, sel=idx)
+            else:
+                block = np.stack([traj[i].positions[idx] for i in chunk])
+            out[a:a + len(chunk)] = np.asarray(project(jnp.asarray(block)))
+        return out

@@ -24,7 +24,7 @@ import time
 import numpy as np
 
 ANALYSES = ("rmsf", "aligned-rmsf", "rmsd", "average-structure", "rdf",
-            "contacts", "pairwise-distances", "rgyr")
+            "contacts", "pairwise-distances", "rgyr", "pca")
 
 
 @dataclasses.dataclass
@@ -47,6 +47,8 @@ class AnalysisConfig:
     r_max: float = 15.0                 # rdf range upper edge
     engine: str = "auto"                # rdf histogram engine
     cutoff: float = 8.0                 # contacts
+    align: bool = False                 # pca: superpose onto the mean
+    n_components: int | None = None     # pca
     output: str | None = None
 
     def validate(self) -> None:
@@ -84,6 +86,10 @@ def build_analysis(cfg: AnalysisConfig, universe=None):
         return ana.PairwiseDistances(u.select_atoms(cfg.select))
     if cfg.analysis == "rgyr":
         return ana.RadiusOfGyration(u.select_atoms(cfg.select))
+    if cfg.analysis == "pca":
+        return ana.PCA(u, select=cfg.select, align=cfg.align,
+                       ref_frame=cfg.ref_frame,
+                       n_components=cfg.n_components)
     raise AssertionError(cfg.analysis)
 
 
@@ -125,6 +131,10 @@ def _parser() -> argparse.ArgumentParser:
                    help="RDF histogram engine (ring needs --backend mesh)")
     p.add_argument("--r-max", type=float, default=15.0)
     p.add_argument("--cutoff", type=float, default=8.0)
+    p.add_argument("--align", action="store_true",
+                   help="PCA: superpose frames onto the run-average "
+                        "structure before fitting")
+    p.add_argument("--n-components", type=int, default=None)
     p.add_argument("--output", default=None, help="write results to .npz")
     p.add_argument("--trace", default=None, metavar="DIR",
                    help="write a jax.profiler trace (TensorBoard format) "
@@ -142,7 +152,7 @@ def main(argv=None) -> int:
         step=ns.step, ref_frame=ns.ref_frame, backend=ns.backend,
         batch_size=ns.batch_size, transfer_dtype=ns.transfer_dtype,
         nbins=ns.nbins, r_max=ns.r_max, cutoff=ns.cutoff, output=ns.output,
-        engine=ns.engine)
+        engine=ns.engine, align=ns.align, n_components=ns.n_components)
     from mdanalysis_mpi_tpu.utils.timers import device_trace
 
     TIMERS.reset()

@@ -1,0 +1,117 @@
+"""PCA analysis: serial f64 oracle vs batched device covariance
+(the (B,3S)ᵀ(B,3S) MXU matmul path), alignment handling, transform."""
+
+import numpy as np
+import pytest
+
+from mdanalysis_mpi_tpu.analysis import PCA
+from mdanalysis_mpi_tpu.core.topology import make_protein_topology
+from mdanalysis_mpi_tpu.core.universe import Universe
+from mdanalysis_mpi_tpu.io.memory import MemoryReader
+from mdanalysis_mpi_tpu.testing import (
+    make_protein_universe, random_rotation_matrices,
+)
+
+
+def _linear_universe(n_frames=40, n_atoms=12, seed=1):
+    """Base structure breathing along one known direction + tiny noise:
+    the first PC must recover that direction."""
+    rng = np.random.default_rng(seed)
+    base = rng.normal(scale=4.0, size=(n_atoms, 3)).astype(np.float64)
+    direction = rng.normal(size=(n_atoms, 3))
+    direction /= np.linalg.norm(direction)
+    amp = rng.normal(scale=3.0, size=n_frames)
+    frames = (base[None] + amp[:, None, None] * direction[None]
+              + rng.normal(scale=0.01, size=(n_frames, n_atoms, 3)))
+    top = make_protein_topology(max(1, n_atoms // 4))
+    top = top.subset(np.arange(n_atoms)) if top.n_atoms > n_atoms else top
+    frames = frames[:, : top.n_atoms]
+    return (Universe(top, MemoryReader(frames.astype(np.float32))),
+            direction[: top.n_atoms].reshape(-1))
+
+
+class TestPCA:
+    def test_serial_vs_jax_parity(self):
+        u = make_protein_universe(n_residues=5, n_frames=32)
+        s = PCA(u, select="name CA").run(backend="serial")
+        j = PCA(u, select="name CA").run(backend="jax", batch_size=8)
+        np.testing.assert_allclose(
+            np.asarray(j.results.cov), s.results.cov,
+            atol=1e-3 * float(np.abs(s.results.cov).max()))
+        np.testing.assert_allclose(
+            np.asarray(j.results.variance), s.results.variance,
+            rtol=2e-2, atol=1e-3 * float(s.results.variance[0]))
+        np.testing.assert_allclose(
+            np.asarray(j.results.mean), s.results.mean, atol=1e-3)
+
+    def test_mesh_backend_parity(self):
+        u = make_protein_universe(n_residues=4, n_frames=24)
+        s = PCA(u, select="name CA").run(backend="serial")
+        m = PCA(u, select="name CA").run(backend="mesh", batch_size=8)
+        np.testing.assert_allclose(
+            np.asarray(m.results.variance), s.results.variance,
+            rtol=2e-2, atol=1e-3 * float(s.results.variance[0]))
+
+    def test_recovers_known_direction(self):
+        u, direction = _linear_universe()
+        p = PCA(u).run(backend="serial")
+        # dominant mode explains almost all variance
+        frac = float(p.results.variance[0] / p.results.variance.sum())
+        assert frac > 0.98, frac
+        # and points along the planted direction (up to sign)
+        overlap = abs(float(p.results.p_components[:, 0] @ direction))
+        assert overlap > 0.99, overlap
+
+    def test_align_removes_rigid_body_variance(self):
+        """Rigid tumbling of a frozen structure: without alignment the
+        apparent variance is large; with align=True it collapses."""
+        u_t = make_protein_universe(n_residues=5, n_frames=24, noise=0.0,
+                                    rigid_motion=True)
+        raw = PCA(u_t, select="name CA").run(backend="serial")
+        ali = PCA(u_t, select="name CA", align=True).run(backend="serial")
+        assert float(ali.results.variance[0]) < 1e-6 * float(
+            raw.results.variance[0])
+
+    def test_align_parity_serial_vs_jax(self):
+        u = make_protein_universe(n_residues=5, n_frames=32, noise=0.3)
+        s = PCA(u, select="name CA", align=True).run(backend="serial")
+        j = PCA(u, select="name CA", align=True).run(
+            backend="jax", batch_size=8)
+        np.testing.assert_allclose(
+            np.asarray(j.results.variance), s.results.variance,
+            rtol=5e-2, atol=1e-3 * float(s.results.variance[0]))
+
+    def test_transform_variances_match_eigenvalues(self):
+        u = make_protein_universe(n_residues=5, n_frames=64, noise=0.4)
+        p = PCA(u, select="name CA", n_components=4).run(backend="serial")
+        proj = p.transform(u.select_atoms("name CA"), batch_size=16)
+        assert proj.shape == (64, 4)
+        # projection variance along PC i = eigenvalue i (ddof=1)
+        got = proj.var(axis=0, ddof=1)
+        np.testing.assert_allclose(got, p.results.variance[:4], rtol=5e-2)
+
+    def test_transform_guards(self):
+        u = make_protein_universe(n_residues=4, n_frames=8)
+        p = PCA(u, select="name CA")
+        with pytest.raises(RuntimeError, match="run"):
+            p.transform(u.select_atoms("name CA"))
+        p.run(backend="serial")
+        with pytest.raises(ValueError, match="atoms"):
+            p.transform(u.select_atoms("all"))
+
+    def test_size_guard_and_min_frames(self):
+        u = make_protein_universe(n_residues=4, n_frames=8)
+        with pytest.raises(ValueError, match="at least 2"):
+            PCA(u, select="name CA").run(stop=1, backend="serial")
+        top = make_protein_topology(3000)
+        big = Universe(
+            top, MemoryReader(np.zeros((2, top.n_atoms, 3), np.float32)))
+        with pytest.raises(ValueError, match="covariance"):
+            PCA(big).run(backend="serial")
+
+    def test_n_components_truncates(self):
+        u = make_protein_universe(n_residues=5, n_frames=16)
+        p = PCA(u, select="name CA", n_components=3).run(backend="serial")
+        assert p.results.p_components.shape[1] == 3
+        assert len(p.results.variance) == 3
+        assert p.results.cumulated_variance[-1] <= 1.0 + 1e-9
