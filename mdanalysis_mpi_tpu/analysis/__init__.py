@@ -22,8 +22,9 @@ from mdanalysis_mpi_tpu.analysis.rdf import InterRDF
 from mdanalysis_mpi_tpu.analysis.distances import ContactMap, PairwiseDistances
 from mdanalysis_mpi_tpu.analysis.rgyr import RadiusOfGyration
 from mdanalysis_mpi_tpu.analysis.pca import PCA
+from mdanalysis_mpi_tpu.analysis.msd import EinsteinMSD
 
 __all__ = ["AnalysisBase", "Results", "RMSF", "RMSD", "AlignedRMSF",
            "AverageStructure", "AlignTraj", "alignto", "rotation_matrix",
            "InterRDF", "ContactMap",
-           "PairwiseDistances", "RadiusOfGyration", "PCA"]
+           "PairwiseDistances", "RadiusOfGyration", "PCA", "EinsteinMSD"]

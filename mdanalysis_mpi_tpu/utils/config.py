@@ -24,7 +24,7 @@ import time
 import numpy as np
 
 ANALYSES = ("rmsf", "aligned-rmsf", "rmsd", "average-structure", "rdf",
-            "contacts", "pairwise-distances", "rgyr", "pca")
+            "contacts", "pairwise-distances", "rgyr", "pca", "msd")
 
 
 @dataclasses.dataclass
@@ -49,6 +49,7 @@ class AnalysisConfig:
     cutoff: float = 8.0                 # contacts
     align: bool = False                 # pca: superpose onto the mean
     n_components: int | None = None     # pca
+    msd_type: str = "xyz"               # msd dimensions
     output: str | None = None
 
     def validate(self) -> None:
@@ -90,6 +91,8 @@ def build_analysis(cfg: AnalysisConfig, universe=None):
         return ana.PCA(u, select=cfg.select, align=cfg.align,
                        ref_frame=cfg.ref_frame,
                        n_components=cfg.n_components)
+    if cfg.analysis == "msd":
+        return ana.EinsteinMSD(u, select=cfg.select, msd_type=cfg.msd_type)
     raise AssertionError(cfg.analysis)
 
 
@@ -135,6 +138,8 @@ def _parser() -> argparse.ArgumentParser:
                    help="PCA: superpose frames onto the run-average "
                         "structure before fitting")
     p.add_argument("--n-components", type=int, default=None)
+    p.add_argument("--msd-type", default="xyz",
+                   choices=("xyz", "xy", "xz", "yz", "x", "y", "z"))
     p.add_argument("--output", default=None, help="write results to .npz")
     p.add_argument("--trace", default=None, metavar="DIR",
                    help="write a jax.profiler trace (TensorBoard format) "
@@ -152,7 +157,8 @@ def main(argv=None) -> int:
         step=ns.step, ref_frame=ns.ref_frame, backend=ns.backend,
         batch_size=ns.batch_size, transfer_dtype=ns.transfer_dtype,
         nbins=ns.nbins, r_max=ns.r_max, cutoff=ns.cutoff, output=ns.output,
-        engine=ns.engine, align=ns.align, n_components=ns.n_components)
+        engine=ns.engine, align=ns.align, n_components=ns.n_components,
+        msd_type=ns.msd_type)
     from mdanalysis_mpi_tpu.utils.timers import device_trace
 
     TIMERS.reset()

@@ -1,0 +1,92 @@
+"""EinsteinMSD: FFT lag algebra vs direct windowed sum, backend parity,
+Brownian-motion slope sanity."""
+
+import numpy as np
+import pytest
+
+from mdanalysis_mpi_tpu.analysis import EinsteinMSD
+from mdanalysis_mpi_tpu.analysis.msd import _np_fft_msd, _np_windowed_msd
+from mdanalysis_mpi_tpu.core.topology import make_water_topology
+from mdanalysis_mpi_tpu.core.universe import Universe
+from mdanalysis_mpi_tpu.io.memory import MemoryReader
+
+
+def _brownian_universe(n_frames=128, n_mol=40, d=0.5, seed=5):
+    """Random-walk particles: MSD(m) ≈ 2*D*dims*m (unwrapped, no box)."""
+    rng = np.random.default_rng(seed)
+    top = make_water_topology(n_mol)
+    n = top.n_atoms
+    steps = rng.normal(scale=np.sqrt(2 * d), size=(n_frames, n, 3))
+    pos = np.cumsum(steps, axis=0).astype(np.float32)
+    return Universe(top, MemoryReader(pos))
+
+
+class TestMSDAlgebra:
+    def test_fft_equals_windowed(self):
+        rng = np.random.default_rng(0)
+        pos = rng.normal(size=(37, 5, 3))
+        np.testing.assert_allclose(
+            _np_fft_msd(pos)[1:], _np_windowed_msd(pos)[1:],
+            rtol=1e-9, atol=1e-9)
+        assert abs(_np_fft_msd(pos)[0]).max() < 1e-9   # msd(0) = 0
+
+
+class TestEinsteinMSD:
+    def test_serial_fft_vs_nofft(self):
+        u = _brownian_universe(n_frames=48)
+        a = EinsteinMSD(u, fft=True).run(backend="serial")
+        b = EinsteinMSD(u, fft=False).run(backend="serial")
+        np.testing.assert_allclose(a.results.timeseries,
+                                   b.results.timeseries, rtol=1e-8,
+                                   atol=1e-9)
+
+    @pytest.mark.parametrize("backend", ["jax", "mesh"])
+    def test_backend_parity(self, backend):
+        u = _brownian_universe(n_frames=64)
+        s = EinsteinMSD(u, select="name OW").run(backend="serial")
+        j = EinsteinMSD(u, select="name OW").run(backend=backend,
+                                                 batch_size=16)
+        np.testing.assert_allclose(
+            j.results.timeseries, s.results.timeseries,
+            rtol=1e-3, atol=1e-2 * float(s.results.timeseries.max()))
+        assert j.results.msds_by_particle.shape == \
+            s.results.msds_by_particle.shape
+
+    def test_brownian_slope(self):
+        d = 0.5
+        u = _brownian_universe(n_frames=256, n_mol=80, d=d)
+        r = EinsteinMSD(u).run(backend="serial")
+        ts = r.results.timeseries
+        lags = np.arange(len(ts))
+        # fit over small lags (good statistics): slope ≈ 2*D*3
+        k = 32
+        slope = np.polyfit(lags[1:k], ts[1:k], 1)[0]
+        assert abs(slope - 6 * d) / (6 * d) < 0.15, slope
+
+    def test_msd_type_dims(self):
+        u = _brownian_universe(n_frames=64)
+        xyz = EinsteinMSD(u, msd_type="xyz").run(backend="serial")
+        x = EinsteinMSD(u, msd_type="x").run(backend="serial")
+        xy = EinsteinMSD(u, msd_type="xy").run(backend="serial")
+        # independent dimensions: msd_xyz ≈ msd_x + msd_y + msd_z
+        assert 0.2 < float(x.results.timeseries[-1]
+                           / xyz.results.timeseries[-1]) < 0.5
+        assert 0.5 < float(xy.results.timeseries[-1]
+                           / xyz.results.timeseries[-1]) < 0.85
+
+    def test_window_and_step(self):
+        u = _brownian_universe(n_frames=64)
+        r = EinsteinMSD(u).run(start=8, stop=56, step=2, backend="jax",
+                               batch_size=8)
+        assert r.results.timeseries.shape == (24,)
+
+    def test_guards(self):
+        u = _brownian_universe(n_frames=8)
+        with pytest.raises(ValueError, match="msd_type"):
+            EinsteinMSD(u, msd_type="zz")
+        with pytest.raises(ValueError, match="at least 2"):
+            EinsteinMSD(u).run(stop=1, backend="serial")
+        with pytest.raises(ValueError, match="no atoms"):
+            EinsteinMSD(u, select="name XX").run(backend="serial")
+        with pytest.raises(ValueError, match="fft"):
+            EinsteinMSD(u, fft=False).run(backend="jax", batch_size=4)
