@@ -33,6 +33,17 @@ def box_to_vectors(dim: np.ndarray) -> np.ndarray:
     return m
 
 
+def wrap_positions(pos: np.ndarray, m: np.ndarray) -> np.ndarray:
+    """Map positions into the primary cell of box matrix ``m``:
+    fractional coordinates in [0, 1) and back.  THE one wrap
+    implementation (AtomGroup.wrap, transformations.wrap,
+    center_in_box all call this — the paths must stay bit-identical).
+    Returns float64 (N, 3); callers cast as needed."""
+    pos = np.asarray(pos, np.float64)
+    frac = pos @ np.linalg.inv(m)
+    return (frac - np.floor(frac)) @ m
+
+
 def vectors_to_box(m: np.ndarray) -> np.ndarray:
     """Lower-triangular (or general) 3x3 box matrix → [lx,ly,lz,α,β,γ]."""
     m = np.asarray(m, dtype=np.float64)

@@ -243,12 +243,11 @@ class AtomGroup:
         ts = self._universe.trajectory.ts
         if ts.dimensions is None or not np.any(ts.dimensions[:3] > 0):
             raise ValueError("wrap() needs a periodic box on this frame")
-        from mdanalysis_mpi_tpu.core.box import box_to_vectors
+        from mdanalysis_mpi_tpu.core.box import box_to_vectors, wrap_positions
 
         m = box_to_vectors(ts.dimensions.astype(np.float64))
-        pos = ts.positions[self._indices].astype(np.float64)
-        frac = pos @ np.linalg.inv(m)
-        wrapped = ((frac - np.floor(frac)) @ m).astype(np.float32)
+        wrapped = wrap_positions(
+            ts.positions[self._indices], m).astype(np.float32)
         ts.positions[self._indices] = wrapped
         return wrapped
 

@@ -59,6 +59,12 @@ class Universe:
             raise ValueError(
                 f"topology has {self.topology.n_atoms} atoms but trajectory "
                 f"has {self.trajectory.n_atoms}")
+        transformations = kwargs.pop("transformations", None)
+        if transformations is not None:
+            # upstream Universe(..., transformations=[...]) convenience
+            if callable(transformations):
+                transformations = (transformations,)
+            self.trajectory.add_transformations(*transformations)
 
     @property
     def atoms(self) -> AtomGroup:
@@ -99,7 +105,12 @@ class Universe:
         traj = self.trajectory
         if not hasattr(traj, "reopen"):
             raise TypeError(f"{type(traj).__name__} does not support copy()")
-        return Universe(self.topology, traj.reopen())
+        new = Universe(self.topology, traj.reopen())
+        if traj.transformations:
+            # the copy must see the same coordinates as the original
+            # (each rank's universe.copy() upstream, RMSF.py:57)
+            new.trajectory.add_transformations(*traj.transformations)
+        return new
 
     def transfer_to_memory(self, start=None, stop=None, step=None) -> None:
         """Replace the trajectory with an in-memory copy (upstream's

@@ -128,12 +128,38 @@ class ReaderBase:
         file-backed subclasses store it as ``_path``)."""
         return getattr(self, "_path", None)
 
+    # ---- on-the-fly transformations (upstream add_transformations) ----
+
+    @property
+    def transformations(self) -> tuple:
+        return self.__dict__.get("_transformations", ())
+
+    def add_transformations(self, *transformations) -> None:
+        """Attach ``ts -> ts`` callables applied to every frame read
+        (upstream one-shot contract: set once).  Readers with
+        transformations fall back from fused decode→gather fast paths
+        to the generic read-transform-gather loop, because a
+        transformation may need atoms outside the staged selection."""
+        if self.__dict__.get("_transformations"):
+            raise ValueError(
+                "transformations are already set (upstream contract: "
+                "add_transformations can only be called once)")
+        self.__dict__["_transformations"] = tuple(transformations)
+        self._ts = None            # cursor must re-read transformed
+        # staged-block caches hold UNtransformed data
+        self.__dict__.pop("_host_stage_cache", None)
+
+    def _emit(self, ts: Timestep) -> Timestep:
+        for t in self.transformations:
+            ts = t(ts)
+        return ts
+
     # ---- shared behavior ----
 
     @property
     def ts(self) -> Timestep:
         if self._ts is None:
-            self._ts = self._read_frame(0)
+            self._ts = self._emit(self._read_frame(0))
         return self._ts
 
     def __len__(self) -> int:
@@ -147,7 +173,7 @@ class ReaderBase:
             i += self.n_frames
         if not 0 <= i < self.n_frames:
             raise IndexError(f"frame {i} out of range [0, {self.n_frames})")
-        self._ts = self._read_frame(i)
+        self._ts = self._emit(self._read_frame(i))
         return self._ts
 
     def __iter__(self):
@@ -180,7 +206,7 @@ class ReaderBase:
         out = np.empty((b, n, 3), dtype=np.float32)
         boxes = None
         for j, i in enumerate(frames):
-            ts = self._read_frame(i)
+            ts = self._emit(self._read_frame(i))
             out[j] = ts.positions if sel is None else ts.positions[sel]
             if ts.dimensions is not None:
                 if boxes is None:

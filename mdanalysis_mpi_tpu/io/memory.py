@@ -93,6 +93,11 @@ class MemoryReader(ReaderBase):
         return idx.astype(np.float64) * self._dt
 
     def read_block(self, start: int, stop: int, sel=None, step: int = 1):
+        if self.transformations:
+            # transformed reads must go through the generic
+            # read-transform-gather loop (ReaderBase)
+            return ReaderBase.read_block(self, start, stop, sel=sel,
+                                         step=step)
         if not 0 <= start <= stop <= self.n_frames:
             raise IndexError(f"block [{start},{stop}) out of range [0,{self.n_frames}]")
         if step < 1:
@@ -114,6 +119,9 @@ class MemoryReader(ReaderBase):
         if not 0 <= start <= stop <= self.n_frames:
             raise IndexError(
                 f"block [{start},{stop}) out of range [0,{self.n_frames}]")
+        if self.transformations:
+            return ReaderBase.stage_block(self, start, stop, sel=sel,
+                                          quantize=quantize)
         boxes = None if self._dims is None else self._dims[start:stop].copy()
         view = self._coords[start:stop]
         if quantize:

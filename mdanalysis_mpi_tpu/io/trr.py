@@ -221,6 +221,11 @@ class TRRReader(ReaderBase):
         return times
 
     def read_block(self, start: int, stop: int, sel=None, step: int = 1):
+        if self.transformations:
+            # transformed reads must go through the generic
+            # read-transform-gather loop (ReaderBase)
+            return ReaderBase.read_block(self, start, stop, sel=sel,
+                                         step=step)
         if not 0 <= start <= stop <= self.n_frames:
             raise IndexError(
                 f"block [{start},{stop}) out of range [0,{self.n_frames}]")

@@ -117,6 +117,11 @@ class XTCReader(ReaderBase):
         return boxes
 
     def read_block(self, start: int, stop: int, sel=None, step: int = 1):
+        if self.transformations:
+            # transformed reads must go through the generic
+            # read-transform-gather loop (ReaderBase)
+            return ReaderBase.read_block(self, start, stop, sel=sel,
+                                         step=step)
         if not 0 <= start <= stop <= self.n_frames:
             raise IndexError(
                 f"block [{start},{stop}) out of range [0,{self.n_frames}]")
@@ -149,6 +154,9 @@ class XTCReader(ReaderBase):
         if not 0 <= start <= stop <= self.n_frames:
             raise IndexError(
                 f"block [{start},{stop}) out of range [0,{self.n_frames}]")
+        if self.transformations:
+            return ReaderBase.stage_block(self, start, stop, sel=sel,
+                                          quantize=quantize)
         if not quantize:
             block, boxes = self.read_block(start, stop, sel=sel)
             return block, boxes, None

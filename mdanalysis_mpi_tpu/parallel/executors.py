@@ -314,6 +314,10 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
 
     sel_fp = sel_fingerprint(sel_idx)
     reader_fp = getattr(reader, "_path", None) or id(reader)
+    # a reader with transformations attached stages DIFFERENT bytes for
+    # the same frames; the transformation tuple (set-once) namespaces
+    # the cached entries
+    xform_fp = getattr(reader, "transformations", ())
 
     def prepare(ab):
         """Host side of one batch: read+gather (+quantize) and enqueue
@@ -322,7 +326,8 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
         double-buffering from SURVEY.md §7 layer 5; NumPy releases the
         GIL for the big copies)."""
         a, b = ab
-        key = (reader_fp, tuple(frames[a:b]), bs, quantize, sel_fp)
+        key = (reader_fp, tuple(frames[a:b]), bs, quantize, sel_fp,
+               xform_fp)
         staged = cache.get(key) if cache is not None else None
         if staged is not None:
             return staged

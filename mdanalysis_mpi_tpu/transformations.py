@@ -1,0 +1,187 @@
+"""On-the-fly trajectory transformations.
+
+Upstream-API mirror (``MDAnalysis.transformations``): callables applied
+to every Timestep as it is read —
+``u.trajectory.add_transformations(translate([1,0,0]), wrap(ag))`` —
+so every consumer (per-frame iteration, ``read_block`` staging, every
+analysis backend) sees the transformed coordinates.  The reference
+program transforms frames *imperatively* inside its loop (the in-place
+translate/rotate/translate at RMSF.py:99-101); this module is that
+pattern made composable and declarative.
+
+Execution model: transformations are host-side, frame-wise NumPy — they
+run where the decode runs, before selection gather and device staging
+(a transformation may need atoms outside the staged selection, e.g.
+centering on the protein while staging water).  Readers with
+transformations attached automatically fall back from their fused
+decode→gather fast paths to the generic read-transform-gather loop;
+attach none and the fast paths are untouched.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mdanalysis_mpi_tpu.ops import host
+
+
+class TransformationBase:
+    """A callable ``ts -> ts`` that edits ``ts.positions`` in place
+    (upstream convention)."""
+
+    def __call__(self, ts):
+        raise NotImplementedError
+
+
+def _require_box(ts, who: str) -> np.ndarray:
+    if ts.dimensions is None or not np.any(ts.dimensions[:3] > 0):
+        raise ValueError(f"{who} needs a periodic box on frame {ts.frame}")
+    return ts.dimensions.astype(np.float64)
+
+
+def _group_center(ag, positions: np.ndarray, center: str) -> np.ndarray:
+    sub = positions[ag.indices].astype(np.float64)
+    if center == "mass":
+        w = ag.masses
+        return (w[:, None] * sub).sum(axis=0) / w.sum()
+    if center == "geometry":
+        return sub.mean(axis=0)
+    raise ValueError(f"center must be 'geometry' or 'mass', got {center!r}")
+
+
+class translate(TransformationBase):
+    """Shift every atom by a constant vector."""
+
+    def __init__(self, vector):
+        self._v = np.asarray(vector, dtype=np.float32)
+        if self._v.shape != (3,):
+            raise ValueError(f"vector must be (3,), got {self._v.shape}")
+
+    def __call__(self, ts):
+        ts.positions += self._v
+        return ts
+
+
+class center_in_box(TransformationBase):
+    """Translate each frame so ``ag``'s center sits at the box center
+    (or at ``point``).  ``wrap=True`` wraps the group into the primary
+    cell before computing its center (upstream semantics)."""
+
+    def __init__(self, ag, center: str = "geometry", point=None,
+                 wrap: bool = False):
+        if center not in ("geometry", "mass"):
+            raise ValueError(
+                f"center must be 'geometry' or 'mass', got {center!r}")
+        self._ag = ag
+        self._center = center
+        self._point = (None if point is None
+                       else np.asarray(point, np.float64))
+        self._wrap = wrap
+
+    def __call__(self, ts):
+        from mdanalysis_mpi_tpu.core.box import box_to_vectors, wrap_positions
+
+        dim = _require_box(ts, "center_in_box")
+        m = box_to_vectors(dim)
+        pos = ts.positions
+        if self._wrap:
+            # wrap affects only the CENTER COMPUTATION (upstream
+            # inplace=False semantics) — atom positions themselves keep
+            # their image, so molecules never break across the boundary
+            sub = wrap_positions(pos[self._ag.indices], m)
+            if self._center == "mass":
+                w = self._ag.masses
+                center = (w[:, None] * sub).sum(axis=0) / w.sum()
+            else:
+                center = sub.mean(axis=0)
+        else:
+            center = _group_center(self._ag, pos, self._center)
+        target = (self._point if self._point is not None
+                  else m.sum(axis=0) / 2.0)
+        shift = target - center
+        ts.positions = (pos.astype(np.float64) + shift).astype(np.float32)
+        return ts
+
+
+class fit_translation(TransformationBase):
+    """Translate each frame so ``ag``'s center matches the reference
+    group's center (captured from the reference universe's CURRENT
+    frame at construction).  ``plane`` ('xy' | 'yz' | 'xz') restricts
+    the shift to that plane."""
+
+    _PLANES = {"xy": (0, 1), "yz": (1, 2), "xz": (0, 2)}
+
+    def __init__(self, ag, reference, plane: str | None = None,
+                 weights: str | None = None):
+        if plane is not None and plane not in self._PLANES:
+            raise ValueError(
+                f"plane must be one of {sorted(self._PLANES)}, got {plane!r}")
+        if weights not in (None, "mass"):
+            raise ValueError(f"weights must be None or 'mass', got {weights!r}")
+        self._ag = ag
+        self._center = "mass" if weights == "mass" else "geometry"
+        ref_u = reference.universe
+        self._ref_center = _group_center(
+            reference, ref_u.trajectory.ts.positions, self._center)
+        self._dims = (self._PLANES[plane] if plane is not None
+                      else (0, 1, 2))
+
+    def __call__(self, ts):
+        shift = np.zeros(3)
+        full = self._ref_center - _group_center(
+            self._ag, ts.positions, self._center)
+        for d in self._dims:
+            shift[d] = full[d]
+        ts.positions = (ts.positions.astype(np.float64) + shift
+                        ).astype(np.float32)
+        return ts
+
+
+class fit_rot_trans(TransformationBase):
+    """Least-squares-superpose each frame onto a reference: rotation fit
+    on ``ag``, transform applied to ALL atoms — the reference program's
+    per-frame body (RMSF.py:94-101) as a reusable transformation.
+    Reference coordinates are captured from the reference universe's
+    CURRENT frame at construction."""
+
+    def __init__(self, ag, reference, weights: str | None = None):
+        if weights not in (None, "mass"):
+            raise ValueError(f"weights must be None or 'mass', got {weights!r}")
+        if reference.n_atoms != ag.n_atoms:
+            raise ValueError(
+                f"fit group has {ag.n_atoms} atoms, reference "
+                f"{reference.n_atoms}")
+        self._ag = ag
+        w = ag.masses if weights == "mass" else np.ones(ag.n_atoms)
+        self._w = w
+        ref_u = reference.universe
+        ref = ref_u.trajectory.ts.positions[reference.indices].astype(
+            np.float64)
+        com = host.weighted_center(ref, w)
+        self._ref_c = ref - com
+        self._ref_com = com
+
+    def __call__(self, ts):
+        ts.positions = host.superpose_frame(
+            ts.positions, self._ag.indices, self._w,
+            self._ref_c, self._ref_com).astype(np.float32)
+        return ts
+
+
+class wrap(TransformationBase):
+    """Wrap ``ag``'s atoms into the primary unit cell every frame
+    (upstream ``transformations.wrap``; per-atom, like
+    ``AtomGroup.wrap``)."""
+
+    def __init__(self, ag):
+        self._ag = ag
+
+    def __call__(self, ts):
+        from mdanalysis_mpi_tpu.core.box import box_to_vectors, wrap_positions
+
+        dim = _require_box(ts, "wrap")
+        m = box_to_vectors(dim)
+        idx = self._ag.indices
+        ts.positions[idx] = wrap_positions(
+            ts.positions[idx], m).astype(np.float32)
+        return ts

@@ -1,0 +1,166 @@
+"""On-the-fly trajectory transformations: per-frame semantics, reader
+fast-path fallback (fused decode/gather must see transformed frames),
+analysis-backend parity through a transformed reader."""
+
+import numpy as np
+import pytest
+
+from mdanalysis_mpi_tpu import transformations as trf
+from mdanalysis_mpi_tpu.core.topology import make_protein_topology
+from mdanalysis_mpi_tpu.core.universe import Universe
+from mdanalysis_mpi_tpu.io.memory import MemoryReader
+from mdanalysis_mpi_tpu.io.xtc import XTCReader, write_xtc
+from mdanalysis_mpi_tpu.testing import make_protein_universe
+
+
+def _boxed_universe(n_frames=6, box=30.0):
+    u = make_protein_universe(n_residues=4, n_frames=n_frames, box=box)
+    return u
+
+
+class TestTransformations:
+    def test_translate(self):
+        u = make_protein_universe(n_residues=3, n_frames=4)
+        raw = u.trajectory[1].positions.copy()
+        u.trajectory.add_transformations(trf.translate([1.0, -2.0, 0.5]))
+        got = u.trajectory[1].positions
+        np.testing.assert_allclose(got, raw + [1.0, -2.0, 0.5], atol=1e-5)
+
+    def test_center_in_box(self):
+        u = _boxed_universe()
+        ca = u.select_atoms("name CA")
+        u.trajectory.add_transformations(trf.center_in_box(ca))
+        for ts in u.trajectory:
+            center = ts.positions[ca.indices].mean(axis=0)
+            np.testing.assert_allclose(center, [15.0, 15.0, 15.0], atol=1e-3)
+
+    def test_center_in_box_mass_and_point(self):
+        u = _boxed_universe()
+        ca = u.select_atoms("name CA")
+        u.trajectory.add_transformations(
+            trf.center_in_box(ca, center="mass", point=[5.0, 5.0, 5.0]))
+        ts = u.trajectory[0]
+        w = ca.masses
+        com = (w[:, None] * ts.positions[ca.indices]).sum(0) / w.sum()
+        np.testing.assert_allclose(com, [5.0, 5.0, 5.0], atol=1e-3)
+
+    def test_fit_rot_trans_freezes_rigid_motion(self):
+        u = make_protein_universe(n_residues=4, n_frames=8, noise=0.0,
+                                  rigid_motion=True)
+        ref = make_protein_universe(n_residues=4, n_frames=8, noise=0.0,
+                                    rigid_motion=True)
+        ca = u.select_atoms("name CA")
+        ref_ca = ref.select_atoms("name CA")
+        ref.trajectory[0]
+        u.trajectory.add_transformations(trf.fit_rot_trans(ca, ref_ca))
+        first = u.trajectory[0].positions.copy()
+        for ts in u.trajectory:
+            np.testing.assert_allclose(ts.positions, first, atol=1e-3)
+
+    def test_fit_translation_plane(self):
+        u = make_protein_universe(n_residues=3, n_frames=4)
+        ref = make_protein_universe(n_residues=3, n_frames=4)
+        ca, ref_ca = u.select_atoms("name CA"), ref.select_atoms("name CA")
+        ref.trajectory[0]
+        u.trajectory.add_transformations(
+            trf.fit_translation(ca, ref_ca, plane="xy"))
+        ref_c = ref.trajectory.ts.positions[ref_ca.indices].mean(0)
+        for i in (0, 3):
+            got_c = u.trajectory[i].positions[ca.indices].mean(0)
+            np.testing.assert_allclose(got_c[:2], ref_c[:2], atol=1e-4)
+
+    def test_wrap(self):
+        u = _boxed_universe(box=20.0)
+        ag = u.atoms
+        u.trajectory.add_transformations(trf.translate([25.0, 0, 0]),
+                                         trf.wrap(ag))
+        ts = u.trajectory[0]
+        assert (ts.positions[:, 0] >= 0).all()
+        assert (ts.positions[:, 0] < 20.0 + 1e-4).all()
+
+    def test_center_in_box_wrap_only_affects_center(self):
+        """wrap=True must not rewrite atom positions (upstream
+        inplace=False): relative geometry is preserved exactly."""
+        u = _boxed_universe(box=20.0)
+        ca = u.select_atoms("name CA")
+        raw = u.trajectory[0].positions.copy()
+        u.trajectory.add_transformations(
+            trf.translate([30.0, 0, 0]),       # push out of the cell
+            trf.center_in_box(ca, wrap=True))
+        got = u.trajectory[0].positions
+        rel_raw = raw - raw[0]
+        rel_got = got - got[0]
+        np.testing.assert_allclose(rel_got, rel_raw, atol=1e-3)
+
+    def test_copy_carries_transformations(self):
+        u = make_protein_universe(n_residues=3, n_frames=4)
+        u.trajectory.add_transformations(trf.translate([1.0, 0, 0]))
+        u2 = u.copy()
+        np.testing.assert_allclose(u2.trajectory[1].positions,
+                                   u.trajectory[1].positions, atol=1e-5)
+
+    def test_add_twice_raises(self):
+        u = make_protein_universe(n_residues=3, n_frames=2)
+        u.trajectory.add_transformations(trf.translate([1, 0, 0]))
+        with pytest.raises(ValueError, match="once"):
+            u.trajectory.add_transformations(trf.translate([0, 1, 0]))
+
+    def test_universe_constructor_kwarg(self):
+        u0 = make_protein_universe(n_residues=3, n_frames=2)
+        block, _ = u0.trajectory.read_block(0, 2)
+        u = Universe(u0.topology, MemoryReader(block),
+                     transformations=trf.translate([0, 0, 3.0]))
+        np.testing.assert_allclose(
+            u.trajectory[0].positions, block[0] + [0, 0, 3.0], atol=1e-5)
+
+
+class TestReaderFallback:
+    """Fused block/stage paths must yield transformed frames too."""
+
+    def _xtc_universe(self, tmp_path):
+        u0 = make_protein_universe(n_residues=4, n_frames=8)
+        block, _ = u0.trajectory.read_block(0, 8)
+        path = str(tmp_path / "t.xtc")
+        write_xtc(path, block)
+        return Universe(u0.topology, XTCReader(path))
+
+    def test_xtc_read_block_sees_transform(self, tmp_path):
+        u = self._xtc_universe(tmp_path)
+        u.trajectory.add_transformations(trf.translate([2.0, 0, 0]))
+        per_frame = np.stack(
+            [u.trajectory[i].positions for i in range(8)])
+        sel = u.select_atoms("name CA").indices
+        block, _ = u.trajectory.read_block(0, 8, sel=sel)
+        np.testing.assert_allclose(block, per_frame[:, sel], atol=1e-5)
+
+    def test_xtc_stage_block_quantize_sees_transform(self, tmp_path):
+        u = self._xtc_universe(tmp_path)
+        u.trajectory.add_transformations(trf.translate([2.0, 0, 0]))
+        sel = u.select_atoms("name CA").indices
+        q, boxes, inv = u.trajectory.stage_block(0, 8, sel=sel,
+                                                 quantize=True)
+        ref, _ = u.trajectory.read_block(0, 8, sel=sel)
+        np.testing.assert_allclose(q.astype(np.float32) * inv, ref,
+                                   atol=2.0 * float(inv))
+
+    def test_memory_stage_block_sees_transform(self):
+        u = make_protein_universe(n_residues=4, n_frames=6)
+        u.trajectory.add_transformations(trf.translate([0, 5.0, 0]))
+        sel = u.select_atoms("name CA").indices
+        block, _, _ = u.trajectory.stage_block(0, 6, sel=sel)
+        per_frame = np.stack(
+            [u.trajectory[i].positions[sel] for i in range(6)])
+        np.testing.assert_allclose(block, per_frame, atol=1e-5)
+
+    def test_analysis_parity_through_transformed_reader(self):
+        from mdanalysis_mpi_tpu.analysis import RMSF
+
+        u_s = make_protein_universe(n_residues=4, n_frames=12, noise=0.3)
+        u_j = make_protein_universe(n_residues=4, n_frames=12, noise=0.3)
+        for u in (u_s, u_j):
+            u.trajectory.add_transformations(trf.translate([1.0, 2.0, 3.0]))
+        s = RMSF(u_s.select_atoms("name CA")).run(backend="serial")
+        j = RMSF(u_j.select_atoms("name CA")).run(backend="jax",
+                                                  batch_size=4)
+        np.testing.assert_allclose(np.asarray(j.results.rmsf),
+                                   s.results.rmsf, atol=1e-4)
