@@ -23,8 +23,11 @@ from mdanalysis_mpi_tpu.analysis.distances import ContactMap, PairwiseDistances
 from mdanalysis_mpi_tpu.analysis.rgyr import RadiusOfGyration
 from mdanalysis_mpi_tpu.analysis.pca import PCA
 from mdanalysis_mpi_tpu.analysis.msd import EinsteinMSD
+from mdanalysis_mpi_tpu.analysis.dihedrals import Dihedral, Ramachandran
+from mdanalysis_mpi_tpu.analysis.contacts import Contacts
 
 __all__ = ["AnalysisBase", "Results", "RMSF", "RMSD", "AlignedRMSF",
            "AverageStructure", "AlignTraj", "alignto", "rotation_matrix",
            "InterRDF", "ContactMap",
-           "PairwiseDistances", "RadiusOfGyration", "PCA", "EinsteinMSD"]
+           "PairwiseDistances", "RadiusOfGyration", "PCA", "EinsteinMSD",
+           "Dihedral", "Ramachandran", "Contacts"]

@@ -1,0 +1,183 @@
+"""Dihedral-angle time series: Dihedral and Ramachandran.
+
+Upstream-API mirror (``MDAnalysis.analysis.dihedrals``):
+``Dihedral([ag1, ag2, ...]).run()`` → ``results.angles`` (T, K) for K
+four-atom groups, and ``Ramachandran(ag).run()`` → ``results.angles``
+(T, n_res, 2) φ/ψ backbone angles.  The reference program has no
+dihedral analysis; this plugs the upstream surface into the
+AnalysisBase executor layer.
+
+TPU-first shape: a *time-series* analysis like RMSD — only the union of
+the quadruples' atoms is staged (K dihedrals touch ≤ 4K atoms no matter
+how big the system), and all K angles of a frame batch come from one
+vectorized gather + cross-product + atan2 kernel
+(:mod:`mdanalysis_mpi_tpu.ops.dihedrals`), psum-free, concatenated on
+device in frame order.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mdanalysis_mpi_tpu.analysis.base import AnalysisBase, Deferred
+from mdanalysis_mpi_tpu.ops.dihedrals import dihedral_batch, dihedral_batch_np
+
+
+# ---- module-level batch kernel (stable identity → cached compiles) ----
+
+def _dihedral_kernel(params, batch, boxes, mask):
+    del boxes
+    (quads,) = params
+    return (dihedral_batch(batch, quads) * mask[:, None], mask)
+
+
+class Dihedral(AnalysisBase):
+    """``Dihedral([ag, ...]).run().results.angles`` — each AtomGroup is
+    one dihedral: exactly 4 atoms, in order."""
+
+    def __init__(self, atomgroups, verbose: bool = False):
+        atomgroups = list(atomgroups)
+        if not atomgroups:
+            raise ValueError("need at least one 4-atom AtomGroup")
+        u = atomgroups[0].universe
+        for i, ag in enumerate(atomgroups):
+            if ag.n_atoms != 4:
+                raise ValueError(
+                    f"atomgroup {i} has {ag.n_atoms} atoms; a dihedral "
+                    "needs exactly 4 (in order)")
+            if ag.universe is not u:
+                raise ValueError("all atomgroups must share one universe")
+        super().__init__(u, verbose)
+        self._quads_global = np.stack([ag.indices for ag in atomgroups])
+
+    def _prepare(self):
+        # stage only the union of involved atoms; quads become slot
+        # indices into that staged selection
+        uniq, inv = np.unique(self._quads_global, return_inverse=True)
+        self._idx = uniq
+        self._quads = inv.reshape(self._quads_global.shape).astype(np.int32)
+        self._serial_rows = []
+
+    # -- serial path --
+
+    def _single_frame(self, ts):
+        self._serial_rows.append(dihedral_batch_np(
+            ts.positions[self._idx][None], self._quads)[0])
+
+    def _serial_summary(self):
+        k = len(self._quads)
+        rows = (np.stack(self._serial_rows) if self._serial_rows
+                else np.empty((0, k)))
+        return (rows, np.ones(len(rows)))
+
+    # -- batch path --
+
+    def _batch_select(self):
+        return self._idx
+
+    def _batch_fn(self):
+        return _dihedral_kernel
+
+    def _batch_params(self):
+        import jax.numpy as jnp
+
+        return (jnp.asarray(self._quads),)
+
+    _device_combine = None      # time series, concatenated in frame order
+
+    def _identity_partials(self):
+        return (np.empty((0, len(self._quads))), np.empty(0))
+
+    def _conclude(self, total):
+        vals, mask = total
+
+        def _finalize():
+            return np.asarray(vals)[np.asarray(mask) > 0.5]
+
+        self.results.angles = Deferred(_finalize)
+
+
+def _phi_psi_quads(ag):
+    """Backbone (C_prev, N, CA, C) / (N, CA, C, N_next) quadruples for
+    every residue OF ``ag`` whose chain neighbors exist in the
+    UNIVERSE (upstream semantics: a selection of resids 5-10 still gets
+    angles for 5 and 10 by fetching resid 4's C and 11's N from the
+    universe).  Neighbors must be the same segment AND resid-contiguous
+    (a ±1 resid step — unresolved-loop gaps in a chain must not produce
+    gap-spanning pseudo-bonds).  Returns (phi (R, 4), psi (R, 4),
+    resindices (R,))."""
+    u = ag.universe
+    t = u.topology
+    if len(ag.indices) == 0 or not t.is_protein[ag.indices].any():
+        raise ValueError("Ramachandran needs protein atoms")
+    wanted = set(int(r) for r in np.unique(
+        t.resindices[ag.indices[t.is_protein[ag.indices]]]))
+    # backbone atom map over the WHOLE universe (neighbor lookups may
+    # leave the selection)
+    prot = np.flatnonzero(t.is_protein)
+    atoms: dict[int, dict] = {}
+    for g in prot:
+        n = t.names[g]
+        if n in ("N", "CA", "C"):
+            atoms.setdefault(int(t.resindices[g]), {})[n] = int(g)
+    segs = (t.segids if t.segids is not None
+            else np.zeros(t.n_atoms, dtype="U1"))
+
+    def _meta(r):
+        d = atoms.get(r, {})
+        ca = d.get("CA")
+        if ca is None:                       # explicit: atom index 0 is falsy
+            ca = next(iter(d.values()), None)
+        return (None, None) if ca is None else (segs[ca], int(t.resids[ca]))
+
+    phi, psi, rows = [], [], []
+    for r in sorted(wanted):
+        cur, prev, nxt = atoms.get(r, {}), atoms.get(r - 1), atoms.get(r + 1)
+        if not all(k in cur for k in ("N", "CA", "C")):
+            continue
+        if prev is None or nxt is None or "C" not in prev \
+                or "N" not in nxt:
+            continue           # chain termini have no phi/psi pair
+        seg, rid = _meta(r)
+        seg_p, rid_p = _meta(r - 1)
+        seg_n, rid_n = _meta(r + 1)
+        # same chain AND contiguous resids (gap check)
+        if seg_p != seg or seg_n != seg:
+            continue
+        if rid_p != rid - 1 or rid_n != rid + 1:
+            continue
+        phi.append((prev["C"], cur["N"], cur["CA"], cur["C"]))
+        psi.append((cur["N"], cur["CA"], cur["C"], nxt["N"]))
+        rows.append(r)
+    if not phi:
+        raise ValueError(
+            "no residue in the selection has complete (C_prev, N, CA, C, "
+            "N_next) backbone atoms")
+    return (np.asarray(phi, np.int64), np.asarray(psi, np.int64),
+            np.asarray(rows))
+
+
+class Ramachandran(Dihedral):
+    """``Ramachandran(u.select_atoms('protein')).run()`` →
+    ``results.angles`` (T, R, 2): φ/ψ per interior residue per frame."""
+
+    def __init__(self, atomgroup, verbose: bool = False):
+        phi, psi, rows = _phi_psi_quads(atomgroup)
+        self._n_res = len(rows)
+        self.resindices = rows
+        AnalysisBase.__init__(self, atomgroup.universe, verbose)
+        # interleave (phi_0, psi_0, phi_1, ...) so the base Dihedral
+        # machinery computes them all in one kernel call
+        self._quads_global = np.empty((2 * self._n_res, 4), np.int64)
+        self._quads_global[0::2] = phi
+        self._quads_global[1::2] = psi
+
+    def _conclude(self, total):
+        vals, mask = total
+        n_res = self._n_res
+
+        def _finalize():
+            flat = np.asarray(vals)[np.asarray(mask) > 0.5]
+            return flat.reshape(len(flat), n_res, 2)
+
+        self.results.angles = Deferred(_finalize)

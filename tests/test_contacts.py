@@ -1,0 +1,103 @@
+"""Native-contacts (q) analysis: reference-pair construction, hard/soft
+scoring, PBC, backend parity."""
+
+import numpy as np
+import pytest
+
+from mdanalysis_mpi_tpu.analysis.contacts import (
+    Contacts, hard_cut_q, soft_cut_q,
+)
+from mdanalysis_mpi_tpu.core.topology import make_protein_topology
+from mdanalysis_mpi_tpu.core.universe import Universe
+from mdanalysis_mpi_tpu.io.memory import MemoryReader
+from mdanalysis_mpi_tpu.testing import make_protein_universe
+
+
+def _universe(n_frames=10, noise=0.2, box=None):
+    return make_protein_universe(n_residues=6, n_frames=n_frames,
+                                 noise=noise, box=box)
+
+
+def _contacts(u, ref=None, **kw):
+    ref = ref if ref is not None else u
+    ref.trajectory[0]
+    kw.setdefault("radius", 6.0)
+    return Contacts(
+        u, select=("name CA", "name CB"),
+        refgroup=(ref.select_atoms("name CA"), ref.select_atoms("name CB")),
+        **kw)
+
+
+class TestContacts:
+    def test_reference_frame_scores_one(self):
+        u = _universe(noise=0.0, n_frames=4)
+        c = _contacts(u).run(backend="serial")
+        ts = c.results.timeseries
+        assert ts.shape == (4, 2)
+        # rigid motion only: every native contact survives every frame
+        np.testing.assert_allclose(ts[:, 1], 1.0, atol=1e-12)
+        assert c.n_initial_contacts > 0
+
+    @pytest.mark.parametrize("method", ["hard_cut", "soft_cut"])
+    @pytest.mark.parametrize("backend", ["jax", "mesh"])
+    def test_backend_parity(self, method, backend):
+        u = _universe(noise=0.5, n_frames=12)
+        s = _contacts(u, method=method).run(backend="serial")
+        j = _contacts(u, method=method).run(backend=backend, batch_size=4)
+        np.testing.assert_allclose(j.results.timeseries[:, 1],
+                                   s.results.timeseries[:, 1], atol=5e-3)
+
+    def test_frame_column_respects_step(self):
+        u = _universe(n_frames=12)
+        c = _contacts(u).run(start=2, stop=12, step=3, backend="serial")
+        np.testing.assert_array_equal(c.results.timeseries[:, 0],
+                                      [2, 5, 8, 11])
+
+    def test_pbc_contact_across_boundary(self):
+        """Two atoms 1 Å apart through the boundary of a 20 Å box must
+        be a native contact under PBC."""
+        top = make_protein_topology(1, atoms_per_residue=("CA", "CB"))
+        pos = np.array([[[0.5, 10.0, 10.0], [19.5, 10.0, 10.0]]],
+                       np.float32)
+        dims = np.array([20.0, 20, 20, 90, 90, 90], np.float32)
+        u = Universe(top, MemoryReader(pos, dimensions=dims))
+        c = Contacts(u, select=("name CA", "name CB"),
+                     refgroup=(u.select_atoms("name CA"),
+                               u.select_atoms("name CB")), radius=4.5)
+        assert c.n_initial_contacts == 1
+        assert abs(c.r0[0] - 1.0) < 1e-5
+        r = c.run(backend="jax", batch_size=2)
+        np.testing.assert_allclose(r.results.timeseries[:, 1], 1.0)
+
+    def test_callable_method_serial_only(self):
+        u = _universe(n_frames=4)
+
+        def radius_count(r, r0, **kw):
+            return r < r0 * 1.5
+
+        c = _contacts(u, method=radius_count).run(backend="serial")
+        assert c.results.timeseries.shape == (4, 2)
+        with pytest.raises(ValueError, match="serial"):
+            _contacts(u, method=radius_count).run(backend="jax",
+                                                  batch_size=2)
+
+    def test_validation(self):
+        u = _universe(n_frames=2)
+        with pytest.raises(ValueError, match="method"):
+            _contacts(u, method="bogus")
+        with pytest.raises(ValueError, match="sizes"):
+            Contacts(u, select=("name CA", "name CA"),
+                     refgroup=(u.select_atoms("name CA"),
+                               u.select_atoms("name CB and resid 1")))
+        with pytest.raises(ValueError, match="no native contacts"):
+            _contacts(u, radius=1e-6)
+
+    def test_q_functions(self):
+        r = np.array([1.0, 5.0, 7.0])
+        r0 = np.array([1.0, 5.0, 7.0])
+        np.testing.assert_array_equal(hard_cut_q(r, r0, 6.0),
+                                      [True, True, False])
+        q = soft_cut_q(r, r0)
+        assert (q > 0.9).all()       # r == r0 < lambda*r0 -> near 1
+        far = soft_cut_q(np.array([20.0]), np.array([1.0]))
+        assert far[0] < 1e-6         # broken contact -> ~0

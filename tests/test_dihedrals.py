@@ -1,0 +1,128 @@
+"""Dihedral/Ramachandran: analytic angles, backend parity, topology
+quad construction."""
+
+import numpy as np
+import pytest
+
+from mdanalysis_mpi_tpu.analysis.dihedrals import Dihedral, Ramachandran
+from mdanalysis_mpi_tpu.core.groups import AtomGroup
+from mdanalysis_mpi_tpu.core.universe import Universe
+from mdanalysis_mpi_tpu.io.memory import MemoryReader
+from mdanalysis_mpi_tpu.ops.dihedrals import dihedral_batch_np
+from mdanalysis_mpi_tpu.testing import make_protein_universe
+
+
+def _angle_fixture(theta_deg):
+    """Four atoms with dihedral exactly theta (b2 along z)."""
+    th = np.radians(theta_deg)
+    return np.array([
+        [1.0, 0.0, 0.0],
+        [0.0, 0.0, 0.0],
+        [0.0, 0.0, 1.0],
+        [np.cos(th), np.sin(th), 1.0],
+    ], dtype=np.float64)
+
+
+class TestKernel:
+    @pytest.mark.parametrize("theta", [0.0, 45.0, 90.0, 135.0, 180.0,
+                                       -60.0, -120.0])
+    def test_analytic_angles(self, theta):
+        pos = _angle_fixture(theta)[None]
+        got = float(dihedral_batch_np(pos, np.array([[0, 1, 2, 3]]))[0, 0])
+        want = ((theta + 180.0) % 360.0) - 180.0
+        if abs(abs(want) - 180.0) < 1e-9:
+            assert abs(abs(got) - 180.0) < 1e-6
+        else:
+            assert abs(got - want) < 1e-6, (got, want)
+
+    def test_jax_matches_numpy(self):
+        import jax.numpy as jnp
+
+        from mdanalysis_mpi_tpu.ops.dihedrals import dihedral_batch
+
+        rng = np.random.default_rng(2)
+        pos = rng.normal(size=(5, 12, 3)).astype(np.float32)
+        quads = rng.integers(0, 12, size=(7, 4)).astype(np.int32)
+        a = dihedral_batch_np(pos, quads)
+        b = np.asarray(dihedral_batch(jnp.asarray(pos), jnp.asarray(quads)))
+        np.testing.assert_allclose(a, b, atol=1e-2)
+
+
+class TestDihedral:
+    def _universe(self, n_frames=10):
+        return make_protein_universe(n_residues=6, n_frames=n_frames,
+                                     noise=0.3)
+
+    def _groups(self, u, k=3):
+        rng = np.random.default_rng(1)
+        n = u.atoms.n_atoms
+        return [AtomGroup(u, rng.choice(n, 4, replace=False))
+                for _ in range(k)]
+
+    @pytest.mark.parametrize("backend", ["jax", "mesh"])
+    def test_backend_parity(self, backend):
+        u = self._universe()
+        groups = self._groups(u)
+        s = Dihedral(groups).run(backend="serial")
+        j = Dihedral(groups).run(backend=backend, batch_size=4)
+        assert s.results.angles.shape == (10, 3)
+        np.testing.assert_allclose(j.results.angles, s.results.angles,
+                                   atol=0.15)
+
+    def test_validation(self):
+        u = self._universe()
+        with pytest.raises(ValueError, match="at least one"):
+            Dihedral([])
+        with pytest.raises(ValueError, match="exactly 4"):
+            Dihedral([u.select_atoms("name CA")])
+
+
+class TestRamachandran:
+    def test_shapes_and_termini(self):
+        u = make_protein_universe(n_residues=8, n_frames=6, noise=0.2)
+        r = Ramachandran(u.select_atoms("protein")).run(backend="serial")
+        # interior residues only: 8 - 2 termini
+        assert r.results.angles.shape == (6, 6, 2)
+        assert len(r.resindices) == 6
+
+    def test_backend_parity(self):
+        u = make_protein_universe(n_residues=8, n_frames=8, noise=0.2)
+        s = Ramachandran(u.select_atoms("protein")).run(backend="serial")
+        j = Ramachandran(u.select_atoms("protein")).run(
+            backend="jax", batch_size=4)
+        np.testing.assert_allclose(j.results.angles, s.results.angles,
+                                   atol=0.15)
+
+    def test_selection_window_pulls_neighbors_from_universe(self):
+        """resid 3-6 of an 8-residue chain: all four residues get
+        angles (neighbors fetched outside the selection, upstream
+        semantics)."""
+        u = make_protein_universe(n_residues=8, n_frames=3, noise=0.2)
+        r = Ramachandran(
+            u.select_atoms("protein and resid 3:6")).run(backend="serial")
+        assert r.results.angles.shape == (3, 4, 2)
+
+    def test_resid_gap_breaks_adjacency(self):
+        """A chain with resids ...3, 20, 21... must not span the gap."""
+        from mdanalysis_mpi_tpu.core.topology import Topology
+
+        per = ("N", "CA", "C")
+        resids = [1, 2, 3, 20, 21, 22]
+        names = np.array(per * len(resids))
+        rr = np.repeat(resids, len(per))
+        top = Topology(names=names, resnames=np.full(len(names), "ALA"),
+                       resids=rr, segids=np.full(len(names), "A"))
+        rng = np.random.default_rng(0)
+        pos = rng.normal(scale=5.0,
+                         size=(2, top.n_atoms, 3)).astype(np.float32)
+        u = Universe(top, MemoryReader(pos))
+        r = Ramachandran(u.atoms).run(backend="serial")
+        # only resids 2 and 21 are interior AND contiguous
+        assert r.results.angles.shape == (2, 2, 2)
+
+    def test_needs_protein(self):
+        from mdanalysis_mpi_tpu.testing import make_water_universe
+
+        w = make_water_universe(n_waters=5, n_frames=2)
+        with pytest.raises(ValueError, match="protein"):
+            Ramachandran(w.atoms)
