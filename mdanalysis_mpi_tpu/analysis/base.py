@@ -238,8 +238,12 @@ class AnalysisBase:
         from mdanalysis_mpi_tpu.utils.timers import TIMERS
 
         t0 = time.perf_counter()
-        frames = self._frames(start, stop, step, frames)
+        frames = list(self._frames(start, stop, step, frames))
         self.n_frames = len(frames)
+        # the resolved frame list, readable from _prepare/_conclude
+        # (analyses that need frame numbers — time-series frame columns,
+        # first-frame-derived grids — use this instead of re-deriving)
+        self._frame_indices = frames
         executor = get_executor(backend, **executor_kwargs)
         with TIMERS.phase("prepare"):
             self._prepare()

@@ -187,14 +187,10 @@ class Contacts(AnalysisBase):
 
     def _conclude(self, total):
         q, mask = total
-        frames = np.asarray(self._run_frames, dtype=np.float64)
+        frames = np.asarray(self._frame_indices, dtype=np.float64)
 
         def _finalize():
             qv = np.asarray(q)[np.asarray(mask) > 0.5]
             return np.column_stack([frames[: len(qv)], qv])
 
         self.results.timeseries = Deferred(_finalize)
-
-    def run(self, start=None, stop=None, step=None, frames=None, **kwargs):
-        self._run_frames = list(self._frames(start, stop, step, frames))
-        return super().run(start, stop, step, frames=frames, **kwargs)

@@ -24,7 +24,8 @@ import time
 import numpy as np
 
 ANALYSES = ("rmsf", "aligned-rmsf", "rmsd", "average-structure", "rdf",
-            "contacts", "pairwise-distances", "rgyr", "pca", "msd")
+            "contacts", "pairwise-distances", "rgyr", "pca", "msd",
+            "ramachandran", "density")
 
 
 @dataclasses.dataclass
@@ -50,6 +51,7 @@ class AnalysisConfig:
     align: bool = False                 # pca: superpose onto the mean
     n_components: int | None = None     # pca
     msd_type: str = "xyz"               # msd dimensions
+    delta: float = 1.0                  # density grid spacing (Å)
     output: str | None = None
 
     def validate(self) -> None:
@@ -93,6 +95,11 @@ def build_analysis(cfg: AnalysisConfig, universe=None):
                        n_components=cfg.n_components)
     if cfg.analysis == "msd":
         return ana.EinsteinMSD(u, select=cfg.select, msd_type=cfg.msd_type)
+    if cfg.analysis == "ramachandran":
+        return ana.Ramachandran(u.select_atoms(cfg.select))
+    if cfg.analysis == "density":
+        return ana.DensityAnalysis(u.select_atoms(cfg.select),
+                                   delta=cfg.delta)
     raise AssertionError(cfg.analysis)
 
 
@@ -140,6 +147,8 @@ def _parser() -> argparse.ArgumentParser:
     p.add_argument("--n-components", type=int, default=None)
     p.add_argument("--msd-type", default="xyz",
                    choices=("xyz", "xy", "xz", "yz", "x", "y", "z"))
+    p.add_argument("--delta", type=float, default=1.0,
+                   help="density grid spacing in Å")
     p.add_argument("--output", default=None, help="write results to .npz")
     p.add_argument("--trace", default=None, metavar="DIR",
                    help="write a jax.profiler trace (TensorBoard format) "
@@ -158,7 +167,7 @@ def main(argv=None) -> int:
         batch_size=ns.batch_size, transfer_dtype=ns.transfer_dtype,
         nbins=ns.nbins, r_max=ns.r_max, cutoff=ns.cutoff, output=ns.output,
         engine=ns.engine, align=ns.align, n_components=ns.n_components,
-        msd_type=ns.msd_type)
+        msd_type=ns.msd_type, delta=ns.delta)
     from mdanalysis_mpi_tpu.utils.timers import device_trace
 
     TIMERS.reset()
@@ -171,9 +180,20 @@ def main(argv=None) -> int:
         # end-to-end number
         a.results.materialize()
     wall = time.perf_counter() - t0
-    arrays = {k: np.asarray(v) for k, v in a.results.items()
-              if isinstance(v, (np.ndarray, list, tuple, float, int))
-              or hasattr(v, "shape")}
+    arrays = {}
+    for k, v in a.results.items():
+        if isinstance(v, (list, tuple)) and any(
+                hasattr(x, "shape") for x in v):
+            # containers of arrays (e.g. the per-axis `edges` list) are
+            # excluded CONSISTENTLY — for some shapes np.asarray would
+            # succeed and for others not, which would make the npz key
+            # set depend on the data; such results carry homogeneous
+            # per-key twins (edges_x/y/z) instead
+            continue
+        if not (isinstance(v, (np.ndarray, list, tuple, float, int))
+                or hasattr(v, "shape")):
+            continue
+        arrays[k] = np.asarray(v)
     if cfg.output:
         np.savez(cfg.output, **arrays)
     print(json.dumps({
