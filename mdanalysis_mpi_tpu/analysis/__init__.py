@@ -26,9 +26,11 @@ from mdanalysis_mpi_tpu.analysis.msd import EinsteinMSD
 from mdanalysis_mpi_tpu.analysis.dihedrals import Dihedral, Ramachandran
 from mdanalysis_mpi_tpu.analysis.contacts import Contacts
 from mdanalysis_mpi_tpu.analysis.density import DensityAnalysis
+from mdanalysis_mpi_tpu.analysis.hbonds import HydrogenBondAnalysis
 
 __all__ = ["AnalysisBase", "Results", "RMSF", "RMSD", "AlignedRMSF",
            "AverageStructure", "AlignTraj", "alignto", "rotation_matrix",
            "InterRDF", "ContactMap",
            "PairwiseDistances", "RadiusOfGyration", "PCA", "EinsteinMSD",
-           "Dihedral", "Ramachandran", "Contacts", "DensityAnalysis"]
+           "Dihedral", "Ramachandran", "Contacts", "DensityAnalysis",
+           "HydrogenBondAnalysis"]

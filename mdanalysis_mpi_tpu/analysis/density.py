@@ -96,9 +96,12 @@ class DensityAnalysis(AnalysisBase):
             raise ValueError("selection matched no atoms")
         d = self._delta
         if self._gridcenter is not None:
-            half = np.array([x / 2.0 for x in self._userdims])
-            origin = self._gridcenter - half
-            shape = np.maximum(np.ceil(2 * half / d), 1).astype(int)
+            dims = np.array([float(x) for x in self._userdims])
+            shape = np.maximum(np.ceil(dims / d), 1).astype(int)
+            # origin from the ROUNDED extent, so the grid stays centered
+            # on gridcenter even when a dimension is not a multiple of
+            # delta (rounding must grow both sides, not just the high one)
+            origin = self._gridcenter - shape * d / 2.0
         else:
             # derive from the run's first frame + padding (upstream)
             first = self._frame_indices[0] if self._frame_indices else 0

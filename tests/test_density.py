@@ -60,6 +60,19 @@ class TestDensity:
         assert all(len(e) == s + 1
                    for e, s in zip(edges, r.results.grid.shape))
 
+    def test_grid_stays_centered_with_nondivisible_dims(self):
+        """xdim=10, delta=3 -> 4 voxels spanning [-6, 6) around the
+        center, not [-5, 7)."""
+        top = make_water_topology(1)
+        pos = np.zeros((1, 3, 3), np.float32)
+        u = Universe(top, MemoryReader(pos))
+        r = DensityAnalysis(u.select_atoms("name OW"), delta=3.0,
+                            gridcenter=[0.0, 0.0, 0.0],
+                            xdim=10, ydim=10, zdim=10).run(backend="serial")
+        for e in r.results.edges:
+            np.testing.assert_allclose(e[0], -6.0)
+            np.testing.assert_allclose(e[-1], 6.0)
+
     def test_validation(self):
         u = make_water_universe(n_waters=5, n_frames=2)
         ow = u.select_atoms("name OW")

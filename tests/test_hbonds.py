@@ -1,0 +1,125 @@
+"""HydrogenBondAnalysis: analytic dimer geometry, donor pairing (bonds
+and heuristic), backend parity, serial bond table."""
+
+import numpy as np
+import pytest
+
+from mdanalysis_mpi_tpu.analysis.hbonds import HydrogenBondAnalysis
+from mdanalysis_mpi_tpu.core.topology import Topology, make_water_topology
+from mdanalysis_mpi_tpu.core.universe import Universe
+from mdanalysis_mpi_tpu.io.memory import MemoryReader
+from mdanalysis_mpi_tpu.testing import make_water_universe
+
+
+def _dimer(angle_deg=180.0, d_a=2.8):
+    """Water dimer: donor O-H points at the acceptor O along +x; the
+    D-H-A angle is set by tilting the acceptor around the hydrogen."""
+    oh = 0.96
+    h = np.array([oh, 0.0, 0.0])
+    th = np.radians(180.0 - angle_deg)     # 180° = collinear
+    a = h + (d_a - oh) * np.array([np.cos(th), np.sin(th), 0.0])
+    pos = np.stack([
+        [0.0, 0.0, 0.0],                   # OW donor
+        h,                                 # HW1
+        [-0.3, -0.9, 0.0],                 # HW2 (points away)
+        a,                                 # OW acceptor
+        a + [0.76, 0.59, 0.0],             # acceptor's hydrogens
+        a + [-0.76, 0.59, 0.0],
+    ]).astype(np.float32)
+    top = make_water_topology(2)
+    return Universe(top, MemoryReader(pos[None]))
+
+
+class TestDimer:
+    def test_ideal_geometry_is_one_bond(self):
+        u = _dimer(angle_deg=180.0, d_a=2.8)
+        r = HydrogenBondAnalysis(u).run(backend="serial")
+        # donor's HW1 -> acceptor O; acceptor's own H's point away
+        assert r.results.count[0] == 1.0
+        tbl = r.results.hbonds
+        assert tbl.shape == (1, 6)
+        frame, d, h, a, dist, ang = tbl[0]
+        assert (d, h, a) == (0.0, 1.0, 3.0)
+        np.testing.assert_allclose(dist, 2.8, atol=1e-5)
+        np.testing.assert_allclose(ang, 180.0, atol=1e-3)
+
+    def test_bent_geometry_fails_angle(self):
+        u = _dimer(angle_deg=120.0, d_a=2.8)
+        r = HydrogenBondAnalysis(u).run(backend="serial")
+        assert r.results.count[0] == 0.0
+
+    def test_far_geometry_fails_distance(self):
+        u = _dimer(angle_deg=180.0, d_a=3.5)
+        r = HydrogenBondAnalysis(u).run(backend="serial")
+        assert r.results.count[0] == 0.0
+        # ...but a looser cutoff finds it again
+        r2 = HydrogenBondAnalysis(u, d_a_cutoff=4.0).run(backend="serial")
+        assert r2.results.count[0] == 1.0
+
+
+class TestWaterBox:
+    @pytest.mark.parametrize("backend", ["jax", "mesh"])
+    def test_backend_parity(self, backend):
+        u = make_water_universe(n_waters=27, n_frames=8, box=10.0)
+        s = HydrogenBondAnalysis(u).run(backend="serial")
+        j = HydrogenBondAnalysis(u).run(backend=backend, batch_size=4)
+        np.testing.assert_allclose(j.results.count, s.results.count)
+        assert s.results.count.sum() > 0    # a dense box H-bonds
+
+    def test_bonds_pairing_matches_heuristic(self):
+        u = make_water_universe(n_waters=8, n_frames=2, box=8.0)
+        r_heur = HydrogenBondAnalysis(u).run(backend="serial")
+        # same topology WITH explicit bonds
+        t = u.topology
+        bonds = []
+        for w in range(8):
+            o = 3 * w
+            bonds += [(o, o + 1), (o, o + 2)]
+        t2 = Topology(names=t.names, resnames=t.resnames, resids=t.resids,
+                      segids=t.segids, bonds=np.array(bonds))
+        block, _ = u.trajectory.read_block(0, 2)
+        dims = u.trajectory.ts.dimensions
+        u2 = Universe(t2, MemoryReader(block, dimensions=dims))
+        r_bond = HydrogenBondAnalysis(u2).run(backend="serial")
+        np.testing.assert_allclose(r_bond.results.count,
+                                   r_heur.results.count)
+
+    def test_acceptors_selection(self):
+        u = make_water_universe(n_waters=27, n_frames=2, box=10.0)
+        all_acc = HydrogenBondAnalysis(u).run(backend="serial")
+        few = HydrogenBondAnalysis(
+            u, acceptors_sel="name OW and resid 1:5").run(backend="serial")
+        assert few.results.count.sum() <= all_acc.results.count.sum()
+
+    def test_default_guess_excludes_apolar_hydrogens(self):
+        """A C-H pointing straight at an O must NOT count by default
+        (polar-donor filter), but an explicit hydrogens_sel overrides."""
+        names = np.array(["C", "HC", "OW", "HW1", "HW2"])
+        top = Topology(names=names, resnames=np.array(["LIG"] * 2 + ["SOL"] * 3),
+                       resids=np.array([1, 1, 2, 2, 2]),
+                       bonds=np.array([(0, 1), (2, 3), (2, 4)]))
+        pos = np.array([[
+            [0.0, 0.0, 0.0],        # C
+            [1.0, 0.0, 0.0],        # HC aimed at OW
+            [2.8, 0.0, 0.0],        # OW acceptor
+            [3.2, 0.9, 0.0],        # its hydrogens point away
+            [3.2, -0.9, 0.0],
+        ]], np.float32)
+        u = Universe(top, MemoryReader(pos))
+        r = HydrogenBondAnalysis(u).run(backend="serial")
+        assert r.results.count[0] == 0.0
+        r2 = HydrogenBondAnalysis(u, hydrogens_sel="name HC").run(
+            backend="serial")
+        assert r2.results.count[0] == 1.0
+
+    def test_validation(self):
+        u = make_water_universe(n_waters=4, n_frames=1)
+        with pytest.raises(ValueError, match="no atoms"):
+            HydrogenBondAnalysis(u, hydrogens_sel="name XX").run(
+                backend="serial")
+        with pytest.raises(ValueError, match="heavy"):
+            HydrogenBondAnalysis(u, hydrogens_sel="name OW").run(
+                backend="serial")
+        with pytest.raises(ValueError, match="acceptor"):
+            HydrogenBondAnalysis(u, acceptors_sel="name ZZ").run(
+                backend="serial")
