@@ -233,8 +233,35 @@ def _accelerator_or_die(timeout_s: float | None = None) -> int:
     sys.exit(1)
 
 
+def _arm_total_watchdog():
+    """The init watchdog (_accelerator_or_die) cannot catch a tunnel
+    that dies MID-run: any in-flight device_put/execute then blocks
+    forever and the driver records no artifact at all.  A daemon timer
+    emits the parseable error line and hard-exits if the whole bench
+    exceeds BENCH_TOTAL_TIMEOUT seconds (default 2400 — a healthy run
+    takes ~8-12 min including one-time fixture generation)."""
+    import threading
+
+    budget = float(os.environ.get("BENCH_TOTAL_TIMEOUT", "2400"))
+
+    def fire():
+        print(json.dumps({
+            "metric": f"frames/sec/chip, {N_ATOMS}-atom heavy-atom "
+                      f"AlignedRMSF ({N_FRAMES} frames, source={SOURCE})",
+            "value": None, "unit": "frames/s/chip", "vs_baseline": None,
+            "error": f"bench exceeded BENCH_TOTAL_TIMEOUT={budget:.0f}s "
+                     "(tunnel died mid-run?)"}), flush=True)
+        os._exit(2)
+
+    t = threading.Timer(budget, fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
 def main():
     tdtype = os.environ.get("BENCH_TRANSFER", "int16")
+    watchdog = _arm_total_watchdog()
 
     # --- serial NumPy stand-ins for one MPI rank, measured FIRST —
     # before ANY jax/accelerator touch: once the tunnel client starts it
@@ -350,6 +377,7 @@ def main():
         result["cold_vs_file_baseline"] = round(
             cold_fps / file_baseline_fps, 2)
     # "not (err <= tol)": NaN must fail the gate, not sail through it
+    watchdog.cancel()
     if not (err <= 1e-3):
         result["error"] = f"backend divergence {err:.2e} vs serial oracle"
         print(json.dumps(result))
