@@ -133,6 +133,31 @@ class AtomGroup:
         d = self.positions.astype(np.float64) - self.center_of_mass()
         return float(np.sqrt((m * (d ** 2).sum(axis=1)).sum() / m.sum()))
 
+    def moment_of_inertia(self) -> np.ndarray:
+        """Mass-weighted inertia tensor about the COM, float64 (3, 3)
+        (upstream ``AtomGroup.moment_of_inertia``):
+        ``I = Σ mᵢ (|rᵢ|²·E − rᵢrᵢᵀ)`` with rᵢ COM-relative."""
+        m = self.masses
+        r = self.positions.astype(np.float64) - self.center_of_mass()
+        r2 = (r ** 2).sum(axis=1)
+        return (np.eye(3) * (m * r2).sum()
+                - np.einsum("i,ij,ik->jk", m, r, r))
+
+    def principal_axes(self) -> np.ndarray:
+        """Principal axes of inertia as ROWS, ordered from the axis
+        with the HIGHEST moment to the lowest (upstream convention:
+        ``principal_axes()[0]`` is the axis about which rotation is
+        hardest; for a linear molecule that is any axis perpendicular
+        to it, and ``[2]`` is the molecular axis)."""
+        vals, vecs = np.linalg.eigh(self.moment_of_inertia())
+        axes = vecs[:, ::-1].T            # rows, descending eigenvalue
+        # deterministic sign: make each axis' largest component positive
+        for a in axes:
+            k = int(np.argmax(np.abs(a)))
+            if a[k] < 0:
+                a *= -1.0
+        return axes
+
     # ---- residue/segment structure ----
 
     @property

@@ -355,3 +355,46 @@ def test_atomgroup_wrap():
     u2 = Universe(top, pos[None])
     with pytest.raises(ValueError, match="periodic box"):
         u2.atoms.wrap()
+
+
+class TestInertia:
+    """moment_of_inertia / principal_axes (analytic rigid bodies)."""
+
+    def _rod_universe(self, axis=2, n=11):
+        from mdanalysis_mpi_tpu.core.topology import make_water_topology
+        from mdanalysis_mpi_tpu.io.memory import MemoryReader
+
+        top = make_water_topology(n)          # 3n atoms
+        pos = np.zeros((1, 3 * n, 3), np.float32)
+        pos[0, :, axis] = np.linspace(-5, 5, 3 * n)
+        return Universe(top, MemoryReader(pos))
+
+    def test_rod_inertia_structure(self):
+        u = self._rod_universe(axis=2)
+        inertia = u.atoms.moment_of_inertia()
+        assert inertia.shape == (3, 3)
+        # rod along z: I_zz is (numerically) zero, I_xx == I_yy > 0
+        assert abs(inertia[2, 2]) < 1e-8
+        np.testing.assert_allclose(inertia[0, 0], inertia[1, 1])
+        assert inertia[0, 0] > 0
+        # off-diagonals vanish for an axis-aligned rod
+        np.testing.assert_allclose(inertia - np.diag(np.diag(inertia)),
+                                   0.0, atol=1e-8)
+
+    def test_rod_principal_axes(self):
+        u = self._rod_universe(axis=0)        # rod along x
+        axes = u.atoms.principal_axes()
+        assert axes.shape == (3, 3)
+        # lowest-moment axis (row 2) IS the rod direction
+        np.testing.assert_allclose(np.abs(axes[2]), [1.0, 0.0, 0.0],
+                                   atol=1e-10)
+        # rows orthonormal
+        np.testing.assert_allclose(axes @ axes.T, np.eye(3), atol=1e-10)
+
+    def test_parallel_axis_consistency(self):
+        """Inertia is COM-relative: translating the body changes nothing."""
+        u = self._rod_universe()
+        i0 = u.atoms.moment_of_inertia()
+        u.trajectory.ts.positions += np.float32(17.0)
+        np.testing.assert_allclose(u.atoms.moment_of_inertia(), i0,
+                                   rtol=1e-10, atol=1e-6)
