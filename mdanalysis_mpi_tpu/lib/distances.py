@@ -90,6 +90,51 @@ def calc_bonds(coords1, coords2, box=None, backend: str = "numpy") -> np.ndarray
     return np.sqrt((disp ** 2).sum(-1))
 
 
+def calc_angles(coords1, coords2, coords3, box=None) -> np.ndarray:
+    """Angle at the APEX ``coords2`` of each (a, b, c) triple, in
+    RADIANS (upstream ``lib.distances.calc_angles``); minimum-image
+    displacements under ``box``."""
+    from mdanalysis_mpi_tpu.ops import host
+
+    a = np.asarray(coords1, np.float64).reshape(-1, 3)
+    b = np.asarray(coords2, np.float64).reshape(-1, 3)
+    c = np.asarray(coords3, np.float64).reshape(-1, 3)
+    if not (a.shape == b.shape == c.shape):
+        raise ValueError(
+            f"shape mismatch {a.shape} vs {b.shape} vs {c.shape}")
+    dims = _dims_of(box)
+    u = host.minimum_image(a - b, dims)
+    v = host.minimum_image(c - b, dims)
+    num = (u * v).sum(-1)
+    den = np.sqrt((u ** 2).sum(-1) * (v ** 2).sum(-1))
+    return np.arccos(np.clip(num / np.maximum(den, 1e-300), -1.0, 1.0))
+
+
+def calc_dihedrals(coords1, coords2, coords3, coords4, box=None) -> np.ndarray:
+    """Dihedral of each (a, b, c, d) quadruple in RADIANS, IUPAC sign
+    (upstream ``lib.distances.calc_dihedrals``) — the same convention as
+    :mod:`mdanalysis_mpi_tpu.ops.dihedrals`; minimum-image under
+    ``box``."""
+    from mdanalysis_mpi_tpu.ops import host
+
+    p = [np.asarray(x, np.float64).reshape(-1, 3)
+         for x in (coords1, coords2, coords3, coords4)]
+    if not all(x.shape == p[0].shape for x in p):
+        raise ValueError(
+            f"shape mismatch: {[x.shape for x in p]}")
+    dims = _dims_of(box)
+    b1 = host.minimum_image(p[1] - p[0], dims)
+    b2 = host.minimum_image(p[2] - p[1], dims)
+    b3 = host.minimum_image(p[3] - p[2], dims)
+    n1 = np.cross(b1, b2)
+    n2 = np.cross(b2, b3)
+    b2n = b2 / np.maximum(
+        np.linalg.norm(b2, axis=-1, keepdims=True), 1e-300)
+    x = (n1 * n2).sum(-1)
+    y = (np.cross(n1, n2) * b2n).sum(-1)
+    return np.arctan2(y, x)
+
+
 def contact_matrix(coords, cutoff: float = 15.0, box=None,
                    backend: str = "numpy") -> np.ndarray:
     """Boolean (N, N) contact map at ``cutoff`` (BASELINE config 5)."""

@@ -342,3 +342,48 @@ class TestCappedDistance:
         with pytest.raises(ValueError, match="below max_cutoff"):
             capped_distance(np.zeros((2, 3)), np.zeros((2, 3)), 1.0,
                             min_cutoff=2.0)
+
+
+class TestGeometryHelpers:
+    """lib.distances.calc_angles / calc_dihedrals (radians, PBC)."""
+
+    def test_right_angle(self):
+        from mdanalysis_mpi_tpu.lib.distances import calc_angles
+
+        a = np.array([[1.0, 0, 0]])
+        b = np.array([[0.0, 0, 0]])
+        c = np.array([[0.0, 1, 0]])
+        np.testing.assert_allclose(calc_angles(a, b, c), np.pi / 2)
+
+    def test_angle_minimum_image(self):
+        """Through-boundary geometry: a straight angle across the box
+        edge must read pi, not the unwrapped bent value."""
+        from mdanalysis_mpi_tpu.lib.distances import calc_angles
+
+        box = np.array([10.0, 10, 10, 90, 90, 90])
+        a = np.array([[9.5, 0, 0]])
+        b = np.array([[0.5, 0, 0]])       # 1 A from a through the wall
+        c = np.array([[1.5, 0, 0]])
+        np.testing.assert_allclose(calc_angles(a, b, c, box=box), np.pi)
+
+    def test_dihedral_matches_ops_kernel(self):
+        from mdanalysis_mpi_tpu.lib.distances import calc_dihedrals
+        from mdanalysis_mpi_tpu.ops.dihedrals import dihedral_batch_np
+
+        rng = np.random.default_rng(3)
+        p = rng.normal(size=(9, 4, 3))
+        got = np.degrees(calc_dihedrals(p[:, 0], p[:, 1], p[:, 2], p[:, 3]))
+        want = dihedral_batch_np(p[None].reshape(1, -1, 3),
+                                 np.arange(36).reshape(9, 4))[0]
+        np.testing.assert_allclose(got, want, atol=1e-10)
+
+    def test_shape_validation(self):
+        from mdanalysis_mpi_tpu.lib.distances import (
+            calc_angles, calc_dihedrals,
+        )
+
+        with pytest.raises(ValueError, match="shape"):
+            calc_angles(np.zeros((2, 3)), np.zeros((3, 3)), np.zeros((2, 3)))
+        with pytest.raises(ValueError, match="shape"):
+            calc_dihedrals(np.zeros((2, 3)), np.zeros((2, 3)),
+                           np.zeros((2, 3)), np.zeros((1, 3)))
