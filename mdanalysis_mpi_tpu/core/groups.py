@@ -276,6 +276,50 @@ class AtomGroup:
         ts.positions[self._indices] = wrapped
         return wrapped
 
+    def guess_bonds(self, fudge_factor: float = 0.55,
+                    lower_bound: float = 0.1) -> np.ndarray:
+        """Distance-based bond perception over THIS group's atoms
+        (upstream ``AtomGroup.guess_bonds``): atoms i, j bond when
+        ``lower_bound < d(i,j) < fudge_factor·(r_vdw(i)+r_vdw(j))``
+        on the current frame (minimum image under the frame's box).
+        The guessed bonds are merged into the universe topology —
+        ``bonded`` selections and HydrogenBondAnalysis donor pairing
+        work afterwards — and returned as an (n_bonds, 2) global-index
+        array.  Elements without a tabulated radius raise."""
+        from mdanalysis_mpi_tpu.core import tables
+        from mdanalysis_mpi_tpu.lib.distances import self_capped_distance
+
+        t = self._universe.topology
+        if len(self._indices) < 2:
+            return np.empty((0, 2), dtype=np.int64)
+        elements = np.char.upper(t.elements[self._indices].astype("U2"))
+        radii = np.empty(len(elements))
+        for j, e in enumerate(elements):
+            r = tables.VDW_RADII.get(e)
+            if r is None:
+                raise ValueError(
+                    f"no van der Waals radius tabulated for element "
+                    f"{e!r} (atom {int(self._indices[j])}); add it to "
+                    "core.tables.VDW_RADII or set bonds explicitly")
+            radii[j] = r
+        ts = self._universe.trajectory.ts
+        max_cut = fudge_factor * 2.0 * float(radii.max())
+        pairs, d = self_capped_distance(
+            self.positions, max_cut, min_cutoff=lower_bound,
+            box=ts.dimensions, return_distances=True)
+        keep = d < fudge_factor * (radii[pairs[:, 0]] + radii[pairs[:, 1]])
+        bonds = self._indices[pairs[keep]]
+        existing = t.bonds if t.bonds is not None else np.empty((0, 2),
+                                                               np.int64)
+        merged = {tuple(sorted(b)) for b in existing.tolist()}
+        merged.update(tuple(sorted(b)) for b in bonds.tolist())
+        t.bonds = np.array(sorted(merged), dtype=np.int64).reshape(-1, 2)
+        # the selection memo assumes an immutable topology — adding
+        # bonds invalidates any cached `bonded ...` mask
+        self._universe.__dict__.pop("_selection_cache", None)
+        self._universe.__dict__.pop("_selection_scope_insensitive", None)
+        return np.asarray(bonds, dtype=np.int64).reshape(-1, 2)
+
     def write(self, path: str) -> None:
         """Write this group's current-frame coordinates (+ subset
         topology) to ``path`` — format chosen by extension (.gro, .pdb,

@@ -29,6 +29,17 @@ MASSES: dict[str, float] = {
     "X": 0.0,  # unknown
 }
 
+# Van der Waals radii (Å, Bondi 1964 + common extensions) — the table
+# behind distance-based bond perception (guess_bonds): two atoms bond
+# when d < fudge·(r₁+r₂), upstream's criterion and default fudge 0.55.
+VDW_RADII: dict[str, float] = {
+    "H": 1.20, "D": 1.20, "HE": 1.40, "LI": 1.82, "B": 1.92, "C": 1.70,
+    "N": 1.55, "O": 1.52, "F": 1.47, "NE": 1.54, "NA": 2.27, "MG": 1.73,
+    "AL": 1.84, "SI": 2.10, "P": 1.80, "S": 1.80, "CL": 1.75, "AR": 1.88,
+    "K": 2.75, "CA": 2.31, "MN": 2.05, "FE": 2.04, "CO": 2.00,
+    "NI": 1.63, "CU": 1.40, "ZN": 1.39, "BR": 1.85, "I": 1.98,
+}
+
 # Two-letter element symbols we will recognise when guessing from atom
 # names.  Deliberately excludes CA/CB/CD/... (protein carbon naming) and
 # HG/HD/HE (protein hydrogen naming) unless the whole name matches an ion

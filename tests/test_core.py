@@ -398,3 +398,59 @@ class TestInertia:
         u.trajectory.ts.positions += np.float32(17.0)
         np.testing.assert_allclose(u.atoms.moment_of_inertia(), i0,
                                    rtol=1e-10, atol=1e-6)
+
+
+class TestGuessBonds:
+    def test_water_box_bonds(self):
+        from mdanalysis_mpi_tpu.testing import make_water_universe
+
+        u = make_water_universe(n_waters=8, n_frames=1, box=8.0)
+        assert u.topology.bonds is None
+        bonds = u.atoms.guess_bonds()
+        # exactly two O-H bonds per water, none between molecules
+        assert len(bonds) == 16
+        assert u.topology.bonds.shape == (16, 2)
+        for o, h in bonds:
+            assert abs(int(o) - int(h)) <= 2
+            assert u.topology.resindices[o] == u.topology.resindices[h]
+
+    def test_enables_bonded_selection_and_busts_cache(self):
+        from mdanalysis_mpi_tpu.testing import make_water_universe
+
+        u = make_water_universe(n_waters=4, n_frames=1, box=8.0)
+        with pytest.raises(ValueError, match="bond"):
+            u.select_atoms("bonded name OW")
+        # the failed parse must not have poisoned a cache entry
+        u.atoms.guess_bonds()
+        got = u.select_atoms("bonded name OW")
+        assert got.n_atoms == 8            # every hydrogen
+        assert u.topology.is_hydrogen[got.indices].all()
+
+    def test_group_scoped_guess(self):
+        """Guessing on a subgroup only adds that subgroup's bonds."""
+        from mdanalysis_mpi_tpu.testing import make_water_universe
+
+        u = make_water_universe(n_waters=6, n_frames=1, box=10.0)
+        first = u.select_atoms("resid 1")
+        bonds = first.guess_bonds()
+        assert len(bonds) == 2
+        assert set(np.unique(bonds)) <= set(first.indices.tolist())
+
+    def test_empty_and_single_atom_groups(self):
+        from mdanalysis_mpi_tpu.testing import make_water_universe
+
+        u = make_water_universe(n_waters=2, n_frames=1, box=8.0)
+        assert u.select_atoms("resid 99").guess_bonds().shape == (0, 2)
+        assert u.select_atoms("name OW and resid 1").guess_bonds(
+        ).shape == (0, 2)
+
+    def test_unknown_element_raises(self):
+        from mdanalysis_mpi_tpu.core.topology import Topology
+        from mdanalysis_mpi_tpu.io.memory import MemoryReader
+
+        top = Topology(names=np.array(["XQ1", "XQ2"]),
+                       resnames=np.array(["UNK", "UNK"]),
+                       resids=np.array([1, 1]))
+        u = Universe(top, MemoryReader(np.zeros((1, 2, 3), np.float32)))
+        with pytest.raises(ValueError, match="radius"):
+            u.atoms.guess_bonds()
