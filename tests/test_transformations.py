@@ -99,6 +99,51 @@ class TestTransformations:
         np.testing.assert_allclose(u2.trajectory[1].positions,
                                    u.trajectory[1].positions, atol=1e-5)
 
+    def test_unwrap_makes_molecules_whole(self):
+        """A water split across the boundary comes back intact."""
+        from mdanalysis_mpi_tpu.core.topology import make_water_topology
+
+        top = make_water_topology(1)
+        # O near the +x wall, hydrogens wrapped to the other side
+        pos = np.array([[[9.8, 5.0, 5.0],
+                         [0.2, 5.0, 5.0],      # image of O + ~0.4 on x
+                         [9.4, 5.8, 5.0]]], np.float32)
+        dims = np.array([10.0, 10, 10, 90, 90, 90], np.float32)
+        u = Universe(top, MemoryReader(pos, dimensions=dims))
+        u.atoms.guess_bonds()
+        u.trajectory.add_transformations(trf.unwrap(u.atoms))
+        got = u.trajectory[0].positions
+        # every O-H distance is now the direct (unwrapped) one
+        d1 = np.linalg.norm(got[1] - got[0])
+        d2 = np.linalg.norm(got[2] - got[0])
+        assert d1 < 1.2 and d2 < 1.2, (d1, d2)
+        np.testing.assert_allclose(got[1], [10.2, 5.0, 5.0], atol=1e-4)
+
+    def test_unwrap_needs_bonds(self):
+        u = make_protein_universe(n_residues=3, n_frames=2, box=20.0)
+        with pytest.raises(ValueError, match="bonds"):
+            trf.unwrap(u.atoms)
+
+    def test_unwrap_roundtrip_with_wrap(self):
+        """wrap then unwrap restores intramolecular geometry exactly."""
+        from mdanalysis_mpi_tpu.testing import make_water_universe
+
+        u = make_water_universe(n_waters=12, n_frames=3, box=6.0)
+        u.atoms.guess_bonds()
+        ref_d = []
+        for f in range(3):
+            p = u.trajectory[f].positions
+            ref_d.append([np.linalg.norm(
+                np.remainder(p[3 * w + 1] - p[3 * w] + 3.0, 6.0) - 3.0)
+                for w in range(12)])
+        u.trajectory.add_transformations(trf.wrap(u.atoms),
+                                         trf.unwrap(u.atoms))
+        for f in range(3):
+            p = u.trajectory[f].positions
+            got = [np.linalg.norm(p[3 * w + 1] - p[3 * w])
+                   for w in range(12)]
+            np.testing.assert_allclose(got, ref_d[f], atol=1e-3)
+
     def test_add_twice_raises(self):
         u = make_protein_universe(n_residues=3, n_frames=2)
         u.trajectory.add_transformations(trf.translate([1, 0, 0]))
