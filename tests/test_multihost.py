@@ -82,9 +82,19 @@ g = InterRDF(ca, ca, nbins=8, range=(0.0, 10.0),
              engine="ring").run(backend="mesh", batch_size=2)
 rdf_ring = g.results.rdf
 
+# round-3 kernel families at 2 controllers: matrix-valued psum partials
+# (PCA covariance) and int32 scatter counts (density grid)
+from mdanalysis_mpi_tpu.analysis import PCA, DensityAnalysis
+p = PCA(u, select="name CA", n_components=3).run(backend="mesh",
+                                                 batch_size=2)
+dn = DensityAnalysis(u.select_atoms("name CA"), delta=4.0).run(
+    backend="mesh", batch_size=2)
+
 if pid == 0:
     np.savez({out!r}, rmsf=a.results.rmsf, rmsf_i16=q.results.rmsf,
-             rmsd=rmsd, rdf_ring=rdf_ring)
+             rmsd=rmsd, rdf_ring=rdf_ring,
+             pca_variance=np.asarray(p.results.variance),
+             density_grid=dn.results.grid)
 """
 
 
@@ -146,4 +156,15 @@ class TestTwoProcessMesh:
             backend="serial")
         np.testing.assert_allclose(got["rdf_ring"], sg.results.rdf,
                                    atol=1e-3)
+
+        from mdanalysis_mpi_tpu.analysis import PCA, DensityAnalysis
+
+        sp = PCA(u, select="name CA", n_components=3).run(backend="serial")
+        np.testing.assert_allclose(
+            got["pca_variance"], sp.results.variance,
+            rtol=5e-2, atol=1e-3 * float(sp.results.variance[0]))
+        sd = DensityAnalysis(u.select_atoms("name CA"), delta=4.0).run(
+            backend="serial")
+        np.testing.assert_allclose(got["density_grid"], sd.results.grid,
+                                   atol=1e-6)
 
