@@ -14,7 +14,9 @@ the reference's docstring (RMSF.py:1-18) — ``Analysis(...).run()`` →
   reference program as one call (pass 1 + pass 2, RMSF.py:53-149).
 """
 
-from mdanalysis_mpi_tpu.analysis.base import AnalysisBase, Results
+from mdanalysis_mpi_tpu.analysis.base import (AnalysisBase, Results,
+                                               AnalysisFromFunction,
+                                               analysis_class)
 from mdanalysis_mpi_tpu.analysis.rms import RMSF, RMSD, AlignedRMSF
 from mdanalysis_mpi_tpu.analysis.align import (AverageStructure, AlignTraj,
                                                alignto, rotation_matrix)
@@ -28,7 +30,8 @@ from mdanalysis_mpi_tpu.analysis.contacts import Contacts
 from mdanalysis_mpi_tpu.analysis.density import DensityAnalysis
 from mdanalysis_mpi_tpu.analysis.hbonds import HydrogenBondAnalysis
 
-__all__ = ["AnalysisBase", "Results", "RMSF", "RMSD", "AlignedRMSF",
+__all__ = ["AnalysisBase", "Results", "AnalysisFromFunction",
+           "analysis_class", "RMSF", "RMSD", "AlignedRMSF",
            "AverageStructure", "AlignTraj", "alignto", "rotation_matrix",
            "InterRDF", "ContactMap",
            "PairwiseDistances", "RadiusOfGyration", "PCA", "EinsteinMSD",

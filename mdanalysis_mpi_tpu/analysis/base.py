@@ -265,3 +265,77 @@ class AnalysisBase:
                       n_frames=self.n_frames, wall_s=round(wall, 4),
                       fps=round(self.n_frames / wall, 2) if wall > 0 else None)
         return self
+
+
+class AnalysisFromFunction(AnalysisBase):
+    """Wrap a per-frame function into an analysis (upstream
+    ``analysis.base.AnalysisFromFunction``)::
+
+        rg = AnalysisFromFunction(
+            lambda ag: ag.radius_of_gyration(), ca).run()
+        rg.results.timeseries          # (n_frames, ...) stacked values
+
+    ``function(*args, **kwargs)`` is called once per frame with the
+    trajectory positioned there (upstream contract: AtomGroup arguments
+    read their universe's CURRENT frame).  Arbitrary Python has no batch
+    kernel — serial backend only, by construction; write a subclass with
+    a batch kernel (see README "Writing your own analysis") when the
+    math should run on the accelerator.
+    """
+
+    def __init__(self, function, *args, verbose: bool = False, **kwargs):
+        from mdanalysis_mpi_tpu.core.groups import AtomGroup
+        from mdanalysis_mpi_tpu.core.universe import Universe
+
+        u = None
+        for a in args:
+            if isinstance(a, AtomGroup):
+                u = a.universe
+                break
+            if isinstance(a, Universe):
+                u = a
+                break
+        if u is None:
+            raise ValueError(
+                "pass at least one AtomGroup or Universe argument so the "
+                "analysis knows which trajectory to iterate")
+        super().__init__(u, verbose)
+        self._function = function
+        self._args = args
+        self._kwargs = kwargs
+
+    def _prepare(self):
+        self._values = []
+
+    def _single_frame(self, ts):
+        self._values.append(self._function(*self._args, **self._kwargs))
+
+    def _serial_summary(self):
+        return self._values
+
+    def _conclude(self, values):
+        self.results.frames = np.asarray(self._frame_indices)
+        self.results.timeseries = (
+            np.stack([np.asarray(v) for v in values]) if values
+            else np.empty(0))
+
+
+def analysis_class(function):
+    """Decorator turning a per-frame function into an Analysis class
+    (upstream ``analysis.base.analysis_class``)::
+
+        @analysis_class
+        def com_z(ag):
+            return ag.center_of_mass()[2]
+
+        com_z(ca).run().results.timeseries
+    """
+    import functools
+
+    class _Wrapped(AnalysisFromFunction):
+        @functools.wraps(function, updated=())
+        def __init__(self, *args, **kwargs):
+            super().__init__(function, *args, **kwargs)
+
+    _Wrapped.__name__ = getattr(function, "__name__", "AnalysisFromFunction")
+    return _Wrapped

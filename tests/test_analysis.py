@@ -475,3 +475,55 @@ class TestExplicitFramesAPI:
         AlignTraj(u2, u, select="name CA").run(frames=[0, 2, 4],
                                                backend="serial")
         assert u2.trajectory.n_frames == 3
+
+
+class TestAnalysisFromFunction:
+    def test_wraps_function_over_frames(self):
+        from mdanalysis_mpi_tpu.analysis.base import AnalysisFromFunction
+
+        u = make_protein_universe(n_residues=4, n_frames=6, noise=0.2)
+        ca = u.select_atoms("name CA")
+        r = AnalysisFromFunction(
+            lambda ag: ag.radius_of_gyration(), ca).run()
+        assert r.results.timeseries.shape == (6,)
+        np.testing.assert_array_equal(r.results.frames, np.arange(6))
+        # spot-check against a manual loop
+        u.trajectory[3]
+        np.testing.assert_allclose(r.results.timeseries[3],
+                                   ca.radius_of_gyration())
+
+    def test_array_valued_and_window(self):
+        from mdanalysis_mpi_tpu.analysis.base import AnalysisFromFunction
+
+        u = make_protein_universe(n_residues=4, n_frames=8, noise=0.2)
+        ca = u.select_atoms("name CA")
+        r = AnalysisFromFunction(
+            lambda ag: ag.center_of_mass(), ca).run(start=2, stop=8, step=2)
+        assert r.results.timeseries.shape == (3, 3)
+        np.testing.assert_array_equal(r.results.frames, [2, 4, 6])
+
+    def test_analysis_class_decorator(self):
+        from mdanalysis_mpi_tpu.analysis.base import analysis_class
+
+        @analysis_class
+        def com_z(ag):
+            return ag.center_of_mass()[2]
+
+        u = make_protein_universe(n_residues=3, n_frames=4, noise=0.2)
+        r = com_z(u.select_atoms("name CA")).run()
+        assert r.results.timeseries.shape == (4,)
+        assert com_z.__name__ == "com_z"
+
+    def test_needs_group_argument(self):
+        from mdanalysis_mpi_tpu.analysis.base import AnalysisFromFunction
+
+        with pytest.raises(ValueError, match="AtomGroup or Universe"):
+            AnalysisFromFunction(lambda x: x, 42)
+
+    def test_serial_only(self):
+        from mdanalysis_mpi_tpu.analysis.base import AnalysisFromFunction
+
+        u = make_protein_universe(n_residues=3, n_frames=4)
+        with pytest.raises(NotImplementedError, match="serial"):
+            AnalysisFromFunction(
+                lambda ag: ag.n_atoms, u.atoms).run(backend="jax")
