@@ -109,16 +109,59 @@ class AtomGroup:
             raise AttributeError("this trajectory's frames carry no forces")
         return f[self._indices]
 
-    def center_of_mass(self) -> np.ndarray:
-        """Mass-weighted center, float64 (3,) (reference RMSF.py:84,94)."""
+    def _compound_keys(self, compound: str) -> np.ndarray:
+        if compound == "residues":
+            return self.resindices
+        if compound == "segments":
+            return self.segids
+        raise ValueError(
+            f"compound must be 'group', 'residues' or 'segments', "
+            f"got {compound!r}")
+
+    def _segmented_center(self, weights: np.ndarray | None,
+                          compound: str) -> np.ndarray:
+        """Per-compound (weighted) centers in first-occurrence order
+        (the split() convention) — one segmented reduction, no Python
+        loop over compounds."""
+        keys = self._compound_keys(compound)
+        uniq, first, inverse = np.unique(keys, return_index=True,
+                                         return_inverse=True)
+        order = np.argsort(first, kind="stable")
+        rank = np.empty(len(uniq), dtype=np.int64)
+        rank[order] = np.arange(len(uniq))
+        seg = rank[inverse]                   # first-occurrence compound id
+        w = (np.ones(len(self._indices)) if weights is None
+             else np.asarray(weights, np.float64))
+        pos = self.positions.astype(np.float64)
+        num = np.zeros((len(uniq), 3))
+        np.add.at(num, seg, pos * w[:, None])
+        den = np.zeros(len(uniq))
+        np.add.at(den, seg, w)
+        if (den == 0.0).any():
+            raise ValueError(
+                "a compound has zero total weight; cannot compute center")
+        return num / den[:, None]
+
+    def center_of_mass(self, compound: str = "group") -> np.ndarray:
+        """Mass-weighted center, float64 (reference RMSF.py:84,94).
+
+        ``compound='group'`` (default) → (3,); ``'residues'`` /
+        ``'segments'`` → (n_compounds, 3), one center per residue/
+        segment of THIS group in first-occurrence order (upstream
+        ``compound=`` semantics)."""
         m = self.masses
+        if compound != "group":
+            return self._segmented_center(m, compound)
         tot = m.sum()
         if tot == 0.0:
             raise ValueError("total mass is zero; cannot compute center_of_mass")
         return (self.positions.astype(np.float64) * m[:, None]).sum(axis=0) / tot
 
-    def center_of_geometry(self) -> np.ndarray:
-        """Unweighted centroid, float64 (3,)."""
+    def center_of_geometry(self, compound: str = "group") -> np.ndarray:
+        """Unweighted centroid, float64; ``compound`` as in
+        :meth:`center_of_mass`."""
+        if compound != "group":
+            return self._segmented_center(None, compound)
         return self.positions.astype(np.float64).mean(axis=0)
 
     centroid = center_of_geometry

@@ -454,3 +454,41 @@ class TestGuessBonds:
         u = Universe(top, MemoryReader(np.zeros((1, 2, 3), np.float32)))
         with pytest.raises(ValueError, match="radius"):
             u.atoms.guess_bonds()
+
+
+class TestCompoundCenters:
+    def test_per_residue_com_matches_split(self):
+        from mdanalysis_mpi_tpu.testing import make_protein_universe
+
+        u = make_protein_universe(n_residues=5, n_frames=2, noise=0.3)
+        ag = u.select_atoms("protein")
+        per_res = ag.center_of_mass(compound="residues")
+        parts = ag.split("residue")
+        assert per_res.shape == (5, 3)
+        for k, part in enumerate(parts):
+            np.testing.assert_allclose(per_res[k], part.center_of_mass())
+
+    def test_per_segment_geometry_order(self):
+        """Segments come back in first-occurrence order, not sorted."""
+        from mdanalysis_mpi_tpu.core.topology import Topology
+        from mdanalysis_mpi_tpu.io.memory import MemoryReader
+
+        top = Topology(names=np.array(["CA"] * 4),
+                       resnames=np.array(["ALA"] * 4),
+                       resids=np.array([1, 2, 3, 4]),
+                       segids=np.array(["Z", "Z", "A", "A"]))
+        pos = np.array([[[0, 0, 0], [2, 0, 0],
+                         [10, 0, 0], [12, 0, 0]]], np.float32)
+        u = Universe(top, MemoryReader(pos))
+        c = u.atoms.center_of_geometry(compound="segments")
+        np.testing.assert_allclose(c, [[1, 0, 0], [11, 0, 0]])
+
+    def test_group_default_unchanged(self):
+        from mdanalysis_mpi_tpu.testing import make_protein_universe
+
+        u = make_protein_universe(n_residues=3, n_frames=1)
+        np.testing.assert_allclose(
+            u.atoms.center_of_mass(),
+            u.atoms.center_of_mass(compound="group"))
+        with pytest.raises(ValueError, match="compound"):
+            u.atoms.center_of_mass(compound="molecules")
