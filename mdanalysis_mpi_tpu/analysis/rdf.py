@@ -29,7 +29,8 @@ import functools
 
 @functools.lru_cache(maxsize=None)
 def _rdf_kernel(exclude_self: bool, tile: int, engine: str,
-                static_edges: tuple | None = None):
+                static_edges: tuple | None = None,
+                exclusion_block: tuple | None = None):
     """``engine``: 'xla' (generic searchsorted+segment_sum path;
     params carry the traced edges array, ``static_edges`` is None) or
     'pallas' (fused TPU kernel — uniform bins, orthorhombic boxes; bin
@@ -52,7 +53,8 @@ def _rdf_kernel(exclude_self: bool, tile: int, engine: str,
             loc_a, loc_b, edges = params
             counts, vol_sum, t = pair_histogram_batch(
                 batch[:, loc_a], batch[:, loc_b], boxes, mask, edges,
-                exclude_self=exclude_self, tile=tile)
+                exclude_self=exclude_self, tile=tile,
+                exclusion_block=exclusion_block)
         # n_boxed: frames carrying a real (non-zero-volume) box.  A frame
         # without a box is staged as a zero box, which would silently
         # deflate <V> and unwrap distances — _conclude rejects runs where
@@ -95,6 +97,7 @@ class InterRDF(AnalysisBase):
     def __init__(self, g1: AtomGroup, g2: AtomGroup, nbins: int = 75,
                  range: tuple[float, float] = (0.0, 15.0),
                  tile: int = 1024, engine: str = "auto",
+                 exclusion_block: tuple[int, int] | None = None,
                  verbose: bool = False):
         if g1.universe is not g2.universe:
             raise ValueError("g1 and g2 must belong to the same Universe")
@@ -102,6 +105,21 @@ class InterRDF(AnalysisBase):
             raise ValueError(
                 f"engine must be 'auto', 'pallas', 'xla' or 'ring', "
                 f"got {engine!r}")
+        if exclusion_block is not None:
+            p, q = (int(exclusion_block[0]), int(exclusion_block[1]))
+            if p < 1 or q < 1:
+                raise ValueError(
+                    f"exclusion_block entries must be >= 1, got "
+                    f"{exclusion_block}")
+            if g1.n_atoms % p or g2.n_atoms % q:
+                raise ValueError(
+                    f"exclusion_block {(p, q)} does not tile the groups "
+                    f"({g1.n_atoms}, {g2.n_atoms} atoms)")
+            if engine in ("pallas", "ring"):
+                raise ValueError(
+                    "exclusion_block is implemented on the 'xla' engine "
+                    "(auto resolves there automatically)")
+            exclusion_block = (p, q)
         super().__init__(g1.universe, verbose)
         self._g1 = g1
         self._g2 = g2
@@ -109,6 +127,7 @@ class InterRDF(AnalysisBase):
         self._range = (float(range[0]), float(range[1]))
         self._tile = int(tile)
         self._engine = engine
+        self._exclusion_block = exclusion_block
 
     def _prepare(self):
         if self._g1.n_atoms == 0 or self._g2.n_atoms == 0:
@@ -170,6 +189,7 @@ class InterRDF(AnalysisBase):
                                             rtol=0.0, atol=1e-4)
         self._resolved_engine = (
             "pallas" if (pallas_distances.use_pallas() and ortho
+                         and self._exclusion_block is None
                          and self._nbins <= pallas_distances.MAX_NBINS
                          and pallas_distances.uniform_edges(self._edges))
             else "xla")
@@ -191,7 +211,8 @@ class InterRDF(AnalysisBase):
         b = ts.positions[self._g2.indices].astype(np.float64)
         self._counts += host.pair_histogram(
             a, b, self._edges, box=box.astype(np.float64),
-            exclude_self=self._identical)
+            exclude_self=self._identical,
+            exclusion_block=self._exclusion_block)
         self._vol_sum += vol
         self._t += 1
 
@@ -214,7 +235,8 @@ class InterRDF(AnalysisBase):
         if engine == "pallas":
             return _rdf_kernel(self._identical, 0, "pallas",
                                tuple(float(e) for e in self._edges))
-        return _rdf_kernel(self._identical, self._tile, "xla")
+        return _rdf_kernel(self._identical, self._tile, "xla",
+                           exclusion_block=self._exclusion_block)
 
     def _batch_params(self):
         import jax.numpy as jnp
@@ -262,6 +284,24 @@ class InterRDF(AnalysisBase):
         resolved_engine = getattr(self, "_resolved_engine", None)
         identical = self._identical
         n_a, n_b = self._g1.n_atoms, self._g2.n_atoms
+        # pairs the kernels never count must leave the normalization too
+        # (upstream subtracts xA·xB·nblocks); computed exactly, including
+        # the diagonal/block overlap when the groups are identical
+        n_excluded = n_a if identical else 0
+        if self._exclusion_block is not None:
+            p, q = self._exclusion_block
+            ia = np.arange(n_a) // p
+            ib = np.arange(n_b) // q
+            m = min(ia[-1], ib[-1]) + 1
+            ca = np.bincount(ia, minlength=m)[:m]
+            cb = np.bincount(ib, minlength=m)[:m]
+            block_pairs = int((ca * cb).sum())
+            if identical:
+                # diagonal pairs not already inside a block exclusion
+                diag_extra = int(np.sum(ia != ib[:n_a]))
+                n_excluded = block_pairs + diag_extra
+            else:
+                n_excluded = block_pairs
 
         def _finalize():
             counts, vol_sum, t = (np.asarray(total[0], np.float64),
@@ -286,7 +326,7 @@ class InterRDF(AnalysisBase):
                     "have no periodic box; every frame must carry one "
                     "for g(r) normalization")
             vols = 4.0 / 3.0 * np.pi * (edges[1:] ** 3 - edges[:-1] ** 3)
-            n_pairs = n_a * n_b - (n_a if identical else 0)
+            n_pairs = n_a * n_b - n_excluded
             density = n_pairs / (vol_sum / t)
             return {"count": counts, "rdf": counts / (density * vols * t)}
 

@@ -94,6 +94,7 @@ def pair_histogram(
     b_offset=0,                   # global index of b[0]
     a_weights: jax.Array | None = None,   # (N,) per-atom pair weights
     b_weights: jax.Array | None = None,   # (M,)
+    exclusion_block: tuple | None = None,  # (p, q): drop i//p == j//q
 ) -> jax.Array:
     """Blockwise histogram of pair distances — the RDF inner kernel.
 
@@ -127,10 +128,14 @@ def pair_histogram(
         wa = (jnp.ones((a.shape[0],), a.dtype) if a_weights is None
               else a_weights)
         w = wa[:, None] * wb[None, :]
-        if exclude_self:
+        if exclude_self or exclusion_block is not None:
             ia = a_offset + jnp.arange(a.shape[0])[:, None]
             ib = b_offset + t * tile + jnp.arange(tile)[None, :]
-            w = w * (ia != ib)
+            if exclude_self:
+                w = w * (ia != ib)
+            if exclusion_block is not None:
+                p, q = exclusion_block
+                w = w * (ia // p != ib // q)
         # bucketize; out-of-range pairs land in bin index nbins (dropped)
         idx = jnp.searchsorted(edges, d.ravel(), side="right") - 1
         idx = jnp.where((d.ravel() >= edges[0]) & (d.ravel() < edges[-1]),
@@ -176,13 +181,15 @@ def pair_histogram_batch(
     edges: jax.Array,
     exclude_self: bool = False,
     tile: int = 1024,
+    exclusion_block: tuple | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Per-frame-batch RDF partials: (counts (nbins,), Σ volume, T).
 
     XLA engine; ``minimum_image`` handles zero and triclinic boxes."""
     return histogram_batch_from(
         lambda a, b, box6: pair_histogram(
-            a, b, edges, box=box6, exclude_self=exclude_self, tile=tile)
+            a, b, edges, box=box6, exclude_self=exclude_self, tile=tile,
+            exclusion_block=exclusion_block)
     )(coords_a, coords_b, boxes, mask)
 
 

@@ -156,12 +156,23 @@ def distance_array(a: np.ndarray, b: np.ndarray,
     return np.sqrt((disp ** 2).sum(-1))
 
 
-def pair_histogram(a, b, edges, box=None, exclude_self=False) -> np.ndarray:
-    """NumPy oracle for the RDF histogram kernel."""
+def pair_histogram(a, b, edges, box=None, exclude_self=False,
+                   exclusion_block=None) -> np.ndarray:
+    """NumPy oracle for the RDF histogram kernel.
+
+    ``exclusion_block=(p, q)`` drops pair (i, j) when ``i//p == j//q``
+    — upstream's same-molecule exclusion for groups laid out as
+    consecutive molecules (e.g. ``(1, 2)`` for O vs H₂ of the same
+    waters)."""
     d = distance_array(a, b, box)
     if exclude_self:
         n = min(d.shape)
         d[np.arange(n), np.arange(n)] = -1.0   # below every edge
+    if exclusion_block is not None:
+        p, q = exclusion_block
+        same = (np.arange(d.shape[0])[:, None] // p
+                == np.arange(d.shape[1])[None, :] // q)
+        d[same] = -1.0
     return np.histogram(d.ravel(), bins=edges)[0].astype(np.float64)
 
 

@@ -387,3 +387,68 @@ class TestGeometryHelpers:
         with pytest.raises(ValueError, match="shape"):
             calc_dihedrals(np.zeros((2, 3)), np.zeros((2, 3)),
                            np.zeros((2, 3)), np.zeros((1, 3)))
+
+
+class TestExclusionBlock:
+    """InterRDF exclusion_block: same-molecule pair suppression."""
+
+    def _ow_hw(self):
+        from mdanalysis_mpi_tpu.testing import make_water_universe
+
+        u = make_water_universe(n_waters=40, n_frames=4, box=12.0)
+        return u, u.select_atoms("name OW"), u.select_atoms("name HW1 HW2")
+
+    def test_intramolecular_peak_removed(self):
+        from mdanalysis_mpi_tpu.analysis import InterRDF
+
+        u, ow, hw = self._ow_hw()
+        full = InterRDF(ow, hw, nbins=30, range=(0.5, 3.5)).run(
+            backend="serial")
+        excl = InterRDF(ow, hw, nbins=30, range=(0.5, 3.5),
+                        exclusion_block=(1, 2)).run(backend="serial")
+        bins = full.results.bins
+        near = bins < 1.3                # covalent O-H distance ~0.96 A
+        assert full.results.count[near].sum() >= 2 * 40 * 4  # both H's
+        assert excl.results.count[near].sum() == 0
+
+    def test_backend_parity_with_exclusion(self):
+        from mdanalysis_mpi_tpu.analysis import InterRDF
+
+        u, ow, hw = self._ow_hw()
+        s = InterRDF(ow, hw, nbins=20, range=(0.5, 5.0),
+                     exclusion_block=(1, 2)).run(backend="serial")
+        j = InterRDF(ow, hw, nbins=20, range=(0.5, 5.0),
+                     exclusion_block=(1, 2)).run(backend="jax",
+                                                 batch_size=2)
+        np.testing.assert_allclose(j.results.count, s.results.count,
+                                   atol=1e-6)
+        np.testing.assert_allclose(j.results.rdf, s.results.rdf,
+                                   rtol=1e-5)
+
+    def test_normalization_subtracts_excluded_pairs(self):
+        """g(r) must divide by the pair count the kernel can actually
+        produce (upstream subtracts xA*xB*nblocks)."""
+        from mdanalysis_mpi_tpu.analysis import InterRDF
+        from mdanalysis_mpi_tpu.core.box import box_to_vectors
+
+        u, ow, hw = self._ow_hw()
+        r = InterRDF(ow, hw, nbins=20, range=(0.5, 5.0),
+                     exclusion_block=(1, 2)).run(backend="serial")
+        edges = np.linspace(0.5, 5.0, 21)
+        vols = 4 / 3 * np.pi * (edges[1:] ** 3 - edges[:-1] ** 3)
+        box_vol = abs(np.linalg.det(box_to_vectors(
+            u.trajectory[0].dimensions.astype(np.float64))))
+        n_pairs = ow.n_atoms * hw.n_atoms - 40 * 1 * 2   # minus blocks
+        expected = r.results.count / (n_pairs / box_vol * vols * 4)
+        np.testing.assert_allclose(r.results.rdf, expected, rtol=1e-10)
+
+    def test_validation(self):
+        from mdanalysis_mpi_tpu.analysis import InterRDF
+
+        u, ow, hw = self._ow_hw()
+        with pytest.raises(ValueError, match="tile"):
+            InterRDF(ow, hw, exclusion_block=(3, 2))
+        with pytest.raises(ValueError, match=">= 1"):
+            InterRDF(ow, hw, exclusion_block=(0, 2))
+        with pytest.raises(ValueError, match="xla"):
+            InterRDF(ow, hw, engine="ring", exclusion_block=(1, 2))
