@@ -452,3 +452,39 @@ class TestExclusionBlock:
             InterRDF(ow, hw, exclusion_block=(0, 2))
         with pytest.raises(ValueError, match="xla"):
             InterRDF(ow, hw, engine="ring", exclusion_block=(1, 2))
+
+
+class TestMdamath:
+    def test_helpers(self):
+        from mdanalysis_mpi_tpu.lib import mdamath
+
+        assert mdamath.norm([3, 4, 0]) == 5.0
+        np.testing.assert_allclose(
+            mdamath.normal([1, 0, 0], [0, 1, 0]), [0, 0, 1])
+        assert mdamath.normal([1, 0, 0], [2, 0, 0]).sum() == 0.0
+        np.testing.assert_allclose(
+            mdamath.angle([1, 0, 0], [0, 1, 0]), np.pi / 2)
+        with pytest.raises(ValueError, match="zero"):
+            mdamath.angle([0, 0, 0], [1, 0, 0])
+
+    def test_box_round_trip_and_volume(self):
+        from mdanalysis_mpi_tpu.lib import mdamath
+
+        dims = np.array([20.0, 18.0, 15.0, 80.0, 95.0, 100.0])
+        m = mdamath.triclinic_vectors(dims)
+        back = mdamath.triclinic_box(m[0], m[1], m[2])
+        np.testing.assert_allclose(back, dims, atol=1e-3)
+        vol = mdamath.box_volume(dims)
+        np.testing.assert_allclose(vol, abs(np.linalg.det(
+            m.astype(np.float64))), rtol=1e-5)
+
+    def test_dihedral_convention_matches_kernel(self):
+        from mdanalysis_mpi_tpu.lib import mdamath
+        from mdanalysis_mpi_tpu.ops.dihedrals import dihedral_batch_np
+
+        rng = np.random.default_rng(4)
+        p = rng.normal(size=(4, 3))
+        want = np.radians(dihedral_batch_np(
+            p[None], np.array([[0, 1, 2, 3]]))[0, 0])
+        got = mdamath.dihedral(p[1] - p[0], p[2] - p[1], p[3] - p[2])
+        np.testing.assert_allclose(got, want, atol=1e-12)
