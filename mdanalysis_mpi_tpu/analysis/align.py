@@ -248,13 +248,16 @@ class AlignTraj(AnalysisBase):
                     "nothing to write")
             import os
 
-            src = getattr(u.trajectory, "filename", None)
-            if src is not None and os.path.abspath(self.filename) \
-                    == os.path.abspath(src):
+            sources = [getattr(u.trajectory, "filename", None)]
+            # chained trajectories expose their segments as .filenames
+            sources += list(getattr(u.trajectory, "filenames", ()) or ())
+            target = os.path.abspath(self.filename)
+            if any(s is not None and os.path.abspath(s) == target
+                   for s in sources):
                 raise ValueError(
-                    f"output filename {self.filename!r} is the source "
-                    "trajectory itself — opening it for writing would "
-                    "destroy the input")
+                    f"output filename {self.filename!r} is (part of) the "
+                    "source trajectory itself — opening it for writing "
+                    "would destroy the input")
             from mdanalysis_mpi_tpu.io.writer import TrajectoryWriter
 
             writer = TrajectoryWriter(self.filename, n_atoms=n)

@@ -34,6 +34,11 @@ def _load_trajectory(source, n_atoms: int) -> ReaderBase:
     if isinstance(source, (str,)):
         from mdanalysis_mpi_tpu.io import trajectory_files
         return trajectory_files.open(source, n_atoms=n_atoms)
+    if isinstance(source, (list, tuple)):
+        # upstream Universe(top, [part1.xtc, part2.xtc]) — restart
+        # segments presented as one trajectory
+        from mdanalysis_mpi_tpu.io.chain import ChainReader
+        return ChainReader(source, n_atoms=n_atoms)
     raise TypeError(f"cannot open a trajectory from {type(source).__name__}")
 
 
