@@ -34,7 +34,7 @@ class AnalysisConfig:
 
     analysis: str = "aligned-rmsf"
     topology: str = ""
-    trajectory: str | None = None
+    trajectory: str | list | None = None   # several files chain into one
     select: str = "protein and name CA"
     select2: str | None = None          # rdf second group (defaults to select)
     start: int | None = None
@@ -122,8 +122,10 @@ def _parser() -> argparse.ArgumentParser:
                     "(RMSF/RMSD/RDF/distances over pluggable backends)")
     p.add_argument("analysis", choices=ANALYSES)
     p.add_argument("topology", help="GRO/PSF/PDB topology file")
-    p.add_argument("trajectory", nargs="?", default=None,
-                   help="XTC/DCD/TRR trajectory (omit for topology coords)")
+    p.add_argument("trajectory", nargs="*", default=None,
+                   help="XTC/DCD/TRR trajectory file(s) — several files "
+                        "chain into one (restart segments); omit for "
+                        "topology coords")
     p.add_argument("--select", default="protein and name CA")
     p.add_argument("--select2", default=None, help="RDF second selection")
     p.add_argument("--start", type=int, default=None)
@@ -161,7 +163,10 @@ def main(argv=None) -> int:
 
     ns = _parser().parse_args(argv)
     cfg = AnalysisConfig(
-        analysis=ns.analysis, topology=ns.topology, trajectory=ns.trajectory,
+        analysis=ns.analysis, topology=ns.topology,
+        trajectory=(None if not ns.trajectory
+                    else ns.trajectory[0] if len(ns.trajectory) == 1
+                    else ns.trajectory),
         select=ns.select, select2=ns.select2, start=ns.start, stop=ns.stop,
         step=ns.step, ref_frame=ns.ref_frame, backend=ns.backend,
         batch_size=ns.batch_size, transfer_dtype=ns.transfer_dtype,
