@@ -17,7 +17,7 @@ the reference's docstring (RMSF.py:1-18) — ``Analysis(...).run()`` →
 from mdanalysis_mpi_tpu.analysis.base import (AnalysisBase, Results,
                                                AnalysisFromFunction,
                                                analysis_class)
-from mdanalysis_mpi_tpu.analysis.rms import RMSF, RMSD, AlignedRMSF
+from mdanalysis_mpi_tpu.analysis.rms import RMSF, RMSD, AlignedRMSF, rmsd
 from mdanalysis_mpi_tpu.analysis.align import (AverageStructure, AlignTraj,
                                                alignto, rotation_matrix)
 from mdanalysis_mpi_tpu.analysis.rdf import InterRDF
@@ -31,7 +31,7 @@ from mdanalysis_mpi_tpu.analysis.density import DensityAnalysis
 from mdanalysis_mpi_tpu.analysis.hbonds import HydrogenBondAnalysis
 
 __all__ = ["AnalysisBase", "Results", "AnalysisFromFunction",
-           "analysis_class", "RMSF", "RMSD", "AlignedRMSF",
+           "analysis_class", "RMSF", "RMSD", "AlignedRMSF", "rmsd",
            "AverageStructure", "AlignTraj", "alignto", "rotation_matrix",
            "InterRDF", "ContactMap",
            "PairwiseDistances", "RadiusOfGyration", "PCA", "EinsteinMSD",

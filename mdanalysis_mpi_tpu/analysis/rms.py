@@ -74,6 +74,31 @@ def _psum_moments_partials(partials, axis_name):
     return psum_moments(*partials, axis_name)
 
 
+def rmsd(a, b, weights=None, center: bool = False,
+         superposition: bool = False) -> float:
+    """One-shot RMSD between two (N, 3) coordinate sets (upstream
+    ``rms.rmsd``): optionally remove the (weighted) centroids
+    (``center``) and/or the optimal rotation (``superposition``, which
+    implies centering — upstream semantics)."""
+    a = np.asarray(a, np.float64)
+    b = np.asarray(b, np.float64)
+    if a.shape != b.shape or a.ndim != 2 or a.shape[1] != 3:
+        raise ValueError(
+            f"a and b must both be (N, 3), got {a.shape} vs {b.shape}")
+    w = (np.ones(len(a)) if weights is None
+         else np.asarray(weights, np.float64))
+    if len(w) != len(a):
+        raise ValueError(
+            f"weights has {len(w)} entries for {len(a)} atoms")
+    if center or superposition:
+        a = a - (w[:, None] * a).sum(0) / w.sum()
+        b = b - (w[:, None] * b).sum(0) / w.sum()
+    if superposition:
+        a = a @ host.qcp_rotation(a, b, None if weights is None else w)
+    d2 = ((a - b) ** 2).sum(axis=1)
+    return float(np.sqrt((w @ d2) / w.sum()))
+
+
 class RMSF(AnalysisBase):
     """Per-atom RMSF of an AtomGroup: ``RMSF(ag).run().results.rmsf``.
 

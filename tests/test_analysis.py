@@ -527,3 +527,48 @@ class TestAnalysisFromFunction:
         with pytest.raises(NotImplementedError, match="serial"):
             AnalysisFromFunction(
                 lambda ag: ag.n_atoms, u.atoms).run(backend="jax")
+
+
+class TestOneShotRmsd:
+    def test_identical_and_translated(self):
+        from mdanalysis_mpi_tpu.analysis.rms import rmsd
+
+        rng = np.random.default_rng(6)
+        a = rng.normal(size=(20, 3))
+        assert rmsd(a, a) == 0.0
+        shifted = a + [1.0, 0, 0]
+        assert rmsd(a, shifted) == pytest.approx(1.0)
+        assert rmsd(a, shifted, center=True) == pytest.approx(0.0, abs=1e-12)
+
+    def test_superposition_removes_rotation(self):
+        from mdanalysis_mpi_tpu.analysis.rms import rmsd
+        from mdanalysis_mpi_tpu.testing import random_rotation_matrices
+
+        rng = np.random.default_rng(7)
+        a = rng.normal(size=(15, 3))
+        r = random_rotation_matrices(1, rng)[0]
+        b = a @ r.T + [2.0, -1.0, 0.5]
+        assert rmsd(a, b) > 1.0
+        assert rmsd(a, b, superposition=True) == pytest.approx(0.0, abs=1e-9)
+
+    def test_weighted_matches_series_analysis(self):
+        """One-shot rmsd(mass-weighted, superposed) == RMSD analysis
+        value for the same frame pair."""
+        from mdanalysis_mpi_tpu.analysis import RMSD
+        from mdanalysis_mpi_tpu.analysis.rms import rmsd
+
+        u = make_protein_universe(n_residues=5, n_frames=4, noise=0.4)
+        ca = u.select_atoms("name CA")
+        series = RMSD(ca, weights="mass").run(backend="serial").results.rmsd
+        ref = u.trajectory[0].positions[ca.indices].copy()
+        mob = u.trajectory[2].positions[ca.indices]
+        got = rmsd(mob, ref, weights=ca.masses, superposition=True)
+        np.testing.assert_allclose(got, series[2], atol=1e-6)
+
+    def test_validation(self):
+        from mdanalysis_mpi_tpu.analysis.rms import rmsd
+
+        with pytest.raises(ValueError, match="N, 3"):
+            rmsd(np.zeros((3, 3)), np.zeros((4, 3)))
+        with pytest.raises(ValueError, match="weights"):
+            rmsd(np.zeros((3, 3)), np.zeros((3, 3)), weights=[1.0])
