@@ -55,6 +55,33 @@ rs = InterRDF(ow, ow, nbins=50, range=(0.0, 8.0)).run(backend="serial")
 err = float(np.abs(rp.results.rdf - rs.results.rdf).max())
 assert err < 0.05, f"pallas RDF diverged on chip: {err:.2e}"
 print(f"pallas_rdf err {err:.2e}")
+
+# --- round-3 kernel families on chip: covariance matmul + on-device
+# eigh (PCA), FFT lag algebra (MSD), int32 scatter grid (density) ---
+from mdanalysis_mpi_tpu.analysis import PCA, EinsteinMSD, DensityAnalysis
+
+p = PCA(u, select="protein and name CA", n_components=3).run(
+    backend="jax", batch_size=8)
+ps = PCA(u, select="protein and name CA", n_components=3).run(
+    backend="serial")
+perr = float(np.abs(np.asarray(p.results.variance)
+                    - ps.results.variance).max())
+assert perr < 1e-2 * max(float(ps.results.variance[0]), 1e-9), \
+    f"PCA diverged on chip: {perr:.2e}"
+print(f"pca err {perr:.2e}")
+
+m = EinsteinMSD(uw, select="name OW").run(backend="jax", batch_size=4)
+ms = EinsteinMSD(uw, select="name OW").run(backend="serial")
+merr = float(np.abs(m.results.timeseries - ms.results.timeseries).max())
+assert merr < 1e-2 * max(float(ms.results.timeseries.max()), 1e-9), \
+    f"MSD diverged on chip: {merr:.2e}"
+print(f"msd err {merr:.2e}")
+
+d = DensityAnalysis(ow, delta=2.0).run(backend="jax", batch_size=4)
+ds = DensityAnalysis(ow, delta=2.0).run(backend="serial")
+derr = float(np.abs(d.results.grid - ds.results.grid).max())
+assert derr < 1e-6, f"density diverged on chip: {derr:.2e}"
+print(f"density err {derr:.2e}")
 print("TPU_SMOKE_OK")
 """
 
