@@ -124,6 +124,9 @@ def run_checkpointed(analysis, path: str, chunk_frames: int = 4096,
 
     frames = list(analysis._frames(start, stop, step, frames))
     analysis.n_frames = len(frames)
+    # same contract as AnalysisBase.run: the resolved frame list is
+    # readable from _prepare/_conclude
+    analysis._frame_indices = frames
     analysis._prepare()
     fp = _fingerprint(analysis, frames)
 

@@ -157,6 +157,30 @@ class TestCheckpoint:
         np.testing.assert_allclose(a.results.rmsf, ref.results.rmsf,
                                    rtol=1e-4)
 
+    def test_checkpoint_round3_reductions(self, tmp_path):
+        """PCA and density partials (matrix psum / int32 grid counts)
+        checkpoint and resume like the moment reductions."""
+        from mdanalysis_mpi_tpu.analysis import PCA, DensityAnalysis
+        from mdanalysis_mpi_tpu.testing import make_water_universe
+
+        u = make_protein_universe(n_residues=6, n_frames=18, seed=6)
+        a = run_checkpointed(PCA(u, select="name CA", n_components=3),
+                             str(tmp_path / "p.npz"), chunk_frames=5,
+                             backend="jax", batch_size=5)
+        ref = PCA(u, select="name CA", n_components=3).run(backend="serial")
+        np.testing.assert_allclose(
+            np.asarray(a.results.variance), ref.results.variance,
+            rtol=5e-2, atol=1e-3 * float(ref.results.variance[0]))
+
+        w = make_water_universe(n_waters=20, n_frames=12, box=12.0, seed=7)
+        ow = w.select_atoms("name OW")
+        d = run_checkpointed(DensityAnalysis(ow, delta=2.0),
+                             str(tmp_path / "d.npz"), chunk_frames=4,
+                             backend="jax", batch_size=4)
+        dref = DensityAnalysis(ow, delta=2.0).run(backend="serial")
+        np.testing.assert_allclose(d.results.grid, dref.results.grid,
+                                   atol=1e-6)
+
     def test_rejects_serial_and_timeseries(self, tmp_path):
         u = make_protein_universe(n_residues=4, n_frames=4, seed=6)
         with pytest.raises(ValueError, match="serial"):
