@@ -12,7 +12,6 @@ Coordinates are already in Å.  Unit cell on disk is XTLABC order
 from __future__ import annotations
 
 import ctypes
-import os
 
 import numpy as np
 
