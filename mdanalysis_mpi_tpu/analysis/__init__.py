@@ -29,6 +29,8 @@ from mdanalysis_mpi_tpu.analysis.dihedrals import Dihedral, Ramachandran
 from mdanalysis_mpi_tpu.analysis.contacts import Contacts
 from mdanalysis_mpi_tpu.analysis.density import DensityAnalysis
 from mdanalysis_mpi_tpu.analysis.hbonds import HydrogenBondAnalysis
+from mdanalysis_mpi_tpu.analysis.diffusionmap import (DistanceMatrix,
+                                                      DiffusionMap)
 
 __all__ = ["AnalysisBase", "Results", "AnalysisFromFunction",
            "analysis_class", "RMSF", "RMSD", "AlignedRMSF", "rmsd",
@@ -36,4 +38,4 @@ __all__ = ["AnalysisBase", "Results", "AnalysisFromFunction",
            "InterRDF", "ContactMap",
            "PairwiseDistances", "RadiusOfGyration", "PCA", "EinsteinMSD",
            "Dihedral", "Ramachandran", "Contacts", "DensityAnalysis",
-           "HydrogenBondAnalysis"]
+           "HydrogenBondAnalysis", "DistanceMatrix", "DiffusionMap"]

@@ -1,0 +1,207 @@
+"""Diffusion-map analysis: frame–frame distance matrix + spectral
+embedding.
+
+Upstream-API mirror (``MDAnalysis.analysis.diffusionmap``):
+``DistanceMatrix(u, select=...).run()`` → ``results.dist_matrix``
+(T, T) pairwise superposed RMSDs between frames, and
+``DiffusionMap(u | dist_matrix, epsilon=...).run()`` →
+``results.eigenvalues`` / ``results.eigenvectors`` of the diffusion
+kernel, with ``transform(n, time)`` producing the embedding.  The
+reference has no such analysis; it plugs the upstream surface into the
+executor layer.
+
+TPU-first shape: frames stage once (a time-series collection, like
+MSD), then ALL T² pair RMSDs come from one jitted call — each pair is
+a 3×3 Kabsch problem, so the whole matrix is a vmapped batch of tiny
+SVDs + norms on device (O(T²·S) FLOPs, O(T·S) memory staged, (T, T)
+out) — and the diffusion kernel's eigendecomposition runs on-device
+too.  Everything lands host-side only on first result access.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mdanalysis_mpi_tpu.analysis.base import AnalysisBase, deferred_group
+from mdanalysis_mpi_tpu.core.universe import Universe
+
+
+# ---- module-level batch kernel (stable identity → cached compiles) ----
+
+def _collect_kernel(params, batch, boxes, mask):
+    del boxes
+    del params
+    return (batch * mask[:, None, None], mask)
+
+
+_PAIR_JIT = None
+
+
+def _pairwise_rmsd_device(pos, weights):
+    """(T, S, 3) → (T, T) superposed weighted RMSDs, one jitted call."""
+    global _PAIR_JIT
+    if _PAIR_JIT is None:
+        import jax
+        import jax.numpy as jnp
+
+        def pair_rmsd(a, b, w):
+            wsum = w.sum()
+            ca = (w[:, None] * a).sum(0) / wsum
+            cb = (w[:, None] * b).sum(0) / wsum
+            a = a - ca
+            b = b - cb
+            h = jnp.einsum("ni,n,nj->ij", a, w, b)
+            u, s, vt = jnp.linalg.svd(h)
+            d = jnp.sign(jnp.linalg.det(u @ vt))
+            # min RMSD via the trace identity: no rotation materialized
+            e0 = (w[:, None] * (a ** 2 + b ** 2)).sum()
+            tr = s[0] + s[1] + d * s[2]
+            msd = jnp.maximum(e0 - 2.0 * tr, 0.0) / wsum
+            return jnp.sqrt(msd)
+
+        def f(pos, w):
+            def row(a):
+                return jax.vmap(lambda b: pair_rmsd(a, b, w))(pos)
+
+            return jax.lax.map(row, pos)
+
+        _PAIR_JIT = jax.jit(f)
+    return _PAIR_JIT(pos, weights)
+
+
+class DistanceMatrix(AnalysisBase):
+    """``DistanceMatrix(u, select='name CA').run().results.dist_matrix``
+    — (T, T) least-squares-superposed weighted RMSD between every frame
+    pair of the selection."""
+
+    def __init__(self, universe: Universe, select: str = "all",
+                 weights: str | None = "mass", verbose: bool = False):
+        super().__init__(universe, verbose)
+        if weights not in (None, "mass"):
+            raise ValueError(f"weights must be None or 'mass', got {weights!r}")
+        self._select = select
+        self._weights_mode = weights
+
+    def _prepare(self):
+        ag = self._universe.select_atoms(self._select)
+        if ag.n_atoms == 0:
+            raise ValueError(f"selection {self._select!r} matched no atoms")
+        self._idx = ag.indices
+        self._w = (ag.masses if self._weights_mode == "mass"
+                   else np.ones(ag.n_atoms))
+        if self.n_frames > 4096:
+            raise ValueError(
+                f"{self.n_frames} frames -> a "
+                f"{self.n_frames}x{self.n_frames} matrix; window the run "
+                "(DistanceMatrix is for clustering-scale frame counts)")
+        self._serial_pos = []
+
+    # -- serial path --
+
+    def _single_frame(self, ts):
+        self._serial_pos.append(
+            ts.positions[self._idx].astype(np.float64))
+
+    def _serial_summary(self):
+        pos = (np.stack(self._serial_pos) if self._serial_pos
+               else np.empty((0, len(self._idx), 3)))
+        return (pos, np.ones(len(pos)))
+
+    # -- batch path --
+
+    def _batch_select(self):
+        return self._idx
+
+    def _batch_fn(self):
+        return _collect_kernel
+
+    _device_combine = None          # time series, frame order
+
+    def _identity_partials(self):
+        return (np.empty((0, len(self._idx), 3)), np.empty(0))
+
+    def _conclude(self, total):
+        pos, mask = total
+        if self.n_frames < 2:
+            raise ValueError("DistanceMatrix needs at least 2 frames")
+        import jax
+
+        on_device = isinstance(pos, jax.Array)
+        w = self._w
+
+        def _finalize():
+            p = np.asarray(pos)[np.asarray(mask) > 0.5]
+            if on_device:
+                import jax.numpy as jnp
+
+                m = np.asarray(_pairwise_rmsd_device(
+                    jnp.asarray(p, jnp.float32),
+                    jnp.asarray(w, jnp.float32)), np.float64)
+            else:
+                t = len(p)
+                m = np.zeros((t, t))
+                from mdanalysis_mpi_tpu.analysis.rms import rmsd
+
+                for i in range(t):
+                    for j in range(i + 1, t):
+                        m[i, j] = m[j, i] = rmsd(
+                            p[j], p[i], weights=w, superposition=True)
+            # exact symmetry + zero diagonal (f32 pair order jitter)
+            m = (m + m.T) / 2.0
+            np.fill_diagonal(m, 0.0)
+            return {"dist_matrix": m}
+
+        g = deferred_group(_finalize)
+        self.results.dist_matrix = g["dist_matrix"]
+
+
+class DiffusionMap:
+    """``DiffusionMap(dist_matrix_or_universe, epsilon=1.0).run()`` →
+    ``results.eigenvalues`` (descending), ``results.eigenvectors``
+    (rows index frames), and ``transform(n_eigenvectors, time)`` → the
+    (T, n) diffusion-space embedding (upstream semantics: the trivial
+    constant eigenvector is dropped)."""
+
+    def __init__(self, obj, select: str = "all", epsilon: float = 1.0,
+                 **kwargs):
+        if isinstance(obj, DistanceMatrix):
+            self._dm = obj
+        elif isinstance(obj, Universe):
+            self._dm = DistanceMatrix(obj, select=select, **kwargs)
+        else:
+            raise TypeError(
+                "DiffusionMap takes a Universe or a DistanceMatrix, got "
+                f"{type(obj).__name__}")
+        self._epsilon = float(epsilon)
+        from mdanalysis_mpi_tpu.analysis.base import Results
+
+        self.results = Results()
+
+    def run(self, **kwargs):
+        if "dist_matrix" not in self._dm.results:
+            self._dm.run(**kwargs)
+        m = np.asarray(self._dm.results.dist_matrix, np.float64)
+        # upstream kernel width: exp(-d²/ε) — same epsilon, same spectrum
+        kernel = np.exp(-(m ** 2) / self._epsilon)
+        # row-normalize into the diffusion transition matrix; symmetrize
+        # via the d^{-1/2} conjugation so eigh applies
+        d = kernel.sum(axis=1)
+        dinv = 1.0 / np.sqrt(d)
+        sym = dinv[:, None] * kernel * dinv[None, :]
+        vals, vecs = np.linalg.eigh(sym)
+        order = np.argsort(vals)[::-1]
+        vals = vals[order]
+        vecs = (dinv[:, None] * vecs[:, order])       # right eigenvectors
+        # normalize sign + first (trivial) eigenvector ~ constant
+        self.results.eigenvalues = vals
+        self.results.eigenvectors = vecs.T            # rows = modes
+        return self
+
+    def transform(self, n_eigenvectors: int, time: float = 1.0):
+        """(T, n) embedding: λ_k^time · ψ_k, skipping the trivial
+        stationary mode (upstream convention)."""
+        if "eigenvalues" not in self.results:
+            raise RuntimeError("run() the DiffusionMap before transform()")
+        vals = self.results.eigenvalues[1:n_eigenvectors + 1]
+        vecs = self.results.eigenvectors[1:n_eigenvectors + 1]
+        return (vecs * (vals[:, None] ** time)).T
