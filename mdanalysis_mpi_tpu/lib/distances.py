@@ -90,6 +90,19 @@ def calc_bonds(coords1, coords2, box=None, backend: str = "numpy") -> np.ndarray
     return np.sqrt((disp ** 2).sum(-1))
 
 
+def apply_PBC(coords, box) -> np.ndarray:
+    """Map coordinates into the primary unit cell (upstream
+    ``lib.distances.apply_PBC``); float32 out like upstream."""
+    from mdanalysis_mpi_tpu.core.box import box_to_vectors, wrap_positions
+
+    dims = _dims_of(box)
+    if dims is None:
+        raise ValueError("apply_PBC needs a box")
+    m = box_to_vectors(np.asarray(dims, np.float64))
+    return wrap_positions(
+        np.asarray(coords, np.float64).reshape(-1, 3), m).astype(np.float32)
+
+
 def calc_angles(coords1, coords2, coords3, box=None) -> np.ndarray:
     """Angle at the APEX ``coords2`` of each (a, b, c) triple, in
     RADIANS (upstream ``lib.distances.calc_angles``); minimum-image

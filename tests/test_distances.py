@@ -488,3 +488,13 @@ class TestMdamath:
             p[None], np.array([[0, 1, 2, 3]]))[0, 0])
         got = mdamath.dihedral(p[1] - p[0], p[2] - p[1], p[3] - p[2])
         np.testing.assert_allclose(got, want, atol=1e-12)
+
+
+def test_apply_pbc():
+    from mdanalysis_mpi_tpu.lib.distances import apply_PBC
+
+    box = np.array([10.0, 10, 10, 90, 90, 90])
+    got = apply_PBC(np.array([[12.0, -3.0, 5.0]]), box)
+    np.testing.assert_allclose(got, [[2.0, 7.0, 5.0]], atol=1e-5)
+    with pytest.raises(ValueError, match="box"):
+        apply_PBC(np.zeros((1, 3)), None)
