@@ -98,9 +98,12 @@ class InterRDF(AnalysisBase):
                  range: tuple[float, float] = (0.0, 15.0),
                  tile: int = 1024, engine: str = "auto",
                  exclusion_block: tuple[int, int] | None = None,
-                 verbose: bool = False):
+                 norm: str = "rdf", verbose: bool = False):
         if g1.universe is not g2.universe:
             raise ValueError("g1 and g2 must belong to the same Universe")
+        if norm not in ("rdf", "density", "none"):
+            raise ValueError(
+                f"norm must be 'rdf', 'density' or 'none', got {norm!r}")
         if engine not in ("auto", "pallas", "xla", "ring"):
             raise ValueError(
                 f"engine must be 'auto', 'pallas', 'xla' or 'ring', "
@@ -127,6 +130,7 @@ class InterRDF(AnalysisBase):
         self._range = (float(range[0]), float(range[1]))
         self._tile = int(tile)
         self._engine = engine
+        self._norm = norm
         self._exclusion_block = exclusion_block
 
     def _prepare(self):
@@ -283,6 +287,7 @@ class InterRDF(AnalysisBase):
         # access of .results.count / .results.rdf.
         resolved_engine = getattr(self, "_resolved_engine", None)
         identical = self._identical
+        norm = self._norm
         n_a, n_b = self._g1.n_atoms, self._g2.n_atoms
         # pairs the kernels never count must leave the normalization too
         # (upstream subtracts xA·xB·nblocks); computed exactly, including
@@ -328,7 +333,15 @@ class InterRDF(AnalysisBase):
             vols = 4.0 / 3.0 * np.pi * (edges[1:] ** 3 - edges[:-1] ** 3)
             n_pairs = n_a * n_b - n_excluded
             density = n_pairs / (vol_sum / t)
-            return {"count": counts, "rdf": counts / (density * vols * t)}
+            if norm == "rdf":
+                rdf = counts / (density * vols * t)
+            elif norm == "density":
+                # pair count per shell volume per frame (upstream
+                # norm='density': the un-normalized pair density)
+                rdf = counts / (vols * t)
+            else:
+                rdf = counts.copy()
+            return {"count": counts, "rdf": rdf}
 
         from mdanalysis_mpi_tpu.analysis.base import deferred_group
 

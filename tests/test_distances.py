@@ -498,3 +498,23 @@ def test_apply_pbc():
     np.testing.assert_allclose(got, [[2.0, 7.0, 5.0]], atol=1e-5)
     with pytest.raises(ValueError, match="box"):
         apply_PBC(np.zeros((1, 3)), None)
+
+
+def test_interrdf_norm_modes():
+    from mdanalysis_mpi_tpu.analysis import InterRDF
+
+    u = make_water_universe(n_waters=40, n_frames=3, box=12.0)
+    ow = u.select_atoms("name OW")
+    kw = dict(nbins=20, range=(0.0, 6.0))
+    full = InterRDF(ow, ow, **kw).run(backend="serial")
+    dens = InterRDF(ow, ow, norm="density", **kw).run(backend="serial")
+    none = InterRDF(ow, ow, norm="none", **kw).run(backend="serial")
+    # none == raw counts; density == counts/(shell_vol*frames);
+    # rdf == density / ideal-gas pair density
+    np.testing.assert_allclose(none.results.rdf, full.results.count)
+    edges = np.linspace(0, 6, 21)
+    vols = 4 / 3 * np.pi * (edges[1:] ** 3 - edges[:-1] ** 3)
+    np.testing.assert_allclose(dens.results.rdf,
+                               full.results.count / (vols * 3), rtol=1e-10)
+    with pytest.raises(ValueError, match="norm"):
+        InterRDF(ow, ow, norm="bogus", **kw)
