@@ -135,3 +135,35 @@ class PairwiseDistances(AnalysisBase):
     def _conclude(self, total):
         self.results.distances = np.asarray(total)
         self.results.n_frames = len(self.results.distances)
+
+
+def dist(ag1, ag2, offset: int = 0, box=None):
+    """Row-wise distances between two equal-sized AtomGroups on the
+    CURRENT frame (upstream ``analysis.distances.dist``): returns
+    ``(resids1 + offset, resids2 + offset, distances)``."""
+    if ag1.n_atoms != ag2.n_atoms:
+        raise ValueError(
+            f"groups have different sizes ({ag1.n_atoms}, {ag2.n_atoms})")
+    from mdanalysis_mpi_tpu.ops.host import minimum_image
+
+    dims = None if box is None else np.asarray(box)
+    disp = minimum_image(
+        ag1.positions.astype(np.float64) - ag2.positions.astype(np.float64),
+        dims)
+    d = np.sqrt((disp ** 2).sum(-1))
+    return ag1.resids + offset, ag2.resids + offset, d
+
+
+def between(group, A, B, distance: float):
+    """Atoms of ``group`` within ``distance`` of BOTH groups A and B on
+    the current frame (upstream ``analysis.distances.between``)."""
+    from mdanalysis_mpi_tpu.core.groups import AtomGroup
+    from mdanalysis_mpi_tpu.ops.host import distance_array
+
+    box = group.universe.trajectory.ts.dimensions
+    pos = group.positions.astype(np.float64)
+    near_a = distance_array(pos, A.positions.astype(np.float64),
+                            box).min(axis=1) < distance
+    near_b = distance_array(pos, B.positions.astype(np.float64),
+                            box).min(axis=1) < distance
+    return AtomGroup(group.universe, group.indices[near_a & near_b])

@@ -518,3 +518,31 @@ def test_interrdf_norm_modes():
                                full.results.count / (vols * 3), rtol=1e-10)
     with pytest.raises(ValueError, match="norm"):
         InterRDF(ow, ow, norm="bogus", **kw)
+
+
+def test_analysis_distances_dist_and_between():
+    from mdanalysis_mpi_tpu.analysis.distances import between, dist
+    from mdanalysis_mpi_tpu.testing import make_solvated_universe
+
+    u = make_solvated_universe(n_residues=6, n_waters=30, n_frames=2)
+    ca = u.select_atoms("protein and name CA")
+    cb = u.select_atoms("protein and name CB")
+    r1, r2, d = dist(ca, cb, offset=10)
+    assert d.shape == (6,)
+    np.testing.assert_array_equal(r1, ca.resids + 10)
+    assert (d > 0).all()
+    with pytest.raises(ValueError, match="sizes"):
+        dist(ca, u.select_atoms("protein"))
+
+    w = u.select_atoms("water")
+    mid = between(w, ca, cb, 12.0)
+    # every returned atom really is within 12 A of both groups
+    if mid.n_atoms:
+        from mdanalysis_mpi_tpu.ops.host import distance_array
+        box = u.trajectory.ts.dimensions
+        da = distance_array(mid.positions.astype(np.float64),
+                            ca.positions.astype(np.float64), box)
+        db = distance_array(mid.positions.astype(np.float64),
+                            cb.positions.astype(np.float64), box)
+        assert (da.min(axis=1) < 12.0).all()
+        assert (db.min(axis=1) < 12.0).all()
