@@ -31,6 +31,7 @@ from mdanalysis_mpi_tpu.analysis.density import DensityAnalysis
 from mdanalysis_mpi_tpu.analysis.hbonds import HydrogenBondAnalysis
 from mdanalysis_mpi_tpu.analysis.diffusionmap import (DistanceMatrix,
                                                       DiffusionMap)
+from mdanalysis_mpi_tpu.analysis.vacf import VelocityAutocorr
 
 __all__ = ["AnalysisBase", "Results", "AnalysisFromFunction",
            "analysis_class", "RMSF", "RMSD", "AlignedRMSF", "rmsd",
@@ -38,4 +39,4 @@ __all__ = ["AnalysisBase", "Results", "AnalysisFromFunction",
            "InterRDF", "ContactMap",
            "PairwiseDistances", "RadiusOfGyration", "PCA", "EinsteinMSD",
            "Dihedral", "Ramachandran", "Contacts", "DensityAnalysis",
-           "HydrogenBondAnalysis", "DistanceMatrix", "DiffusionMap"]
+           "HydrogenBondAnalysis", "DistanceMatrix", "DiffusionMap", "VelocityAutocorr"]
