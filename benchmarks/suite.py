@@ -189,12 +189,48 @@ def config5(stack):
             "vs_serial": round(fps / serial, 2)}, check
 
 
+def config6(stack):
+    """Informational (not a BASELINE config): the round-3 analysis
+    families — PCA covariance matmuls and the FFT MSD — on the chip."""
+    del stack
+    from mdanalysis_mpi_tpu.analysis import PCA, EinsteinMSD
+
+    u = make_protein_universe(n_residues=200, n_frames=int(128 * SCALE),
+                              noise=0.3, seed=13)
+    n = u.trajectory.n_frames
+    fps, serial, sf, a = _timed(
+        lambda: PCA(u, select="name CA", n_components=8),
+        n, dict(backend="jax", batch_size=32))
+    uw = make_water_universe(n_waters=500, n_frames=int(64 * SCALE),
+                             seed=13)
+    nm = uw.trajectory.n_frames
+    mfps, mserial, msf, _ = _timed(
+        lambda: EinsteinMSD(uw, select="name OW"),
+        nm, dict(backend="jax", batch_size=16))
+
+    def check():
+        s = PCA(u, select="name CA", n_components=8).run(backend="serial")
+        err = float(np.abs(np.asarray(a.results.variance)
+                           - s.results.variance).max())
+        assert err < 1e-2 * float(s.results.variance[0]), \
+            f"config6 PCA divergence {err}"
+
+    return {"config": 6,
+            "metric": "informational: PCA(200res Ca) + MSD(500 OW)",
+            "value": round(fps, 2), "unit": "frames/s", "backend": "jax",
+            "serial_fps": round(serial, 2), "serial_frames": sf,
+            "vs_serial": round(fps / serial, 2),
+            "msd_fps": round(mfps, 2),
+            "msd_serial_fps": round(mserial, 2),
+            "msd_serial_frames": msf}, check
+
+
 def main():
     # BENCH_SUITE_CONFIGS="1,3,5" runs a subset (default: all)
     wanted = os.environ.get("BENCH_SUITE_CONFIGS")
     wanted = ({int(x) for x in wanted.split(",")} if wanted
-              else {1, 2, 3, 4, 5})
-    configs = (config1, config2, config3, config4, config5)
+              else {1, 2, 3, 4, 5, 6})
+    configs = (config1, config2, config3, config4, config5, config6)
     with contextlib.ExitStack() as stack:
         rows = []
         for i, fn in enumerate(configs, start=1):
