@@ -137,13 +137,19 @@ class PairwiseDistances(AnalysisBase):
         self.results.n_frames = len(self.results.distances)
 
 
-def dist(ag1, ag2, offset: int = 0, box=None):
+def dist(ag1, ag2, offset=0, box=None):
     """Row-wise distances between two equal-sized AtomGroups on the
-    CURRENT frame (upstream ``analysis.distances.dist``): returns
-    ``(resids1 + offset, resids2 + offset, distances)``."""
+    CURRENT frame (upstream ``analysis.distances.dist``): returns a
+    stacked ``(3, N)`` ndarray ``[resids1 + offA, resids2 + offB, d]``.
+    ``offset`` is a single int applied to both resid rows or an
+    ``(offset_A, offset_B)`` pair, matching upstream."""
     if ag1.n_atoms != ag2.n_atoms:
         raise ValueError(
             f"groups have different sizes ({ag1.n_atoms}, {ag2.n_atoms})")
+    try:
+        off_a, off_b = offset
+    except TypeError:
+        off_a = off_b = offset
     from mdanalysis_mpi_tpu.ops.host import minimum_image
 
     dims = None if box is None else np.asarray(box)
@@ -151,7 +157,7 @@ def dist(ag1, ag2, offset: int = 0, box=None):
         ag1.positions.astype(np.float64) - ag2.positions.astype(np.float64),
         dims)
     d = np.sqrt((disp ** 2).sum(-1))
-    return ag1.resids + offset, ag2.resids + offset, d
+    return np.array([ag1.resids + off_a, ag2.resids + off_b, d])
 
 
 def between(group, A, B, distance: float):

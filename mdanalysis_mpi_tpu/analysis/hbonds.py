@@ -210,7 +210,22 @@ class HydrogenBondAnalysis(AnalysisBase):
 
     # -- batch path --
 
+    # the batch kernel materializes dense (nH, nA, 3) candidate tensors
+    # on device (three live inside the per-frame map); past this many
+    # pairs that is multi-GB per frame and will OOM an HBM chip —
+    # upstream sidesteps it with a neighbor search, which is inherently
+    # dynamic-shape and therefore serial-oracle territory here
+    MAX_BATCH_PAIRS = 25_000_000
+
     def _batch_select(self):
+        n_pairs = len(self._h_idx) * len(self._a_idx)
+        if n_pairs > self.MAX_BATCH_PAIRS:
+            raise ValueError(
+                f"{len(self._h_idx)} hydrogens x {len(self._a_idx)} "
+                f"acceptors = {n_pairs} candidate pairs exceeds the dense "
+                f"batch kernel's limit ({self.MAX_BATCH_PAIRS}); narrow "
+                "hydrogens_sel/acceptors_sel or run with "
+                "backend='serial'")
         return self._idx
 
     def _batch_fn(self):

@@ -171,6 +171,10 @@ class PCA(AnalysisBase):
         self._t = 0.0
         self._sx = np.zeros(dim, dtype=np.float64)
         self._sxx = np.zeros((dim, dim), dtype=np.float64)
+        # the serial path caches the host copy of the centered reference
+        # in _single_frame; a second run() recomputes _ref_c/_ref_com
+        # above, so the cache must not survive into it
+        self._ref_np = None
 
     # -- serial path --
 

@@ -363,6 +363,9 @@ class _MomentsToReference(AnalysisBase):
             com = host.weighted_center(ref, self._masses)
             self._ref_sel_c = ref - com
             self._ref_com = com
+        # _single_frame caches the host copy of the centered reference;
+        # it must not survive a re-run (the reference is recomputed above)
+        self._ref_np = None
         self._stream = host.StreamingMoments((len(self._idx), 3))
 
     def _single_frame(self, ts):

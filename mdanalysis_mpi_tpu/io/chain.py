@@ -44,12 +44,7 @@ class ChainReader(ReaderBase):
                 raise ValueError(
                     f"chained trajectory {j} has {r.n_atoms} atoms, "
                     f"the first has {na}")
-        for j, r in enumerate(readers):
-            if r.transformations:
-                raise ValueError(
-                    f"chained trajectory {j} has transformations attached; "
-                    "add them to the ChainReader itself so per-frame and "
-                    "block reads agree")
+        self._check_children(readers)
         self._readers = readers
         self._starts = np.concatenate(
             [[0], np.cumsum([r.n_frames for r in readers])])
@@ -73,12 +68,33 @@ class ChainReader(ReaderBase):
     def reopen(self) -> "ChainReader":
         return ChainReader([r.reopen() for r in self._readers])
 
+    @staticmethod
+    def _check_children(readers) -> None:
+        """No child may carry transformations — per-frame reads go
+        through the raw child ``_read_frame`` while block reads go
+        through the child's ``read_block`` (which applies them), so a
+        transformed child would make the two paths disagree.  Checked at
+        construction AND on every dispatch: ``add_transformations`` on a
+        child AFTER chaining must fail loudly, not skew results."""
+        for j, r in enumerate(readers):
+            if r.transformations:
+                raise ValueError(
+                    f"chained trajectory {j} has transformations attached; "
+                    "add them to the ChainReader itself so per-frame and "
+                    "block reads agree")
+
     def _locate(self, i: int) -> tuple[int, int]:
         k = int(np.searchsorted(self._starts, i, side="right")) - 1
         return k, i - int(self._starts[k])
 
     def _read_frame(self, i: int) -> Timestep:
         k, local = self._locate(i)
+        # per-frame hot path: only the serving child can skew this read
+        if self._readers[k].transformations:
+            raise ValueError(
+                f"chained trajectory {k} has transformations attached; "
+                "add them to the ChainReader itself so per-frame and "
+                "block reads agree")
         ts = self._readers[k]._read_frame(local)
         ts.frame = i                     # global numbering
         return ts
@@ -97,6 +113,7 @@ class ChainReader(ReaderBase):
             i += n * step
 
     def read_block(self, start: int, stop: int, sel=None, step: int = 1):
+        self._check_children(self._readers)
         if not 0 <= start <= stop <= self.n_frames:
             raise IndexError(
                 f"block [{start},{stop}) out of range [0,{self.n_frames}]")
@@ -127,6 +144,7 @@ class ChainReader(ReaderBase):
 
     def stage_block(self, start: int, stop: int, sel=None,
                     quantize: bool = False):
+        self._check_children(self._readers)
         if not 0 <= start <= stop <= self.n_frames:
             raise IndexError(
                 f"block [{start},{stop}) out of range [0,{self.n_frames}]")

@@ -65,8 +65,32 @@ class TrajectoryWriter:
         self._dt = dt
         self._box_flag: bool | None = None   # DCD: cell blocks all-or-none
         self._file = open(path, "wb")
-        self._chunk_path = path + _CHUNK_SUFFIX
+        # per-instance temp name: two writers targeting the same output
+        # path (or a crashed run's leftover) must not clobber each
+        # other's in-flight chunk file
+        self._chunk_path = (f"{path}{_CHUNK_SUFFIX}"
+                            f".{os.getpid()}.{id(self):x}")
+        self._reap_orphans(path)
         self._closed = False
+
+    @staticmethod
+    def _reap_orphans(path: str) -> None:
+        """Best-effort removal of chunk temp files a hard-killed run
+        left behind for THIS output path — only when the embedded pid is
+        verifiably dead (a live pid may still be mid-write)."""
+        import glob
+
+        for p in glob.glob(glob.escape(path + _CHUNK_SUFFIX) + ".*"):
+            try:
+                pid = int(p[len(path + _CHUNK_SUFFIX) + 1:].split(".")[0])
+                os.kill(pid, 0)          # raises if no such process
+            except ProcessLookupError:
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
+            except (ValueError, OSError, PermissionError):
+                pass
 
     # -- input normalization --
 

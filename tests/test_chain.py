@@ -94,6 +94,23 @@ class TestChainReader:
         with pytest.raises(ValueError, match="ChainReader itself"):
             ChainReader([r0, paths[1]])
 
+    def test_child_transformations_after_chaining_rejected(self, parts):
+        """add_transformations on a CHILD after construction must fail
+        at dispatch, not silently skew per-frame vs block reads
+        (ADVICE r3)."""
+        from mdanalysis_mpi_tpu import transformations as trf
+
+        u, block, paths = parts
+        c = ChainReader(paths)
+        c[0]                                   # healthy before
+        c._readers[0].add_transformations(trf.translate([1.0, 0, 0]))
+        with pytest.raises(ValueError, match="ChainReader itself"):
+            c[0]
+        with pytest.raises(ValueError, match="ChainReader itself"):
+            c.read_block(0, 2)
+        with pytest.raises(ValueError, match="ChainReader itself"):
+            c.stage_block(0, 2)
+
     def test_chain_level_transformations_consistent(self, parts):
         from mdanalysis_mpi_tpu import transformations as trf
 

@@ -527,10 +527,18 @@ def test_analysis_distances_dist_and_between():
     u = make_solvated_universe(n_residues=6, n_waters=30, n_frames=2)
     ca = u.select_atoms("protein and name CA")
     cb = u.select_atoms("protein and name CB")
-    r1, r2, d = dist(ca, cb, offset=10)
-    assert d.shape == (6,)
+    out = dist(ca, cb, offset=10)
+    # upstream contract: one stacked (3, N) ndarray, not a tuple
+    assert isinstance(out, np.ndarray) and out.shape == (3, 6)
+    r1, r2, d = out
     np.testing.assert_array_equal(r1, ca.resids + 10)
+    np.testing.assert_array_equal(r2, cb.resids + 10)
     assert (d > 0).all()
+    # offset may also be an (offset_A, offset_B) pair
+    ra, rb, d2 = dist(ca, cb, offset=(10, 20))
+    np.testing.assert_array_equal(ra, ca.resids + 10)
+    np.testing.assert_array_equal(rb, cb.resids + 20)
+    np.testing.assert_allclose(d2, d)
     with pytest.raises(ValueError, match="sizes"):
         dist(ca, u.select_atoms("protein"))
 

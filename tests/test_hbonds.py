@@ -112,6 +112,15 @@ class TestWaterBox:
             backend="serial")
         assert r2.results.count[0] == 1.0
 
+    def test_batch_pair_guard(self, monkeypatch):
+        """The dense batch kernel must refuse pair counts that would
+        OOM a device (ADVICE r3); the serial path stays available."""
+        u = make_water_universe(n_waters=8, n_frames=2)
+        monkeypatch.setattr(HydrogenBondAnalysis, "MAX_BATCH_PAIRS", 10)
+        with pytest.raises(ValueError, match="candidate pairs"):
+            HydrogenBondAnalysis(u).run(backend="jax", batch_size=2)
+        HydrogenBondAnalysis(u).run(backend="serial")   # unaffected
+
     def test_validation(self):
         u = make_water_universe(n_waters=4, n_frames=1)
         with pytest.raises(ValueError, match="no atoms"):

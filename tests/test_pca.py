@@ -81,6 +81,19 @@ class TestPCA:
             np.asarray(j.results.variance), s.results.variance,
             rtol=5e-2, atol=1e-3 * float(s.results.variance[0]))
 
+    def test_rerun_recomputes_aligned_reference(self):
+        """A second run() over a different window must not reuse the
+        first window's cached host reference (ADVICE r3: stale
+        _ref_np survived _prepare)."""
+        u = make_protein_universe(n_residues=5, n_frames=24, noise=0.3)
+        p = PCA(u, select="name CA", align=True)
+        p.run(stop=8, backend="serial")        # caches ref of frames [0,8)
+        again = p.run(backend="serial")        # full window: new reference
+        fresh = PCA(u, select="name CA", align=True).run(backend="serial")
+        np.testing.assert_allclose(np.asarray(again.results.cov),
+                                   np.asarray(fresh.results.cov),
+                                   rtol=1e-12, atol=1e-12)
+
     def test_transform_variances_match_eigenvalues(self):
         u = make_protein_universe(n_residues=5, n_frames=64, noise=0.4)
         p = PCA(u, select="name CA", n_components=4).run(backend="serial")

@@ -221,3 +221,25 @@ def test_aligntraj_in_memory_false_needs_name_for_memory_reader():
     u = make_protein_universe(n_residues=4, n_frames=4)
     with pytest.raises(ValueError, match="filename"):
         AlignTraj(u, select="name CA", in_memory=False)
+
+
+def test_chunk_temp_path_unique_per_writer(tmp_path):
+    """Two writers (or a crashed run's leftover) must not share the
+    chunk temp file (ADVICE r3: fixed suffix clobbered in-flight
+    chunks)."""
+    p1 = str(tmp_path / "a.xtc")
+    w1 = TrajectoryWriter(p1)
+    w2 = TrajectoryWriter(str(tmp_path / "b.xtc"))
+    assert w1._chunk_path != w2._chunk_path
+    assert w1._chunk_path != TrajectoryWriter(p1)._chunk_path
+    # a crashed run's leftover under the OLD fixed name must survive
+    # another writer's write/cleanup cycle
+    stale = p1 + ".mdtpu_chunk"
+    with open(stale, "wb") as f:
+        f.write(b"leftover")
+    w1.write(_frames(n=2))
+    w1.close()
+    assert os.path.exists(stale)
+    assert not os.path.exists(w1._chunk_path)
+    block, _ = _read_all(p1)
+    assert block.shape == (2, 17, 3)
