@@ -14,6 +14,7 @@ Grammar (recursive descent)::
     not_expr := 'not' not_expr | primary
     primary  := '(' expr ')' | 'around' number not_expr
               | 'sphzone' number not_expr | 'point' x y z number
+              | 'sphlayer' rIn rExt not_expr
               | 'cyzone' rExt zMax zMin not_expr
               | 'cylayer' rIn rExt zMax zMin not_expr
               | 'bonded' not_expr
@@ -39,6 +40,9 @@ them with ``around`` constantly):
 
 - ``sphzone R inner`` — atoms within R Å of the center of geometry of
   ``inner`` (inclusive: ``inner`` atoms inside the sphere stay).
+- ``sphlayer rIn rExt inner`` — spherical annulus: atoms between rIn
+  and rExt Å of ``inner``'s center of geometry (upstream
+  SphericalLayerSelection; bounds inclusive).
 - ``point x y z R`` — atoms within R Å of the fixed point (x, y, z).
 - ``byres inner`` — expand to every atom of any residue containing an
   ``inner`` atom.
@@ -83,7 +87,7 @@ _RESERVED = {
     "name", "resname", "segid", "chainID", "chainid", "element", "type",
     "resid", "resnum",
     "index", "bynum", "prop", "around",
-    "byres", "same", "as", "sphzone", "point", "global",
+    "byres", "same", "as", "sphzone", "sphlayer", "point", "global",
     "cyzone", "cylayer", "bonded",
 }
 
@@ -184,6 +188,15 @@ class _Parser:
             return self._around(self._cutoff(tok), self.not_expr())
         if tok == "sphzone":
             return self._sphzone(self._cutoff(tok), self.not_expr())
+        if tok == "sphlayer":
+            r_in = self._cutoff(tok)
+            r_ext = self._cutoff(tok)
+            if r_in >= r_ext:
+                raise SelectionError(
+                    f"sphlayer inner radius {r_in} must be below outer "
+                    f"{r_ext}")
+            return self._sphzone(r_ext, self.not_expr(), r_in=r_in,
+                                 kw="sphlayer")
         if tok == "point":
             try:
                 x, y, z = (float(self.next()) for _ in range(3))
@@ -305,8 +318,10 @@ class _Parser:
             return np.zeros_like(inner)
         return np.isin(attr, np.unique(attr[inner]))
 
-    def _sphere(self, center: np.ndarray, cutoff: float) -> np.ndarray:
-        """Atoms within ``cutoff`` of ``center`` (minimum image)."""
+    def _sphere(self, center: np.ndarray, cutoff: float,
+                r_in: float | None = None) -> np.ndarray:
+        """Atoms within ``cutoff`` of ``center`` (minimum image); with
+        ``r_in`` set, only atoms also beyond ``r_in`` (an annulus)."""
         positions, box = self._coords()
         if positions is None:
             raise SelectionError(
@@ -318,20 +333,26 @@ class _Parser:
         box = None if box is None else np.asarray(box, np.float64)
         disp = minimum_image(pos - np.asarray(center, np.float32), box)
         d2 = np.einsum("ai,ai->a", disp, disp)
-        return d2 <= np.float64(cutoff) ** 2
+        mask = d2 <= np.float64(cutoff) ** 2
+        if r_in is not None:
+            mask &= d2 >= np.float64(r_in) ** 2
+        return mask
 
-    def _sphzone(self, cutoff: float, inner: np.ndarray) -> np.ndarray:
+    def _sphzone(self, cutoff: float, inner: np.ndarray,
+                 r_in: float | None = None,
+                 kw: str = "sphzone") -> np.ndarray:
         """Atoms within ``cutoff`` of the center of geometry of ``inner``
-        (upstream SphericalZoneSelection — inclusive of ``inner``)."""
+        (upstream SphericalZoneSelection — inclusive of ``inner``); with
+        ``r_in``, the ``sphlayer`` annulus [r_in, cutoff] instead."""
         inner = self._scoped(inner)
         if not inner.any():
             return np.zeros_like(inner)
         positions, _ = self._coords()
         if positions is None:
             raise SelectionError(
-                "'sphzone' is a geometric selection and needs coordinates")
+                f"{kw!r} is a geometric selection and needs coordinates")
         center = np.asarray(positions, np.float64)[inner].mean(axis=0)
-        return self._sphere(center, cutoff)
+        return self._sphere(center, cutoff, r_in=r_in)
 
     def _point(self, xyz: np.ndarray, cutoff: float) -> np.ndarray:
         """Atoms within ``cutoff`` of a fixed point (upstream

@@ -257,6 +257,17 @@ class TestGeometricZones:
         got = u.select_atoms("sphzone 3.0 protein")
         assert list(got.indices) == [0, 1, 2, 4]
 
+    def test_sphlayer_annulus(self):
+        u = self._universe()
+        # distances to protein cog (2,0,0): 1, 1, 2, 7, 2.5 (via PBC)
+        got = u.select_atoms("sphlayer 1.5 5 protein")
+        assert list(got.indices) == [2, 4]
+        # inner bound excludes the 2.0 A atom, keeps the periodic 2.5 A
+        got = u.select_atoms("sphlayer 2.2 5 protein")
+        assert list(got.indices) == [4]
+        with pytest.raises(SelectionError, match="below outer"):
+            u.select_atoms("sphlayer 5 2 protein")
+
     def test_point_fixed_center(self):
         u = self._universe()
         got = u.select_atoms("point 9.0 0.0 0.0 1.5")
