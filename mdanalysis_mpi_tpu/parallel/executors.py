@@ -275,8 +275,21 @@ def _staging_pool():
 def _run_batches(analysis, reader, frames, bs, call, sel_idx,
                  device_put_fn=None, cache: "DeviceBlockCache | None" = None,
                  quantize: bool = False, local_divisor: int = 1,
-                 local_index: int = 0, inv_per_frame: bool = False):
+                 local_index: int = 0, inv_per_frame: bool = False,
+                 prestage: bool = False):
     """Shared batch loop: stage → kernel → DEVICE-side accumulation.
+
+    ``prestage=True`` switches the schedule from interleaved
+    (stage batch i+1 while the device consumes batch i) to
+    DECODE-THEN-WIRE: every batch is host-staged through the fused
+    native decode→gather→quantize path FIRST, with zero device contact,
+    and only then do the device_puts stream out back-to-back (VERDICT
+    r3 next-round #2).  On tunneled targets the transfer client and the
+    decoder compete for the same host core, so interleaving runs the
+    decode at a fraction of its quiet-host rate (measured ~4×); phase
+    separation restores it.  Cost: the staged (selection-gathered,
+    possibly int16) trajectory is resident in host RAM at once — size
+    accordingly (the 10k-frame 50k-atom int16 flagship is ~3 GB).
 
     Partials never leave the device per batch: results are either folded
     on-device with the analysis' module-level ``_device_fold_fn`` (one
@@ -319,22 +332,16 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
     # the cached entries
     xform_fp = getattr(reader, "transformations", ())
 
-    def prepare(ab):
-        """Host side of one batch: read+gather (+quantize) and enqueue
-        the device transfer.  Runs on the prefetch thread so the next
-        batch stages while the device consumes the current one (the
-        double-buffering from SURVEY.md §7 layer 5; NumPy releases the
-        GIL for the big copies)."""
+    def _key(ab):
         a, b = ab
-        key = (reader_fp, tuple(frames[a:b]), bs, quantize, sel_fp,
-               xform_fp)
-        staged = cache.get(key) if cache is not None else None
-        if staged is not None:
-            return staged
-        with TIMERS.phase("stage"):
-            return _prepare_uncached(frames[a:b], key)
+        return (reader_fp, tuple(frames[a:b]), bs, quantize, sel_fp,
+                xform_fp)
 
-    def _prepare_uncached(batch_frames, key):
+    def _host_stage(batch_frames):
+        """Pure host side of one batch: read+gather (+quantize) + pad.
+        No jax call anywhere on this path — the prestage schedule
+        depends on that.  Returns (staged_host_tuple, resident_nbytes).
+        """
         pad_to = bs
         if local_divisor > 1:
             # stage only this process's slice of the global batch
@@ -370,30 +377,77 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
                                 dtype=np.float32)
         staged = ((padded, inv_scale, boxes_p, mask) if quantize
                   else (padded, boxes_p, mask))
+        return staged, padded.nbytes
+
+    def _place(staged, key, nbytes):
+        """Device side: transfer a host-staged tuple and cache it."""
         if device_put_fn is not None:
             staged = device_put_fn(staged)
         if cache is not None:
             # charge this process's resident share of the cached entry:
-            # ``padded`` is the HOST-side block this process staged —
-            # already the 1/local_divisor slice on multi-host — and a
-            # global sharded array keeps exactly those bytes resident
-            # per host, so its nbytes IS the per-host charge
-            cache.put(key, staged, padded.nbytes)
+            # the host block nbytes IS the per-host charge (on
+            # multi-host the staged slice is already 1/local_divisor of
+            # the global batch, and a global sharded array keeps exactly
+            # those bytes resident per host)
+            cache.put(key, staged, nbytes)
         return staged
 
-    with _staging_pool() as pool:
-        fut = pool.submit(prepare, bounds[0]) if bounds else None
-        for i in range(len(bounds)):
-            staged = fut.result()
-            if i + 1 < len(bounds):
-                fut = pool.submit(prepare, bounds[i + 1])
-            with TIMERS.phase("dispatch"):
-                partials = call(*staged)
-                if fold_j is not None:
-                    total = (partials if total is None
-                             else fold_j(total, partials))
-                else:
-                    parts_list.append(partials)
+    def prepare(ab):
+        """Host side of one batch: read+gather (+quantize) and enqueue
+        the device transfer.  Runs on the prefetch thread so the next
+        batch stages while the device consumes the current one (the
+        double-buffering from SURVEY.md §7 layer 5; NumPy releases the
+        GIL for the big copies)."""
+        a, b = ab
+        key = _key(ab)
+        staged = cache.get(key) if cache is not None else None
+        if staged is not None:
+            return staged
+        with TIMERS.phase("stage"):
+            staged, nbytes = _host_stage(frames[a:b])
+        return _place(staged, key, nbytes)
+
+    def consume(staged):
+        nonlocal total
+        with TIMERS.phase("dispatch"):
+            partials = call(*staged)
+            if fold_j is not None:
+                total = (partials if total is None
+                         else fold_j(total, partials))
+            else:
+                parts_list.append(partials)
+
+    if prestage:
+        # phase 1 — decode+stage EVERY batch, zero device contact (the
+        # transfer client stays idle, so the native decoder gets the
+        # whole host core); cache hits stay device-resident
+        items: list = []
+        for ab in bounds:
+            key = _key(ab)
+            hit = cache.get(key) if cache is not None else None
+            if hit is not None:
+                items.append((None, hit, key, 0))
+                continue
+            a, b = ab
+            with TIMERS.phase("stage"):
+                staged_host, nbytes = _host_stage(frames[a:b])
+            items.append((staged_host, None, key, nbytes))
+        # phase 2 — stream the puts back-to-back and dispatch; each
+        # host block is dropped right after its transfer is enqueued
+        for i, (staged_host, staged, key, nbytes) in enumerate(items):
+            if staged is None:
+                with TIMERS.phase("wire"):
+                    staged = _place(staged_host, key, nbytes)
+                items[i] = None
+            consume(staged)
+    else:
+        with _staging_pool() as pool:
+            fut = pool.submit(prepare, bounds[0]) if bounds else None
+            for i in range(len(bounds)):
+                staged = fut.result()
+                if i + 1 < len(bounds):
+                    fut = pool.submit(prepare, bounds[i + 1])
+                consume(staged)
     if fold is not None:
         if fold_j is not None and total is not None:
             import jax
@@ -433,12 +487,16 @@ class JaxExecutor:
 
     def __init__(self, batch_size: int = 128, device=None,
                  block_cache: DeviceBlockCache | None = None,
-                 transfer_dtype: str = "float32"):
+                 transfer_dtype: str = "float32",
+                 prestage: bool = False):
         _validate_transfer_dtype(transfer_dtype)
         self.batch_size = batch_size
         self.device = device
         self.block_cache = block_cache
         self.transfer_dtype = transfer_dtype
+        # decode-then-wire cold schedule (see _run_batches); holds the
+        # staged trajectory in host RAM for the length of the run
+        self.prestage = prestage
 
     def execute(self, analysis, reader, frames, batch_size=None):
         import jax
@@ -462,7 +520,8 @@ class JaxExecutor:
         return _run_batches(
             analysis, reader, frames, bs,
             lambda *staged: kernel(params, *staged), sel_idx,
-            device_put_fn=put, cache=self.block_cache, quantize=quantize)
+            device_put_fn=put, cache=self.block_cache, quantize=quantize,
+            prestage=self.prestage)
 
 
 class MeshExecutor:
@@ -480,13 +539,16 @@ class MeshExecutor:
     def __init__(self, batch_size: int = 64, devices=None,
                  axis_name: str = "data",
                  block_cache: DeviceBlockCache | None = None,
-                 transfer_dtype: str = "float32"):
+                 transfer_dtype: str = "float32",
+                 prestage: bool = False):
         _validate_transfer_dtype(transfer_dtype)
         self.batch_size = batch_size
         self.devices = devices
         self.axis_name = axis_name
         self.block_cache = block_cache
         self.transfer_dtype = transfer_dtype
+        # decode-then-wire cold schedule (see _run_batches)
+        self.prestage = prestage
 
     def _build(self, analysis):
         import jax
@@ -617,7 +679,7 @@ class MeshExecutor:
                 device_put_fn=put, cache=self.block_cache,
                 quantize=self.transfer_dtype == "int16",
                 local_divisor=n_proc, local_index=jax.process_index(),
-                inv_per_frame=True)
+                inv_per_frame=True, prestage=self.prestage)
 
         def put(staged):
             return _put_staged(staged, shardings)
@@ -630,7 +692,8 @@ class MeshExecutor:
             analysis, reader, frames, global_bs,
             lambda *staged: gfn(params, *staged), sel_idx,
             device_put_fn=put, cache=self.block_cache,
-            quantize=self.transfer_dtype == "int16")
+            quantize=self.transfer_dtype == "int16",
+            prestage=self.prestage)
 
     def _execute_ring_multihost(self, analysis, reader, frames, bs, gfn,
                                 shardings, params_specs, params, sel_idx,
@@ -716,7 +779,8 @@ class MeshExecutor:
         return _run_batches(
             analysis, reader, frames, bs,
             lambda *staged: gfn(params, *staged), local_sel,
-            device_put_fn=put, cache=self.block_cache, quantize=False)
+            device_put_fn=put, cache=self.block_cache, quantize=False,
+            prestage=self.prestage)
 
 
 from mdanalysis_mpi_tpu.parallel.mpi import MPIExecutor  # noqa: E402

@@ -1,0 +1,91 @@
+"""Decode-then-wire (prestage) schedule — VERDICT r3 next-round #2.
+
+The cold flagship path pays for interleaving: on a tunneled target the
+transfer client and the native decoder compete for one host core, so
+stage→put→stage→put runs the decode at a fraction of its quiet-host
+rate.  ``prestage=True`` host-stages EVERY batch before the first
+device contact, then streams the puts.  Pinned here: the schedule
+actually separates the phases, results are bit-identical to the
+interleaved schedule, and a shared DeviceBlockCache still serves the
+second run without re-staging.
+"""
+
+import numpy as np
+import pytest
+
+from mdanalysis_mpi_tpu.analysis import AlignedRMSF, RMSD
+from mdanalysis_mpi_tpu.parallel import executors
+from mdanalysis_mpi_tpu.parallel.executors import DeviceBlockCache
+from mdanalysis_mpi_tpu.testing import make_protein_universe
+
+
+def _traced(u, monkeypatch):
+    """Record the order of host-stage vs device-put events."""
+    events = []
+    reader = u.trajectory
+    orig_stage = reader.stage_cached
+
+    def stage_wrap(*a, **k):
+        events.append("stage")
+        return orig_stage(*a, **k)
+
+    reader.stage_cached = stage_wrap
+    orig_put = executors._put_staged
+
+    def put_wrap(*a, **k):
+        events.append("put")
+        return orig_put(*a, **k)
+
+    monkeypatch.setattr(executors, "_put_staged", put_wrap)
+    return events
+
+
+def test_prestage_stages_every_batch_before_first_put(monkeypatch):
+    u = make_protein_universe(n_residues=30, n_frames=32, noise=0.2)
+    events = _traced(u, monkeypatch)
+    RMSD(u.select_atoms("name CA")).run(backend="jax", batch_size=8,
+                                        prestage=True)
+    assert events.count("stage") == 4 and events.count("put") == 4
+    # the defining property: zero device contact until staging is done
+    assert events[:4] == ["stage"] * 4, events
+
+
+def test_prestage_parity_and_cache_reuse(monkeypatch):
+    u = make_protein_universe(n_residues=30, n_frames=24, noise=0.3)
+    s = AlignedRMSF(u, select="name CA").run(backend="serial")
+    interleaved = AlignedRMSF(u, select="name CA").run(
+        backend="jax", batch_size=8, transfer_dtype="int16")
+    cache = DeviceBlockCache()
+    events = _traced(u, monkeypatch)
+    pre = AlignedRMSF(u, select="name CA").run(
+        backend="jax", batch_size=8, transfer_dtype="int16",
+        block_cache=cache, prestage=True)
+    np.testing.assert_allclose(np.asarray(pre.results.rmsf),
+                               s.results.rmsf, atol=1e-3)
+    # same staged bytes -> identical to the interleaved schedule
+    np.testing.assert_array_equal(np.asarray(pre.results.rmsf),
+                                  np.asarray(interleaved.results.rmsf))
+    n_staged = events.count("stage")
+    assert n_staged > 0
+    # a second prestaged run over the shared cache re-stages nothing
+    m0 = cache.misses
+    AlignedRMSF(u, select="name CA").run(
+        backend="jax", batch_size=8, transfer_dtype="int16",
+        block_cache=cache, prestage=True)
+    assert cache.misses == m0
+    assert cache.hits > 0
+    assert events.count("stage") == n_staged
+
+
+def test_prestage_on_mesh_backend():
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the multi-device CPU mesh")
+    u = make_protein_universe(n_residues=30, n_frames=32, noise=0.3)
+    s = AlignedRMSF(u, select="name CA").run(backend="serial")
+    m = AlignedRMSF(u, select="name CA").run(
+        backend="mesh", batch_size=4, transfer_dtype="int16",
+        prestage=True)
+    np.testing.assert_allclose(np.asarray(m.results.rmsf),
+                               s.results.rmsf, atol=1e-3)

@@ -82,6 +82,47 @@ ds = DensityAnalysis(ow, delta=2.0).run(backend="serial")
 derr = float(np.abs(d.results.grid - ds.results.grid).max())
 assert derr < 1e-6, f"density diverged on chip: {derr:.2e}"
 print(f"density err {derr:.2e}")
+
+# --- flagship cold-path mechanisms on chip (VERDICT r3 next-round #5):
+# a real XTC decoded through the C++ codec, fused int16 staging via the
+# decode-then-wire prestage path, and DeviceBlockCache reuse across two
+# runs — all differenced against the serial f64 oracle ---
+import os as _os
+import tempfile
+
+from mdanalysis_mpi_tpu.core.topology import Topology
+from mdanalysis_mpi_tpu.core.universe import Universe
+from mdanalysis_mpi_tpu.io.xtc import XTCReader, write_xtc
+from mdanalysis_mpi_tpu.parallel.executors import DeviceBlockCache
+
+rng = np.random.default_rng(17)
+base = rng.normal(scale=12.0, size=(600, 3)).astype(np.float32)
+frames = base[None] + rng.normal(
+    scale=0.4, size=(64, 600, 3)).astype(np.float32)
+path = _os.path.join(tempfile.mkdtemp(), "smoke.xtc")
+write_xtc(path, frames,
+          dimensions=np.array([40.0, 40, 40, 90, 90, 90]))
+topo = Topology(names=np.tile(np.array(["CA", "HA"]), 300),
+                resnames=np.full(600, "ALA"),
+                resids=np.arange(600) // 2 + 1)
+uf = Universe(topo, XTCReader(path))
+sf = AlignedRMSF(uf, select="heavy").run(backend="serial")
+cachef = DeviceBlockCache()
+a1 = AlignedRMSF(uf, select="heavy").run(
+    backend="jax", batch_size=16, transfer_dtype="int16",
+    block_cache=cachef, prestage=True)
+e1 = float(np.abs(a1.results.rmsf - sf.results.rmsf).max())
+assert e1 < 1e-3, f"file-backed int16 cold run diverged: {e1:.2e}"
+m0 = cachef.misses
+a2 = AlignedRMSF(uf, select="heavy").run(
+    backend="jax", batch_size=16, transfer_dtype="int16",
+    block_cache=cachef)
+assert cachef.misses == m0, "second run re-staged HBM-resident blocks"
+assert cachef.hits > 0, "DeviceBlockCache never hit on the second run"
+e2 = float(np.abs(a2.results.rmsf - sf.results.rmsf).max())
+assert e2 < 1e-3, f"cache-served second run diverged: {e2:.2e}"
+print(f"file_backed int16 prestage+cache err {e1:.2e}/{e2:.2e} "
+      f"hits {cachef.hits}")
 print("TPU_SMOKE_OK")
 """
 
