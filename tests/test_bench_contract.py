@@ -19,7 +19,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 @pytest.mark.slow
-def test_bench_json_contract():
+def test_bench_json_contract(tmp_path):
+    partial = str(tmp_path / "partial.json")
     env = dict(
         os.environ,
         JAX_PLATFORMS="cpu",
@@ -32,6 +33,7 @@ def test_bench_json_contract():
         # script writes its fixture beside itself in .bench_data (tiny
         # at this scale, globbed away in the finally block below)
         BENCH_SOURCE="file",
+        BENCH_PARTIAL_PATH=partial,
     )
     try:
         proc = subprocess.run([sys.executable,
@@ -39,25 +41,88 @@ def test_bench_json_contract():
                               env=env, capture_output=True, text=True,
                               timeout=600)
         assert proc.returncode == 0, proc.stderr[-3000:]
-        line = proc.stdout.strip().splitlines()[-1]
-        rec = json.loads(line)
-        # the three-metric series, every round (VERDICT r2 next-round #4)
+        out_lines = proc.stdout.strip().splitlines()
+        # exactly ONE stdout JSON line: partial legs go to the file so
+        # the driver's parse cannot land on an in-progress record
+        assert len([ln for ln in out_lines if ln.startswith("{")]) == 1
+        rec = json.loads(out_lines[-1])
+        # the three-metric series, every round (VERDICT r2 next-round
+        # #4), plus the r4 weather/retry telemetry
         for key in ("metric", "value", "unit", "vs_baseline",
                     "cold_value", "cold_vs_baseline",
                     "f32_nocache_value", "f32_nocache_vs_baseline",
                     "serial_fps", "baseline_fps",
                     "serial_file_fps", "file_baseline_fps",
-                    "cold_vs_file_baseline", "divergence"):
+                    "cold_vs_file_baseline", "divergence",
+                    "put_gbps", "decode_fps", "init_wait_s",
+                    "init_probes", "init_log"):
             assert key in rec, f"missing {key} in {sorted(rec)}"
         assert rec["unit"] == "frames/s/chip"
         assert "file-backed XTC" in rec["metric"]
         assert "steady-state" in rec["metric"]
         assert rec["value"] > 0 and rec["cold_value"] > 0
+        assert rec["decode_fps"] > 0 and rec["put_gbps"] > 0
+        assert "status" not in rec          # success record is final
         # the correctness gate actually gated (a number was compared)
         assert 0 <= rec["divergence"] <= 1e-3
+        # the partial file ends as the FINAL record (no in-progress
+        # status), so a later suite run inlines the finished state
+        with open(partial) as f:
+            part = json.loads(f.read())
+        assert part["value"] == rec["value"]
+        assert "status" not in part and "error" not in part
     finally:
         # remove the test-scale fixture AND its offset-index sidecar,
         # whatever generator version produced them
+        import glob
+
+        for p in glob.glob(os.path.join(REPO, ".bench_data",
+                                        "flagship_2000a_96f_*")):
+            os.remove(p)
+
+
+@pytest.mark.slow
+def test_bench_outage_records_host_legs(tmp_path):
+    """An unreachable accelerator must still yield a parseable record
+    carrying every completed host-side leg plus the probe retry log —
+    never a bare null (VERDICT r3 next-round #1)."""
+    partial = str(tmp_path / "partial.json")
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="no_such_platform",   # every probe fails fast
+        BENCH_ATOMS="2000",
+        BENCH_FRAMES="96",
+        BENCH_BATCH="32",
+        BENCH_REPEATS="1",
+        BENCH_SERIAL_FRAMES="8",
+        BENCH_SOURCE="file",
+        BENCH_PARTIAL_PATH=partial,
+        BENCH_INIT_BUDGET="1",              # one probe, then exhaustion
+        BENCH_PROBE_SLEEP="1",
+        # keep one probe cheap even if the site hook rewrites the bogus
+        # platform into a real (possibly dead) one and the probe hangs
+        BENCH_PROBE_TIMEOUT="30",
+    )
+    try:
+        proc = subprocess.run([sys.executable,
+                               os.path.join(REPO, "bench.py")],
+                              env=env, capture_output=True, text=True,
+                              timeout=600)
+        assert proc.returncode == 1, proc.stderr[-3000:]
+        rec = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert rec["value"] is None
+        assert "unreachable" in rec["error"]
+        # host-side legs survived the outage
+        assert rec["serial_fps"] > 0
+        assert rec["serial_file_fps"] > 0
+        assert rec["decode_fps"] > 0
+        # the retry log shows what init actually did
+        assert rec["init_log"] and rec["init_log"][0]["attempt"] == 1
+        # the incremental file matches the emitted record's legs
+        with open(partial) as f:
+            part = json.loads(f.read())
+        assert part["serial_fps"] == rec["serial_fps"]
+    finally:
         import glob
 
         for p in glob.glob(os.path.join(REPO, ".bench_data",
