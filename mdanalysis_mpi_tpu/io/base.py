@@ -62,6 +62,22 @@ class BlockCache:
 HostStageCache = BlockCache
 
 
+def norm_quantize(quantize) -> str | None:
+    """Normalize a staging-quantization request: ``False``/``None`` →
+    None, ``True`` → ``"int16"`` (backward compatible), ``"int16"`` /
+    ``"int8"`` pass through.  One normalization shared by every reader
+    and cache key, so ``True`` and ``"int16"`` can never produce
+    distinct cache entries for identical bytes."""
+    if not quantize:
+        return None
+    if quantize is True:
+        return "int16"
+    if quantize in ("int16", "int8"):
+        return quantize
+    raise ValueError(
+        f"quantize must be a bool, 'int16' or 'int8', got {quantize!r}")
+
+
 def sel_fingerprint(sel) -> int | None:
     """Content hash of a selection index array — the cache-key component
     shared by the host stage cache and the executors' device block cache
@@ -224,23 +240,28 @@ class ReaderBase:
         return None
 
     def stage_block(self, start: int, stop: int,
-                    sel: np.ndarray | None = None, quantize: bool = False):
-        """Staging primitive: ``read_block`` plus optional fused int16
+                    sel: np.ndarray | None = None, quantize=False):
+        """Staging primitive: ``read_block`` plus optional fused
         quantization → (block, boxes, inv_scale).
 
-        ``inv_scale`` is None on the float32 path.  Quantization runs in
-        the native C++ codec when available (the host staging core is
-        the throughput bottleneck, SURVEY.md §7) and falls back to the
-        NumPy reference implementation
-        (``parallel.executors.quantize_block``) otherwise.  The first
-        block per selection uses the exact per-block scale
-        (bit-identical to the NumPy path); later blocks use the adaptive
-        one-pass scale (see ``_quantize_staged``) — same resolution
-        class, different bits.
+        ``quantize``: False (float32 staging, ``inv_scale`` None), True
+        or ``"int16"`` (the default wire format — native C++ fused path
+        when available, NumPy fallback), or ``"int8"`` (half the wire
+        bytes again; coarse — see ``quantize_block`` for the accuracy
+        envelope; NumPy path only).  The first int16 block per
+        selection uses the exact per-block scale (bit-identical to the
+        NumPy path); later blocks use the adaptive one-pass scale (see
+        ``_quantize_staged``) — same resolution class, different bits.
         """
+        qmode = norm_quantize(quantize)
         block, boxes = self.read_block(start, stop, sel=sel)
-        if not quantize:
+        if qmode is None:
             return block, boxes, None
+        if qmode == "int8":
+            from mdanalysis_mpi_tpu.parallel.executors import quantize_block
+
+            q, inv_scale = quantize_block(block, "int8")
+            return q, boxes, inv_scale
         q, inv_scale = self._quantize_staged(block, None,
                                              sel_fp=sel_fingerprint(sel))
         return q, boxes, inv_scale
@@ -286,7 +307,7 @@ class ReaderBase:
             return quantize_block(src if sel is None else src[:, sel])
 
     def stage_cached(self, start: int, stop: int,
-                     sel: np.ndarray | None = None, quantize: bool = False):
+                     sel: np.ndarray | None = None, quantize=False):
         """``stage_block`` through the reader's :class:`HostStageCache`.
 
         The executors' staging entry point.  Cache key = (frame window,
@@ -301,7 +322,7 @@ class ReaderBase:
         if cache is None or cache.max_bytes != cap:
             cache = HostStageCache(cap)
             self.__dict__["_host_stage_cache"] = cache
-        key = (start, stop, sel_fingerprint(sel), quantize)
+        key = (start, stop, sel_fingerprint(sel), norm_quantize(quantize))
         staged = cache.get(key)
         if staged is None:
             staged = self.stage_block(start, stop, sel=sel, quantize=quantize)

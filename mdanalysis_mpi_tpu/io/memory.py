@@ -119,12 +119,23 @@ class MemoryReader(ReaderBase):
         if not 0 <= start <= stop <= self.n_frames:
             raise IndexError(
                 f"block [{start},{stop}) out of range [0,{self.n_frames}]")
+        from mdanalysis_mpi_tpu.io.base import norm_quantize
+
+        qmode = norm_quantize(quantize)
         if self.transformations:
             return ReaderBase.stage_block(self, start, stop, sel=sel,
                                           quantize=quantize)
         boxes = None if self._dims is None else self._dims[start:stop].copy()
         view = self._coords[start:stop]
-        if quantize:
+        if qmode == "int8":
+            # no fused native int8 kernel; quantize straight off the
+            # backing view (no intermediate read_block copy)
+            from mdanalysis_mpi_tpu.parallel.executors import quantize_block
+
+            q, inv_scale = quantize_block(
+                view if sel is None else view[:, sel], "int8")
+            return q, boxes, inv_scale
+        if qmode is not None:
             # adaptive one-pass gather+quantize (ReaderBase helper)
             q, inv_scale = self._quantize_staged(view, sel)
             return q, boxes, inv_scale

@@ -154,10 +154,15 @@ class XTCReader(ReaderBase):
         if not 0 <= start <= stop <= self.n_frames:
             raise IndexError(
                 f"block [{start},{stop}) out of range [0,{self.n_frames}]")
-        if self.transformations:
+        from mdanalysis_mpi_tpu.io.base import norm_quantize
+
+        qmode = norm_quantize(quantize)
+        if self.transformations or qmode == "int8":
+            # int8 has no fused native kernel (opt-in coarse path);
+            # the base read-then-quantize handles it
             return ReaderBase.stage_block(self, start, stop, sel=sel,
                                           quantize=quantize)
-        if not quantize:
+        if qmode is None:
             block, boxes = self.read_block(start, stop, sel=sel)
             return block, boxes, None
         if start == stop:

@@ -153,17 +153,24 @@ def _dequant_wrapper(fn):
 
 
 def _validate_transfer_dtype(transfer_dtype: str) -> None:
-    if transfer_dtype not in ("float32", "int16"):
+    if transfer_dtype not in ("float32", "int16", "int8"):
         raise ValueError(
-            f"transfer_dtype must be 'float32' or 'int16', got {transfer_dtype!r}")
+            f"transfer_dtype must be 'float32', 'int16' or 'int8', "
+            f"got {transfer_dtype!r}")
+
+
+def _quant_mode(transfer_dtype: str):
+    """Executor transfer dtype → stage_block/stage_cached quantize arg
+    (None for the float32 path)."""
+    return None if transfer_dtype == "float32" else transfer_dtype
 
 
 def _wrap_for_transfer(params, sel_idx, n_atoms: int, transfer_dtype: str):
-    """Shared int16-staging setup for Jax/Mesh executors: wrap params as
-    ``(device_gather_sel, params)`` for the dequant wrapper, moving the
-    selection gather onto the device for wide selections (see
-    ``_DEVICE_GATHER_FRACTION``).  Returns (params, sel_idx)."""
-    if transfer_dtype != "int16":
+    """Shared quantized-staging setup for Jax/Mesh executors: wrap
+    params as ``(device_gather_sel, params)`` for the dequant wrapper,
+    moving the selection gather onto the device for wide selections
+    (see ``_DEVICE_GATHER_FRACTION``).  Returns (params, sel_idx)."""
+    if transfer_dtype == "float32":
         return params, sel_idx
     if (sel_idx is not None
             and len(sel_idx) > _DEVICE_GATHER_FRACTION * n_atoms):
@@ -186,19 +193,25 @@ _DEVICE_GATHER_FRACTION = float(
     _os.environ.get("MDTPU_DEVICE_GATHER_FRACTION", "1.1"))
 
 
-def quantize_block(block: np.ndarray):
-    """Quantize an (B, S, 3) float32 block to int16 + inverse scale.
+def quantize_block(block: np.ndarray, dtype: str = "int16"):
+    """Quantize an (B, S, 3) float32 block to ``dtype`` + inverse scale.
 
-    One symmetric scale per block: resolution = max|x| / 32000 (e.g.
-    0.002 Å for a 60 Å system) — far below thermal fluctuation scales,
-    and bounded relative error ~6e-5 of the coordinate range.  Halves
-    host→device wire bytes, which is the dominant cost when staging
-    100k-atom frames through a slow link (SURVEY.md §7 "Host I/O vs TPU
-    throughput").
+    One symmetric scale per block.  ``int16``: resolution = max|x| /
+    32000 (e.g. 0.002 Å for a 60 Å system) — far below thermal
+    fluctuation scales, bounded relative error ~6e-5 of the coordinate
+    range; halves host→device wire bytes, the dominant cost when
+    staging 100k-atom frames through a slow link (SURVEY.md §7).
+    ``int8``: resolution = max|x| / 120 — halves the bytes AGAIN but is
+    COARSE (0.5 Å on a 60 Å system): fit for wire-bound reductions on
+    small-range systems (a water box: ~0.1 Å resolution, quantization
+    σ ≈ 0.03 Å) and gated by the same divergence checks as every other
+    staging dtype; unfit for Å-precision observables on wide systems —
+    the bench's divergence gate fails loudly rather than score it.
     """
+    target = {"int16": 32000.0, "int8": 120.0}[dtype]
     m = float(np.abs(block).max()) if block.size else 1.0
-    scale = 32000.0 / max(m, 1e-30)
-    q = np.round(block * scale).astype(np.int16)
+    scale = target / max(m, 1e-30)
+    q = np.round(block * scale).astype(dtype)
     return q, np.float32(1.0 / scale)
 
 
@@ -361,7 +374,7 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
             block, boxes = _stage(reader, batch_frames, sel_idx)
             inv_scale = None
             if quantize:
-                block, inv_scale = quantize_block(block)
+                block, inv_scale = quantize_block(block, quantize)
         if boxes is None:
             boxes = np.zeros((block.shape[0], 6), dtype=np.float32)
         padded, mask = pad_batch(block, pad_to)
@@ -506,7 +519,7 @@ class JaxExecutor:
                 f"{type(analysis).__name__} uses an atom-sharded ring "
                 "kernel (mesh collectives); run it with backend='mesh'")
         bs = batch_size or self.batch_size
-        quantize = self.transfer_dtype == "int16"
+        quantize = _quant_mode(self.transfer_dtype)
         f = analysis._batch_fn()
         kernel = _jit_kernel(_dequant_wrapper(f) if quantize else f)
         params, sel_idx = _wrap_for_transfer(
@@ -556,7 +569,7 @@ class MeshExecutor:
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
         devices = self.devices if self.devices is not None else jax.devices()
-        quantize = self.transfer_dtype == "int16"
+        quantize = _quant_mode(self.transfer_dtype) is not None
         custom = analysis._batch_specs(self.axis_name)
         if custom is not None and quantize:
             raise ValueError(
@@ -677,7 +690,7 @@ class MeshExecutor:
                 analysis, reader, frames, global_bs,
                 lambda *staged: gfn(params, *staged), sel_idx,
                 device_put_fn=put, cache=self.block_cache,
-                quantize=self.transfer_dtype == "int16",
+                quantize=_quant_mode(self.transfer_dtype),
                 local_divisor=n_proc, local_index=jax.process_index(),
                 inv_per_frame=True, prestage=self.prestage)
 
@@ -692,7 +705,7 @@ class MeshExecutor:
             analysis, reader, frames, global_bs,
             lambda *staged: gfn(params, *staged), sel_idx,
             device_put_fn=put, cache=self.block_cache,
-            quantize=self.transfer_dtype == "int16",
+            quantize=_quant_mode(self.transfer_dtype),
             prestage=self.prestage)
 
     def _execute_ring_multihost(self, analysis, reader, frames, bs, gfn,

@@ -136,7 +136,7 @@ def _parser() -> argparse.ArgumentParser:
                    choices=("serial", "jax", "mesh"))
     p.add_argument("--batch-size", type=int, default=None)
     p.add_argument("--transfer-dtype", default="float32",
-                   choices=("float32", "int16"))
+                   choices=("float32", "int16", "int8"))
     p.add_argument("--nbins", type=int, default=75)
     p.add_argument("--engine", default="auto",
                    choices=("auto", "xla", "pallas", "ring"),

@@ -156,7 +156,7 @@ def test_int16_transfer_accuracy(uni):
 def test_bad_transfer_dtype(uni):
     with pytest.raises(ValueError, match="transfer_dtype"):
         AlignedRMSF(uni, select="name CA").run(backend="jax",
-                                               transfer_dtype="int8")
+                                               transfer_dtype="int4")
 
 
 def test_rmsd_atomgroup_select_refines_within_group(uni):

@@ -1,0 +1,90 @@
+"""int8 staging (VERDICT r3 next-round #6): half the wire bytes of
+int16 again, behind the same divergence discipline.
+
+int8 is deliberately opt-in and coarse — resolution max|x|/120, so a
+60 Å system quantizes at ~0.5 Å and Å-precision observables on wide
+systems must (and do) fail their gates rather than score.  On
+small-range systems (water boxes) and bin-tolerant reductions (RDF)
+it holds its accuracy envelope; pinned here along with the plumbing:
+dtype threading, cache-key separation from int16, and the ``True`` ≡
+``"int16"`` normalization.
+"""
+
+import numpy as np
+import pytest
+
+from mdanalysis_mpi_tpu.analysis import AlignedRMSF, InterRDF
+from mdanalysis_mpi_tpu.io.base import norm_quantize
+from mdanalysis_mpi_tpu.io.memory import MemoryReader
+from mdanalysis_mpi_tpu.parallel.executors import quantize_block
+from mdanalysis_mpi_tpu.testing import make_water_universe
+
+
+def test_norm_quantize():
+    assert norm_quantize(False) is None
+    assert norm_quantize(None) is None
+    assert norm_quantize(True) == "int16"
+    assert norm_quantize("int16") == "int16"
+    assert norm_quantize("int8") == "int8"
+    with pytest.raises(ValueError, match="quantize"):
+        norm_quantize("int4")
+
+
+def test_quantize_block_int8_roundtrip():
+    rng = np.random.default_rng(3)
+    block = rng.normal(scale=5.0, size=(4, 50, 3)).astype(np.float32)
+    q, inv = quantize_block(block, "int8")
+    assert q.dtype == np.int8
+    res = float(np.abs(block).max()) / 120.0
+    assert np.abs(q.astype(np.float32) * inv - block).max() <= 0.51 * res
+    q16, inv16 = quantize_block(block)              # default stays int16
+    assert q16.dtype == np.int16
+
+
+def test_stage_block_int8_and_cache_separation(tmp_path):
+    rng = np.random.default_rng(5)
+    coords = rng.normal(scale=4.0, size=(6, 40, 3)).astype(np.float32)
+    r = MemoryReader(coords)
+    q8, _, inv8 = r.stage_block(0, 6, quantize="int8")
+    assert q8.dtype == np.int8
+    np.testing.assert_allclose(q8.astype(np.float32) * inv8, coords,
+                               atol=float(np.abs(coords).max()) / 120)
+    # the same window staged int16 must come from a DIFFERENT cache
+    # entry (a shared key would hand int8 bytes to an int16 consumer)
+    a16 = r.stage_cached(0, 6, quantize="int16")
+    a8 = r.stage_cached(0, 6, quantize="int8")
+    assert a16[0].dtype == np.int16 and a8[0].dtype == np.int8
+    # and True ≡ "int16" shares ONE entry (no duplicate resident block)
+    hits0 = r._host_stage_cache.hits
+    b16 = r.stage_cached(0, 6, quantize=True)
+    assert r._host_stage_cache.hits == hits0 + 1
+    assert b16[0] is a16[0]
+    # XTC reader routes int8 through the base path
+    from mdanalysis_mpi_tpu.io.xtc import XTCReader, write_xtc
+
+    p = str(tmp_path / "t.xtc")
+    write_xtc(p, coords)
+    x8, _, xinv = XTCReader(p).stage_block(0, 6, quantize="int8")
+    assert x8.dtype == np.int8
+    np.testing.assert_allclose(x8.astype(np.float32) * xinv, coords,
+                               atol=float(np.abs(coords).max()) / 100)
+
+
+def test_int8_end_to_end_small_range_system():
+    """On a small-range system the int8 path passes the same oracle
+    difference discipline as int16 (looser bound: quantization sigma
+    ~ range/120/sqrt(12))."""
+    u = make_water_universe(n_waters=60, n_frames=16, box=12.0, seed=7)
+    s = AlignedRMSF(u, select="name OW").run(backend="serial")
+    a = AlignedRMSF(u, select="name OW").run(
+        backend="jax", batch_size=8, transfer_dtype="int8")
+    res = 12.0 / 120.0
+    err = float(np.abs(np.asarray(a.results.rmsf) - s.results.rmsf).max())
+    assert err < res, f"int8 RMSF err {err} vs resolution {res}"
+    ow = u.select_atoms("name OW")
+    rs = InterRDF(ow, ow, nbins=30, range=(0.0, 6.0)).run(backend="serial")
+    r8 = InterRDF(ow, ow, nbins=30, range=(0.0, 6.0)).run(
+        backend="jax", batch_size=8, transfer_dtype="int8")
+    # bin-tolerant reduction: only edge atoms can change bins
+    err = float(np.abs(np.asarray(r8.results.rdf) - rs.results.rdf).max())
+    assert err < 0.35 * float(rs.results.rdf.max()), err
