@@ -32,6 +32,7 @@ from mdanalysis_mpi_tpu.analysis.hbonds import HydrogenBondAnalysis
 from mdanalysis_mpi_tpu.analysis.diffusionmap import (DistanceMatrix,
                                                       DiffusionMap)
 from mdanalysis_mpi_tpu.analysis.vacf import VelocityAutocorr
+from mdanalysis_mpi_tpu.analysis.lineardensity import LinearDensity
 
 __all__ = ["AnalysisBase", "Results", "AnalysisFromFunction",
            "analysis_class", "RMSF", "RMSD", "AlignedRMSF", "rmsd",
@@ -39,4 +40,5 @@ __all__ = ["AnalysisBase", "Results", "AnalysisFromFunction",
            "InterRDF", "ContactMap",
            "PairwiseDistances", "RadiusOfGyration", "PCA", "EinsteinMSD",
            "Dihedral", "Ramachandran", "Contacts", "DensityAnalysis",
-           "HydrogenBondAnalysis", "DistanceMatrix", "DiffusionMap", "VelocityAutocorr"]
+           "HydrogenBondAnalysis", "DistanceMatrix", "DiffusionMap",
+           "VelocityAutocorr", "LinearDensity"]

@@ -133,11 +133,14 @@ class Results(dict):
         self[key] = value
 
     def materialize(self):
-        """Force every entry: evaluate Deferreds, fetch device arrays.
-        Returns self.  One deliberate readback point for callers (CLI,
-        serialization) that need plain host values."""
+        """Force every entry: evaluate Deferreds, fetch device arrays,
+        recurse into nested Results (e.g. LinearDensity's per-axis
+        groups).  Returns self.  One deliberate readback point for
+        callers (CLI, serialization) that need plain host values."""
         for key in list(self):
-            getattr(self, key)
+            value = getattr(self, key)
+            if isinstance(value, Results):
+                value.materialize()
         return self
 
 
