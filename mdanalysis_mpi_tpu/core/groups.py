@@ -217,6 +217,25 @@ class AtomGroup:
         """Segments containing this group's atoms (upstream idiom)."""
         return SegmentGroup(self._universe, self.segids)
 
+    @property
+    def fragindices(self) -> np.ndarray:
+        """Per-atom bonded-fragment (molecule) index (upstream
+        ``fragindices``; needs bonds — PSF or ``guess_bonds``)."""
+        return self._universe.topology.fragindices[self._indices]
+
+    @property
+    def n_fragments(self) -> int:
+        return len(np.unique(self.fragindices))
+
+    @property
+    def fragments(self) -> list["AtomGroup"]:
+        """The FULL bonded fragments containing any atom of this group
+        (upstream semantics: whole molecules, not intersections), in
+        fragment-index order."""
+        frag = self._universe.topology.fragindices
+        return [AtomGroup(self._universe, np.flatnonzero(frag == f))
+                for f in np.unique(frag[self._indices])]
+
     def split(self, level: str = "residue") -> list["AtomGroup"]:
         """Split into per-residue or per-segment AtomGroups (upstream
         ``AtomGroup.split``), preserving this group's atom order within
@@ -358,9 +377,11 @@ class AtomGroup:
         merged.update(tuple(sorted(b)) for b in bonds.tolist())
         t.bonds = np.array(sorted(merged), dtype=np.int64).reshape(-1, 2)
         # the selection memo assumes an immutable topology — adding
-        # bonds invalidates any cached `bonded ...` mask
+        # bonds invalidates any cached `bonded ...` mask, and the
+        # fragment components derive from the bond graph too
         self._universe.__dict__.pop("_selection_cache", None)
         self._universe.__dict__.pop("_selection_scope_insensitive", None)
+        t._derived.pop("fragindices", None)
         return np.asarray(bonds, dtype=np.int64).reshape(-1, 2)
 
     def write(self, path: str) -> None:

@@ -47,7 +47,7 @@ them with ``around`` constantly):
 - ``byres inner`` — expand to every atom of any residue containing an
   ``inner`` atom.
 - ``same ATTR as inner`` — atoms whose ATTR (name, type, resname,
-  resid, resnum, segid, residue, mass, charge) equals that of any
+  resid, resnum, segid, residue, mass, charge, fragment) equals that of any
   ``inner`` atom.
 - ``global inner`` — evaluate ``inner`` against the whole universe even
   inside ``AtomGroup.select_atoms`` (escapes group scoping, e.g.
@@ -294,7 +294,7 @@ class _Parser:
         return np.isin(self.top.resindices, hit)
 
     _SAME_ATTRS = ("name", "type", "resname", "resid", "resnum", "segid",
-                   "residue", "segment", "mass", "charge")
+                   "residue", "segment", "mass", "charge", "fragment")
 
     def _same(self) -> np.ndarray:
         """``same ATTR as inner`` (upstream SameSubSelection): atoms
@@ -309,10 +309,21 @@ class _Parser:
         t = self.top
         if what == "charge" and t.charges is None:
             raise SelectionError("topology has no charges for 'same charge as'")
-        attr = {"name": t.names, "type": t.elements, "resname": t.resnames,
-                "resid": t.resids, "resnum": t.resids, "segid": t.segids,
-                "residue": t.resindices, "segment": t.segids,
-                "mass": t.masses, "charge": t.charges}[what]
+        if what == "fragment":
+            if t.bonds is None:
+                raise SelectionError(
+                    "'same fragment as' needs bonds (PSF topology or "
+                    "guess_bonds)")
+            # separate branch: the union-find over the bond graph must
+            # only run when actually asked for
+            attr = t.fragindices
+        else:
+            attr = {"name": t.names, "type": t.elements,
+                    "resname": t.resnames,
+                    "resid": t.resids, "resnum": t.resids,
+                    "segid": t.segids,
+                    "residue": t.resindices, "segment": t.segids,
+                    "mass": t.masses, "charge": t.charges}[what]
         inner = self._scoped(self.not_expr())
         if not inner.any():
             return np.zeros_like(inner)

@@ -169,6 +169,45 @@ class Topology:
             self._derived["residue_first_atom"] = m
         return m
 
+    @property
+    def fragindices(self) -> np.ndarray:
+        """0-based fragment (bonded connected component = molecule)
+        index per atom, dense in first-atom order — upstream
+        ``fragindices``.  Needs bonds: parse a bonded topology (PSF) or
+        run ``guess_bonds`` first; atoms with no bonds form singleton
+        fragments."""
+        m = self._derived.get("fragindices")
+        if m is None:
+            if self.bonds is None:
+                raise ValueError(
+                    "fragments need bonds; load a bonded topology (PSF) "
+                    "or call guess_bonds() first")
+            parent = np.arange(self.n_atoms, dtype=np.int64)
+
+            def find(i: int) -> int:
+                root = i
+                while parent[root] != root:
+                    root = parent[root]
+                while parent[i] != root:       # path compression
+                    parent[i], i = root, parent[i]
+                return root
+
+            for a, b in self.bonds:
+                ra, rb = find(int(a)), find(int(b))
+                if ra != rb:
+                    parent[max(ra, rb)] = min(ra, rb)
+            roots = np.fromiter((find(i) for i in range(self.n_atoms)),
+                                dtype=np.int64, count=self.n_atoms)
+            # roots are component minima → ascending unique = dense
+            # fragment ids in first-atom order
+            _, m = np.unique(roots, return_inverse=True)
+            self._derived["fragindices"] = m
+        return m
+
+    @property
+    def n_fragments(self) -> int:
+        return int(self.fragindices.max()) + 1 if self.n_atoms else 0
+
     # ---- cached boolean masks used by the selection DSL ----
 
     def _mask(self, key: str, fn) -> np.ndarray:

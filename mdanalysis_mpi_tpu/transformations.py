@@ -62,6 +62,46 @@ class translate(TransformationBase):
         return ts
 
 
+class rotateby(TransformationBase):
+    """Rotate every atom by ``angle`` degrees about the axis along
+    ``direction`` through ``point`` — or through the center of ``ag``,
+    recomputed per frame (upstream ``transformations.rotate.rotateby``).
+    Exactly one of ``point``/``ag`` must be given."""
+
+    def __init__(self, angle, direction, point=None, ag=None,
+                 center: str = "geometry"):
+        if (point is None) == (ag is None):
+            raise ValueError("rotateby needs exactly one of point= or ag=")
+        d = np.asarray(direction, np.float64).reshape(3)
+        n = float(np.linalg.norm(d))
+        if n == 0.0:
+            raise ValueError("direction must be a nonzero vector")
+        k = d / n
+        theta = np.radians(float(angle))
+        # Rodrigues: R = I + sin K + (1-cos) K², K the cross matrix of k
+        kx = np.array([[0.0, -k[2], k[1]],
+                       [k[2], 0.0, -k[0]],
+                       [-k[1], k[0], 0.0]])
+        self._rot = (np.eye(3) + np.sin(theta) * kx
+                     + (1.0 - np.cos(theta)) * (kx @ kx))
+        self._point = None if point is None else np.asarray(point,
+                                                            np.float64)
+        self._ag = ag
+        self._center = center
+        if center not in ("geometry", "mass"):      # fail at build time
+            raise ValueError(
+                f"center must be 'geometry' or 'mass', got {center!r}")
+        if ag is None and center != "geometry":
+            raise ValueError("center= applies only with ag=")
+
+    def __call__(self, ts):
+        p = (self._point if self._point is not None
+             else _group_center(self._ag, ts.positions, self._center))
+        x = ts.positions.astype(np.float64) - p
+        ts.positions = (x @ self._rot.T + p).astype(np.float32)
+        return ts
+
+
 class center_in_box(TransformationBase):
     """Translate each frame so ``ag``'s center sits at the box center
     (or at ``point``).  ``wrap=True`` wraps the group into the primary

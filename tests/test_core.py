@@ -492,3 +492,58 @@ class TestCompoundCenters:
             u.atoms.center_of_mass(compound="group"))
         with pytest.raises(ValueError, match="compound"):
             u.atoms.center_of_mass(compound="molecules")
+
+
+class TestFragments:
+    """Bonded connected components (upstream fragments/fragindices)."""
+
+    def _universe(self):
+        top = Topology(
+            names=np.array(["C1", "C2", "C3", "OW", "HW1", "HW2", "NA"]),
+            resnames=np.array(["MOL"] * 3 + ["SOL"] * 3 + ["NA"]),
+            resids=np.array([1, 1, 1, 2, 2, 2, 3]),
+            bonds=np.array([(0, 1), (1, 2), (3, 4), (3, 5)]))
+        pos = np.zeros((1, 7, 3), np.float32)
+        return Universe(top, MemoryReader(pos))
+
+    def test_fragindices_dense_first_atom_order(self):
+        u = self._universe()
+        np.testing.assert_array_equal(
+            u.topology.fragindices, [0, 0, 0, 1, 1, 1, 2])
+        assert u.topology.n_fragments == 3
+        # unbonded ion = singleton fragment
+        assert u.atoms[6:].fragindices.tolist() == [2]
+
+    def test_atomgroup_fragments_are_whole_molecules(self):
+        u = self._universe()
+        # one atom of the water pulls in the WHOLE water (upstream
+        # semantics: full fragments, not intersections)
+        frags = u.atoms[4:5].fragments
+        assert len(frags) == 1
+        assert frags[0].indices.tolist() == [3, 4, 5]
+        all_frags = u.atoms.fragments
+        assert [f.indices.tolist() for f in all_frags] == [
+            [0, 1, 2], [3, 4, 5], [6]]
+        assert u.atoms.n_fragments == 3
+
+    def test_fragments_need_bonds(self):
+        top = Topology(names=np.array(["CA"]),
+                       resnames=np.array(["ALA"]),
+                       resids=np.array([1]))
+        u = Universe(top, MemoryReader(np.zeros((1, 1, 3), np.float32)))
+        with pytest.raises(ValueError, match="bonds"):
+            u.atoms.fragments
+
+    def test_guess_bonds_invalidates_fragment_cache(self):
+        """fragindices derives from the bond graph; guess_bonds must
+        bust the cached components (r4 review finding)."""
+        top = Topology(names=np.array(["C", "C"]),
+                       resnames=np.array(["MOL"] * 2),
+                       resids=np.array([1, 1]),
+                       elements=np.array(["C", "C"]))
+        pos = np.array([[[0.0, 0, 0], [1.4, 0, 0]]], np.float32)
+        u = Universe(top, MemoryReader(pos))
+        top.bonds = np.empty((0, 2), np.int64)
+        assert u.topology.n_fragments == 2       # cached: two singletons
+        u.atoms.guess_bonds()
+        assert u.topology.n_fragments == 1       # stale cache busted

@@ -413,3 +413,25 @@ class TestSelectionMemoization:
         keys = [k for k in cache if k[0] == "byres name OW"]
         assert len(keys) == 2           # whole-universe + scoped entry
         assert set(sub.indices) <= set(whole.indices)
+
+
+def test_same_fragment_as():
+    from mdanalysis_mpi_tpu.core.topology import Topology
+    from mdanalysis_mpi_tpu.core.universe import Universe
+    from mdanalysis_mpi_tpu.io.memory import MemoryReader
+
+    top = Topology(
+        names=np.array(["C1", "C2", "OW", "HW1", "HW2"]),
+        resnames=np.array(["MOL", "MOL", "SOL", "SOL", "SOL"]),
+        resids=np.array([1, 1, 2, 2, 2]),
+        bonds=np.array([(0, 1), (2, 3), (2, 4)]))
+    u = Universe(top, MemoryReader(np.zeros((1, 5, 3), np.float32)))
+    got = u.select_atoms("same fragment as name HW1")
+    assert list(got.indices) == [2, 3, 4]      # the whole water molecule
+    assert list(u.select_atoms("same fragment as name C1").indices) == [0, 1]
+    # no bonds -> actionable error
+    top2 = Topology(names=np.array(["CA"]), resnames=np.array(["ALA"]),
+                    resids=np.array([1]))
+    u2 = Universe(top2, MemoryReader(np.zeros((1, 1, 3), np.float32)))
+    with pytest.raises(SelectionError, match="bonds"):
+        u2.select_atoms("same fragment as all")

@@ -209,3 +209,31 @@ class TestReaderFallback:
                                                   batch_size=4)
         np.testing.assert_allclose(np.asarray(j.results.rmsf),
                                    s.results.rmsf, atol=1e-4)
+
+
+def test_rotateby_about_point_and_group_center():
+    from mdanalysis_mpi_tpu.core.timestep import Timestep
+
+    pos = np.array([[2.0, 0.0, 0.0], [4.0, 0.0, 0.0]], np.float32)
+    ts = Timestep(positions=pos.copy(), frame=0)
+    # 90 deg about z through the origin: (x, y) -> (-y, x)
+    trf.rotateby(90.0, [0, 0, 1], point=[0, 0, 0])(ts)
+    np.testing.assert_allclose(
+        ts.positions, [[0, 2, 0], [0, 4, 0]], atol=1e-5)
+    # about the group's own center of geometry (3, 0, 0): endpoints swap
+    ts2 = Timestep(positions=pos.copy(), frame=0)
+
+    class _AG:                       # minimal ag contract: indices
+        indices = np.array([0, 1])
+
+    trf.rotateby(180.0, [0, 0, 1], ag=_AG())(ts2)
+    np.testing.assert_allclose(
+        ts2.positions, [[4, 0, 0], [2, 0, 0]], atol=1e-5)
+    # 360 degrees is the identity
+    ts3 = Timestep(positions=pos.copy(), frame=0)
+    trf.rotateby(360.0, [1, 1, 1], point=[5, 5, 5])(ts3)
+    np.testing.assert_allclose(ts3.positions, pos, atol=1e-5)
+    with pytest.raises(ValueError, match="exactly one"):
+        trf.rotateby(90.0, [0, 0, 1])
+    with pytest.raises(ValueError, match="nonzero"):
+        trf.rotateby(90.0, [0, 0, 0], point=[0, 0, 0])
