@@ -81,6 +81,40 @@ def _jit_kernel(f):
     return fn
 
 
+_FUSED_STEP_CACHE: dict = {}
+
+
+def _fused_step(kernel, fold):
+    """One jitted ``total, params, *staged -> fold(total, kernel(...))``
+    per (kernel, fold) identity pair.
+
+    The steady-state flagship is DISPATCH-bound (~0.1 ms of fixed
+    per-dispatch latency on tunneled targets × ~4 dispatches per batch
+    across two passes — PERF.md §6); folding the cross-batch merge into
+    the kernel's own dispatch halves the per-batch dispatch count on
+    the single-device path.  Cache keyed on module-level function
+    identities (same contract as ``_jit_kernel``), so compiles survive
+    across ``run()`` calls.
+
+    Cost accepted deliberately: a fresh process compiles the kernel
+    TWICE (standalone for batch 1, fused for batch 2+).  The win is the
+    steady-state/repeat-run regime the flagship headline measures —
+    there the compile cache is warm and every batch saves one fixed
+    ~0.1 ms dispatch round-trip; one-shot users pay one extra compile,
+    bounded by the first run's existing compile wall."""
+    key = (kernel, fold)
+    fn = _FUSED_STEP_CACHE.get(key)
+    if fn is None:
+        import jax
+
+        def step(total, params, *staged):
+            return fold(total, kernel(params, *staged))
+
+        fn = jax.jit(_f32_precision(step))
+        _FUSED_STEP_CACHE[key] = fn
+    return fn
+
+
 def _uniform_stride(frames) -> int | None:
     """The constant positive stride of ``frames``, or None.  Strided
     windows (``run(step=N)``) then ride the readers' bulk ``read_block``
@@ -289,7 +323,7 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
                  device_put_fn=None, cache: "DeviceBlockCache | None" = None,
                  quantize: bool = False, local_divisor: int = 1,
                  local_index: int = 0, inv_per_frame: bool = False,
-                 prestage: bool = False):
+                 prestage: bool = False, fused_call=None):
     """Shared batch loop: stage → kernel → DEVICE-side accumulation.
 
     ``prestage=True`` switches the schedule from interleaved
@@ -423,12 +457,15 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
     def consume(staged):
         nonlocal total
         with TIMERS.phase("dispatch"):
-            partials = call(*staged)
-            if fold_j is not None:
-                total = (partials if total is None
-                         else fold_j(total, partials))
+            if fold_j is None:
+                parts_list.append(call(*staged))
+            elif total is None:
+                total = call(*staged)
+            elif fused_call is not None:
+                # merge folded into the kernel dispatch (see _fused_step)
+                total = fused_call(total, *staged)
             else:
-                parts_list.append(partials)
+                total = fold_j(total, call(*staged))
 
     if prestage:
         # phase 1 — decode+stage EVERY batch, zero device contact (the
@@ -494,7 +531,9 @@ class SerialExecutor:
 
 class JaxExecutor:
     """Single-device batch pipeline: stage block → jitted kernel →
-    host float64 Chan merge across blocks."""
+    ON-DEVICE f32 fold across blocks (fused into the kernel dispatch
+    when the analysis declares a ``_device_fold_fn``; see the module
+    precision-policy docstring)."""
 
     name = "jax"
 
@@ -521,7 +560,10 @@ class JaxExecutor:
         bs = batch_size or self.batch_size
         quantize = _quant_mode(self.transfer_dtype)
         f = analysis._batch_fn()
-        kernel = _jit_kernel(_dequant_wrapper(f) if quantize else f)
+        wrapped = _dequant_wrapper(f) if quantize else f
+        kernel = _jit_kernel(wrapped)
+        fold = analysis._device_fold_fn
+        step = _fused_step(wrapped, fold) if fold is not None else None
         params, sel_idx = _wrap_for_transfer(
             analysis._batch_params(), analysis._batch_select(),
             reader.n_atoms, self.transfer_dtype)
@@ -534,7 +576,10 @@ class JaxExecutor:
             analysis, reader, frames, bs,
             lambda *staged: kernel(params, *staged), sel_idx,
             device_put_fn=put, cache=self.block_cache, quantize=quantize,
-            prestage=self.prestage)
+            prestage=self.prestage,
+            fused_call=(None if step is None else
+                        lambda total, *staged: step(total, params,
+                                                    *staged)))
 
 
 class MeshExecutor:
