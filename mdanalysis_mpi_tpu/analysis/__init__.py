@@ -33,6 +33,7 @@ from mdanalysis_mpi_tpu.analysis.diffusionmap import (DistanceMatrix,
                                                       DiffusionMap)
 from mdanalysis_mpi_tpu.analysis.vacf import VelocityAutocorr
 from mdanalysis_mpi_tpu.analysis.lineardensity import LinearDensity
+from mdanalysis_mpi_tpu.analysis.gnm import GNMAnalysis
 
 __all__ = ["AnalysisBase", "Results", "AnalysisFromFunction",
            "analysis_class", "RMSF", "RMSD", "AlignedRMSF", "rmsd",
@@ -41,4 +42,4 @@ __all__ = ["AnalysisBase", "Results", "AnalysisFromFunction",
            "PairwiseDistances", "RadiusOfGyration", "PCA", "EinsteinMSD",
            "Dihedral", "Ramachandran", "Contacts", "DensityAnalysis",
            "HydrogenBondAnalysis", "DistanceMatrix", "DiffusionMap",
-           "VelocityAutocorr", "LinearDensity"]
+           "VelocityAutocorr", "LinearDensity", "GNMAnalysis"]

@@ -1,0 +1,89 @@
+"""GNMAnalysis — Kirchhoff-matrix slowest-mode time series (upstream
+``analysis.gnm.GNMAnalysis`` semantics: contact springs within cutoff,
+per-frame eigenvalue[1] + eigenvector)."""
+
+import numpy as np
+import pytest
+
+from mdanalysis_mpi_tpu.analysis import GNMAnalysis
+from mdanalysis_mpi_tpu.core.topology import Topology
+from mdanalysis_mpi_tpu.core.universe import Universe
+from mdanalysis_mpi_tpu.io.memory import MemoryReader
+from mdanalysis_mpi_tpu.testing import make_protein_universe
+
+
+def _chain_universe(n_frames=1):
+    """4 nodes on a line, 5 Å apart: with cutoff 7 only neighbors bond
+    → path-graph Laplacian with known spectrum 2-2cos(kπ/4)."""
+    pos = np.array([[0.0, 0, 0], [5.0, 0, 0], [10.0, 0, 0],
+                    [15.0, 0, 0]], np.float32)
+    frames = np.repeat(pos[None], n_frames, axis=0)
+    top = Topology(names=np.array(["CA"] * 4),
+                   resnames=np.array(["GLY"] * 4),
+                   resids=np.arange(4) + 1)
+    return Universe(top, MemoryReader(frames))
+
+
+def test_path_graph_spectrum():
+    u = _chain_universe(n_frames=3)
+    r = GNMAnalysis(u, select="name CA", cutoff=7.0).run(backend="serial")
+    # path graph P4: eigenvalues 2 - 2cos(k pi / 4), k=0..3; mode 1:
+    lam1 = 2.0 - 2.0 * np.cos(np.pi / 4)
+    np.testing.assert_allclose(r.results.eigenvalues,
+                               np.full(3, lam1), atol=1e-10)
+    assert r.results.eigenvectors.shape == (3, 4)
+    # Fiedler vector of a path is monotone across the chain
+    v = r.results.eigenvectors[0]
+    assert (np.diff(v) > 0).all() or (np.diff(v) < 0).all()
+
+
+def test_backend_parity_and_sign_convention():
+    u = make_protein_universe(n_residues=12, n_frames=16, noise=0.4,
+                              seed=3)
+    s = GNMAnalysis(u, select="name CA").run(backend="serial")
+    j = GNMAnalysis(u, select="name CA").run(backend="jax", batch_size=4)
+    np.testing.assert_allclose(np.asarray(j.results.eigenvalues),
+                               s.results.eigenvalues, atol=1e-3)
+    # eigenVECTORS are only comparable across f32/f64 when the mode is
+    # spectrally isolated — near-degenerate lambda_1 ~ lambda_2 frames
+    # legitimately rotate the eigenbasis; compare where the serial
+    # eigengap is clear (the sign convention makes them directly equal)
+    def _gaps():
+        out = []
+        for i in s._frame_indices:
+            x = u.trajectory[i].positions[s._idx].astype(np.float64)
+            d2 = ((x[:, None] - x[None]) ** 2).sum(-1)
+            a = (d2 < 49.0).astype(float)
+            np.fill_diagonal(a, 0)
+            lam = np.linalg.eigvalsh(np.diag(a.sum(1)) - a)
+            out.append(lam[2] - lam[1])
+        return np.asarray(out)
+
+    clear = _gaps() > 0.1
+    assert clear.sum() >= 8, "fixture too degenerate to test vectors"
+    jv = np.asarray(j.results.eigenvectors)[clear]
+    sv = s.results.eigenvectors[clear]
+    # the sign convention breaks ties by |component| argmax, which can
+    # land differently across f32/f64 — align residual sign per frame
+    # (the eigenvector contract is up-to-sign there)
+    sign = np.sign((jv * sv).sum(axis=1, keepdims=True))
+    np.testing.assert_allclose(jv * sign, sv, atol=5e-3)
+    m = GNMAnalysis(u, select="name CA").run(backend="mesh", batch_size=2)
+    np.testing.assert_allclose(np.asarray(m.results.eigenvalues),
+                               s.results.eigenvalues, atol=1e-3)
+
+
+def test_validation():
+    u = _chain_universe()
+    with pytest.raises(ValueError, match="cutoff"):
+        GNMAnalysis(u, cutoff=0.0)
+    with pytest.raises(ValueError, match="at least 3"):
+        GNMAnalysis(u, select="resid 1:2").run(backend="serial")
+    n = 8_100
+    big_top = Topology(names=np.array(["CA"] * n),
+                       resnames=np.array(["GLY"] * n),
+                       resids=np.arange(n) + 1)
+    big = Universe(big_top,
+                   MemoryReader(np.zeros((1, n, 3), np.float32)))
+    with pytest.raises(ValueError, match="Kirchhoff"):
+        GNMAnalysis(big, select="all").run(backend="serial")
