@@ -83,6 +83,27 @@ derr = float(np.abs(d.results.grid - ds.results.grid).max())
 assert derr < 1e-6, f"density diverged on chip: {derr:.2e}"
 print(f"density err {derr:.2e}")
 
+# --- round-4 analysis families on chip: LinearDensity (scatter +
+# Chan-moment stddev) and GNM (batched Kirchhoff eigh) ---
+from mdanalysis_mpi_tpu.analysis import GNMAnalysis, LinearDensity
+
+uw.topology.charges = np.zeros(uw.topology.n_atoms)
+lds = LinearDensity(ow, binsize=1.0).run(backend="serial")
+ldj = LinearDensity(ow, binsize=1.0).run(backend="jax", batch_size=4)
+lerr = max(float(np.abs(np.asarray(getattr(ldj.results, ax).mass_density)
+                        - getattr(lds.results, ax).mass_density).max())
+           for ax in ("x", "y", "z"))
+assert lerr < 1e-3, f"LinearDensity diverged on chip: {lerr:.2e}"
+print(f"lineardensity err {lerr:.2e}")
+
+gs = GNMAnalysis(u, select="protein and name CA").run(backend="serial")
+gj = GNMAnalysis(u, select="protein and name CA").run(
+    backend="jax", batch_size=8)
+gerr = float(np.abs(np.asarray(gj.results.eigenvalues)
+                    - gs.results.eigenvalues).max())
+assert gerr < 1e-3, f"GNM diverged on chip: {gerr:.2e}"
+print(f"gnm err {gerr:.2e}")
+
 # --- flagship cold-path mechanisms on chip (VERDICT r3 next-round #5):
 # a real XTC decoded through the C++ codec, fused int16 staging via the
 # decode-then-wire prestage path, and DeviceBlockCache reuse across two
