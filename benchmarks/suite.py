@@ -256,12 +256,55 @@ def config6(stack):
             "msd_serial_frames": msf, "msd_serial_cv": mscv}, check
 
 
+def config7(stack):
+    """Informational (not a BASELINE config): the round-4 analysis
+    families — LinearDensity's scatter+Chan-moment kernel and GNM's
+    batched Kirchhoff eigensolve — on the chip."""
+    del stack
+    from mdanalysis_mpi_tpu.analysis import GNMAnalysis, LinearDensity
+
+    uw = make_water_universe(n_waters=1000, n_frames=int(64 * SCALE),
+                             seed=14)
+    uw.topology.charges = np.zeros(uw.topology.n_atoms)
+    ow = uw.select_atoms("name OW")
+    n = uw.trajectory.n_frames
+    fps, serial, sf, scv, a = _timed(
+        lambda: LinearDensity(ow, binsize=0.5),
+        n, dict(backend="jax", batch_size=16))
+    up = make_protein_universe(n_residues=150, n_frames=int(64 * SCALE),
+                               noise=0.3, seed=14)
+    ng = up.trajectory.n_frames
+    gfps, gserial, gsf, gscv, _ = _timed(
+        lambda: GNMAnalysis(up, select="name CA"),
+        ng, dict(backend="jax", batch_size=16))
+
+    def check():
+        s = LinearDensity(ow, binsize=0.5).run(backend="serial")
+        err = max(float(np.abs(np.asarray(getattr(a.results, ax)
+                                          .mass_density)
+                               - getattr(s.results, ax).mass_density
+                               ).max()) for ax in ("x", "y", "z"))
+        assert err < 5e-2, f"config7 LinearDensity divergence {err}"
+
+    return {"config": 7,
+            "metric": "informational: LinearDensity(1000 OW) + "
+                      "GNM(150res Ca)",
+            "value": round(fps, 2), "unit": "frames/s", "backend": "jax",
+            "serial_fps": round(serial, 2), "serial_frames": sf,
+            "serial_cv": scv,
+            "vs_serial": round(fps / serial, 2),
+            "gnm_fps": round(gfps, 2),
+            "gnm_serial_fps": round(gserial, 2),
+            "gnm_serial_frames": gsf, "gnm_serial_cv": gscv}, check
+
+
 def main():
     # BENCH_SUITE_CONFIGS="1,3,5" runs a subset (default: all)
     wanted = os.environ.get("BENCH_SUITE_CONFIGS")
     wanted = ({int(x) for x in wanted.split(",")} if wanted
-              else {1, 2, 3, 4, 5, 6})
-    configs = (config1, config2, config3, config4, config5, config6)
+              else {1, 2, 3, 4, 5, 6, 7})
+    configs = (config1, config2, config3, config4, config5, config6,
+               config7)
     with contextlib.ExitStack() as stack:
         rows = []
         for i, fn in enumerate(configs, start=1):

@@ -105,16 +105,25 @@ from mdanalysis_mpi_tpu.analysis import GNMAnalysis, LinearDensity
 uw.topology.charges = np.zeros(uw.topology.n_atoms)
 lds = LinearDensity(ow, binsize=1.0).run(backend="serial")
 ldj = LinearDensity(ow, binsize=1.0).run(backend="jax", batch_size=4)
-lerr = max(float(np.abs(np.asarray(getattr(ldj.results, ax).mass_density)
-                        - getattr(lds.results, ax).mass_density).max())
-           for ax in ("x", "y", "z"))
-# same boundary-flip class as the density grid: one oxygen flipping a
-# slab in one frame moves mass_density by mass/slab_vol/nfr*conv —
-# tolerate up to 4 such flips, which still catches real divergence
-flip_tol = (16.0 / lds.results.x.slab_volume / nfr * 1.66054) * 4
-assert lerr < max(flip_tol, 1e-3), \
-    f"LinearDensity diverged on chip: {lerr:.2e} (flip_tol {flip_tol:.2e})"
-print(f"lineardensity err {lerr:.2e} (flip tol {flip_tol:.2e})")
+# all selected atoms are OW (one mass), so integer per-slab sample
+# counts are exactly recoverable — same technique as the density grid:
+# on-integer residual catches normalization drift, moved-count bounds
+# the boundary flips without loosening sensitivity
+ow_mass = float(uw.topology.masses[ow.indices[0]])
+lmoved = 0
+for ax in ("x", "y", "z"):
+    sj, ss = getattr(ldj.results, ax), getattr(lds.results, ax)
+    cj = (np.asarray(sj.mass_density) * sj.slab_volume / 1.66053906660
+          / ow_mass * nfr)
+    cs = (ss.mass_density * ss.slab_volume / 1.66053906660
+          / ow_mass * nfr)
+    resid = max(float(np.abs(cj - cj.round()).max()),
+                float(np.abs(cs - cs.round()).max()))
+    assert resid < 1e-2, \
+        f"LinearDensity {ax} counts drifted off-integer: {resid:.2e}"
+    lmoved += int(np.abs(cj.round() - cs.round()).sum())
+assert lmoved <= 8, f"LinearDensity diverged on chip: {lmoved} deltas"
+print(f"lineardensity boundary deltas {lmoved}")
 
 gs = GNMAnalysis(u, select="protein and name CA").run(backend="serial")
 gj = GNMAnalysis(u, select="protein and name CA").run(
@@ -127,6 +136,10 @@ gdiff = np.abs(np.asarray(gj.results.eigenvalues)
 # frame; every other frame must agree tightly.
 bad = int((gdiff > 1e-3).sum())
 assert bad <= 1, f"GNM diverged on chip: {bad} frames off (max {gdiff.max():.2e})"
+# one spring flip perturbs the Laplacian by a rank-2 matrix of 2-norm
+# <= 2 — a boundary frame may move that far, corruption moves further
+assert float(gdiff.max()) < 4.0, \
+    f"GNM frame corrupted on chip: {gdiff.max():.2e}"
 print(f"gnm err median {np.median(gdiff):.2e}, boundary frames {bad}")
 
 # --- flagship cold-path mechanisms on chip (VERDICT r3 next-round #5):
