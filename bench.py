@@ -511,7 +511,8 @@ def main():
     put_gbps = _measure_put_gbps(jax)
     _note(f"[bench] link weather: put {put_gbps:.2f} GB/s")
     _leg_done("accelerator up", n_chips=n_chips,
-              put_gbps=round(put_gbps, 3))
+              put_gbps=round(put_gbps, 3),
+              platform=jax.default_backend())
 
     accel_backend = "jax" if n_chips == 1 else "mesh"
 

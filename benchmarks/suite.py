@@ -149,7 +149,7 @@ def config2(stack):
         row["value"] = rec.get("value")
         row["metric"] = rec.get("metric", row["metric"])
         for k in ("vs_baseline", "cold_value", "status", "error",
-                  "put_gbps", "decode_fps"):
+                  "put_gbps", "decode_fps", "platform"):
             if rec.get(k) is not None:
                 row[f"bench_{k}"] = rec[k]
     except (OSError, ValueError):
@@ -314,8 +314,23 @@ def main():
                 rows.append(fn(stack))
             except Exception as e:                 # keep the suite going
                 rows.append(({"config": fn.__name__, "error": str(e)}, None))
+        # every measured row discloses the accelerator it actually ran
+        # on — a CPU fallback recording must never read as chip numbers.
+        # Captured LAZILY from the already-initialized jax module: a
+        # config-2-only run touches no device and must keep working with
+        # the tunnel down (the outage mode bench.py's probes exist for).
+        jax_mod = sys.modules.get("jax")
+        platform = (jax_mod.default_backend()
+                    if jax_mod is not None else "none")
         # checks LAST: the first result fetch collapses the tunnel
         for rec, check in rows:
+            if rec.get("config") == 2:
+                # config2's number comes from an external bench record,
+                # possibly made on different hardware — label the suite
+                # process separately rather than misattributing it
+                rec["suite_platform"] = platform
+            else:
+                rec["platform"] = platform
             if check is not None:
                 try:
                     check()
