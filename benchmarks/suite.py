@@ -82,6 +82,13 @@ def _serial_fps(make_analysis, n_frames) -> tuple[float, int, float]:
         window *= 2
 
 
+#: the accelerator the measured configs actually ran on, captured by
+#: _timed AFTER a device run completed (so the capture can never itself
+#: initialize a backend — a config-2-only run with the tunnel down must
+#: keep working, and it never calls _timed)
+_PLATFORM = {"name": "none (no measured config ran)"}
+
+
 def _timed(make_analysis, n_frames, run_kwargs):
     """Median frames/sec over REPEATS accelerator runs.  Synchronizes on
     the raw device partials — never on materialized results, which would
@@ -97,6 +104,7 @@ def _timed(make_analysis, n_frames, run_kwargs):
         a = make_analysis().run(**run_kwargs)
         jax.block_until_ready(a._last_total)
         walls.append(time.perf_counter() - t0)
+    _PLATFORM["name"] = jax.default_backend()   # initialized by now
     return (n_frames / float(np.median(walls)), serial, serial_frames,
             serial_cv, a)
 
@@ -316,12 +324,10 @@ def main():
                 rows.append(({"config": fn.__name__, "error": str(e)}, None))
         # every measured row discloses the accelerator it actually ran
         # on — a CPU fallback recording must never read as chip numbers.
-        # Captured LAZILY from the already-initialized jax module: a
-        # config-2-only run touches no device and must keep working with
-        # the tunnel down (the outage mode bench.py's probes exist for).
-        jax_mod = sys.modules.get("jax")
-        platform = (jax_mod.default_backend()
-                    if jax_mod is not None else "none")
+        # _PLATFORM was captured inside _timed after a device run, so
+        # reading it here can never initialize a backend (a config-2-only
+        # run must keep working with the tunnel down).
+        platform = _PLATFORM["name"]
         # checks LAST: the first result fetch collapses the tunnel
         for rec, check in rows:
             if rec.get("config") == 2:

@@ -637,7 +637,8 @@ class MeshExecutor:
         #   with the batch instead of one replicated scalar
         series_gather = devcombine is None and n_proc > 1
         inv_sharded = quantize and n_proc > 1
-        key = (f, devcombine, tuple(devices), self.axis_name,
+        fold = analysis._device_fold_fn
+        key = (f, devcombine, fold, tuple(devices), self.axis_name,
                series_gather, inv_sharded)
         cached = _MESH_CACHE.get(key)
         if cached is not None:
@@ -685,9 +686,23 @@ class MeshExecutor:
             shard_fn, mesh=mesh,
             in_specs=in_specs,
             out_specs=out_specs, check_vma=False))
+        # fused cross-batch fold (same dispatch-halving as the
+        # single-device path, _fused_step): the replicated running total
+        # rides into the shard_map as a P() input and the fold applies
+        # right after the psum merge — one dispatch per batch
+        gfn_fused = None
+        if custom is None and devcombine is not None and fold is not None:
+            def shard_fn_fused(total, params, *staged):
+                merged = devcombine(kernel(params, *staged), axis)
+                return fold(total, merged)
+
+            gfn_fused = jax.jit(shard_map(
+                shard_fn_fused, mesh=mesh,
+                in_specs=(P(),) + in_specs,
+                out_specs=P(), check_vma=False))
         shardings = tuple(NamedSharding(mesh, s) for s in put_specs)
         result = (frames_per_batch_factor, gfn, shardings,
-                  custom[0] if custom is not None else None)
+                  custom[0] if custom is not None else None, gfn_fused)
         _MESH_CACHE[key] = result
         return result
 
@@ -695,12 +710,16 @@ class MeshExecutor:
         import jax
 
         bs = batch_size or self.batch_size
-        bs_factor, gfn, shardings, params_specs = self._build(analysis)
+        bs_factor, gfn, shardings, params_specs, gfn_fused = self._build(
+            analysis)
         global_bs = bs * bs_factor
         params, sel_idx = _wrap_for_transfer(
             analysis._batch_params(), analysis._batch_select(),
             reader.n_atoms, self.transfer_dtype)
         frames = list(frames)
+        fused_call = (None if gfn_fused is None else
+                      lambda total, *staged: gfn_fused(total, params,
+                                                       *staged))
 
         n_proc = jax.process_count()
         if n_proc > 1:
@@ -737,7 +756,8 @@ class MeshExecutor:
                 device_put_fn=put, cache=self.block_cache,
                 quantize=_quant_mode(self.transfer_dtype),
                 local_divisor=n_proc, local_index=jax.process_index(),
-                inv_per_frame=True, prestage=self.prestage)
+                inv_per_frame=True, prestage=self.prestage,
+                fused_call=fused_call)
 
         def put(staged):
             return _put_staged(staged, shardings)
@@ -751,7 +771,7 @@ class MeshExecutor:
             lambda *staged: gfn(params, *staged), sel_idx,
             device_put_fn=put, cache=self.block_cache,
             quantize=_quant_mode(self.transfer_dtype),
-            prestage=self.prestage)
+            prestage=self.prestage, fused_call=fused_call)
 
     def _execute_ring_multihost(self, analysis, reader, frames, bs, gfn,
                                 shardings, params_specs, params, sel_idx,
