@@ -98,13 +98,15 @@ def _timed(make_analysis, n_frames, run_kwargs):
 
     serial, serial_frames, serial_cv = _serial_fps(make_analysis, n_frames)
     make_analysis().run(**run_kwargs)              # compile warm-up
+    # capture right after the first device run: a tunnel collapse later
+    # in the repeats must not erase the fact that device runs happened
+    _PLATFORM["name"] = jax.default_backend()
     walls = []
     for _ in range(REPEATS):
         t0 = time.perf_counter()
         a = make_analysis().run(**run_kwargs)
         jax.block_until_ready(a._last_total)
         walls.append(time.perf_counter() - t0)
-    _PLATFORM["name"] = jax.default_backend()   # initialized by now
     return (n_frames / float(np.median(walls)), serial, serial_frames,
             serial_cv, a)
 

@@ -693,8 +693,9 @@ class MeshExecutor:
         gfn_fused = None
         if custom is None and devcombine is not None and fold is not None:
             def shard_fn_fused(total, params, *staged):
-                merged = devcombine(kernel(params, *staged), axis)
-                return fold(total, merged)
+                # reuse shard_fn so the merge semantics cannot diverge
+                # between batch 1 (gfn) and batches 2+ (gfn_fused)
+                return fold(total, shard_fn(params, *staged))
 
             gfn_fused = jax.jit(shard_map(
                 shard_fn_fused, mesh=mesh,

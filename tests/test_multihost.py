@@ -20,7 +20,10 @@ Round 3 closes every carve-out: the child asserts multi-controller
   BASELINE config 3 at 2 processes,
 - **InterRDF engine='ring'** — the atom-sharded ppermute ring with the
   union atom axis process-sliced (frames replicated), so the ring
-  crosses the process boundary the way it crosses ICI single-host.
+  crosses the process boundary the way it crosses ICI single-host,
+- round-3/4 kernel families: PCA covariance, density grid,
+  **LinearDensity** (law-of-total-variance psum across controllers —
+  mean AND stddev parity) and **GNM** (all_gathered eigen series).
 
 The child script writes process 0's results; the parent compares them
 against the serial f64 oracle computed in-process.
@@ -90,11 +93,26 @@ p = PCA(u, select="name CA", n_components=3).run(backend="mesh",
 dn = DensityAnalysis(u.select_atoms("name CA"), delta=4.0).run(
     backend="mesh", batch_size=2)
 
+# round-4 families at 2 controllers: LinearDensity's law-of-total-
+# variance psum (two moment sets, shared frame counts) and GNM's
+# all_gathered eigen time series
+from mdanalysis_mpi_tpu.analysis import GNMAnalysis, LinearDensity
+ub2 = make_protein_universe(n_residues={n_res}, n_frames={n_frames},
+                            noise=0.3, seed=11, box=40.0)
+ub2.topology.charges = np.linspace(-0.5, 0.5, ub2.topology.n_atoms)
+ld = LinearDensity(ub2.select_atoms("name CA"), binsize=2.0).run(
+    backend="mesh", batch_size=2)
+gn = GNMAnalysis(u, select="name CA").run(backend="mesh", batch_size=2)
+
 if pid == 0:
     np.savez({out!r}, rmsf=a.results.rmsf, rmsf_i16=q.results.rmsf,
              rmsd=rmsd, rdf_ring=rdf_ring,
              pca_variance=np.asarray(p.results.variance),
-             density_grid=dn.results.grid)
+             density_grid=dn.results.grid,
+             ld_mass_z=np.asarray(ld.results.z.mass_density),
+             ld_mass_std_z=np.asarray(ld.results.z.mass_density_stddev),
+             ld_charge_z=np.asarray(ld.results.z.charge_density),
+             gnm_eigenvalues=np.asarray(gn.results.eigenvalues))
 """
 
 
@@ -167,4 +185,24 @@ class TestTwoProcessMesh:
             backend="serial")
         np.testing.assert_allclose(got["density_grid"], sd.results.grid,
                                    atol=1e-6)
+
+        from mdanalysis_mpi_tpu.analysis import GNMAnalysis, LinearDensity
+
+        ub2 = make_protein_universe(n_residues=N_RES, n_frames=N_FRAMES,
+                                    noise=0.3, seed=11, box=40.0)
+        ub2.topology.charges = np.linspace(-0.5, 0.5,
+                                           ub2.topology.n_atoms)
+        sl = LinearDensity(ub2.select_atoms("name CA"),
+                           binsize=2.0).run(backend="serial")
+        np.testing.assert_allclose(got["ld_mass_z"],
+                                   sl.results.z.mass_density, atol=1e-4)
+        np.testing.assert_allclose(got["ld_mass_std_z"],
+                                   sl.results.z.mass_density_stddev,
+                                   atol=1e-4)
+        np.testing.assert_allclose(got["ld_charge_z"],
+                                   sl.results.z.charge_density,
+                                   atol=1e-6)
+        sgn = GNMAnalysis(u, select="name CA").run(backend="serial")
+        np.testing.assert_allclose(got["gnm_eigenvalues"],
+                                   sgn.results.eigenvalues, atol=1e-3)
 
