@@ -34,6 +34,7 @@ from mdanalysis_mpi_tpu.analysis.diffusionmap import (DistanceMatrix,
 from mdanalysis_mpi_tpu.analysis.vacf import VelocityAutocorr
 from mdanalysis_mpi_tpu.analysis.lineardensity import LinearDensity
 from mdanalysis_mpi_tpu.analysis.gnm import GNMAnalysis
+from mdanalysis_mpi_tpu.analysis.waterdynamics import SurvivalProbability
 
 __all__ = ["AnalysisBase", "Results", "AnalysisFromFunction",
            "analysis_class", "RMSF", "RMSD", "AlignedRMSF", "rmsd",
@@ -42,4 +43,5 @@ __all__ = ["AnalysisBase", "Results", "AnalysisFromFunction",
            "PairwiseDistances", "RadiusOfGyration", "PCA", "EinsteinMSD",
            "Dihedral", "Ramachandran", "Contacts", "DensityAnalysis",
            "HydrogenBondAnalysis", "DistanceMatrix", "DiffusionMap",
-           "VelocityAutocorr", "LinearDensity", "GNMAnalysis"]
+           "VelocityAutocorr", "LinearDensity", "GNMAnalysis",
+           "SurvivalProbability"]

@@ -1,0 +1,93 @@
+"""SurvivalProbability — residence-time correlation of a dynamic
+selection (upstream ``analysis.waterdynamics.SurvivalProbability``)."""
+
+import numpy as np
+import pytest
+
+from mdanalysis_mpi_tpu.analysis import SurvivalProbability
+from mdanalysis_mpi_tpu.core.topology import Topology
+from mdanalysis_mpi_tpu.core.universe import Universe
+from mdanalysis_mpi_tpu.io.memory import MemoryReader
+
+
+def _universe(frames):
+    """One fixed 'protein' atom at the origin + three waters whose
+    per-frame x positions are scripted, so shell membership (within
+    3 Å of the origin atom) is known exactly."""
+    n = len(frames)
+    pos = np.zeros((n, 4, 3), np.float32)
+    for f, xs in enumerate(frames):
+        pos[f, 0] = [0.0, 0.0, 0.0]
+        for j, x in enumerate(xs):
+            pos[f, j + 1] = [x, 0.0, 0.0]
+    top = Topology(names=np.array(["CA", "OW", "OW", "OW"]),
+                   resnames=np.array(["GLY", "SOL", "SOL", "SOL"]),
+                   resids=np.array([1, 2, 3, 4]))
+    return Universe(top, MemoryReader(pos))
+
+
+IN, OUT = 2.0, 9.0          # inside / outside the 3 Å shell
+
+
+def test_hand_computed_survival():
+    # membership rows (w1, w2, w3) per frame:
+    # f0: 1,1,0 ; f1: 1,0,0 ; f2: 1,1,1 ; f3: 1,1,1
+    u = _universe([(IN, IN, OUT), (IN, OUT, OUT),
+                   (IN, IN, IN), (IN, IN, IN)])
+    r = SurvivalProbability(u, "name OW and around 3.0 name CA").run(
+        tau_max=2, backend="serial")
+    np.testing.assert_array_equal(r.results.tau_timeseries, [0, 1, 2])
+    # tau=0: always 1.  tau=1: starts f0..f2 -> 1/2, 1/1, 3/3
+    # tau=2: starts f0, f1 -> 1/2, 1/1
+    np.testing.assert_allclose(
+        r.results.sp_timeseries,
+        [1.0, (0.5 + 1.0 + 1.0) / 3, (0.5 + 1.0) / 2])
+
+
+def test_intermittency_fills_single_gap():
+    # w1 leaves for exactly one frame (f1) then returns
+    u = _universe([(IN, OUT, OUT), (OUT, OUT, OUT),
+                   (IN, OUT, OUT), (IN, OUT, OUT)])
+    strict = SurvivalProbability(
+        u, "name OW and around 3.0 name CA").run(tau_max=3,
+                                                 backend="serial")
+    # strict: the f1 absence breaks every window crossing it
+    np.testing.assert_allclose(strict.results.sp_timeseries[3], 0.0)
+    loose = SurvivalProbability(
+        u, "name OW and around 3.0 name CA", intermittency=1).run(
+        tau_max=3, backend="serial")
+    # with the gap filled, w1 survives f0..f3 continuously
+    np.testing.assert_allclose(loose.results.sp_timeseries[3], 1.0)
+
+
+def test_empty_start_windows_are_skipped():
+    u = _universe([(OUT, OUT, OUT), (IN, OUT, OUT), (IN, OUT, OUT)])
+    r = SurvivalProbability(u, "name OW and around 3.0 name CA").run(
+        tau_max=1, backend="serial")
+    # tau=1 averages only over starts with N(t) > 0 (f1 here)
+    np.testing.assert_allclose(r.results.sp_timeseries, [1.0, 1.0])
+
+
+def test_validation_and_batch_refusal():
+    u = _universe([(IN, IN, IN)])
+    with pytest.raises(ValueError, match="intermittency"):
+        SurvivalProbability(u, "name OW", intermittency=-1)
+    with pytest.raises(ValueError, match="tau_max"):
+        SurvivalProbability(u, "name OW").run(tau_max=-1)
+    with pytest.raises(Exception):      # selection typo fails up front
+        SurvivalProbability(u, "nmae OW").run(backend="serial")
+    u2 = _universe([(IN, IN, IN)] * 4)
+    with pytest.raises(ValueError, match="serial backend only"):
+        SurvivalProbability(u2, "name OW").run(backend="jax",
+                                               batch_size=2)
+    # tau_max beyond the window is clamped to T-1
+    r = SurvivalProbability(u2, "name OW").run(tau_max=99,
+                                               backend="serial")
+    assert len(r.results.tau_timeseries) == 4
+    np.testing.assert_allclose(r.results.sp_timeseries, np.ones(4))
+
+
+def test_zero_frames_is_clear_error():
+    u = _universe([(IN, IN, IN)] * 3)
+    with pytest.raises(ValueError, match="zero frames"):
+        SurvivalProbability(u, "name OW").run(stop=0, backend="serial")
