@@ -1,24 +1,44 @@
 #!/bin/bash
 # One-shot round-N artifact recorder (run on the real chip when the
 # tunnel is up).  Produces, next to the driver's BENCH_r{N}.json:
-#   SUITE_r{N}.json      — the 5-config matrix with serial windows
-#   TPUSMOKE_r{N}.json   — on-chip pytest -m tpu result (VERDICT r2 #8)
-#   PROFILE_r{N}.json    — staging phase decomposition for PERF.md
-# Usage: benchmarks/record_round.sh <round-number>
+#   BENCH_r{N}_builder.json  — builder-attested flagship bench record
+#   BENCH_r{N}_b{B}.json     — batch-size sweep points (steady state is
+#                              dispatch-bound; bigger batches = fewer
+#                              dispatches; each metric string discloses
+#                              its batch)
+#   SUITE_r{N}.json          — the 7-config matrix with serial windows
+#   TPUSMOKE_r{N}.json       — on-chip pytest -m tpu result
+#   PROFILE_r{N}.json        — staging phase decomposition for PERF.md
+# Ordering: bench FIRST (if the tunnel dies mid-recording, the scored
+# series' backup lands before the informational artifacts), everything
+# strictly sequential — one process may hold the TPU at a time and the
+# serial legs need a quiet host (PERF.md measurement protocol).
+# Usage: benchmarks/record_round.sh <round-number> [quick]
 set -u
-N="${1:?usage: record_round.sh <round-number>}"
+N="${1:?usage: record_round.sh <round-number> [quick]}"
+QUICK="${2:-}"
+NN="$(printf %02d "$N")"
 cd "$(dirname "$0")/.."
 
-echo "[record] on-chip smoke..." >&2
-MDTPU_TPU_TESTS=1 python -m pytest tests/ -m tpu -q > /tmp/tpusmoke.txt 2>&1
-rc=$?
-python - "$N" "$rc" <<'EOF'
-import json, sys
-n, rc = sys.argv[1], int(sys.argv[2])
-txt = open("/tmp/tpusmoke.txt").read()
-json.dump({"round": int(n), "rc": rc, "tail": txt[-2000:]},
-          open(f"TPUSMOKE_r{n.zfill(2)}.json", "w"), indent=1)
-EOF
+echo "[record] probing accelerator (150 s cap)..." >&2
+if ! timeout 150 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    echo "[record] tunnel down; aborting with nothing written" >&2
+    exit 3
+fi
+
+echo "[record] flagship bench (default batch)..." >&2
+python bench.py 2>"/tmp/bench_r${NN}.log" | tail -1 \
+    > "BENCH_r${NN}_builder.json"
+echo "[record]   -> $(head -c 200 "BENCH_r${NN}_builder.json")" >&2
+
+if [ "$QUICK" != "quick" ]; then
+    for B in 128 256; do
+        echo "[record] bench sweep BENCH_BATCH=$B..." >&2
+        BENCH_BATCH=$B python bench.py 2>>"/tmp/bench_r${NN}.log" \
+            | tail -1 > "BENCH_r${NN}_b${B}.json"
+        echo "[record]   -> $(head -c 160 "BENCH_r${NN}_b${B}.json")" >&2
+    done
+fi
 
 echo "[record] suite..." >&2
 if ! python benchmarks/suite.py > "/tmp/suite_rows.jsonl" \
@@ -36,18 +56,29 @@ json.dump({"round": int(n),
            "hardware": "1x TPU v5 lite (tunneled), 1 host core",
            "note": ("value = accelerator frames/s (median, readback-free "
                     "timing); serial_fps measured first on an adaptive "
-                    "window (serial_frames) stable to ~10%"),
+                    "window (serial_frames) with the serial_cv <= 0.1 "
+                    "stability criterion recorded per row"),
            "rows": rows},
           open(f"SUITE_r{n.zfill(2)}.json", "w"), indent=1)
 EOF
 
+echo "[record] on-chip smoke..." >&2
+MDTPU_TPU_TESTS=1 python -m pytest tests/ -m tpu -q > /tmp/tpusmoke.txt 2>&1
+rc=$?
+python - "$N" "$rc" <<'EOF'
+import json, sys
+n, rc = sys.argv[1], int(sys.argv[2])
+txt = open("/tmp/tpusmoke.txt").read()
+json.dump({"round": int(n), "rc": rc, "tail": txt[-2500:]},
+          open(f"TPUSMOKE_r{n.zfill(2)}.json", "w"), indent=1)
+EOF
+
 echo "[record] staging profile..." >&2
 if ! python benchmarks/profile_staging.py \
-        > "PROFILE_r$(printf %02d "$N").json" 2>/tmp/profile_err.txt; then
+        > "PROFILE_r${NN}.json" 2>/tmp/profile_err.txt; then
     echo "[record] PROFILE FAILED:" >&2
     tail -5 /tmp/profile_err.txt >&2
     exit 1
 fi
 
-echo "[record] bench (informational run; the driver records its own)..." >&2
-python bench.py
+echo "[record] all round-${N} artifacts written" >&2
