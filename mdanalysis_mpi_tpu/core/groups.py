@@ -169,6 +169,24 @@ class AtomGroup:
     def total_mass(self) -> float:
         return float(self.masses.sum())
 
+    def total_charge(self) -> float:
+        """Sum of partial charges, e (upstream ``ag.total_charge()``)."""
+        return float(self.charges.sum())
+
+    def dipole_moment(self) -> float:
+        """|Σ qᵢ·(rᵢ − COM)| in e·Å (upstream ``ag.dipole_moment``
+        convention: charge-weighted displacement about the mass-weighted
+        center).  For a non-neutral group the value depends on that
+        reference point, as upstream documents."""
+        return float(np.linalg.norm(self.dipole_vector()))
+
+    def dipole_vector(self) -> np.ndarray:
+        """Σ qᵢ·(rᵢ − COM), e·Å (upstream ``ag.dipole_vector``)."""
+        q = self.charges.astype(np.float64)
+        x = self.positions.astype(np.float64)
+        com = self.center_of_mass()
+        return (q[:, None] * (x - com)).sum(axis=0)
+
     def radius_of_gyration(self) -> float:
         """Mass-weighted radius of gyration, float64 (upstream
         ``AtomGroup.radius_of_gyration``): sqrt(Σ mᵢ·|rᵢ−COM|² / Σ mᵢ)."""
@@ -290,8 +308,13 @@ class AtomGroup:
         insensitive = udict.setdefault("_selection_scope_insensitive",
                                        set())
         # exact bytes as the scope key (a 64-bit hash could collide and
-        # silently serve another subgroup's mask)
-        key = (selection, None if whole or selection in insensitive
+        # silently serve another subgroup's mask).  The topology's
+        # attr_version joins the key because the topology — and thus a
+        # cached mask's validity — is SHARED across Universe.copy()
+        # clones: mutators (add_TopologyAttr, guess_bonds) bump it, so
+        # every sharer misses cleanly instead of serving a stale mask.
+        key = (selection, top._derived.get("attr_version", 0),
+               None if whole or selection in insensitive
                else self._indices.tobytes())
         mask = cache.get(key)
         if mask is None:
@@ -312,7 +335,8 @@ class AtomGroup:
             if not touched_frame:
                 if not whole and not scope_consulted:
                     insensitive.add(selection)
-                    key = (selection, None)
+                    key = (selection, top._derived.get("attr_version", 0),
+                           None)
                 if len(cache) >= 256:    # bound stale-string buildup
                     cache.clear()
                 if len(insensitive) >= 256:   # same bound, same reason
@@ -382,6 +406,9 @@ class AtomGroup:
         self._universe.__dict__.pop("_selection_cache", None)
         self._universe.__dict__.pop("_selection_scope_insensitive", None)
         t._derived.pop("fragindices", None)
+        # copy() clones share this topology; their memoized `bonded`
+        # masks go stale too — the version bump invalidates them
+        t._derived["attr_version"] = t._derived.get("attr_version", 0) + 1
         return np.asarray(bonds, dtype=np.int64).reshape(-1, 2)
 
     def write(self, path: str) -> None:

@@ -99,6 +99,39 @@ class Universe:
         """
         return self.atoms.select_atoms(selection)
 
+    #: attributes settable via add_TopologyAttr → Topology field.  Per-
+    #: atom float arrays only; structural attributes (names, resids,
+    #: bonds) define identity and are construction-time.
+    _SETTABLE_ATTRS = {"charges": "charges", "masses": "masses",
+                       "charge": "charges", "mass": "masses"}
+
+    def add_TopologyAttr(self, name: str, values=None) -> None:
+        """Attach a per-atom topology attribute after construction
+        (upstream ``Universe.add_TopologyAttr`` for the attributes that
+        are data, not identity): ``charges`` / ``masses``, with
+        ``values`` length n_atoms (default zeros — upstream's empty
+        attr).  Selection caches keyed on the old values are busted —
+        including those of ``copy()`` clones, which share the
+        topology."""
+        field = self._SETTABLE_ATTRS.get(name)
+        if field is None:
+            raise ValueError(
+                f"cannot add topology attribute {name!r}; settable: "
+                f"{sorted(set(self._SETTABLE_ATTRS.values()))} "
+                "(structural attributes are construction-time)")
+        n = self.topology.n_atoms
+        arr = (np.zeros(n) if values is None
+               else np.asarray(values, dtype=np.float64))
+        if arr.shape != (n,):
+            raise ValueError(
+                f"{name} needs {n} per-atom values, got shape {arr.shape}")
+        setattr(self.topology, field, arr)
+        # prop mass/charge selections memoize against the old values;
+        # the version bump invalidates every universe sharing this
+        # topology (the memo key includes it)
+        d = self.topology._derived
+        d["attr_version"] = d.get("attr_version", 0) + 1
+
     def copy(self) -> "Universe":
         """Clone with an independent trajectory cursor (RMSF.py:57).
 

@@ -547,3 +547,58 @@ class TestFragments:
         assert u.topology.n_fragments == 2       # cached: two singletons
         u.atoms.guess_bonds()
         assert u.topology.n_fragments == 1       # stale cache busted
+
+
+class TestTopologyAttrAndCharges:
+    def _universe(self):
+        top = Topology(names=np.array(["OW", "HW1", "HW2"]),
+                       resnames=np.array(["SOL"] * 3),
+                       resids=np.array([1, 1, 1]))
+        pos = np.array([[[0.0, 0, 0], [1.0, 0, 0], [-1.0, 0, 0]]],
+                       np.float32)
+        return Universe(top, MemoryReader(pos))
+
+    def test_add_topology_attr_charges(self):
+        u = self._universe()
+        with pytest.raises(AttributeError, match="charges"):
+            u.atoms.charges
+        u.add_TopologyAttr("charges", [-0.8, 0.4, 0.4])
+        np.testing.assert_allclose(u.atoms.charges, [-0.8, 0.4, 0.4])
+        assert u.atoms.total_charge() == pytest.approx(0.0)
+        # default: zeros (upstream's empty attr)
+        u2 = self._universe()
+        u2.add_TopologyAttr("charges")
+        assert u2.atoms.total_charge() == 0.0
+        with pytest.raises(ValueError, match="per-atom"):
+            u2.add_TopologyAttr("charges", [1.0])
+        with pytest.raises(ValueError, match="settable"):
+            u2.add_TopologyAttr("names", ["A", "B", "C"])
+
+    def test_add_topology_attr_busts_prop_selection_cache(self):
+        u = self._universe()
+        u.add_TopologyAttr("charges", [0.0, 0.0, 0.0])
+        assert u.select_atoms("prop charge > 0.1").n_atoms == 0
+        u.add_TopologyAttr("charges", [-0.8, 0.4, 0.4])
+        assert u.select_atoms("prop charge > 0.1").n_atoms == 2
+
+    def test_dipole_moment(self):
+        u = self._universe()
+        u.add_TopologyAttr("charges", [-0.8, 0.4, 0.4])
+        # symmetric H placement about the O: charge displacements cancel
+        # (COM ~ on the O for equal H masses)
+        v = u.atoms.dipole_vector()
+        np.testing.assert_allclose(v, [0.0, 0.0, 0.0], atol=1e-10)
+        # break the symmetry: move one H out
+        u.trajectory.ts.positions[1] = [2.0, 0.0, 0.0]
+        d = u.atoms.dipole_moment()
+        assert d > 0.3
+
+    def test_attr_change_invalidates_copies_too(self):
+        """copy() clones share the topology; a mutated attribute must
+        bust THEIR memoized selections as well (r4 review finding)."""
+        u = self._universe()
+        u.add_TopologyAttr("charges", [0.0, 0.0, 0.0])
+        u2 = u.copy()
+        assert u2.select_atoms("prop charge > 0.1").n_atoms == 0
+        u.add_TopologyAttr("charges", [-0.8, 0.4, 0.4])
+        assert u2.select_atoms("prop charge > 0.1").n_atoms == 2

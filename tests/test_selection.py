@@ -385,7 +385,8 @@ class TestSelectionMemoization:
         b = u.select_atoms("protein and name CA")
         np.testing.assert_array_equal(a.indices, b.indices)
         cache = u.__dict__["_selection_cache"]
-        assert ("protein and name CA", None) in cache
+        # key = (selection, topology attr_version, scope)
+        assert ("protein and name CA", 0, None) in cache
 
     def test_geometric_not_cached(self):
         u = make_solvated_universe(n_frames=4)
@@ -402,7 +403,8 @@ class TestSelectionMemoization:
         whole = u.select_atoms("name CA")
         np.testing.assert_array_equal(whole.indices, sub.indices)
         cache = u.__dict__["_selection_cache"]
-        assert [k for k in cache if k[0] == "name CA"] == [("name CA", None)]
+        assert [k for k in cache if k[0] == "name CA"] == [
+            ("name CA", 0, None)]
 
     def test_scope_sensitive_strings_keyed_per_subgroup(self):
         # byres consults the scope: a subgroup's mask must NOT be shared
