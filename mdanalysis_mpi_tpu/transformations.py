@@ -72,18 +72,11 @@ class rotateby(TransformationBase):
                  center: str = "geometry"):
         if (point is None) == (ag is None):
             raise ValueError("rotateby needs exactly one of point= or ag=")
-        d = np.asarray(direction, np.float64).reshape(3)
-        n = float(np.linalg.norm(d))
-        if n == 0.0:
-            raise ValueError("direction must be a nonzero vector")
-        k = d / n
-        theta = np.radians(float(angle))
-        # Rodrigues: R = I + sin K + (1-cos) K², K the cross matrix of k
-        kx = np.array([[0.0, -k[2], k[1]],
-                       [k[2], 0.0, -k[0]],
-                       [-k[1], k[0], 0.0]])
-        self._rot = (np.eye(3) + np.sin(theta) * kx
-                     + (1.0 - np.cos(theta)) * (kx @ kx))
+        from mdanalysis_mpi_tpu.lib.transformations import rotation_matrix
+
+        # one Rodrigues implementation for the whole package
+        self._rot = rotation_matrix(np.radians(float(angle)),
+                                    direction)[:3, :3]
         self._point = None if point is None else np.asarray(point,
                                                             np.float64)
         self._ag = ag
