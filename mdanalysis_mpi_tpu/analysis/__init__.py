@@ -20,7 +20,7 @@ from mdanalysis_mpi_tpu.analysis.base import (AnalysisBase, Results,
 from mdanalysis_mpi_tpu.analysis.rms import RMSF, RMSD, AlignedRMSF, rmsd
 from mdanalysis_mpi_tpu.analysis.align import (AverageStructure, AlignTraj,
                                                alignto, rotation_matrix)
-from mdanalysis_mpi_tpu.analysis.rdf import InterRDF
+from mdanalysis_mpi_tpu.analysis.rdf import InterRDF, InterRDF_s
 from mdanalysis_mpi_tpu.analysis.distances import ContactMap, PairwiseDistances
 from mdanalysis_mpi_tpu.analysis.rgyr import RadiusOfGyration
 from mdanalysis_mpi_tpu.analysis.pca import PCA
@@ -39,7 +39,7 @@ from mdanalysis_mpi_tpu.analysis.waterdynamics import SurvivalProbability
 __all__ = ["AnalysisBase", "Results", "AnalysisFromFunction",
            "analysis_class", "RMSF", "RMSD", "AlignedRMSF", "rmsd",
            "AverageStructure", "AlignTraj", "alignto", "rotation_matrix",
-           "InterRDF", "ContactMap",
+           "InterRDF", "InterRDF_s", "ContactMap",
            "PairwiseDistances", "RadiusOfGyration", "PCA", "EinsteinMSD",
            "Dihedral", "Ramachandran", "Contacts", "DensityAnalysis",
            "HydrogenBondAnalysis", "DistanceMatrix", "DiffusionMap",

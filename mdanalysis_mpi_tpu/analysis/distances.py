@@ -160,6 +160,39 @@ def dist(ag1, ag2, offset=0, box=None):
     return np.array([ag1.resids + off_a, ag2.resids + off_b, d])
 
 
+def contact_matrix(coord, cutoff: float = 15.0, returntype: str = "numpy",
+                   box=None):
+    """Dense or sparse boolean contact map of one coordinate set
+    (upstream ``analysis.distances.contact_matrix``): entry (i, j) is
+    True when ``d(i, j) < cutoff`` under the optional minimum-image
+    box; the diagonal is True (zero self-distance), as upstream."""
+    from mdanalysis_mpi_tpu.ops.host import distance_array
+
+    x = np.asarray(coord, dtype=np.float64)
+    if returntype == "numpy":
+        d = distance_array(x, x, None if box is None else np.asarray(box))
+        return d < cutoff
+    if returntype == "sparse":
+        from scipy import sparse
+
+        from mdanalysis_mpi_tpu.lib.distances import self_capped_distance
+
+        # full-precision coords and a STRICT d < cutoff filter, so the
+        # sparse and dense returntypes agree at the boundary
+        pairs, d = self_capped_distance(
+            x, cutoff, box=None if box is None else np.asarray(box),
+            return_distances=True)
+        pairs = pairs[d < cutoff]
+        n = len(x)
+        rows = np.concatenate([pairs[:, 0], pairs[:, 1], np.arange(n)])
+        cols = np.concatenate([pairs[:, 1], pairs[:, 0], np.arange(n)])
+        return sparse.coo_matrix(
+            (np.ones(len(rows), dtype=bool), (rows, cols)),
+            shape=(n, n)).tolil()
+    raise ValueError(
+        f"returntype must be 'numpy' or 'sparse', got {returntype!r}")
+
+
 def between(group, A, B, distance: float):
     """Atoms of ``group`` within ``distance`` of BOTH groups A and B on
     the current frame (upstream ``analysis.distances.between``)."""

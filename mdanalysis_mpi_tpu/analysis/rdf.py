@@ -348,3 +348,217 @@ class InterRDF(AnalysisBase):
         group = deferred_group(_finalize)
         self.results.count = group["count"]
         self.results.rdf = group["rdf"]
+
+
+# ---- site-resolved RDF (upstream InterRDF_s) ----
+
+def _rdf_s_kernel(params, batch, boxes, mask):
+    """Per-SITE-pair histograms: every (i, j) site combination of every
+    ags pair is one row of a flat pair list, so the whole analysis is
+    P scalar distances per frame + one scatter — static shapes, any
+    number of ags pairs in one kernel."""
+    import jax
+    import jax.numpy as jnp
+
+    from mdanalysis_mpi_tpu.ops._boxmat import box_to_matrix
+    from mdanalysis_mpi_tpu.ops.distances import minimum_image as mi
+
+    loc_a, loc_b, edges = params
+    p = loc_a.shape[0]
+    nb = edges.shape[0] - 1
+
+    def per_frame(args):
+        x, box6 = args
+        d = jnp.sqrt(
+            (mi(x[loc_a] - x[loc_b], box6) ** 2).sum(-1))      # (P,)
+        k = jnp.searchsorted(edges, d, side="right") - 1
+        inside = (d >= edges[0]) & (d < edges[-1]) & (k >= 0) & (k < nb)
+        flat = (jnp.arange(p, dtype=jnp.int32) * (nb + 1)
+                + jnp.where(inside, k, nb).astype(jnp.int32))
+        return jnp.zeros(p * (nb + 1), jnp.float32).at[flat].add(1.0)
+
+    hists = jax.lax.map(per_frame, (batch, boxes))
+    m = mask.astype(jnp.float32)
+    counts = (hists * m[:, None]).sum(0)
+    vols = jax.vmap(
+        lambda b6: jnp.abs(jnp.linalg.det(box_to_matrix(b6))))(boxes)
+    vol_sum = (vols * m).sum()
+    n_boxed = ((vols > 0.0) * m).sum()
+    return counts, vol_sum, m.sum(), n_boxed
+
+
+class InterRDF_s(AnalysisBase):
+    """Site-resolved RDF (upstream ``rdf.InterRDF_s``): one g(r) per
+    ATOM PAIR for each ``(g1, g2)`` entry of ``ags``.
+
+    ``InterRDF_s(u, [(s1, s2), ...]).run()`` → ``results.rdf`` /
+    ``results.count``: lists, entry k of shape (len(g1ₖ), len(g2ₖ),
+    nbins); ``results.bins`` / ``results.edges`` shared.  Norms match
+    :class:`InterRDF` with N_pairs = 1 per site pair.  ``get_cdf()``
+    returns the per-pair cumulative ⟨count within r⟩ (upstream method).
+    """
+
+    def __init__(self, universe, ags, nbins: int = 75,
+                 range: tuple[float, float] = (0.0, 15.0),
+                 norm: str = "rdf", verbose: bool = False):
+        if norm not in ("rdf", "density", "none"):
+            raise ValueError(
+                f"norm must be 'rdf', 'density' or 'none', got {norm!r}")
+        pairs = list(ags)
+        if not pairs:
+            raise ValueError("InterRDF_s needs at least one (g1, g2) pair")
+        for k, entry in enumerate(pairs):
+            if (not isinstance(entry, (tuple, list)) or len(entry) != 2
+                    or not all(isinstance(g, AtomGroup) for g in entry)):
+                raise ValueError(
+                    f"ags[{k}] must be an (AtomGroup, AtomGroup) pair")
+            if any(g.universe is not universe for g in entry):
+                raise ValueError(
+                    f"ags[{k}] does not belong to the given universe")
+            if any(g.n_atoms == 0 for g in entry):
+                raise ValueError(f"ags[{k}] contains an empty group")
+        super().__init__(universe, verbose)
+        self._ags = pairs
+        self._nbins = int(nbins)
+        self._range = (float(range[0]), float(range[1]))
+        self._norm = norm
+
+    def _prepare(self):
+        if self._universe.trajectory.ts.dimensions is None:
+            raise ValueError(
+                "InterRDF_s requires a periodic box (trajectory has none)")
+        self._edges = np.linspace(self._range[0], self._range[1],
+                                  self._nbins + 1)
+        self._shapes = [(g1.n_atoms, g2.n_atoms) for g1, g2 in self._ags]
+        total_pairs = int(sum(a * b for a, b in self._shapes))
+        if total_pairs * (self._nbins + 1) > 20_000_000:
+            raise ValueError(
+                f"{total_pairs} site pairs x {self._nbins} bins exceeds "
+                "the per-pair histogram budget; InterRDF_s is for small "
+                "site groups (use InterRDF for bulk g(r))")
+        union = np.union1d(
+            np.concatenate([np.concatenate([g1.indices, g2.indices])
+                            for g1, g2 in self._ags]), [])
+        self._union = union.astype(np.int64)
+        loc_a, loc_b = [], []
+        for g1, g2 in self._ags:
+            a = np.searchsorted(union, g1.indices)
+            b = np.searchsorted(union, g2.indices)
+            loc_a.append(np.repeat(a, len(b)))
+            loc_b.append(np.tile(b, len(a)))
+        self._loc_a = np.concatenate(loc_a).astype(np.int32)
+        self._loc_b = np.concatenate(loc_b).astype(np.int32)
+        p = len(self._loc_a)
+        self._counts = np.zeros(p * (self._nbins + 1), dtype=np.float64)
+        self._vol_sum = 0.0
+        self._t = 0
+        self._n_boxed = 0
+
+    # -- serial path --
+
+    def _single_frame(self, ts):
+        if ts.dimensions is None:
+            raise ValueError(
+                f"frame {ts.frame} has no box; every frame must carry "
+                "one for g(r) normalization")
+        x = ts.positions[self._union].astype(np.float64)
+        disp = host.minimum_image(x[self._loc_a] - x[self._loc_b],
+                                  ts.dimensions)
+        d = np.sqrt((disp ** 2).sum(-1))
+        nb = self._nbins
+        k = np.searchsorted(self._edges, d, side="right") - 1
+        inside = (d >= self._edges[0]) & (d < self._edges[-1]) \
+            & (k >= 0) & (k < nb)
+        flat = (np.arange(len(d)) * (nb + 1)
+                + np.where(inside, k, nb))
+        np.add.at(self._counts, flat, 1.0)
+        from mdanalysis_mpi_tpu.lib.mdamath import box_volume
+
+        vol = float(box_volume(ts.dimensions))
+        if vol <= 0.0:
+            # same contract as InterRDF's serial path and this class's
+            # own batch n_boxed guard: a zero-volume box must fail, not
+            # silently deflate <V>
+            raise ValueError(
+                f"frame {ts.frame} has a zero-volume box; every frame "
+                "must carry a real box for g(r) normalization")
+        self._vol_sum += vol
+        self._t += 1
+        self._n_boxed += 1
+
+    def _serial_summary(self):
+        return (self._counts, self._vol_sum, float(self._t),
+                float(self._n_boxed))
+
+    # -- batch path --
+
+    def _batch_select(self):
+        return self._union
+
+    def _batch_fn(self):
+        return _rdf_s_kernel
+
+    def _batch_params(self):
+        import jax.numpy as jnp
+
+        return (jnp.asarray(self._loc_a), jnp.asarray(self._loc_b),
+                jnp.asarray(self._edges, jnp.float32))
+
+    _device_combine = staticmethod(tree_psum)
+    _device_fold_fn = staticmethod(tree_add)
+
+    def _identity_partials(self):
+        return (np.zeros(len(self._loc_a) * (self._nbins + 1)),
+                0.0, 0.0, 0.0)
+
+    def _conclude(self, total):
+        edges = self._edges
+        nb = self._nbins
+        shapes = self._shapes
+        norm = self._norm
+        self.results.edges = edges
+        self.results.bins = 0.5 * (edges[:-1] + edges[1:])
+
+        def _finalize():
+            counts = np.asarray(total[0], np.float64)
+            vol_sum, t, n_boxed = (float(total[1]), float(total[2]),
+                                   float(total[3]))
+            if t == 0:
+                raise ValueError("InterRDF_s over zero frames")
+            if n_boxed != t:
+                raise ValueError(
+                    f"InterRDF_s: {int(t - n_boxed)} of {int(t)} frames "
+                    "have no periodic box; every frame must carry one "
+                    "for g(r) normalization")
+            per_pair = counts.reshape(-1, nb + 1)[:, :nb]
+            vols = 4.0 / 3.0 * np.pi * (edges[1:] ** 3 - edges[:-1] ** 3)
+            if norm == "rdf":
+                flat = per_pair * (vol_sum / t) / (vols * t)
+            elif norm == "density":
+                flat = per_pair / (vols * t)
+            else:
+                flat = per_pair.copy()
+            count_list, rdf_list, lo = [], [], 0
+            for n1, n2 in shapes:
+                count_list.append(
+                    per_pair[lo:lo + n1 * n2].reshape(n1, n2, nb))
+                rdf_list.append(
+                    flat[lo:lo + n1 * n2].reshape(n1, n2, nb))
+                lo += n1 * n2
+            return {"count": count_list, "rdf": rdf_list, "t": t}
+
+        from mdanalysis_mpi_tpu.analysis.base import deferred_group
+
+        group = deferred_group(_finalize)
+        self.results.count = group["count"]
+        self.results.rdf = group["rdf"]
+        self._t_deferred = group["t"]
+
+    def get_cdf(self):
+        """Per-pair cumulative mean count within r (upstream method):
+        list of (n1, n2, nbins) arrays, entry k for ags[k]."""
+        from mdanalysis_mpi_tpu.analysis.base import _materialize
+
+        counts = self.results.count          # shares the one finalize
+        t = float(_materialize(self._t_deferred))
+        return [c.cumsum(axis=-1) / t for c in counts]
