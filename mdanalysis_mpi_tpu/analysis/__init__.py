@@ -35,6 +35,7 @@ from mdanalysis_mpi_tpu.analysis.vacf import VelocityAutocorr
 from mdanalysis_mpi_tpu.analysis.lineardensity import LinearDensity
 from mdanalysis_mpi_tpu.analysis.gnm import GNMAnalysis
 from mdanalysis_mpi_tpu.analysis.waterdynamics import SurvivalProbability
+from mdanalysis_mpi_tpu.analysis.dielectric import DielectricConstant
 
 __all__ = ["AnalysisBase", "Results", "AnalysisFromFunction",
            "analysis_class", "RMSF", "RMSD", "AlignedRMSF", "rmsd",
@@ -44,4 +45,4 @@ __all__ = ["AnalysisBase", "Results", "AnalysisFromFunction",
            "Dihedral", "Ramachandran", "Contacts", "DensityAnalysis",
            "HydrogenBondAnalysis", "DistanceMatrix", "DiffusionMap",
            "VelocityAutocorr", "LinearDensity", "GNMAnalysis",
-           "SurvivalProbability"]
+           "SurvivalProbability", "DielectricConstant"]

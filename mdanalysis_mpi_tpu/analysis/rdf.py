@@ -59,10 +59,9 @@ def _rdf_kernel(exclude_self: bool, tile: int, engine: str,
         # without a box is staged as a zero box, which would silently
         # deflate <V> and unwrap distances — _conclude rejects runs where
         # n_boxed != T (the batch-path image of the serial per-frame check).
-        import jax
+        from mdanalysis_mpi_tpu.ops._boxmat import batch_box_volumes
 
-        vols = jax.vmap(lambda b6: jnp.abs(jnp.linalg.det(box_to_matrix(b6))))(
-            boxes)
+        vols = batch_box_volumes(boxes)
         n_boxed = ((vols > 0.0) * mask).sum()
         return counts, vol_sum, t, n_boxed
 
@@ -380,8 +379,9 @@ def _rdf_s_kernel(params, batch, boxes, mask):
     hists = jax.lax.map(per_frame, (batch, boxes))
     m = mask.astype(jnp.float32)
     counts = (hists * m[:, None]).sum(0)
-    vols = jax.vmap(
-        lambda b6: jnp.abs(jnp.linalg.det(box_to_matrix(b6))))(boxes)
+    from mdanalysis_mpi_tpu.ops._boxmat import batch_box_volumes
+
+    vols = batch_box_volumes(boxes)
     vol_sum = (vols * m).sum()
     n_boxed = ((vols > 0.0) * m).sum()
     return counts, vol_sum, m.sum(), n_boxed

@@ -26,3 +26,11 @@ def box_to_matrix(dim: jax.Array) -> jax.Array:
     return jnp.array([[lx, 0.0, 0.0],
                       [m10, m11, 0.0],
                       [m20, m21, m22]])
+
+
+def batch_box_volumes(boxes: jax.Array) -> jax.Array:
+    """(B, 6) staged box rows → (B,) volumes (0 for boxless zero rows).
+    The one definition of the per-frame volume used by every kernel
+    that normalizes against ⟨V⟩."""
+    return jax.vmap(
+        lambda b6: jnp.abs(jnp.linalg.det(box_to_matrix(b6))))(boxes)
