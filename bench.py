@@ -55,7 +55,12 @@ the record must never again be a bare null —
 Env knobs: BENCH_ATOMS, BENCH_FRAMES, BENCH_BATCH,
 BENCH_SERIAL_FRAMES, BENCH_REPEATS, BENCH_TRANSFER,
 BENCH_SOURCE=file|memory, BENCH_INIT_BUDGET, BENCH_PROBE_TIMEOUT,
-BENCH_TOTAL_TIMEOUT.
+BENCH_TOTAL_TIMEOUT; ``--watch`` (or BENCH_WATCH=1) +
+BENCH_WATCH_HORIZON / BENCH_WATCH_SLEEP — keep probing past the init
+budget and complete the record in place on tunnel recovery (VERDICT
+r4 #2).  The artifact also carries a static-cost-model roofline for
+the steady and cold legs (achieved_gflops / achieved_hbm_gbps /
+roofline_frac vs TPU v5e peaks — VERDICT r4 #3).
 """
 
 import json
@@ -83,6 +88,9 @@ SERIAL_FRAMES = int(os.environ.get("BENCH_SERIAL_FRAMES", 32))
 SELECT = os.environ.get("BENCH_SELECT", "heavy")
 REPEATS = int(os.environ.get("BENCH_REPEATS", 7))
 SOURCE = os.environ.get("BENCH_SOURCE", "file")   # file | memory
+#: persistent recovery recorder (VERDICT r4 #2) — see _wait_for_accelerator
+WATCH = ("--watch" in sys.argv[1:]
+         or os.environ.get("BENCH_WATCH", "0") == "1")
 R01_FRAMES = 512                                  # the r01 leg's window
 DATA_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         ".bench_data")
@@ -243,8 +251,10 @@ def _write_partial() -> None:
         with open(tmp, "w") as f:
             f.write(json.dumps(dict(RESULT)) + "\n")
         os.replace(tmp, PARTIAL_PATH)
-    except (OSError, RuntimeError):      # read-only fs / racing snapshot
-        pass                             # must not kill legs
+    except Exception:   # read-only fs, racing snapshot, OR an
+        pass            # unserializable leg value — must not kill legs,
+        #               and must not re-raise past _emit_final's fallback
+        #               (which would lose the final stdout line)
 
 
 def _leg_done(status: str, **fields) -> None:
@@ -275,11 +285,15 @@ def _emit_final(error: str | None = None, code: int = 0,
                 RESULT.pop("status", None)
             try:
                 line = json.dumps(dict(RESULT))
-            except RuntimeError:        # racing mutation (unlocked path)
+            except Exception:   # racing mutation (unlocked path) OR a
+                # non-JSON value (e.g. a numpy scalar) in a leg field —
+                # either way the final line must still print, or the
+                # watchdog would os._exit silently and reintroduce the
+                # bare-null outcome this protocol exists to prevent
                 line = json.dumps({
                     "metric": RESULT.get("metric"), "value": None,
                     "unit": "frames/s/chip", "vs_baseline": None,
-                    "error": error or "result snapshot raced"})
+                    "error": error or "result snapshot unserializable"})
             _write_partial()
         finally:
             if locked:
@@ -300,11 +314,18 @@ def _emit_final(error: str | None = None, code: int = 0,
 # process, so an env-var CPU request (the test harness) needs the
 # jax.config override or the probe dials the tunnel anyway.
 _PROBE_SRC = (
-    "import os\n"
+    "import os, sys\n"
+    # test hook (tests/test_bench_contract.py): BENCH_PROBE_GATE names a
+    # file; until it exists the probe reports a dead tunnel — the only
+    # way to rehearse outage→recovery inside one run without real
+    # weather.  Unset in production.
+    "gate = os.environ.get('BENCH_PROBE_GATE')\n"
+    "if gate and not os.path.exists(gate):\n"
+    "    sys.exit(3)\n"
     "if 'cpu' in os.environ.get('JAX_PLATFORMS', ''):\n"
     "    import jax\n"
     "    jax.config.update('jax_platforms', 'cpu')\n"
-    "import jax, sys\n"
+    "import jax\n"
     "sys.stdout.write(str(len(jax.devices())))\n")
 
 
@@ -320,7 +341,19 @@ def _wait_for_accelerator() -> int:
     short sleep until BENCH_INIT_BUDGET (default 1500 s) is spent.  Only
     after a probe SUCCEEDS does the main process import jax, so the real
     init never starts against a known-dead tunnel.  Every attempt lands
-    in RESULT["init_log"]; exhaustion emits the accumulated artifact."""
+    in RESULT["init_log"]; exhaustion emits the accumulated artifact.
+
+    WATCH MODE (VERDICT r4 #2 — the persistent recovery recorder):
+    ``--watch`` / ``BENCH_WATCH=1`` keeps probing past the init budget
+    at low cadence (``BENCH_WATCH_SLEEP``, default 600 s) for
+    ``BENCH_WATCH_HORIZON`` more seconds (default 21600 = 6 h), every
+    probe appended to the incremental artifact.  If the tunnel recovers
+    anywhere inside the horizon the accelerator legs run and COMPLETE
+    the record in place — no human in the loop; a full-outage run
+    leaves an artifact whose init_log spans the whole horizon.  (The
+    round-4 failure mode this closes: the tunnel recovering one minute
+    after bench.py exits, with the builder's ad-hoc watcher leaving no
+    artifact — PERF.md §7e.)"""
     import signal
     import subprocess
     import tempfile
@@ -328,6 +361,9 @@ def _wait_for_accelerator() -> int:
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "180"))
     budget = float(os.environ.get("BENCH_INIT_BUDGET", "1500"))
     sleep_s = float(os.environ.get("BENCH_PROBE_SLEEP", "45"))
+    watch_sleep = float(os.environ.get("BENCH_WATCH_SLEEP", "600"))
+    horizon = (float(os.environ.get("BENCH_WATCH_HORIZON", "21600"))
+               if WATCH else 0.0)
     t0 = time.monotonic()
     log: list = []
     RESULT["init_log"] = log
@@ -352,15 +388,18 @@ def _wait_for_accelerator() -> int:
             except subprocess.TimeoutExpired:
                 rc = None
                 outcome = f"hung, killed at {probe_timeout:.0f}s"
-            if outcome is not None or rc != 0:
-                try:
-                    os.killpg(p.pid, signal.SIGKILL)
-                except (ProcessLookupError, PermissionError):
-                    pass
-                try:
-                    p.wait(timeout=10)
-                except subprocess.TimeoutExpired:   # pragma: no cover
-                    pass
+            # kill the probe's whole session UNCONDITIONALLY — even an
+            # rc==0 probe can leave tunnel-helper grandchildren behind
+            # (they inherit the session), and a survivor would hold the
+            # single-owner device against the real init that follows
+            try:
+                os.killpg(p.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:   # pragma: no cover
+                pass
             out_f.seek(0)
             err_f.seek(0)
             stdout = out_f.read()
@@ -381,15 +420,26 @@ def _wait_for_accelerator() -> int:
                     "t_s": round(time.monotonic() - t0, 1),
                     "outcome": outcome})
         elapsed = time.monotonic() - t0
+        in_watch = elapsed + sleep_s + probe_timeout > budget
         _note(f"[bench] probe {attempt}: {outcome} "
-              f"({elapsed:.0f}s/{budget:.0f}s)")
-        _leg_done(f"waiting for accelerator (probe {attempt})")
-        if elapsed + sleep_s + probe_timeout > budget:
-            _emit_final(
-                error=f"accelerator unreachable: {attempt} probes over "
-                      f"{elapsed:.0f}s (tunnel down); host-side legs "
-                      "recorded", code=1)
-        time.sleep(sleep_s)
+              f"({elapsed:.0f}s/{budget:.0f}s"
+              + (f", watch horizon {horizon:.0f}s" if in_watch and WATCH
+                 else "") + ")")
+        _leg_done(("watching for recovery (probe %d)" % attempt)
+                  if in_watch and WATCH
+                  else f"waiting for accelerator (probe {attempt})")
+        if in_watch:
+            if not WATCH or (elapsed + watch_sleep + probe_timeout
+                             > budget + horizon):
+                _emit_final(
+                    error=f"accelerator unreachable: {attempt} probes "
+                          f"over {elapsed:.0f}s (tunnel down"
+                          + (f"; watch horizon {horizon:.0f}s spent"
+                             if WATCH else "")
+                          + "); host-side legs recorded", code=1)
+            time.sleep(watch_sleep)
+        else:
+            time.sleep(sleep_s)
 
 
 def _import_jax_guarded(timeout_s: float = 420.0):
@@ -423,15 +473,21 @@ def _import_jax_guarded(timeout_s: float = 420.0):
     return jax
 
 
-def _arm_total_watchdog():
+def _arm_total_watchdog(post_recovery: bool = False):
     """Init retries cannot catch a tunnel that dies MID-run: an
     in-flight device_put/execute blocks forever.  A daemon timer prints
     the ACCUMULATED legs (not a bare error) and hard-exits if the whole
     bench exceeds BENCH_TOTAL_TIMEOUT (default 3000 s — covers the
-    1500 s init budget plus a healthy ~10 min measured phase)."""
+    1500 s init budget plus a healthy ~10 min measured phase).  Watch
+    mode pre-inflates the first fuse by its horizon (the watchdog must
+    not amputate the recovery window), and main() RE-ARMS a base-budget
+    fuse the moment recovery happens — so a post-recovery hang is still
+    cut at the normal bound, not horizon-late."""
     import threading
 
     budget = float(os.environ.get("BENCH_TOTAL_TIMEOUT", "3000"))
+    if WATCH and not post_recovery:
+        budget += float(os.environ.get("BENCH_WATCH_HORIZON", "21600"))
 
     def fire():
         _emit_final(
@@ -443,6 +499,63 @@ def _arm_total_watchdog():
     t.daemon = True
     t.start()
     return t
+
+
+# ---- MFU / roofline accounting (VERDICT r4 #3) ----
+#
+# A static cost model of the flagship batch kernel
+# (analysis/rms.py:_aligned_moments_kernel) relates measured frames/s to
+# the chip's published peaks, so the artifact answers "is it actually
+# fast, or just faster than a generous baseline?" on its own.
+#
+# FLOPs per frame (S = selection atoms; every term elementwise or a
+# (S,3)x(3,3)-class contraction — there is no large matmul, so the MXU
+# peak is an upper bound the kernel cannot approach by construction):
+#   dequant int16→f32 (scale+shift)          ~  6·S
+#   weighted COM + center                    ~  9·S
+#   Kabsch covariance einsum (bni,bnj→bij)   ~ 18·S
+#   3×3 SVD + det fix                        ~ constant (≈600)
+#   rotate einsum (bni,bij→bnj) + shift      ~ 21·S
+#   batched Welford moments (sum, (x−μ)²)    ~ 12·S
+#   total                                    ~ 66·S + 600
+#
+# HBM bytes per frame (steady state — staged int16 blocks HBM-resident):
+#   staged int16 read, 2 consumer passes     ~ 12·S   (covariance; rotate)
+#   aligned f32 batch write (einsum output)  ~ 12·S
+#   moments reads of the aligned batch (×2)  ~ 24·S
+#   modeled total                            ~ 48·S
+#   perfect-fusion floor (int16 read twice,
+#   everything else fused to registers)      ~ 12·S
+#
+# Peaks: TPU v5e (the tunneled "v5 lite" chip) publishes 819 GB/s HBM
+# bandwidth and 197 TFLOP/s bf16; the kernel runs f32 (precision pinned,
+# parallel/executors.py:_f32_precision), so the FLOP fraction below is
+# an optimistic-denominator figure — fine, because the point it makes is
+# that this kernel lives on the BANDWIDTH wall, nowhere near the MXU.
+V5E_HBM_GBPS = 819.0
+V5E_BF16_TFLOPS = 197.0
+
+
+def _roofline(fps: float, n_sel: int) -> dict:
+    """Roofline fields for a measured frames/s point (see model above)."""
+    if not fps or fps != fps:
+        return {}
+    flops = 66.0 * n_sel + 600.0
+    bytes_est = 48.0 * n_sel
+    bytes_min = 12.0 * n_sel
+    gf = fps * flops / 1e9
+    gb = fps * bytes_est / 1e9
+    gb_min = fps * bytes_min / 1e9
+    frac_hbm = gb / V5E_HBM_GBPS
+    frac_flops = gf / (V5E_BF16_TFLOPS * 1e3)
+    wall = ("hbm" if frac_hbm >= frac_flops else "mxu")
+    if max(frac_hbm, frac_flops) < 0.05:
+        wall = "dispatch/overhead"
+    return {"achieved_gflops": round(gf, 1),
+            "achieved_hbm_gbps": round(gb, 1),
+            "achieved_hbm_gbps_fused_floor": round(gb_min, 1),
+            "roofline_frac": round(max(frac_hbm, frac_flops), 4),
+            "roofline_wall": wall}
 
 
 def _measure_decode_fps(u_file, heavy_sel) -> float:
@@ -507,6 +620,11 @@ def main():
         _leg_done("host decode leg", decode_fps=round(decode_fps, 2))
 
     n_chips = _wait_for_accelerator()
+    if WATCH:
+        # the horizon-inflated fuse served its purpose (covering the
+        # outage); from here a hang must be cut at the NORMAL bound
+        watchdog.cancel()
+        watchdog = _arm_total_watchdog(post_recovery=True)
     jax = _import_jax_guarded()
     put_gbps = _measure_put_gbps(jax)
     _note(f"[bench] link weather: put {put_gbps:.2f} GB/s")
@@ -573,7 +691,9 @@ def main():
               cold_vs_baseline=round(cold_fps / baseline_fps, 2),
               **({"cold_vs_file_baseline":
                   round(cold_fps / file_baseline_fps, 2)}
-                 if SOURCE == "file" else {}))
+                 if SOURCE == "file" else {}),
+              **{f"cold_{k}": v
+                 for k, v in _roofline(cold_fps, len(heavy_idx)).items()})
 
     # steady state: HBM-resident staged blocks (shared DeviceBlockCache),
     # median of REPEATS — by construction independent of link weather.
@@ -594,7 +714,8 @@ def main():
         f"{n_chips} chip(s), {tdtype} staging, steady-state: "
         f"staged blocks HBM-resident across runs)")
     _leg_done("steady leg", value=round(fps_per_chip, 2),
-              vs_baseline=round(fps_per_chip / baseline_fps, 2))
+              vs_baseline=round(fps_per_chip / baseline_fps, 2),
+              **_roofline(fps_per_chip, len(heavy_idx)))
 
     # sanity: accelerator backend (same transfer dtype as the timed path)
     # must agree with the serial f64 oracle over the same window.  A

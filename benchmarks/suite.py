@@ -275,7 +275,9 @@ def config7(stack):
 
     uw = make_water_universe(n_waters=1000, n_frames=int(64 * SCALE),
                              seed=14)
-    uw.topology.charges = np.zeros(uw.topology.n_atoms)
+    # the supported post-construction mutation path (bumps attr_version
+    # so charge-keyed selection memos can never go stale)
+    uw.add_TopologyAttr("charges")
     ow = uw.select_atoms("name OW")
     n = uw.trajectory.n_frames
     fps, serial, sf, scv, a = _timed(
@@ -284,7 +286,7 @@ def config7(stack):
     up = make_protein_universe(n_residues=150, n_frames=int(64 * SCALE),
                                noise=0.3, seed=14)
     ng = up.trajectory.n_frames
-    gfps, gserial, gsf, gscv, _ = _timed(
+    gfps, gserial, gsf, gscv, ga = _timed(
         lambda: GNMAnalysis(up, select="name CA"),
         ng, dict(backend="jax", batch_size=16))
 
@@ -295,6 +297,14 @@ def config7(stack):
                                - getattr(s.results, ax).mass_density
                                ).max()) for ax in ("x", "y", "z"))
         assert err < 5e-2, f"config7 LinearDensity divergence {err}"
+        # GNM: compare EIGENVALUES only (f32 batch vs f64 oracle) — the
+        # eigenvector is trustworthy only away from spectral
+        # near-degeneracy (GNMAnalysis docstring precision envelope)
+        gs = GNMAnalysis(up, select="name CA").run(backend="serial",
+                                                   stop=ng)
+        gerr = float(np.abs(np.asarray(ga.results.eigenvalues)
+                            - np.asarray(gs.results.eigenvalues)).max())
+        assert gerr < 1e-2, f"config7 GNM eigenvalue divergence {gerr}"
 
     return {"config": 7,
             "metric": "informational: LinearDensity(1000 OW) + "

@@ -62,6 +62,18 @@ class GNMAnalysis(AnalysisBase):
 
     ``results.eigenvalues`` / ``results.eigenvectors`` / ``results.times``
     — one slowest-internal-mode record per frame, upstream layout.
+
+    Precision envelope: the batch backends eigensolve the Kirchhoff
+    matrix in float32 (vmapped ``eigh``) while the serial oracle runs
+    float64.  λ1 agrees to ~1e-5 relative in practice, but for
+    NEAR-DEGENERATE low modes (weakly connected contact graphs, λ1≈λ2)
+    the float32 eigenVECTOR can rotate inside the near-degenerate
+    subspace and diverge from the oracle beyond ordinary tolerances.
+    Before trusting per-frame eigenvectors from a batch run, check the
+    spectral gap: ``results.eigenvalues`` close to the next mode's
+    value flags frames where only the eigenvalue is comparable across
+    backends.  (Advisor r4; the suite's config-7 check compares
+    eigenvalues, not vectors, for exactly this reason.)
     """
 
     def __init__(self, universe, select: str = "protein and name CA",

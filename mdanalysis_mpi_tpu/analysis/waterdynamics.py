@@ -64,9 +64,31 @@ class SurvivalProbability(AnalysisBase):
         self._tau_max = 20
 
     def run(self, start=None, stop=None, step=None, frames=None,
-            backend: str = "serial", tau_max: int = 20, **kwargs):
+            backend: str = "serial", tau_max: int = 20,
+            intermittency: int | None = None, residues: bool = False,
+            **kwargs):
+        """Upstream passes ``intermittency`` (and ``residues``) to
+        ``run()``, not the constructor — accept both spellings so ported
+        scripts work unchanged.  ``residues=True`` (atom→residue
+        membership coarsening) is not implemented; it fails loudly here
+        rather than silently computing atom-level survival."""
         if tau_max < 0:
             raise ValueError(f"tau_max must be >= 0, got {tau_max}")
+        if residues:
+            raise NotImplementedError(
+                "SurvivalProbability(residues=True) (residue-level "
+                "membership) is not supported; compute atom-level "
+                "survival (residues=False) or coarsen the selection "
+                "to one atom per residue")
+        if intermittency is not None and intermittency < 0:
+            raise ValueError(
+                f"intermittency must be >= 0, got {intermittency}")
+        # a run()-call override is scoped to THIS run — upstream's run
+        # default resets every call, so it must not leak into a later
+        # run() that omits the kwarg
+        self._run_intermittency = (self._intermittency
+                                   if intermittency is None
+                                   else int(intermittency))
         self._tau_max = int(tau_max)
         return super().run(start, stop, step, frames=frames,
                            backend=backend, **kwargs)
@@ -111,7 +133,8 @@ class SurvivalProbability(AnalysisBase):
         # so this cuts the mask and the AND loop by that ratio
         mask = mask[:, mask.any(axis=0)]
         tau_max = min(self._tau_max, t - 1)
-        mask = _apply_intermittency(mask, self._intermittency)
+        mask = _apply_intermittency(
+            mask, getattr(self, "_run_intermittency", self._intermittency))
         n0 = mask.sum(axis=1).astype(np.float64)       # N(t) per start
         sp = []
         surviving = mask.copy()

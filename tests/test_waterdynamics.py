@@ -91,3 +91,32 @@ def test_zero_frames_is_clear_error():
     u = _universe([(IN, IN, IN)] * 3)
     with pytest.raises(ValueError, match="zero frames"):
         SurvivalProbability(u, "name OW").run(stop=0, backend="serial")
+
+
+def test_sp_intermittency_as_run_kwarg():
+    """Upstream passes intermittency to run(); both spellings agree."""
+    u = _universe([(IN, OUT, OUT), (OUT, OUT, OUT),
+                   (IN, OUT, OUT), (IN, OUT, OUT)])
+    a = SurvivalProbability(u, "name OW and around 3.0 name CA").run(
+        tau_max=3, intermittency=1, backend="serial")
+    b = SurvivalProbability(u, "name OW and around 3.0 name CA",
+                            intermittency=1).run(tau_max=3,
+                                                 backend="serial")
+    np.testing.assert_allclose(a.results.sp_timeseries,
+                               b.results.sp_timeseries)
+    np.testing.assert_allclose(a.results.sp_timeseries[3], 1.0)
+    # the run() override is scoped to that run: a later run() with the
+    # kwarg omitted falls back to the CONSTRUCTOR value (here 0), as
+    # upstream's per-call default does
+    c = SurvivalProbability(u, "name OW and around 3.0 name CA")
+    c.run(tau_max=3, intermittency=1, backend="serial")
+    c.run(tau_max=3, backend="serial")
+    np.testing.assert_allclose(c.results.sp_timeseries[3], 0.0)
+
+
+def test_sp_residues_kwarg_loud():
+    u = _universe([(IN, OUT, OUT)])
+    with pytest.raises(NotImplementedError, match="residues"):
+        SurvivalProbability(u, "name OW").run(tau_max=2, residues=True)
+    with pytest.raises(ValueError, match="intermittency"):
+        SurvivalProbability(u, "name OW").run(tau_max=2, intermittency=-1)
