@@ -20,9 +20,42 @@ QUICK="${2:-}"
 NN="$(printf %02d "$N")"
 cd "$(dirname "$0")/.."
 
+write_suite_json() {     # $1=round $2=host_only(0|1) — rows from /tmp
+    python - "$1" "$2" <<'EOF'
+import json, sys
+n, host_only = sys.argv[1], sys.argv[2] == "1"
+rows = [json.loads(l) for l in open("/tmp/suite_rows.jsonl")
+        if l.strip().startswith("{")]
+json.dump({"round": int(n),
+           "hardware": "1x TPU v5 lite (tunneled), 1 host core",
+           "host_only": host_only,
+           "note": ("value = accelerator frames/s (median, readback-free "
+                    "timing); serial_fps measured first on an adaptive "
+                    "window (serial_frames) with the serial_cv <= 0.1 "
+                    "stability criterion recorded per row"
+                    + ("; HOST-ONLY record: accelerator unreachable, "
+                       "device values null with the probe error inline"
+                       if host_only else "")),
+           "rows": rows},
+          open(f"SUITE_r{n.zfill(2)}.json", "w"), indent=1)
+EOF
+}
+
 echo "[record] probing accelerator (150 s cap)..." >&2
 if ! timeout 150 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
-    echo "[record] tunnel down; aborting with nothing written" >&2
+    # the suite records UNCONDITIONALLY (VERDICT r4 #4): serial rows +
+    # serial_cv populated, device rows null, probe error inline
+    echo "[record] tunnel down; recording HOST-ONLY suite" >&2
+    if JAX_PLATFORMS=cpu BENCH_SUITE_HOST_ONLY=1 \
+        BENCH_SUITE_PROBE_ERROR="accelerator probe failed (150 s timeout; tunnel down)" \
+        python benchmarks/suite.py > /tmp/suite_rows.jsonl \
+        2>/tmp/suite_err.txt; then
+        write_suite_json "$N" 1
+        echo "[record] SUITE_r${NN}.json written (host-only)" >&2
+    else
+        echo "[record] host-only suite FAILED:" >&2
+        tail -5 /tmp/suite_err.txt >&2
+    fi
     exit 3
 fi
 
@@ -47,20 +80,7 @@ if ! python benchmarks/suite.py > "/tmp/suite_rows.jsonl" \
     tail -5 /tmp/suite_err.txt >&2
     exit 1
 fi
-python - "$N" <<'EOF'
-import json, sys
-n = sys.argv[1]
-rows = [json.loads(l) for l in open("/tmp/suite_rows.jsonl")
-        if l.strip().startswith("{")]
-json.dump({"round": int(n),
-           "hardware": "1x TPU v5 lite (tunneled), 1 host core",
-           "note": ("value = accelerator frames/s (median, readback-free "
-                    "timing); serial_fps measured first on an adaptive "
-                    "window (serial_frames) with the serial_cv <= 0.1 "
-                    "stability criterion recorded per row"),
-           "rows": rows},
-          open(f"SUITE_r{n.zfill(2)}.json", "w"), indent=1)
-EOF
+write_suite_json "$N" 0
 
 echo "[record] on-chip smoke..." >&2
 MDTPU_TPU_TESTS=1 python -m pytest tests/ -m tpu -q > /tmp/tpusmoke.txt 2>&1

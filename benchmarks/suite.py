@@ -49,6 +49,22 @@ from mdanalysis_mpi_tpu.testing import (                         # noqa: E402
 SCALE = float(os.environ.get("BENCH_SUITE_SCALE", "1.0"))
 REPEATS = int(os.environ.get("BENCH_REPEATS", "3"))
 TOL = 1e-3
+#: HOST-ONLY mode (VERDICT r4 #4): with the accelerator unreachable the
+#: suite must still record — serial rows + serial_cv populated, device
+#: values null with the probe error inline.  No jax import, no device
+#: contact, no oracle checks (they would compare against nothing).
+HOST_ONLY = os.environ.get("BENCH_SUITE_HOST_ONLY", "0") == "1"
+PROBE_ERR = os.environ.get("BENCH_SUITE_PROBE_ERROR",
+                           "accelerator unreachable (host-only suite)")
+
+
+def _r(x, nd: int = 2):
+    """round() that passes None through (host-only device fields)."""
+    return None if x is None else round(x, nd)
+
+
+def _vs(fps, serial):
+    return None if fps is None else round(fps / serial, 2)
 
 
 def _serial_fps(make_analysis, n_frames) -> tuple[float, int, float]:
@@ -94,9 +110,11 @@ def _timed(make_analysis, n_frames, run_kwargs):
     the raw device partials — never on materialized results, which would
     fetch (see module docstring).  Returns (fps, serial_fps,
     serial_frames, serial_cv, last_analysis)."""
+    serial, serial_frames, serial_cv = _serial_fps(make_analysis, n_frames)
+    if HOST_ONLY:
+        return None, serial, serial_frames, serial_cv, None
     import jax
 
-    serial, serial_frames, serial_cv = _serial_fps(make_analysis, n_frames)
     make_analysis().run(**run_kwargs)              # compile warm-up
     # capture right after the first device run: a tunnel collapse later
     # in the repeats must not erase the fact that device runs happened
@@ -130,10 +148,10 @@ def config1(stack):
         assert err < TOL, f"config1 divergence {err}"
 
     return {"config": 1, "metric": "Ca RMSF, 3341-atom ADK-size, DCD",
-            "value": round(fps, 2), "unit": "frames/s", "backend": "jax",
+            "value": _r(fps), "unit": "frames/s", "backend": "jax",
             "serial_fps": round(serial, 2), "serial_frames": sf,
             "serial_cv": scv,
-            "vs_serial": round(fps / serial, 2)}, check
+            "vs_serial": _vs(fps, serial)}, check
 
 
 def config2(stack):
@@ -180,10 +198,10 @@ def config3(stack):
         assert err < TOL, f"config3 divergence {err}"
 
     return {"config": 3, "metric": "superposed RMSD series, 2000 atoms",
-            "value": round(fps, 2), "unit": "frames/s", "backend": "jax",
+            "value": _r(fps), "unit": "frames/s", "backend": "jax",
             "serial_fps": round(serial, 2), "serial_frames": sf,
             "serial_cv": scv,
-            "vs_serial": round(fps / serial, 2)}, check
+            "vs_serial": _vs(fps, serial)}, check
 
 
 def config4(stack):
@@ -201,10 +219,10 @@ def config4(stack):
         assert err < 0.05, f"config4 divergence {err}"
 
     return {"config": 4, "metric": "O-O RDF, 2000-water box",
-            "value": round(fps, 2), "unit": "frames/s", "backend": "jax",
+            "value": _r(fps), "unit": "frames/s", "backend": "jax",
             "serial_fps": round(serial, 2), "serial_frames": sf,
             "serial_cv": scv,
-            "vs_serial": round(fps / serial, 2)}, check
+            "vs_serial": _vs(fps, serial)}, check
 
 
 def config5(stack):
@@ -223,10 +241,10 @@ def config5(stack):
         assert err < TOL, f"config5 divergence {err}"
 
     return {"config": 5, "metric": "Ca contact map, 500 residues",
-            "value": round(fps, 2), "unit": "frames/s", "backend": "jax",
+            "value": _r(fps), "unit": "frames/s", "backend": "jax",
             "serial_fps": round(serial, 2), "serial_frames": sf,
             "serial_cv": scv,
-            "vs_serial": round(fps / serial, 2)}, check
+            "vs_serial": _vs(fps, serial)}, check
 
 
 def config6(stack):
@@ -257,11 +275,11 @@ def config6(stack):
 
     return {"config": 6,
             "metric": "informational: PCA(200res Ca) + MSD(500 OW)",
-            "value": round(fps, 2), "unit": "frames/s", "backend": "jax",
+            "value": _r(fps), "unit": "frames/s", "backend": "jax",
             "serial_fps": round(serial, 2), "serial_frames": sf,
             "serial_cv": scv,
-            "vs_serial": round(fps / serial, 2),
-            "msd_fps": round(mfps, 2),
+            "vs_serial": _vs(fps, serial),
+            "msd_fps": _r(mfps),
             "msd_serial_fps": round(mserial, 2),
             "msd_serial_frames": msf, "msd_serial_cv": mscv}, check
 
@@ -309,11 +327,11 @@ def config7(stack):
     return {"config": 7,
             "metric": "informational: LinearDensity(1000 OW) + "
                       "GNM(150res Ca)",
-            "value": round(fps, 2), "unit": "frames/s", "backend": "jax",
+            "value": _r(fps), "unit": "frames/s", "backend": "jax",
             "serial_fps": round(serial, 2), "serial_frames": sf,
             "serial_cv": scv,
-            "vs_serial": round(fps / serial, 2),
-            "gnm_fps": round(gfps, 2),
+            "vs_serial": _vs(fps, serial),
+            "gnm_fps": _r(gfps),
             "gnm_serial_fps": round(gserial, 2),
             "gnm_serial_frames": gsf, "gnm_serial_cv": gscv}, check
 
@@ -349,7 +367,12 @@ def main():
                 rec["suite_platform"] = platform
             else:
                 rec["platform"] = platform
-            if check is not None:
+                if HOST_ONLY and "error" not in rec:
+                    # device fields are null BECAUSE of this, inline
+                    # (VERDICT r4 #4: probe error in the row, not a
+                    # missing artifact)
+                    rec["error"] = PROBE_ERR
+            if check is not None and not HOST_ONLY:
                 try:
                     check()
                 except Exception as e:

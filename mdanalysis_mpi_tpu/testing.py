@@ -28,6 +28,16 @@ def random_rotation_matrices(n: int, rng: np.random.Generator) -> np.ndarray:
     return q
 
 
+def _random_coil_base(rng, n_residues: int, n: int) -> np.ndarray:
+    """Compact random-coil base structure: random walk of residue
+    centers + local geometry noise, mean-centered.  Shared by the
+    protein-like fixtures so their coil statistics cannot diverge."""
+    centers = np.cumsum(rng.normal(scale=1.5, size=(n_residues, 3)), axis=0)
+    base = (np.repeat(centers, n // n_residues, axis=0)
+            + rng.normal(scale=0.8, size=(n, 3)))
+    return base - base.mean(axis=0)
+
+
 def make_protein_universe(
     n_residues: int = 50,
     n_frames: int = 24,
@@ -47,11 +57,7 @@ def make_protein_universe(
     rng = np.random.default_rng(seed)
     top = make_protein_topology(n_residues)
     n = top.n_atoms
-    # compact random coil: random walk of residue centers + local geometry
-    centers = np.cumsum(rng.normal(scale=1.5, size=(n_residues, 3)), axis=0)
-    base = (np.repeat(centers, n // n_residues, axis=0)
-            + rng.normal(scale=0.8, size=(n, 3)))
-    base -= base.mean(axis=0)
+    base = _random_coil_base(rng, n_residues, n)
     frames = np.empty((n_frames, n, 3), dtype=np.float32)
     rots = (random_rotation_matrices(n_frames, rng) if rigid_motion
             else np.broadcast_to(np.eye(3), (n_frames, 3, 3)))
@@ -60,6 +66,36 @@ def make_protein_universe(
     for f in range(n_frames):
         frames[f] = (base @ rots[f].T + trans[f]
                      + rng.normal(scale=noise, size=(n, 3)))
+    dims = None
+    if box is not None:
+        dims = np.array([box, box, box, 90.0, 90.0, 90.0], dtype=np.float32)
+    return Universe(top, MemoryReader(frames, dimensions=dims))
+
+
+def make_md_universe(
+    n_residues: int = 50,
+    n_frames: int = 32,
+    step: float = 0.05,
+    seed: int = 0,
+    box: float | None = None,
+) -> Universe:
+    """MD-like CORRELATED trajectory: every atom random-walks from a
+    compact base with per-frame displacement ``step`` Å.
+
+    Consecutive frames differ by ~``step`` — the temporal-correlation
+    regime real MD trajectories live in (saved frames are picoseconds
+    apart; thermal drift between them is a tiny fraction of the
+    coordinate range).  This is the fixture for the delta wire format
+    (``transfer_dtype='delta'``): make_protein_universe's independent
+    per-frame tumbling is deliberately DEcorrelated and blows the
+    residual range up (executors.quantize_block_delta docstring).
+    """
+    rng = np.random.default_rng(seed)
+    top = make_protein_topology(n_residues)
+    n = top.n_atoms
+    base = _random_coil_base(rng, n_residues, n)
+    walk = np.cumsum(rng.normal(scale=step, size=(n_frames, n, 3)), axis=0)
+    frames = (base[None] + walk).astype(np.float32)
     dims = None
     if box is not None:
         dims = np.array([box, box, box, 90.0, 90.0, 90.0], dtype=np.float32)

@@ -257,3 +257,39 @@ def test_roofline_model_fields():
     # degenerate inputs vanish rather than emit NaNs
     assert bench._roofline(float("nan"), 50_000) == {}
     assert bench._roofline(0.0, 50_000) == {}
+
+
+@pytest.mark.slow
+def test_suite_host_only_records_serial_rows(tmp_path):
+    """BENCH_SUITE_HOST_ONLY=1: the suite must emit every requested row
+    with serial_fps/serial_cv populated, device value null, and the
+    probe error inline — no jax import, no device contact (VERDICT r4
+    #4: the suite records unconditionally every round)."""
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        BENCH_SUITE_HOST_ONLY="1",
+        BENCH_SUITE_PROBE_ERROR="probe failed (test)",
+        BENCH_SUITE_SCALE="0.125",
+        BENCH_SUITE_CONFIGS="1,2,7",
+        BENCH_PARTIAL_PATH=str(tmp_path / "nonexistent.json"),
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks", "suite.py")],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    rows = [json.loads(ln) for ln in proc.stdout.splitlines()
+            if ln.startswith("{")]
+    by_cfg = {r.get("config"): r for r in rows}
+    assert set(by_cfg) == {1, 2, 7}
+    for cfg in (1, 7):
+        row = by_cfg[cfg]
+        assert row["value"] is None
+        assert row["error"] == "probe failed (test)"
+        assert row["serial_fps"] > 0 and row["serial_frames"] > 0
+        assert row["vs_serial"] is None
+        assert "check_error" not in row     # oracle checks skipped
+        assert row["platform"].startswith("none")
+    # config7 carries BOTH families' serial legs (GNM too)
+    assert by_cfg[7]["gnm_serial_fps"] > 0
+    assert by_cfg[7]["gnm_fps"] is None
