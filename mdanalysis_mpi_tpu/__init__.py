@@ -30,12 +30,12 @@ From-scratch re-design of the capability envelope of the reference
 """
 
 from mdanalysis_mpi_tpu.core.universe import Universe
-from mdanalysis_mpi_tpu.core.groups import AtomGroup
+from mdanalysis_mpi_tpu.core.groups import AtomGroup, UpdatingAtomGroup
 from mdanalysis_mpi_tpu.core.topology import Topology
 
 __version__ = "0.1.0"
 
-__all__ = ["Universe", "AtomGroup", "Topology", "analysis", "__version__"]
+__all__ = ["Universe", "AtomGroup", "UpdatingAtomGroup", "Topology", "analysis", "__version__"]
 
 
 def __getattr__(name):

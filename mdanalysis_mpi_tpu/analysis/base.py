@@ -144,8 +144,33 @@ class Results(dict):
         return self
 
 
+def reject_updating_groups(*groups, owner: str) -> None:
+    """Loud static-snapshot contract for analyses that read
+    ``ag.indices`` at CONSTRUCTION time (and may not retain the group):
+    the run()-time scan cannot see a group that was dropped after
+    snapshotting, so such constructors must call this first."""
+    from mdanalysis_mpi_tpu.core.groups import UpdatingAtomGroup
+
+    for g in groups:
+        if isinstance(g, UpdatingAtomGroup):
+            raise TypeError(
+                f"{owner} snapshots its groups into static index arrays "
+                "at construction and cannot track an UpdatingAtomGroup's "
+                "per-frame membership; pass a static group, or use a "
+                "per-frame selection string (SurvivalProbability) / "
+                "AnalysisFromFunction for dynamic-membership analyses")
+
+
 class AnalysisBase:
     """Template for trajectory analyses with pluggable backends."""
+
+    #: analyses snapshot their selection into a static index array in
+    #: _prepare (the gather map TPU kernels compile against), so a
+    #: per-frame-re-evaluating UpdatingAtomGroup would silently freeze
+    #: at frame-0 membership; run() refuses it loudly unless the
+    #: subclass genuinely re-reads the group each frame and says so
+    #: (AnalysisFromFunction).
+    _accepts_updating_groups = False
 
     _device_combine = None    # subclasses may override with a psum merge
     # module-level (total, partials) -> total merge executed on device once
@@ -198,6 +223,34 @@ class AnalysisBase:
 
     # ---- driver ----
 
+    def _refuse_updating_groups(self):
+        """The documented static-snapshot contract, enforced loudly:
+        this analysis compiles its selection into a static index array
+        once (``_prepare``), so a per-frame UpdatingAtomGroup would
+        silently freeze at its current membership — on the serial
+        oracle AND the batch backends alike.  Dynamic selections go
+        through per-frame selection strings
+        (:class:`~mdanalysis_mpi_tpu.analysis.SurvivalProbability`) or
+        :class:`AnalysisFromFunction` (its function reads the group
+        each frame, so it sees every re-evaluation)."""
+        from mdanalysis_mpi_tpu.core.groups import UpdatingAtomGroup
+
+        def scan(value):
+            if isinstance(value, UpdatingAtomGroup):
+                raise TypeError(
+                    f"{type(self).__name__} snapshots its selection into "
+                    "a static index array at _prepare time and cannot "
+                    "track an UpdatingAtomGroup's per-frame membership; "
+                    "pass a static group, or use a per-frame selection "
+                    "string (SurvivalProbability) / AnalysisFromFunction "
+                    "for dynamic-membership analyses")
+            if isinstance(value, (tuple, list)):
+                for v in value:
+                    scan(v)
+
+        for v in vars(self).values():
+            scan(v)
+
     def _frames(self, start, stop, step, frames=None):
         n = self._universe.trajectory.n_frames
         if frames is not None:
@@ -241,6 +294,8 @@ class AnalysisBase:
         from mdanalysis_mpi_tpu.utils.timers import TIMERS
 
         t0 = time.perf_counter()
+        if not self._accepts_updating_groups:
+            self._refuse_updating_groups()
         frames = list(self._frames(start, stop, step, frames))
         self.n_frames = len(frames)
         # the resolved frame list, readable from _prepare/_conclude
@@ -285,6 +340,11 @@ class AnalysisFromFunction(AnalysisBase):
     a batch kernel (see README "Writing your own analysis") when the
     math should run on the accelerator.
     """
+
+    # the per-frame function reads its AtomGroup arguments at call time,
+    # so an UpdatingAtomGroup's re-evaluation is seen every frame — the
+    # supported dynamic-membership route (with SurvivalProbability)
+    _accepts_updating_groups = True
 
     def __init__(self, function, *args, verbose: bool = False, **kwargs):
         from mdanalysis_mpi_tpu.core.groups import AtomGroup

@@ -90,6 +90,12 @@ class Contacts(AnalysisBase):
         super().__init__(universe, verbose)
         s1, s2 = select
         ref1, ref2 = refgroup
+        # the refgroups' reference distances and the selections' pair
+        # indices are snapshotted below and the groups dropped — the
+        # run()-time updating-group scan cannot catch them here
+        from mdanalysis_mpi_tpu.analysis.base import reject_updating_groups
+
+        reject_updating_groups(ref1, ref2, owner="Contacts")
         ag1 = universe.select_atoms(s1)
         ag2 = universe.select_atoms(s2)
         if ag1.n_atoms != ref1.n_atoms or ag2.n_atoms != ref2.n_atoms:

@@ -36,9 +36,14 @@ class Dihedral(AnalysisBase):
     one dihedral: exactly 4 atoms, in order."""
 
     def __init__(self, atomgroups, verbose: bool = False):
+        from mdanalysis_mpi_tpu.analysis.base import reject_updating_groups
+
         atomgroups = list(atomgroups)
         if not atomgroups:
             raise ValueError("need at least one 4-atom AtomGroup")
+        # the groups are snapshotted below and not retained — the
+        # run()-time updating-group scan cannot catch them here
+        reject_updating_groups(*atomgroups, owner="Dihedral")
         u = atomgroups[0].universe
         for i, ag in enumerate(atomgroups):
             if ag.n_atoms != 4:

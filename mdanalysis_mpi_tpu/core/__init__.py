@@ -6,7 +6,7 @@ MDAnalysis at RMSF.py:27,56-57,77-78,116,120,126.
 
 from mdanalysis_mpi_tpu.core.topology import Topology
 from mdanalysis_mpi_tpu.core.universe import Universe
-from mdanalysis_mpi_tpu.core.groups import AtomGroup
+from mdanalysis_mpi_tpu.core.groups import AtomGroup, UpdatingAtomGroup
 from mdanalysis_mpi_tpu.core.selection import select
 
-__all__ = ["Topology", "Universe", "AtomGroup", "select"]
+__all__ = ["Topology", "Universe", "AtomGroup", "UpdatingAtomGroup", "select"]

@@ -279,8 +279,14 @@ class AtomGroup:
 
     # ---- refinement & set algebra ----
 
-    def select_atoms(self, selection: str) -> "AtomGroup":
+    def select_atoms(self, selection: str,
+                     updating: bool = False) -> "AtomGroup":
         """Select within this group (indices stay sorted/unique).
+
+        ``updating=True`` returns an :class:`UpdatingAtomGroup` that
+        RE-EVALUATES the selection whenever the universe's current
+        frame changes (upstream semantics — the general form of the
+        reference's in-loop ``select_atoms``, RMSF.py:126).
 
         The whole string is evaluated against the group (upstream
         semantics): geometric keywords' inner selections see only group
@@ -298,6 +304,21 @@ class AtomGroup:
         """
         from mdanalysis_mpi_tpu.core.selection import select_mask_info
 
+        if updating:
+            n_all = self._universe.topology.n_atoms
+            # exact whole-universe test (length alone would misread a
+            # duplicate-bearing group of coincidental length n_all and
+            # leak atoms outside the base scope); an updating BASE is
+            # kept as the group itself so nested updating selections
+            # track it per frame instead of freezing its creation-frame
+            # membership
+            if isinstance(self, UpdatingAtomGroup):
+                base = self
+            elif np.array_equal(self._indices, np.arange(n_all)):
+                base = None
+            else:
+                base = self
+            return UpdatingAtomGroup(self._universe, selection, base=base)
         top = self._universe.topology
         n = top.n_atoms
         whole = len(self._indices) == n
@@ -460,6 +481,76 @@ class AtomGroup:
     def _check(self, other):
         if other._universe is not self._universe:
             raise ValueError("AtomGroups belong to different Universes")
+
+
+class UpdatingAtomGroup(AtomGroup):
+    """A dynamic AtomGroup: membership re-evaluates per frame.
+
+    Upstream's ``select_atoms(..., updating=True)``: the group holds a
+    selection STRING, not a static index array, and re-runs it against
+    the universe's CURRENT frame whenever the frame has changed since
+    the last evaluation — the general form of the reference's in-loop
+    ``select_atoms`` (RMSF.py:126; static there only because that
+    selection is topology-only).  Geometric keywords (``around``,
+    ``sphzone``, ``point``…) therefore track the trajectory:
+
+        shell = u.select_atoms("name OW and around 3.5 protein",
+                               updating=True)
+        for ts in u.trajectory:       # len(shell) changes per frame
+            ...
+
+    Every inherited accessor (``indices``, ``positions``, ``n_atoms``,
+    set algebra, ``center_of_mass``…) reads through the freshness
+    check.  Re-evaluation keys on the current ``Timestep.frame``;
+    in-place position edits *within* a frame do not trigger one
+    (matching the upstream contract of evaluating once per frame).
+
+    Batch/serial ANALYSES snapshot their selection once in
+    ``_prepare`` (static gather maps are what TPU kernels compile
+    against), so handing an updating group to an analysis raises
+    loudly instead of silently freezing frame-0 membership
+    (``analysis/base.py``); the supported dynamic-selection routes are
+    per-frame selection strings (``SurvivalProbability``) and
+    ``AnalysisFromFunction``, whose user function reads the group per
+    frame and so sees every re-evaluation.
+    """
+
+    def __init__(self, universe, selection: str, base=None):
+        # deliberately NOT calling AtomGroup.__init__: _indices is a
+        # property here (assignment would clash), and validation happens
+        # by evaluating the selection once below.  ``base`` may be an
+        # AtomGroup (scope; an UpdatingAtomGroup base re-evaluates per
+        # frame — nested updating selections track it) or None (whole
+        # universe).
+        self._universe = universe
+        self._selection = selection
+        self._base = base
+        self._last_frame = None
+        self._cached = None
+        self._indices                    # validate selection eagerly
+
+    @property
+    def _indices(self) -> np.ndarray:
+        ts = self._universe.trajectory.ts
+        frame = getattr(ts, "frame", None)
+        if self._cached is None or frame != self._last_frame:
+            if self._base is None:
+                base = self._universe.atoms
+            else:
+                # materialize the base's CURRENT membership as a static
+                # group (an updating base re-evaluates right here)
+                base = AtomGroup(self._universe, self._base.indices)
+            self._cached = base.select_atoms(self._selection).indices
+            self._last_frame = frame
+        return self._cached
+
+    @property
+    def selection(self) -> str:
+        return self._selection
+
+    def __repr__(self):
+        return (f"<UpdatingAtomGroup {self._selection!r}, currently "
+                f"{self.n_atoms} atoms>")
 
 
 class ResidueGroup:

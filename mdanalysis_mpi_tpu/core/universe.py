@@ -89,15 +89,18 @@ class Universe:
 
         return SegmentGroup(self, self.topology.segids)
 
-    def select_atoms(self, selection: str) -> AtomGroup:
+    def select_atoms(self, selection: str,
+                     updating: bool = False) -> AtomGroup:
         """Selection string → AtomGroup (RMSF.py:77 semantics).
 
         Parsed once per call; analyses cache the resulting index array in
         ``_prepare`` instead of re-selecting per frame (fixes quirk Q3).
         Geometric keywords (``around``) see the current frame — fetched
         lazily, so topology-only selections never decode one.
+        ``updating=True`` returns an :class:`UpdatingAtomGroup` whose
+        membership re-evaluates whenever the current frame changes.
         """
-        return self.atoms.select_atoms(selection)
+        return self.atoms.select_atoms(selection, updating=updating)
 
     #: attributes settable via add_TopologyAttr → Topology field.  Per-
     #: atom float arrays only; structural attributes (names, resids,
