@@ -34,7 +34,10 @@ from mdanalysis_mpi_tpu.analysis.diffusionmap import (DistanceMatrix,
 from mdanalysis_mpi_tpu.analysis.vacf import VelocityAutocorr
 from mdanalysis_mpi_tpu.analysis.lineardensity import LinearDensity
 from mdanalysis_mpi_tpu.analysis.gnm import GNMAnalysis
-from mdanalysis_mpi_tpu.analysis.waterdynamics import SurvivalProbability
+from mdanalysis_mpi_tpu.analysis.waterdynamics import (
+    AngularDistribution, SurvivalProbability,
+    WaterOrientationalRelaxation,
+)
 from mdanalysis_mpi_tpu.analysis.dielectric import DielectricConstant
 
 __all__ = ["AnalysisBase", "Results", "AnalysisFromFunction",
@@ -45,4 +48,5 @@ __all__ = ["AnalysisBase", "Results", "AnalysisFromFunction",
            "Dihedral", "Ramachandran", "Contacts", "DensityAnalysis",
            "HydrogenBondAnalysis", "DistanceMatrix", "DiffusionMap",
            "VelocityAutocorr", "LinearDensity", "GNMAnalysis",
-           "SurvivalProbability", "DielectricConstant"]
+           "SurvivalProbability", "DielectricConstant",
+           "WaterOrientationalRelaxation", "AngularDistribution"]

@@ -138,6 +138,10 @@ class HydrogenBondAnalysis(AnalysisBase):
         return donors
 
     def _prepare(self):
+        # a re-run must not leave an earlier run's bond table behind:
+        # lifetime() reads results["hbonds"] against THIS run's frame
+        # window, and a stale table would mix frame grids silently
+        self.results.pop("hbonds", None)
         u = self._universe
         t = u.topology
         guess = self._hydrogens_sel is None
@@ -254,3 +258,63 @@ class HydrogenBondAnalysis(AnalysisBase):
         if self._serial_records or self._serial_counts:
             self.results.hbonds = np.array(
                 self._serial_records, dtype=np.float64).reshape(-1, 6)
+
+    def lifetime(self, tau_max: int = 20, intermittency: int = 0):
+        """Hydrogen-bond lifetime autocorrelation (upstream
+        ``HydrogenBondAnalysis.lifetime``): for each lag τ, the MEAN
+        over time origins t of the per-origin survival ratio
+
+            C(τ) = ⟨ Σ_p b_p(t)·b_p(t+τ)  /  Σ_p b_p(t) ⟩_t
+
+        over (hydrogen, acceptor) pairs ever bonded (origins with zero
+        bonds are skipped) — the same mean-of-ratios normalization as
+        upstream's ``lib.correlations.autocorrelation`` and this
+        package's SurvivalProbability; a ratio-of-sums would weight
+        bond-rich origins more and diverge whenever the count varies.
+        Departures of ≤ ``intermittency`` consecutive frames are filled
+        first (the same preprocessing as SurvivalProbability).  Returns
+        ``(taus, timeseries)`` with τ in analyzed-frame steps.
+
+        Needs the per-bond table — i.e. a completed ``run()`` on the
+        SERIAL backend (the batch kernel reduces to counts on device;
+        the bond LIST is inherently dynamic-shape, module docstring).
+        """
+        if tau_max < 0:
+            raise ValueError(f"tau_max must be >= 0, got {tau_max}")
+        if intermittency < 0:
+            raise ValueError(
+                f"intermittency must be >= 0, got {intermittency}")
+        if "hbonds" not in self.results:
+            raise ValueError(
+                "lifetime() needs the per-bond table: run "
+                ".run(backend='serial') first (batch backends produce "
+                "counts only)")
+        from mdanalysis_mpi_tpu.analysis.waterdynamics import (
+            _apply_intermittency)
+
+        table = self.results["hbonds"]
+        frames = list(self._frame_indices)
+        frame_row = {f: i for i, f in enumerate(frames)}
+        t = len(frames)
+        pairs = {}                       # (hydrogen, acceptor) -> column
+        rows, cols = [], []
+        for rec in table:
+            key = (int(rec[2]), int(rec[3]))
+            col = pairs.setdefault(key, len(pairs))
+            rows.append(frame_row[int(rec[0])])
+            cols.append(col)
+        present = np.zeros((t, len(pairs)), dtype=bool)
+        if rows:
+            present[rows, cols] = True
+        present = _apply_intermittency(present, int(intermittency))
+        tau_max = min(int(tau_max), t - 1 if t else 0)
+        taus = np.arange(tau_max + 1)
+        c = np.empty(tau_max + 1)
+        n0 = present.sum(axis=1).astype(np.float64)    # bonds per origin
+        for tau in taus:
+            joint = (present[:t - tau] & present[tau:]).sum(axis=1)
+            starts = n0[:t - tau]
+            ok = starts > 0
+            c[tau] = (float((joint[ok] / starts[ok]).mean())
+                      if ok.any() else 0.0)
+        return taus, c
