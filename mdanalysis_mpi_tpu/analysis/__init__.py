@@ -39,6 +39,9 @@ from mdanalysis_mpi_tpu.analysis.waterdynamics import (
     WaterOrientationalRelaxation,
 )
 from mdanalysis_mpi_tpu.analysis.dielectric import DielectricConstant
+from mdanalysis_mpi_tpu.analysis.psa import (PSAnalysis, discrete_frechet,
+                                             hausdorff)
+from mdanalysis_mpi_tpu.analysis.polymer import PersistenceLength
 
 __all__ = ["AnalysisBase", "Results", "AnalysisFromFunction",
            "analysis_class", "RMSF", "RMSD", "AlignedRMSF", "rmsd",
@@ -49,4 +52,6 @@ __all__ = ["AnalysisBase", "Results", "AnalysisFromFunction",
            "HydrogenBondAnalysis", "DistanceMatrix", "DiffusionMap",
            "VelocityAutocorr", "LinearDensity", "GNMAnalysis",
            "SurvivalProbability", "DielectricConstant",
-           "WaterOrientationalRelaxation", "AngularDistribution"]
+           "WaterOrientationalRelaxation", "AngularDistribution",
+           "PSAnalysis", "hausdorff", "discrete_frechet",
+           "PersistenceLength"]

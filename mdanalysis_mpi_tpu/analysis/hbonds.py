@@ -262,17 +262,19 @@ class HydrogenBondAnalysis(AnalysisBase):
     def lifetime(self, tau_max: int = 20, intermittency: int = 0):
         """Hydrogen-bond lifetime autocorrelation (upstream
         ``HydrogenBondAnalysis.lifetime``): for each lag τ, the MEAN
-        over time origins t of the per-origin survival ratio
+        over time origins t of the per-origin CONTINUOUS-survival ratio
 
-            C(τ) = ⟨ Σ_p b_p(t)·b_p(t+τ)  /  Σ_p b_p(t) ⟩_t
+            C(τ) = ⟨ |{p : b_p(t′) ∀ t′ ∈ [t, t+τ]}|  /  Σ_p b_p(t) ⟩_t
 
         over (hydrogen, acceptor) pairs ever bonded (origins with zero
-        bonds are skipped) — the same mean-of-ratios normalization as
-        upstream's ``lib.correlations.autocorrelation`` and this
-        package's SurvivalProbability; a ratio-of-sums would weight
-        bond-rich origins more and diverge whenever the count varies.
-        Departures of ≤ ``intermittency`` consecutive frames are filled
-        first (the same preprocessing as SurvivalProbability).  Returns
+        bonds are skipped) — the same semantics as upstream's
+        ``lib.correlations.autocorrelation`` and this package's
+        SurvivalProbability: a bond must hold through EVERY intermediate
+        frame (a break-and-reform does not survive), and per-origin
+        ratios are averaged (ratio-of-sums would overweight bond-rich
+        origins).  Departures of ≤ ``intermittency`` consecutive frames
+        are filled BEFORE the survival product (upstream's
+        intermittent-lifetime preprocessing).  Returns
         ``(taus, timeseries)`` with τ in analyzed-frame steps.
 
         Needs the per-bond table — i.e. a completed ``run()`` on the
@@ -293,28 +295,35 @@ class HydrogenBondAnalysis(AnalysisBase):
             _apply_intermittency)
 
         table = self.results["hbonds"]
-        frames = list(self._frame_indices)
-        frame_row = {f: i for i, f in enumerate(frames)}
+        frames = np.asarray(self._frame_indices, dtype=np.int64)
         t = len(frames)
-        pairs = {}                       # (hydrogen, acceptor) -> column
-        rows, cols = [], []
-        for rec in table:
-            key = (int(rec[2]), int(rec[3]))
-            col = pairs.setdefault(key, len(pairs))
-            rows.append(frame_row[int(rec[0])])
-            cols.append(col)
-        present = np.zeros((t, len(pairs)), dtype=bool)
-        if rows:
+        if len(table):
+            # vectorized (frame, pair) scatter: the serial table can be
+            # millions of rows at benchmark scale, a per-row Python
+            # loop is minutes of host time
+            order = np.argsort(frames, kind="stable")
+            rows = order[np.searchsorted(frames[order],
+                                         table[:, 0].astype(np.int64))]
+            _, cols = np.unique(table[:, 2:4].astype(np.int64), axis=0,
+                                return_inverse=True)
+            present = np.zeros((t, int(cols.max()) + 1), dtype=bool)
             present[rows, cols] = True
+        else:
+            present = np.zeros((t, 0), dtype=bool)
         present = _apply_intermittency(present, int(intermittency))
         tau_max = min(int(tau_max), t - 1 if t else 0)
         taus = np.arange(tau_max + 1)
         c = np.empty(tau_max + 1)
         n0 = present.sum(axis=1).astype(np.float64)    # bonds per origin
+        surviving = present.copy()
         for tau in taus:
-            joint = (present[:t - tau] & present[tau:]).sum(axis=1)
+            if tau:
+                # running AND: survival through EVERY frame of the
+                # window, all origins at once (SurvivalProbability's
+                # recurrence)
+                surviving = surviving[:-1] & present[tau:]
             starts = n0[:t - tau]
             ok = starts > 0
-            c[tau] = (float((joint[ok] / starts[ok]).mean())
-                      if ok.any() else 0.0)
+            c[tau] = (float((surviving.sum(axis=1)[ok]
+                             / starts[ok]).mean()) if ok.any() else 0.0)
         return taus, c

@@ -25,6 +25,8 @@ host, negligible next to the per-frame selection evaluation itself.
 
 from __future__ import annotations
 
+import os as _os
+
 import numpy as np
 
 from mdanalysis_mpi_tpu.analysis.base import AnalysisBase, deferred_group
@@ -246,6 +248,20 @@ class _WaterVectorAnalysis(AnalysisBase):
 
     def _prepare(self):
         o, h1, h2 = _water_triplets(self._universe, self._select)
+        # the whole (T, nW, 3, 3) float32 vector series is materialized
+        # for the lag reduction — bound it EXPLICITLY rather than OOM:
+        # at 33k waters × 10k frames that is ~12 GB.  Window the run
+        # (start/stop/step) or raise MDTPU_WATER_SERIES_BUDGET.
+        est = float(getattr(self, "n_frames", 0)) * len(o) * 36
+        budget = float(_os.environ.get("MDTPU_WATER_SERIES_BUDGET",
+                                       4e9))
+        if est > budget:
+            raise ValueError(
+                f"{type(self).__name__}: the {self.n_frames}-frame × "
+                f"{len(o)}-water vector series needs ~{est / 1e9:.1f} GB "
+                f"(budget {budget / 1e9:.1f} GB); analyze a window "
+                "(run(start=, stop=, step=)) or raise "
+                "MDTPU_WATER_SERIES_BUDGET")
         # stage only the union of involved atoms; slots index into it
         union = np.unique(np.concatenate([o, h1, h2]))
         lookup = {int(g): s for s, g in enumerate(union)}
@@ -291,7 +307,10 @@ class _WaterVectorAnalysis(AnalysisBase):
         vecs, mask = total
 
         def _finalize():
-            v = np.asarray(vecs, np.float64)
+            # float32 keeps the big series at half size; reductions
+            # accumulate in float64 (unit-vector dot products lose
+            # ~1e-7 to f32 storage — inside every stated tolerance)
+            v = np.asarray(vecs, np.float32)
             m = np.asarray(mask) > 0.5
             return self._conclude_vectors(v[m])
 
@@ -335,7 +354,8 @@ class WaterOrientationalRelaxation(_WaterVectorAnalysis):
         out = np.empty((dtmax + 1, 3))
         for tau in range(dtmax + 1):
             dots = (vecs[:t - tau] * vecs[tau:]).sum(-1)  # (T-τ, nW, 3)
-            out[tau] = (1.5 * dots ** 2 - 0.5).mean(axis=(0, 1))
+            out[tau] = (1.5 * dots.astype(np.float64) ** 2
+                        - 0.5).mean(axis=(0, 1))
         return {"tau_timeseries": np.arange(dtmax + 1),
                 "timeseries": out, "OH": out[:, 0], "HH": out[:, 1],
                 "dip": out[:, 2]}

@@ -1,0 +1,121 @@
+"""Path similarity analysis (upstream ``analysis.psa``): Hausdorff and
+discrete Fréchet path metrics, hand-computed fixtures + device/oracle
+parity.  The discrete Fréchet DP is order-sensitive — the classic
+back-and-forth example distinguishes it from Hausdorff."""
+
+import numpy as np
+import pytest
+
+from mdanalysis_mpi_tpu.analysis import (
+    PSAnalysis, discrete_frechet, hausdorff,
+)
+from mdanalysis_mpi_tpu.analysis.psa import _pair_fn
+from mdanalysis_mpi_tpu.testing import make_protein_universe
+
+
+def _path_1d(xs):
+    """1-atom path along x: (T, 1, 3); frame RMSD = |Δx|."""
+    p = np.zeros((len(xs), 1, 3))
+    p[:, 0, 0] = xs
+    return p
+
+
+def test_hausdorff_hand_computed():
+    p = _path_1d([0.0, 1.0, 2.0])
+    q = _path_1d([0.0, 1.0, 2.0, 5.0])
+    # every p-point has a 0-distance match; q's 5.0 is 3.0 from p's 2.0
+    assert hausdorff(p, q) == pytest.approx(3.0)
+    assert hausdorff(p, p) == 0.0
+
+
+def test_frechet_order_sensitivity():
+    """A path that doubles back: Hausdorff ignores ordering (0), the
+    Fréchet leash must stretch."""
+    p = _path_1d([0.0, 1.0, 2.0, 3.0])
+    q = _path_1d([0.0, 1.0, 2.0, 1.0, 2.0, 3.0])   # backtracks 2->1->2
+    assert hausdorff(p, q) == pytest.approx(0.0)
+    f = discrete_frechet(p, q)
+    assert f == pytest.approx(1.0)   # leash stretches during the backtrack
+    # Fréchet >= Hausdorff always
+    assert f >= hausdorff(p, q)
+
+
+def test_frechet_equals_hausdorff_for_monotone_paths():
+    p = _path_1d([0.0, 1.0, 2.0])
+    q = _path_1d([0.5, 1.5, 2.5])
+    assert discrete_frechet(p, q) == pytest.approx(0.5)
+    assert hausdorff(p, q) == pytest.approx(0.5)
+
+
+def test_device_twins_match_oracle():
+    rng = np.random.default_rng(7)
+    p = rng.normal(size=(9, 12, 3))
+    q = rng.normal(size=(13, 12, 3))
+    import jax.numpy as jnp
+
+    pj = jnp.asarray(p, jnp.float32)
+    qj = jnp.asarray(q, jnp.float32)
+    assert float(_pair_fn("hausdorff")(pj, qj)) == pytest.approx(
+        hausdorff(p, q), abs=1e-4)
+    assert float(_pair_fn("discrete_frechet")(pj, qj)) == pytest.approx(
+        discrete_frechet(p, q), abs=1e-4)
+
+
+def test_psanalysis_end_to_end():
+    """Three trajectories of one system: identical paths at distance 0,
+    a perturbed one strictly farther; jax and serial backends agree."""
+    u1 = make_protein_universe(n_residues=10, n_frames=6, noise=0.2,
+                               seed=31)
+    u2 = make_protein_universe(n_residues=10, n_frames=6, noise=0.2,
+                               seed=31)          # identical
+    u3 = make_protein_universe(n_residues=10, n_frames=8, noise=0.5,
+                               seed=32)          # different
+    psa = PSAnalysis([u1, u2, u3], select="name CA")
+    d_jax = psa.run(metric="hausdorff", backend="jax").results.D
+    assert d_jax.shape == (3, 3)
+    assert np.allclose(np.diag(d_jax), 0.0)
+    # identical paths: inside the documented f32 cancellation floor
+    assert d_jax[0, 1] < 0.05
+    assert d_jax[0, 2] > 0.1
+    d_ser = PSAnalysis([u1, u2, u3], select="name CA").run(
+        metric="hausdorff", backend="serial").results.D
+    assert d_ser[0, 1] == pytest.approx(0.0, abs=1e-5)   # f64 oracle
+    np.testing.assert_allclose(d_jax, d_ser, atol=0.05)
+    # Fréchet run on the same paths
+    d_f = PSAnalysis([u1, u2, u3], select="name CA").run(
+        metric="discrete_frechet", backend="serial").results.D
+    assert (d_f >= d_ser - 1e-9).all()
+
+
+def test_psa_alignment_removes_rigid_motion():
+    """align=True: the same internal motion under different rigid-body
+    tumbling collapses to ~zero path distance."""
+    from mdanalysis_mpi_tpu.testing import random_rotation_matrices
+
+    rng = np.random.default_rng(33)
+    p = np.cumsum(rng.normal(scale=0.2, size=(5, 12, 3)), axis=0) \
+        + rng.normal(scale=4.0, size=(1, 12, 3))
+    rots = random_rotation_matrices(5, rng)
+    trans = rng.normal(scale=6.0, size=(5, 1, 3))
+    q = np.einsum("tnj,tij->tni", p, rots) + trans   # rigidly tumbled p
+    d = PSAnalysis([p, q], align=True).run(
+        metric="hausdorff", backend="serial").results.D
+    assert d[0, 1] == pytest.approx(0.0, abs=1e-6)
+    d_raw = PSAnalysis([p, q], align=False).run(
+        metric="hausdorff", backend="serial").results.D
+    assert d_raw[0, 1] > 1.0
+
+
+def test_psa_validation():
+    u = make_protein_universe(n_residues=10, n_frames=4)
+    with pytest.raises(ValueError, match="at least two"):
+        PSAnalysis([u])
+    v = make_protein_universe(n_residues=12, n_frames=4)
+    with pytest.raises(ValueError, match="widths"):
+        PSAnalysis([u, v], select="name CA")
+    with pytest.raises(ValueError, match="metric"):
+        PSAnalysis([u, u]).run(metric="euclidean")
+    with pytest.raises(TypeError, match="path"):
+        PSAnalysis([u, "not-a-path"])
+    with pytest.raises(ValueError, match="\\(T, S, 3\\)"):
+        PSAnalysis([u, np.zeros((4, 3))])

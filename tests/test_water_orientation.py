@@ -143,11 +143,12 @@ def test_hbond_lifetime_hand_computed():
     h = HydrogenBondAnalysis(u).run(backend="serial")
     np.testing.assert_array_equal(h.results.count, [1, 1, 0, 1])
     taus, c = h.lifetime(tau_max=2)
-    # presence b = [1,1,0,1] (one pair):
-    # C(0)=1; C(1)= (b0·b1 + b1·b2 + b2·b3)/(b0+b1+b2) = 1/2
-    # C(2)= (b0·b2 + b1·b3)/(b0+b1) = 1/2
+    # presence b = [1,1,0,1] (one pair), CONTINUOUS survival:
+    # C(0)=1; C(1) = mean(t0: 1/1, t1: 0/1; t2 has no bonds) = 1/2
+    # C(2) = mean(t0: b0&b1&b2 = 0, t1: b1&b2&b3 = 0) = 0 — the gap
+    # kills every window crossing it (break-and-reform ≠ survival)
     np.testing.assert_array_equal(taus, [0, 1, 2])
-    np.testing.assert_allclose(c, [1.0, 0.5, 0.5])
+    np.testing.assert_allclose(c, [1.0, 0.5, 0.0])
     # intermittency=1 fills the single-frame gap: b = [1,1,1,1]
     _, ci = h.lifetime(tau_max=2, intermittency=1)
     np.testing.assert_allclose(ci, [1.0, 1.0, 1.0])
@@ -221,3 +222,10 @@ def test_wor_minimum_image_wrapped_water():
         np.testing.assert_allclose(rw.results.timeseries,
                                    rn.results.timeseries, atol=1e-5)
         np.testing.assert_allclose(rw.results.timeseries, 1.0, atol=1e-5)
+
+
+def test_wor_series_budget_guard(monkeypatch):
+    monkeypatch.setenv("MDTPU_WATER_SERIES_BUDGET", "100")
+    u = make_water_universe(n_waters=10, n_frames=4)
+    with pytest.raises(ValueError, match="SERIES_BUDGET"):
+        WaterOrientationalRelaxation(u, "name OW").run(backend="serial")
