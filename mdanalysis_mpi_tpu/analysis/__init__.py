@@ -42,6 +42,7 @@ from mdanalysis_mpi_tpu.analysis.dielectric import DielectricConstant
 from mdanalysis_mpi_tpu.analysis.psa import (PSAnalysis, discrete_frechet,
                                              hausdorff)
 from mdanalysis_mpi_tpu.analysis.polymer import PersistenceLength
+from mdanalysis_mpi_tpu.analysis.helix import HELANAL, helix_analysis
 
 __all__ = ["AnalysisBase", "Results", "AnalysisFromFunction",
            "analysis_class", "RMSF", "RMSD", "AlignedRMSF", "rmsd",
@@ -54,4 +55,4 @@ __all__ = ["AnalysisBase", "Results", "AnalysisFromFunction",
            "SurvivalProbability", "DielectricConstant",
            "WaterOrientationalRelaxation", "AngularDistribution",
            "PSAnalysis", "hausdorff", "discrete_frechet",
-           "PersistenceLength"]
+           "PersistenceLength", "HELANAL", "helix_analysis"]

@@ -22,8 +22,11 @@ class Timestep:
     conventions.
     """
 
+    # ``aux`` is the auxiliary-data namespace (upstream ``ts.aux``):
+    # None until the reader has auxiliaries attached (add_auxiliary),
+    # then an attribute-accessible mapping of aligned aux steps
     __slots__ = ("positions", "frame", "time", "dimensions",
-                 "velocities", "forces")
+                 "velocities", "forces", "aux")
 
     def __init__(self, positions: np.ndarray, frame: int = 0,
                  time: float = 0.0, dimensions: np.ndarray | None = None,
@@ -45,17 +48,21 @@ class Timestep:
                         f"{name} must match positions shape "
                         f"{self.positions.shape}, got {arr.shape}")
             setattr(self, name, arr)
+        self.aux = None
 
     @property
     def n_atoms(self) -> int:
         return self.positions.shape[0]
 
     def copy(self) -> "Timestep":
-        return Timestep(
+        new = Timestep(
             self.positions.copy(), self.frame, self.time,
             None if self.dimensions is None else self.dimensions.copy(),
             None if self.velocities is None else self.velocities.copy(),
             None if self.forces is None else self.forces.copy())
+        if self.aux is not None:
+            new.aux = type(self.aux)(self.aux)     # shallow copy
+        return new
 
     def __repr__(self):
         return f"<Timestep frame={self.frame} n_atoms={self.n_atoms}>"

@@ -165,9 +165,63 @@ class ReaderBase:
         # staged-block caches hold UNtransformed data
         self.__dict__.pop("_host_stage_cache", None)
 
+    # ---- auxiliary series (upstream add_auxiliary / ts.aux) ----
+
+    @property
+    def auxiliaries(self) -> dict:
+        return self.__dict__.get("_auxiliaries", {})
+
+    def add_auxiliary(self, name: str, aux, cutoff: float | None = None
+                      ) -> None:
+        """Attach an auxiliary time series (``auxiliary.XVGReader`` /
+        ``ArrayAuxReader``); every subsequently read frame carries
+        ``ts.aux.<name>`` = the aux step nearest the frame's time
+        (NaNs past ``cutoff`` — never a silently wrong neighbor)."""
+        if not hasattr(aux, "value_at"):
+            raise TypeError(
+                f"aux must provide value_at(time, cutoff) (an "
+                f"auxiliary.ArrayAuxReader/XVGReader), got "
+                f"{type(aux).__name__}")
+        if not name.isidentifier() or hasattr(dict, name):
+            # ts.aux is attribute-accessed; a name shadowing a dict
+            # method ('values', 'items', ...) would silently return the
+            # bound method instead of the data
+            raise ValueError(
+                f"auxiliary name {name!r} must be a Python identifier "
+                "that does not collide with dict attributes")
+        auxs = self.__dict__.setdefault("_auxiliaries", {})
+        if name in auxs:
+            raise ValueError(f"auxiliary {name!r} already attached")
+        auxs[name] = (aux, cutoff)
+        self._ts = None            # cursor must re-read with aux attached
+
+    def remove_auxiliary(self, name: str) -> None:
+        try:
+            del self.__dict__["_auxiliaries"][name]
+        except KeyError:
+            raise ValueError(
+                f"no auxiliary {name!r}; attached: "
+                f"{sorted(self.auxiliaries)}") from None
+        self._ts = None            # cursor must drop the stale aux view
+
     def _emit(self, ts: Timestep) -> Timestep:
         for t in self.transformations:
             ts = t(ts)
+        return ts
+
+    def _emit_cursor(self, ts: Timestep) -> Timestep:
+        """The per-frame CURSOR path: transformations + the auxiliary
+        namespace.  Block reads (read_block/stage_block) go through
+        plain ``_emit`` — batch kernels never see aux, so building an
+        AuxHolder per staged frame would be pure discarded work."""
+        ts = self._emit(ts)
+        auxs = self.auxiliaries
+        if auxs:
+            from mdanalysis_mpi_tpu.auxiliary import AuxHolder
+
+            ts.aux = AuxHolder(
+                {name: aux.value_at(ts.time, cutoff)
+                 for name, (aux, cutoff) in auxs.items()})
         return ts
 
     # ---- shared behavior ----
@@ -175,7 +229,7 @@ class ReaderBase:
     @property
     def ts(self) -> Timestep:
         if self._ts is None:
-            self._ts = self._emit(self._read_frame(0))
+            self._ts = self._emit_cursor(self._read_frame(0))
         return self._ts
 
     def __len__(self) -> int:
@@ -189,7 +243,7 @@ class ReaderBase:
             i += self.n_frames
         if not 0 <= i < self.n_frames:
             raise IndexError(f"frame {i} out of range [0, {self.n_frames})")
-        self._ts = self._emit(self._read_frame(i))
+        self._ts = self._emit_cursor(self._read_frame(i))
         return self._ts
 
     def __iter__(self):

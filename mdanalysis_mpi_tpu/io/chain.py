@@ -95,6 +95,14 @@ class ChainReader(ReaderBase):
                 f"chained trajectory {k} has transformations attached; "
                 "add them to the ChainReader itself so per-frame and "
                 "block reads agree")
+        if self._readers[k].auxiliaries:
+            # same loud contract as transformations: the chain bypasses
+            # child cursor paths, so a child-attached auxiliary would
+            # silently vanish from every chained frame
+            raise ValueError(
+                f"chained trajectory {k} has auxiliaries attached; "
+                "attach them to the ChainReader itself "
+                "(add_auxiliary on the chain)")
         ts = self._readers[k]._read_frame(local)
         ts.frame = i                     # global numbering
         return ts

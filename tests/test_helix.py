@@ -1,0 +1,78 @@
+"""HELANAL helix geometry (upstream ``analysis.helix_analysis``).
+
+The analytic oracle: an ideal helix with twist θ per residue and rise d
+has EVERY local twist = θ and every local rise = d — pinned exactly for
+the α-helix geometry (100°, 1.5 Å), plus device/serial parity and the
+degenerate-input refusals."""
+
+import numpy as np
+import pytest
+
+from mdanalysis_mpi_tpu.analysis import HELANAL, helix_analysis
+from mdanalysis_mpi_tpu.core.topology import Topology
+from mdanalysis_mpi_tpu.core.universe import Universe
+from mdanalysis_mpi_tpu.io.memory import MemoryReader
+
+
+def _ideal_helix(n, twist_deg=100.0, rise=1.5, radius=2.3, phase=0.0):
+    k = np.arange(n)
+    t = np.radians(twist_deg) * k + phase
+    return np.stack([radius * np.cos(t), radius * np.sin(t), rise * k],
+                    axis=1)
+
+
+def test_ideal_alpha_helix_geometry():
+    r = helix_analysis(_ideal_helix(12))
+    np.testing.assert_allclose(r["local_twists"], 100.0, atol=1e-8)
+    np.testing.assert_allclose(r["local_rises"], 1.5, atol=1e-8)
+    # the local axes all point along +z (helix axis)
+    np.testing.assert_allclose(r["local_axes"][:, 2], 1.0, atol=1e-8)
+    np.testing.assert_allclose(r["global_axis"], [0, 0, 1], atol=1e-8)
+
+
+def test_left_handed_helix_flips_axis():
+    r = helix_analysis(_ideal_helix(10, twist_deg=-100.0))
+    np.testing.assert_allclose(r["local_twists"], 100.0, atol=1e-8)
+    np.testing.assert_allclose(r["local_axes"][:, 2], -1.0, atol=1e-8)
+    # rise measured along the (flipped) local axis
+    np.testing.assert_allclose(r["local_rises"], -1.5, atol=1e-8)
+
+
+def test_3_10_helix():
+    # 3-10 helix: 120 deg twist, ~2.0 A rise
+    r = helix_analysis(_ideal_helix(9, twist_deg=120.0, rise=2.0))
+    np.testing.assert_allclose(r["local_twists"], 120.0, atol=1e-8)
+    np.testing.assert_allclose(r["local_rises"], 2.0, atol=1e-8)
+
+
+def test_helanal_backends_and_means():
+    n, t_frames = 11, 6
+    pos = np.empty((t_frames, n, 3), np.float32)
+    for f in range(t_frames):
+        pos[f] = _ideal_helix(n, phase=0.3 * f) + f * np.array([5.0, 0, 0])
+    top = Topology(names=np.full(n, "CA"), resnames=np.full(n, "ALA"),
+                   resids=np.arange(1, n + 1))
+    u = Universe(top, MemoryReader(pos))
+    s = HELANAL(u, select="name CA").run(backend="serial")
+    assert s.results.local_twists.shape == (t_frames, n - 3)
+    np.testing.assert_allclose(s.results.all_twists, 100.0, atol=1e-4)
+    np.testing.assert_allclose(s.results.all_rises, 1.5, atol=1e-4)
+    np.testing.assert_allclose(s.results.global_axis, [0, 0, 1],
+                               atol=1e-4)
+    for backend in ("jax", "mesh"):
+        b = HELANAL(u, select="name CA").run(backend=backend,
+                                             batch_size=2)
+        np.testing.assert_allclose(b.results.local_twists,
+                                   s.results.local_twists, atol=1e-3)
+        np.testing.assert_allclose(b.results.local_rises,
+                                   s.results.local_rises, atol=1e-4)
+
+
+def test_validation():
+    with pytest.raises(ValueError, match="n>=5"):
+        helix_analysis(np.zeros((4, 3)))
+    top = Topology(names=np.full(4, "CA"), resnames=np.full(4, "ALA"),
+                   resids=np.arange(1, 5))
+    u = Universe(top, MemoryReader(np.zeros((1, 4, 3), np.float32)))
+    with pytest.raises(ValueError, match=">= 5 atoms"):
+        HELANAL(u, select="name CA").run(backend="serial")
