@@ -43,6 +43,7 @@ from mdanalysis_mpi_tpu.analysis.psa import (PSAnalysis, discrete_frechet,
                                              hausdorff)
 from mdanalysis_mpi_tpu.analysis.polymer import PersistenceLength
 from mdanalysis_mpi_tpu.analysis.helix import HELANAL, helix_analysis
+from mdanalysis_mpi_tpu.analysis.bat import BAT
 
 __all__ = ["AnalysisBase", "Results", "AnalysisFromFunction",
            "analysis_class", "RMSF", "RMSD", "AlignedRMSF", "rmsd",
@@ -55,4 +56,4 @@ __all__ = ["AnalysisBase", "Results", "AnalysisFromFunction",
            "SurvivalProbability", "DielectricConstant",
            "WaterOrientationalRelaxation", "AngularDistribution",
            "PSAnalysis", "hausdorff", "discrete_frechet",
-           "PersistenceLength", "HELANAL", "helix_analysis"]
+           "PersistenceLength", "HELANAL", "helix_analysis", "BAT"]

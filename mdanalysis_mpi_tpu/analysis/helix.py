@@ -59,9 +59,10 @@ def _helanal_kernel(params, batch, boxes, mask):
     concatenated in frame order (time-series family)."""
     import jax.numpy as jnp
 
-    del boxes
-    (slots,) = params
-    p = batch[:, slots]                           # (B, n, 3)
+    del boxes, params
+    # the staged block is already selection-gathered in index order —
+    # no further gather needed
+    p = batch                                     # (B, n, 3)
     v = p[:, 1:] - p[:, :-1]
     h = v[:, :-1] - v[:, 1:]
     h = h / (jnp.linalg.norm(h, axis=-1, keepdims=True) + 1e-30)
@@ -112,10 +113,7 @@ class HELANAL(AnalysisBase):
         return _helanal_kernel
 
     def _batch_params(self):
-        import jax.numpy as jnp
-
-        # staged block is already selection-gathered in index order
-        return (jnp.arange(len(self._idx)),)
+        return ()
 
     _device_combine = None      # time series, concatenated in frame order
 

@@ -86,7 +86,9 @@ class ArrayAuxReader:
         i = self.closest_step(time)
         if cutoff is not None and abs(self.times[i] - time) > cutoff:
             return np.full(self.data.shape[1], np.nan)
-        return self.data[i]
+        # a copy, not a view: an in-place edit of ts.aux.<name> must
+        # not corrupt the series for every later frame
+        return self.data[i].copy()
 
 
 class XVGReader(ArrayAuxReader):

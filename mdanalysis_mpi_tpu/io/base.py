@@ -161,9 +161,9 @@ class ReaderBase:
                 "transformations are already set (upstream contract: "
                 "add_transformations can only be called once)")
         self.__dict__["_transformations"] = tuple(transformations)
-        self._ts = None            # cursor must re-read transformed
         # staged-block caches hold UNtransformed data
         self.__dict__.pop("_host_stage_cache", None)
+        self._reset_cursor()       # re-read transformed, same frame
 
     # ---- auxiliary series (upstream add_auxiliary / ts.aux) ----
 
@@ -193,7 +193,7 @@ class ReaderBase:
         if name in auxs:
             raise ValueError(f"auxiliary {name!r} already attached")
         auxs[name] = (aux, cutoff)
-        self._ts = None            # cursor must re-read with aux attached
+        self._reset_cursor()       # re-read with aux attached, IN PLACE
 
     def remove_auxiliary(self, name: str) -> None:
         try:
@@ -202,7 +202,16 @@ class ReaderBase:
             raise ValueError(
                 f"no auxiliary {name!r}; attached: "
                 f"{sorted(self.auxiliaries)}") from None
-        self._ts = None            # cursor must drop the stale aux view
+        self._reset_cursor()       # drop the stale aux view, IN PLACE
+
+    def _reset_cursor(self) -> None:
+        """Invalidate the ts cursor WITHOUT losing the current frame: a
+        bare ``_ts = None`` would silently rewind the next ``ts`` access
+        to frame 0 — wrong for a user positioned mid-trajectory."""
+        cur = None if self._ts is None else self._ts.frame
+        self._ts = None
+        if cur is not None:
+            self[cur]
 
     def _emit(self, ts: Timestep) -> Timestep:
         for t in self.transformations:

@@ -167,6 +167,22 @@ class ChainReader(ReaderBase):
         return ReaderBase.stage_block(self, start, stop, sel=sel,
                                       quantize=quantize)
 
+    def add_auxiliary(self, name, aux, cutoff=None):
+        """Auxiliaries align by ``ts.time``, and a chain's child files
+        commonly RESTART their embedded clocks — which would silently
+        hand segment-2 frames the aux records of segment 1's times.
+        Attach only when the chained time axis is globally
+        non-decreasing; otherwise fail with the remedy."""
+        t = self.frame_times(range(self.n_frames))
+        if t is None or np.any(np.diff(t) < 0):
+            raise ValueError(
+                "chained trajectory times are not globally "
+                "non-decreasing (segment clocks restart, or no time "
+                "metadata); time-aligned auxiliaries would silently "
+                "misalign — write the segment files with continuous "
+                "times, or transfer_to_memory() with explicit times")
+        super().add_auxiliary(name, aux, cutoff)
+
     def frame_times(self, frames):
         idx = np.asarray(list(frames), dtype=np.int64)
         times = np.empty(len(idx), dtype=np.float64)

@@ -151,14 +151,47 @@ def test_chain_refuses_child_auxiliaries(tmp_path):
     rng = np.random.default_rng(3)
     frames = rng.normal(size=(3, 5, 3)).astype(np.float32)
     p1, p2 = str(tmp_path / "a.xtc"), str(tmp_path / "b.xtc")
-    write_xtc(p1, frames)
-    write_xtc(p2, frames)
+    write_xtc(p1, frames, times=np.array([0.0, 1.0, 2.0], np.float32))
+    write_xtc(p2, frames, times=np.array([3.0, 4.0, 5.0], np.float32))
     child = XTCReader(p1)
     child.add_auxiliary("e", ArrayAuxReader([0.0], [1.0]))
     chain = ChainReader([child, XTCReader(p2)])
     with pytest.raises(ValueError, match="auxiliaries"):
         chain[0]
-    # attached to the CHAIN itself it works
+    # attached to the CHAIN itself (continuous times) it aligns
+    # GLOBALLY: frame 4's time is 4.0, in the second segment
     chain2 = ChainReader([XTCReader(p1), XTCReader(p2)])
-    chain2.add_auxiliary("e", ArrayAuxReader([0.0], [7.0]))
-    assert float(chain2[4].aux.e[0]) == 7.0
+    chain2.add_auxiliary(
+        "e", ArrayAuxReader(np.arange(6.0), np.arange(6.0) * 10))
+    assert float(chain2[4].aux.e[0]) == 40.0
+    # segment clocks that RESTART are refused: alignment by time would
+    # silently hand segment-2 frames the aux records of segment 1
+    p3 = str(tmp_path / "c.xtc")
+    write_xtc(p3, frames, times=np.array([0.0, 1.0, 2.0], np.float32))
+    chain3 = ChainReader([XTCReader(p1), XTCReader(p3)])
+    with pytest.raises(ValueError, match="non-decreasing"):
+        chain3.add_auxiliary("e", ArrayAuxReader([0.0], [1.0]))
+
+
+def test_aux_values_are_copies():
+    """Mutating ts.aux.<name> must not corrupt the series."""
+    aux = ArrayAuxReader([0.0], [[0.0, 42.0]])
+    u = _universe(times=[0.0])
+    u.trajectory.add_auxiliary("e", aux)
+    ts = u.trajectory[0]
+    ts.aux.e[1] = -1.0
+    assert float(u.trajectory[0].aux.e[1]) == 42.0
+    assert aux.data[0, 1] == 42.0
+
+
+def test_attach_preserves_current_frame():
+    """add/remove_auxiliary must not silently rewind the cursor."""
+    u = _universe(times=[0.0, 1.0, 2.0])
+    u.trajectory[2]
+    u.trajectory.add_auxiliary("e", ArrayAuxReader([0.0, 2.0],
+                                                   [1.0, 9.0]))
+    assert u.trajectory.ts.frame == 2
+    assert float(u.trajectory.ts.aux.e[0]) == 9.0
+    u.trajectory.remove_auxiliary("e")
+    assert u.trajectory.ts.frame == 2
+    assert u.trajectory.ts.aux is None
