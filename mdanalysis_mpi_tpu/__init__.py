@@ -29,13 +29,13 @@ From-scratch re-design of the capability envelope of the reference
   (reference: absent; SURVEY.md §5).
 """
 
-from mdanalysis_mpi_tpu.core.universe import Universe
+from mdanalysis_mpi_tpu.core.universe import Merge, Universe
 from mdanalysis_mpi_tpu.core.groups import AtomGroup, UpdatingAtomGroup
 from mdanalysis_mpi_tpu.core.topology import Topology
 
 __version__ = "0.1.0"
 
-__all__ = ["Universe", "AtomGroup", "UpdatingAtomGroup", "Topology", "analysis", "__version__"]
+__all__ = ["Universe", "Merge", "AtomGroup", "UpdatingAtomGroup", "Topology", "analysis", "__version__"]
 
 
 def __getattr__(name):

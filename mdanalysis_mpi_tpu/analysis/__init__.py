@@ -44,13 +44,14 @@ from mdanalysis_mpi_tpu.analysis.psa import (PSAnalysis, discrete_frechet,
 from mdanalysis_mpi_tpu.analysis.polymer import PersistenceLength
 from mdanalysis_mpi_tpu.analysis.helix import HELANAL, helix_analysis
 from mdanalysis_mpi_tpu.analysis.bat import BAT
+from mdanalysis_mpi_tpu.analysis.dihedrals import Janin
 
 __all__ = ["AnalysisBase", "Results", "AnalysisFromFunction",
            "analysis_class", "RMSF", "RMSD", "AlignedRMSF", "rmsd",
            "AverageStructure", "AlignTraj", "alignto", "rotation_matrix",
            "InterRDF", "InterRDF_s", "ContactMap",
            "PairwiseDistances", "RadiusOfGyration", "PCA", "EinsteinMSD",
-           "Dihedral", "Ramachandran", "Contacts", "DensityAnalysis",
+           "Dihedral", "Ramachandran", "Janin", "Contacts", "DensityAnalysis",
            "HydrogenBondAnalysis", "DistanceMatrix", "DiffusionMap",
            "VelocityAutocorr", "LinearDensity", "GNMAnalysis",
            "SurvivalProbability", "DielectricConstant",

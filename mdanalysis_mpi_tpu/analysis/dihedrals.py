@@ -167,6 +167,10 @@ class Ramachandran(Dihedral):
     ``results.angles`` (T, R, 2): φ/ψ per interior residue per frame."""
 
     def __init__(self, atomgroup, verbose: bool = False):
+        from mdanalysis_mpi_tpu.analysis.base import reject_updating_groups
+
+        # same snapshot-and-drop pattern as Dihedral/Janin
+        reject_updating_groups(atomgroup, owner="Ramachandran")
         phi, psi, rows = _phi_psi_quads(atomgroup)
         self._n_res = len(rows)
         self.resindices = rows
@@ -184,5 +188,95 @@ class Ramachandran(Dihedral):
         def _finalize():
             flat = np.asarray(vals)[np.asarray(mask) > 0.5]
             return flat.reshape(len(flat), n_res, 2)
+
+        self.results.angles = Deferred(_finalize)
+
+
+#: side-chain atom-name variants accepted for the chi dihedral
+#: positions (upstream Janin's select strings)
+_CHI1_G = ("CG", "CG1", "OG", "OG1", "SG")
+_CHI2_D = ("CD", "CD1", "SD", "OD1", "ND1")
+
+
+def _chi_quads(ag, remove_resnames):
+    """(chi1 (R, 4), chi2 (R, 4), resindices) for every protein residue
+    of ``ag`` not excluded by resname.  chi1 = N-CA-CB-G,
+    chi2 = CA-CB-G-D with G/D from the name-variant tables.  A
+    surviving residue MISSING any of the five atoms raises (upstream
+    Janin's too-few-atoms error) — silent drops would misalign the
+    per-residue rows users zip against their own residue lists."""
+    u = ag.universe
+    t = u.topology
+    if len(ag.indices) == 0 or not t.is_protein[ag.indices].any():
+        raise ValueError("Janin needs protein atoms")
+    sel = ag.indices[t.is_protein[ag.indices]]
+    rn = np.char.upper(t.resnames[sel].astype("U"))
+    # a trailing '*' matches by prefix (upstream's 'CYS*' select idiom:
+    # disulfide/protonation-state variants CYX/CYS2/CYM all lack a χ₂)
+    keep = np.ones(len(sel), dtype=bool)
+    for pat in remove_resnames:
+        p = pat.upper()
+        if p.endswith("*"):
+            keep &= ~np.char.startswith(rn, p[:-1])
+        else:
+            keep &= rn != p
+    wanted = np.unique(t.resindices[sel[keep]])
+    atoms: dict[int, dict] = {}
+    for g in np.flatnonzero(np.isin(t.resindices, wanted)):
+        atoms.setdefault(int(t.resindices[g]), {})[str(t.names[g])] = int(g)
+    chi1, chi2, rows = [], [], []
+    for r in wanted:
+        d = atoms[int(r)]
+        g_atom = next((d[n] for n in _CHI1_G if n in d), None)
+        d_atom = next((d[n] for n in _CHI2_D if n in d), None)
+        missing = [n for n in ("N", "CA", "CB") if n not in d]
+        if missing or g_atom is None or d_atom is None:
+            resname = t.resnames[next(iter(d.values()))]
+            raise ValueError(
+                f"residue {resname} (resindex {int(r)}) lacks chi1/chi2 "
+                f"atoms (missing {missing or 'G/D side-chain atoms'}); "
+                "exclude it via remove_resnames")
+        chi1.append((d["N"], d["CA"], d["CB"], g_atom))
+        chi2.append((d["CA"], d["CB"], g_atom, d_atom))
+        rows.append(int(r))
+    if not chi1:
+        raise ValueError(
+            "no residue in the selection carries chi1/chi2 side chains "
+            "(all excluded by remove_resnames?)")
+    return (np.asarray(chi1, np.int64), np.asarray(chi2, np.int64),
+            np.asarray(rows))
+
+
+class Janin(Dihedral):
+    """``Janin(u.select_atoms('protein')).run()`` → ``results.angles``
+    (T, R, 2): χ₁/χ₂ per side-chain-bearing residue per frame, wrapped
+    to [0, 360) (the upstream Janin-plot convention, unlike
+    Ramachandran's (−180, 180]).  ``remove_resnames`` excludes residues
+    without a χ₂ (upstream's ``select_remove`` default)."""
+
+    REMOVE_DEFAULT = ("ALA", "CYS*", "GLY", "PRO", "SER", "THR", "VAL")
+
+    def __init__(self, atomgroup, remove_resnames=REMOVE_DEFAULT,
+                 verbose: bool = False):
+        from mdanalysis_mpi_tpu.analysis.base import reject_updating_groups
+
+        # the group is snapshotted by _chi_quads and not retained —
+        # the run()-time updating-group scan cannot catch it here
+        reject_updating_groups(atomgroup, owner="Janin")
+        chi1, chi2, rows = _chi_quads(atomgroup, remove_resnames)
+        self._n_res = len(rows)
+        self.resindices = rows
+        AnalysisBase.__init__(self, atomgroup.universe, verbose)
+        self._quads_global = np.empty((2 * self._n_res, 4), np.int64)
+        self._quads_global[0::2] = chi1
+        self._quads_global[1::2] = chi2
+
+    def _conclude(self, total):
+        vals, mask = total
+        n_res = self._n_res
+
+        def _finalize():
+            flat = np.asarray(vals)[np.asarray(mask) > 0.5]
+            return flat.reshape(len(flat), n_res, 2) % 360.0
 
         self.results.angles = Deferred(_finalize)

@@ -276,7 +276,27 @@ def make_water_topology(n_waters: int, resname: str = "SOL",
 
 
 def concatenate(tops: list[Topology]) -> Topology:
-    """Concatenate topologies (e.g. protein + solvent) preserving order."""
+    """Concatenate topologies (e.g. protein + solvent) preserving order.
+
+    Bonds survive with atom indices offset by each part's position;
+    parts without bonds contribute none (a PSF protein + bondless
+    water box keeps the protein's bonds)."""
+    bond_parts = []
+    res_parts = []
+    offset = 0
+    res_offset = 0
+    for t in tops:
+        if t.bonds is not None and len(t.bonds):
+            bond_parts.append(np.asarray(t.bonds, np.int64) + offset)
+        offset += t.n_atoms
+        # residues never fuse across part boundaries: part i's last
+        # residue and part i+1's first stay distinct even when their
+        # (resid, segid) coincide — the change-point rederivation in
+        # __post_init__ would merge them (and re-merge the scattered
+        # residues subset() deliberately keeps apart)
+        r = t.resindices
+        res_parts.append(np.asarray(r, np.int64) + res_offset)
+        res_offset += int(r.max()) + 1 if len(r) else 0
     return Topology(
         names=np.concatenate([t.names for t in tops]),
         resnames=np.concatenate([t.resnames for t in tops]),
@@ -286,4 +306,6 @@ def concatenate(tops: list[Topology]) -> Topology:
         masses=np.concatenate([t.masses for t in tops]),
         charges=(np.concatenate([t.charges for t in tops])
                  if all(t.charges is not None for t in tops) else None),
+        bonds=(np.concatenate(bond_parts) if bond_parts else None),
+        resindices=np.concatenate(res_parts),
     )

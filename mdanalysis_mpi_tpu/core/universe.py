@@ -181,3 +181,33 @@ class Universe:
     def __repr__(self):
         return (f"<Universe with {self.topology.n_atoms} atoms, "
                 f"{self.trajectory.n_frames} frames>")
+
+
+def Merge(*groups) -> "Universe":
+    """Build a NEW single-frame Universe from AtomGroups' CURRENT
+    coordinates (upstream ``MDAnalysis.Merge``): the groups'
+    sub-topologies concatenate in argument order (bonds survive within
+    each group, remapped) and the frame snapshots each group's
+    positions at its universe's current trajectory cursor.
+
+    Groups may come from different universes.  The box is taken from
+    the first group's current frame (upstream behavior); an
+    UpdatingAtomGroup contributes its current membership — Merge is a
+    snapshot by definition.
+    """
+    from mdanalysis_mpi_tpu.core.topology import concatenate
+
+    if not groups:
+        raise ValueError("Merge needs at least one AtomGroup")
+    for g in groups:
+        if not isinstance(g, AtomGroup):
+            raise TypeError(
+                f"Merge takes AtomGroups, got {type(g).__name__}")
+        if g.n_atoms == 0:
+            raise ValueError("cannot Merge an empty AtomGroup")
+    tops = [g.universe.topology.subset(g.indices) for g in groups]
+    top = tops[0] if len(tops) == 1 else concatenate(tops)
+    pos = np.concatenate([g.positions for g in groups])[None]
+    dims = groups[0].universe.trajectory.ts.dimensions
+    return Universe(top, MemoryReader(pos.astype(np.float32),
+                                      dimensions=dims))

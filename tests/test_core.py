@@ -602,3 +602,61 @@ class TestTopologyAttrAndCharges:
         assert u2.select_atoms("prop charge > 0.1").n_atoms == 0
         u.add_TopologyAttr("charges", [-0.8, 0.4, 0.4])
         assert u2.select_atoms("prop charge > 0.1").n_atoms == 2
+
+
+class TestMerge:
+    def test_merge_snapshots_current_frames(self):
+        import mdanalysis_mpi_tpu as mdt
+        from mdanalysis_mpi_tpu.testing import (make_protein_universe,
+                                                make_water_universe)
+
+        up = make_protein_universe(n_residues=4, n_frames=3, seed=1)
+        uw = make_water_universe(n_waters=5, n_frames=2, seed=2)
+        up.trajectory[2]                     # snapshot a LATER frame
+        ca = up.select_atoms("name CA")
+        ow = uw.select_atoms("name OW")
+        m = mdt.Merge(ca, ow)
+        assert m.topology.n_atoms == ca.n_atoms + ow.n_atoms
+        assert m.trajectory.n_frames == 1
+        np.testing.assert_allclose(
+            m.atoms.positions[:ca.n_atoms], ca.positions, atol=1e-6)
+        np.testing.assert_allclose(
+            m.atoms.positions[ca.n_atoms:], ow.positions, atol=1e-6)
+        # names/resnames carried through the sub-topologies
+        assert set(m.select_atoms("name CA").indices.tolist()) \
+            == set(range(ca.n_atoms))
+        assert m.select_atoms("resname SOL").n_atoms == ow.n_atoms
+        # box from the FIRST group's frame (protein fixture: boxless)
+        assert m.trajectory.ts.dimensions is None
+        # the merged universe is independent: advancing the sources
+        # does not move it
+        before = m.atoms.positions.copy()
+        up.trajectory[0]
+        np.testing.assert_array_equal(m.atoms.positions, before)
+
+    def test_merge_validation(self):
+        import mdanalysis_mpi_tpu as mdt
+        from mdanalysis_mpi_tpu.testing import make_protein_universe
+
+        u = make_protein_universe(n_residues=3, n_frames=1)
+        with pytest.raises(ValueError, match="at least one"):
+            mdt.Merge()
+        with pytest.raises(TypeError, match="AtomGroups"):
+            mdt.Merge(u)
+        with pytest.raises(ValueError, match="empty"):
+            mdt.Merge(u.select_atoms("name ZZ"))
+
+    def test_merge_preserves_bonds_within_groups(self):
+        import mdanalysis_mpi_tpu as mdt
+        from mdanalysis_mpi_tpu.core.topology import Topology
+        from mdanalysis_mpi_tpu.core.universe import Universe
+        from mdanalysis_mpi_tpu.io.memory import MemoryReader
+
+        top = Topology(names=np.array(["A", "B", "C"]),
+                       resnames=np.full(3, "MOL"),
+                       resids=np.full(3, 1),
+                       bonds=np.array([[0, 1], [1, 2]]))
+        u = Universe(top, MemoryReader(np.zeros((1, 3, 3), np.float32)))
+        m = mdt.Merge(u.atoms[[0, 1]], u.atoms[[2]])
+        assert m.topology.bonds is not None
+        np.testing.assert_array_equal(m.topology.bonds, [[0, 1]])

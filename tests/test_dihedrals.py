@@ -126,3 +126,110 @@ class TestRamachandran:
         w = make_water_universe(n_waters=5, n_frames=2)
         with pytest.raises(ValueError, match="protein"):
             Ramachandran(w.atoms)
+
+
+class TestJanin:
+    def _universe(self, n_frames=2, resnames=("LYS", "LYS"),
+                  chi1_deg=-60.0):
+        """Residues with N/CA/CB/CG/CD side chains; chi1 constructed at
+        a known angle by placing CG off the N-CA-CB plane."""
+        from mdanalysis_mpi_tpu.core.topology import Topology
+        from mdanalysis_mpi_tpu.core.universe import Universe
+        from mdanalysis_mpi_tpu.io.memory import MemoryReader
+
+        names, rn, rid, coords = [], [], [], []
+        phi = np.radians(chi1_deg)
+        for i, resname in enumerate(resnames):
+            base = np.array([8.0 * i, 0.0, 0.0])
+            # N-CA along +x, CB along +y from CA; CG at torsion phi
+            # about the CA-CB axis relative to N
+            n = base + [0.0, 0.0, 0.0]
+            ca = base + [1.5, 0.0, 0.0]
+            cb = ca + [0.0, 1.5, 0.0]
+            # reference direction for torsion 0 is back toward N (-x);
+            # rotate about +y by phi
+            cg = cb + 1.5 * np.array([-np.cos(phi), 0.0, np.sin(phi)])
+            cd = cg + [0.0, 1.5, 0.0]
+            for nm, xyz in (("N", n), ("CA", ca), ("CB", cb),
+                            ("CG", cg), ("CD", cd)):
+                names.append(nm)
+                rn.append(resname)
+                rid.append(i + 1)
+                coords.append(xyz)
+        top = Topology(names=np.array(names), resnames=np.array(rn),
+                       resids=np.array(rid))
+        pos = np.repeat(np.asarray(coords, np.float32)[None], n_frames,
+                        axis=0)
+        return Universe(top, MemoryReader(pos))
+
+    def test_chi_angles_and_wrap(self):
+        from mdanalysis_mpi_tpu.analysis import Janin
+
+        u = self._universe(chi1_deg=-60.0)
+        r = Janin(u.atoms).run(backend="serial")
+        assert r.results.angles.shape == (2, 2, 2)
+        # chi1 = -60 wraps to 300 (Janin-plot convention [0, 360))
+        np.testing.assert_allclose(r.results.angles[:, :, 0], 300.0,
+                                   atol=1e-4)
+        assert ((0 <= r.results.angles) & (r.results.angles < 360)).all()
+        j = Janin(u.atoms).run(backend="jax", batch_size=2)
+        np.testing.assert_allclose(j.results.angles, r.results.angles,
+                                   atol=1e-3)
+
+    def test_remove_resnames_and_missing_atoms(self):
+        from mdanalysis_mpi_tpu.analysis import Janin
+
+        u = self._universe(resnames=("LYS", "ALA"))
+        # default removal drops the ALA row
+        r = Janin(u.atoms).run(backend="serial")
+        assert r.results.angles.shape[1] == 1
+        # a surviving residue genuinely MISSING side-chain atoms raises
+        # loudly instead of silently skipping (row alignment)
+        from mdanalysis_mpi_tpu.core.topology import Topology
+        from mdanalysis_mpi_tpu.core.universe import Universe
+        from mdanalysis_mpi_tpu.io.memory import MemoryReader
+
+        names = np.array(["N", "CA", "CB", "CG", "CD", "N", "CA", "CB"])
+        top = Topology(names=names,
+                       resnames=np.array(["LYS"] * 5 + ["MET"] * 3),
+                       resids=np.array([1] * 5 + [2] * 3))
+        ut = Universe(top, MemoryReader(
+            np.random.default_rng(0).normal(
+                size=(1, 8, 3)).astype(np.float32)))
+        with pytest.raises(ValueError, match="lacks chi1/chi2"):
+            Janin(ut.atoms)
+        with pytest.raises(ValueError, match="excluded|protein"):
+            Janin(u.select_atoms("resname ALA"),
+                  remove_resnames=("ALA", "LYS"))
+
+    def test_cys_wildcard_and_updating_refusal(self):
+        from mdanalysis_mpi_tpu.analysis import Janin, Ramachandran
+
+        # CYS2 (a CYS* protonation/disulfide variant) is protein but
+        # has no chi2 — the default CYS* wildcard must remove it, not
+        # crash on it (upstream's select_remove glob)
+        u = self._universe(resnames=("LYS", "CYS2"))
+        r = Janin(u.atoms).run(backend="serial")
+        assert r.results.angles.shape[1] == 1
+        uag = u.select_atoms("resname LYS", updating=True)
+        with pytest.raises(TypeError, match="UpdatingAtomGroup"):
+            Janin(uag)
+        with pytest.raises(TypeError, match="UpdatingAtomGroup"):
+            Ramachandran(uag)
+
+
+def test_merge_keeps_distinct_residues():
+    """Merging two copies of a one-residue group must yield TWO
+    residues (boundary residues never fuse)."""
+    import mdanalysis_mpi_tpu as mdt
+    from mdanalysis_mpi_tpu.core.topology import Topology
+    from mdanalysis_mpi_tpu.core.universe import Universe
+    from mdanalysis_mpi_tpu.io.memory import MemoryReader
+
+    top = Topology(names=np.array(["C1", "C2"]),
+                   resnames=np.full(2, "LIG"), resids=np.full(2, 1))
+    u = Universe(top, MemoryReader(np.zeros((1, 2, 3), np.float32)))
+    m = mdt.Merge(u.atoms, u.atoms)
+    assert m.topology.n_atoms == 4
+    np.testing.assert_array_equal(m.topology.resindices, [0, 0, 1, 1])
+    assert len(m.residues) == 2
