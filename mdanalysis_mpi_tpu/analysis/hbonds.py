@@ -312,18 +312,10 @@ class HydrogenBondAnalysis(AnalysisBase):
             present = np.zeros((t, 0), dtype=bool)
         present = _apply_intermittency(present, int(intermittency))
         tau_max = min(int(tau_max), t - 1 if t else 0)
-        taus = np.arange(tau_max + 1)
-        c = np.empty(tau_max + 1)
-        n0 = present.sum(axis=1).astype(np.float64)    # bonds per origin
-        surviving = present.copy()
-        for tau in taus:
-            if tau:
-                # running AND: survival through EVERY frame of the
-                # window, all origins at once (SurvivalProbability's
-                # recurrence)
-                surviving = surviving[:-1] & present[tau:]
-            starts = n0[:t - tau]
-            ok = starts > 0
-            c[tau] = (float((surviving.sum(axis=1)[ok]
-                             / starts[ok]).mean()) if ok.any() else 0.0)
-        return taus, c
+        from mdanalysis_mpi_tpu.lib.correlations import survival_windows
+
+        # the shared running-AND survival reduction (one home for the
+        # semantics: lib.correlations)
+        data = survival_windows(present, tau_max)
+        c = np.array([float(np.mean(v)) if v else 0.0 for v in data])
+        return np.arange(tau_max + 1), c

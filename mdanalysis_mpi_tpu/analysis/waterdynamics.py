@@ -32,20 +32,11 @@ import numpy as np
 from mdanalysis_mpi_tpu.analysis.base import AnalysisBase, deferred_group
 
 
-def _apply_intermittency(mask: np.ndarray, k: int) -> np.ndarray:
-    """Fill gaps of ≤ k consecutive absent frames for atoms present on
-    both sides (upstream ``correct_intermittency`` semantics)."""
-    if k <= 0:
-        return mask
-    out = mask.copy()
-    t = mask.shape[0]
-    for gap in range(1, k + 1):
-        # present at i and at i+gap+1 with the gap in between → filled
-        for i in range(t - gap - 1):
-            bridge = mask[i] & mask[i + gap + 1]
-            if bridge.any():
-                out[i + 1:i + gap + 1] |= bridge
-    return out
+# canonical implementation lives in lib.correlations (the upstream
+# public API); this alias keeps the analysis-internal import surface
+from mdanalysis_mpi_tpu.lib.correlations import (            # noqa: E402
+    intermittency_filter as _apply_intermittency,
+)
 
 
 class SurvivalProbability(AnalysisBase):
@@ -137,17 +128,10 @@ class SurvivalProbability(AnalysisBase):
         tau_max = min(self._tau_max, t - 1)
         mask = _apply_intermittency(
             mask, getattr(self, "_run_intermittency", self._intermittency))
-        n0 = mask.sum(axis=1).astype(np.float64)       # N(t) per start
-        sp = []
-        surviving = mask.copy()
-        for tau in range(tau_max + 1):
-            if tau:
-                # C_tau[t] = C_{tau-1}[t] & mask[t+tau], all starts at once
-                surviving = surviving[:-1] & mask[tau:]
-            starts = n0[:t - tau]
-            ok = starts > 0
-            sp.append(float((surviving.sum(axis=1)[ok]
-                             / starts[ok]).mean()) if ok.any() else 0.0)
+        from mdanalysis_mpi_tpu.lib.correlations import survival_windows
+
+        data = survival_windows(mask, tau_max)
+        sp = [float(np.mean(v)) if v else 0.0 for v in data]
         self.results.tau_timeseries = np.arange(tau_max + 1)
         self.results.sp_timeseries = np.asarray(sp)
 

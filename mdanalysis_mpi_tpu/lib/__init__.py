@@ -3,6 +3,7 @@ surface the reference's capability envelope touches (SURVEY.md §2.2:
 ``lib.distances``/``c_distances``; ``lib.qcprot`` is covered by
 :mod:`mdanalysis_mpi_tpu.ops.align`/:mod:`~mdanalysis_mpi_tpu.ops.host`)."""
 
-from mdanalysis_mpi_tpu.lib import distances, mdamath, transformations
+from mdanalysis_mpi_tpu.lib import (correlations, distances, mdamath,
+                                    transformations)
 
-__all__ = ["distances", "mdamath", "transformations"]
+__all__ = ["correlations", "distances", "mdamath", "transformations"]

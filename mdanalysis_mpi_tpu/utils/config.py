@@ -25,7 +25,8 @@ import numpy as np
 
 ANALYSES = ("rmsf", "aligned-rmsf", "rmsd", "average-structure", "rdf",
             "contacts", "pairwise-distances", "rgyr", "pca", "msd",
-            "ramachandran", "density")
+            "ramachandran", "density", "janin", "helanal",
+            "lineardensity", "gnm", "wor")
 
 
 @dataclasses.dataclass
@@ -52,6 +53,9 @@ class AnalysisConfig:
     n_components: int | None = None     # pca
     msd_type: str = "xyz"               # msd dimensions
     delta: float = 1.0                  # density grid spacing (Å)
+    dtmax: int = 20                     # wor lag window
+    gnm_cutoff: float = 7.0             # gnm contact cutoff (upstream default)
+    binsize: float = 0.25               # lineardensity slab thickness (Å)
     output: str | None = None
 
     def validate(self) -> None:
@@ -100,6 +104,21 @@ def build_analysis(cfg: AnalysisConfig, universe=None):
     if cfg.analysis == "density":
         return ana.DensityAnalysis(u.select_atoms(cfg.select),
                                    delta=cfg.delta)
+    if cfg.analysis == "janin":
+        return ana.Janin(u.select_atoms(cfg.select))
+    if cfg.analysis == "helanal":
+        return ana.HELANAL(u, select=cfg.select)
+    if cfg.analysis == "lineardensity":
+        return ana.LinearDensity(u.select_atoms(cfg.select),
+                                 binsize=cfg.binsize)
+    if cfg.analysis == "gnm":
+        # NOT cfg.cutoff (the contacts knob, default 8.0) — GNM keeps
+        # its own upstream default of 7.0
+        return ana.GNMAnalysis(u, select=cfg.select,
+                               cutoff=cfg.gnm_cutoff)
+    if cfg.analysis == "wor":
+        return ana.WaterOrientationalRelaxation(u, select=cfg.select,
+                                                dtmax=cfg.dtmax)
     raise AssertionError(cfg.analysis)
 
 
@@ -136,7 +155,7 @@ def _parser() -> argparse.ArgumentParser:
                    choices=("serial", "jax", "mesh"))
     p.add_argument("--batch-size", type=int, default=None)
     p.add_argument("--transfer-dtype", default="float32",
-                   choices=("float32", "int16", "int8"))
+                   choices=("float32", "int16", "int8", "delta"))
     p.add_argument("--nbins", type=int, default=75)
     p.add_argument("--engine", default="auto",
                    choices=("auto", "xla", "pallas", "ring"),
@@ -151,6 +170,12 @@ def _parser() -> argparse.ArgumentParser:
                    choices=("xyz", "xy", "xz", "yz", "x", "y", "z"))
     p.add_argument("--delta", type=float, default=1.0,
                    help="density grid spacing in Å")
+    p.add_argument("--dtmax", type=int, default=20,
+                   help="wor: maximum lag (analyzed-frame steps)")
+    p.add_argument("--gnm-cutoff", type=float, default=7.0,
+                   help="gnm: Kirchhoff contact cutoff in Å")
+    p.add_argument("--binsize", type=float, default=0.25,
+                   help="lineardensity slab thickness in Å")
     p.add_argument("--output", default=None, help="write results to .npz")
     p.add_argument("--trace", default=None, metavar="DIR",
                    help="write a jax.profiler trace (TensorBoard format) "
@@ -172,7 +197,8 @@ def main(argv=None) -> int:
         batch_size=ns.batch_size, transfer_dtype=ns.transfer_dtype,
         nbins=ns.nbins, r_max=ns.r_max, cutoff=ns.cutoff, output=ns.output,
         engine=ns.engine, align=ns.align, n_components=ns.n_components,
-        msd_type=ns.msd_type, delta=ns.delta)
+        msd_type=ns.msd_type, delta=ns.delta, dtmax=ns.dtmax,
+        binsize=ns.binsize, gnm_cutoff=ns.gnm_cutoff)
     from mdanalysis_mpi_tpu.utils.timers import device_trace
 
     TIMERS.reset()

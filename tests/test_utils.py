@@ -139,3 +139,49 @@ class TestCLI:
         data = np.load(out)
         assert data["rmsf"].shape == (5,)
         assert np.isfinite(data["rmsf"]).all()
+
+
+class TestRound5CLIAnalyses:
+    def test_helanal_gnm_via_config(self):
+        u = make_protein_universe(n_residues=8, n_frames=6, seed=2)
+        a = run_config(AnalysisConfig(analysis="helanal", topology="mem",
+                                      select="name CA",
+                                      backend="serial"), universe=u)
+        assert np.isfinite(np.asarray(a.results.local_twists)).all()
+        g = run_config(AnalysisConfig(analysis="gnm", topology="mem",
+                                      select="name CA", cutoff=15.0,
+                                      backend="serial"), universe=u)
+        assert np.isfinite(np.asarray(g.results.eigenvalues)).all()
+
+    def test_wor_lineardensity_via_config(self):
+        from mdanalysis_mpi_tpu.testing import make_water_universe
+
+        u = make_water_universe(n_waters=20, n_frames=6, seed=3)
+        a = run_config(AnalysisConfig(analysis="wor", topology="mem",
+                                      select="name OW", dtmax=3,
+                                      backend="serial"), universe=u)
+        assert np.asarray(a.results.timeseries).shape == (4, 3)
+        u.add_TopologyAttr("charges")
+        ld = run_config(AnalysisConfig(analysis="lineardensity",
+                                       topology="mem", select="name OW",
+                                       binsize=1.0, backend="serial"),
+                        universe=u)
+        assert np.asarray(ld.results.x.mass_density).size > 0
+
+    def test_janin_via_config(self):
+        from mdanalysis_mpi_tpu.core.topology import Topology
+        from mdanalysis_mpi_tpu.core.universe import Universe
+        from mdanalysis_mpi_tpu.io.memory import MemoryReader
+
+        names = np.array(["N", "CA", "CB", "CG", "CD"] * 2)
+        top = Topology(names=names, resnames=np.full(10, "LYS"),
+                       resids=np.repeat([1, 2], 5))
+        rng = np.random.default_rng(4)
+        u = Universe(top, MemoryReader(
+            rng.normal(scale=3.0, size=(2, 10, 3)).astype(np.float32)))
+        a = run_config(AnalysisConfig(analysis="janin", topology="mem",
+                                      select="protein",
+                                      backend="serial"), universe=u)
+        ang = np.asarray(a.results.angles)
+        assert ang.shape == (2, 2, 2)
+        assert ((0 <= ang) & (ang < 360)).all()
