@@ -117,14 +117,12 @@ def _phi_psi_quads(ag):
         raise ValueError("Ramachandran needs protein atoms")
     wanted = set(int(r) for r in np.unique(
         t.resindices[ag.indices[t.is_protein[ag.indices]]]))
-    # backbone atom map over the WHOLE universe (neighbor lookups may
-    # leave the selection)
-    prot = np.flatnonzero(t.is_protein)
-    atoms: dict[int, dict] = {}
-    for g in prot:
-        n = t.names[g]
-        if n in ("N", "CA", "C"):
-            atoms.setdefault(int(t.resindices[g]), {})[n] = int(g)
+    # backbone atom map over ALL protein residues (neighbor lookups may
+    # leave the selection); shared builder, core/topology.py
+    from mdanalysis_mpi_tpu.core.topology import residue_atom_map
+
+    prot_res = np.unique(t.resindices[np.flatnonzero(t.is_protein)])
+    atoms = residue_atom_map(t, prot_res, names=("N", "CA", "C"))
     segs = (t.segids if t.segids is not None
             else np.zeros(t.n_atoms, dtype="U1"))
 
@@ -220,10 +218,10 @@ def _chi_quads(ag, remove_resnames):
             keep &= ~np.char.startswith(rn, p[:-1])
         else:
             keep &= rn != p
+    from mdanalysis_mpi_tpu.core.topology import residue_atom_map
+
     wanted = np.unique(t.resindices[sel[keep]])
-    atoms: dict[int, dict] = {}
-    for g in np.flatnonzero(np.isin(t.resindices, wanted)):
-        atoms.setdefault(int(t.resindices[g]), {})[str(t.names[g])] = int(g)
+    atoms = residue_atom_map(t, wanted)
     chi1, chi2, rows = [], [], []
     for r in wanted:
         d = atoms[int(r)]

@@ -275,6 +275,27 @@ def make_water_topology(n_waters: int, resname: str = "SOL",
     return Topology(names=names, resnames=resnames, resids=resids, segids=segids)
 
 
+def residue_atom_map(top: Topology, resindices=None,
+                     names=None) -> dict:
+    """``{resindex: {atom_name: global_atom_index}}`` over the given
+    residues (all residues when None), optionally restricted to
+    ``names``.  The one shared builder for analyses that look atoms up
+    by (residue, name) — Ramachandran/Janin quad construction, DSSP
+    backbone gathering — so duplicate-name/gap semantics cannot drift
+    between them (last atom of a duplicated name wins, everywhere)."""
+    if resindices is None:
+        idx = np.arange(top.n_atoms)
+    else:
+        idx = np.flatnonzero(np.isin(top.resindices, resindices))
+    out: dict[int, dict] = {}
+    for g in idx:
+        nm = str(top.names[g])
+        if names is not None and nm not in names:
+            continue
+        out.setdefault(int(top.resindices[g]), {})[nm] = int(g)
+    return out
+
+
 def concatenate(tops: list[Topology]) -> Topology:
     """Concatenate topologies (e.g. protein + solvent) preserving order.
 
