@@ -46,6 +46,7 @@ from mdanalysis_mpi_tpu.analysis.helix import HELANAL, helix_analysis
 from mdanalysis_mpi_tpu.analysis.bat import BAT
 from mdanalysis_mpi_tpu.analysis.dihedrals import Janin
 from mdanalysis_mpi_tpu.analysis.dssp import DSSP
+from mdanalysis_mpi_tpu.analysis.encore import hes
 
 __all__ = ["AnalysisBase", "Results", "AnalysisFromFunction",
            "analysis_class", "RMSF", "RMSD", "AlignedRMSF", "rmsd",
@@ -58,4 +59,4 @@ __all__ = ["AnalysisBase", "Results", "AnalysisFromFunction",
            "SurvivalProbability", "DielectricConstant",
            "WaterOrientationalRelaxation", "AngularDistribution",
            "PSAnalysis", "hausdorff", "discrete_frechet",
-           "PersistenceLength", "HELANAL", "helix_analysis", "BAT", "DSSP"]
+           "PersistenceLength", "HELANAL", "helix_analysis", "BAT", "DSSP", "hes"]

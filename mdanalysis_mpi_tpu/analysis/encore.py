@@ -1,0 +1,134 @@
+"""Ensemble similarity (upstream ``MDAnalysis.analysis.encore``).
+
+:func:`hes` — the Harmonic Ensemble Similarity: model each ensemble
+(trajectory) as a multivariate Gaussian over its flattened (3N,)
+configuration vectors and take the symmetrized harmonic divergence
+
+    d(A, B) = ¼ (μ_A − μ_B)ᵀ (Σ_A⁻¹ + Σ_B⁻¹) (μ_A − μ_B)
+            + ½ tr(Σ_A Σ_B⁻¹ + Σ_B Σ_A⁻¹ − 2·I)
+
+(upstream ``encore.hes``'s closed form).  Ensembles are rigid-aligned
+to a common reference first (every frame Kabsch-superposed onto the
+first ensemble's first frame — without this, μ differences would be
+dominated by tumbling).
+
+Covariance estimators: ``"shrinkage"`` (default, Ledoit–Wolf 2004 —
+the estimator upstream defaults to, SPD even with far fewer frames
+than 3N dimensions) or ``"ml"`` (sample covariance; needs T ≫ 3N and a
+jitter to invert).
+
+TPU-first shape: each ensemble's covariance is ONE (T, 3N)ᵀ(T, 3N)
+matmul — the MXU-shaped reduction PCA already uses — and the
+cross-ensemble terms are Cholesky solves; everything runs in float64
+on host at typical Cα sizes (3N ~ 10³), with the per-frame alignment
+reusing the shared QCP machinery (ops/host.py).
+
+Scope note: upstream encore also ships clustering/dimensionality-based
+similarities (ces/dres); those depend on scikit-learn-style machinery
+and are out of scope — hes is the closed-form, testable core.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mdanalysis_mpi_tpu.analysis.psa import _as_path, align_path
+
+
+def ledoit_wolf_covariance(x: np.ndarray) -> np.ndarray:
+    """Ledoit–Wolf shrinkage covariance of rows of ``x`` (T, p):
+    ``(1−δ)·S + δ·m·I`` with the closed-form optimal δ (LW 2004).
+    SPD for any T ≥ 2."""
+    x = np.asarray(x, np.float64)
+    t, p = x.shape
+    if t < 2:
+        raise ValueError(f"need at least 2 frames, got {t}")
+    xc = x - x.mean(axis=0)
+    s = xc.T @ xc / t
+    m = np.trace(s) / p
+    d2 = ((s - m * np.eye(p)) ** 2).sum() / p
+    # b̄² = (1/T²) Σ_t ‖x_t x_tᵀ − S‖_F² / p, via the expansion
+    # ‖x xᵀ − S‖² = (xᵀx)² − 2 xᵀSx + ‖S‖²
+    xtx = (xc * xc).sum(axis=1)
+    xsx = ((xc @ s) * xc).sum(axis=1)
+    s_f2 = (s * s).sum()
+    b2 = (xtx ** 2 - 2.0 * xsx + s_f2).sum() / (t * t) / p
+    b2 = min(b2, d2)
+    delta = b2 / d2 if d2 > 0 else 1.0
+    return (1.0 - delta) * s + delta * m * np.eye(p)
+
+
+def _aligned_flat(paths: list) -> list:
+    """Kabsch-align every frame of every path onto paths[0][0] (the
+    shared :func:`~mdanalysis_mpi_tpu.analysis.psa.align_path`);
+    return flattened (T_i, 3N) float64 arrays."""
+    ref = paths[0][0]
+    return [align_path(p, ref).reshape(len(p), -1) for p in paths]
+
+
+def hes(ensembles, select: str = "name CA", align: bool = True,
+        cov_estimator: str = "shrinkage"):
+    """Upstream ``encore.hes``: ``(d_matrix, details)`` with
+    ``d_matrix`` the symmetric (k, k) harmonic divergences and
+    ``details`` carrying each ensemble's ``means``/``covariances``."""
+    if cov_estimator not in ("shrinkage", "ml"):
+        raise ValueError(
+            f"cov_estimator must be 'shrinkage' or 'ml', got "
+            f"{cov_estimator!r}")
+    paths = [_as_path(e, select) for e in ensembles]
+    if len(paths) < 2:
+        raise ValueError("hes needs at least two ensembles")
+    widths = {p.shape[1] for p in paths}
+    if len(widths) != 1:
+        raise ValueError(
+            f"ensembles have different selection widths {sorted(widths)}")
+    if min(len(p) for p in paths) < 2:
+        raise ValueError("every ensemble needs at least 2 frames")
+    flats = (_aligned_flat(paths) if align
+             else [p.reshape(len(p), -1).astype(np.float64)
+                   for p in paths])
+    p_dim = flats[0].shape[1]
+    means = [f.mean(axis=0) for f in flats]
+    # a zero-variance ensemble (all frames identical) has no Gaussian
+    # model — fail naming the input, not with a downstream LinAlgError
+    for idx, (f, mu) in enumerate(zip(flats, means)):
+        var_sum = float(((f - mu) ** 2).sum())
+        # relative: the mean of identical frames differs from them by
+        # float roundoff, so exact zero would miss real frozen inputs
+        if var_sum <= 1e-18 * max(float((f ** 2).sum()), 1e-30):
+            raise ValueError(
+                f"ensemble {idx} has zero variance (all frames "
+                "identical); hes needs fluctuating ensembles")
+    if cov_estimator == "shrinkage":
+        covs = [ledoit_wolf_covariance(f) for f in flats]
+    else:
+        # ML sample covariance + a relative jitter so the inverse
+        # exists even at T ≈ p (documented estimator caveat)
+        covs = []
+        for f, mu in zip(flats, means):
+            xc = f - mu
+            s = xc.T @ xc / len(f)
+            covs.append(s + 1e-9 * (np.trace(s) / p_dim)
+                        * np.eye(p_dim))
+    k = len(flats)
+    # Cholesky is the SPD gate (a clear failure point if an estimator
+    # ever regresses); the inverses themselves come from one LU each
+    for idx, c in enumerate(covs):
+        try:
+            np.linalg.cholesky(c)
+        except np.linalg.LinAlgError:
+            raise ValueError(
+                f"ensemble {idx}'s covariance is not positive "
+                "definite; use cov_estimator='shrinkage'") from None
+    invs = [np.linalg.inv(c) for c in covs]
+    d = np.zeros((k, k))
+    for i in range(k):
+        for j in range(i + 1, k):
+            dm = means[i] - means[j]
+            quad = 0.25 * dm @ ((invs[i] + invs[j]) @ dm)
+            # tr(A @ B) for symmetric A, B without the (p, p) matmul
+            tr = 0.5 * ((covs[i] * invs[j]).sum()
+                        + (covs[j] * invs[i]).sum() - 2.0 * p_dim)
+            d[i, j] = d[j, i] = float(quad + tr)
+    return d, {"means": means, "covariances": covs,
+               "estimator": cov_estimator}

@@ -65,6 +65,23 @@ def _as_path(obj, select: str | None):
     return np.asarray(block, np.float64)
 
 
+def align_path(p: np.ndarray, ref: np.ndarray) -> np.ndarray:
+    """Kabsch-superpose every frame of path ``p`` (T, S, 3) onto the
+    single reference structure ``ref`` (S, 3) — the shared pre-
+    alignment of PSA and encore.hes (one implementation; ops/host QCP).
+    """
+    from mdanalysis_mpi_tpu.ops import host
+
+    ref_com = ref.mean(axis=0)
+    ref_c = ref - ref_com
+    out = np.empty_like(p, dtype=np.float64)
+    for i, x in enumerate(p):
+        xc = x - x.mean(axis=0)
+        # qcp_rotation's R applies as `mobile @ R` (row vectors)
+        out[i] = xc @ host.qcp_rotation(xc, ref_c) + ref_com
+    return out
+
+
 def _cross_rmsd_np(p: np.ndarray, q: np.ndarray) -> np.ndarray:
     """(T1, S, 3), (T2, S, 3) → (T1, T2) frame-pair RMSD, float64."""
     s = p.shape[1]
@@ -195,18 +212,7 @@ class PSAnalysis:
         self.results = Results()
 
     def _align(self, p: np.ndarray) -> np.ndarray:
-        from mdanalysis_mpi_tpu.ops import host
-
-        ref = self._paths[0][0]
-        ref_com = ref.mean(axis=0)
-        ref_c = ref - ref_com
-        out = np.empty_like(p)
-        for i, x in enumerate(p):
-            com = x.mean(axis=0)
-            xc = x - com
-            # qcp_rotation's R applies as `mobile @ R` (row vectors)
-            out[i] = xc @ host.qcp_rotation(xc, ref_c) + ref_com
-        return out
+        return align_path(p, self._paths[0][0])
 
     def run(self, metric: str = "hausdorff", backend: str = "jax"):
         if metric not in _METRICS:

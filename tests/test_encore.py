@@ -1,0 +1,111 @@
+"""encore.hes (harmonic ensemble similarity): closed-form oracle on
+known Gaussians, invariance under rigid motion with align=True,
+symmetry/zero diagonals, and the Ledoit-Wolf estimator's SPD
+guarantee in the frames << dimensions regime."""
+
+import numpy as np
+import pytest
+
+from mdanalysis_mpi_tpu.analysis import hes
+from mdanalysis_mpi_tpu.analysis.encore import ledoit_wolf_covariance
+from mdanalysis_mpi_tpu.testing import (make_protein_universe,
+                                        random_rotation_matrices)
+
+
+def _gauss_paths(mu_shift=0.0, scale=1.0, t=4000, n=4, seed=0,
+                 base_seed=100):
+    """(T, n, 3) samples from an isotropic Gaussian around a base;
+    the base structure is seeded SEPARATELY so two ensembles can share
+    it exactly (mean differences then come only from mu_shift)."""
+    base = np.random.default_rng(base_seed).normal(scale=5.0,
+                                                   size=(n, 3))
+    rng = np.random.default_rng(seed)
+    return base + mu_shift + rng.normal(scale=scale, size=(t, n, 3))
+
+
+def test_identical_ensembles_zero():
+    a = _gauss_paths(seed=1)
+    d, details = hes([a, a.copy()], align=False)
+    assert d.shape == (2, 2)
+    assert d[0, 0] == 0.0 and d[1, 1] == 0.0
+    assert d[0, 1] == pytest.approx(0.0, abs=1e-8)
+    assert details["estimator"] == "shrinkage"
+
+
+def test_closed_form_isotropic_oracle():
+    """Two well-sampled isotropic Gaussians with known mean shift and
+    variances: d = 1/4 |dmu|^2 (1/s1 + 1/s2) + p/2 (s1/s2 + s2/s1 - 2).
+    """
+    p = 12                               # 4 atoms x 3
+    s1, s2, shift = 1.0, 1.5, 0.7
+    a = _gauss_paths(scale=np.sqrt(s1), t=60000, seed=2)
+    b = _gauss_paths(mu_shift=shift, scale=np.sqrt(s2), t=60000, seed=3)
+    d, _ = hes([a, b], align=False, cov_estimator="ml")
+    dmu2 = p * shift ** 2                # shift in every coordinate
+    expect = (0.25 * dmu2 * (1 / s1 + 1 / s2)
+              + 0.5 * p * (s1 / s2 + s2 / s1 - 2.0))
+    assert d[0, 1] == pytest.approx(expect, rel=0.1)
+
+
+def test_align_removes_rigid_motion():
+    rng = np.random.default_rng(4)
+    a = _gauss_paths(t=40, n=10, seed=5)
+    rots = random_rotation_matrices(len(a), rng)
+    b = np.einsum("tnj,tij->tni", a, rots) + rng.normal(
+        scale=8.0, size=(len(a), 1, 3))
+    d_aligned, _ = hes([a, b], align=True)
+    d_raw, _ = hes([a, b], align=False)
+    assert d_aligned[0, 1] < 0.05 * d_raw[0, 1]
+
+
+def test_universe_inputs_and_symmetry():
+    u1 = make_protein_universe(n_residues=8, n_frames=12, noise=0.3,
+                               seed=6)
+    u2 = make_protein_universe(n_residues=8, n_frames=10, noise=0.6,
+                               seed=7)
+    u3 = make_protein_universe(n_residues=8, n_frames=12, noise=0.3,
+                               seed=6)
+    d, details = hes([u1, u2, u3], select="name CA")
+    assert d.shape == (3, 3)
+    assert np.allclose(d, d.T)
+    assert (d >= -1e-9).all()
+    # same-seed universes are identical ensembles
+    assert d[0, 2] == pytest.approx(0.0, abs=1e-6)
+    assert d[0, 1] > d[0, 2]
+    assert len(details["means"]) == 3
+
+
+def test_ledoit_wolf_spd_few_frames():
+    """T=5 frames in p=30 dims: the ML covariance is rank-deficient;
+    shrinkage must still be SPD (all eigenvalues > 0)."""
+    rng = np.random.default_rng(8)
+    x = rng.normal(size=(5, 30))
+    c = ledoit_wolf_covariance(x)
+    w = np.linalg.eigvalsh(c)
+    assert w.min() > 0
+    # and hes runs end-to-end in that regime
+    a = _gauss_paths(t=6, n=10, seed=9)
+    b = _gauss_paths(t=6, n=10, mu_shift=2.0, seed=10)
+    d, _ = hes([a, b], align=False)
+    assert np.isfinite(d).all() and d[0, 1] > 0
+
+
+def test_validation():
+    a = _gauss_paths(t=4)
+    with pytest.raises(ValueError, match="at least two"):
+        hes([a])
+    with pytest.raises(ValueError, match="widths"):
+        hes([a, _gauss_paths(t=4, n=6)])
+    with pytest.raises(ValueError, match="at least 2 frames"):
+        hes([a, a[:1]])
+    with pytest.raises(ValueError, match="cov_estimator"):
+        hes([a, a], cov_estimator="oas")
+    with pytest.raises(ValueError, match="at least 2"):
+        ledoit_wolf_covariance(np.zeros((1, 5)))
+
+
+def test_zero_variance_named_error():
+    a = _gauss_paths(t=6)
+    frozen = np.repeat(a[:1], 6, axis=0)
+    with pytest.raises(ValueError, match="ensemble 1 has zero variance"):
+        hes([a, frozen], align=False)
