@@ -92,6 +92,20 @@ NUCLEIC_RESNAMES = frozenset({
     "RA5", "RC5", "RG5", "RU5", "RA3", "RC3", "RG3", "RU3",
 })
 
+# Purine / pyrimidine split of NUCLEIC_RESNAMES (the Watson-Crick
+# N1-vs-N3 atom choice, analysis/nucleicacids.py).  Kept HERE, next to
+# the nucleic table, so a resname added above cannot silently miss its
+# classification below — consumers raise on nucleic names in neither.
+PURINE_RESNAMES = frozenset({
+    "ADE", "GUA", "A", "G", "DA", "DG", "RA", "RG",
+    "DA5", "DG5", "DA3", "DG3", "RA5", "RG5", "RA3", "RG3",
+})
+PYRIMIDINE_RESNAMES = frozenset({
+    "URA", "CYT", "THY", "C", "T", "U", "DC", "DT", "DU",
+    "RC", "RU", "DC5", "DT5", "DC3", "DT3",
+    "RC5", "RU5", "RC3", "RU3",
+})
+
 WATER_RESNAMES = frozenset({
     "SOL", "WAT", "HOH", "H2O", "TIP", "TIP2", "TIP3", "TIP4", "TIP5",
     "T3P", "T4P", "T5P", "SPC", "SPCE", "OH2",
