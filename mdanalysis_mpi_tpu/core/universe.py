@@ -146,6 +146,13 @@ class Universe:
         traj = self.trajectory
         if not hasattr(traj, "reopen"):
             raise TypeError(f"{type(traj).__name__} does not support copy()")
+        if any(getattr(t, "stateful", False)
+               for t in traj.transformations):
+            raise ValueError(
+                "cannot copy() a universe with stateful transformations "
+                "(PositionAverager): the copies would share one window "
+                "buffer and corrupt each other — build a fresh "
+                "transformation per universe instead")
         new = Universe(self.topology, traj.reopen())
         if traj.transformations:
             # the copy must see the same coordinates as the original

@@ -164,6 +164,12 @@ class ReaderBase:
         # staged-block caches hold UNtransformed data
         self.__dict__.pop("_host_stage_cache", None)
         self._reset_cursor()       # re-read transformed, same frame
+        # the cursor re-read above passed one frame through any NEW
+        # stateful transformation; clear that seed so the caller's own
+        # first read is window frame 1, not a double-counted duplicate
+        for t in transformations:
+            if getattr(t, "stateful", False):
+                t.reset()
 
     # ---- auxiliary series (upstream add_auxiliary / ts.aux) ----
 
@@ -279,6 +285,15 @@ class ReaderBase:
             raise IndexError(f"block [{start},{stop}) out of range [0,{self.n_frames}]")
         if step < 1:
             raise ValueError(f"step must be >= 1, got {step}")
+        if any(getattr(t, "stateful", False) for t in self.transformations):
+            # block/cache schedules are not the sequential cursor a
+            # stateful transformation's numbers depend on — two passes
+            # could silently disagree; refuse instead
+            raise ValueError(
+                "stateful transformations (PositionAverager) are "
+                "sequential-cursor only; iterate frames with "
+                "backend='serial' (block staging would silently change "
+                "their output)")
         frames = range(start, stop, step)
         b = len(frames)
         n = self.n_atoms if sel is None else len(sel)

@@ -27,10 +27,22 @@ from mdanalysis_mpi_tpu.ops import host
 
 class TransformationBase:
     """A callable ``ts -> ts`` that edits ``ts.positions`` in place
-    (upstream convention)."""
+    (upstream convention).
+
+    ``stateful = True`` marks transformations whose output depends on
+    the SEQUENCE of frames they saw (PositionAverager): those are
+    sequential-cursor-only — block staging refuses them (block/cache
+    schedules would silently change their numbers), ``Universe.copy()``
+    refuses to share them across cursors, and attaching one resets its
+    state (``reset()``)."""
+
+    stateful = False
 
     def __call__(self, ts):
         raise NotImplementedError
+
+    def reset(self) -> None:            # stateful subclasses override
+        pass
 
 
 def _require_box(ts, who: str) -> np.ndarray:
@@ -282,4 +294,60 @@ class wrap(TransformationBase):
         idx = self._ag.indices
         ts.positions[idx] = wrap_positions(
             ts.positions[idx], m).astype(np.float32)
+        return ts
+
+
+class PositionAverager(TransformationBase):
+    """Sliding-window position averaging (upstream
+    ``transformations.positionaveraging.PositionAverager``): each
+    emitted frame's positions become the mean of the last
+    ``avg_frames`` frames read (fewer at the start of iteration) — a
+    smoothing filter for noisy trajectories.
+
+    Stateful BY DESIGN (upstream too): it assumes SEQUENTIAL frame
+    reads on ONE cursor.  With ``check_reset=True`` (default) a
+    non-consecutive frame jump clears the window, so random access
+    degrades to plain positions instead of averaging unrelated frames;
+    ``check_reset=False`` reproduces upstream's trust-the-caller mode.
+    Block staging (batch backends / transfer_to_memory) and
+    ``Universe.copy()`` refuse stateful transformations loudly — their
+    schedules/cursor sharing would silently change the averages.
+    ``current_avg`` reports how many frames the current window held.
+    """
+
+    stateful = True
+
+    def __init__(self, avg_frames: int, check_reset: bool = True):
+        if avg_frames < 1:
+            raise ValueError(
+                f"avg_frames must be >= 1, got {avg_frames}")
+        from collections import deque
+
+        self._avg_frames = int(avg_frames)
+        self._check_reset = bool(check_reset)
+        self._buf = deque(maxlen=self._avg_frames)
+        self._sum: np.ndarray | None = None    # float64 running sum
+        self._last_frame: int | None = None
+        self.current_avg = 0
+
+    def reset(self) -> None:
+        self._buf.clear()
+        self._sum = None
+        self._last_frame = None
+        self.current_avg = 0
+
+    def __call__(self, ts):
+        if (self._check_reset and self._last_frame is not None
+                and ts.frame != self._last_frame + 1):
+            self.reset()
+        x = ts.positions.astype(np.float64)
+        if self._sum is None:
+            self._sum = np.zeros_like(x)
+        if len(self._buf) == self._buf.maxlen:
+            self._sum -= self._buf[0]          # deque evicts it below
+        self._buf.append(x)
+        self._sum += x
+        self.current_avg = len(self._buf)
+        ts.positions = (self._sum / self.current_avg).astype(np.float32)
+        self._last_frame = ts.frame
         return ts

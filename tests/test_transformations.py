@@ -237,3 +237,76 @@ def test_rotateby_about_point_and_group_center():
         trf.rotateby(90.0, [0, 0, 1])
     with pytest.raises(ValueError, match="nonzero"):
         trf.rotateby(90.0, [0, 0, 0], point=[0, 0, 0])
+
+
+class TestPositionAverager:
+    def _universe(self, n_frames=6):
+        from mdanalysis_mpi_tpu.core.topology import Topology
+        from mdanalysis_mpi_tpu.core.universe import Universe
+        from mdanalysis_mpi_tpu.io.memory import MemoryReader
+
+        pos = np.zeros((n_frames, 2, 3), np.float32)
+        pos[:, 0, 0] = np.arange(n_frames, dtype=np.float32)  # ramp
+        pos[:, 1, 1] = 5.0                                    # constant
+        top = Topology(names=np.array(["A", "B"]),
+                       resnames=np.full(2, "X"),
+                       resids=np.array([1, 2]))
+        return Universe(top, MemoryReader(pos))
+
+    def test_sliding_window_mean(self):
+        u = self._universe()
+        avg = trf.PositionAverager(avg_frames=3)
+        u.trajectory.add_transformations(avg)
+        xs = [float(ts.positions[0, 0]) for ts in u.trajectory]
+        # window means of the ramp 0,1,2,...: [0, .5, 1, 2, 3, 4]
+        np.testing.assert_allclose(xs, [0.0, 0.5, 1.0, 2.0, 3.0, 4.0],
+                                    atol=1e-6)
+        assert avg.current_avg == 3
+        # the constant coordinate is untouched by averaging
+        assert float(u.trajectory.ts.positions[1, 1]) == 5.0
+
+    def test_reset_on_jump(self):
+        u = self._universe()
+        avg = trf.PositionAverager(avg_frames=4)
+        u.trajectory.add_transformations(avg)
+        u.trajectory[0]
+        u.trajectory[1]
+        assert avg.current_avg == 2
+        u.trajectory[4]                   # non-consecutive -> reset
+        assert avg.current_avg == 1
+        np.testing.assert_allclose(u.trajectory.ts.positions[0, 0], 4.0)
+
+    def test_avg_frames_one_is_identity(self):
+        u = self._universe()
+        u.trajectory.add_transformations(trf.PositionAverager(1))
+        xs = [float(ts.positions[0, 0]) for ts in u.trajectory]
+        np.testing.assert_allclose(xs, np.arange(6.0))
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="avg_frames"):
+            trf.PositionAverager(0)
+
+    def test_stateful_guards(self):
+        from mdanalysis_mpi_tpu.analysis import RMSD
+
+        u = self._universe()
+        u.trajectory.add_transformations(trf.PositionAverager(3))
+        # block staging (batch backends) refuses stateful transforms
+        with pytest.raises(ValueError, match="sequential-cursor"):
+            RMSD(u.atoms).run(backend="jax", batch_size=2)
+        # copy() refuses sharing one window buffer across cursors
+        with pytest.raises(ValueError, match="stateful"):
+            u.copy()
+
+    def test_attach_after_cursor_no_double_count(self):
+        """Materializing the cursor before attaching must not seed the
+        window with a duplicated frame 0 (the hidden _reset_cursor
+        re-read is cleared)."""
+        u = self._universe()
+        _ = u.atoms.positions                 # cursor at frame 0
+        avg = trf.PositionAverager(3, check_reset=False)
+        u.trajectory.add_transformations(avg)
+        assert avg.current_avg == 0           # seed cleared
+        xs = [float(ts.positions[0, 0]) for ts in u.trajectory]
+        np.testing.assert_allclose(xs, [0.0, 0.5, 1.0, 2.0, 3.0, 4.0],
+                                    atol=1e-6)
