@@ -220,3 +220,71 @@ def self_capped_distance(reference, max_cutoff: float,
                            min_cutoff=min_cutoff, box=box,
                            return_distances=return_distances,
                            _self_upper=True)
+
+
+def minimize_vectors(vectors, box) -> np.ndarray:
+    """Apply the minimum-image convention to arbitrary displacement
+    vectors (upstream ``lib.distances.minimize_vectors``): each vector
+    is replaced by its TRUE shortest periodic image.
+
+    For skewed triclinic cells the fractional round-to-nearest used by
+    the hot-path kernels (ops/host.py ``minimum_image`` — the standard
+    single-shift MD compromise) is not always minimal; this public
+    utility finishes the job with a 27-neighbor lattice search, which
+    is exact for any valid triclinic cell."""
+    from mdanalysis_mpi_tpu.core.box import box_to_vectors
+    from mdanalysis_mpi_tpu.ops.host import minimum_image
+
+    dims = _dims_of(box)
+    if dims is None:
+        raise ValueError("minimize_vectors needs a box")
+    dims = np.asarray(dims, np.float64)
+    v = np.asarray(vectors, np.float64)
+    base = minimum_image(v, dims)
+    if np.all(np.abs(dims[3:] - 90.0) < 1e-4):
+        return base.astype(np.float32)       # orthorhombic: exact already
+    m = box_to_vectors(dims)
+    flat = base.reshape(-1, 3)
+    shifts = np.array([(i, j, k) for i in (-1, 0, 1)
+                       for j in (-1, 0, 1)
+                       for k in (-1, 0, 1)], np.float64) @ m   # (27, 3)
+    cand = flat[:, None, :] + shifts[None]                     # (n, 27, 3)
+    best = cand[np.arange(len(flat)),
+                (cand ** 2).sum(-1).argmin(axis=1)]
+    return best.reshape(base.shape).astype(np.float32)
+
+
+def _valid_box_matrix(box, who: str) -> np.ndarray:
+    """Box → (3, 3) cell matrix, refusing degenerate inputs (zero
+    lengths / zero angles) with a ValueError instead of a downstream
+    LinAlgError or silent NaNs."""
+    from mdanalysis_mpi_tpu.core.box import box_to_vectors
+
+    dims = _dims_of(box)
+    if dims is None:
+        raise ValueError(f"{who} needs a box")
+    dims = np.asarray(dims, np.float64)
+    if not (np.all(dims[:3] > 0) and np.all(dims[3:] > 0)
+            and np.all(dims[3:] < 180)):
+        raise ValueError(
+            f"{who}: degenerate box {dims.tolist()} (lengths must be "
+            "> 0, angles in (0, 180))")
+    m = box_to_vectors(dims)
+    if not np.isfinite(m).all() or abs(np.linalg.det(m)) < 1e-12:
+        raise ValueError(f"{who}: box {dims.tolist()} has no volume")
+    return m
+
+
+def transform_RtoS(coords, box) -> np.ndarray:
+    """Real → fractional (scaled) coordinates (upstream
+    ``lib.distances.transform_RtoS``)."""
+    m = _valid_box_matrix(box, "transform_RtoS")
+    return (np.asarray(coords, np.float64) @ np.linalg.inv(m)).astype(
+        np.float32)
+
+
+def transform_StoR(coords, box) -> np.ndarray:
+    """Fractional (scaled) → real coordinates (upstream
+    ``lib.distances.transform_StoR``)."""
+    m = _valid_box_matrix(box, "transform_StoR")
+    return (np.asarray(coords, np.float64) @ m).astype(np.float32)

@@ -554,3 +554,69 @@ def test_analysis_distances_dist_and_between():
                             cb.positions.astype(np.float64), box)
         assert (da.min(axis=1) < 12.0).all()
         assert (db.min(axis=1) < 12.0).all()
+
+
+def test_minimize_vectors_and_fractional_transforms():
+    from mdanalysis_mpi_tpu.lib.distances import (
+        minimize_vectors, transform_RtoS, transform_StoR,
+    )
+
+    box = np.array([10.0, 10.0, 10.0, 90.0, 90.0, 90.0])
+    v = np.array([[9.0, 0.0, 0.0], [-6.0, 4.0, 5.0]])
+    out = minimize_vectors(v, box)
+    np.testing.assert_allclose(out, [[-1.0, 0.0, 0.0],
+                                     [4.0, 4.0, 5.0]], atol=1e-6)
+    # round trip real -> fractional -> real
+    r = np.array([[2.5, 7.5, 1.0]])
+    s = transform_RtoS(r, box)
+    np.testing.assert_allclose(s, [[0.25, 0.75, 0.1]], atol=1e-6)
+    np.testing.assert_allclose(transform_StoR(s, box), r, atol=1e-5)
+    # triclinic: inverse property holds through the box matrix
+    tbox = np.array([8.0, 9.0, 10.0, 80.0, 95.0, 100.0])
+    rr = np.random.default_rng(0).normal(scale=4.0, size=(5, 3))
+    np.testing.assert_allclose(
+        transform_StoR(transform_RtoS(rr, tbox), tbox), rr, atol=1e-4)
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="box"):
+        minimize_vectors(v, None)
+
+
+def test_minimize_vectors_triclinic_is_truly_minimal():
+    """The skewed-cell case the single-shift kernel gets wrong: every
+    minimized vector must be at least as short as ALL 27 neighboring
+    images of the raw vector (brute-force certificate)."""
+    from mdanalysis_mpi_tpu.core.box import box_to_vectors
+    from mdanalysis_mpi_tpu.lib.distances import minimize_vectors
+
+    rng = np.random.default_rng(3)
+    for box in (np.array([10.0, 10.0, 10.0, 90.0, 90.0, 45.0]),
+                np.array([10.0, 10.0, 10.0, 60.0, 60.0, 90.0])):
+        m = box_to_vectors(box)
+        v = rng.normal(scale=12.0, size=(300, 3))
+        out = minimize_vectors(v, box).astype(np.float64)
+        # certificate: out is an image of v ...
+        frac = (v - out) @ np.linalg.inv(m)
+        np.testing.assert_allclose(frac, np.round(frac), atol=1e-4)
+        # ... and no single extra lattice shift shortens it
+        shifts = np.array([(i, j, k) for i in (-1, 0, 1)
+                           for j in (-1, 0, 1)
+                           for k in (-1, 0, 1)], np.float64) @ m
+        cand = out[:, None, :] + shifts[None]
+        best = (cand ** 2).sum(-1).min(axis=1)
+        norm = (out ** 2).sum(-1)
+        assert (norm <= best + 1e-6).all()
+
+
+def test_fractional_transforms_refuse_degenerate_boxes():
+    from mdanalysis_mpi_tpu.lib.distances import (
+        transform_RtoS, transform_StoR,
+    )
+
+    v = np.zeros((1, 3))
+    for bad in (np.zeros(6), np.array([10.0, 10, 10, 0, 0, 0]),
+                np.array([0.0, 10, 10, 90, 90, 90])):
+        with pytest.raises(ValueError, match="degenerate|volume"):
+            transform_RtoS(v, bad)
+        with pytest.raises(ValueError, match="degenerate|volume"):
+            transform_StoR(v, bad)
