@@ -47,6 +47,7 @@ from mdanalysis_mpi_tpu.analysis.bat import BAT
 from mdanalysis_mpi_tpu.analysis.dihedrals import Janin
 from mdanalysis_mpi_tpu.analysis.dssp import DSSP
 from mdanalysis_mpi_tpu.analysis.encore import hes
+from mdanalysis_mpi_tpu.analysis.atomicdistances import AtomicDistances
 from mdanalysis_mpi_tpu.analysis.nucleicacids import (
     NucPairDist, WatsonCrickDist,
 )
@@ -62,4 +63,4 @@ __all__ = ["AnalysisBase", "Results", "AnalysisFromFunction",
            "SurvivalProbability", "DielectricConstant",
            "WaterOrientationalRelaxation", "AngularDistribution",
            "PSAnalysis", "hausdorff", "discrete_frechet",
-           "PersistenceLength", "HELANAL", "helix_analysis", "BAT", "DSSP", "hes", "NucPairDist", "WatsonCrickDist"]
+           "PersistenceLength", "HELANAL", "helix_analysis", "BAT", "DSSP", "hes", "NucPairDist", "WatsonCrickDist", "AtomicDistances"]

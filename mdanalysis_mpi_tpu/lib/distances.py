@@ -232,18 +232,14 @@ def minimize_vectors(vectors, box) -> np.ndarray:
     single-shift MD compromise) is not always minimal; this public
     utility finishes the job with a 27-neighbor lattice search, which
     is exact for any valid triclinic cell."""
-    from mdanalysis_mpi_tpu.core.box import box_to_vectors
     from mdanalysis_mpi_tpu.ops.host import minimum_image
 
-    dims = _dims_of(box)
-    if dims is None:
-        raise ValueError("minimize_vectors needs a box")
-    dims = np.asarray(dims, np.float64)
+    m = _valid_box_matrix(box, "minimize_vectors")   # refuses degenerate
+    dims = np.asarray(_dims_of(box), np.float64)
     v = np.asarray(vectors, np.float64)
     base = minimum_image(v, dims)
     if np.all(np.abs(dims[3:] - 90.0) < 1e-4):
         return base.astype(np.float32)       # orthorhombic: exact already
-    m = box_to_vectors(dims)
     flat = base.reshape(-1, 3)
     shifts = np.array([(i, j, k) for i in (-1, 0, 1)
                        for j in (-1, 0, 1)
