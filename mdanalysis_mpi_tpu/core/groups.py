@@ -383,6 +383,19 @@ class AtomGroup:
         ts.positions[self._indices] = wrapped
         return wrapped
 
+    def unwrap(self, inplace: bool = True) -> np.ndarray:
+        """Make this bonded group whole across periodic boundaries at
+        the current frame (upstream ``AtomGroup.unwrap``); see
+        :func:`mdanalysis_mpi_tpu.lib.mdamath.make_whole`."""
+        from mdanalysis_mpi_tpu.lib.mdamath import make_whole
+
+        return make_whole(self, inplace=inplace)
+
+    def pack_into_box(self) -> np.ndarray:
+        """Upstream ``AtomGroup.pack_into_box()`` — alias of
+        :meth:`wrap` (map atoms into the primary unit cell)."""
+        return self.wrap()
+
     def guess_bonds(self, fudge_factor: float = 0.55,
                     lower_bound: float = 0.1) -> np.ndarray:
         """Distance-based bond perception over THIS group's atoms

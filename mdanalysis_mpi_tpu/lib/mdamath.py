@@ -69,3 +69,35 @@ def box_volume(dimensions) -> float:
     """Cell volume (Å³) from ``[lx, ly, lz, alpha, beta, gamma]``."""
     return float(abs(np.linalg.det(
         box_to_vectors(np.asarray(dimensions, np.float64)))))
+
+
+def make_whole(atomgroup, inplace: bool = True) -> np.ndarray:
+    """Make a bonded group whole across periodic boundaries at the
+    CURRENT frame (upstream ``lib.mdamath.make_whole``): every atom
+    moves to the minimum-image position relative to its bond-tree
+    parent.  Requires bonds (PSF or ``guess_bonds``) and a box on the
+    frame.  Returns the whole positions; ``inplace=True`` (upstream
+    default) also writes them back to the Timestep.
+
+    One-shot form of ``transformations.unwrap`` — attach that to the
+    trajectory instead when every frame needs it (the bond tree is
+    then built once, not per call).
+    """
+    from mdanalysis_mpi_tpu.lib.distances import _valid_box_matrix
+    from mdanalysis_mpi_tpu.transformations import unwrap
+
+    u = atomgroup.universe
+    ts = u.trajectory.ts
+    # strict validation: a partially degenerate box ([10, 0, 0, ...])
+    # would sail past an any(length > 0) check and write NaNs back
+    _valid_box_matrix(ts.dimensions, "make_whole")
+    t = unwrap(atomgroup)
+    if inplace:
+        t(ts)
+        return ts.positions[atomgroup.indices]
+    saved = ts.positions.copy()
+    try:
+        t(ts)
+        return ts.positions[atomgroup.indices].copy()
+    finally:
+        ts.positions = saved

@@ -620,3 +620,61 @@ def test_fractional_transforms_refuse_degenerate_boxes():
             transform_RtoS(v, bad)
         with pytest.raises(ValueError, match="degenerate|volume"):
             transform_StoR(v, bad)
+
+
+def test_make_whole():
+    from mdanalysis_mpi_tpu.core.topology import Topology
+    from mdanalysis_mpi_tpu.core.universe import Universe
+    from mdanalysis_mpi_tpu.io.memory import MemoryReader
+    from mdanalysis_mpi_tpu.lib.mdamath import make_whole
+
+    box = 10.0
+    dims = np.array([box, box, box, 90, 90, 90], np.float32)
+    # a 3-atom chain whose tail wrapped across the +x boundary
+    pos = np.array([[[9.0, 5.0, 5.0], [9.8, 5.0, 5.0],
+                     [0.6, 5.0, 5.0]]], np.float32)
+    top = Topology(names=np.array(["C1", "C2", "C3"]),
+                   resnames=np.full(3, "MOL"), resids=np.full(3, 1),
+                   bonds=np.array([[0, 1], [1, 2]]))
+    u = Universe(top, MemoryReader(pos, dimensions=dims))
+    out = make_whole(u.atoms)
+    np.testing.assert_allclose(out[2], [10.6, 5.0, 5.0], atol=1e-5)
+    # inplace: the Timestep now holds the whole molecule
+    np.testing.assert_allclose(u.trajectory.ts.positions[2],
+                               [10.6, 5.0, 5.0], atol=1e-5)
+    # inplace=False leaves the frame untouched
+    u2 = Universe(top, MemoryReader(pos, dimensions=dims))
+    out2 = make_whole(u2.atoms, inplace=False)
+    np.testing.assert_allclose(out2[2], [10.6, 5.0, 5.0], atol=1e-5)
+    np.testing.assert_allclose(u2.trajectory.ts.positions[2],
+                               [0.6, 5.0, 5.0], atol=1e-6)
+    # boxless frame refuses
+    u3 = Universe(top, MemoryReader(pos))
+    with pytest.raises(ValueError, match="box"):
+        make_whole(u3.atoms)
+    # PARTIALLY degenerate boxes refuse too (any-length>0 would pass
+    # and write NaNs back)
+    bad = np.array([10.0, 0.0, 0.0, 90, 90, 90], np.float32)
+    u4 = Universe(top, MemoryReader(pos, dimensions=bad))
+    with pytest.raises(ValueError, match="degenerate|volume"):
+        make_whole(u4.atoms)
+
+
+def test_atomgroup_unwrap_and_pack_into_box():
+    from mdanalysis_mpi_tpu.core.topology import Topology
+    from mdanalysis_mpi_tpu.core.universe import Universe
+    from mdanalysis_mpi_tpu.io.memory import MemoryReader
+
+    box = 10.0
+    dims = np.array([box, box, box, 90, 90, 90], np.float32)
+    pos = np.array([[[9.0, 5.0, 5.0], [9.8, 5.0, 5.0],
+                     [0.6, 5.0, 5.0]]], np.float32)
+    top = Topology(names=np.array(["C1", "C2", "C3"]),
+                   resnames=np.full(3, "MOL"), resids=np.full(3, 1),
+                   bonds=np.array([[0, 1], [1, 2]]))
+    u = Universe(top, MemoryReader(pos, dimensions=dims))
+    out = u.atoms.unwrap()
+    np.testing.assert_allclose(out[2], [10.6, 5.0, 5.0], atol=1e-5)
+    # pack_into_box wraps it back into the cell
+    packed = u.atoms.pack_into_box()
+    np.testing.assert_allclose(packed[2], [0.6, 5.0, 5.0], atol=1e-4)
