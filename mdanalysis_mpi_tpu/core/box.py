@@ -55,3 +55,26 @@ def vectors_to_box(m: np.ndarray) -> np.ndarray:
     beta = np.degrees(np.arccos(np.clip(a @ c / (la * lc), -1, 1)))
     gamma = np.degrees(np.arccos(np.clip(a @ b / (la * lb), -1, 1)))
     return np.array([la, lb, lc, alpha, beta, gamma], dtype=np.float32)
+
+
+def valid_box_matrix(box, who: str) -> np.ndarray:
+    """Box dimensions → (3, 3) cell matrix, refusing degenerate inputs
+    (None, zero/negative lengths, angles outside (0, 180), zero
+    volume) with a clear ValueError — the ONE validator every
+    box-consuming public surface uses (lib.distances transforms,
+    make_whole, AtomGroup.wrap); a weak ``any(length > 0)`` check lets
+    partially degenerate boxes through to NaNs or LinAlgErrors."""
+    if box is None:
+        raise ValueError(f"{who} needs a box")
+    dims = np.asarray(box, np.float64).reshape(-1)
+    if dims.shape != (6,):
+        raise ValueError(f"{who}: box must be 6 values, got {dims.shape}")
+    if not (np.all(dims[:3] > 0) and np.all(dims[3:] > 0)
+            and np.all(dims[3:] < 180)):
+        raise ValueError(
+            f"{who}: degenerate box {dims.tolist()} (lengths must be "
+            "> 0, angles in (0, 180))")
+    m = box_to_vectors(dims)
+    if not np.isfinite(m).all() or abs(np.linalg.det(m)) < 1e-12:
+        raise ValueError(f"{who}: box {dims.tolist()} has no volume")
+    return m

@@ -373,11 +373,12 @@ class AtomGroup:
         current Timestep.  Returns the wrapped positions.  Requires a
         box on the current frame."""
         ts = self._universe.trajectory.ts
-        if ts.dimensions is None or not np.any(ts.dimensions[:3] > 0):
-            raise ValueError("wrap() needs a periodic box on this frame")
-        from mdanalysis_mpi_tpu.core.box import box_to_vectors, wrap_positions
+        from mdanalysis_mpi_tpu.core.box import (valid_box_matrix,
+                                                 wrap_positions)
 
-        m = box_to_vectors(ts.dimensions.astype(np.float64))
+        # strict: a partially degenerate box would otherwise write NaN
+        # positions back silently (core.box.valid_box_matrix rationale)
+        m = valid_box_matrix(ts.dimensions, "wrap()")
         wrapped = wrap_positions(
             ts.positions[self._indices], m).astype(np.float32)
         ts.positions[self._indices] = wrapped

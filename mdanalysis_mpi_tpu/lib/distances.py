@@ -251,24 +251,11 @@ def minimize_vectors(vectors, box) -> np.ndarray:
 
 
 def _valid_box_matrix(box, who: str) -> np.ndarray:
-    """Box → (3, 3) cell matrix, refusing degenerate inputs (zero
-    lengths / zero angles) with a ValueError instead of a downstream
-    LinAlgError or silent NaNs."""
-    from mdanalysis_mpi_tpu.core.box import box_to_vectors
+    """Shared strict validation (core.box.valid_box_matrix), applied
+    to this module's box argument convention (_dims_of)."""
+    from mdanalysis_mpi_tpu.core.box import valid_box_matrix
 
-    dims = _dims_of(box)
-    if dims is None:
-        raise ValueError(f"{who} needs a box")
-    dims = np.asarray(dims, np.float64)
-    if not (np.all(dims[:3] > 0) and np.all(dims[3:] > 0)
-            and np.all(dims[3:] < 180)):
-        raise ValueError(
-            f"{who}: degenerate box {dims.tolist()} (lengths must be "
-            "> 0, angles in (0, 180))")
-    m = box_to_vectors(dims)
-    if not np.isfinite(m).all() or abs(np.linalg.det(m)) < 1e-12:
-        raise ValueError(f"{who}: box {dims.tolist()} has no volume")
-    return m
+    return valid_box_matrix(_dims_of(box), who)
 
 
 def transform_RtoS(coords, box) -> np.ndarray:

@@ -353,7 +353,7 @@ def test_atomgroup_wrap():
     np.testing.assert_array_equal(ts.positions, wrapped)
     # boxless frame refuses
     u2 = Universe(top, pos[None])
-    with pytest.raises(ValueError, match="periodic box"):
+    with pytest.raises(ValueError, match="box"):
         u2.atoms.wrap()
 
 

@@ -678,3 +678,19 @@ def test_atomgroup_unwrap_and_pack_into_box():
     # pack_into_box wraps it back into the cell
     packed = u.atoms.pack_into_box()
     np.testing.assert_allclose(packed[2], [0.6, 5.0, 5.0], atol=1e-4)
+
+
+def test_wrap_refuses_partially_degenerate_box():
+    from mdanalysis_mpi_tpu.core.topology import Topology
+    from mdanalysis_mpi_tpu.core.universe import Universe
+    from mdanalysis_mpi_tpu.io.memory import MemoryReader
+
+    top = Topology(names=np.array(["A"]), resnames=np.array(["X"]),
+                   resids=np.array([1]))
+    bad = np.array([10.0, 10.0, 10.0, 0.0, 90.0, 90.0], np.float32)
+    u = Universe(top, MemoryReader(np.zeros((1, 1, 3), np.float32),
+                                   dimensions=bad))
+    with pytest.raises(ValueError, match="degenerate|volume"):
+        u.atoms.wrap()
+    with pytest.raises(ValueError, match="degenerate|volume"):
+        u.atoms.pack_into_box()

@@ -21,6 +21,8 @@ Round 3 closes every carve-out: the child asserts multi-controller
 - **InterRDF engine='ring'** — the atom-sharded ppermute ring with the
   union atom axis process-sliced (frames replicated), so the ring
   crosses the process boundary the way it crosses ICI single-host,
+- round-5 families: HELANAL (helix-geometry series) and
+  PersistenceLength (additive psum partials),
 - round-3/4 kernel families: PCA covariance, density grid,
   **LinearDensity** (law-of-total-variance psum across controllers —
   mean AND stddev parity) and **GNM** (all_gathered eigen series).
@@ -104,8 +106,17 @@ ld = LinearDensity(ub2.select_atoms("name CA"), binsize=2.0).run(
     backend="mesh", batch_size=2)
 gn = GNMAnalysis(u, select="name CA").run(backend="mesh", batch_size=2)
 
+# round-5 families at 2 controllers: HELANAL's helix-geometry time
+# series and PersistenceLength's additive psum partials
+from mdanalysis_mpi_tpu.analysis import HELANAL, PersistenceLength
+hx = HELANAL(u, select="name CA").run(backend="mesh", batch_size=2)
+chains = [u.select_atoms("name CA")]
+pl = PersistenceLength(chains).run(backend="mesh", batch_size=2)
+
 if pid == 0:
     np.savez({out!r}, rmsf=a.results.rmsf, rmsf_i16=q.results.rmsf,
+             helanal_twists=np.asarray(hx.results.local_twists),
+             pl_autocorr=np.asarray(pl.results.bond_autocorrelation),
              rmsd=rmsd, rdf_ring=rdf_ring,
              pca_variance=np.asarray(p.results.variance),
              density_grid=dn.results.grid,
@@ -205,4 +216,15 @@ class TestTwoProcessMesh:
         sgn = GNMAnalysis(u, select="name CA").run(backend="serial")
         np.testing.assert_allclose(got["gnm_eigenvalues"],
                                    sgn.results.eigenvalues, atol=1e-3)
+
+        from mdanalysis_mpi_tpu.analysis import HELANAL, PersistenceLength
+
+        sh = HELANAL(u, select="name CA").run(backend="serial")
+        np.testing.assert_allclose(got["helanal_twists"],
+                                   sh.results.local_twists, atol=1e-2)
+        spl = PersistenceLength([u.select_atoms("name CA")]).run(
+            backend="serial")
+        np.testing.assert_allclose(got["pl_autocorr"],
+                                   spl.results.bond_autocorrelation,
+                                   atol=1e-4)
 

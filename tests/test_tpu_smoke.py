@@ -182,6 +182,19 @@ e2 = float(np.abs(a2.results.rmsf - sf.results.rmsf).max())
 assert e2 < 1e-3, f"cache-served second run diverged: {e2:.2e}"
 print(f"file_backed int16 prestage+cache err {e1:.2e}/{e2:.2e} "
       f"hits {cachef.hits}")
+
+# --- round-5 delta wire format on chip: correlated trajectory (the
+# format's stated envelope), keyframe+residual reconstruction on
+# device, differenced against the serial f64 oracle ---
+from mdanalysis_mpi_tpu.testing import make_md_universe
+
+um = make_md_universe(n_residues=150, n_frames=64, step=0.05, seed=18)
+sm = AlignedRMSF(um, select="heavy").run(backend="serial")
+dm = AlignedRMSF(um, select="heavy").run(
+    backend="jax", batch_size=16, transfer_dtype="delta")
+ed = float(np.abs(dm.results.rmsf - sm.results.rmsf).max())
+assert ed < 1e-3, f"delta staging diverged on chip: {ed:.2e}"
+print(f"delta wire format on-chip err {ed:.2e}")
 print("TPU_SMOKE_OK")
 """
 
