@@ -336,13 +336,58 @@ def config7(stack):
             "gnm_serial_frames": gsf, "gnm_serial_cv": gscv}, check
 
 
+def config8(stack):
+    """Informational (not a BASELINE config): the round-5 analysis
+    families — DSSP's O(n²) Kabsch-Sander H-bond kernel and HELANAL's
+    helix geometry — on the chip."""
+    del stack
+    from mdanalysis_mpi_tpu.analysis import DSSP, HELANAL
+    from mdanalysis_mpi_tpu.core.topology import Topology
+    from mdanalysis_mpi_tpu.io.memory import MemoryReader
+
+    n_res = 120
+    names = np.tile(np.array(["N", "CA", "C", "O"]), n_res)
+    top = Topology(names=names, resnames=np.full(4 * n_res, "ALA"),
+                   resids=np.repeat(np.arange(1, n_res + 1), 4))
+    rng = np.random.default_rng(15)
+    nf = int(64 * SCALE)
+    pos = rng.normal(scale=8.0, size=(nf, 4 * n_res, 3)).astype(
+        np.float32)
+    ud = Universe(top, MemoryReader(pos))
+    fps, serial, sf, scv, a = _timed(
+        lambda: DSSP(ud), nf, dict(backend="jax", batch_size=8))
+    up = make_protein_universe(n_residues=150, n_frames=int(128 * SCALE),
+                               noise=0.3, seed=15)
+    nh = up.trajectory.n_frames
+    hfps, hserial, hsf, hscv, _ = _timed(
+        lambda: HELANAL(up, select="name CA"),
+        nh, dict(backend="jax", batch_size=32))
+
+    def check():
+        s = DSSP(ud).run(backend="serial")
+        agree = float((np.asarray(a.results.dssp)
+                       == np.asarray(s.results.dssp)).mean())
+        assert agree >= 0.98, f"config8 DSSP agreement {agree}"
+
+    return {"config": 8,
+            "metric": "informational: DSSP(120res) + HELANAL(150res Ca)",
+            "value": _r(fps), "unit": "frames/s", "backend": "jax",
+            "serial_fps": round(serial, 2), "serial_frames": sf,
+            "serial_cv": scv,
+            "vs_serial": _vs(fps, serial),
+            "helanal_fps": _r(hfps),
+            "helanal_serial_fps": round(hserial, 2),
+            "helanal_serial_frames": hsf,
+            "helanal_serial_cv": hscv}, check
+
+
 def main():
     # BENCH_SUITE_CONFIGS="1,3,5" runs a subset (default: all)
     wanted = os.environ.get("BENCH_SUITE_CONFIGS")
     wanted = ({int(x) for x in wanted.split(",")} if wanted
-              else {1, 2, 3, 4, 5, 6, 7})
+              else {1, 2, 3, 4, 5, 6, 7, 8})
     configs = (config1, config2, config3, config4, config5, config6,
-               config7)
+               config7, config8)
     with contextlib.ExitStack() as stack:
         rows = []
         for i, fn in enumerate(configs, start=1):

@@ -46,8 +46,12 @@ class TransformationBase:
 
 
 def _require_box(ts, who: str) -> np.ndarray:
-    if ts.dimensions is None or not np.any(ts.dimensions[:3] > 0):
-        raise ValueError(f"{who} needs a periodic box on frame {ts.frame}")
+    """Strict per-frame box validation (core.box.valid_box_matrix —
+    the one shared validator): a partially degenerate box must raise
+    here, not write NaN positions through box_to_vectors downstream."""
+    from mdanalysis_mpi_tpu.core.box import valid_box_matrix
+
+    valid_box_matrix(ts.dimensions, f"{who} (frame {ts.frame})")
     return ts.dimensions.astype(np.float64)
 
 

@@ -172,3 +172,38 @@ def test_flagship_scale_delta_parity():
         backend="jax", batch_size=32, transfer_dtype="delta")
     err = float(np.abs(np.asarray(a.results.rmsf) - s.results.rmsf).max())
     assert err < 1e-3, f"flagship-scale delta RMSF err {err}"
+
+
+def test_quantize_block_delta_fuzz():
+    """Property fuzz: for arbitrary finite blocks and anchor splits,
+    reconstruction error stays within the per-frame closed-loop bound
+    (keyframe step + that frame's residual step)."""
+    hyp = pytest.importorskip("hypothesis")
+    from hypothesis import given, settings, strategies as st
+
+    @settings(max_examples=40, deadline=None)
+    @given(
+        b_seg=st.sampled_from([(4, 1), (8, 2), (12, 3), (16, 4)]),
+        s=st.integers(min_value=1, max_value=9),
+        scale=st.floats(min_value=1e-3, max_value=1e3),
+        step=st.floats(min_value=1e-6, max_value=10.0),
+        seed=st.integers(min_value=0, max_value=2**31 - 1),
+    )
+    def check(b_seg, s, scale, step, seed):
+        b, n_anchors = b_seg
+        rng = np.random.default_rng(seed)
+        base = rng.normal(scale=scale, size=(s, 3))
+        walk = np.cumsum(rng.normal(scale=step, size=(b, s, 3)), axis=0)
+        block = (base[None] + walk).astype(np.float32)
+        res, key, inv_abs, inv_res = quantize_block_delta(
+            block, n_anchors=n_anchors)
+        seg = b // n_anchors
+        for a in range(n_anchors):
+            sl = slice(a * seg, (a + 1) * seg)
+            xhat = _reconstruct(res[sl], key[a:a + 1], inv_abs,
+                                inv_res[sl])
+            err = np.abs(xhat - block[sl]).max(axis=(1, 2))
+            bound = 0.51 * (inv_res[sl, 0, 0] + inv_abs) + 1e-6
+            assert (err <= bound).all(), (err, bound)
+
+    check()

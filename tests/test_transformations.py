@@ -310,3 +310,20 @@ class TestPositionAverager:
         xs = [float(ts.positions[0, 0]) for ts in u.trajectory]
         np.testing.assert_allclose(xs, [0.0, 0.5, 1.0, 2.0, 3.0, 4.0],
                                     atol=1e-6)
+
+
+def test_transformations_refuse_partially_degenerate_box():
+    from mdanalysis_mpi_tpu.core.topology import Topology
+    from mdanalysis_mpi_tpu.core.universe import Universe
+    from mdanalysis_mpi_tpu.io.memory import MemoryReader
+
+    top = Topology(names=np.array(["A"]), resnames=np.array(["X"]),
+                   resids=np.array([1]))
+    bad = np.array([10.0, 10.0, 10.0, 0.0, 90.0, 90.0], np.float32)
+    u = Universe(top, MemoryReader(np.zeros((1, 1, 3), np.float32),
+                                   dimensions=bad))
+    ts = u.trajectory.ts
+    with pytest.raises(ValueError, match="degenerate|volume"):
+        trf.wrap(u.atoms)(ts)
+    with pytest.raises(ValueError, match="degenerate|volume"):
+        trf.center_in_box(u.atoms)(ts)
