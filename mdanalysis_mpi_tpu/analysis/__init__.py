@@ -48,6 +48,8 @@ from mdanalysis_mpi_tpu.analysis.dihedrals import Janin
 from mdanalysis_mpi_tpu.analysis.dssp import DSSP
 from mdanalysis_mpi_tpu.analysis.encore import hes
 from mdanalysis_mpi_tpu.analysis.atomicdistances import AtomicDistances
+from mdanalysis_mpi_tpu.analysis.leaflet import (LeafletFinder,
+                                                 optimize_cutoff)
 from mdanalysis_mpi_tpu.analysis.nucleicacids import (
     NucPairDist, WatsonCrickDist,
 )
@@ -63,4 +65,5 @@ __all__ = ["AnalysisBase", "Results", "AnalysisFromFunction",
            "SurvivalProbability", "DielectricConstant",
            "WaterOrientationalRelaxation", "AngularDistribution",
            "PSAnalysis", "hausdorff", "discrete_frechet",
-           "PersistenceLength", "HELANAL", "helix_analysis", "BAT", "DSSP", "hes", "NucPairDist", "WatsonCrickDist", "AtomicDistances"]
+           "PersistenceLength", "HELANAL", "helix_analysis", "BAT", "DSSP", "hes", "NucPairDist", "WatsonCrickDist", "AtomicDistances",
+           "LeafletFinder", "optimize_cutoff"]

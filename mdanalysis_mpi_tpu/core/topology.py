@@ -182,25 +182,7 @@ class Topology:
                 raise ValueError(
                     "fragments need bonds; load a bonded topology (PSF) "
                     "or call guess_bonds() first")
-            parent = np.arange(self.n_atoms, dtype=np.int64)
-
-            def find(i: int) -> int:
-                root = i
-                while parent[root] != root:
-                    root = parent[root]
-                while parent[i] != root:       # path compression
-                    parent[i], i = root, parent[i]
-                return root
-
-            for a, b in self.bonds:
-                ra, rb = find(int(a)), find(int(b))
-                if ra != rb:
-                    parent[max(ra, rb)] = min(ra, rb)
-            roots = np.fromiter((find(i) for i in range(self.n_atoms)),
-                                dtype=np.int64, count=self.n_atoms)
-            # roots are component minima → ascending unique = dense
-            # fragment ids in first-atom order
-            _, m = np.unique(roots, return_inverse=True)
+            m = label_components(self.n_atoms, self.bonds)
             self._derived["fragindices"] = m
         return m
 
@@ -273,6 +255,35 @@ def make_water_topology(n_waters: int, resname: str = "SOL",
     resids = np.repeat(np.arange(start_resid, start_resid + n_waters), 3)
     segids = np.full(3 * n_waters, segid)
     return Topology(names=names, resnames=resnames, resids=resids, segids=segids)
+
+
+def label_components(n: int, pairs) -> np.ndarray:
+    """Connected components over ``pairs`` (K, 2) of nodes [0, n) →
+    dense 0-based component label per node, in first-node order.
+
+    The ONE union-find (min-root + path compression) shared by bonded
+    fragments (``Topology.fragindices``) and spatial clustering
+    (``analysis.leaflet``) — a subtle algorithm that must not fork."""
+    parent = np.arange(n, dtype=np.int64)
+
+    def find(i: int) -> int:
+        root = i
+        while parent[root] != root:
+            root = parent[root]
+        while parent[i] != root:       # path compression
+            parent[i], i = root, parent[i]
+        return root
+
+    for a, b in pairs:
+        ra, rb = find(int(a)), find(int(b))
+        if ra != rb:
+            parent[max(ra, rb)] = min(ra, rb)
+    roots = np.fromiter((find(i) for i in range(n)),
+                        dtype=np.int64, count=n)
+    # roots are component minima → ascending unique = dense labels in
+    # first-node order
+    _, labels = np.unique(roots, return_inverse=True)
+    return labels
 
 
 def residue_atom_map(top: Topology, resindices=None,

@@ -45,14 +45,16 @@ class TransformationBase:
         pass
 
 
-def _require_box(ts, who: str) -> np.ndarray:
+def _require_box(ts, who: str):
     """Strict per-frame box validation (core.box.valid_box_matrix —
     the one shared validator): a partially degenerate box must raise
-    here, not write NaN positions through box_to_vectors downstream."""
+    here, not write NaN positions downstream.  Returns ``(dims, m)``
+    — the validator already built the (3, 3) cell matrix, so per-frame
+    callers must not rebuild it."""
     from mdanalysis_mpi_tpu.core.box import valid_box_matrix
 
-    valid_box_matrix(ts.dimensions, f"{who} (frame {ts.frame})")
-    return ts.dimensions.astype(np.float64)
+    m = valid_box_matrix(ts.dimensions, f"{who} (frame {ts.frame})")
+    return ts.dimensions.astype(np.float64), m
 
 
 def _group_center(ag, positions: np.ndarray, center: str) -> np.ndarray:
@@ -128,10 +130,9 @@ class center_in_box(TransformationBase):
         self._wrap = wrap
 
     def __call__(self, ts):
-        from mdanalysis_mpi_tpu.core.box import box_to_vectors, wrap_positions
+        from mdanalysis_mpi_tpu.core.box import wrap_positions
 
-        dim = _require_box(ts, "center_in_box")
-        m = box_to_vectors(dim)
+        dim, m = _require_box(ts, "center_in_box")
         pos = ts.positions
         if self._wrap:
             # wrap affects only the CENTER COMPUTATION (upstream
@@ -270,10 +271,9 @@ class unwrap(TransformationBase):
                         for p, c in levels if p]
 
     def __call__(self, ts):
-        from mdanalysis_mpi_tpu.core.box import box_to_vectors
         from mdanalysis_mpi_tpu.ops.host import minimum_image
 
-        dim = _require_box(ts, "unwrap")
+        dim, _m = _require_box(ts, "unwrap")
         pos = ts.positions.astype(np.float64)
         for parents, children in self._levels:
             d = minimum_image(pos[children] - pos[parents], dim)
@@ -291,10 +291,9 @@ class wrap(TransformationBase):
         self._ag = ag
 
     def __call__(self, ts):
-        from mdanalysis_mpi_tpu.core.box import box_to_vectors, wrap_positions
+        from mdanalysis_mpi_tpu.core.box import wrap_positions
 
-        dim = _require_box(ts, "wrap")
-        m = box_to_vectors(dim)
+        dim, m = _require_box(ts, "wrap")
         idx = self._ag.indices
         ts.positions[idx] = wrap_positions(
             ts.positions[idx], m).astype(np.float32)

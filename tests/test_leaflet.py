@@ -1,0 +1,96 @@
+"""LeafletFinder (upstream ``analysis.leaflet``): two constructed
+planar sheets separate into two leaflets; PBC merging across the
+boundary; optimize_cutoff picks a sane value."""
+
+import numpy as np
+import pytest
+
+from mdanalysis_mpi_tpu.analysis import LeafletFinder, optimize_cutoff
+from mdanalysis_mpi_tpu.core.topology import Topology
+from mdanalysis_mpi_tpu.core.universe import Universe
+from mdanalysis_mpi_tpu.io.memory import MemoryReader
+
+
+def _bilayer(nx=6, ny=6, sep=30.0, spacing=8.0, box=None, jitter=0.5,
+             seed=0):
+    """Two nx x ny headgroup sheets at z=0 and z=sep."""
+    rng = np.random.default_rng(seed)
+    g = np.stack(np.meshgrid(np.arange(nx), np.arange(ny),
+                             indexing="ij"), -1).reshape(-1, 2) * spacing
+    n = len(g)
+    pos = np.zeros((2 * n, 3), np.float32)
+    pos[:n, :2] = g
+    pos[n:, :2] = g
+    pos[n:, 2] = sep
+    pos += rng.normal(scale=jitter, size=pos.shape).astype(np.float32)
+    names = np.full(2 * n, "P")
+    top = Topology(names=names, resnames=np.full(2 * n, "POPC"),
+                   resids=np.arange(1, 2 * n + 1))
+    dims = (np.array([box, box, box, 90, 90, 90], np.float32)
+            if box else None)
+    return Universe(top, MemoryReader(pos[None], dimensions=dims)), n
+
+
+def test_two_leaflets():
+    u, n = _bilayer()
+    lf = LeafletFinder(u, "name P", cutoff=12.0)
+    assert lf.sizes() == [n, n]
+    top_group, bottom_group = lf.groups()
+    # groups partition the selection, and each leaflet is one z-slab
+    zs0 = top_group.positions[:, 2]
+    zs1 = bottom_group.positions[:, 2]
+    assert (np.abs(zs0 - zs0.mean()) < 5.0).all()
+    assert abs(zs0.mean() - zs1.mean()) > 20.0
+    assert lf.groups(0).n_atoms == n
+    idx = np.sort(np.concatenate([g.indices for g in lf.groups()]))
+    np.testing.assert_array_equal(idx, np.arange(2 * n))
+
+
+def test_cutoff_too_small_fragments():
+    u, n = _bilayer()
+    lf = LeafletFinder(u, "name P", cutoff=2.0)
+    assert len(lf.sizes()) > 2                   # every lipid its own isle
+
+
+def test_pbc_merges_across_boundary():
+    """A sheet wrapped across the boundary splits without pbc and
+    stays whole with pbc=True."""
+    box = 60.0
+    u, n = _bilayer(box=box, jitter=0.0)
+    # columns at x = 0..40 (spacing 8); shift by 30 so the last two
+    # wrap (54, 62 % 60 = 2): the in-cell gap 2 -> 30 exceeds the
+    # cutoff, but through the boundary the sheet is continuous
+    ts = u.trajectory.ts
+    ts.positions[:, 0] = (ts.positions[:, 0] + 30.0) % box
+    lf_no = LeafletFinder(u, "name P", cutoff=9.0, pbc=False)
+    lf_yes = LeafletFinder(u, "name P", cutoff=9.0, pbc=True)
+    assert lf_yes.sizes() == [n, n]
+    assert len(lf_no.sizes()) > 2                # split at the seam
+
+
+def test_rerun_tracks_frame_and_validation():
+    u, n = _bilayer()
+    lf = LeafletFinder(u, "name P", cutoff=12.0)
+    # squash the top sheet onto the bottom -> one component on re-run
+    u.trajectory.ts.positions[:, 2] = 0.0
+    lf.run()
+    assert len(lf.sizes()) == 1
+    with pytest.raises(ValueError, match="cutoff"):
+        LeafletFinder(u, "name P", cutoff=0.0)
+    with pytest.raises(ValueError, match="matches no atoms"):
+        LeafletFinder(u, "name XX")
+    u2, _ = _bilayer(box=None)
+    with pytest.raises(ValueError, match="no box"):
+        LeafletFinder(u2, "name P", pbc=True)
+
+
+def test_optimize_cutoff():
+    u, n = _bilayer()
+    cutoff, ncomp = optimize_cutoff(u, "name P", dmin=8.0, dmax=16.0)
+    assert ncomp == 2
+    lf = LeafletFinder(u, "name P", cutoff=cutoff)
+    assert lf.sizes() == [n, n]
+    # below the lattice spacing everything fragments: the optimum in
+    # that range is many balanced singletons, never two leaflets
+    _, ncomp_small = optimize_cutoff(u, "name P", dmin=0.5, dmax=1.0)
+    assert ncomp_small > 2
