@@ -47,6 +47,7 @@ from mdanalysis_mpi_tpu.analysis.bat import BAT
 from mdanalysis_mpi_tpu.analysis.dihedrals import Janin
 from mdanalysis_mpi_tpu.analysis.dssp import DSSP
 from mdanalysis_mpi_tpu.analysis.encore import hes
+from mdanalysis_mpi_tpu.analysis.pca import cosine_content
 from mdanalysis_mpi_tpu.analysis.atomicdistances import AtomicDistances
 from mdanalysis_mpi_tpu.analysis.leaflet import (LeafletFinder,
                                                  optimize_cutoff)
@@ -66,4 +67,4 @@ __all__ = ["AnalysisBase", "Results", "AnalysisFromFunction",
            "WaterOrientationalRelaxation", "AngularDistribution",
            "PSAnalysis", "hausdorff", "discrete_frechet",
            "PersistenceLength", "HELANAL", "helix_analysis", "BAT", "DSSP", "hes", "NucPairDist", "WatsonCrickDist", "AtomicDistances",
-           "LeafletFinder", "optimize_cutoff"]
+           "LeafletFinder", "optimize_cutoff", "cosine_content"]

@@ -302,3 +302,48 @@ class PCA(AnalysisBase):
                 block = np.stack([traj[i].positions[idx] for i in chunk])
             out[a:a + len(chunk)] = np.asarray(project(jnp.asarray(block)))
         return out
+
+
+def cosine_content(pca_space: np.ndarray, i: int) -> float:
+    """Cosine content of PCA projection ``i`` (upstream
+    ``analysis.pca.cosine_content``):
+
+        c_i = (2/T) · ( Σ_t cos(π·i'·t/T)·p_i(t) )² / Σ_t p_i(t)²
+
+    with i' = i+1 (the first projection compares against a half
+    cosine).  Values near 1 indicate random-diffusion-like sampling
+    (Hess 2000); near 0, converged sampling along that mode.
+    """
+    p = np.asarray(pca_space, np.float64)
+    if p.ndim != 2:
+        raise ValueError(
+            f"pca_space must be (n_frames, n_components), got {p.shape}")
+    if not 0 <= i < p.shape[1]:
+        raise IndexError(
+            f"component {i} out of range for {p.shape[1]} components")
+    t = p.shape[0]
+    if t < 3:
+        raise ValueError("cosine content needs at least 3 frames")
+    series = p[:, i]
+    cos = np.cos(np.pi * (i + 1) * np.arange(t) / t)
+    # upstream integrates with Simpson's rule (scipy.integrate.simps);
+    # composite Simpson here, last interval by trapezoid when the
+    # sample count is even (documented O(1/T³)-class divergence from
+    # scipy's even='avg' treatment — far below sampling noise)
+    num = _simpson(cos * series)
+    denom = _simpson(series ** 2)
+    if denom == 0.0:
+        return 0.0
+    return float(2.0 / t * num ** 2 / denom)
+
+
+def _simpson(y: np.ndarray) -> float:
+    """Composite Simpson integral of unit-spaced samples; even sample
+    counts close with one trapezoid panel (see cosine_content note)."""
+    n = len(y)
+    end = n if n % 2 == 1 else n - 1
+    s = float(y[0] + y[end - 1]
+              + 4.0 * y[1:end - 1:2].sum() + 2.0 * y[2:end - 1:2].sum()) / 3.0
+    if n % 2 == 0:
+        s += 0.5 * float(y[-2] + y[-1])
+    return s

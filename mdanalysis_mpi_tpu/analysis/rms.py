@@ -58,6 +58,40 @@ def _rmsd_kernel(params, batch, boxes, mask):
     return (vals * mask, mask)
 
 
+def _rmsd_groups_kernel(params, batch, boxes, mask):
+    """Main-selection superposed RMSD + per-group RMSDs (upstream
+    ``RMSD(groupselections=[...])``): the rotation fitted on the MAIN
+    selection is applied to every group (no per-group fitting), each
+    group compared to its reference coords about the main reference
+    COM.  batch is the staged UNION; slots gather main/groups.  Groups
+    are padded to a common width with 0/1 weights."""
+    import jax.numpy as jnp
+
+    from mdanalysis_mpi_tpu.ops.align import (kabsch_rotation_batch,
+                                              weighted_center)
+
+    del boxes
+    (main_slots, masses, rot_w, rmsd_w, ref_main_c, group_slots,
+     group_w, ref_groups_c) = params
+    x_main = batch[:, main_slots]
+    # ONE weighted COM + Kabsch solve serves both the main RMSD and the
+    # group transforms (rmsd_batch would redo the same SVD internally)
+    com = weighted_center(x_main, masses)                 # (B, 3)
+    main_c = x_main - com[:, None]
+    r = kabsch_rotation_batch(main_c, ref_main_c, rot_w)
+    aligned = jnp.einsum("bni,bij->bnj", main_c, r)
+    w = rmsd_w / rmsd_w.sum()
+    d2m = ((aligned - ref_main_c[None]) ** 2).sum(-1)
+    vals = jnp.sqrt(d2m @ w)
+    xg = batch[:, group_slots.reshape(-1)].reshape(
+        (batch.shape[0],) + group_slots.shape + (3,))     # (B, K, G, 3)
+    xg_c = jnp.einsum("bkgi,bij->bkgj", xg - com[:, None, None, :], r)
+    d2 = ((xg_c - ref_groups_c[None]) ** 2).sum(-1)       # (B, K, G)
+    wsum = group_w.sum(axis=1)                            # (K,)
+    gvals = jnp.sqrt((d2 * group_w[None]).sum(-1) / wsum[None])
+    return (vals * mask, gvals * mask[:, None], mask)
+
+
 def _rmsd_nofit_kernel(params, batch, boxes, mask):
     """Per-frame RMSD without superposition."""
     del boxes
@@ -158,11 +192,20 @@ class RMSD(AnalysisBase):
     rotation+translation first (the reference's qcprot machinery,
     RMSF.py:43-51, as used by BASELINE config 3); ``weights="mass"``
     mass-weights both the fit and the RMSD.
+
+    ``groupselections=[sel, ...]`` (upstream): each extra selection's
+    unweighted RMSD is computed per frame in the MAIN selection's
+    fitted frame (no per-group fitting — the domain-motion recipe) →
+    ``results.group_rmsd`` (n_frames, K).  Upstream packs these as
+    extra columns of ``results.rmsd``; here the main series stays
+    (n_frames,) and the groups get their own key (documented
+    divergence, PARITY.md).
     """
 
     def __init__(self, mobile, reference=None, select: str = "all",
                  ref_frame: int = 0, superposition: bool = True,
-                 weights: str | None = None, verbose: bool = False):
+                 weights: str | None = None, groupselections=None,
+                 verbose: bool = False):
         universe = mobile.universe if isinstance(mobile, AtomGroup) else mobile
         super().__init__(universe, verbose)
         self._mobile = mobile
@@ -173,6 +216,12 @@ class RMSD(AnalysisBase):
         if weights not in (None, "mass"):
             raise ValueError(f"weights must be None or 'mass', got {weights!r}")
         self._weights_mode = weights
+        self._groupselections = (list(groupselections)
+                                 if groupselections else None)
+        if self._groupselections and not superposition:
+            raise ValueError(
+                "groupselections need superposition=True (their RMSD "
+                "is defined in the main selection's fitted frame)")
 
     def _prepare(self):
         if isinstance(self._mobile, AtomGroup):
@@ -191,6 +240,49 @@ class RMSD(AnalysisBase):
         self._ref_sel_c, self._ref_com = _reference_sel_coords(
             self._reference, self._idx, self._masses, self._ref_frame)
         self._serial_vals: list[float] = []
+        if self._groupselections:
+            gids = []
+            for gsel in self._groupselections:
+                g = self._universe.select_atoms(gsel)
+                if g.n_atoms == 0:
+                    raise ValueError(
+                        f"groupselection {gsel!r} matched no atoms")
+                gids.append(g.indices)
+            # groups padded to a common width with 0/1 weights (static
+            # shapes for the batch kernel)
+            gmax = max(len(g) for g in gids)
+            k = len(gids)
+            self._gslots_global = np.zeros((k, gmax), np.int64)
+            self._gw = np.zeros((k, gmax), np.float64)
+            for j, g in enumerate(gids):
+                self._gslots_global[j, :len(g)] = g
+                self._gw[j, :len(g)] = 1.0
+            # reference group coords about the main-selection ref COM;
+            # the reference cursor is SAVED and RESTORED (the upstream
+            # try/finally contract _reference_sel_coords also keeps,
+            # RMSF.py:80-87) so a user iterating the reference universe
+            # is not silently rewound
+            ref_traj = self._reference.trajectory
+            prev = ref_traj.ts.frame
+            try:
+                rp = ref_traj[self._ref_frame].positions.astype(
+                    np.float64)
+            finally:
+                ref_traj[prev]
+            self._ref_groups_c = np.stack(
+                [rp[self._gslots_global[j]] - self._ref_com
+                 for j in range(k)])
+            self._serial_gvals: list[np.ndarray] = []
+            # stage the union; slot maps for main + groups
+            union = np.unique(np.concatenate(
+                [self._idx] + [self._gslots_global.ravel()]))
+            self._union = union
+            # np.unique returns the union sorted → searchsorted IS the
+            # global-index → slot map, fully vectorized
+            self._main_slots = np.searchsorted(
+                union, self._idx).astype(np.int32)
+            self._gslots = np.searchsorted(
+                union, self._gslots_global).astype(np.int32)
 
     # -- serial path --
 
@@ -198,6 +290,7 @@ class RMSD(AnalysisBase):
         sel = ts.positions[self._idx].astype(np.float64)
         com = host.weighted_center(sel, self._masses)
         sel_c = sel - com
+        r = None
         if self._superposition:
             rot_w = self._masses if self._weights_mode == "mass" else None
             r = host.qcp_rotation(sel_c, self._ref_sel_c, rot_w)
@@ -205,17 +298,32 @@ class RMSD(AnalysisBase):
         w = self._rmsd_w / self._rmsd_w.sum()
         d2 = ((sel_c - self._ref_sel_c) ** 2).sum(axis=1)
         self._serial_vals.append(float(np.sqrt(d2 @ w)))
+        if self._groupselections:
+            pos = ts.positions.astype(np.float64)
+            gv = np.empty(len(self._gslots_global))
+            for j in range(len(gv)):
+                xg = (pos[self._gslots_global[j]] - com) @ r
+                diff2 = ((xg - self._ref_groups_c[j]) ** 2).sum(-1)
+                wj = self._gw[j]
+                gv[j] = np.sqrt((diff2 * wj).sum() / wj.sum())
+            self._serial_gvals.append(gv)
 
     def _serial_summary(self):
         vals = np.asarray(self._serial_vals)
+        if self._groupselections:
+            g = (np.stack(self._serial_gvals) if self._serial_gvals
+                 else np.empty((0, len(self._gslots_global))))
+            return (vals, g, np.ones(len(vals)))
         return (vals, np.ones(len(vals)))
 
     # -- batch path --
 
     def _batch_select(self):
-        return self._idx
+        return self._union if self._groupselections else self._idx
 
     def _batch_fn(self):
+        if self._groupselections:
+            return _rmsd_groups_kernel
         return _rmsd_kernel if self._superposition else _rmsd_nofit_kernel
 
     def _batch_params(self):
@@ -223,6 +331,13 @@ class RMSD(AnalysisBase):
 
         masses = jnp.asarray(self._masses, jnp.float32)
         rot_w = masses if self._weights_mode == "mass" else None
+        if self._groupselections:
+            return (jnp.asarray(self._main_slots), masses, rot_w,
+                    jnp.asarray(self._rmsd_w, jnp.float32),
+                    jnp.asarray(self._ref_sel_c, jnp.float32),
+                    jnp.asarray(self._gslots),
+                    jnp.asarray(self._gw, jnp.float32),
+                    jnp.asarray(self._ref_groups_c, jnp.float32))
         return (masses, rot_w,
                 jnp.asarray(self._rmsd_w, jnp.float32),
                 jnp.asarray(self._ref_sel_c, jnp.float32))
@@ -232,17 +347,32 @@ class RMSD(AnalysisBase):
     _device_combine = None
 
     def _identity_partials(self):
+        if self._groupselections:
+            return (np.empty(0),
+                    np.empty((0, len(self._gslots_global))), np.empty(0))
         return (np.empty(0), np.empty(0))
 
     def _conclude(self, total):
+        from mdanalysis_mpi_tpu.analysis.base import Deferred
+
+        if self._groupselections:
+            vals, gvals, mask = total
+
+            def _finalize_main():
+                return np.asarray(vals)[np.asarray(mask) > 0.5]
+
+            def _finalize_groups():
+                return np.asarray(gvals)[np.asarray(mask) > 0.5]
+
+            self.results.rmsd = Deferred(_finalize_main)
+            self.results.group_rmsd = Deferred(_finalize_groups)
+            return
         vals, mask = total
 
         def _finalize():
             # mask filtering is dynamic-shape → host-side, deferred so
             # run() stays readback-free (base.Deferred rationale)
             return np.asarray(vals)[np.asarray(mask) > 0.5]
-
-        from mdanalysis_mpi_tpu.analysis.base import Deferred
 
         self.results.rmsd = Deferred(_finalize)
 

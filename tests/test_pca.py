@@ -128,3 +128,26 @@ class TestPCA:
         assert p.results.p_components.shape[1] == 3
         assert len(p.results.variance) == 3
         assert p.results.cumulated_variance[-1] <= 1.0 + 1e-9
+
+
+def test_cosine_content():
+    """Analytic: a pure cosine projection has content ~1; white noise
+    ~0; validation errors are loud."""
+    from mdanalysis_mpi_tpu.analysis import cosine_content
+
+    t = np.arange(500)
+    p = np.stack([np.cos(np.pi * 1 * t / 500),
+                  np.cos(np.pi * 2 * t / 500)], axis=1)
+    assert cosine_content(p, 0) == pytest.approx(1.0, abs=1e-2)
+    assert cosine_content(p, 1) == pytest.approx(1.0, abs=1e-2)
+    # the WRONG mode index scores low (orthogonal cosines)
+    swapped = p[:, ::-1]
+    assert cosine_content(swapped, 0) < 0.05
+    rng = np.random.default_rng(0)
+    noise = rng.normal(size=(2000, 1))
+    assert cosine_content(noise, 0) < 0.1
+    with pytest.raises(IndexError):
+        cosine_content(p, 5)
+    with pytest.raises(ValueError, match="n_components"):
+        cosine_content(np.zeros(5), 0)
+    assert cosine_content(np.zeros((4, 1)), 0) == 0.0
