@@ -35,7 +35,7 @@ from mdanalysis_mpi_tpu.analysis.vacf import VelocityAutocorr
 from mdanalysis_mpi_tpu.analysis.lineardensity import LinearDensity
 from mdanalysis_mpi_tpu.analysis.gnm import GNMAnalysis
 from mdanalysis_mpi_tpu.analysis.waterdynamics import (
-    AngularDistribution, SurvivalProbability,
+    AngularDistribution, MeanSquareDisplacement, SurvivalProbability,
     WaterOrientationalRelaxation,
 )
 from mdanalysis_mpi_tpu.analysis.dielectric import DielectricConstant
@@ -48,6 +48,7 @@ from mdanalysis_mpi_tpu.analysis.dihedrals import Janin
 from mdanalysis_mpi_tpu.analysis.dssp import DSSP
 from mdanalysis_mpi_tpu.analysis.encore import hes
 from mdanalysis_mpi_tpu.analysis.pca import cosine_content
+from mdanalysis_mpi_tpu.analysis.align import sequence_alignment
 from mdanalysis_mpi_tpu.analysis.atomicdistances import AtomicDistances
 from mdanalysis_mpi_tpu.analysis.leaflet import (LeafletFinder,
                                                  optimize_cutoff)
@@ -67,4 +68,5 @@ __all__ = ["AnalysisBase", "Results", "AnalysisFromFunction",
            "WaterOrientationalRelaxation", "AngularDistribution",
            "PSAnalysis", "hausdorff", "discrete_frechet",
            "PersistenceLength", "HELANAL", "helix_analysis", "BAT", "DSSP", "hes", "NucPairDist", "WatsonCrickDist", "AtomicDistances",
-           "LeafletFinder", "optimize_cutoff", "cosine_content"]
+           "LeafletFinder", "optimize_cutoff", "cosine_content",
+           "MeanSquareDisplacement", "sequence_alignment"]

@@ -461,3 +461,98 @@ def alignto(mobile, reference, select: str = "all",
         ts.positions, ag.indices, wv, ref_c, ref_com,
         rot_weights=w).astype(np.float32)
     return old, new
+
+
+#: three-letter → one-letter residue codes (sequence_alignment)
+_AA_CODES = {
+    "ALA": "A", "ARG": "R", "ASN": "N", "ASP": "D", "CYS": "C",
+    "GLN": "Q", "GLU": "E", "GLY": "G", "HIS": "H", "ILE": "I",
+    "LEU": "L", "LYS": "K", "MET": "M", "PHE": "F", "PRO": "P",
+    "SER": "S", "THR": "T", "TRP": "W", "TYR": "Y", "VAL": "V",
+    "HSD": "H", "HSE": "H", "HSP": "H", "HID": "H", "HIE": "H",
+    "HIP": "H", "CYX": "C", "CYM": "C", "MSE": "M",
+}
+
+
+def _residue_letters(ag) -> tuple:
+    """(one-letter string, per-residue resindices) for a protein group."""
+    t = ag.universe.topology
+    res = t.resindices[ag.indices]
+    _, first = np.unique(res, return_index=True)
+    order = np.sort(first)
+    rows = res[order]
+    letters = []
+    for a in ag.indices[order]:
+        rn = str(t.resnames[a]).upper()
+        letters.append(_AA_CODES.get(rn, "X"))
+    return "".join(letters), rows
+
+
+def sequence_alignment(mobile, reference, match: float = 2.0,
+                       mismatch: float = -1.0, gap_open: float = -2.0,
+                       gap_extend: float = -0.1):
+    """Global (Needleman–Wunsch, AFFINE gaps — Gotoh) alignment of two
+    groups' residue sequences (upstream ``align.sequence_alignment``,
+    reimplemented without Biopython; upstream's default scoring:
+    match 2, mismatch −1, gap open −2, gap extend −0.1 — affine, so a
+    multi-residue indel costs one opening, not one penalty per
+    residue).  Returns ``(seq_mobile, seq_reference, pairs)`` — the
+    two gapped sequences and the (K, 2) array of ALIGNED residue index
+    pairs ``[mobile_resindex, reference_resindex]`` (matched columns
+    only), the input ``align.fasta2select``-style workflows need to
+    fit structures with differing sequences.
+    """
+    s1, r1 = _residue_letters(mobile)
+    s2, r2 = _residue_letters(reference)
+    if not s1 or not s2:
+        raise ValueError("both groups need at least one residue")
+    n, m = len(s1), len(s2)
+    neg = -1e18
+    M = np.full((n + 1, m + 1), neg)
+    X = np.full((n + 1, m + 1), neg)   # gap in reference (consumes s1)
+    Y = np.full((n + 1, m + 1), neg)   # gap in mobile (consumes s2)
+    M[0, 0] = 0.0
+    for i in range(1, n + 1):
+        X[i, 0] = gap_open + (i - 1) * gap_extend
+    for j in range(1, m + 1):
+        Y[0, j] = gap_open + (j - 1) * gap_extend
+    s2b = np.frombuffer(s2.encode(), np.uint8)
+    for i in range(1, n + 1):
+        sub = np.where(s2b == ord(s1[i - 1]), match, mismatch)
+        for j in range(1, m + 1):
+            best_prev = max(M[i - 1, j - 1], X[i - 1, j - 1],
+                            Y[i - 1, j - 1])
+            M[i, j] = best_prev + sub[j - 1]
+            X[i, j] = max(M[i - 1, j] + gap_open,
+                          X[i - 1, j] + gap_extend)
+            Y[i, j] = max(M[i, j - 1] + gap_open,
+                          Y[i, j - 1] + gap_extend)
+    # traceback from the best terminal state
+    a1, a2, pairs = [], [], []
+    i, j = n, m
+    state = int(np.argmax([M[n, m], X[n, m], Y[n, m]]))
+    while i > 0 or j > 0:
+        if state == 0 and i > 0 and j > 0:
+            a1.append(s1[i - 1])
+            a2.append(s2[j - 1])
+            pairs.append((int(r1[i - 1]), int(r2[j - 1])))
+            i -= 1
+            j -= 1
+            state = int(np.argmax([M[i, j], X[i, j], Y[i, j]]))
+        elif state == 1 and i > 0:
+            a1.append(s1[i - 1])
+            a2.append("-")
+            # did this gap OPEN here (came from M) or extend?
+            state = (0 if np.isclose(X[i, j], M[i - 1, j] + gap_open)
+                     else 1)
+            i -= 1
+        elif state == 2 and j > 0:
+            a1.append("-")
+            a2.append(s2[j - 1])
+            state = (0 if np.isclose(Y[i, j], M[i, j - 1] + gap_open)
+                     else 2)
+            j -= 1
+        else:                     # boundary: only one direction remains
+            state = 1 if i > 0 else 2
+    return ("".join(reversed(a1)), "".join(reversed(a2)),
+            np.asarray(list(reversed(pairs)), np.int64).reshape(-1, 2))

@@ -386,3 +386,37 @@ class AngularDistribution(_WaterVectorAnalysis):
         g = self._vector_group
         for key in ("bins", "OH", "HH", "dip"):
             self.results[key] = g[key]
+
+
+class MeanSquareDisplacement:
+    """Upstream ``waterdynamics.MeanSquareDisplacement`` spelling: a
+    thin front over :class:`~mdanalysis_mpi_tpu.analysis.EinsteinMSD`
+    (the modern module with the FFT lag algebra), kept so ported
+    waterdynamics scripts find the name AND calling convention —
+    upstream's positional ``(universe, select, t0, tf, dtmax)`` window
+    translates to ``run(start=t0, stop=tf)``; EinsteinMSD computes the
+    FULL lag series, so ``dtmax`` just truncates
+    ``results.timeseries``.  ``run()`` returns self;
+    ``results.timeseries`` etc. as EinsteinMSD."""
+
+    def __init__(self, universe, select: str = "name OW",
+                 t0: int | None = None, tf: int | None = None,
+                 dtmax: int | None = None, verbose: bool = False):
+        from mdanalysis_mpi_tpu.analysis.msd import EinsteinMSD
+
+        self._inner = EinsteinMSD(universe, select=select,
+                                  verbose=verbose)
+        self._window = (t0, tf)
+        self._dtmax = dtmax
+
+    def run(self, *args, **kwargs):
+        if not args and "start" not in kwargs and "stop" not in kwargs:
+            t0, tf = self._window
+            kwargs.setdefault("start", t0)
+            kwargs.setdefault("stop", tf)
+        self._inner.run(*args, **kwargs)
+        self.results = self._inner.results
+        if self._dtmax is not None:
+            self.results.timeseries = np.asarray(
+                self.results.timeseries)[:self._dtmax + 1]
+        return self

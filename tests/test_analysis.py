@@ -633,3 +633,85 @@ class TestRMSDGroupselections:
         with pytest.raises(ValueError, match="matched no atoms"):
             RMSD(u, select="name CA",
                  groupselections=["name ZZ"]).run(backend="serial")
+
+
+def test_sequence_alignment():
+    """Needleman-Wunsch over residue sequences: identical sequences map
+    1:1; an insertion opens a gap; pairs carry resindices."""
+    from mdanalysis_mpi_tpu.analysis import sequence_alignment
+    from mdanalysis_mpi_tpu.core.topology import Topology
+    from mdanalysis_mpi_tpu.core.universe import Universe
+    from mdanalysis_mpi_tpu.io.memory import MemoryReader
+
+    def chain(resnames):
+        n = len(resnames)
+        top = Topology(names=np.full(n, "CA"),
+                       resnames=np.array(resnames),
+                       resids=np.arange(1, n + 1))
+        return Universe(top, MemoryReader(np.zeros((1, n, 3),
+                                                   np.float32)))
+
+    a = chain(["ALA", "GLY", "LYS", "TRP"])
+    b = chain(["ALA", "GLY", "LYS", "TRP"])
+    s1, s2, pairs = sequence_alignment(a.atoms, b.atoms)
+    assert s1 == s2 == "AGKW"
+    np.testing.assert_array_equal(pairs,
+                                  np.stack([np.arange(4)] * 2, axis=1))
+    # an inserted residue in one chain opens a gap, others still pair
+    c = chain(["ALA", "GLY", "PHE", "LYS", "TRP"])
+    s1, s2, pairs = sequence_alignment(c.atoms, b.atoms)
+    assert s1 == "AGFKW" and s2 == "AG-KW"
+    assert len(pairs) == 4                       # A, G, K, W columns
+    np.testing.assert_array_equal(pairs[:, 1], [0, 1, 2, 3])
+    np.testing.assert_array_equal(pairs[:, 0], [0, 1, 3, 4])
+    with pytest.raises(ValueError, match="residue"):
+        sequence_alignment(a.atoms[[]], b.atoms)
+
+
+def test_waterdynamics_msd_alias():
+    from mdanalysis_mpi_tpu.analysis import (EinsteinMSD,
+                                             MeanSquareDisplacement)
+    from mdanalysis_mpi_tpu.testing import make_water_universe
+
+    u = make_water_universe(n_waters=20, n_frames=8, seed=2)
+    a = MeanSquareDisplacement(u, select="name OW").run(backend="serial")
+    b = EinsteinMSD(u, select="name OW").run(backend="serial")
+    np.testing.assert_allclose(a.results.timeseries,
+                               b.results.timeseries, atol=1e-10)
+
+
+def test_sequence_alignment_affine_gap():
+    """A multi-residue indel must open ONE affine gap (upstream's
+    open -2 / extend -0.1), not pay per-residue linear penalties that
+    a mismatch-heavy diagonal would outscore."""
+    from mdanalysis_mpi_tpu.analysis import sequence_alignment
+    from mdanalysis_mpi_tpu.core.topology import Topology
+    from mdanalysis_mpi_tpu.core.universe import Universe
+    from mdanalysis_mpi_tpu.io.memory import MemoryReader
+
+    def chain(resnames):
+        n = len(resnames)
+        top = Topology(names=np.full(n, "CA"),
+                       resnames=np.array(resnames),
+                       resids=np.arange(1, n + 1))
+        return Universe(top, MemoryReader(np.zeros((1, n, 3),
+                                                   np.float32)))
+
+    # reference AGKW; mobile has a 3-residue loop inserted after G
+    a = chain(["ALA", "GLY", "PHE", "PHE", "PHE", "LYS", "TRP"])
+    b = chain(["ALA", "GLY", "LYS", "TRP"])
+    s1, s2, pairs = sequence_alignment(a.atoms, b.atoms)
+    assert s1 == "AGFFFKW" and s2 == "AG---KW"
+    np.testing.assert_array_equal(pairs[:, 0], [0, 1, 5, 6])
+    np.testing.assert_array_equal(pairs[:, 1], [0, 1, 2, 3])
+
+
+def test_waterdynamics_msd_upstream_signature():
+    from mdanalysis_mpi_tpu.analysis import MeanSquareDisplacement
+    from mdanalysis_mpi_tpu.testing import make_water_universe
+
+    u = make_water_universe(n_waters=15, n_frames=10, seed=3)
+    # upstream positional window (t0, tf, dtmax)
+    m = MeanSquareDisplacement(u, "name OW", 2, 8, 3).run(
+        backend="serial")
+    assert len(m.results.timeseries) == 4        # dtmax truncation
