@@ -491,16 +491,23 @@ def _residue_letters(ag) -> tuple:
 def sequence_alignment(mobile, reference, match: float = 2.0,
                        mismatch: float = -1.0, gap_open: float = -2.0,
                        gap_extend: float = -0.1):
-    """Global (Needleman–Wunsch, AFFINE gaps — Gotoh) alignment of two
-    groups' residue sequences (upstream ``align.sequence_alignment``,
+    """Global (Needleman–Wunsch, AFFINE gaps — full Gotoh, including
+    the cross-gap X↔Y transitions so adjacent insertion+deletion paths
+    are representable under any scoring) alignment of two groups'
+    residue sequences (upstream ``align.sequence_alignment``,
     reimplemented without Biopython; upstream's default scoring:
-    match 2, mismatch −1, gap open −2, gap extend −0.1 — affine, so a
-    multi-residue indel costs one opening, not one penalty per
-    residue).  Returns ``(seq_mobile, seq_reference, pairs)`` — the
-    two gapped sequences and the (K, 2) array of ALIGNED residue index
-    pairs ``[mobile_resindex, reference_resindex]`` (matched columns
-    only), the input ``align.fasta2select``-style workflows need to
-    fit structures with differing sequences.
+    match 2, mismatch −1, gap open −2, gap extend −0.1).  Returns
+    ``(seq_mobile, seq_reference, pairs)`` — the two gapped sequences
+    and the (K, 2) array of ALIGNED residue index pairs
+    ``[mobile_resindex, reference_resindex]`` (matched columns only),
+    the input ``align.fasta2select``-style workflows need to fit
+    structures with differing sequences.
+
+    The DP runs one vectorized row at a time (the in-row Y recurrence
+    is a prefix max), and the traceback re-derives each step with
+    EXACT float comparisons against the forward pass's own arithmetic
+    — no relative tolerances that could misread open-vs-extend on long
+    high-scoring chains.
     """
     s1, r1 = _residue_letters(mobile)
     s2, r2 = _residue_letters(reference)
@@ -512,22 +519,29 @@ def sequence_alignment(mobile, reference, match: float = 2.0,
     X = np.full((n + 1, m + 1), neg)   # gap in reference (consumes s1)
     Y = np.full((n + 1, m + 1), neg)   # gap in mobile (consumes s2)
     M[0, 0] = 0.0
-    for i in range(1, n + 1):
-        X[i, 0] = gap_open + (i - 1) * gap_extend
-    for j in range(1, m + 1):
-        Y[0, j] = gap_open + (j - 1) * gap_extend
+    X[1:, 0] = gap_open + np.arange(n) * gap_extend
+    Y[0, 1:] = gap_open + np.arange(m) * gap_extend
     s2b = np.frombuffer(s2.encode(), np.uint8)
     for i in range(1, n + 1):
         sub = np.where(s2b == ord(s1[i - 1]), match, mismatch)
-        for j in range(1, m + 1):
-            best_prev = max(M[i - 1, j - 1], X[i - 1, j - 1],
-                            Y[i - 1, j - 1])
-            M[i, j] = best_prev + sub[j - 1]
-            X[i, j] = max(M[i - 1, j] + gap_open,
-                          X[i - 1, j] + gap_extend)
-            Y[i, j] = max(M[i, j - 1] + gap_open,
-                          Y[i, j - 1] + gap_extend)
-    # traceback from the best terminal state
+        prev_best = np.maximum(np.maximum(M[i - 1], X[i - 1]), Y[i - 1])
+        M[i, 1:] = prev_best[:-1] + sub
+        X[i] = np.maximum(np.maximum(M[i - 1], Y[i - 1]) + gap_open,
+                          X[i - 1] + gap_extend)
+        X[i, 0] = gap_open + (i - 1) * gap_extend
+        # Y's in-row recurrence Y[j] = max(base[j-1]+open, Y[j-1]+ext)
+        # as a prefix max of (candidate - j*ext)
+        base = np.maximum(M[i], X[i]) + gap_open
+        cand = np.full(m + 1, neg)
+        cand[1:] = base[:-1] - np.arange(1, m + 1) * gap_extend
+        cand[0] = Y[i, 0]
+        Y[i] = np.maximum.accumulate(cand) \
+            + np.arange(m + 1) * gap_extend
+    # traceback — every comparison recomputes the forward pass's exact
+    # float expression, so abs-1e-9 equality is bit-safe
+    def _eq(a, b):
+        return abs(a - b) <= 1e-9
+
     a1, a2, pairs = [], [], []
     i, j = n, m
     state = int(np.argmax([M[n, m], X[n, m], Y[n, m]]))
@@ -542,15 +556,22 @@ def sequence_alignment(mobile, reference, match: float = 2.0,
         elif state == 1 and i > 0:
             a1.append(s1[i - 1])
             a2.append("-")
-            # did this gap OPEN here (came from M) or extend?
-            state = (0 if np.isclose(X[i, j], M[i - 1, j] + gap_open)
-                     else 1)
+            if _eq(X[i, j], M[i - 1, j] + gap_open):
+                state = 0
+            elif _eq(X[i, j], Y[i - 1, j] + gap_open):
+                state = 2
+            else:
+                state = 1                       # extension
             i -= 1
         elif state == 2 and j > 0:
             a1.append("-")
             a2.append(s2[j - 1])
-            state = (0 if np.isclose(Y[i, j], M[i, j - 1] + gap_open)
-                     else 2)
+            if _eq(Y[i, j], M[i, j - 1] + gap_open):
+                state = 0
+            elif _eq(Y[i, j], X[i, j - 1] + gap_open):
+                state = 1
+            else:
+                state = 2
             j -= 1
         else:                     # boundary: only one direction remains
             state = 1 if i > 0 else 2

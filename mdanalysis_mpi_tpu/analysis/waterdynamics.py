@@ -410,13 +410,22 @@ class MeanSquareDisplacement:
         self._dtmax = dtmax
 
     def run(self, *args, **kwargs):
-        if not args and "start" not in kwargs and "stop" not in kwargs:
+        if not args:
+            # each window bound defaults INDEPENDENTLY: overriding only
+            # start must not silently drop the constructor's tf
             t0, tf = self._window
-            kwargs.setdefault("start", t0)
-            kwargs.setdefault("stop", tf)
+            if t0 is not None:
+                kwargs.setdefault("start", t0)
+            if tf is not None:
+                kwargs.setdefault("stop", tf)
         self._inner.run(*args, **kwargs)
         self.results = self._inner.results
         if self._dtmax is not None:
+            # BOTH lag-indexed results truncate together — a mixed
+            # lag length between timeseries and msds_by_particle would
+            # break the documented pairing (analysis/msd.py)
             self.results.timeseries = np.asarray(
                 self.results.timeseries)[:self._dtmax + 1]
+            self.results.msds_by_particle = np.asarray(
+                self.results.msds_by_particle)[:self._dtmax + 1]
         return self

@@ -4,6 +4,8 @@ surface the reference's capability envelope touches (SURVEY.md §2.2:
 :mod:`mdanalysis_mpi_tpu.ops.align`/:mod:`~mdanalysis_mpi_tpu.ops.host`)."""
 
 from mdanalysis_mpi_tpu.lib import (correlations, distances, mdamath,
-                                    transformations)
+                                    neighborsearch, transformations)
+from mdanalysis_mpi_tpu.lib.neighborsearch import AtomNeighborSearch
 
-__all__ = ["correlations", "distances", "mdamath", "transformations"]
+__all__ = ["correlations", "distances", "mdamath", "neighborsearch",
+           "transformations", "AtomNeighborSearch"]

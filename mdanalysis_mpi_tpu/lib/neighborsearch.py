@@ -1,0 +1,53 @@
+"""Neighbor search over AtomGroups (upstream
+``MDAnalysis.lib.NeighborSearch.AtomNeighborSearch``).
+
+A thin object front over the blockwise capped-distance kernel
+(``lib.distances.capped_distance`` — no N×M materialization): build
+once over a (static) group, query with any coordinates or group, get
+the matching atoms back at atom / residue / segment granularity.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class AtomNeighborSearch:
+    """``AtomNeighborSearch(ag, box=None).search(other, radius,
+    level='A'|'R'|'S')`` → AtomGroup / ResidueGroup / SegmentGroup of
+    the atoms of ``ag`` within ``radius`` of ``other`` (an AtomGroup or
+    (M, 3) coordinates)."""
+
+    def __init__(self, atomgroup, box=None):
+        from mdanalysis_mpi_tpu.analysis.base import reject_updating_groups
+
+        reject_updating_groups(atomgroup, owner="AtomNeighborSearch")
+        if atomgroup.n_atoms == 0:
+            raise ValueError("cannot search an empty AtomGroup")
+        self._ag = atomgroup
+        self._box = box
+
+    def search(self, other, radius: float, level: str = "A"):
+        from mdanalysis_mpi_tpu.lib.distances import capped_distance
+
+        if radius <= 0:
+            raise ValueError(f"radius must be positive, got {radius}")
+        coords = (other.positions if hasattr(other, "positions")
+                  else np.asarray(other, np.float64).reshape(-1, 3))
+        pairs = capped_distance(self._ag.positions, coords, radius,
+                                box=self._box, return_distances=False)
+        hits = np.unique(pairs[:, 0]) if len(pairs) else np.empty(
+            0, np.int64)
+        ag = self._ag[hits] if len(hits) else self._ag[[]]
+        if level == "A":
+            return ag
+        if level == "R":
+            from mdanalysis_mpi_tpu.core.groups import ResidueGroup
+
+            return ResidueGroup(ag.universe, ag.resindices)
+        if level == "S":
+            from mdanalysis_mpi_tpu.core.groups import SegmentGroup
+
+            return SegmentGroup(ag.universe, ag.segids)
+        raise ValueError(
+            f"level must be 'A', 'R' or 'S', got {level!r}")

@@ -715,3 +715,41 @@ def test_waterdynamics_msd_upstream_signature():
     m = MeanSquareDisplacement(u, "name OW", 2, 8, 3).run(
         backend="serial")
     assert len(m.results.timeseries) == 4        # dtmax truncation
+
+
+def test_sequence_alignment_cross_gap_scoring():
+    """Full Gotoh: with mismatch far costlier than two adjacent gaps,
+    the X<->Y transition path (insertion next to deletion) must win."""
+    from mdanalysis_mpi_tpu.analysis import sequence_alignment
+    from mdanalysis_mpi_tpu.core.topology import Topology
+    from mdanalysis_mpi_tpu.core.universe import Universe
+    from mdanalysis_mpi_tpu.io.memory import MemoryReader
+
+    def chain(resnames):
+        n = len(resnames)
+        top = Topology(names=np.full(n, "CA"),
+                       resnames=np.array(resnames),
+                       resids=np.arange(1, n + 1))
+        return Universe(top, MemoryReader(np.zeros((1, n, 3),
+                                                   np.float32)))
+
+    a = chain(["ALA", "TRP"])
+    b = chain(["ALA", "VAL"])
+    s1, s2, pairs = sequence_alignment(
+        a.atoms, b.atoms, mismatch=-10.0, gap_open=-1.0,
+        gap_extend=-0.1)
+    # W and V must NOT pair; each sits against a gap
+    assert "-" in s1 and "-" in s2
+    assert len(pairs) == 1 and tuple(pairs[0]) == (0, 0)
+
+
+def test_msd_shim_partial_window_and_particles():
+    from mdanalysis_mpi_tpu.analysis import MeanSquareDisplacement
+    from mdanalysis_mpi_tpu.testing import make_water_universe
+
+    u = make_water_universe(n_waters=12, n_frames=10, seed=4)
+    m = MeanSquareDisplacement(u, "name OW", 2, 8, 3)
+    # overriding only start keeps the constructor's stop=8 (6 frames)
+    m.run(start=0, backend="serial")
+    assert len(m.results.timeseries) == 4            # dtmax
+    assert m.results.msds_by_particle.shape[0] == 4  # truncated together

@@ -694,3 +694,37 @@ def test_wrap_refuses_partially_degenerate_box():
         u.atoms.wrap()
     with pytest.raises(ValueError, match="degenerate|volume"):
         u.atoms.pack_into_box()
+
+
+def test_atom_neighbor_search():
+    from mdanalysis_mpi_tpu.lib.neighborsearch import AtomNeighborSearch
+    from mdanalysis_mpi_tpu.testing import make_solvated_universe
+
+    u = make_solvated_universe(n_residues=5, n_waters=30, n_frames=2,
+                               seed=9)
+    waters = u.select_atoms("water")
+    protein = u.select_atoms("protein")
+    ns = AtomNeighborSearch(waters)
+    near = ns.search(protein, 4.0)
+    # cross-check against the selection DSL's around keyword
+    want = u.select_atoms("water and around 4.0 protein")
+    np.testing.assert_array_equal(np.sort(near.indices),
+                                  want.indices)
+    # residue / segment levels
+    res = ns.search(protein, 4.0, level="R")
+    assert set(res.resindices.tolist()) == set(
+        u.topology.resindices[want.indices].tolist())
+    segs = ns.search(protein, 4.0, level="S")
+    assert segs.n_segments >= 1
+    # raw coordinates work as the query; empty result is an empty group
+    far = ns.search(np.array([[500.0, 500.0, 500.0]]), 3.0)
+    assert far.n_atoms == 0
+    with pytest.raises(ValueError, match="radius"):
+        ns.search(protein, 0.0)
+    with pytest.raises(ValueError, match="level"):
+        ns.search(protein, 4.0, level="Q")
+    with pytest.raises(ValueError, match="empty"):
+        AtomNeighborSearch(u.select_atoms("name ZZ"))
+    uag = u.select_atoms("water", updating=True)
+    with pytest.raises(TypeError, match="UpdatingAtomGroup"):
+        AtomNeighborSearch(uag)
