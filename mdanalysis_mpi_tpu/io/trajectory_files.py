@@ -31,9 +31,10 @@ def open(path: str, n_atoms: int | None = None):
 def _autoload():
     if _READERS:
         return
-    # trr is pure NumPy: an ImportError from it is always a programming
-    # error and must surface, unlike the native-backed xtc/dcd modules
-    from mdanalysis_mpi_tpu.io import trr  # noqa: F401  (self-registers)
+    # trr/netcdf are pure NumPy: an ImportError from them is always a
+    # programming error and must surface, unlike the native-backed
+    # xtc/dcd modules
+    from mdanalysis_mpi_tpu.io import netcdf, trr  # noqa: F401  (self-register)
     try:
         from mdanalysis_mpi_tpu.io import xtc, dcd  # noqa: F401  (self-register)
     except ImportError:
