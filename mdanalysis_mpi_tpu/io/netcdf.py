@@ -202,8 +202,14 @@ class NCDFReader(ReaderBase):
         v = self._hdr.vars[var]
         self._file.seek(v["begin"] + i * self._hdr.recsize)
         raw = self._file.read(v["vsize"])
-        dt = v["dtype"]
-        return np.frombuffer(raw, dt).reshape(v["shape"][1:])
+        arr = np.frombuffer(raw, v["dtype"]).reshape(v["shape"][1:])
+        # the AMBER convention allows a scale_factor attribute on ANY
+        # variable (coordinates/velocities/cells/time) — apply it
+        # uniformly, as upstream does
+        sf = v["atts"].get("scale_factor")
+        if sf is not None:
+            arr = arr * np.asarray(sf).reshape(())[()]
+        return arr
 
     def _read_frame(self, i: int) -> Timestep:
         if not 0 <= i < self._nframes:
@@ -222,7 +228,14 @@ class NCDFReader(ReaderBase):
             if np.all(lengths > 0):
                 dims = np.concatenate([lengths, angles]).astype(
                     np.float32)
-        return Timestep(pos, frame=i, time=time, dimensions=dims)
+        vels = None
+        vvar = self._hdr.vars.get("velocities")
+        if vvar is not None and vvar["record"]:
+            # scale_factor (AMBER 20.455 Å/ps convention) applied in
+            # _rec_field like every variable's
+            vels = self._rec_field("velocities", i).astype(np.float32)
+        return Timestep(pos, frame=i, time=time, dimensions=dims,
+                        velocities=vels)
 
     def frame_times(self, frames) -> np.ndarray | None:
         if "time" not in self._hdr.vars:
@@ -232,10 +245,15 @@ class NCDFReader(ReaderBase):
 
 
 def write_ncdf(path: str, frames: np.ndarray, dimensions=None,
-               times=None, title: str = "mdanalysis_mpi_tpu") -> None:
+               times=None, velocities=None,
+               vel_scale_factor: float | None = None,
+               title: str = "mdanalysis_mpi_tpu") -> None:
     """Write (F, N, 3) Å coordinates as an AMBER-convention NetCDF-3
-    classic file (``frame`` unlimited; ``time`` ps; optional per-file
-    box as ``cell_lengths``/``cell_angles``)."""
+    classic file (``frame`` unlimited; ``time`` ps; optional box as
+    ``cell_lengths``/``cell_angles`` — one (6,) vector or per-frame
+    (F, 6); optional ``velocities`` (F, N, 3) in Å/ps, stored divided
+    by ``vel_scale_factor`` with the matching ``scale_factor``
+    attribute when given — the AMBER 20.455 convention)."""
     frames = np.asarray(frames, np.float32)
     if frames.ndim != 3 or frames.shape[2] != 3:
         raise ValueError(f"frames must be (F, N, 3), got {frames.shape}")
@@ -248,26 +266,45 @@ def write_ncdf(path: str, frames: np.ndarray, dimensions=None,
             f"{len(times)} times for {f_count} frames")
     has_box = dimensions is not None
     if has_box:
-        dimensions = np.asarray(dimensions, np.float64).reshape(6)
+        dimensions = np.asarray(dimensions, np.float64)
+        if dimensions.size == 6:
+            dimensions = np.broadcast_to(dimensions.reshape(6),
+                                         (f_count, 6))
+        elif dimensions.shape != (f_count, 6):
+            raise ValueError(
+                f"dimensions must be (6,) or (F, 6), got "
+                f"{dimensions.shape}")
+    has_vel = velocities is not None
+    if has_vel:
+        velocities = np.asarray(velocities, np.float32)
+        if velocities.shape != frames.shape:
+            raise ValueError(
+                f"velocities must match frames shape {frames.shape}, "
+                f"got {velocities.shape}")
+        if vel_scale_factor is not None:
+            velocities = velocities / np.float32(vel_scale_factor)
 
     def name(s: str) -> bytes:
         b = s.encode("ascii")
         return struct.pack(">i", len(b)) + b + b"\0" * (_pad4(len(b))
                                                         - len(b))
 
-    def char_att(k: str, v: str) -> bytes:
-        b = v.encode("ascii")
-        return (name(k) + struct.pack(">ii", 2, len(b)) + b
-                + b"\0" * (_pad4(len(b)) - len(b)))
+    def att(k: str, v) -> bytes:
+        if isinstance(v, str):
+            b = v.encode("ascii")
+            return (name(k) + struct.pack(">ii", 2, len(b)) + b
+                    + b"\0" * (_pad4(len(b)) - len(b)))
+        # numeric attributes are NC_FLOAT: the AMBER convention
+        # specifies scale_factor as type float
+        return (name(k) + struct.pack(">ii", 5, 1)
+                + struct.pack(">f", float(v)))
 
     def att_list(pairs) -> bytes:
         if not pairs:
             return struct.pack(">ii", 0, 0)
         return (struct.pack(">ii", _NC_ATTRIBUTE, len(pairs))
-                + b"".join(char_att(k, v) for k, v in pairs))
+                + b"".join(att(k, v) for k, v in pairs))
 
-    # dimensions: frame (unlimited), spatial=3, atom, cell_spatial=3,
-    # cell_angular=3 (AMBER convention order is free; ids are by index)
     dims = [("frame", 0), ("spatial", 3), ("atom", n_atoms)]
     if has_box:
         dims += [("cell_spatial", 3), ("cell_angular", 3)]
@@ -280,10 +317,16 @@ def write_ncdf(path: str, frames: np.ndarray, dimensions=None,
                       ("programVersion", "1.0"),
                       ("title", title)])
 
-    # record variables, in record order: time, coordinates[, cells]
+    # record variables, in record order
     specs = [("time", [0], 5, 4, [("units", "picosecond")])]
     specs.append(("coordinates", [0, 2, 1], 5, n_atoms * 12,
                   [("units", "angstrom")]))
+    if has_vel:
+        vel_atts = [("units", "angstrom/picosecond")]
+        if vel_scale_factor is not None:
+            vel_atts.append(("scale_factor", float(vel_scale_factor)))
+        specs.append(("velocities", [0, 2, 1], 5, n_atoms * 12,
+                      vel_atts))
     if has_box:
         specs.append(("cell_lengths", [0, 3], 6, 24,
                       [("units", "angstrom")]))
@@ -297,7 +340,6 @@ def write_ncdf(path: str, frames: np.ndarray, dimensions=None,
                 + att_list(atts)
                 + struct.pack(">iiI", xtype, vsize, begin))
 
-    # two passes: sizes first (begins depend on header length)
     def build(begins):
         var_block = (struct.pack(">ii", _NC_VARIABLE, len(specs))
                      + b"".join(var_header(nm, dimids, xt, vs, atts,
@@ -314,7 +356,6 @@ def write_ncdf(path: str, frames: np.ndarray, dimensions=None,
     for a in aligned:
         begins.append(off)
         off += a
-    recsize = sum(aligned)
     header = build(begins)
     assert len(header) == header_len
 
@@ -326,9 +367,11 @@ def write_ncdf(path: str, frames: np.ndarray, dimensions=None,
             rec += b"\0" * (aligned[0] - 4)
             coord = frames[i].astype(">f4").tobytes()
             rec += coord + b"\0" * (aligned[1] - len(coord))
+            if has_vel:
+                rec += velocities[i].astype(">f4").tobytes()
             if has_box:
-                rec += np.asarray(dimensions[:3], ">f8").tobytes()
-                rec += np.asarray(dimensions[3:], ">f8").tobytes()
+                rec += np.asarray(dimensions[i, :3], ">f8").tobytes()
+                rec += np.asarray(dimensions[i, 3:], ">f8").tobytes()
             out.write(bytes(rec))
 
 

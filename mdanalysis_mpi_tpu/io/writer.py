@@ -53,17 +53,21 @@ class TrajectoryWriter:
                  format: str | None = None, precision: float = 1000.0,
                  dt: float = 1.0):
         fmt = (format or os.path.splitext(path)[1].lstrip(".")).lower()
-        if fmt not in ("xtc", "trr", "dcd"):
+        if fmt == "nc":
+            fmt = "ncdf"
+        if fmt not in ("xtc", "trr", "dcd", "ncdf"):
             raise ValueError(
                 f"unsupported trajectory format {fmt!r} for {path!r} "
-                "(xtc, trr, dcd)")
+                "(xtc, trr, dcd, nc/ncdf)")
         self.path = path
         self.format = fmt
         self.n_atoms = n_atoms
         self.frames_written = 0
         self._precision = precision
         self._dt = dt
-        self._box_flag: bool | None = None   # DCD: cell blocks all-or-none
+        self._box_flag: bool | None = None   # DCD/NetCDF: cells all-or-none
+        self._vel_flag: bool | None = None   # NetCDF: velocities likewise
+        self._ncdf_strip: int | None = None  # later chunks' header length
         self._file = open(path, "wb")
         # per-instance temp name: two writers targeting the same output
         # path (or a crashed run's leftover) must not clobber each
@@ -137,26 +141,53 @@ class TrajectoryWriter:
             dimensions = np.broadcast_to(
                 np.asarray(dimensions, np.float32), (len(coords), 6))
         nf, na = coords.shape[:2]
+        if nf == 0:
+            # header-only chunks must not splice (a second chunk would
+            # then keep its own header and corrupt dcd/ncdf record data)
+            return self.frames_written
         if self.n_atoms is None:
             self.n_atoms = na
         elif na != self.n_atoms:
             raise ValueError(
                 f"frame has {na} atoms, writer opened for {self.n_atoms}")
         has_box = dimensions is not None
-        if self.format == "dcd":
-            if self._box_flag is None:
-                self._box_flag = has_box
-            elif self._box_flag != has_box:
-                raise ValueError(
-                    "DCD cannot mix frames with and without unit cells")
-        if (velocities is not None or forces is not None) \
-                and self.format != "trr":
+        # ALL refusals precede any state latching: a rejected write must
+        # not leave _box_flag/_vel_flag poisoned for the retry
+        if velocities is not None and self.format not in ("trr", "ncdf"):
             raise ValueError(
-                f"{self.format} cannot store velocities/forces (use trr)")
+                f"{self.format} cannot store velocities (use trr/ncdf)")
+        if forces is not None and self.format != "trr":
+            raise ValueError(
+                f"{self.format} cannot store forces (use trr)")
         if (times is not None or steps is not None) and self.format == "dcd":
             raise ValueError(
                 "dcd stores no per-frame times/steps (only a fixed dt in "
                 "the header — pass dt= to the writer instead)")
+        if steps is not None and self.format == "ncdf":
+            raise ValueError(
+                "ncdf stores no integer step variable (AMBER convention "
+                "keeps time only) — pass times= instead")
+        if self.format in ("dcd", "ncdf"):
+            # cell blocks are all-or-none: they change the fixed record
+            # structure (DCD block layout; NetCDF record stride)
+            if self._box_flag is not None and self._box_flag != has_box:
+                raise ValueError(
+                    f"{self.format} cannot mix frames with and without "
+                    "unit cells")
+        if self.format == "ncdf" and self._vel_flag is not None \
+                and self._vel_flag != (velocities is not None):
+            raise ValueError(
+                "ncdf cannot mix frames with and without velocities "
+                "(the record structure is fixed at the first chunk)")
+        if self.format in ("dcd", "ncdf") and self._box_flag is None:
+            self._box_flag = has_box
+        if self.format == "ncdf" and self._vel_flag is None:
+            self._vel_flag = velocities is not None
+        if velocities is not None:
+            velocities = np.asarray(velocities, np.float32)
+            if velocities.ndim == 2:
+                velocities = velocities[None]   # single-frame form,
+                #                                 like the coords coerce
         lo = self.frames_written
         if times is None:
             times = np.arange(lo, lo + nf, dtype=np.float32) * self._dt
@@ -183,6 +214,31 @@ class TrajectoryWriter:
                           steps=np.asarray(steps, np.int32),
                           velocities=velocities, forces=forces)
                 strip = 0
+            elif self.format == "ncdf":
+                from mdanalysis_mpi_tpu.io.netcdf import write_ncdf
+
+                write_ncdf(self._chunk_path, coords,
+                           dimensions=dimensions,
+                           times=np.asarray(times, np.float32),
+                           velocities=velocities)
+                if self.frames_written == 0:
+                    strip = 0
+                else:
+                    if self._ncdf_strip is None:   # pragma: no cover
+                        raise AssertionError("ncdf strip unset")
+                    strip = self._ncdf_strip
+                if self._ncdf_strip is None:
+                    # header length is constant across chunks (same
+                    # n_atoms/box/velocity structure — enforced above),
+                    # measured once from the first chunk
+                    from mdanalysis_mpi_tpu.io.netcdf import _NC3Header
+
+                    with open(self._chunk_path, "rb") as cf:
+                        hdr = _NC3Header(cf.read(65536),
+                                         self._chunk_path)
+                    self._ncdf_strip = min(
+                        v["begin"] for v in hdr.vars.values()
+                        if v["record"])
             else:
                 from mdanalysis_mpi_tpu.io.dcd import write_dcd
 
@@ -208,6 +264,12 @@ class TrajectoryWriter:
             return
         self._file.close()
         self._closed = True
+        if self.format == "ncdf" and self.frames_written:
+            # patch numrecs (bytes 4:8, big-endian) — each chunk's
+            # header recorded only its own frame count
+            with open(self.path, "r+b") as f:
+                f.seek(4)
+                f.write(struct.pack(">i", self.frames_written))
         if self.format == "dcd" and self.frames_written:
             # patch the two frame-count fields the first chunk's header
             # recorded for only its own frames
