@@ -55,10 +55,10 @@ class TrajectoryWriter:
         fmt = (format or os.path.splitext(path)[1].lstrip(".")).lower()
         if fmt == "nc":
             fmt = "ncdf"
-        if fmt not in ("xtc", "trr", "dcd", "ncdf"):
+        if fmt not in ("xtc", "trr", "dcd", "ncdf", "xyz"):
             raise ValueError(
                 f"unsupported trajectory format {fmt!r} for {path!r} "
-                "(xtc, trr, dcd, nc/ncdf)")
+                "(xtc, trr, dcd, nc/ncdf, xyz)")
         self.path = path
         self.format = fmt
         self.n_atoms = n_atoms
@@ -135,7 +135,14 @@ class TrajectoryWriter:
         if self._closed:
             raise ValueError(f"writer for {self.path!r} is closed")
         coords, auto_dims = self._coerce(obj)
-        if dimensions is None:
+        if dimensions is not None and self.format == "xyz":
+            # EXPLICIT dimensions are refused like every unstorable
+            # field; a Universe/AtomGroup's auto-dims stay droppable so
+            # W.write(u) keeps working for box-carrying sources
+            raise ValueError(
+                "xyz stores no unit cell; drop dimensions= (the text "
+                "format carries coordinates only)")
+        if dimensions is None and self.format != "xyz":
             dimensions = auto_dims
         elif np.ndim(dimensions) == 1:
             dimensions = np.broadcast_to(
@@ -153,6 +160,10 @@ class TrajectoryWriter:
         has_box = dimensions is not None
         # ALL refusals precede any state latching: a rejected write must
         # not leave _box_flag/_vel_flag poisoned for the retry
+        if (times is not None or steps is not None) \
+                and self.format == "xyz":
+            raise ValueError(
+                "xyz stores no per-frame times/steps (text frames only)")
         if velocities is not None and self.format not in ("trr", "ncdf"):
             raise ValueError(
                 f"{self.format} cannot store velocities (use trr/ncdf)")
@@ -213,6 +224,12 @@ class TrajectoryWriter:
                           times=np.asarray(times, np.float32),
                           steps=np.asarray(steps, np.int32),
                           velocities=velocities, forces=forces)
+                strip = 0
+            elif self.format == "xyz":
+                from mdanalysis_mpi_tpu.io.xyz import write_xyz
+
+                write_xyz(self._chunk_path, coords,
+                          start=self.frames_written)
                 strip = 0
             elif self.format == "ncdf":
                 from mdanalysis_mpi_tpu.io.netcdf import write_ncdf

@@ -84,7 +84,7 @@ def test_writer_errors(tmp_path):
     with pytest.raises(ValueError, match="closed"):
         w.write(_frames(1, atoms=9))
     with pytest.raises(ValueError, match="format"):
-        TrajectoryWriter(str(tmp_path / "out.xyz"))
+        TrajectoryWriter(str(tmp_path / "out.gro"))
 
 
 def test_dcd_frame_count_patched(tmp_path):

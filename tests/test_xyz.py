@@ -1,0 +1,113 @@
+"""XYZ text trajectory format: round trips, random access, Universe
+dispatch, streaming append, and malformed-file refusals."""
+
+import numpy as np
+import pytest
+
+from mdanalysis_mpi_tpu.core.universe import Universe
+from mdanalysis_mpi_tpu.io.xyz import XYZReader, write_xyz
+from mdanalysis_mpi_tpu.testing import make_protein_universe
+
+
+def _frames(f=4, n=7, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.normal(scale=6.0, size=(f, n, 3)).astype(np.float32)
+
+
+def test_round_trip_and_random_access(tmp_path):
+    p = str(tmp_path / "t.xyz")
+    fr = _frames()
+    write_xyz(p, fr, names=["C"] * 7)
+    r = XYZReader(p)
+    assert r.n_frames == 4 and r.n_atoms == 7
+    np.testing.assert_allclose(r[2].positions, fr[2], atol=1e-5)
+    np.testing.assert_allclose(r[0].positions, fr[0], atol=1e-5)
+    assert r[3].time == 3.0
+    block, boxes = r.read_block(1, 3)
+    np.testing.assert_allclose(block, fr[1:3], atol=1e-5)
+    assert boxes is None                        # the format has no box
+
+
+def test_universe_and_analysis(tmp_path):
+    from mdanalysis_mpi_tpu.analysis import RMSD
+
+    u0 = make_protein_universe(n_residues=6, n_frames=5, noise=0.3,
+                               seed=2)
+    fr, _ = u0.trajectory.read_block(0, 5)
+    p = str(tmp_path / "traj.xyz")
+    write_xyz(p, fr)
+    u = Universe(u0.topology, p)
+    s = RMSD(u.select_atoms("name CA")).run(backend="serial")
+    j = RMSD(u.select_atoms("name CA")).run(backend="jax", batch_size=2)
+    np.testing.assert_allclose(np.asarray(j.results.rmsd),
+                               s.results.rmsd, atol=1e-4)
+
+
+def test_streaming_writer_xyz(tmp_path):
+    from mdanalysis_mpi_tpu.io.writer import TrajectoryWriter
+
+    fr = _frames(f=5, n=4, seed=3)
+    out = str(tmp_path / "s.xyz")
+    w = TrajectoryWriter(out, n_atoms=4)
+    w.write(fr[:2])
+    w.write(fr[2:])
+    w.close()
+    r = XYZReader(out)
+    assert r.n_frames == 5
+    np.testing.assert_allclose(r[4].positions, fr[4], atol=1e-5)
+    with pytest.raises(ValueError, match="times"):
+        TrajectoryWriter(str(tmp_path / "x.xyz"),
+                         n_atoms=4).write(fr, times=[0.0] * 5)
+
+
+def test_malformed_refusals(tmp_path):
+    bad = tmp_path / "bad.xyz"
+    bad.write_text("not a count\nc\n")
+    with pytest.raises(ValueError, match="atom-count"):
+        XYZReader(str(bad))
+    trunc = tmp_path / "trunc.xyz"
+    trunc.write_text("3\ncomment\nC 0 0 0\n")
+    with pytest.raises(ValueError, match="truncated"):
+        XYZReader(str(trunc))
+    varying = tmp_path / "var.xyz"
+    varying.write_text("1\nc\nC 0 0 0\n2\nc\nC 0 0 0\nC 1 1 1\n")
+    with pytest.raises(ValueError, match="previous frames"):
+        XYZReader(str(varying))
+    empty = tmp_path / "e.xyz"
+    empty.write_text("")
+    with pytest.raises(ValueError, match="empty"):
+        XYZReader(str(empty))
+    p = str(tmp_path / "ok.xyz")
+    write_xyz(p, _frames(f=1, n=3))
+    with pytest.raises(ValueError, match="atoms"):
+        XYZReader(p, n_atoms=9)
+    with pytest.raises(ValueError, match="names"):
+        write_xyz(p, _frames(f=1, n=3), names=["C"])
+
+
+def test_offset_cache_and_comment_numbering(tmp_path):
+    from mdanalysis_mpi_tpu.io import _offsets
+    from mdanalysis_mpi_tpu.io.writer import TrajectoryWriter
+
+    fr = _frames(f=4, n=3, seed=5)
+    p = str(tmp_path / "c.xyz")
+    write_xyz(p, fr)
+    XYZReader(p)
+    import os
+
+    assert os.path.exists(_offsets.cache_path(p))   # index cached
+    r2 = XYZReader(p)                               # served from cache
+    np.testing.assert_allclose(r2[3].positions, fr[3], atol=1e-5)
+    # streamed chunks number their comment lines monotonically
+    out = str(tmp_path / "s2.xyz")
+    w = TrajectoryWriter(out, n_atoms=3)
+    w.write(fr[:2])
+    w.write(fr[2:])
+    w.close()
+    comments = [ln for ln in open(out) if ln.startswith("frame ")]
+    assert comments == [f"frame {i}\n" for i in range(4)]
+    # explicit dimensions refuse (the format stores no cell)
+    w2 = TrajectoryWriter(str(tmp_path / "d.xyz"), n_atoms=3)
+    with pytest.raises(ValueError, match="unit cell"):
+        w2.write(fr, dimensions=np.array([10.0, 10, 10, 90, 90, 90]))
+    w2.close()
