@@ -154,3 +154,34 @@ class TestChainReader:
         write_xtc(other, np.zeros((2, 7, 3), np.float32))
         with pytest.raises(ValueError, match="atoms"):
             ChainReader([paths[0], other])
+
+
+def test_mixed_format_chain(tmp_path):
+    """One logical trajectory spliced from XTC + NetCDF + XYZ segments:
+    the chain dispatches each child by extension and block reads cross
+    the format boundaries."""
+    from mdanalysis_mpi_tpu.core.universe import Universe
+    from mdanalysis_mpi_tpu.io.netcdf import write_ncdf
+    from mdanalysis_mpi_tpu.io.xtc import write_xtc
+    from mdanalysis_mpi_tpu.io.xyz import write_xyz
+    from mdanalysis_mpi_tpu.testing import make_protein_universe
+
+    u0 = make_protein_universe(n_residues=5, n_frames=9, noise=0.3,
+                               seed=8)
+    fr, _ = u0.trajectory.read_block(0, 9)
+    p1 = str(tmp_path / "a.xtc")
+    p2 = str(tmp_path / "b.nc")
+    p3 = str(tmp_path / "c.xyz")
+    write_xtc(p1, fr[:3])
+    write_ncdf(p2, fr[3:6])
+    write_xyz(p3, fr[6:])
+    u = Universe(u0.topology, [p1, p2, p3])
+    assert u.trajectory.n_frames == 9
+    # frames renumber globally; positions match the source (XTC is
+    # 0.001-A quantized, XYZ 1e-6 text)
+    np.testing.assert_allclose(u.trajectory[4].positions, fr[4],
+                               atol=1e-5)
+    np.testing.assert_allclose(u.trajectory[8].positions, fr[8],
+                               atol=1e-4)
+    block, _ = u.trajectory.read_block(2, 7)      # spans two boundaries
+    np.testing.assert_allclose(block, fr[2:7], atol=1e-2)
