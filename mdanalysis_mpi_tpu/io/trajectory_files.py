@@ -34,7 +34,8 @@ def _autoload():
     # trr/netcdf are pure NumPy: an ImportError from them is always a
     # programming error and must surface, unlike the native-backed
     # xtc/dcd modules
-    from mdanalysis_mpi_tpu.io import netcdf, trr, xyz  # noqa: F401  (self-register)
+    from mdanalysis_mpi_tpu.io import (  # noqa: F401  (self-register)
+        lammps, netcdf, trr, xyz)
     try:
         from mdanalysis_mpi_tpu.io import xtc, dcd  # noqa: F401  (self-register)
     except ImportError:
