@@ -227,3 +227,57 @@ def test_writer_empty_chunk_and_steps_refusal(tmp_path):
     w2.write(fr[0], velocities=fr[0])
     w2.close()
     assert NCDFReader(str(tmp_path / "s.nc"))[0].velocities is not None
+
+
+def test_format_round_trip_fuzz(tmp_path):
+    """Property fuzz across the round-5 formats: arbitrary shapes,
+    optional boxes/velocities/times — write→read is exact (NetCDF f32)
+    or within text precision (XYZ/LAMMPS 1e-4)."""
+    hyp = pytest.importorskip("hypothesis")
+    from hypothesis import given, settings, strategies as st
+
+    from mdanalysis_mpi_tpu.io.lammps import (LAMMPSDumpReader,
+                                              write_lammpsdump)
+    from mdanalysis_mpi_tpu.io.xyz import XYZReader, write_xyz
+
+    counter = [0]
+
+    @settings(max_examples=25, deadline=None)
+    @given(
+        f=st.integers(min_value=1, max_value=5),
+        n=st.integers(min_value=1, max_value=9),
+        box=st.booleans(),
+        vel=st.booleans(),
+        seed=st.integers(min_value=0, max_value=2**31 - 1),
+    )
+    def check(f, n, box, vel, seed):
+        rng = np.random.default_rng(seed)
+        fr = rng.normal(scale=50.0, size=(f, n, 3)).astype(np.float32)
+        counter[0] += 1
+        tag = counter[0]
+        dims = (np.abs(rng.normal(scale=30.0, size=3)) + 1.0)
+        dims6 = np.concatenate([dims, [90.0, 90.0, 90.0]])
+        p = str(tmp_path / f"fz{tag}.nc")
+        write_ncdf(p, fr, dimensions=dims6 if box else None,
+                   velocities=fr * 0.1 if vel else None)
+        r = NCDFReader(p)
+        assert r.n_frames == f and r.n_atoms == n
+        i = int(rng.integers(0, f))
+        np.testing.assert_array_equal(r[i].positions, fr[i])
+        if box:
+            np.testing.assert_allclose(r[i].dimensions, dims6,
+                                       atol=1e-5)
+        if vel:
+            np.testing.assert_allclose(r[i].velocities, fr[i] * 0.1,
+                                       atol=1e-6)
+        p2 = str(tmp_path / f"fz{tag}.xyz")
+        write_xyz(p2, fr)
+        np.testing.assert_allclose(XYZReader(p2)[i].positions, fr[i],
+                                   atol=1e-4)
+        p3 = str(tmp_path / f"fz{tag}.dump")
+        write_lammpsdump(p3, fr,
+                         dimensions=dims6 if box else None)
+        np.testing.assert_allclose(LAMMPSDumpReader(p3)[i].positions,
+                                   fr[i], atol=1e-4)
+
+    check()
