@@ -12,6 +12,20 @@ the reference's docstring (RMSF.py:1-18) — ``Analysis(...).run()`` →
   trajectory alignment (oracle RMSF.py:12).
 - :class:`~mdanalysis_mpi_tpu.analysis.rms.AlignedRMSF` — the whole
   reference program as one call (pass 1 + pass 2, RMSF.py:53-149).
+
+Beyond the reference's envelope, the roster mirrors upstream
+MDAnalysis' analysis subpackages (each with serial f64 oracle +
+batched kernels where static shapes allow — see PARITY.md for the
+line-by-line map): InterRDF/InterRDF_s, ContactMap/Contacts/
+PairwiseDistances/AtomicDistances, RadiusOfGyration, PCA (+
+cosine_content), EinsteinMSD, Dihedral/Ramachandran/Janin,
+DensityAnalysis/LinearDensity, HydrogenBondAnalysis (+ lifetime),
+DistanceMatrix/DiffusionMap, VelocityAutocorr, GNMAnalysis,
+SurvivalProbability/WaterOrientationalRelaxation/AngularDistribution/
+MeanSquareDisplacement, DielectricConstant, PSAnalysis
+(hausdorff/discrete_frechet), PersistenceLength, HELANAL, BAT, DSSP,
+encore.hes, NucPairDist/WatsonCrickDist, LeafletFinder
+(+ optimize_cutoff), sequence_alignment, AnalysisFromFunction.
 """
 
 from mdanalysis_mpi_tpu.analysis.base import (AnalysisBase, Results,
