@@ -91,12 +91,28 @@ class AverageStructure(AnalysisBase):
 
     def __init__(self, mobile: Universe, reference: Universe | None = None,
                  select: str = "all", ref_frame: int = 0,
-                 select_only: bool = False, verbose: bool = False):
+                 select_only: bool = False, verbose: bool = False,
+                 engine: str | None = None):
         super().__init__(mobile, verbose)
         self._reference = reference if reference is not None else mobile
         self._select = select
         self._ref_frame = ref_frame
         self._select_only = select_only
+        # engine='fused': int16-staged accelerator runs consume the
+        # quantized block directly through the fused Pallas sweeps
+        # (ops/pallas_rmsf.py) instead of dequant→superpose→sum
+        from mdanalysis_mpi_tpu.ops.pallas_rmsf import validate_engine
+
+        validate_engine(engine)
+        if engine == "fused" and not select_only:
+            # the wide path rotates ALL atoms (quirk Q5) — a different
+            # traffic shape with no fused kernel; silently running
+            # unfused would be the perf surprise validate_engine exists
+            # to prevent
+            raise ValueError(
+                "engine='fused' requires select_only=True (the wide "
+                "all-atom path has no fused kernel)")
+        self._engine = engine
 
     def _prepare(self):
         u = self._universe
@@ -130,6 +146,20 @@ class AverageStructure(AnalysisBase):
 
     def _batch_fn(self):
         return _avg_sel_kernel if self._select_only else _avg_all_kernel
+
+    def _quantized_batch(self, transfer_dtype: str):
+        """Fused quantized-native path (executors._quantized_native):
+        lean pass-1 average straight off the staged int16 block
+        (ops/pallas_rmsf.py).  Only the select_only form qualifies —
+        the wide path rotates ALL atoms (quirk Q5), a different
+        traffic shape."""
+        if not self._select_only:
+            return None
+        from mdanalysis_mpi_tpu.ops import pallas_rmsf as pr
+
+        return pr.quantized_batch(
+            "avg", self._engine, transfer_dtype, self._sel_idx,
+            self._ref_sel_c, self._ref_com, self._weights)
 
     def _batch_params(self):
         import jax.numpy as jnp

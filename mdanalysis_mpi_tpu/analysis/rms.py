@@ -387,10 +387,21 @@ class AlignedRMSF(AnalysisBase):
     """
 
     def __init__(self, universe, select: str = "protein and name CA",
-                 ref_frame: int = 0, verbose: bool = False):
+                 ref_frame: int = 0, verbose: bool = False,
+                 engine: str | None = None):
         super().__init__(universe, verbose)
         self._select = select
         self._ref_frame = ref_frame
+        # engine='fused': on int16-staged accelerator runs, BOTH passes
+        # consume the staged quantized blocks directly via the fused
+        # Pallas sweeps (ops/pallas_rmsf.py — 12·S bytes/frame of HBM
+        # traffic, the perfect-fusion floor of PERF.md §8b) instead of
+        # materializing dequantized f32 intermediates.  None/'auto'
+        # keeps the generic dequant path.
+        from mdanalysis_mpi_tpu.ops.pallas_rmsf import validate_engine
+
+        validate_engine(engine)
+        self._engine = engine
 
     def run(self, start=None, stop=None, step=None, frames=None,
             backend: str = "serial", batch_size: int | None = None,
@@ -415,7 +426,7 @@ class AlignedRMSF(AnalysisBase):
         # the selection's average (SURVEY.md quirk Q5 discussion).
         avg = AverageStructure(
             self._universe, select=self._select, ref_frame=self._ref_frame,
-            select_only=True, verbose=self._verbose,
+            select_only=True, verbose=self._verbose, engine=self._engine,
         ).run(start, stop, step, frames=frames, backend=backend,
               batch_size=batch_size, **kwargs)
         # raw dict access: keep the average device-resident between
@@ -424,7 +435,8 @@ class AlignedRMSF(AnalysisBase):
 
         # Pass 2 (RMSF.py:115-143): moments of coords aligned to the average.
         moments_pass = _MomentsToReference(
-            self._universe, self._select, self._avg_sel, self._verbose)
+            self._universe, self._select, self._avg_sel, self._verbose,
+            engine=self._engine)
         moments_pass.run(start, stop, step, frames=frames, backend=backend,
                          batch_size=batch_size, **kwargs)
         t, mean, m2 = moments_pass._total
@@ -467,10 +479,12 @@ class _MomentsToReference(AnalysisBase):
     """Pass 2 of the reference: superpose the selection onto fixed
     reference coords, accumulate Welford moments (RMSF.py:115-143)."""
 
-    def __init__(self, universe, select, ref_sel_positions, verbose=False):
+    def __init__(self, universe, select, ref_sel_positions, verbose=False,
+                 engine: str | None = None):
         super().__init__(universe, verbose)
         self._select = select
         self._ref_sel_positions = ref_sel_positions
+        self._engine = engine
 
     def _prepare(self):
         import jax
@@ -518,6 +532,17 @@ class _MomentsToReference(AnalysisBase):
 
     def _batch_fn(self):
         return _aligned_moments_kernel
+
+    def _quantized_batch(self, transfer_dtype: str):
+        """Fused quantized-native pass 2 (executors._quantized_native):
+        rotate + deviation moments straight off the staged int16 block
+        (ops/pallas_rmsf.py).  Shares pass 1's padded selection, so the
+        HBM block cache serves both passes."""
+        from mdanalysis_mpi_tpu.ops import pallas_rmsf as pr
+
+        return pr.quantized_batch(
+            "moments", self._engine, transfer_dtype, self._idx,
+            self._ref_sel_c, self._ref_com, self._masses)
 
     def _batch_params(self):
         import jax.numpy as jnp

@@ -34,6 +34,19 @@ def weighted_center(x: jax.Array, weights: jax.Array) -> jax.Array:
     return jnp.einsum("...ni,n->...i", x, w, precision=_HI)
 
 
+def kabsch_from_correlation(H: jax.Array) -> jax.Array:
+    """Optimal rotation from the 3x3 correlation matrix H = mobileᵀ·ref
+    (both point sets centered).  Factored out of :func:`kabsch_rotation`
+    so fused kernels that build H themselves (e.g. the Pallas RMSF path,
+    which exploits Σref = 0 to skip the COM subtraction entirely) share
+    the identical SVD + det-correction."""
+    U, _, Vt = jnp.linalg.svd(H, full_matrices=False)
+    d = jnp.sign(jnp.linalg.det(jnp.matmul(U, Vt, precision=_HI)))
+    # fold the det-correction into U's last column instead of a diag matmul
+    U = U.at[..., :, -1].multiply(d[..., None] if U.ndim > 2 else d)
+    return jnp.matmul(U, Vt, precision=_HI)
+
+
 def kabsch_rotation(mobile: jax.Array, ref: jax.Array,
                     weights: jax.Array | None = None) -> jax.Array:
     """Optimal rotation R (3,3) minimizing ||mobile @ R - ref||_w.
@@ -45,11 +58,7 @@ def kabsch_rotation(mobile: jax.Array, ref: jax.Array,
         H = jnp.einsum("ni,n,nj->ij", mobile, weights, ref, precision=_HI)
     else:
         H = jnp.einsum("ni,nj->ij", mobile, ref, precision=_HI)
-    U, _, Vt = jnp.linalg.svd(H, full_matrices=False)
-    d = jnp.sign(jnp.linalg.det(jnp.matmul(U, Vt, precision=_HI)))
-    # fold the det-correction into U's last column instead of a diag matmul
-    U = U.at[:, -1].multiply(d)
-    return jnp.matmul(U, Vt, precision=_HI)
+    return kabsch_from_correlation(H)
 
 
 kabsch_rotation_batch = jax.vmap(kabsch_rotation, in_axes=(0, None, None))

@@ -1,0 +1,384 @@
+"""Fused Pallas kernels for the flagship aligned-moments pipeline.
+
+The steady-state flagship (AlignedRMSF over HBM-cached int16 blocks)
+sits on the HBM bandwidth wall (PERF.md §8b): the unfused XLA path
+models ~48·S bytes/frame of traffic (int16 read + dequantized f32
+intermediates materialized between the dequant, superpose and moments
+stages), against a perfect-fusion floor of 12·S bytes/frame (read the
+int16 block exactly twice).  This module hits that floor: two Pallas
+sweeps over the *quantized* block with nothing but 3x3-sized tensors
+materialized in between.
+
+Algebra (why two sweeps suffice — the reference computes the same
+quantities per frame at RMSF.py:94-101/124-138):
+
+- Pass 1 needs each frame's selection COM and its Kabsch correlation
+  ``H = Σ_n (x_n - com)·ref_nᵀ``.  Because the reference coords are
+  centered (``Σ ref = 0``), the COM term vanishes: ``H = Σ_n x_n·ref_nᵀ``
+  exactly.  So one sweep over the raw block yields both ``Σ w·x`` (the
+  COM) and ``H`` — 12 running scalars per frame, no (B,S,3) f32 tensor.
+- The 3x3 SVDs (one per frame) run in XLA between the sweeps
+  (:func:`mdanalysis_mpi_tpu.ops.align.kabsch_from_correlation`).
+- Pass 2 accumulates per-atom sums of the *deviation from the
+  reference coords*: ``d = (x - com)·R - ref_c``.  Shifting by ref_c
+  (≈ the mean) makes the textbook-cancellation-prone sum-of-squares
+  form safe in f32: deviations are O(fluctuation), so
+  ``M2 = Σd² - (Σd)²/T`` loses nothing.  Mean and M2 recover as
+  ``mean = ref_c + ref_com + Σd/T``; both are exact algebra, not
+  approximation (same Chan-merge family as ops/moments.py).
+
+Layout: a staged ``(B, S, 3)`` block reshapes *for free* to ``(B, 3S)``
+with atom triplets contiguous on the lane axis.  The kernels work on
+that interleaved layout directly — component selection by ``lane % 3``
+masks, and the per-frame 3x3 rotation applied with nine static
+``jnp.roll``s on the lane axis (shift ``j - i`` moves component-i lanes
+onto component-j lanes; triplets never straddle a block because the
+lane tile is a multiple of 3, so the rolls never mix atoms).  No
+transpose, no dequantized copy: HBM traffic is the two int16 reads.
+
+Callers pad the *selection* (not the block) so ``S`` is a multiple of
+:data:`ATOM_TILE` — padding atoms replicate index 0 with zero weight,
+zero reference row and a zero atom-mask lane, making them exact
+no-ops in every accumulation (see :func:`pad_selection`).
+
+On non-TPU backends the kernels run in Pallas interpret mode for the
+CPU test suite (``MDTPU_PALLAS=1``); ``engine='xla'`` is the identical
+algebra as plain XLA ops — the differential oracle for both.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from mdanalysis_mpi_tpu.ops.pallas_distances import use_pallas
+
+ATOM_TILE = 256                 # atoms per lane tile
+LANE_TILE = 3 * ATOM_TILE       # 768 lanes = 256 interleaved triplets
+FRAME_TILE = 16                 # int16 sublane tile
+
+
+def pad_selection(idx: np.ndarray):
+    """Pad a selection index array so the fused kernels' lane tiling is
+    exact: atoms → next multiple of :data:`ATOM_TILE`, padding entries
+    replicating index 0 (a real, gatherable atom — masked out of every
+    sum by zero weights / zero mask lanes).  Returns
+    ``(padded_idx, n_real)``."""
+    idx = np.asarray(idx)
+    n = len(idx)
+    n_pad = -(-max(n, 1) // ATOM_TILE) * ATOM_TILE
+    if n == n_pad:
+        return idx, n
+    out = np.zeros(n_pad, dtype=idx.dtype)
+    out[:n] = idx
+    return out, n
+
+
+@functools.lru_cache(maxsize=None)
+def _build_p1(interpret: bool):
+    """Sweep 1: interleaved int16 block → per-frame (Σ w·x, H).
+
+    Grid (nb, ns), lane tiles innermost; the (BT, 3) / (BT, 9) output
+    blocks accumulate across the ns sweep (sequential TPU grid)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    def kernel(q_ref, wb_ref, refb_ref, sxw_ref, h_ref):
+        s = pl.program_id(1)
+        x = q_ref[...].astype(jnp.float32)           # (BT, LT)
+        wb = wb_ref[...]                             # (1, LT)
+        refb = refb_ref[...]                         # (3, LT)
+        lane = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1) % 3
+
+        @pl.when(s == 0)
+        def _():
+            sxw_ref[...] = jnp.zeros_like(sxw_ref)
+            h_ref[...] = jnp.zeros_like(h_ref)
+
+        sxw_cols = []
+        h_cols = []
+        for i in range(3):
+            xi = x * (lane == i)
+            sxw_cols.append((xi * wb).sum(axis=1, keepdims=True))
+            for j in range(3):
+                h_cols.append(
+                    (xi * refb[j:j + 1]).sum(axis=1, keepdims=True))
+        sxw_ref[...] += jnp.concatenate(sxw_cols, axis=1)
+        h_ref[...] += jnp.concatenate(h_cols, axis=1)
+
+    def call(q2, wb, refb):
+        B, L = q2.shape
+        grid = (B // FRAME_TILE, L // LANE_TILE)
+        return pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((FRAME_TILE, LANE_TILE), lambda b, s: (b, s)),
+                pl.BlockSpec((1, LANE_TILE), lambda b, s: (0, s)),
+                pl.BlockSpec((3, LANE_TILE), lambda b, s: (0, s)),
+            ],
+            out_specs=[
+                pl.BlockSpec((FRAME_TILE, 3), lambda b, s: (b, 0)),
+                pl.BlockSpec((FRAME_TILE, 9), lambda b, s: (b, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((B, 3), jnp.float32),
+                jax.ShapeDtypeStruct((B, 9), jnp.float32),
+            ],
+            interpret=interpret,
+        )(q2, wb, refb)
+
+    return call
+
+
+@functools.lru_cache(maxsize=None)
+def _build_p2(interpret: bool):
+    """Sweep 2: rotate + accumulate deviation sums.
+
+    Grid (ns, nb), frame tiles innermost; the (2, LT) output block
+    (row 0 = Σd, row 1 = Σd²) accumulates across the nb sweep."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    def kernel(q_ref, inv_ref, com_ref, r_ref, refi_ref, am_ref, fm_ref,
+               out_ref):
+        b = pl.program_id(1)
+        x = q_ref[...].astype(jnp.float32) * inv_ref[...]   # (BT,LT)*(BT,1)
+        lane = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1) % 3
+        com = com_ref[...]                                  # (BT, 3)
+        comlane = (com[:, 0:1] * (lane == 0)
+                   + com[:, 1:2] * (lane == 1)
+                   + com[:, 2:3] * (lane == 2))
+        xc = x - comlane
+        r = r_ref[...]                                      # (BT, 9)
+        d = jnp.zeros_like(x)
+        for i in range(3):
+            yi = xc * (lane == i)
+            for j in range(3):
+                # value at lane 3n+i moves to lane 3n+j; LANE_TILE is a
+                # multiple of 3 so triplets never straddle the block and
+                # the wrap-around lanes only ever carry zeros of yi.
+                # shift 0 must bypass roll: Mosaic rejects the
+                # zero-width slice jnp.roll's static path emits for it
+                rolled = yi if j == i else jnp.roll(yi, j - i, axis=1)
+                d += rolled * r[:, 3 * i + j:3 * i + j + 1]
+        dev = (d - refi_ref[...]) * am_ref[...]             # (BT, LT)
+        devm = dev * fm_ref[...]                            # frame mask 0/1
+
+        @pl.when(b == 0)
+        def _():
+            out_ref[...] = jnp.zeros_like(out_ref)
+
+        out_ref[0:1, :] += devm.sum(axis=0, keepdims=True)
+        out_ref[1:2, :] += (devm * dev).sum(axis=0, keepdims=True)
+
+    def call(q2, inv_col, com, r9, refi, aml, fm_col):
+        B, L = q2.shape
+        grid = (L // LANE_TILE, B // FRAME_TILE)
+        return pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((FRAME_TILE, LANE_TILE), lambda s, b: (b, s)),
+                pl.BlockSpec((FRAME_TILE, 1), lambda s, b: (b, 0)),
+                pl.BlockSpec((FRAME_TILE, 3), lambda s, b: (b, 0)),
+                pl.BlockSpec((FRAME_TILE, 9), lambda s, b: (b, 0)),
+                pl.BlockSpec((1, LANE_TILE), lambda s, b: (0, s)),
+                pl.BlockSpec((1, LANE_TILE), lambda s, b: (0, s)),
+                pl.BlockSpec((FRAME_TILE, 1), lambda s, b: (b, 0)),
+            ],
+            out_specs=pl.BlockSpec((2, LANE_TILE), lambda s, b: (0, s)),
+            out_shape=jax.ShapeDtypeStruct((2, L), jnp.float32),
+            interpret=interpret,
+        )(q2, inv_col, com, r9, refi, aml, fm_col)
+
+    return call
+
+
+def _resolve_engine(engine: str, B: int, L: int) -> str:
+    """'pallas' needs the tile alignment the staging layer provides
+    (B % 16, padded selection); anything else falls back to the
+    identical-algebra XLA path at trace time (same fn identity, the
+    shape-keyed jit cache keeps both compiled forms)."""
+    if engine in ("pallas", "interpret"):
+        if B % FRAME_TILE == 0 and L % LANE_TILE == 0 and L > 0:
+            return engine
+        return "xla"
+    return "xla"
+
+
+def _core(engine: str, q, inv_scale, wN, refc_p, amask, sref, fmask):
+    """Shared fused core: quantized block → (T, Σdev, Σdev²) with
+    dev = (x−com)·R − ref_c, padded atoms zeroed.  q (B,S,3) int16 (or
+    any real dtype — dequant is a cast+scale), inv_scale scalar or
+    (B,1,1); returns sums shaped (S,3).
+
+    ``sref = Σ ref_c`` corrects the no-COM Kabsch correlation: ref_c is
+    centered by the MASS-weighted COM (RMSF.py:84) while the rotation
+    fit is unweighted (RMSF.py:48 weights=None), so Σ ref_c ≠ 0 and
+    ``H = Σ(x−com)·ref_cᵀ = Σ x·ref_cᵀ − com⊗sref`` — an exact rank-1
+    fixup applied between the sweeps, not inside them."""
+    import jax.numpy as jnp
+
+    from mdanalysis_mpi_tpu.ops.align import _HI, kabsch_from_correlation
+
+    B, S, _ = q.shape
+    # scalar (single-host) or (B,1,1) per-frame (multi-host) → (B,1)
+    inv_col = jnp.broadcast_to(
+        jnp.asarray(inv_scale, jnp.float32).reshape(-1, 1), (B, 1))
+    eng = _resolve_engine(engine, B, 3 * S)
+    fm_col = fmask.astype(jnp.float32).reshape(B, 1)
+    if eng in ("pallas", "interpret"):
+        interpret = eng == "interpret" or not _on_tpu()
+        q2 = q.reshape(B, 3 * S)
+        wb = jnp.repeat(wN.reshape(1, S), 3, axis=1).reshape(1, 3 * S)
+        # interleaved-broadcast reference: refb[j, 3n+c] = ref_c[n, j]
+        refb = jnp.repeat(refc_p.T, 3, axis=1)
+        refi = refc_p.reshape(1, 3 * S)
+        aml = jnp.repeat(amask.reshape(1, S), 3, axis=1).reshape(1, 3 * S)
+        sxw, h9 = _build_p1(interpret)(q2, wb, refb)
+        com = sxw * inv_col
+        h = h9.reshape(B, 3, 3) * inv_col[:, :, None]
+        h = h - com[:, :, None] * sref[None, None, :]
+        r = kabsch_from_correlation(h)
+        sums = _build_p2(interpret)(
+            q2, inv_col, com, r.reshape(B, 9), refi, aml, fm_col)
+        sum_d = sums[0].reshape(S, 3)
+        sumsq = sums[1].reshape(S, 3)
+    else:
+        x = q.astype(jnp.float32) * inv_col[:, :, None]
+        com = jnp.einsum("bni,n->bi", x, wN, precision=_HI)
+        h = jnp.einsum("bni,nj->bij", x, refc_p, precision=_HI)
+        h = h - com[:, :, None] * sref[None, None, :]
+        r = kabsch_from_correlation(h)
+        d = jnp.einsum("bni,bij->bnj", x - com[:, None], r,
+                       precision=_HI) - refc_p
+        d = d * amask[None, :, None]
+        dm = d * fm_col[:, :, None]
+        sum_d = dm.sum(axis=0)
+        sumsq = (dm * d).sum(axis=0)
+    t = fm_col.sum()
+    return t, sum_d, sumsq
+
+
+def _on_tpu() -> bool:
+    import jax
+
+    return jax.default_backend() == "tpu"
+
+
+@functools.lru_cache(maxsize=None)
+def moments_kernel_for(engine: str, n_real: int):
+    """Executor batch kernel (quantized-native calling convention
+    ``f(params, q, inv_scale, boxes, mask)``) returning the standard
+    moment partials (T, mean, M2).  The static in-kernel slice back to
+    ``n_real`` atoms makes the partials shape-identical to the unfused
+    path, so folds / psum merges / _conclude are untouched.  Stable
+    identity per (engine, selection width) → compiles survive run()
+    calls."""
+
+    def aligned_moments_q(params, q, inv_scale, boxes, mask):
+        del boxes
+        import jax.numpy as jnp
+
+        wN, refc_p, ref_com, amask, sref = params
+        t, sum_d, sumsq = _core(engine, q, inv_scale, wN, refc_p, amask,
+                                sref, mask)
+        tt = jnp.maximum(t, 1.0)
+        mean = ((refc_p + ref_com) + sum_d / tt)[:n_real]
+        m2 = jnp.maximum(sumsq - sum_d * sum_d / tt, 0.0)[:n_real]
+        return t, mean, m2
+
+    aligned_moments_q.__name__ = f"aligned_moments_q_{engine}_{n_real}"
+    return aligned_moments_q
+
+
+@functools.lru_cache(maxsize=None)
+def avg_kernel_for(engine: str, n_real: int):
+    """Executor batch kernel for the pass-1 average partials
+    ``(T, Σ aligned)`` (same convention as align._avg_sel_kernel),
+    sliced in-kernel back to the real selection width."""
+
+    def avg_sum_q(params, q, inv_scale, boxes, mask):
+        del boxes
+
+        wN, refc_p, ref_com, amask, sref = params
+        t, sum_d, _ = _core(engine, q, inv_scale, wN, refc_p, amask,
+                            sref, mask)
+        return t, (sum_d + t * (refc_p + ref_com))[:n_real]
+
+    avg_sum_q.__name__ = f"avg_sum_q_{engine}_{n_real}"
+    return avg_sum_q
+
+
+def default_engine() -> str:
+    """'pallas' on a real TPU backend, else the XLA form of the same
+    algebra (interpret mode is opt-in for tests via MDTPU_PALLAS=1)."""
+    return "pallas" if use_pallas() else "xla"
+
+
+VALID_ENGINES = (None, "auto", "fused")
+
+
+def validate_engine(engine) -> None:
+    """Constructor-time check: a misspelled engine (e.g. 'Fused',
+    'pallas') must fail loudly, not silently take the unfused path."""
+    if engine not in VALID_ENGINES:
+        raise ValueError(
+            f"engine must be one of {VALID_ENGINES}, got {engine!r} "
+            "('fused' = quantized-native Pallas sweeps on int16-staged "
+            "accelerator runs)")
+
+
+def quantized_batch(kind: str, engine, transfer_dtype: str, idx,
+                    ref_sel_c, ref_com, weights):
+    """The one (fn, params, padded_sel) assembly both AlignedRMSF
+    passes share (executors._quantized_native contract), so the padding
+    and params contracts cannot diverge between pass 1 and pass 2 —
+    identical padded selections are what let the HBM block cache serve
+    both passes.  Returns None unless engine='fused' and the staging is
+    int16-native."""
+    if engine != "fused":
+        return None
+    if transfer_dtype != "int16":
+        # float32 staging is a documented silent fallback (no quantized
+        # block to fuse over — the generic path is already dequant-free);
+        # int8/delta with an explicit engine ask must fail loudly, same
+        # rationale as validate_engine
+        if transfer_dtype == "float32":
+            return None
+        raise ValueError(
+            f"engine='fused' supports transfer_dtype='int16' (or the "
+            f"float32 fallback), not {transfer_dtype!r}")
+    idx_p, n_real = pad_selection(idx)
+    params = build_params(ref_sel_c, ref_com, weights, n_real, len(idx_p))
+    kernel_for = {"moments": moments_kernel_for, "avg": avg_kernel_for}[kind]
+    return kernel_for(default_engine(), n_real), params, idx_p
+
+
+@functools.lru_cache(maxsize=None)
+def _params_builder(n_real: int, n_pad: int):
+    import jax
+    import jax.numpy as jnp
+
+    def build(ref_sel_c, ref_com, masses):
+        refc = jnp.asarray(ref_sel_c, jnp.float32)
+        pad = ((0, n_pad - n_real), (0, 0))
+        refc_p = jnp.pad(refc, pad)
+        m = jnp.asarray(masses, jnp.float32)
+        wN = jnp.pad(m / m.sum(), (0, n_pad - n_real))
+        amask = (jnp.arange(n_pad) < n_real).astype(jnp.float32)
+        return (wN, refc_p, jnp.asarray(ref_com, jnp.float32), amask,
+                refc_p.sum(axis=0))
+
+    return jax.jit(build)
+
+
+def build_params(ref_sel_c, ref_com, masses, n_real: int, n_pad: int):
+    """(wN, refc_p, ref_com, amask, Σref_c) padded params for the fused kernels,
+    built in ONE jitted dispatch (ref may be device-resident from a
+    pass-1 result; eager ops on tunneled targets cost ~150 ms each)."""
+    return _params_builder(n_real, n_pad)(ref_sel_c, ref_com, masses)

@@ -186,6 +186,22 @@ def _dequant_wrapper(fn):
     return g
 
 
+def _quantized_native(analysis, transfer_dtype: str):
+    """Analysis-provided quantized-native kernel, or None.
+
+    An analysis may implement ``_quantized_batch(transfer_dtype)``
+    returning ``(fn, params, sel_idx)`` where ``fn(params, q,
+    inv_scale, boxes, mask)`` consumes the staged quantized block
+    DIRECTLY (same calling convention the dequant wrapper produces) —
+    e.g. the fused Pallas RMSF path (ops/pallas_rmsf.py), which reads
+    the int16 block twice and materializes no dequantized copy.
+    Returning None keeps the generic dequant-wrapper path."""
+    get = getattr(analysis, "_quantized_batch", None)
+    if get is None:
+        return None
+    return get(transfer_dtype)
+
+
 def _validate_transfer_dtype(transfer_dtype: str) -> None:
     if transfer_dtype not in ("float32", "int16", "int8", "delta"):
         raise ValueError(
@@ -679,19 +695,23 @@ class JaxExecutor:
                 "kernel (mesh collectives); run it with backend='mesh'")
         bs = batch_size or self.batch_size
         quantize = _quant_mode(self.transfer_dtype)
-        f = analysis._batch_fn()
-        if self.transfer_dtype == "delta":
-            wrapped = _delta_wrapper(f)
-        elif quantize:
-            wrapped = _dequant_wrapper(f)
+        qn = _quantized_native(analysis, self.transfer_dtype)
+        if qn is not None:
+            wrapped, params, sel_idx = qn
         else:
-            wrapped = f
+            f = analysis._batch_fn()
+            if self.transfer_dtype == "delta":
+                wrapped = _delta_wrapper(f)
+            elif quantize:
+                wrapped = _dequant_wrapper(f)
+            else:
+                wrapped = f
+            params, sel_idx = _wrap_for_transfer(
+                analysis._batch_params(), analysis._batch_select(),
+                reader.n_atoms, self.transfer_dtype)
         kernel = _jit_kernel(wrapped)
         fold = analysis._device_fold_fn
         step = _fused_step(wrapped, fold) if fold is not None else None
-        params, sel_idx = _wrap_for_transfer(
-            analysis._batch_params(), analysis._batch_select(),
-            reader.n_atoms, self.transfer_dtype)
         frames = list(frames)
 
         def put(staged):
@@ -733,7 +753,12 @@ class MeshExecutor:
         # decode-then-wire cold schedule (see _run_batches)
         self.prestage = prestage
 
-    def _build(self, analysis):
+    def _build(self, analysis, qn_fn=None):
+        """``qn_fn``: the quantized-native kernel resolved ONCE by
+        ``execute`` (same `custom is None` guard) — _build must not call
+        ``_quantized_batch`` again, both to avoid a second discarded
+        jitted ``build_params`` dispatch and to keep the kernel/params
+        decision in one place."""
         import jax
         from jax import shard_map
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -746,11 +771,14 @@ class MeshExecutor:
             raise ValueError(
                 "atom-sharded (ring) kernels support transfer_dtype="
                 "'float32' only")
-        f = analysis._batch_fn()
-        if delta:
-            f = _delta_wrapper(f)
-        elif quantize:
-            f = _dequant_wrapper(f)
+        if qn_fn is not None:
+            f = qn_fn
+        else:
+            f = analysis._batch_fn()
+            if delta:
+                f = _delta_wrapper(f)
+            elif quantize:
+                f = _dequant_wrapper(f)
         devcombine = analysis._device_combine
         if custom is not None and devcombine is None:
             raise ValueError(
@@ -850,12 +878,17 @@ class MeshExecutor:
         import jax
 
         bs = batch_size or self.batch_size
+        qn = (_quantized_native(analysis, self.transfer_dtype)
+              if analysis._batch_specs(self.axis_name) is None else None)
         bs_factor, gfn, shardings, params_specs, gfn_fused = self._build(
-            analysis)
+            analysis, qn_fn=qn[0] if qn is not None else None)
         global_bs = bs * bs_factor
-        params, sel_idx = _wrap_for_transfer(
-            analysis._batch_params(), analysis._batch_select(),
-            reader.n_atoms, self.transfer_dtype)
+        if qn is not None:
+            params, sel_idx = qn[1], qn[2]
+        else:
+            params, sel_idx = _wrap_for_transfer(
+                analysis._batch_params(), analysis._batch_select(),
+                reader.n_atoms, self.transfer_dtype)
         frames = list(frames)
         fused_call = (None if gfn_fused is None else
                       lambda total, *staged: gfn_fused(total, params,

@@ -1,0 +1,278 @@
+"""Fused Pallas RMSF kernels (ops/pallas_rmsf.py).
+
+Differential strategy (SURVEY.md §4): the fused quantized-native path
+must reproduce (a) the production dequant→superpose→moments kernel on
+the SAME staged int16 bytes, (b) a NumPy float64 oracle, and (c) the
+serial backend end-to-end through AlignedRMSF(engine='fused').  The
+Pallas sweeps run in interpret mode on CPU (same policy as
+tests/test_pallas.py); 'xla' is the identical algebra as plain XLA ops
+and is cross-checked against interpret mode bit-for-bit-ish (1e-5).
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from mdanalysis_mpi_tpu.analysis.rms import (  # noqa: E402
+    AlignedRMSF, _aligned_moments_kernel)
+from mdanalysis_mpi_tpu.ops import pallas_rmsf as pr  # noqa: E402
+from mdanalysis_mpi_tpu.parallel.executors import (  # noqa: E402
+    quantize_block)
+from mdanalysis_mpi_tpu.testing import make_protein_universe  # noqa: E402
+
+
+def _random_case(rng, b, s, valid_b=None, scale=20.0):
+    """Staged-int16 test case + its float64 dequantized truth."""
+    block = rng.normal(scale=scale, size=(b, s, 3)).astype(np.float32)
+    q, inv = quantize_block(block, "int16")
+    x64 = q.astype(np.float64) * float(inv)
+    masses = rng.uniform(1.0, 16.0, size=s)
+    ref = rng.normal(scale=scale, size=(s, 3))
+    com = (ref * (masses / masses.sum())[:, None]).sum(0)
+    ref_c = ref - com
+    mask = np.zeros(b, np.float32)
+    mask[:b if valid_b is None else valid_b] = 1.0
+    return q, inv, x64, masses, ref_c, com, mask
+
+
+def _oracle_moments(x64, masses, ref_c, ref_com, mask):
+    """NumPy float64 oracle of the reference's pass-2 body
+    (RMSF.py:124-138): per-frame COM, Kabsch, rotate, then mean/M2 over
+    the valid frames."""
+    w = masses / masses.sum()
+    aligned = []
+    for f in range(x64.shape[0]):
+        if mask[f] == 0:
+            continue
+        x = x64[f]
+        com = (x * w[:, None]).sum(0)
+        h = (x - com).T @ ref_c
+        u, _, vt = np.linalg.svd(h)
+        d = np.sign(np.linalg.det(u @ vt))
+        u[:, -1] *= d
+        aligned.append((x - com) @ (u @ vt) + ref_com)
+    a = np.asarray(aligned)
+    t = float(a.shape[0])
+    mean = a.mean(0)
+    m2 = ((a - mean) ** 2).sum(0)
+    return t, mean, m2
+
+
+def _fused(engine, q, inv, masses, ref_c, ref_com, mask):
+    s = q.shape[1]
+    idx_p, n_real = pr.pad_selection(np.arange(s))
+    params = pr.build_params(ref_c, ref_com, masses, n_real, len(idx_p))
+    # stage the padded selection the way the executor does: gather
+    q_p = q[:, idx_p]
+    fn = pr.moments_kernel_for(engine, n_real)
+    t, mean, m2 = jax.jit(fn)(params, q_p, np.float32(inv), None,
+                              jnp.asarray(mask))
+    return float(t), np.asarray(mean), np.asarray(m2)
+
+
+@pytest.mark.parametrize("engine", ["xla", "interpret"])
+@pytest.mark.parametrize("s", [37, 256, 300])
+def test_fused_matches_f64_oracle(engine, s):
+    rng = np.random.default_rng(3)
+    q, inv, x64, masses, ref_c, com, mask = _random_case(rng, 16, s)
+    t, mean, m2 = _fused(engine, q, inv, masses, ref_c, com, mask)
+    t0, mean0, m20 = _oracle_moments(x64, masses, ref_c, com, mask)
+    assert t == t0
+    np.testing.assert_allclose(mean, mean0, atol=5e-4)
+    np.testing.assert_allclose(m2, m20, rtol=2e-4, atol=5e-3)
+
+
+def test_interpret_matches_xla_closely():
+    rng = np.random.default_rng(7)
+    q, inv, _, masses, ref_c, com, mask = _random_case(rng, 16, 512)
+    r1 = _fused("xla", q, inv, masses, ref_c, com, mask)
+    r2 = _fused("interpret", q, inv, masses, ref_c, com, mask)
+    np.testing.assert_allclose(r1[1], r2[1], atol=2e-4)
+    np.testing.assert_allclose(r1[2], r2[2], rtol=2e-4, atol=2e-3)
+
+
+def test_fused_matches_production_dequant_kernel():
+    """Same staged int16 bytes through the fused path and through the
+    production dequant→superpose→batch_moments kernel."""
+    rng = np.random.default_rng(11)
+    q, inv, _, masses, ref_c, com, mask = _random_case(rng, 16, 300)
+    t, mean, m2 = _fused("interpret", q, inv, masses, ref_c, com, mask)
+    x = jnp.asarray(q, jnp.float32) * inv
+    params = (jnp.asarray(masses, jnp.float32),
+              jnp.asarray(ref_c, jnp.float32),
+              jnp.asarray(com, jnp.float32))
+    t0, mean0, m20 = jax.jit(_aligned_moments_kernel)(
+        params, x, None, jnp.asarray(mask))
+    assert t == float(t0)
+    np.testing.assert_allclose(mean, np.asarray(mean0), atol=2e-4)
+    np.testing.assert_allclose(m2, np.asarray(m20), rtol=3e-4, atol=2e-3)
+
+
+@pytest.mark.parametrize("engine", ["xla", "interpret"])
+def test_frame_mask_excludes_padding(engine):
+    """Padding frames carry garbage (the executor pads by repeating the
+    last frame); masked results must depend only on valid rows."""
+    rng = np.random.default_rng(5)
+    q, inv, x64, masses, ref_c, com, mask = _random_case(
+        rng, 16, 256, valid_b=9)
+    # poison padded rows to prove the mask wins
+    q = q.copy()
+    q[9:] = 31000
+    t, mean, m2 = _fused(engine, q, inv, masses, ref_c, com, mask)
+    t0, mean0, m20 = _oracle_moments(x64, masses, ref_c, com, mask)
+    assert t == t0 == 9.0
+    np.testing.assert_allclose(mean, mean0, atol=5e-4)
+    np.testing.assert_allclose(m2, m20, rtol=2e-4, atol=5e-3)
+
+
+def test_unaligned_batch_falls_back_to_xla():
+    """B not a multiple of FRAME_TILE resolves to the XLA form at trace
+    time — same fn identity, correct result, no error."""
+    rng = np.random.default_rng(9)
+    q, inv, x64, masses, ref_c, com, mask = _random_case(rng, 10, 256)
+    t, mean, m2 = _fused("interpret", q, inv, masses, ref_c, com, mask)
+    t0, mean0, m20 = _oracle_moments(x64, masses, ref_c, com, mask)
+    np.testing.assert_allclose(m2, m20, rtol=2e-4, atol=5e-3)
+
+
+@pytest.mark.parametrize("engine", ["xla", "interpret"])
+def test_avg_kernel_matches_oracle(engine):
+    rng = np.random.default_rng(13)
+    q, inv, x64, masses, ref_c, com, mask = _random_case(rng, 16, 300)
+    s = q.shape[1]
+    idx_p, n_real = pr.pad_selection(np.arange(s))
+    params = pr.build_params(ref_c, com, masses, n_real, len(idx_p))
+    fn = pr.avg_kernel_for(engine, n_real)
+    t, acc = jax.jit(fn)(params, q[:, idx_p], np.float32(inv), None,
+                         jnp.asarray(mask))
+    t0, mean0, _ = _oracle_moments(x64, masses, ref_c, com, mask)
+    np.testing.assert_allclose(np.asarray(acc) / float(t), mean0,
+                               atol=5e-4)
+
+
+def test_per_frame_inv_scale():
+    """Multi-host int16 staging ships a (B, 1, 1) per-frame scale; the
+    fused core must honor it."""
+    rng = np.random.default_rng(17)
+    q, inv, x64, masses, ref_c, com, mask = _random_case(rng, 16, 256)
+    inv_arr = np.full((16, 1, 1), np.float32(inv))
+    s = q.shape[1]
+    idx_p, n_real = pr.pad_selection(np.arange(s))
+    params = pr.build_params(ref_c, com, masses, n_real, len(idx_p))
+    fn = pr.moments_kernel_for("interpret", n_real)
+    t, mean, m2 = jax.jit(fn)(params, q[:, idx_p], inv_arr, None,
+                              jnp.asarray(mask))
+    t0, mean0, m20 = _oracle_moments(x64, masses, ref_c, com, mask)
+    np.testing.assert_allclose(np.asarray(m2), m20, rtol=2e-4, atol=5e-3)
+
+
+# ---- end-to-end through the executors ----
+
+
+def _rmsf_case(n_residues=40, n_frames=48):
+    return make_protein_universe(n_residues=n_residues, n_frames=n_frames,
+                                 noise=0.3, seed=21)
+
+
+def test_e2e_fused_vs_serial_jax():
+    u = _rmsf_case()
+    serial = AlignedRMSF(u, select="name CA").run(backend="serial")
+    fused = AlignedRMSF(u, select="name CA", engine="fused").run(
+        backend="jax", batch_size=16, transfer_dtype="int16")
+    np.testing.assert_allclose(np.asarray(fused.results.rmsf),
+                               serial.results.rmsf, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(fused.results.average),
+                               np.asarray(serial.results.average),
+                               atol=1e-2)
+
+
+def test_e2e_fused_interpret_pallas(monkeypatch):
+    """Force the Pallas sweeps (interpret mode on CPU) end-to-end."""
+    monkeypatch.setenv("MDTPU_PALLAS", "1")
+    u = _rmsf_case()
+    serial = AlignedRMSF(u, select="name CA").run(backend="serial")
+    fused = AlignedRMSF(u, select="name CA", engine="fused").run(
+        backend="jax", batch_size=16, transfer_dtype="int16")
+    np.testing.assert_allclose(np.asarray(fused.results.rmsf),
+                               serial.results.rmsf, atol=1e-3)
+
+
+def test_e2e_fused_multibatch_fold():
+    """Cross-batch Chan fold over fused partials (batch_size smaller
+    than the trajectory)."""
+    u = _rmsf_case(n_frames=56)
+    serial = AlignedRMSF(u, select="name CA").run(backend="serial")
+    fused = AlignedRMSF(u, select="name CA", engine="fused").run(
+        backend="jax", batch_size=16, transfer_dtype="int16")
+    unfused = AlignedRMSF(u, select="name CA").run(
+        backend="jax", batch_size=16, transfer_dtype="int16")
+    np.testing.assert_allclose(np.asarray(fused.results.rmsf),
+                               serial.results.rmsf, atol=1e-3)
+    # fused and unfused consume different staged bytes (padded vs
+    # unpadded selection) but identical physics
+    np.testing.assert_allclose(np.asarray(fused.results.rmsf),
+                               np.asarray(unfused.results.rmsf), atol=5e-4)
+
+
+def test_e2e_fused_mesh():
+    u = _rmsf_case(n_frames=64)
+    serial = AlignedRMSF(u, select="name CA").run(backend="serial")
+    fused = AlignedRMSF(u, select="name CA", engine="fused").run(
+        backend="mesh", batch_size=8, transfer_dtype="int16")
+    np.testing.assert_allclose(np.asarray(fused.results.rmsf),
+                               serial.results.rmsf, atol=1e-3)
+
+
+def test_fused_f32_transfer_ignores_engine():
+    """engine='fused' with float32 staging silently keeps the generic
+    path (the fused kernels are int16-native)."""
+    u = _rmsf_case()
+    serial = AlignedRMSF(u, select="name CA").run(backend="serial")
+    r = AlignedRMSF(u, select="name CA", engine="fused").run(
+        backend="jax", batch_size=16)
+    np.testing.assert_allclose(np.asarray(r.results.rmsf),
+                               serial.results.rmsf, atol=1e-4)
+
+
+def test_pad_selection():
+    idx, n = pr.pad_selection(np.arange(300))
+    assert n == 300 and len(idx) == 512 and (idx[300:] == 0).all()
+    src = np.arange(256)
+    idx2, n2 = pr.pad_selection(src)
+    assert n2 == 256 and idx2 is src  # aligned input: no-copy fast path
+
+
+def test_engine_validation():
+    """A misspelled engine fails loudly at construction (silently
+    taking the unfused path would be a ~78x perf surprise)."""
+    u = _rmsf_case(n_residues=5, n_frames=4)
+    with pytest.raises(ValueError, match="engine"):
+        AlignedRMSF(u, select="name CA", engine="Fused")
+    with pytest.raises(ValueError, match="engine"):
+        AlignedRMSF(u, select="name CA", engine="pallas")
+    # 'auto' and None are accepted aliases for the generic path
+    AlignedRMSF(u, select="name CA", engine="auto")
+
+
+def test_fused_wide_average_rejected():
+    """AverageStructure's wide (all-atom) path has no fused kernel —
+    engine='fused' there must fail at construction, not silently run
+    unfused."""
+    from mdanalysis_mpi_tpu.analysis.align import AverageStructure
+
+    u = _rmsf_case(n_residues=5, n_frames=4)
+    with pytest.raises(ValueError, match="select_only"):
+        AverageStructure(u, select="name CA", engine="fused")
+    AverageStructure(u, select="name CA", select_only=True, engine="fused")
+
+
+def test_fused_rejects_int8_and_delta():
+    """engine='fused' with a wire format the fused kernels cannot
+    consume fails loudly instead of silently taking the unfused path."""
+    u = _rmsf_case(n_residues=5, n_frames=16)
+    for dtype in ("int8", "delta"):
+        with pytest.raises(ValueError, match="fused"):
+            AlignedRMSF(u, select="name CA", engine="fused").run(
+                backend="jax", batch_size=16, transfer_dtype=dtype)
