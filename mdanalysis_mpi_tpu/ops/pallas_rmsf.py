@@ -1,13 +1,26 @@
-"""Fused Pallas kernels for the flagship aligned-moments pipeline.
+"""Fused kernels for the flagship aligned-moments pipeline
+(EXPERIMENTAL, opt-in via ``AlignedRMSF(engine='fused')``).
 
-The steady-state flagship (AlignedRMSF over HBM-cached int16 blocks)
-sits on the HBM bandwidth wall (PERF.md §8b): the unfused XLA path
-models ~48·S bytes/frame of traffic (int16 read + dequantized f32
-intermediates materialized between the dequant, superpose and moments
-stages), against a perfect-fusion floor of 12·S bytes/frame (read the
-int16 block exactly twice).  This module hits that floor: two Pallas
-sweeps over the *quantized* block with nothing but 3x3-sized tensors
-materialized in between.
+Motivation: the steady-state flagship (AlignedRMSF over HBM-cached
+int16 blocks) sits on the HBM bandwidth wall (PERF.md §8b) — the
+generic path models ~48·S bytes/frame against a perfect-fusion floor
+of 12·S (read the int16 block exactly twice).  This module implements
+that floor: two sweeps over the *quantized* block with nothing but
+3x3-sized tensors materialized in between.
+
+**Measured outcome (PERF.md §8e): the fused forms are CORRECT but
+SLOWER on TPU v5e** — the bandwidth they save is repaid in compute.
+The Pallas sweeps are VPU-bound (the interleaved-lane algebra below
+costs ~9 masked/rolled elementwise ops where a planar layout costs
+one; measured 13.8k f/s steady vs the generic path's 306.7k), and the
+XLA form's ``(B,S,3)x(S,3)->(B,3,3)`` contraction maps poorly to the
+MXU (150.5k f/s).  The generic dequant path already runs at ~91% of
+the chip's HBM wall per its own traffic model, so the headroom the
+floor promised is not reachable by fusion on this compiler/chip
+generation.  The path is kept: it is differential-tested, its algebra
+(no-COM Kabsch correlation, ref-shifted cancellation-safe moments) is
+independently useful, and the measured numbers document exactly why
+the generic path is the right default.
 
 Algebra (why two sweeps suffice — the reference computes the same
 quantities per frame at RMSF.py:94-101/124-138):
@@ -41,9 +54,9 @@ Callers pad the *selection* (not the block) so ``S`` is a multiple of
 zero reference row and a zero atom-mask lane, making them exact
 no-ops in every accumulation (see :func:`pad_selection`).
 
-On non-TPU backends the kernels run in Pallas interpret mode for the
-CPU test suite (``MDTPU_PALLAS=1``); ``engine='xla'`` is the identical
-algebra as plain XLA ops — the differential oracle for both.
+On non-TPU backends the Pallas sweeps run in interpret mode for the
+CPU test suite (``MDTPU_RMSF_PALLAS=1``); ``engine='xla'`` is the
+identical algebra as plain XLA ops — the differential oracle for both.
 """
 
 from __future__ import annotations
@@ -52,11 +65,29 @@ import functools
 
 import numpy as np
 
-from mdanalysis_mpi_tpu.ops.pallas_distances import use_pallas
+ATOM_TILE = 256                 # selection-padding granule (atoms)
+FRAME_TILE = 16                 # frame-tile granule (int16 sublane tile)
+# Per-block tile TARGETS.  Blocks must be big enough to amortize the
+# per-grid-step DMA/loop overhead (measured on-chip: 768-lane x 16-frame
+# blocks ran the sweeps at ~12 GB/s, two orders under the HBM wall,
+# because the 24 KB DMAs are latency-bound) while the ~8 live f32
+# temporaries per block stay inside the ~16 MB of VMEM.
+LANE_TILE_TARGET = 6144         # 2048 atoms; multiple of 3*128
+FRAME_TILE_TARGET = 32
 
-ATOM_TILE = 256                 # atoms per lane tile
-LANE_TILE = 3 * ATOM_TILE       # 768 lanes = 256 interleaved triplets
-FRAME_TILE = 16                 # int16 sublane tile
+
+def _tiles(B: int, L: int):
+    """Largest (frame_tile, lane_tile) dividing (B, L) under the
+    targets; both stay multiples of the hardware granules (16 sublanes
+    for int16, 384 lanes = 128 f32 lanes x 3 components so triplets
+    never straddle a block)."""
+    bt = FRAME_TILE_TARGET
+    while bt > FRAME_TILE and B % bt:
+        bt -= FRAME_TILE
+    lt = (LANE_TILE_TARGET // 384) * 384
+    while lt > 384 and L % lt:
+        lt -= 384
+    return bt, lt
 
 
 def pad_selection(idx: np.ndarray):
@@ -76,7 +107,7 @@ def pad_selection(idx: np.ndarray):
 
 
 @functools.lru_cache(maxsize=None)
-def _build_p1(interpret: bool):
+def _build_p1(interpret: bool, bt: int, lt: int):
     """Sweep 1: interleaved int16 block → per-frame (Σ w·x, H).
 
     Grid (nb, ns), lane tiles innermost; the (BT, 3) / (BT, 9) output
@@ -110,18 +141,18 @@ def _build_p1(interpret: bool):
 
     def call(q2, wb, refb):
         B, L = q2.shape
-        grid = (B // FRAME_TILE, L // LANE_TILE)
+        grid = (B // bt, L // lt)
         return pl.pallas_call(
             kernel,
             grid=grid,
             in_specs=[
-                pl.BlockSpec((FRAME_TILE, LANE_TILE), lambda b, s: (b, s)),
-                pl.BlockSpec((1, LANE_TILE), lambda b, s: (0, s)),
-                pl.BlockSpec((3, LANE_TILE), lambda b, s: (0, s)),
+                pl.BlockSpec((bt, lt), lambda b, s: (b, s)),
+                pl.BlockSpec((1, lt), lambda b, s: (0, s)),
+                pl.BlockSpec((3, lt), lambda b, s: (0, s)),
             ],
             out_specs=[
-                pl.BlockSpec((FRAME_TILE, 3), lambda b, s: (b, 0)),
-                pl.BlockSpec((FRAME_TILE, 9), lambda b, s: (b, 0)),
+                pl.BlockSpec((bt, 3), lambda b, s: (b, 0)),
+                pl.BlockSpec((bt, 9), lambda b, s: (b, 0)),
             ],
             out_shape=[
                 jax.ShapeDtypeStruct((B, 3), jnp.float32),
@@ -134,7 +165,7 @@ def _build_p1(interpret: bool):
 
 
 @functools.lru_cache(maxsize=None)
-def _build_p2(interpret: bool):
+def _build_p2(interpret: bool, bt: int, lt: int):
     """Sweep 2: rotate + accumulate deviation sums.
 
     Grid (ns, nb), frame tiles innermost; the (2, LT) output block
@@ -158,9 +189,10 @@ def _build_p2(interpret: bool):
         for i in range(3):
             yi = xc * (lane == i)
             for j in range(3):
-                # value at lane 3n+i moves to lane 3n+j; LANE_TILE is a
-                # multiple of 3 so triplets never straddle the block and
-                # the wrap-around lanes only ever carry zeros of yi.
+                # value at lane 3n+i moves to lane 3n+j; the lane tile
+                # (lt, a multiple of 3 by _tiles' 384-lane granule) keeps
+                # triplets inside one block, so the wrap-around lanes
+                # only ever carry zeros of yi.
                 # shift 0 must bypass roll: Mosaic rejects the
                 # zero-width slice jnp.roll's static path emits for it
                 rolled = yi if j == i else jnp.roll(yi, j - i, axis=1)
@@ -177,20 +209,20 @@ def _build_p2(interpret: bool):
 
     def call(q2, inv_col, com, r9, refi, aml, fm_col):
         B, L = q2.shape
-        grid = (L // LANE_TILE, B // FRAME_TILE)
+        grid = (L // lt, B // bt)
         return pl.pallas_call(
             kernel,
             grid=grid,
             in_specs=[
-                pl.BlockSpec((FRAME_TILE, LANE_TILE), lambda s, b: (b, s)),
-                pl.BlockSpec((FRAME_TILE, 1), lambda s, b: (b, 0)),
-                pl.BlockSpec((FRAME_TILE, 3), lambda s, b: (b, 0)),
-                pl.BlockSpec((FRAME_TILE, 9), lambda s, b: (b, 0)),
-                pl.BlockSpec((1, LANE_TILE), lambda s, b: (0, s)),
-                pl.BlockSpec((1, LANE_TILE), lambda s, b: (0, s)),
-                pl.BlockSpec((FRAME_TILE, 1), lambda s, b: (b, 0)),
+                pl.BlockSpec((bt, lt), lambda s, b: (b, s)),
+                pl.BlockSpec((bt, 1), lambda s, b: (b, 0)),
+                pl.BlockSpec((bt, 3), lambda s, b: (b, 0)),
+                pl.BlockSpec((bt, 9), lambda s, b: (b, 0)),
+                pl.BlockSpec((1, lt), lambda s, b: (0, s)),
+                pl.BlockSpec((1, lt), lambda s, b: (0, s)),
+                pl.BlockSpec((bt, 1), lambda s, b: (b, 0)),
             ],
-            out_specs=pl.BlockSpec((2, LANE_TILE), lambda s, b: (0, s)),
+            out_specs=pl.BlockSpec((2, lt), lambda s, b: (0, s)),
             out_shape=jax.ShapeDtypeStruct((2, L), jnp.float32),
             interpret=interpret,
         )(q2, inv_col, com, r9, refi, aml, fm_col)
@@ -204,7 +236,7 @@ def _resolve_engine(engine: str, B: int, L: int) -> str:
     identical-algebra XLA path at trace time (same fn identity, the
     shape-keyed jit cache keeps both compiled forms)."""
     if engine in ("pallas", "interpret"):
-        if B % FRAME_TILE == 0 and L % LANE_TILE == 0 and L > 0:
+        if B % FRAME_TILE == 0 and L % 384 == 0 and L > 0:
             return engine
         return "xla"
     return "xla"
@@ -239,12 +271,13 @@ def _core(engine: str, q, inv_scale, wN, refc_p, amask, sref, fmask):
         refb = jnp.repeat(refc_p.T, 3, axis=1)
         refi = refc_p.reshape(1, 3 * S)
         aml = jnp.repeat(amask.reshape(1, S), 3, axis=1).reshape(1, 3 * S)
-        sxw, h9 = _build_p1(interpret)(q2, wb, refb)
+        bt, lt = _tiles(B, 3 * S)
+        sxw, h9 = _build_p1(interpret, bt, lt)(q2, wb, refb)
         com = sxw * inv_col
         h = h9.reshape(B, 3, 3) * inv_col[:, :, None]
         h = h - com[:, :, None] * sref[None, None, :]
         r = kabsch_from_correlation(h)
-        sums = _build_p2(interpret)(
+        sums = _build_p2(interpret, bt, lt)(
             q2, inv_col, com, r.reshape(B, 9), refi, aml, fm_col)
         sum_d = sums[0].reshape(S, 3)
         sumsq = sums[1].reshape(S, 3)
@@ -315,9 +348,16 @@ def avg_kernel_for(engine: str, n_real: int):
 
 
 def default_engine() -> str:
-    """'pallas' on a real TPU backend, else the XLA form of the same
-    algebra (interpret mode is opt-in for tests via MDTPU_PALLAS=1)."""
-    return "pallas" if use_pallas() else "xla"
+    """The XLA form everywhere: measured on-chip (PERF.md §8e), the
+    Pallas sweeps lose to it ~11x (VPU-bound interleave algebra), so
+    unlike pallas_distances the hardware default is NOT pallas.
+    ``MDTPU_RMSF_PALLAS=1`` opts into the Pallas sweeps (on TPU;
+    interpret mode elsewhere) for kernel work/measurement."""
+    import os
+
+    if os.environ.get("MDTPU_RMSF_PALLAS", "0") in ("1", "true", "yes"):
+        return "pallas"
+    return "xla"
 
 
 VALID_ENGINES = (None, "auto", "fused")

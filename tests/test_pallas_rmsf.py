@@ -190,7 +190,7 @@ def test_e2e_fused_vs_serial_jax():
 
 def test_e2e_fused_interpret_pallas(monkeypatch):
     """Force the Pallas sweeps (interpret mode on CPU) end-to-end."""
-    monkeypatch.setenv("MDTPU_PALLAS", "1")
+    monkeypatch.setenv("MDTPU_RMSF_PALLAS", "1")
     u = _rmsf_case()
     serial = AlignedRMSF(u, select="name CA").run(backend="serial")
     fused = AlignedRMSF(u, select="name CA", engine="fused").run(
