@@ -99,4 +99,20 @@ rt = float(np.abs(rec - ub.trajectory[0].positions.astype(np.float64)
 print(f"BAT round-trip error: {rt:.2e}")
 assert rt < 1e-5
 
+# -- AnalysisCollection: several analyses, ONE staged trajectory pass --
+from mdanalysis_mpi_tpu.analysis import (AnalysisCollection,  # noqa: E402
+                                         AverageStructure, RMSF)
+
+up = make_protein_universe(n_residues=20, n_frames=12, noise=0.3, seed=5)
+coll = AnalysisCollection(
+    RMSF(up.select_atoms("name CA")),
+    AverageStructure(up, select="protein and not name H*",
+                     select_only=True))
+coll.run(backend="jax", batch_size=4)      # one staged union block
+solo = RMSF(up.select_atoms("name CA")).run(backend="serial")
+cerr = float(np.abs(np.asarray(coll.analyses[0].results.rmsf)
+                    - solo.results.rmsf).max())
+print(f"collection RMSF vs solo serial: {cerr:.2e}")
+assert cerr < 1e-4
+
 print("ROUND5_TOUR_OK")

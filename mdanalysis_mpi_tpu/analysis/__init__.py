@@ -25,10 +25,13 @@ SurvivalProbability/WaterOrientationalRelaxation/AngularDistribution/
 MeanSquareDisplacement, DielectricConstant, PSAnalysis
 (hausdorff/discrete_frechet), PersistenceLength, HELANAL, BAT, DSSP,
 encore.hes, NucPairDist/WatsonCrickDist, LeafletFinder
-(+ optimize_cutoff), sequence_alignment, AnalysisFromFunction.
+(+ optimize_cutoff), sequence_alignment, AnalysisFromFunction, and
+AnalysisCollection (N analyses over ONE staged trajectory pass).
 """
 
-from mdanalysis_mpi_tpu.analysis.base import (AnalysisBase, Results,
+from mdanalysis_mpi_tpu.analysis.base import (AnalysisBase,
+                                               AnalysisCollection,
+                                               Results,
                                                AnalysisFromFunction,
                                                analysis_class)
 from mdanalysis_mpi_tpu.analysis.rms import RMSF, RMSD, AlignedRMSF, rmsd
@@ -70,7 +73,8 @@ from mdanalysis_mpi_tpu.analysis.nucleicacids import (
     NucPairDist, WatsonCrickDist,
 )
 
-__all__ = ["AnalysisBase", "Results", "AnalysisFromFunction",
+__all__ = ["AnalysisBase", "AnalysisCollection", "Results",
+           "AnalysisFromFunction",
            "analysis_class", "RMSF", "RMSD", "AlignedRMSF", "rmsd",
            "AverageStructure", "AlignTraj", "alignto", "rotation_matrix",
            "InterRDF", "InterRDF_s", "ContactMap",

@@ -402,3 +402,235 @@ def analysis_class(function):
 
     _Wrapped.__name__ = getattr(function, "__name__", "AnalysisFromFunction")
     return _Wrapped
+
+
+# ---- AnalysisCollection (upstream analysis.base.AnalysisCollection) ----
+
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=None)
+def _collection_kernel_for(fns):
+    """One batch kernel running every child kernel on its slice of the
+    staged UNION block.  ``params`` is a tuple of (slots, child_params):
+    slots gathers the child's selection out of the union on device
+    (None = the child consumes the staged block as-is).  Stable
+    identity per child-kernel tuple → compiles survive run() calls."""
+
+    def kernel(params, batch, boxes, mask):
+        outs = []
+        for fn, (slots, p) in zip(fns, params):
+            b = batch if slots is None else batch[:, slots]
+            outs.append(fn(p, b, boxes, mask))
+        return tuple(outs)
+
+    kernel.__name__ = "collection_" + "_".join(f.__name__ for f in fns)
+    return kernel
+
+
+@_functools.lru_cache(maxsize=None)
+def _collection_fold_for(folds):
+    def fold(tot, part):
+        return tuple(f(t, p) for f, t, p in zip(folds, tot, part))
+
+    return fold
+
+
+@_functools.lru_cache(maxsize=None)
+def _collection_combine_for(combines):
+    def combine(partials, axis_name):
+        return tuple(c(p, axis_name) for c, p in zip(combines, partials))
+
+    return combine
+
+
+class AnalysisCollection(AnalysisBase):
+    """Run several analyses over the SAME trajectory in ONE pass
+    (upstream 2.8's ``analysis.base.AnalysisCollection``)::
+
+        coll = AnalysisCollection(RMSF(ca), RadiusOfGyration(protein))
+        coll.run(backend="jax")
+        coll.analyses[0].results.rmsf
+
+    Why this matters more here than upstream: on the TPU backends the
+    wall clock is dominated by decode + staging (PERF.md §1), and a
+    collection stages each frame block ONCE for all children — the
+    union of the children's selections is gathered host-side, and each
+    child's kernel slices its atoms back out on device (the same slot
+    trick as ``RMSD(groupselections=...)``).  The reference's analog
+    cost is its per-pass re-decode of every frame (RMSF.py:92,124).
+
+    Constraints: children must be hook-driven — any analysis whose
+    class overrides ``run()`` (AlignedRMSF, AlignTraj, PCA,
+    DiffusionMap, PSAnalysis, the waterdynamics family, ...) is
+    rejected at construction, and collections do not nest.  On the
+    batch and MPI backends the children must be EITHER all reductions
+    (analyses with a device fold — RMSF, AverageStructure, GNM, ...)
+    or all time-series (RMSD, RadiusOfGyration, ...), not a mix — the
+    executors fold or concatenate a run's partials uniformly
+    (``_run_batches``); a mixed collection raises when those backends
+    resolve the fold, with the split spelled out, while
+    ``backend='serial'`` runs any mix.  Ring (atom-sharded) analyses
+    cannot join a collection's batch path.
+    """
+
+    def __init__(self, *analyses, verbose: bool = False):
+        if not analyses:
+            raise ValueError("AnalysisCollection needs at least one analysis")
+        traj = analyses[0]._universe.trajectory
+        for a in analyses[1:]:
+            if a._universe.trajectory is not traj:
+                raise ValueError(
+                    "all analyses in a collection must share one "
+                    "trajectory (upstream contract); got distinct "
+                    "readers — run them separately")
+        for a in analyses:
+            if isinstance(a, AnalysisCollection):
+                raise ValueError(
+                    "collections do not nest; pass the inner "
+                    "collection's analyses directly")
+            # children whose algorithm lives in a run() override
+            # (ANY class overriding run(): multi-pass orchestration
+            # like AlignedRMSF/PCA/DiffusionMap, map-style AlignTraj,
+            # extra run() kwargs like SurvivalProbability) cannot be
+            # driven through the per-frame / batch hooks alone — the
+            # collection never calls their run(), so accepting them
+            # would crash deep inside hooks with no hint of the real
+            # incompatibility
+            if type(a).run is not AnalysisBase.run:
+                raise ValueError(
+                    f"{type(a).__name__} overrides run() (its "
+                    "algorithm or signature lives there) and cannot "
+                    "join a collection; run it separately")
+        super().__init__(analyses[0]._universe, verbose)
+        self.analyses = list(analyses)
+        # batch-path eligibility is resolved lazily (properties below):
+        # the serial backend never touches folds/combines, so any mix
+        # of reductions and time-series runs there; the batch and MPI
+        # backends read these attributes and get the loud error
+        folds = tuple(a._device_fold_fn for a in analyses)
+        self._mixed_folds = (any(f is not None for f in folds)
+                             and not all(f is not None for f in folds))
+        self._folds = folds
+        self._combines = tuple(a._device_combine for a in analyses)
+        # side-effect-free ring detection: a child that declares custom
+        # shard specs (or is mesh-only) cannot consume the collection's
+        # union block
+        self._ring_children = [
+            type(a).__name__ for a in analyses
+            if (getattr(a, "_mesh_only", False)
+                or type(a)._batch_specs is not AnalysisBase._batch_specs)]
+
+    def _mix_error(self):
+        red = [type(a).__name__ for a, f in zip(self.analyses, self._folds)
+               if f is not None]
+        ser = [type(a).__name__ for a, f in zip(self.analyses, self._folds)
+               if f is None]
+        return ValueError(
+            "a collection's batch/MPI path needs all-reduction or "
+            f"all-time-series children, not a mix (reductions: {red}; "
+            f"series: {ser}); split into two collections or run with "
+            "backend='serial'")
+
+    @property
+    def _device_fold_fn(self):
+        if self._mixed_folds:
+            raise self._mix_error()
+        if all(f is not None for f in self._folds):
+            return _collection_fold_for(self._folds)
+        return None
+
+    @property
+    def _device_combine(self):
+        if self._mixed_folds:
+            raise self._mix_error()
+        if all(c is not None for c in self._combines):
+            return _collection_combine_for(self._combines)
+        if any(c is not None for c in self._combines):
+            # a reduction child without a psum combine cannot ride the
+            # mesh concatenation path its siblings would force —
+            # mirrors the fold-mix loudness (mesh-only condition, so
+            # raise only when the mesh executor actually reads this)
+            mixed = [type(a).__name__
+                     for a, c in zip(self.analyses, self._combines)
+                     if c is None]
+            raise ValueError(
+                "a mesh collection needs every child to declare a "
+                f"_device_combine psum merge; missing on: {mixed} — "
+                "run those children separately or add the combine")
+        return None
+
+    def _check_ring_children(self):
+        if self._ring_children:
+            raise ValueError(
+                f"{self._ring_children} use atom-sharded (ring) "
+                "kernels and cannot consume a collection's union "
+                "block; run them separately (serial runs of a "
+                "collection never hit this)")
+
+    def _prepare(self):
+        for a in self.analyses:
+            if not a._accepts_updating_groups:
+                a._refuse_updating_groups()
+            a.n_frames = self.n_frames
+            a._frame_indices = self._frame_indices
+            a._prepare()
+        self._compute_union()
+
+    def _single_frame(self, ts):
+        for a in self.analyses:
+            a._single_frame(ts)
+
+    def _serial_summary(self):
+        return tuple(a._serial_summary() for a in self.analyses)
+
+    def _identity_partials(self):
+        return tuple(a._identity_partials() for a in self.analyses)
+
+    def _compute_union(self):
+        """Union selection + per-child slot arrays, computed once at
+        _prepare time (the executors may evaluate _batch_params before
+        _batch_select)."""
+        sels = [a._batch_select() for a in self.analyses]
+        if any(s is None for s in sels):
+            # some child consumes whole frames: stage full frames, each
+            # selected child gathers its absolute indices on device
+            self._union = None
+            self._slots = tuple(
+                None if s is None else np.asarray(s) for s in sels)
+            return
+        union = np.unique(np.concatenate([np.asarray(s) for s in sels]))
+        slots = []
+        for s in sels:
+            pos = np.searchsorted(union, np.asarray(s))
+            if len(pos) == len(union) and np.array_equal(
+                    pos, np.arange(len(union))):
+                pos = None          # child's selection IS the union
+            slots.append(pos)
+        self._union = union
+        self._slots = tuple(slots)
+
+    def _batch_select(self):
+        return self._union
+
+    def _batch_specs(self, axis_name):
+        self._check_ring_children()
+        return None
+
+    def _batch_fn(self):
+        self._check_ring_children()
+        return _collection_kernel_for(
+            tuple(a._batch_fn() for a in self.analyses))
+
+    def _batch_params(self):
+        import jax.numpy as jnp
+
+        return tuple(
+            (None if s is None else jnp.asarray(s), a._batch_params())
+            for s, a in zip(self._slots, self.analyses))
+
+    def _conclude(self, total):
+        for a, t in zip(self.analyses, total):
+            a._last_total = t
+            a._conclude(t)
+        self.results.analyses = [a.results for a in self.analyses]

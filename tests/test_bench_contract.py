@@ -89,7 +89,13 @@ def test_bench_outage_records_host_legs(tmp_path):
     partial = str(tmp_path / "partial.json")
     env = dict(
         os.environ,
-        JAX_PLATFORMS="no_such_platform",   # every probe fails fast
+        # the axon site hook rewrites JAX_PLATFORMS, and with a LIVE
+        # tunnel a rewritten probe would succeed and void the outage
+        # simulation (observed round 5); an unknown XLA flag instead
+        # fatally aborts any jax init — probe and main alike —
+        # independent of hook and tunnel state
+        XLA_FLAGS="--xla_no_such_flag_outage_sim=1",
+        JAX_PLATFORMS="no_such_platform",
         BENCH_ATOMS="2000",
         BENCH_FRAMES="96",
         BENCH_BATCH="32",
