@@ -65,7 +65,9 @@ python bench.py 2>"/tmp/bench_r${NN}.log" | tail -1 \
 echo "[record]   -> $(head -c 200 "BENCH_r${NN}_builder.json")" >&2
 
 if [ "$QUICK" != "quick" ]; then
-    for B in 128 256; do
+    # 64 = the pre-round-5 default (cross-round continuity); 256 = the
+    # second-best sweep point.  The new bench default is 512.
+    for B in 64 256; do
         echo "[record] bench sweep BENCH_BATCH=$B..." >&2
         BENCH_BATCH=$B python bench.py 2>>"/tmp/bench_r${NN}.log" \
             | tail -1 > "BENCH_r${NN}_b${B}.json"
