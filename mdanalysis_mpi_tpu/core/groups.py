@@ -77,6 +77,13 @@ class AtomGroup:
             raise AttributeError("topology has no charges")
         return ch[self._indices]
 
+    @property
+    def radii(self) -> np.ndarray:
+        r = self._universe.topology.radii
+        if r is None:
+            raise AttributeError("topology has no radii (PQR-style)")
+        return r[self._indices]
+
     # ---- dynamic attributes (gathered from the current Timestep) ----
 
     @property

@@ -25,7 +25,7 @@ Grammar (recursive descent)::
               | ('name'|'resname'|'segid'|'chainID'|'element'|'type') value+
               | ('resid'|'resnum') range+
               | ('index'|'bynum') range+
-              | 'prop' ['abs'] ('mass'|'charge'|'x'|'y'|'z') cmp number
+              | 'prop' ['abs'] ('mass'|'charge'|'radius'|'x'|'y'|'z') cmp number
     value    := token with optional fnmatch globs (* ?)
     range    := N | N:M | N-M        (inclusive, MDAnalysis convention)
 
@@ -531,6 +531,10 @@ class _Parser:
             if t.charges is None:
                 raise SelectionError("topology has no charges for 'prop charge'")
             arr = t.charges
+        elif what == "radius":
+            if t.radii is None:
+                raise SelectionError("topology has no radii for 'prop radius'")
+            arr = t.radii
         elif what in ("x", "y", "z"):
             positions, _ = self._coords()
             if positions is None:

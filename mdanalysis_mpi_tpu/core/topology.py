@@ -34,6 +34,7 @@ class Topology:
     elements: np.ndarray | None = None     # U-str element symbols
     masses: np.ndarray | None = None       # float64 masses (u)
     charges: np.ndarray | None = None      # float64 partial charges (e)
+    radii: np.ndarray | None = None        # float64 atomic radii (Å; PQR)
     resindices: np.ndarray | None = None   # int 0-based residue index
     bonds: np.ndarray | None = None        # (n_bonds, 2) int atom indices
     _derived: dict = field(default_factory=dict, repr=False)
@@ -76,6 +77,7 @@ class Topology:
             elements=None if self.elements is None else self.elements[idx],
             masses=None if self.masses is None else self.masses[idx],
             charges=None if self.charges is None else self.charges[idx],
+            radii=None if self.radii is None else self.radii[idx],
             resindices=dense,
             bonds=bonds,
         )
@@ -120,6 +122,9 @@ class Topology:
         if self.charges is not None:
             self.charges = _check_len(
                 np.asarray(self.charges, dtype=np.float64), "charges")
+        if self.radii is not None:
+            self.radii = _check_len(
+                np.asarray(self.radii, dtype=np.float64), "radii")
         if self.resindices is None:
             # New residue whenever (resid, segid) changes between
             # consecutive atoms — the standard file-order convention.
@@ -338,6 +343,8 @@ def concatenate(tops: list[Topology]) -> Topology:
         masses=np.concatenate([t.masses for t in tops]),
         charges=(np.concatenate([t.charges for t in tops])
                  if all(t.charges is not None for t in tops) else None),
+        radii=(np.concatenate([t.radii for t in tops])
+               if all(t.radii is not None for t in tops) else None),
         bonds=(np.concatenate(bond_parts) if bond_parts else None),
         resindices=np.concatenate(res_parts),
     )

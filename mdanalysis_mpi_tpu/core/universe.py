@@ -106,7 +106,8 @@ class Universe:
     #: atom float arrays only; structural attributes (names, resids,
     #: bonds) define identity and are construction-time.
     _SETTABLE_ATTRS = {"charges": "charges", "masses": "masses",
-                       "charge": "charges", "mass": "masses"}
+                       "charge": "charges", "mass": "masses",
+                       "radii": "radii", "radius": "radii"}
 
     def add_TopologyAttr(self, name: str, values=None) -> None:
         """Attach a per-atom topology attribute after construction

@@ -66,7 +66,8 @@ def _autoload():
         return
     _autoloaded = True
     try:
-        from mdanalysis_mpi_tpu.io import gro, pdb, psf  # noqa: F401  (self-register)
+        from mdanalysis_mpi_tpu.io import (  # noqa: F401  (self-register)
+            gro, mol2, pdb, pqr, psf)
     except ImportError:
         pass
     register("tpr", _tpr)
