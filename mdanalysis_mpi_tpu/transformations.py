@@ -354,3 +354,92 @@ class PositionAverager(TransformationBase):
         ts.positions = (self._sum / self.current_avg).astype(np.float32)
         self._last_frame = ts.frame
         return ts
+
+
+class set_dimensions(TransformationBase):
+    """Assign fixed box dimensions to every frame (upstream
+    ``transformations.boxdimensions.set_dimensions``) — the standard
+    fix for trajectories whose format carries no box (XYZ, some DCDs)
+    before PBC-dependent analyses (RDF, minimum-image distances)."""
+
+    def __init__(self, dimensions):
+        dims = np.asarray(dimensions, dtype=np.float32).reshape(-1)
+        if dims.shape != (6,):
+            raise ValueError(
+                "set_dimensions needs [lx, ly, lz, alpha, beta, gamma], "
+                f"got shape {np.asarray(dimensions).shape}")
+        # the ONE shared validator (core.box): an unphysical box —
+        # including geometrically impossible angle combinations with no
+        # volume — must fail here, at build time, not mid-analysis
+        from mdanalysis_mpi_tpu.core.box import valid_box_matrix
+
+        valid_box_matrix(dims, "set_dimensions")
+        self._dims = dims
+
+    def __call__(self, ts):
+        ts.dimensions = self._dims.copy()
+        return ts
+
+
+class NoJump(TransformationBase):
+    """Remove box jumps frame-over-frame (upstream
+    ``transformations.nojump.NoJump``): each atom's displacement since
+    the previous read frame is minimum-imaged, so a particle drifting
+    out of the box keeps its continuous (unwrapped-in-time) trajectory
+    instead of teleporting to the other side.  The first frame read is
+    the anchor.
+
+    Stateful BY DESIGN like :class:`PositionAverager` (the output
+    depends on the previous frame read on this cursor): sequential
+    reads on one cursor only; a non-consecutive jump with
+    ``check_continuity=True`` (default) re-anchors at the new frame
+    (upstream warns and does the same); block staging and
+    ``Universe.copy()`` refuse stateful transformations loudly.
+    Orthorhombic boxes only — the displacement wrap is per-axis
+    (upstream supports triclinic via the lambda-matrix form; this port
+    refuses non-90 angles rather than silently mis-unwrapping).
+    """
+
+    stateful = True
+
+    def __init__(self, check_continuity: bool = True):
+        self._check = bool(check_continuity)
+        self._prev_raw: np.ndarray | None = None
+        self._prev_out: np.ndarray | None = None
+        self._last_frame: int | None = None
+
+    def reset(self) -> None:
+        self._prev_raw = None
+        self._prev_out = None
+        self._last_frame = None
+
+    def __call__(self, ts):
+        box, _ = _require_box(ts, "NoJump")
+        if not np.allclose(box[3:], 90.0, atol=1e-3):
+            raise ValueError(
+                f"NoJump supports orthorhombic boxes only, got angles "
+                f"{box[3:].tolist()}")
+        if (self._check and self._last_frame is not None
+                and ts.frame != self._last_frame + 1):
+            import warnings
+
+            warnings.warn(
+                f"NoJump: non-sequential read (frame {self._last_frame} "
+                f"-> {ts.frame}); re-anchoring — strided/random access "
+                "yields RAW wrapped positions, not a continuous "
+                "trajectory (iterate sequentially for unwrapping)",
+                stacklevel=2)
+            self.reset()
+        x = ts.positions.astype(np.float64)
+        if self._prev_raw is None:
+            self._prev_raw = x
+            self._prev_out = x.copy()
+        else:
+            from mdanalysis_mpi_tpu.ops.host import minimum_image
+
+            d = minimum_image(x - self._prev_raw, box)
+            self._prev_out = self._prev_out + d
+            self._prev_raw = x
+        ts.positions = self._prev_out.astype(np.float32)
+        self._last_frame = ts.frame
+        return ts

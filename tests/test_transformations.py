@@ -327,3 +327,87 @@ def test_transformations_refuse_partially_degenerate_box():
         trf.wrap(u.atoms)(ts)
     with pytest.raises(ValueError, match="degenerate|volume"):
         trf.center_in_box(u.atoms)(ts)
+
+
+class TestSetDimensionsNoJump:
+    def test_set_dimensions(self):
+        from mdanalysis_mpi_tpu.testing import make_protein_universe
+        from mdanalysis_mpi_tpu.transformations import set_dimensions
+
+        u = make_protein_universe(n_residues=4, n_frames=3)
+        assert u.trajectory[0].dimensions is None
+        u.trajectory.add_transformations(
+            set_dimensions([30.0, 40.0, 50.0, 90.0, 90.0, 90.0]))
+        np.testing.assert_allclose(u.trajectory[1].dimensions,
+                                   [30, 40, 50, 90, 90, 90])
+
+    def test_set_dimensions_validates(self):
+        from mdanalysis_mpi_tpu.transformations import set_dimensions
+
+        with pytest.raises(ValueError):
+            set_dimensions([0, 1, 1, 90, 90, 90])
+        with pytest.raises(ValueError, match="lx"):
+            set_dimensions([1, 2, 3])
+        # geometrically impossible angles (no volume) fail at build
+        with pytest.raises(ValueError):
+            set_dimensions([10, 10, 10, 60, 60, 170])
+
+    def test_nojump_unwraps_drift(self):
+        """A particle drifting +1 Å/frame through a 10 Å box, wrapped
+        into [0, 10): NoJump must recover the continuous path."""
+        from mdanalysis_mpi_tpu.core.topology import Topology
+        from mdanalysis_mpi_tpu.core.universe import Universe
+        from mdanalysis_mpi_tpu.io.memory import MemoryReader
+        from mdanalysis_mpi_tpu.transformations import NoJump
+
+        n_frames = 25
+        true_x = 5.0 + np.arange(n_frames)          # crosses twice
+        frames = np.zeros((n_frames, 1, 3), np.float32)
+        frames[:, 0, 0] = true_x % 10.0              # wrapped input
+        top = Topology(names=np.array(["X"]), resnames=np.array(["M"]),
+                       resids=np.array([1]))
+        dims = np.array([10, 10, 10, 90, 90, 90], np.float32)
+        u = Universe(top, MemoryReader(frames, dimensions=dims))
+        u.trajectory.add_transformations(NoJump())
+        got = np.array([u.trajectory[i].positions[0, 0]
+                        for i in range(n_frames)])
+        np.testing.assert_allclose(got, true_x, atol=1e-4)
+
+    def test_nojump_reanchors_on_jump(self):
+        from mdanalysis_mpi_tpu.core.topology import Topology
+        from mdanalysis_mpi_tpu.core.universe import Universe
+        from mdanalysis_mpi_tpu.io.memory import MemoryReader
+        from mdanalysis_mpi_tpu.transformations import NoJump
+
+        frames = np.zeros((8, 1, 3), np.float32)
+        frames[:, 0, 0] = (5.0 + np.arange(8)) % 10.0
+        top = Topology(names=np.array(["X"]), resnames=np.array(["M"]),
+                       resids=np.array([1]))
+        dims = np.array([10, 10, 10, 90, 90, 90], np.float32)
+        u = Universe(top, MemoryReader(frames, dimensions=dims))
+        u.trajectory.add_transformations(NoJump())
+        u.trajectory[0]
+        u.trajectory[1]
+        # random seek: re-anchor WITH a warning, no pretend-unwrap
+        with pytest.warns(UserWarning, match="re-anchoring"):
+            x5 = u.trajectory[5].positions[0, 0]
+        np.testing.assert_allclose(x5, frames[5, 0, 0], atol=1e-5)
+
+    def test_nojump_refuses_triclinic_and_boxless(self):
+        from mdanalysis_mpi_tpu.core.topology import Topology
+        from mdanalysis_mpi_tpu.core.universe import Universe
+        from mdanalysis_mpi_tpu.io.memory import MemoryReader
+        from mdanalysis_mpi_tpu.transformations import NoJump
+
+        frames = np.zeros((2, 1, 3), np.float32)
+        top = Topology(names=np.array(["X"]), resnames=np.array(["M"]),
+                       resids=np.array([1]))
+        u = Universe(top, MemoryReader(frames))
+        u.trajectory.add_transformations(NoJump())
+        with pytest.raises(ValueError, match="NoJump"):
+            u.trajectory[0]
+        dims = np.array([10, 10, 10, 90, 90, 60], np.float32)
+        v = Universe(top, MemoryReader(frames.copy(), dimensions=dims))
+        v.trajectory.add_transformations(NoJump())
+        with pytest.raises(ValueError, match="orthorhombic"):
+            v.trajectory[0]
