@@ -24,7 +24,7 @@ DistanceMatrix/DiffusionMap, VelocityAutocorr, GNMAnalysis,
 SurvivalProbability/WaterOrientationalRelaxation/AngularDistribution/
 MeanSquareDisplacement, DielectricConstant, PSAnalysis
 (hausdorff/discrete_frechet), PersistenceLength, HELANAL, BAT, DSSP,
-encore.hes, NucPairDist/WatsonCrickDist, LeafletFinder
+encore.hes, NucPairDist/WatsonCrickDist, nuclinfo, LeafletFinder
 (+ optimize_cutoff), sequence_alignment, AnalysisFromFunction, and
 AnalysisCollection (N analyses over ONE staged trajectory pass).
 """

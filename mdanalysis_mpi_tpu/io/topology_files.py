@@ -67,7 +67,7 @@ def _autoload():
     _autoloaded = True
     try:
         from mdanalysis_mpi_tpu.io import (  # noqa: F401  (self-register)
-            gro, mol2, pdb, pqr, psf)
+            crd, gro, mol2, pdb, pqr, psf)
     except ImportError:
         pass
     register("tpr", _tpr)
