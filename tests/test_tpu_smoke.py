@@ -195,6 +195,38 @@ dm = AlignedRMSF(um, select="heavy").run(
 ed = float(np.abs(dm.results.rmsf - sm.results.rmsf).max())
 assert ed < 1e-3, f"delta staging diverged on chip: {ed:.2e}"
 print(f"delta wire format on-chip err {ed:.2e}")
+
+# --- round-5 additions: the fused quantized-native kernels (real
+# Mosaic codegen, not interpret mode) and an AnalysisCollection over
+# one staged pass — both against the serial f64 oracle ---
+import os as _os2
+
+# engine choice is read per run (default_engine) and kernels cache
+# per engine string, so flipping the env var is enough
+_os2.environ["MDTPU_RMSF_PALLAS"] = "1"     # exercise the Pallas sweeps
+ff = AlignedRMSF(uf, select="heavy", engine="fused").run(
+    backend="jax", batch_size=16, transfer_dtype="int16")
+ef = float(np.abs(ff.results.rmsf - sf.results.rmsf).max())
+assert ef < 1e-3, f"fused Pallas path diverged on chip: {ef:.2e}"
+_os2.environ.pop("MDTPU_RMSF_PALLAS")
+fx = AlignedRMSF(uf, select="heavy", engine="fused").run(
+    backend="jax", batch_size=16, transfer_dtype="int16")
+ex = float(np.abs(fx.results.rmsf - sf.results.rmsf).max())
+assert ex < 1e-3, f"fused XLA path diverged on chip: {ex:.2e}"
+print(f"fused engine on-chip err pallas {ef:.2e} / xla {ex:.2e}")
+
+from mdanalysis_mpi_tpu.analysis import (AnalysisCollection,
+                                         AverageStructure, RMSF)
+
+coll = AnalysisCollection(
+    RMSF(uf.select_atoms("heavy")),
+    AverageStructure(uf, select="name CA", select_only=True))
+coll.run(backend="jax", batch_size=16, transfer_dtype="int16")
+srf = RMSF(uf.select_atoms("heavy")).run(backend="serial")
+ec = float(np.abs(np.asarray(coll.analyses[0].results.rmsf)
+                  - srf.results.rmsf).max())
+assert ec < 1e-3, f"collection diverged on chip: {ec:.2e}"
+print(f"collection on-chip err {ec:.2e}")
 print("TPU_SMOKE_OK")
 """
 
