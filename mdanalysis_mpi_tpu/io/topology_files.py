@@ -65,9 +65,9 @@ def _autoload():
     if _autoloaded:
         return
     _autoloaded = True
-    try:
-        from mdanalysis_mpi_tpu.io import (  # noqa: F401  (self-register)
-            crd, gro, mol2, pdb, pqr, psf)
-    except ImportError:
-        pass
+    # every topology parser here is pure Python/NumPy: an ImportError
+    # is a programming error and must surface — a swallowed one would
+    # unregister EVERY format and misreport "no topology parser"
+    from mdanalysis_mpi_tpu.io import (  # noqa: F401  (self-register)
+        crd, gro, mol2, pdb, pqr, prmtop, psf)
     register("tpr", _tpr)

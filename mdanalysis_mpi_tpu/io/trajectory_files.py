@@ -28,14 +28,24 @@ def open(path: str, n_atoms: int | None = None):
     return opener(path, n_atoms=n_atoms)
 
 
+_autoloaded = False
+
+
 def _autoload():
-    if _READERS:
+    """Guarded by a flag, not ``_READERS`` truthiness: a format module
+    imported directly (e.g. ``from ...io.inpcrd import read_inpcrd``)
+    self-registers before the first ``open`` call, which must not
+    suppress the remaining registrations (same fix as
+    topology_files)."""
+    global _autoloaded
+    if _autoloaded:
         return
-    # trr/netcdf are pure NumPy: an ImportError from them is always a
+    _autoloaded = True
+    # pure-NumPy modules: an ImportError from them is always a
     # programming error and must surface, unlike the native-backed
     # xtc/dcd modules
     from mdanalysis_mpi_tpu.io import (  # noqa: F401  (self-register)
-        lammps, netcdf, trr, xyz)
+        inpcrd, lammps, netcdf, trr, xyz)
     try:
         from mdanalysis_mpi_tpu.io import xtc, dcd  # noqa: F401  (self-register)
     except ImportError:

@@ -1,0 +1,227 @@
+"""AMBER PRMTOP + INPCRD (upstream TOPParser / INPCRDReader): a
+hand-written prmtop with the quirks that matter (packed 20a4 names, the
+18.2223 charge scale, index*3 bond convention, residue pointers), our
+writer's round trip, and the restart reader's trailing-block
+disambiguation — plus the full AMBER combo prmtop + NetCDF."""
+
+import numpy as np
+import pytest
+
+from mdanalysis_mpi_tpu.core.universe import Universe
+from mdanalysis_mpi_tpu.io.inpcrd import (read_inpcrd, write_inpcrd)
+from mdanalysis_mpi_tpu.io.prmtop import (AMBER_CHARGE_SCALE,
+                                          parse_prmtop, write_prmtop)
+
+PRMTOP = """\
+%VERSION  VERSION_STAMP = V0001.000  DATE = 01/01/01
+%FLAG POINTERS
+%FORMAT(10I8)
+       5       2       1       1       0       0       0       0       0       0
+       0       2       0       0       0       0       0       0       0       0
+       0       0       0       0       0       0       0       0       0       0
+       0       0
+%FLAG ATOM_NAME
+%FORMAT(20a4)
+N   CA  HA1 OW  HW1
+%FLAG CHARGE
+%FORMAT(5E16.8)
+ -7.73130000E+00  1.82223000E+00  3.64446000E+00 -1.51245090E+01  7.56225450E+00
+%FLAG MASS
+%FORMAT(5E16.8)
+  1.40070000E+01  1.20110000E+01  1.00800000E+00  1.59990000E+01  1.00800000E+00
+%FLAG ATOMIC_NUMBER
+%FORMAT(10I8)
+       7       6       1       8       1
+%FLAG RESIDUE_LABEL
+%FORMAT(20a4)
+ALA WAT
+%FLAG RESIDUE_POINTER
+%FORMAT(10I8)
+       1       4
+%FLAG BONDS_INC_HYDROGEN
+%FORMAT(10I8)
+       3       6       1       9      12       2
+%FLAG BONDS_WITHOUT_HYDROGEN
+%FORMAT(10I8)
+       0       3       3
+%FLAG SOME_UNKNOWN_FUTURE_FLAG
+%FORMAT(5E16.8)
+  1.00000000E+00
+"""
+
+
+def test_prmtop_parse(tmp_path):
+    p = tmp_path / "sys.prmtop"
+    p.write_text(PRMTOP)
+    top = parse_prmtop(str(p))
+    assert top.n_atoms == 5
+    assert list(top.names) == ["N", "CA", "HA1", "OW", "HW1"]
+    assert list(top.resnames) == ["ALA", "ALA", "ALA", "WAT", "WAT"]
+    assert list(top.resids) == [1, 1, 1, 2, 2]
+    assert list(top.elements) == ["N", "C", "H", "O", "H"]
+    np.testing.assert_allclose(
+        top.charges,
+        np.array([-7.7313, 1.82223, 3.64446, -15.124509, 7.5622545])
+        / AMBER_CHARGE_SCALE)
+    np.testing.assert_allclose(top.masses,
+                               [14.007, 12.011, 1.008, 15.999, 1.008])
+    # index*3 convention: (3,6)->1-2, (9,12)->3-4, (0,3)->0-1
+    assert sorted(map(tuple, top.bonds.tolist())) == [
+        (0, 1), (1, 2), (3, 4)]
+
+
+def test_prmtop_universe_and_selections(tmp_path):
+    p = tmp_path / "sys.prmtop"
+    p.write_text(PRMTOP)
+    coords = np.zeros((1, 5, 3), np.float32)
+    u = Universe(str(p), coords)
+    assert u.select_atoms("resname WAT").n_atoms == 2
+    assert u.select_atoms("prop mass > 10").n_atoms == 3
+
+
+def test_prmtop_round_trip(tmp_path):
+    p = tmp_path / "sys.prmtop"
+    p.write_text(PRMTOP)
+    u = Universe(str(p), np.zeros((1, 5, 3), np.float32))
+    out = tmp_path / "rt.prmtop"
+    write_prmtop(str(out), u)
+    t2 = parse_prmtop(str(out))
+    assert list(t2.names) == list(u.topology.names)
+    assert list(t2.resnames) == list(u.topology.resnames)
+    np.testing.assert_allclose(t2.charges, u.topology.charges,
+                               atol=1e-7)
+    np.testing.assert_allclose(t2.masses, u.topology.masses)
+    assert sorted(map(tuple, t2.bonds.tolist())) == sorted(
+        map(tuple, u.topology.bonds.tolist()))
+
+
+def test_prmtop_packed_names(tmp_path):
+    """20a4 names with no separators must split by field width."""
+    packed = PRMTOP.replace("N   CA  HA1 OW  HW1", "N1*AC2'BH3TCO5'DHW2E")
+    p = tmp_path / "packed.prmtop"
+    p.write_text(packed)
+    top = parse_prmtop(str(p))
+    assert list(top.names) == ["N1*A", "C2'B", "H3TC", "O5'D", "HW2E"]
+
+
+def _rst_text(coords, vels=None, box=None, natom=None):
+    out = ["fixture", f"{natom if natom is not None else len(coords):5d}"]
+    flat = list(np.asarray(coords, np.float64).reshape(-1))
+    if vels is not None:
+        flat += list(np.asarray(vels, np.float64).reshape(-1))
+    if box is not None:
+        flat += list(np.asarray(box, np.float64))
+    lines = []
+    for k in range(0, len(flat), 6):
+        lines.append("".join(f"{v:12.7f}" for v in flat[k:k + 6]))
+    return "\n".join(out + lines) + "\n"
+
+
+def test_inpcrd_coords_only(tmp_path):
+    c = np.arange(9, dtype=np.float64).reshape(3, 3) / 7.0
+    p = tmp_path / "x.inpcrd"
+    p.write_text(_rst_text(c))
+    coords, vels, box = read_inpcrd(str(p))
+    np.testing.assert_allclose(coords, c, atol=1e-6)
+    assert vels is None and box is None
+
+
+def test_inpcrd_velocities_and_box(tmp_path):
+    rng = np.random.default_rng(1)
+    c = rng.normal(size=(4, 3))
+    v = rng.normal(size=(4, 3))
+    b = [20.0, 21.0, 22.0, 90.0, 90.0, 90.0]
+    p = tmp_path / "x.rst7"
+    p.write_text(_rst_text(c, v, b))
+    coords, vels, box = read_inpcrd(str(p))
+    np.testing.assert_allclose(coords, c, atol=1e-6)
+    np.testing.assert_allclose(vels, v, atol=1e-6)
+    np.testing.assert_allclose(box, b)
+
+
+def test_inpcrd_box_only(tmp_path):
+    c = np.ones((5, 3))
+    b = [10.0, 10.0, 10.0, 90.0, 90.0, 90.0]
+    p = tmp_path / "x.restrt"
+    p.write_text(_rst_text(c, box=b))
+    coords, vels, box = read_inpcrd(str(p))
+    assert vels is None
+    np.testing.assert_allclose(box, b)
+
+
+def test_inpcrd_trailing_garbage_rejected(tmp_path):
+    c = np.ones((5, 3))
+    p = tmp_path / "x.inpcrd"
+    p.write_text(_rst_text(c) + "   1.0000000   2.0000000\n")
+    with pytest.raises(ValueError, match="trailing"):
+        read_inpcrd(str(p))
+
+
+def test_amber_combo_prmtop_inpcrd_netcdf(tmp_path):
+    """The full AMBER stack: prmtop topology + rst7 coordinates, then
+    the same topology over a NetCDF trajectory, analyzed end to end."""
+    from mdanalysis_mpi_tpu.analysis import RMSF
+    from mdanalysis_mpi_tpu.io.netcdf import write_ncdf
+
+    p = tmp_path / "sys.prmtop"
+    p.write_text(PRMTOP)
+    rng = np.random.default_rng(5)
+    c0 = rng.normal(scale=5.0, size=(5, 3))
+    rst = tmp_path / "sys.rst7"
+    rst.write_text(_rst_text(c0))
+    u = Universe(str(p), str(rst))
+    assert u.trajectory.n_frames == 1
+    np.testing.assert_allclose(u.atoms.positions, c0, atol=1e-5)
+
+    frames = (c0[None] + rng.normal(scale=0.2, size=(12, 5, 3))
+              ).astype(np.float32)
+    nc = tmp_path / "md.nc"
+    write_ncdf(str(nc), frames)
+    u2 = Universe(str(p), str(nc))
+    r = RMSF(u2.select_atoms("resname ALA")).run(backend="serial")
+    assert r.results.rmsf.shape == (3,)
+    assert np.isfinite(r.results.rmsf).all()
+
+
+def test_inpcrd_writer_round_trip(tmp_path):
+    p = tmp_path / "sys.prmtop"
+    p.write_text(PRMTOP)
+    rng = np.random.default_rng(8)
+    c0 = rng.normal(scale=5.0, size=(5, 3)).astype(np.float32)
+    u = Universe(str(p), c0[None])
+    out = tmp_path / "out.rst7"
+    vel = rng.normal(size=(5, 3))
+    write_inpcrd(str(out), u, velocities=vel, time=100.0)
+    coords, vels, box = read_inpcrd(str(out))
+    np.testing.assert_allclose(coords, c0, atol=1e-6)
+    np.testing.assert_allclose(vels, vel, atol=1e-6)
+
+
+def test_direct_inpcrd_import_keeps_registry(tmp_path):
+    """Importing io.inpcrd directly must not suppress the other
+    trajectory format registrations (flag-guarded autoload)."""
+    from mdanalysis_mpi_tpu.io import trajectory_files
+
+    trajectory_files._autoload()
+    for ext in ("xtc", "nc", "xyz", "inpcrd"):
+        assert ext in trajectory_files._READERS
+
+
+def test_write_prmtop_empty_group_refuses_or_roundtrips(tmp_path):
+    p = tmp_path / "sys.prmtop"
+    p.write_text(PRMTOP)
+    u = Universe(str(p), np.zeros((1, 5, 3), np.float32))
+    out = tmp_path / "empty.prmtop"
+    write_prmtop(str(out), u.select_atoms("resname NOPE"))
+    t = parse_prmtop(str(out))
+    assert t.n_atoms == 0
+
+
+def test_write_inpcrd_overflow_refused(tmp_path):
+    p = tmp_path / "sys.prmtop"
+    p.write_text(PRMTOP)
+    c = np.zeros((1, 5, 3), np.float32)
+    c[0, 0, 0] = -12345.0
+    u = Universe(str(p), c)
+    with pytest.raises(ValueError, match="F12.7"):
+        write_inpcrd(str(tmp_path / "x.rst7"), u)
