@@ -207,6 +207,23 @@ def write_prmtop(path: str, universe_or_group) -> None:
                     cards(trip, 10, lambda v: f"{v:8d}"))
 
 
+def _parse_top(path: str) -> Topology:
+    """`.top` is claimed by TWO ecosystems: AMBER prmtop (upstream also
+    maps .top here) and GROMACS topologies.  Sniff by content — AMBER
+    card files open with %VERSION/%FLAG lines, GROMACS tops with
+    directives/sections/comments."""
+    with open(path) as fh:
+        for ln in fh:
+            if not ln.strip():
+                continue
+            if ln.startswith("%"):
+                return parse_prmtop(path)
+            from mdanalysis_mpi_tpu.io.itp import parse_itp
+
+            return parse_itp(path)
+    raise ValueError(f"{path!r} is empty")
+
+
 topology_files.register("prmtop", parse_prmtop)
 topology_files.register("parm7", parse_prmtop)
-topology_files.register("top", parse_prmtop)
+topology_files.register("top", _parse_top)
