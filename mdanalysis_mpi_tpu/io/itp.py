@@ -109,6 +109,12 @@ def parse_itp(path: str, defines=()) -> Topology:
             continue
         t = s.split()
         if section == "moleculetype":
+            if t[0] in molecules:
+                raise ValueError(
+                    f"{src}:{lineno}: [moleculetype] {t[0]!r} is "
+                    "redefined (same .itp included twice, or two "
+                    "files defining it) — GROMACS grompp refuses "
+                    "this too")
             current = _Molecule(t[0])
             molecules[t[0]] = current
         elif section == "atoms":
@@ -174,12 +180,9 @@ def parse_itp(path: str, defines=()) -> Topology:
             from mdanalysis_mpi_tpu.core import tables
 
             gaps = masses < 0
-            guessed = np.array([
-                tables.mass_of(tables.guess_element(nm, rn))
-                for nm, rn in zip(np.array(mol.names)[gaps],
-                                  np.array(mol.resnames)[gaps])])
             masses = masses.copy()
-            masses[gaps] = guessed
+            masses[gaps] = tables.guess_masses(
+                np.array(mol.names)[gaps], np.array(mol.resnames)[gaps])
         # replicate ONCE per [molecules] entry with np.tile — a
         # 30000-copy solvent box must not build a 30000-part list
         nm = len(mol.names)
@@ -195,10 +198,15 @@ def parse_itp(path: str, defines=()) -> Topology:
         else:
             bonds = None
         # per-copy residue separation: shift resindices by copy so
-        # identical (resid, segid) in adjacent copies stay distinct
-        base_ri = Topology(
-            names=np.array(mol.names), resnames=np.array(mol.resnames),
-            resids=np.array(mol.resids, np.int64)).resindices
+        # identical (resid, segid) in adjacent copies stay distinct.
+        # The change-point cumsum is derived directly (a throwaway
+        # Topology would run per-atom element/mass guessing for
+        # nothing); segids are constant within a moleculetype
+        rid = np.array(mol.resids, np.int64)
+        change = np.ones(nm, dtype=bool)
+        if nm > 1:
+            change[1:] = rid[1:] != rid[:-1]
+        base_ri = np.cumsum(change) - 1
         nres_mol = int(base_ri.max()) + 1 if nm else 0
         resindices = (np.tile(base_ri, count)
                       + np.repeat(np.arange(count), nm) * nres_mol)

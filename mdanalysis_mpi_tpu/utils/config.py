@@ -142,7 +142,7 @@ def _parser() -> argparse.ArgumentParser:
     p.add_argument("analysis", choices=ANALYSES)
     p.add_argument("topology", help="GRO/PSF/PDB/PQR/MOL2/CRD/PRMTOP/ITP topology file")
     p.add_argument("trajectory", nargs="*", default=None,
-                   help="XTC/DCD/TRR/NetCDF/XYZ/LAMMPS-dump trajectory file(s) — several files "
+                   help="XTC/DCD/TRR/NetCDF/XYZ/LAMMPS-dump/mdcrd/INPCRD trajectory file(s) — several files "
                         "chain into one (restart segments); omit for "
                         "topology coords")
     p.add_argument("--select", default="protein and name CA")

@@ -225,3 +225,100 @@ def test_write_inpcrd_overflow_refused(tmp_path):
     u = Universe(str(p), c)
     with pytest.raises(ValueError, match="F12.7"):
         write_inpcrd(str(tmp_path / "x.rst7"), u)
+
+
+# ---- mdcrd (AMBER ASCII trajectory) ----
+
+
+def test_mdcrd_round_trip_plain(tmp_path):
+    from mdanalysis_mpi_tpu.io.mdcrd import read_mdcrd, write_mdcrd
+
+    rng = np.random.default_rng(3)
+    frames = rng.normal(scale=8.0, size=(5, 7, 3))
+    p = tmp_path / "x.mdcrd"
+    write_mdcrd(str(p), frames)
+    coords, boxes = read_mdcrd(str(p), 7)
+    assert boxes is None
+    np.testing.assert_allclose(coords, frames, atol=1e-3)
+
+
+def test_mdcrd_round_trip_boxed(tmp_path):
+    from mdanalysis_mpi_tpu.io.mdcrd import read_mdcrd, write_mdcrd
+
+    rng = np.random.default_rng(4)
+    frames = rng.normal(scale=8.0, size=(4, 6, 3))
+    box = np.array([30.0, 31.0, 32.0])
+    p = tmp_path / "x.crdbox"
+    write_mdcrd(str(p), frames, boxes=box)
+    coords, boxes = read_mdcrd(str(p), 6)
+    np.testing.assert_allclose(coords, frames, atol=1e-3)
+    np.testing.assert_allclose(boxes[0], [30, 31, 32, 90, 90, 90])
+
+
+def test_mdcrd_universe_combo(tmp_path):
+    from mdanalysis_mpi_tpu.io.mdcrd import write_mdcrd
+
+    p = tmp_path / "sys.prmtop"
+    p.write_text(PRMTOP)
+    rng = np.random.default_rng(6)
+    frames = rng.normal(scale=5.0, size=(8, 5, 3))
+    t = tmp_path / "md.mdcrd"
+    write_mdcrd(str(t), frames)
+    u = Universe(str(p), str(t))
+    assert u.trajectory.n_frames == 8
+    np.testing.assert_allclose(u.trajectory[3].positions, frames[3],
+                               atol=1e-3)
+
+
+def test_mdcrd_line_replay_disambiguates_3mod10(tmp_path):
+    """3n ≡ 3 (mod 10) but n > 1: the per-frame line PATTERN still
+    differs between plain ([...,3]) and boxed ([...,3,3]) layouts, so
+    the replay check resolves it without guessing."""
+    from mdanalysis_mpi_tpu.io.mdcrd import read_mdcrd, write_mdcrd
+
+    rng = np.random.default_rng(7)
+    frames = rng.normal(scale=5.0, size=(2, 11, 3))
+    p = tmp_path / "x.mdcrd"
+    write_mdcrd(str(p), frames, boxes=np.array([20.0, 20, 20]))
+    coords, boxes = read_mdcrd(str(p), 11)
+    np.testing.assert_allclose(coords, frames, atol=1e-3)
+    np.testing.assert_allclose(boxes[:, :3], 20.0)
+
+
+def test_mdcrd_truly_ambiguous_refused(tmp_path):
+    """n=1 is the one genuinely ambiguous shape: every line carries 3
+    values whether coordinates or box — must refuse, not guess."""
+    from mdanalysis_mpi_tpu.io.mdcrd import read_mdcrd, write_mdcrd
+
+    frames = np.zeros((2, 1, 3))
+    p = tmp_path / "x.mdcrd"
+    write_mdcrd(str(p), frames, boxes=np.array([20.0, 20, 20]))
+    with pytest.raises(ValueError, match="ambiguous"):
+        read_mdcrd(str(p), 1)
+
+
+def test_mdcrd_wrong_topology_refused(tmp_path):
+    from mdanalysis_mpi_tpu.io.mdcrd import read_mdcrd, write_mdcrd
+
+    p = tmp_path / "x.mdcrd"
+    write_mdcrd(str(p), np.zeros((2, 7, 3)))
+    with pytest.raises(ValueError, match="neither"):
+        read_mdcrd(str(p), 9)
+
+
+def test_mdcrd_empty_file_loud(tmp_path):
+    from mdanalysis_mpi_tpu.io.mdcrd import read_mdcrd
+
+    p = tmp_path / "x.mdcrd"
+    p.write_text("just a title\n")
+    with pytest.raises(ValueError, match="truncated"):
+        read_mdcrd(str(p), 7)
+
+
+def test_mdcrd_f83_overflow_refused(tmp_path):
+    from mdanalysis_mpi_tpu.io.mdcrd import write_mdcrd
+
+    frames = np.zeros((1, 2, 3))
+    frames[0, 0, 0] = -1000.5          # passes |x|<1e4, overflows F8.3
+    with pytest.raises(ValueError, match="F8.3"):
+        write_mdcrd(str(tmp_path / "x.mdcrd"), frames)

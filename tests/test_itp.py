@@ -190,3 +190,10 @@ def test_large_replication_fast(tmp_path):
     # residues stay distinct across copies
     assert len(np.unique(top.resindices)) == 30000
     assert wall < 2.0, f"replication took {wall:.2f}s"
+
+
+def test_redefined_moleculetype_loud(tmp_path):
+    p = tmp_path / "m.itp"
+    p.write_text(PROT_ITP + "\n" + PROT_ITP)
+    with pytest.raises(ValueError, match="redefined"):
+        parse_itp(str(p))
