@@ -45,7 +45,7 @@ def _autoload():
     # programming error and must surface, unlike the native-backed
     # xtc/dcd modules
     from mdanalysis_mpi_tpu.io import (  # noqa: F401  (self-register)
-        inpcrd, lammps, mdcrd, netcdf, trr, xyz)
+        inpcrd, lammps, mdcrd, netcdf, trr, txyz, xyz)
     try:
         from mdanalysis_mpi_tpu.io import xtc, dcd  # noqa: F401  (self-register)
     except ImportError:

@@ -140,7 +140,7 @@ def _parser() -> argparse.ArgumentParser:
         description="TPU-native trajectory analysis "
                     "(RMSF/RMSD/RDF/distances over pluggable backends)")
     p.add_argument("analysis", choices=ANALYSES)
-    p.add_argument("topology", help="GRO/PSF/PDB/PQR/MOL2/CRD/PRMTOP/ITP topology file")
+    p.add_argument("topology", help="GRO/PSF/PDB/PQR/MOL2/CRD/PRMTOP/ITP/PDBQT/TXYZ topology file")
     p.add_argument("trajectory", nargs="*", default=None,
                    help="XTC/DCD/TRR/NetCDF/XYZ/LAMMPS-dump/mdcrd/INPCRD trajectory file(s) — several files "
                         "chain into one (restart segments); omit for "

@@ -1,0 +1,153 @@
+"""PDBQT (AutoDock) and Tinker TXYZ/ARC formats: hand fixtures +
+writer round trips."""
+
+import numpy as np
+import pytest
+
+from mdanalysis_mpi_tpu.core.universe import Universe
+from mdanalysis_mpi_tpu.io.pdbqt import parse_pdbqt, write_pdbqt
+from mdanalysis_mpi_tpu.io.txyz import parse_txyz, write_txyz
+
+PDBQT = """\
+REMARK  receptor fragment
+ATOM      1  N   LYS A  12      10.000  20.000  30.000  1.00  0.00    -0.347 N
+ATOM      2  CA  LYS A  12      11.000  20.500  30.200  1.00  0.00     0.177 C
+ATOM      3  HZ1 LYS A  12      12.000  21.000  31.000  1.00  0.00     0.274 HD
+ATOM      4  OD1 ASP A  13      13.500  19.000  29.000  1.00  0.00    -0.648 OA
+END
+"""
+
+PDBQT_POSES = """\
+MODEL 1
+ATOM      1  C1  LIG A   1       0.000   0.000   0.000  1.00  0.00     0.100 C
+ATOM      2  O1  LIG A   1       1.200   0.000   0.000  1.00  0.00    -0.300 OA
+ENDMDL
+MODEL 2
+ATOM      1  C1  LIG A   1       5.000   0.000   0.000  1.00  0.00     0.100 C
+ATOM      2  O1  LIG A   1       6.200   0.000   0.000  1.00  0.00    -0.300 OA
+ENDMDL
+"""
+
+TXYZ = """\
+     4  ethanol fragment
+     1  C      0.000000    0.000000    0.000000     1     2     3
+     2  C      1.530000    0.000000    0.000000     1     1     4
+     3  H     -0.500000    0.900000    0.000000     5     1
+     4  O      2.200000    1.100000    0.000000     6     2
+"""
+
+
+def test_pdbqt_parse(tmp_path):
+    p = tmp_path / "x.pdbqt"
+    p.write_text(PDBQT)
+    u = Universe(str(p))
+    assert u.atoms.n_atoms == 4
+    np.testing.assert_allclose(u.atoms.charges,
+                               [-0.347, 0.177, 0.274, -0.648])
+    assert list(u.atoms.elements) == ["N", "C", "H", "O"]
+    assert list(u.topology.segids) == ["A"] * 4
+    assert u.select_atoms("prop charge < 0").n_atoms == 2
+
+
+def test_pdbqt_poses_become_frames(tmp_path):
+    p = tmp_path / "poses.pdbqt"
+    p.write_text(PDBQT_POSES)
+    u = Universe(str(p))
+    assert u.trajectory.n_frames == 2
+    np.testing.assert_allclose(u.trajectory[1].positions[0],
+                               [5, 0, 0], atol=1e-5)
+
+
+def test_pdbqt_round_trip(tmp_path):
+    p = tmp_path / "x.pdbqt"
+    p.write_text(PDBQT)
+    u = Universe(str(p))
+    out = tmp_path / "rt.pdbqt"
+    write_pdbqt(str(out), u)
+    v = Universe(str(out))
+    np.testing.assert_allclose(v.atoms.charges, u.atoms.charges,
+                               atol=1e-3)
+    np.testing.assert_allclose(v.trajectory[0].positions,
+                               u.trajectory[0].positions, atol=1e-3)
+    assert list(v.atoms.names) == list(u.atoms.names)
+    assert list(v.atoms.elements) == list(u.atoms.elements)
+
+
+def test_txyz_parse(tmp_path):
+    p = tmp_path / "m.txyz"
+    p.write_text(TXYZ)
+    u = Universe(str(p))
+    assert u.atoms.n_atoms == 4
+    assert list(u.atoms.names) == ["C", "C", "H", "O"]
+    # bonds deduplicated from both atoms' neighbor lists
+    assert sorted(map(tuple, u.topology.bonds.tolist())) == [
+        (0, 1), (0, 2), (1, 3)]
+    np.testing.assert_allclose(u.trajectory[0].positions[1],
+                               [1.53, 0, 0], atol=1e-5)
+
+
+def test_txyz_arc_multiframe_and_round_trip(tmp_path):
+    p = tmp_path / "m.txyz"
+    p.write_text(TXYZ)
+    u = Universe(str(p))
+    out = tmp_path / "m.arc"
+    # write two frames (same coords twice via current frame)
+    write_txyz(str(out), u, frames=[0, 0])
+    top, frames, box = parse_txyz(str(out))
+    assert frames.shape == (2, 4, 3)
+    assert sorted(map(tuple, top.bonds.tolist())) == sorted(
+        map(tuple, u.topology.bonds.tolist()))
+    # and as a trajectory against the txyz topology
+    v = Universe(str(p), str(out))
+    assert v.trajectory.n_frames == 2
+
+
+def test_txyz_truncated_loud(tmp_path):
+    p = tmp_path / "m.txyz"
+    p.write_text("     3  broken\n     1  C 0.0 0.0 0.0 1\n")
+    with pytest.raises(ValueError, match="truncated"):
+        parse_txyz(str(p))
+
+
+def test_pdbqt_writer_column_exactness(tmp_path):
+    """Round trip with field-filling values: an 8-char coordinate
+    (1000.000) and 4-char resname must land on the standard columns
+    the parser slices."""
+    p = tmp_path / "x.pdbqt"
+    # width-preserving edits: resname field [17:21] "LYS " -> "LYSX",
+    # x field [30:38] "  10.000" -> "1000.000"
+    p.write_text(PDBQT.replace("LYS A", "LYSXA")
+                 .replace("  10.000  20.000", "1000.000  20.000"))
+    u = Universe(str(p))
+    out = tmp_path / "rt.pdbqt"
+    write_pdbqt(str(out), u)
+    v = Universe(str(out))
+    assert list(v.atoms.resnames) == list(u.atoms.resnames)
+    assert list(v.topology.segids) == list(u.topology.segids)
+    np.testing.assert_allclose(v.trajectory[0].positions,
+                               u.trajectory[0].positions, atol=1e-3)
+    np.testing.assert_allclose(v.atoms.charges, u.atoms.charges,
+                               atol=1e-3)
+
+
+def test_txyz_per_frame_boxes(tmp_path):
+    """NPT archives: every frame's box line is kept, not just frame
+    1's."""
+    arc = """\
+     1  npt frame 1
+    10.000000   10.000000   10.000000   90.000000   90.000000   90.000000
+     1  C      0.000000    0.000000    0.000000     1
+     1  npt frame 2
+    12.000000   12.000000   12.000000   90.000000   90.000000   90.000000
+     1  C      1.000000    0.000000    0.000000     1
+"""
+    p = tmp_path / "npt.arc"
+    p.write_text(arc)
+    top, frames, boxes = parse_txyz(str(p))
+    assert frames.shape == (2, 1, 3)
+    np.testing.assert_allclose(boxes[0][:3], 10.0)
+    np.testing.assert_allclose(boxes[1][:3], 12.0)
+    # and .arc opens standalone as a Universe (topology + frames)
+    u = Universe(str(p))
+    assert u.trajectory.n_frames == 2
+    np.testing.assert_allclose(u.trajectory[1].dimensions[:3], 12.0)
