@@ -62,17 +62,14 @@ class SurvivalProbability(AnalysisBase):
             **kwargs):
         """Upstream passes ``intermittency`` (and ``residues``) to
         ``run()``, not the constructor — accept both spellings so ported
-        scripts work unchanged.  ``residues=True`` (atom→residue
-        membership coarsening) is not implemented; it fails loudly here
-        rather than silently computing atom-level survival."""
+        scripts work unchanged.  ``residues=True`` coarsens membership
+        to the RESIDUE level before the survival algebra: a residue is
+        in the shell on a frame iff ANY of its atoms matches the
+        selection (upstream's contract — a water stays "present" while
+        different hydrogens poke into the shell)."""
         if tau_max < 0:
             raise ValueError(f"tau_max must be >= 0, got {tau_max}")
-        if residues:
-            raise NotImplementedError(
-                "SurvivalProbability(residues=True) (residue-level "
-                "membership) is not supported; compute atom-level "
-                "survival (residues=False) or coarsen the selection "
-                "to one atom per residue")
+        self._run_residues = bool(residues)
         if intermittency is not None and intermittency < 0:
             raise ValueError(
                 f"intermittency must be >= 0, got {intermittency}")
@@ -95,13 +92,24 @@ class SurvivalProbability(AnalysisBase):
 
     def _single_frame(self, ts):
         del ts          # selection reads the universe's current frame
+        top = self._universe.topology
         idx = self._universe.select_atoms(self._select).indices
-        row = np.zeros(self._universe.topology.n_atoms, dtype=bool)
-        row[idx] = True
+        if getattr(self, "_run_residues", False):
+            # residue-level membership: present iff ANY atom matches
+            n = int(top.resindices.max()) + 1 if top.n_atoms else 0
+            row = np.zeros(n, dtype=bool)
+            row[top.resindices[idx]] = True
+        else:
+            row = np.zeros(top.n_atoms, dtype=bool)
+            row[idx] = True
         self._rows.append(row)
 
     def _serial_summary(self):
-        n = self._universe.topology.n_atoms
+        top = self._universe.topology
+        n = (int(top.resindices.max()) + 1
+             if getattr(self, "_run_residues", False) and top.n_atoms
+             else (0 if getattr(self, "_run_residues", False)
+                   else top.n_atoms))
         return np.asarray(self._rows, dtype=bool).reshape(
             len(self._rows), n)
 

@@ -114,9 +114,34 @@ def test_sp_intermittency_as_run_kwarg():
     np.testing.assert_allclose(c.results.sp_timeseries[3], 0.0)
 
 
-def test_sp_residues_kwarg_loud():
+def test_sp_invalid_intermittency_loud():
     u = _universe([(IN, OUT, OUT)])
-    with pytest.raises(NotImplementedError, match="residues"):
-        SurvivalProbability(u, "name OW").run(tau_max=2, residues=True)
     with pytest.raises(ValueError, match="intermittency"):
         SurvivalProbability(u, "name OW").run(tau_max=2, intermittency=-1)
+
+
+def test_survival_residue_level_membership():
+    """residues=True: a residue stays 'present' while DIFFERENT atoms
+    of it occupy the shell — atom-level survival would drop to 0."""
+    from mdanalysis_mpi_tpu.core.topology import Topology
+    from mdanalysis_mpi_tpu.core.universe import Universe
+    from mdanalysis_mpi_tpu.io.memory import MemoryReader
+
+    # one 2-atom residue; the two atoms alternate inside x < 1.0
+    frames = np.zeros((4, 2, 3), np.float32)
+    frames[0] = [[0.5, 0, 0], [5.0, 0, 0]]   # atom0 in
+    frames[1] = [[5.0, 0, 0], [0.5, 0, 0]]   # atom1 in
+    frames[2] = [[0.5, 0, 0], [5.0, 0, 0]]   # atom0 in
+    frames[3] = [[5.0, 0, 0], [0.5, 0, 0]]   # atom1 in
+    top = Topology(names=np.array(["H1", "H2"]),
+                   resnames=np.array(["SOL", "SOL"]),
+                   resids=np.array([1, 1]))
+    u = Universe(top, MemoryReader(frames))
+    sel = "prop x < 1.0"
+    atom = SurvivalProbability(u, sel).run(tau_max=2)
+    res = SurvivalProbability(u, sel).run(tau_max=2, residues=True)
+    # atom-level: the in-shell atom changes identity every frame
+    assert atom.results.sp_timeseries[1] == pytest.approx(0.0)
+    # residue-level: the residue never leaves
+    assert res.results.sp_timeseries[1] == pytest.approx(1.0)
+    assert res.results.sp_timeseries[2] == pytest.approx(1.0)
