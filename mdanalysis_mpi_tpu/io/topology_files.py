@@ -69,5 +69,5 @@ def _autoload():
     # is a programming error and must surface — a swallowed one would
     # unregister EVERY format and misreport "no topology parser"
     from mdanalysis_mpi_tpu.io import (  # noqa: F401  (self-register)
-        crd, gro, itp, mol2, pdb, pdbqt, pqr, prmtop, psf, txyz)
+        crd, dms, gro, itp, mol2, pdb, pdbqt, pqr, prmtop, psf, txyz)
     register("tpr", _tpr)

@@ -151,3 +151,102 @@ def test_txyz_per_frame_boxes(tmp_path):
     u = Universe(str(p))
     assert u.trajectory.n_frames == 2
     np.testing.assert_allclose(u.trajectory[1].dimensions[:3], 12.0)
+
+
+# ---- DMS (Desmond sqlite) ----
+
+
+def _make_dms(path, with_cell=True, seg_col="segid"):
+    import sqlite3
+
+    con = sqlite3.connect(path)
+    cur = con.cursor()
+    cur.execute(f"""CREATE TABLE particle (
+        id INTEGER PRIMARY KEY, anum INTEGER, name TEXT, resname TEXT,
+        resid INTEGER, {seg_col} TEXT, mass REAL, charge REAL,
+        x REAL, y REAL, z REAL)""")
+    rows = [
+        (0, 7, "N", "ALA", 1, "A", 14.007, -0.3, 1.0, 2.0, 3.0),
+        (1, 6, "CA", "ALA", 1, "A", 12.011, 0.1, 2.0, 2.5, 3.5),
+        (2, 8, "OW", "SOL", 2, "B", 15.999, -0.8, 9.0, 9.0, 9.0),
+    ]
+    cur.executemany("INSERT INTO particle VALUES (?,?,?,?,?,?,?,?,?,?,?)",
+                    rows)
+    cur.execute("CREATE TABLE bond (p0 INTEGER, p1 INTEGER)")
+    cur.execute("INSERT INTO bond VALUES (0, 1)")
+    if with_cell:
+        cur.execute("""CREATE TABLE global_cell (
+            id INTEGER PRIMARY KEY, x REAL, y REAL, z REAL)""")
+        cur.executemany("INSERT INTO global_cell VALUES (?,?,?,?)",
+                        [(1, 30.0, 0, 0), (2, 0, 40.0, 0),
+                         (3, 0, 0, 50.0)])
+    con.commit()
+    con.close()
+
+
+def test_dms_parse(tmp_path):
+    from mdanalysis_mpi_tpu.io.dms import parse_dms
+
+    p = tmp_path / "sys.dms"
+    _make_dms(str(p))
+    u = Universe(str(p))
+    assert u.atoms.n_atoms == 3
+    assert list(u.atoms.names) == ["N", "CA", "OW"]
+    assert list(u.atoms.elements) == ["N", "C", "O"]
+    np.testing.assert_allclose(u.atoms.charges, [-0.3, 0.1, -0.8])
+    np.testing.assert_allclose(u.atoms.masses, [14.007, 12.011, 15.999])
+    assert list(u.topology.segids) == ["A", "A", "B"]
+    assert u.topology.bonds.tolist() == [[0, 1]]
+    np.testing.assert_allclose(u.trajectory[0].positions[0], [1, 2, 3])
+    np.testing.assert_allclose(u.trajectory[0].dimensions,
+                               [30, 40, 50, 90, 90, 90], atol=1e-4)
+
+
+def test_dms_chain_column_variant(tmp_path):
+    p = tmp_path / "sys.dms"
+    _make_dms(str(p), with_cell=False, seg_col="chain")
+    u = Universe(str(p))
+    assert list(u.topology.segids) == ["A", "A", "B"]
+    assert u.trajectory[0].dimensions is None
+
+
+def test_dms_not_sqlite_loud(tmp_path):
+    from mdanalysis_mpi_tpu.io.dms import parse_dms
+
+    p = tmp_path / "fake.dms"
+    p.write_text("this is not sqlite")
+    with pytest.raises(ValueError, match="SQLite"):
+        parse_dms(str(p))
+
+
+def test_dms_optional_anum_and_velocities(tmp_path):
+    import sqlite3
+
+    p = tmp_path / "v.dms"
+    con = sqlite3.connect(str(p))
+    cur = con.cursor()
+    cur.execute("""CREATE TABLE particle (
+        id INTEGER PRIMARY KEY, name TEXT, resname TEXT, resid INTEGER,
+        mass REAL, charge REAL, x REAL, y REAL, z REAL,
+        vx REAL, vy REAL, vz REAL)""")
+    cur.execute("INSERT INTO particle VALUES "
+                "(0,'CA','ALA',1,12.0,0.0, 1,2,3, 0.1,0.2,0.3)")
+    con.commit(); con.close()
+    u = Universe(str(p))
+    assert u.atoms.n_atoms == 1
+    np.testing.assert_allclose(u.atoms.velocities[0], [0.1, 0.2, 0.3],
+                               atol=1e-6)
+
+
+def test_dms_missing_id_column_loud(tmp_path):
+    import sqlite3
+    from mdanalysis_mpi_tpu.io.dms import parse_dms
+
+    p = tmp_path / "noid.dms"
+    con = sqlite3.connect(str(p))
+    con.execute("CREATE TABLE particle (name TEXT, resname TEXT, "
+                "resid INTEGER, mass REAL, charge REAL, "
+                "x REAL, y REAL, z REAL)")
+    con.commit(); con.close()
+    with pytest.raises(ValueError, match="id"):
+        parse_dms(str(p))
