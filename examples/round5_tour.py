@@ -115,4 +115,33 @@ cerr = float(np.abs(np.asarray(coll.analyses[0].results.rmsf)
 print(f"collection RMSF vs solo serial: {cerr:.2e}")
 assert cerr < 1e-4
 
+# -- the format surface: one molecule through five ecosystems --
+import tempfile
+
+from mdanalysis_mpi_tpu.io.inpcrd import write_inpcrd
+from mdanalysis_mpi_tpu.io.mol2 import write_mol2
+from mdanalysis_mpi_tpu.io.pqr import write_pqr
+from mdanalysis_mpi_tpu.io.prmtop import write_prmtop
+
+fmt_dir = tempfile.mkdtemp()
+uf = make_protein_universe(n_residues=6, n_frames=1, seed=11)
+uf.add_TopologyAttr("charges", np.linspace(-0.3, 0.3, uf.atoms.n_atoms))
+uf.add_TopologyAttr("radii", np.full(uf.atoms.n_atoms, 1.5))
+roundtrips = {}
+for name, writer in (("sys.pqr", write_pqr), ("sys.mol2", write_mol2),
+                     ("sys.prmtop", None), ("sys.rst7", None)):
+    path = os.path.join(fmt_dir, name)
+    if name == "sys.prmtop":
+        write_prmtop(path, uf)
+        v = mdt.Universe(path, uf.trajectory[0].positions[None])
+    elif name == "sys.rst7":
+        write_inpcrd(path, uf)
+        v = mdt.Universe(os.path.join(fmt_dir, "sys.prmtop"), path)
+    else:
+        writer(path, uf)
+        v = mdt.Universe(path)
+    roundtrips[name] = int(v.atoms.n_atoms)
+print("format round trips (atoms):", roundtrips)
+assert set(roundtrips.values()) == {uf.atoms.n_atoms}
+
 print("ROUND5_TOUR_OK")
