@@ -10,6 +10,20 @@ Readers expose the interface the analysis layer depends on:
   the block-staging primitive the TPU executor feeds on (the reference
   has no analog; it reads frame-at-a-time)
 - iteration and ``ts`` (current frame)
+
+Format roster (each module self-registers with the topology_files /
+trajectory_files registries; PARITY.md maps every row to its upstream
+counterpart and tests):
+
+- topology (+coordinates where the format carries them): GRO, PSF,
+  PDB, PQR, MOL2, CRD, PDBQT, TXYZ/ARC, Desmond DMS, AMBER
+  PRMTOP/parm7, GROMACS ITP/TOP (`.top` sniffs AMBER vs GROMACS by
+  content); TPR is a documented conversion path.
+- trajectories: XTC + DCD (C++ codec, NumPy fallbacks), TRR, AMBER
+  NetCDF (.nc/.ncdf, from-scratch NetCDF-3), AMBER ASCII
+  mdcrd/crdbox/trj, AMBER INPCRD/restrt/rst7 restarts, XYZ, LAMMPS
+  dump, Tinker ARC, in-memory arrays, and multi-file chains
+  (io/chain.py).
 """
 
 from mdanalysis_mpi_tpu.io.memory import MemoryReader
