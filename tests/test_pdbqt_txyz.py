@@ -250,3 +250,16 @@ def test_dms_missing_id_column_loud(tmp_path):
     con.commit(); con.close()
     with pytest.raises(ValueError, match="id"):
         parse_dms(str(p))
+
+
+def test_closed_container_formats_loud(tmp_path):
+    """H5MD/GSD/TNG refuse with conversion guidance, not a bare
+    'no trajectory reader'."""
+    from mdanalysis_mpi_tpu.io import trajectory_files
+
+    for ext, word in (("h5md", "h5py"), ("gsd", "gsd"),
+                      ("tng", "trjconv")):
+        p = tmp_path / f"x.{ext}"
+        p.write_bytes(b"\x00" * 16)
+        with pytest.raises(ValueError, match=word):
+            trajectory_files.open(str(p), n_atoms=5)
