@@ -54,3 +54,47 @@ def test_backend_fuzz(seed):
     af = RMSF(ag).run(backend=backend, batch_size=batch,
                       transfer_dtype=tdtype, **window)
     np.testing.assert_allclose(af.results.rmsf, sf.results.rmsf, atol=tol)
+
+
+@pytest.mark.parametrize("seed", CASES)
+def test_fused_and_collection_fuzz(seed):
+    """Round-5 execution paths under the same random sweep: the fused
+    quantized-native engine (int16 only) and AnalysisCollection's
+    union staging, both against the serial oracle."""
+    from mdanalysis_mpi_tpu.analysis import AnalysisCollection
+
+    rng = np.random.default_rng(2000 + seed)
+    n_res = int(rng.integers(3, 40))
+    n_frames = int(rng.integers(2, 60))
+    batch = int(rng.integers(1, 24))
+    start = int(rng.integers(0, max(1, n_frames // 3)))
+    step = int(rng.integers(1, 4))
+    select = rng.choice(["name CA", "name CA CB", "protein and heavy",
+                         "resid 1:2"])
+    backend = rng.choice(["jax", "mesh"])
+    u = make_protein_universe(n_residues=n_res, n_frames=n_frames,
+                              noise=0.3, seed=seed)
+    window = dict(start=start, step=step)
+    if len(range(start, n_frames, step)) < 2:
+        window = {}
+
+    s = AlignedRMSF(u, select=select).run(backend="serial", **window)
+    f = AlignedRMSF(u, select=select, engine="fused").run(
+        backend=backend, batch_size=batch, transfer_dtype="int16",
+        **window)
+    np.testing.assert_allclose(
+        np.asarray(f.results.rmsf), s.results.rmsf, atol=1e-3,
+        err_msg=f"fused {select=} {batch=} {backend=} {window=}")
+
+    sel2 = rng.choice(["name CB", "protein", "name CA"])
+    coll = AnalysisCollection(RMSF(u.select_atoms(select)),
+                              RMSF(u.select_atoms(sel2)))
+    coll.run(backend=backend, batch_size=batch, **window)
+    s1 = RMSF(u.select_atoms(select)).run(backend="serial", **window)
+    s2 = RMSF(u.select_atoms(sel2)).run(backend="serial", **window)
+    np.testing.assert_allclose(
+        np.asarray(coll.analyses[0].results.rmsf), s1.results.rmsf,
+        atol=2e-4, err_msg=f"collection[0] {select=} {batch=}")
+    np.testing.assert_allclose(
+        np.asarray(coll.analyses[1].results.rmsf), s2.results.rmsf,
+        atol=2e-4, err_msg=f"collection[1] {sel2=} {batch=}")
