@@ -24,7 +24,7 @@ DistanceMatrix/DiffusionMap, VelocityAutocorr, GNMAnalysis,
 SurvivalProbability/WaterOrientationalRelaxation/AngularDistribution/
 MeanSquareDisplacement, DielectricConstant, PSAnalysis
 (hausdorff/discrete_frechet), PersistenceLength, HELANAL, BAT, DSSP,
-encore.hes, NucPairDist/WatsonCrickDist, nuclinfo, LeafletFinder
+encore.hes/ces/dres, NucPairDist/WatsonCrickDist, nuclinfo, LeafletFinder
 (+ optimize_cutoff), sequence_alignment, AnalysisFromFunction, and
 AnalysisCollection (N analyses over ONE staged trajectory pass).
 """
@@ -63,7 +63,7 @@ from mdanalysis_mpi_tpu.analysis.helix import HELANAL, helix_analysis
 from mdanalysis_mpi_tpu.analysis.bat import BAT
 from mdanalysis_mpi_tpu.analysis.dihedrals import Janin
 from mdanalysis_mpi_tpu.analysis.dssp import DSSP
-from mdanalysis_mpi_tpu.analysis.encore import hes
+from mdanalysis_mpi_tpu.analysis.encore import ces, dres, hes
 from mdanalysis_mpi_tpu.analysis.pca import cosine_content
 from mdanalysis_mpi_tpu.analysis.align import sequence_alignment
 from mdanalysis_mpi_tpu.analysis.atomicdistances import AtomicDistances
@@ -85,6 +85,6 @@ __all__ = ["AnalysisBase", "AnalysisCollection", "Results",
            "SurvivalProbability", "DielectricConstant",
            "WaterOrientationalRelaxation", "AngularDistribution",
            "PSAnalysis", "hausdorff", "discrete_frechet",
-           "PersistenceLength", "HELANAL", "helix_analysis", "BAT", "DSSP", "hes", "NucPairDist", "WatsonCrickDist", "AtomicDistances",
+           "PersistenceLength", "HELANAL", "helix_analysis", "BAT", "DSSP", "hes", "ces", "dres", "NucPairDist", "WatsonCrickDist", "AtomicDistances",
            "LeafletFinder", "optimize_cutoff", "cosine_content",
            "MeanSquareDisplacement", "sequence_alignment"]
