@@ -72,6 +72,7 @@ from mdanalysis_mpi_tpu.analysis.leaflet import (LeafletFinder,
 from mdanalysis_mpi_tpu.analysis.nucleicacids import (
     NucPairDist, WatsonCrickDist,
 )
+from mdanalysis_mpi_tpu.analysis.waterbridge import WaterBridgeAnalysis
 
 __all__ = ["AnalysisBase", "AnalysisCollection", "Results",
            "AnalysisFromFunction",
@@ -87,4 +88,5 @@ __all__ = ["AnalysisBase", "AnalysisCollection", "Results",
            "PSAnalysis", "hausdorff", "discrete_frechet",
            "PersistenceLength", "HELANAL", "helix_analysis", "BAT", "DSSP", "hes", "ces", "dres", "NucPairDist", "WatsonCrickDist", "AtomicDistances",
            "LeafletFinder", "optimize_cutoff", "cosine_content",
-           "MeanSquareDisplacement", "sequence_alignment"]
+           "MeanSquareDisplacement", "sequence_alignment",
+           "WaterBridgeAnalysis"]

@@ -39,6 +39,12 @@ class BlockCache:
             self._store[key] = value
             self._bytes += nbytes
 
+    @property
+    def full(self) -> bool:
+        """True once inserts have reached the byte cap (further puts
+        are no-ops; consumers can route overflow elsewhere)."""
+        return self._bytes >= self.max_bytes
+
     def clear(self) -> None:
         self._store.clear()
         self._bytes = 0

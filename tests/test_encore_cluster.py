@@ -153,19 +153,41 @@ def test_spe_deterministic():
     assert np.array_equal(m(d), m(d))
 
 
-def test_gaussian_kde_matches_closed_form():
-    """One kernel center → logpdf IS the multivariate normal density."""
-    pts = np.zeros((2, 2))
-    pts[1] = 1e-9            # two near-identical centers, tiny jitter
+def test_gaussian_kde_normalizes():
+    """The KDE is a density: exp(logpdf) integrates to ~1."""
     kde = GaussianKDE(np.random.default_rng(0).normal(0, 1.0, (500, 2)))
-    x = np.array([[0.0, 0.0], [1.0, -1.0]])
-    # Monte-Carlo check: integral of exp(logpdf) over a wide box ~ 1
     g = np.linspace(-6, 6, 61)
     xx, yy = np.meshgrid(g, g)
     grid = np.stack([xx.ravel(), yy.ravel()], axis=1)
     mass = np.exp(kde.logpdf(grid)).sum() * (g[1] - g[0]) ** 2
     assert mass == pytest.approx(1.0, rel=0.02)
-    assert np.isfinite(kde.logpdf(x)).all()
+
+
+def test_gaussian_kde_closed_form_gaussian_kernel():
+    """Against the analytic mixture: for KNOWN centers and bandwidth H,
+    logpdf(x) must equal log( mean_i N(x; c_i, H) ) exactly — computed
+    here independently from the same H the KDE built (Scott's rule)."""
+    rng = np.random.default_rng(3)
+    centers = rng.normal(0.0, 2.0, (6, 2))
+    kde = GaussianKDE(centers)
+    h = kde.h                             # (2, 2) bandwidth matrix
+    hinv = np.linalg.inv(h)
+    norm = 1.0 / (2 * np.pi * np.sqrt(np.linalg.det(h)))
+    x = np.array([[0.3, -1.2], [4.0, 4.0], [centers[0, 0],
+                                            centers[0, 1]]])
+    expected = np.log(np.array([
+        np.mean([norm * np.exp(-0.5 * (xi - c) @ hinv @ (xi - c))
+                 for c in centers]) for xi in x]))
+    np.testing.assert_allclose(kde.logpdf(x), expected, rtol=1e-10)
+
+
+def test_gaussian_kde_degenerate_spread_jitter():
+    """Near-identical centers exercise the jitter branch: the KDE must
+    stay finite and normalized instead of failing Cholesky."""
+    pts = np.zeros((4, 2))
+    pts[1:] = np.random.default_rng(0).normal(0, 1e-9, (3, 2))
+    kde = GaussianKDE(pts)
+    assert np.isfinite(kde.logpdf(np.array([[0.0, 0.0]]))).all()
 
 
 def test_gaussian_kde_sampling_follows_density():

@@ -23,13 +23,28 @@ def _traced(u, monkeypatch):
     """Record the order of host-stage vs device-put events."""
     events = []
     reader = u.trajectory
+    # device-cache runs stage through stage_block (host cache
+    # bypassed); host-cache runs through stage_cached — trace both
     orig_stage = reader.stage_cached
+    orig_block = reader.stage_block
+
+    nested = []
 
     def stage_wrap(*a, **k):
         events.append("stage")
-        return orig_stage(*a, **k)
+        nested.append(1)            # stage_cached calls stage_block
+        try:
+            return orig_stage(*a, **k)
+        finally:
+            nested.pop()
+
+    def block_wrap(*a, **k):
+        if not nested:
+            events.append("stage")
+        return orig_block(*a, **k)
 
     reader.stage_cached = stage_wrap
+    reader.stage_block = block_wrap
     orig_put = executors._put_staged
 
     def put_wrap(*a, **k):
@@ -50,13 +65,34 @@ def test_prestage_stages_every_batch_before_first_put(monkeypatch):
     assert events[:4] == ["stage"] * 4, events
 
 
+def test_prestage_chunked_schedule(monkeypatch):
+    """With MDTPU_PRESTAGE_CHUNK=2, the schedule phase-separates PER
+    CHUNK: both of a chunk's stages land before its first put, and the
+    next chunk's stages start only after the previous chunk wired —
+    bounded host residency without decode/transfer interleaving."""
+    monkeypatch.setenv("MDTPU_PRESTAGE_CHUNK", "2")
+    monkeypatch.setenv("MDTPU_WIRE_WINDOW", "2")
+    u = make_protein_universe(n_residues=30, n_frames=32, noise=0.2)
+    events = _traced(u, monkeypatch)
+    RMSD(u.select_atoms("name CA")).run(backend="jax", batch_size=8,
+                                        prestage=True)
+    assert events == ["stage", "stage", "put", "put"] * 2, events
+
+
 def test_prestage_parity_and_cache_reuse(monkeypatch):
     u = make_protein_universe(n_residues=30, n_frames=24, noise=0.3)
     s = AlignedRMSF(u, select="name CA").run(backend="serial")
+    # schedule equivalence needs identical adaptive-scale hint
+    # evolution: clear hints before each accelerated run and give both
+    # their own device cache (a device cache bypasses the host stage
+    # cache, so both schedules genuinely stage every block)
+    u.trajectory.__dict__.pop("_quant_max_hints", None)
     interleaved = AlignedRMSF(u, select="name CA").run(
-        backend="jax", batch_size=8, transfer_dtype="int16")
+        backend="jax", batch_size=8, transfer_dtype="int16",
+        block_cache=DeviceBlockCache())
     cache = DeviceBlockCache()
     events = _traced(u, monkeypatch)
+    u.trajectory.__dict__.pop("_quant_max_hints", None)
     pre = AlignedRMSF(u, select="name CA").run(
         backend="jax", batch_size=8, transfer_dtype="int16",
         block_cache=cache, prestage=True)
