@@ -18,12 +18,14 @@ counterpart and tests):
 - topology (+coordinates where the format carries them): GRO, PSF,
   PDB, PQR, MOL2, CRD, PDBQT, TXYZ/ARC, Desmond DMS, AMBER
   PRMTOP/parm7, GROMACS ITP/TOP (`.top` sniffs AMBER vs GROMACS by
-  content); TPR is a documented conversion path.
+  content), DL_POLY CONFIG/REVCON (bare-filename dispatch); TPR is a
+  documented conversion path.
 - trajectories: XTC + DCD (C++ codec, NumPy fallbacks), TRR, AMBER
   NetCDF (.nc/.ncdf, from-scratch NetCDF-3), AMBER ASCII
   mdcrd/crdbox/trj, AMBER INPCRD/restrt/rst7 restarts, XYZ, LAMMPS
-  dump, Tinker ARC, in-memory arrays, and multi-file chains
-  (io/chain.py).
+  dump, Tinker ARC, DL_POLY HISTORY, in-memory arrays, and multi-file
+  chains (io/chain.py).  H5MD/GSD/TNG/TRZ are documented conversion
+  paths (loud per-format guidance in trajectory_files.py).
 """
 
 from mdanalysis_mpi_tpu.io.memory import MemoryReader

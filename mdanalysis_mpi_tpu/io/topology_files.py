@@ -21,6 +21,10 @@ def register(extension: str, parser) -> None:
 
 def parse(path: str) -> Topology:
     ext = os.path.splitext(path)[1].lower().lstrip(".")
+    if not ext:
+        # extensionless conventions (DL_POLY's CONFIG/REVCON): the
+        # basename IS the format name
+        ext = os.path.basename(path).lower()
     _autoload()
     parser = _PARSERS.get(ext)
     if parser is None:
@@ -69,5 +73,6 @@ def _autoload():
     # is a programming error and must surface — a swallowed one would
     # unregister EVERY format and misreport "no topology parser"
     from mdanalysis_mpi_tpu.io import (  # noqa: F401  (self-register)
-        crd, dms, gro, itp, mol2, pdb, pdbqt, pqr, prmtop, psf, txyz)
+        crd, dlpoly, dms, gro, itp, mol2, pdb, pdbqt, pqr, prmtop, psf,
+        txyz)
     register("tpr", _tpr)

@@ -19,6 +19,10 @@ def register(extension: str, opener) -> None:
 
 def open(path: str, n_atoms: int | None = None):
     ext = os.path.splitext(path)[1].lower().lstrip(".")
+    if not ext:
+        # extensionless conventions (DL_POLY's HISTORY): the basename
+        # IS the format name
+        ext = os.path.basename(path).lower()
     _autoload()
     opener = _READERS.get(ext)
     if opener is None:
@@ -58,7 +62,7 @@ def _autoload():
     # programming error and must surface, unlike the native-backed
     # xtc/dcd modules
     from mdanalysis_mpi_tpu.io import (  # noqa: F401  (self-register)
-        inpcrd, lammps, mdcrd, netcdf, trr, txyz, xyz)
+        dlpoly, inpcrd, lammps, mdcrd, netcdf, trr, txyz, xyz)
     register("h5md", _unavailable(
         "H5MD", "the HDF5 container needs h5py, which is not installed",
         "convert once with MDAnalysis/mdconvert on a machine with "
@@ -75,6 +79,13 @@ def _autoload():
         "TNG", "GROMACS' TNG container needs pytng",
         "convert once with 'gmx trjconv -f traj.tng -o traj.xtc' and "
         "open the XTC here"))
+    register("trz", _unavailable(
+        "TRZ", "the IBIsCO/YASP binary layout has no public spec to "
+        "validate a from-scratch reader against in this offline "
+        "environment (a reader checked only against self-written bytes "
+        "would be circular — the TPR rationale)",
+        "convert once with MDAnalysis elsewhere ('mda.Writer' to "
+        "XTC/DCD) and open the result here"))
     try:
         from mdanalysis_mpi_tpu.io import xtc, dcd  # noqa: F401  (self-register)
     except ImportError:

@@ -258,7 +258,7 @@ def test_closed_container_formats_loud(tmp_path):
     from mdanalysis_mpi_tpu.io import trajectory_files
 
     for ext, word in (("h5md", "h5py"), ("gsd", "gsd"),
-                      ("tng", "trjconv")):
+                      ("tng", "trjconv"), ("trz", "circular")):
         p = tmp_path / f"x.{ext}"
         p.write_bytes(b"\x00" * 16)
         with pytest.raises(ValueError, match=word):
