@@ -404,6 +404,31 @@ class AtomGroup:
         :meth:`wrap` (map atoms into the primary unit cell)."""
         return self.wrap()
 
+    # ---- connectivity groups (upstream TopologyGroup surface) ----
+
+    @property
+    def bonds(self):
+        """Bonds with BOTH atoms in this group (upstream ``ag.bonds``),
+        as a vectorized :class:`~mdanalysis_mpi_tpu.core.
+        topologyobjects.TopologyGroup` — ``.values()`` gives lengths Å.
+        """
+        return self._universe.bonds.atomgroup_intersection(self)
+
+    @property
+    def angles(self):
+        """Angles fully inside this group; ``.values()`` in degrees."""
+        return self._universe.angles.atomgroup_intersection(self)
+
+    @property
+    def dihedrals(self):
+        """Proper dihedrals fully inside this group (degrees)."""
+        return self._universe.dihedrals.atomgroup_intersection(self)
+
+    @property
+    def impropers(self):
+        """Improper dihedrals fully inside this group (degrees)."""
+        return self._universe.impropers.atomgroup_intersection(self)
+
     def guess_bonds(self, fudge_factor: float = 0.55,
                     lower_bound: float = 0.1) -> np.ndarray:
         """Distance-based bond perception over THIS group's atoms

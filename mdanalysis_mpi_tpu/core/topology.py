@@ -37,6 +37,9 @@ class Topology:
     radii: np.ndarray | None = None        # float64 atomic radii (Å; PQR)
     resindices: np.ndarray | None = None   # int 0-based residue index
     bonds: np.ndarray | None = None        # (n_bonds, 2) int atom indices
+    angles: np.ndarray | None = None       # (n_angles, 3) int atom indices
+    dihedrals: np.ndarray | None = None    # (n_dihedrals, 4)
+    impropers: np.ndarray | None = None    # (n_impropers, 4)
     _derived: dict = field(default_factory=dict, repr=False)
 
     def subset(self, indices: np.ndarray) -> "Topology":
@@ -47,12 +50,25 @@ class Topology:
         subset-universe construction need.
         """
         idx = np.asarray(indices, dtype=np.int64)
-        bonds = None
-        if self.bonds is not None and len(self.bonds):
-            remap = np.full(self.n_atoms, -1, dtype=np.int64)
-            remap[idx] = np.arange(len(idx))
-            b = remap[self.bonds]
-            bonds = b[(b >= 0).all(axis=1)]
+        remap = np.full(self.n_atoms, -1, dtype=np.int64)
+        remap[idx] = np.arange(len(idx))
+
+        def _remap_tuples(tuples):
+            """Connectivity tuples survive iff EVERY member is selected,
+            remapped to the subset's 0-based numbering.  'Known but
+            zero survive' stays an EMPTY array — only an absent input
+            maps to None ('no connectivity information'): downstream
+            consumers (fragment selections, u.bonds) distinguish the
+            two."""
+            if tuples is None:
+                return None
+            t = np.asarray(tuples, np.int64)
+            if not len(t):
+                return t.copy()
+            t = remap[t]
+            return t[(t >= 0).all(axis=1)]
+
+        bonds = _remap_tuples(self.bonds)
         # carry residue identity explicitly: recomputing boundaries from
         # (resid, segid) change-points would merge distinct residues that
         # subsetting makes adjacent (e.g. wrapped resids).  Each
@@ -80,6 +96,9 @@ class Topology:
             radii=None if self.radii is None else self.radii[idx],
             resindices=dense,
             bonds=bonds,
+            angles=_remap_tuples(self.angles),
+            dihedrals=_remap_tuples(self.dihedrals),
+            impropers=_remap_tuples(self.impropers),
         )
 
     def __post_init__(self):
@@ -155,6 +174,16 @@ class Topology:
                         f"{len(uniq)} distinct)")
         if self.bonds is not None:
             self.bonds = np.asarray(self.bonds, dtype=np.int64).reshape(-1, 2)
+        for attr, width in (("angles", 3), ("dihedrals", 4),
+                            ("impropers", 4)):
+            v = getattr(self, attr)
+            if v is not None:
+                v = np.asarray(v, dtype=np.int64).reshape(-1, width)
+                if len(v) and (v.min() < 0 or v.max() >= n):
+                    raise ValueError(
+                        f"{attr} reference atom indices outside "
+                        f"[0, {n})")
+                setattr(self, attr, v)
 
     @property
     def n_atoms(self) -> int:
@@ -319,12 +348,17 @@ def concatenate(tops: list[Topology]) -> Topology:
     parts without bonds contribute none (a PSF protein + bondless
     water box keeps the protein's bonds)."""
     bond_parts = []
+    tuple_parts: dict = {"angles": [], "dihedrals": [], "impropers": []}
     res_parts = []
     offset = 0
     res_offset = 0
     for t in tops:
         if t.bonds is not None and len(t.bonds):
             bond_parts.append(np.asarray(t.bonds, np.int64) + offset)
+        for attr, parts in tuple_parts.items():
+            v = getattr(t, attr)
+            if v is not None and len(v):
+                parts.append(np.asarray(v, np.int64) + offset)
         offset += t.n_atoms
         # residues never fuse across part boundaries: part i's last
         # residue and part i+1's first stay distinct even when their
@@ -346,5 +380,11 @@ def concatenate(tops: list[Topology]) -> Topology:
         radii=(np.concatenate([t.radii for t in tops])
                if all(t.radii is not None for t in tops) else None),
         bonds=(np.concatenate(bond_parts) if bond_parts else None),
+        angles=(np.concatenate(tuple_parts["angles"])
+                if tuple_parts["angles"] else None),
+        dihedrals=(np.concatenate(tuple_parts["dihedrals"])
+                   if tuple_parts["dihedrals"] else None),
+        impropers=(np.concatenate(tuple_parts["impropers"])
+                   if tuple_parts["impropers"] else None),
         resindices=np.concatenate(res_parts),
     )

@@ -82,6 +82,47 @@ class Universe:
 
         return ResidueGroup(self, self.topology.resindices)
 
+    _GUESS_REMEDY = {
+        "bonds": "u.topology.bonds = u.atoms.guess_bonds()",
+        "angles": ("u.topology.angles = core.topologyobjects."
+                   "guess_angles(u.topology.bonds, u.topology.n_atoms)"),
+        "dihedrals": ("u.topology.dihedrals = core.topologyobjects."
+                      "guess_dihedrals(u.topology.angles, "
+                      "u.topology.bonds, u.topology.n_atoms)"),
+        "impropers": ("u.topology.impropers = core.topologyobjects."
+                      "guess_improper_dihedrals(u.topology.angles, "
+                      "u.topology.bonds, u.topology.n_atoms)"),
+    }
+
+    def _topology_group(self, attr: str, kind: str):
+        from mdanalysis_mpi_tpu.core.topologyobjects import TopologyGroup
+
+        tuples = getattr(self.topology, attr)
+        if tuples is None:
+            raise ValueError(
+                f"this topology carries no {attr}; parse a format with "
+                f"{attr} sections (PSF, ITP) or derive them: "
+                f"{self._GUESS_REMEDY[attr]}")
+        return TopologyGroup(self, tuples, kind)
+
+    @property
+    def bonds(self):
+        """All bonds as a :class:`TopologyGroup` (upstream ``u.bonds``).
+        """
+        return self._topology_group("bonds", "bond")
+
+    @property
+    def angles(self):
+        return self._topology_group("angles", "angle")
+
+    @property
+    def dihedrals(self):
+        return self._topology_group("dihedrals", "dihedral")
+
+    @property
+    def impropers(self):
+        return self._topology_group("impropers", "improper")
+
     @property
     def segments(self):
         """All segments (upstream's ``u.segments``)."""

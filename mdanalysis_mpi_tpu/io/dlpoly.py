@@ -177,7 +177,7 @@ class HistoryReader(MemoryReader):
             raise ValueError(f"{path!r}: inconsistent cell records")
         dims_arr = (np.asarray(boxes, np.float32) if have_box else None)
         super().__init__(np.stack(frames), dimensions=dims_arr,
-                         times=(np.asarray(times, np.float32)
+                         times=(np.asarray(times, np.float64)
                                 if len(times) == len(frames) else None))
         self._path = path
 

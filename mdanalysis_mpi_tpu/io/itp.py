@@ -4,7 +4,9 @@ The GROMACS side of what PRMTOP gives AMBER users: GRO coordinate
 files carry no masses/charges/bonds — those live in the ``.top`` /
 ``.itp`` force-field topology.  ``Universe("topol.top", "md.xtc")``
 builds the full system: every ``[moleculetype]``'s ``[atoms]`` /
-``[bonds]`` / ``[settles]`` / ``[constraints]`` blocks are collected,
+``[bonds]`` / ``[settles]`` / ``[constraints]`` / ``[angles]`` /
+``[dihedrals]`` blocks are collected (dihedral function types 2/4
+land in ``impropers``, the GROMACS convention),
 ``#include`` lines are resolved relative to the including file (a
 missing include — e.g. a force-field file living in a GROMACS install
 this environment doesn't have — fails loudly with the remedy), and
@@ -40,6 +42,9 @@ class _Molecule:
         self.charges: list[float] = []
         self.masses: list[float] = []
         self.bonds: list[tuple[int, int]] = []
+        self.angles: list[tuple[int, int, int]] = []
+        self.dihedrals: list[tuple[int, int, int, int]] = []
+        self.impropers: list[tuple[int, int, int, int]] = []
 
 
 def _iter_lines(path: str, defines: set, stack=()):
@@ -136,6 +141,24 @@ def parse_itp(path: str, defines=()) -> Topology:
                 raise ValueError(
                     f"{src}:{lineno}: [{section}] outside [moleculetype]")
             current.bonds.append((int(t[0]) - 1, int(t[1]) - 1))
+        elif section == "angles":
+            if current is None:
+                raise ValueError(
+                    f"{src}:{lineno}: [angles] outside [moleculetype]")
+            current.angles.append(
+                (int(t[0]) - 1, int(t[1]) - 1, int(t[2]) - 1))
+        elif section == "dihedrals":
+            if current is None:
+                raise ValueError(
+                    f"{src}:{lineno}: [dihedrals] outside "
+                    "[moleculetype]")
+            quad = (int(t[0]) - 1, int(t[1]) - 1, int(t[2]) - 1,
+                    int(t[3]) - 1)
+            # GROMACS function type 2/4 are improper conventions;
+            # everything else (1, 3, 5, 9...) is a proper dihedral
+            func = int(t[4]) if len(t) > 4 else 1
+            (current.impropers if func in (2, 4)
+             else current.dihedrals).append(quad)
         elif section == "settles":
             # rigid water: OW is atom ai; bonds OW-HW1, OW-HW2
             if current is None:
@@ -146,7 +169,7 @@ def parse_itp(path: str, defines=()) -> Topology:
             current.bonds.append((ow, ow + 2))
         elif section == "molecules":
             system_mols.append((t[0], int(t[1])))
-        # every other section (atomtypes, pairs, angles, dihedrals,
+        # every other section (atomtypes, pairs,
         # exclusions, position_restraints, system, defaults...) carries
         # force-field data the Topology does not store
     if not molecules:
@@ -191,12 +214,17 @@ def parse_itp(path: str, defines=()) -> Topology:
         resids = np.tile(np.array(mol.resids, np.int64), count)
         charges = np.tile(np.array(mol.charges), count)
         m_t = None if masses is None else np.tile(masses, count)
-        if mol.bonds:
-            b = np.asarray(mol.bonds, np.int64)
-            bonds = (b[None] + (np.arange(count) * nm)[:, None, None]
-                     ).reshape(-1, 2)
-        else:
-            bonds = None
+        def _replicate(tuples, width):
+            if not tuples:
+                return None
+            b = np.asarray(tuples, np.int64)
+            return (b[None] + (np.arange(count) * nm)[:, None, None]
+                    ).reshape(-1, width)
+
+        bonds = _replicate(mol.bonds, 2)
+        angles = _replicate(mol.angles, 3)
+        dihedrals = _replicate(mol.dihedrals, 4)
+        impropers = _replicate(mol.impropers, 4)
         # per-copy residue separation: shift resindices by copy so
         # identical (resid, segid) in adjacent copies stay distinct.
         # The change-point cumsum is derived directly (a throwaway
@@ -212,7 +240,8 @@ def parse_itp(path: str, defines=()) -> Topology:
                       + np.repeat(np.arange(count), nm) * nres_mol)
         parts.append(Topology(
             names=names, resnames=resnames, resids=resids,
-            charges=charges, masses=m_t, bonds=bonds,
+            charges=charges, masses=m_t, bonds=bonds, angles=angles,
+            dihedrals=dihedrals, impropers=impropers,
             resindices=resindices))
     return parts[0] if len(parts) == 1 else concatenate(parts)
 

@@ -48,22 +48,34 @@ def parse_psf(path: str) -> Topology:
         charges[a] = float(parts[6])
         masses[a] = float(parts[7])
     i += natom
-    bonds = None
-    while i < len(lines):
-        ln = lines[i]
-        if "!NBOND" in ln:
-            nbond = int(ln.split("!")[0].strip())
-            flat: list[int] = []
-            i += 1
-            while i < len(lines) and len(flat) < 2 * nbond:
-                flat.extend(int(x) for x in lines[i].split())
-                i += 1
-            bonds = np.asarray(flat[: 2 * nbond], dtype=np.int64).reshape(-1, 2) - 1
-            break
-        i += 1
+
+    def _section(flag: str, width: int, start: int):
+        """Scan for ``<count> !FLAG`` from ``start``; return the
+        (count, width) 0-based tuple array (or None) and the scan
+        position after it."""
+        j = start
+        while j < len(lines):
+            if flag in lines[j]:
+                count = int(lines[j].split("!")[0].strip())
+                flat: list[int] = []
+                j += 1
+                while j < len(lines) and len(flat) < width * count:
+                    flat.extend(int(x) for x in lines[j].split())
+                    j += 1
+                arr = (np.asarray(flat[: width * count], np.int64)
+                       .reshape(-1, width) - 1)
+                return arr, j
+            j += 1
+        return None, start
+
+    bonds, i = _section("!NBOND", 2, i)
+    angles, i = _section("!NTHETA", 3, i)
+    dihedrals, i = _section("!NPHI", 4, i)
+    impropers, _ = _section("!NIMPHI", 4, i)
     return Topology(names=names, resnames=resnames, resids=resids,
                     segids=segids, charges=charges, masses=masses,
-                    bonds=bonds)
+                    bonds=bonds, angles=angles, dihedrals=dihedrals,
+                    impropers=impropers)
 
 
 def write_psf(path: str, topology: Topology) -> None:
@@ -81,12 +93,20 @@ def write_psf(path: str, topology: Topology) -> None:
                 i + 1, t.segids[i][:4], t.resids[i], t.resnames[i][:4],
                 t.names[i][:4], (t.elements[i] or "X")[:4],
                 charges[i], t.masses[i], 0))
-        fh.write("\n")
-        bonds = t.bonds if t.bonds is not None else np.empty((0, 2), np.int64)
-        fh.write("%8d !NBOND: bonds\n" % len(bonds))
-        flat = (bonds + 1).ravel()
-        for j in range(0, len(flat), 8):
-            fh.write("".join("%8d" % x for x in flat[j:j + 8]) + "\n")
+        def _section(flag: str, tuples, width: int):
+            arr = (tuples if tuples is not None
+                   else np.empty((0, width), np.int64))
+            fh.write("\n%8d !%s\n" % (len(arr), flag))
+            flat = (np.asarray(arr, np.int64) + 1).ravel()
+            per_line = 8 if width != 3 else 9     # whole tuples per line
+            for j in range(0, len(flat), per_line):
+                fh.write("".join("%8d" % x for x in flat[j:j + per_line])
+                         + "\n")
+
+        _section("NBOND: bonds", t.bonds, 2)
+        _section("NTHETA: angles", t.angles, 3)
+        _section("NPHI: dihedrals", t.dihedrals, 4)
+        _section("NIMPHI: impropers", t.impropers, 4)
 
 
 topology_files.register("psf", parse_psf)

@@ -197,3 +197,42 @@ def test_redefined_moleculetype_loud(tmp_path):
     p.write_text(PROT_ITP + "\n" + PROT_ITP)
     with pytest.raises(ValueError, match="redefined"):
         parse_itp(str(p))
+
+
+def test_itp_angles_dihedrals_impropers(tmp_path):
+    """[angles] and [dihedrals] populate the connectivity arrays;
+    function types 2/4 become impropers; [molecules] replication
+    offsets every tuple."""
+    p = tmp_path / "mol.itp"
+    p.write_text("""
+[ moleculetype ]
+BUT 3
+[ atoms ]
+1 C 1 BUT C1 1 0.0 12.0
+2 C 1 BUT C2 2 0.0 12.0
+3 C 1 BUT C3 3 0.0 12.0
+4 C 1 BUT C4 4 0.0 12.0
+[ bonds ]
+1 2 1
+2 3 1
+3 4 1
+[ angles ]
+1 2 3 1
+2 3 4 1
+[ dihedrals ]
+1 2 3 4 9
+2 1 3 4 2
+[ system ]
+butane
+[ molecules ]
+BUT 2
+""")
+    top = parse_itp(str(p))
+    assert top.n_atoms == 8
+    np.testing.assert_array_equal(top.angles,
+                                  [[0, 1, 2], [1, 2, 3],
+                                   [4, 5, 6], [5, 6, 7]])
+    np.testing.assert_array_equal(top.dihedrals,
+                                  [[0, 1, 2, 3], [4, 5, 6, 7]])
+    np.testing.assert_array_equal(top.impropers,
+                                  [[1, 0, 2, 3], [5, 4, 6, 7]])
