@@ -26,7 +26,7 @@ import numpy as np
 ANALYSES = ("rmsf", "aligned-rmsf", "rmsd", "average-structure", "rdf",
             "contacts", "pairwise-distances", "rgyr", "pca", "msd",
             "ramachandran", "density", "janin", "helanal",
-            "lineardensity", "gnm", "wor")
+            "lineardensity", "gnm", "wor", "waterbridge")
 
 
 @dataclasses.dataclass
@@ -56,6 +56,10 @@ class AnalysisConfig:
     dtmax: int = 20                     # wor lag window
     gnm_cutoff: float = 7.0             # gnm contact cutoff (upstream default)
     binsize: float = 0.25               # lineardensity slab thickness (Å)
+    wb_order: int = 1                   # waterbridge: max waters in a chain
+    wb_distance: float = 3.0            # waterbridge donor-acceptor cutoff
+    wb_angle: float = 120.0             # waterbridge D-H-A angle cutoff
+    water: str | None = None            # waterbridge water selection
     output: str | None = None
 
     def validate(self) -> None:
@@ -116,6 +120,15 @@ def build_analysis(cfg: AnalysisConfig, universe=None):
         # its own upstream default of 7.0
         return ana.GNMAnalysis(u, select=cfg.select,
                                cutoff=cfg.gnm_cutoff)
+    if cfg.analysis == "waterbridge":
+        if not cfg.select2:
+            raise ValueError(
+                "waterbridge needs --select2 (the second terminal "
+                "selection)")
+        return ana.WaterBridgeAnalysis(
+            u, cfg.select, cfg.select2, water_selection=cfg.water,
+            order=cfg.wb_order, distance=cfg.wb_distance,
+            angle=cfg.wb_angle)
     if cfg.analysis == "wor":
         return ana.WaterOrientationalRelaxation(u, select=cfg.select,
                                                 dtmax=cfg.dtmax)
@@ -130,8 +143,13 @@ def run_config(cfg: AnalysisConfig, universe=None):
         kwargs["batch_size"] = cfg.batch_size
     if cfg.backend in ("jax", "mesh") and cfg.transfer_dtype != "float32":
         kwargs["transfer_dtype"] = cfg.transfer_dtype
-    return a.run(start=cfg.start, stop=cfg.stop, step=cfg.step,
-                 backend=cfg.backend, **kwargs)
+    out = a.run(start=cfg.start, stop=cfg.stop, step=cfg.step,
+                backend=cfg.backend, **kwargs)
+    if cfg.analysis == "waterbridge":
+        # the nested bridge chains are not npz-able; the per-frame
+        # count series is the CLI-facing summary
+        a.results.bridge_counts = a.count_by_time()
+    return out
 
 
 def _parser() -> argparse.ArgumentParser:
@@ -146,7 +164,9 @@ def _parser() -> argparse.ArgumentParser:
                         "chain into one (restart segments); omit for "
                         "topology coords")
     p.add_argument("--select", default="protein and name CA")
-    p.add_argument("--select2", default=None, help="RDF second selection")
+    p.add_argument("--select2", default=None,
+                   help="second selection (rdf's B group; waterbridge's "
+                        "required second terminal)")
     p.add_argument("--start", type=int, default=None)
     p.add_argument("--stop", type=int, default=None)
     p.add_argument("--step", type=int, default=None)
@@ -176,6 +196,14 @@ def _parser() -> argparse.ArgumentParser:
                    help="gnm: Kirchhoff contact cutoff in Å")
     p.add_argument("--binsize", type=float, default=0.25,
                    help="lineardensity slab thickness in Å")
+    p.add_argument("--order", type=int, default=1,
+                   help="waterbridge: max waters in a bridge chain")
+    p.add_argument("--wb-distance", type=float, default=3.0,
+                   help="waterbridge donor-acceptor cutoff (A)")
+    p.add_argument("--wb-angle", type=float, default=120.0,
+                   help="waterbridge D-H-A angle cutoff (deg)")
+    p.add_argument("--water", default=None,
+                   help="waterbridge water selection override")
     p.add_argument("--output", default=None, help="write results to .npz")
     p.add_argument("--trace", default=None, metavar="DIR",
                    help="write a jax.profiler trace (TensorBoard format) "
@@ -198,7 +226,9 @@ def main(argv=None) -> int:
         nbins=ns.nbins, r_max=ns.r_max, cutoff=ns.cutoff, output=ns.output,
         engine=ns.engine, align=ns.align, n_components=ns.n_components,
         msd_type=ns.msd_type, delta=ns.delta, dtmax=ns.dtmax,
-        binsize=ns.binsize, gnm_cutoff=ns.gnm_cutoff)
+        binsize=ns.binsize, gnm_cutoff=ns.gnm_cutoff,
+        wb_order=ns.order, wb_distance=ns.wb_distance,
+        wb_angle=ns.wb_angle, water=ns.water)
     from mdanalysis_mpi_tpu.utils.timers import device_trace
 
     TIMERS.reset()
@@ -224,7 +254,16 @@ def main(argv=None) -> int:
         if not (isinstance(v, (np.ndarray, list, tuple, float, int))
                 or hasattr(v, "shape")):
             continue
-        arrays[k] = np.asarray(v)
+        try:
+            arr = np.asarray(v)
+        except ValueError:
+            # ragged nested results (waterbridge's per-frame bridge
+            # chains, whose count varies frame to frame) are not
+            # npz-able; their flat summaries (bridge_counts) are
+            continue
+        if arr.dtype == object:     # same raggedness, older numpy path
+            continue
+        arrays[k] = arr
     if cfg.output:
         np.savez(cfg.output, **arrays)
     print(json.dumps({

@@ -185,3 +185,28 @@ class TestRound5CLIAnalyses:
         ang = np.asarray(a.results.angles)
         assert ang.shape == (2, 2, 2)
         assert ((0 <= ang) & (ang < 360)).all()
+
+
+class TestWaterbridgeCLI:
+    def test_waterbridge_via_config(self):
+        """The waterbridge CLI path: config -> analysis -> npz-able
+        bridge_counts series."""
+        from tests.test_waterbridge import _bridge_universe
+
+        u = _bridge_universe(n_frames=3)
+        cfg = AnalysisConfig(analysis="waterbridge", topology="mem",
+                             select="resname PROT",
+                             select2="resname ACCP",
+                             backend="serial")
+        a = run_config(cfg, universe=u)
+        counts = np.asarray(a.results.bridge_counts)
+        assert counts.shape == (3,)
+        assert (counts == 1).all()
+
+    def test_waterbridge_requires_select2(self):
+        from tests.test_waterbridge import _bridge_universe
+
+        cfg = AnalysisConfig(analysis="waterbridge", topology="mem",
+                             select="resname PROT", backend="serial")
+        with pytest.raises(ValueError, match="select2"):
+            run_config(cfg, universe=_bridge_universe())
