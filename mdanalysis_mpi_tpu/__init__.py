@@ -32,10 +32,12 @@ From-scratch re-design of the capability envelope of the reference
 from mdanalysis_mpi_tpu.core.universe import Merge, Universe
 from mdanalysis_mpi_tpu.core.groups import AtomGroup, UpdatingAtomGroup
 from mdanalysis_mpi_tpu.core.topology import Topology
+from mdanalysis_mpi_tpu import units
 
 __version__ = "0.1.0"
 
-__all__ = ["Universe", "Merge", "AtomGroup", "UpdatingAtomGroup", "Topology", "analysis", "__version__"]
+__all__ = ["Universe", "Merge", "AtomGroup", "UpdatingAtomGroup",
+           "Topology", "analysis", "units", "__version__"]
 
 
 def __getattr__(name):

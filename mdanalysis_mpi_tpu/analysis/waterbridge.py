@@ -248,6 +248,9 @@ class WaterBridgeAnalysis(AnalysisBase):
         del total
         self.results.timeseries = self._frames_out
         self.results.network = self._edges_out
+        # the flat npz-able summary (the nested chains are ragged)
+        self.results.bridge_counts = np.array(
+            [len(b) for b in self._frames_out], dtype=np.int64)
 
     # batch backends cannot express per-frame dynamic graph membership
     def _batch_select(self):
@@ -262,10 +265,10 @@ class WaterBridgeAnalysis(AnalysisBase):
     # -- aggregation ----------------------------------------------------
 
     def count_by_time(self) -> np.ndarray:
-        """Number of distinct bridges per analyzed frame (T,)."""
+        """Number of distinct bridges per analyzed frame (T,) —
+        ``results.bridge_counts``."""
         self._require_results()
-        return np.array([len(b) for b in self.results.timeseries],
-                        dtype=np.int64)
+        return self.results.bridge_counts
 
     def count_by_type(self):
         """Occupancy per (sel1 atom, sel2 atom) terminal pair: fraction

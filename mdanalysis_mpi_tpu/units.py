@@ -1,0 +1,137 @@
+"""Unit conversion (upstream ``MDAnalysis.units``).
+
+The framework's internal bases match upstream: length Å, time ps,
+charge e, mass u, speed Å/ps, force kJ/(mol·Å), energy kJ/mol,
+density count/Å³ (plus the water-based conveniences upstream ships).
+``convert(x, "nm", "A")`` is the ported-script surface;
+``timeUnit_factor`` etc. expose the raw tables under upstream's names.
+
+Factors are "multiply by this to go FROM the base TO the unit" —
+upstream's convention — so ``convert`` divides by the source factor
+and multiplies by the target's.  Water density conveniences use the
+upstream reference values (TIP4P number density at 298 K).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+#: length, base Å
+lengthUnit_factor = {
+    "Angstrom": 1.0, "A": 1.0, "angstrom": 1.0, "Å": 1.0,
+    "nm": 0.1, "nanometer": 0.1,
+    "pm": 100.0, "picometer": 100.0,
+    "fm": 1.0e5, "femtometer": 1.0e5,
+}
+
+#: time, base ps
+timeUnit_factor = {
+    "ps": 1.0, "picosecond": 1.0,
+    "fs": 1.0e3, "femtosecond": 1.0e3,
+    "ns": 1.0e-3, "nanosecond": 1.0e-3,
+    "us": 1.0e-6, "microsecond": 1.0e-6, "μs": 1.0e-6,
+    "ms": 1.0e-9, "millisecond": 1.0e-9,
+    "s": 1.0e-12, "second": 1.0e-12,
+    "AKMA": 1.0 / 4.888821e-2,      # CHARMM's AKMA time unit
+}
+
+#: speed, base Å/ps
+speedUnit_factor = {
+    "Angstrom/ps": 1.0, "A/ps": 1.0, "Å/ps": 1.0,
+    "nm/ps": 0.1, "pm/ps": 100.0,
+    "m/s": 100.0, "Angstrom/fs": 1.0e-3, "A/fs": 1.0e-3,
+    "Angstrom/AKMA": 4.888821e-2, "A/AKMA": 4.888821e-2,
+    "nm/ns": 100.0,
+}
+
+#: charge, base e
+chargeUnit_factor = {
+    "e": 1.0,
+    "C": 1.602176634e-19, "As": 1.602176634e-19,
+    "Amber": 18.2223,               # sqrt(kcal/mol·Å) charges
+}
+
+#: force, base kJ/(mol·Å)
+forceUnit_factor = {
+    "kJ/(mol*Angstrom)": 1.0, "kJ/(mol*A)": 1.0, "kJ/(mol*Å)": 1.0,
+    "kJ/(mol*nm)": 10.0,
+    "kcal/(mol*Angstrom)": 1.0 / 4.184, "kcal/(mol*A)": 1.0 / 4.184,
+    "Newton": 1.66053906660e-11, "N": 1.66053906660e-11,
+}
+
+#: energy, base kJ/mol
+energyUnit_factor = {
+    "kJ/mol": 1.0,
+    "kcal/mol": 1.0 / 4.184,
+    "J": 1.66053906660e-21,
+    "eV": 1.0364269574711572e-2,
+}
+
+#: mass, base u
+massUnit_factor = {
+    "u": 1.0, "amu": 1.0, "Da": 1.0, "dalton": 1.0,
+    "kg": 1.66053906660e-27, "g": 1.66053906660e-24,
+}
+
+#: number density, base Å^-3
+densityUnit_factor = {
+    "Angstrom^{-3}": 1.0, "A^{-3}": 1.0, "Å^{-3}": 1.0,
+    "nm^{-3}": 1000.0,
+    # upstream's water conveniences: bulk TIP4P water at 298 K, 0.997
+    # g/cm³ → 0.033366 waters/Å³
+    "water": 1.0 / 0.033366,
+    "Molar": 1.0 / (6.02214076e-4),    # mol/L per Å^-3
+}
+
+#: every category in one registry (upstream ``conversion_factor``)
+conversion_factor = {
+    "length": lengthUnit_factor,
+    "time": timeUnit_factor,
+    "speed": speedUnit_factor,
+    "charge": chargeUnit_factor,
+    "force": forceUnit_factor,
+    "energy": energyUnit_factor,
+    "mass": massUnit_factor,
+    "density": densityUnit_factor,
+}
+
+#: unit name → category (flat lookup for convert())
+unit_types: dict = {}
+for _cat, _table in conversion_factor.items():
+    for _unit in _table:
+        if _unit in unit_types and unit_types[_unit] != _cat:
+            raise AssertionError(
+                f"unit name {_unit!r} is ambiguous across categories")
+        unit_types[_unit] = _cat
+
+
+def get_conversion_factor(category: str, u1: str, u2: str) -> float:
+    """Multiplicative factor taking values in ``u1`` to ``u2`` within
+    ``category`` (upstream signature)."""
+    table = conversion_factor[category]
+    return table[u2] / table[u1]
+
+
+def convert(x, u1: str, u2: str):
+    """Convert ``x`` from unit ``u1`` to ``u2`` (upstream
+    ``units.convert``): scalars stay scalars, arrays convert
+    elementwise; unknown or cross-category units raise ValueError."""
+    try:
+        t1 = unit_types[u1]
+    except KeyError:
+        raise ValueError(
+            f"unit {u1!r} is not recognized (known: "
+            f"{sorted(unit_types)[:12]}...)") from None
+    try:
+        t2 = unit_types[u2]
+    except KeyError:
+        raise ValueError(
+            f"unit {u2!r} is not recognized (known: "
+            f"{sorted(unit_types)[:12]}...)") from None
+    if t1 != t2:
+        raise ValueError(
+            f"cannot convert between {u1!r} ({t1}) and {u2!r} ({t2})")
+    factor = get_conversion_factor(t1, u1, u2)
+    if np.isscalar(x):
+        return x * factor
+    return np.asarray(x) * factor

@@ -143,13 +143,8 @@ def run_config(cfg: AnalysisConfig, universe=None):
         kwargs["batch_size"] = cfg.batch_size
     if cfg.backend in ("jax", "mesh") and cfg.transfer_dtype != "float32":
         kwargs["transfer_dtype"] = cfg.transfer_dtype
-    out = a.run(start=cfg.start, stop=cfg.stop, step=cfg.step,
-                backend=cfg.backend, **kwargs)
-    if cfg.analysis == "waterbridge":
-        # the nested bridge chains are not npz-able; the per-frame
-        # count series is the CLI-facing summary
-        a.results.bridge_counts = a.count_by_time()
-    return out
+    return a.run(start=cfg.start, stop=cfg.stop, step=cfg.step,
+                 backend=cfg.backend, **kwargs)
 
 
 def _parser() -> argparse.ArgumentParser:
@@ -196,7 +191,7 @@ def _parser() -> argparse.ArgumentParser:
                    help="gnm: Kirchhoff contact cutoff in Å")
     p.add_argument("--binsize", type=float, default=0.25,
                    help="lineardensity slab thickness in Å")
-    p.add_argument("--order", type=int, default=1,
+    p.add_argument("--wb-order", type=int, default=1,
                    help="waterbridge: max waters in a bridge chain")
     p.add_argument("--wb-distance", type=float, default=3.0,
                    help="waterbridge donor-acceptor cutoff (A)")
@@ -227,7 +222,7 @@ def main(argv=None) -> int:
         engine=ns.engine, align=ns.align, n_components=ns.n_components,
         msd_type=ns.msd_type, delta=ns.delta, dtmax=ns.dtmax,
         binsize=ns.binsize, gnm_cutoff=ns.gnm_cutoff,
-        wb_order=ns.order, wb_distance=ns.wb_distance,
+        wb_order=ns.wb_order, wb_distance=ns.wb_distance,
         wb_angle=ns.wb_angle, water=ns.water)
     from mdanalysis_mpi_tpu.utils.timers import device_trace
 

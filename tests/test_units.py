@@ -1,0 +1,57 @@
+"""units.convert: exact factors against independent physical
+constants, round-trip identity, array elementwise behavior, and the
+loud cross-category / unknown-unit contract."""
+
+import numpy as np
+import pytest
+
+from mdanalysis_mpi_tpu import units
+
+
+def test_length_conversions():
+    assert units.convert(1.0, "nm", "A") == pytest.approx(10.0)
+    assert units.convert(10.0, "A", "nm") == pytest.approx(1.0)
+    assert units.convert(1.0, "A", "pm") == pytest.approx(100.0)
+
+
+def test_time_conversions():
+    assert units.convert(1.0, "ns", "ps") == pytest.approx(1000.0)
+    assert units.convert(1000.0, "fs", "ps") == pytest.approx(1.0)
+    assert units.convert(1.0, "ps", "s") == pytest.approx(1e-12)
+
+
+def test_energy_force_charge():
+    assert units.convert(1.0, "kcal/mol", "kJ/mol") == pytest.approx(
+        4.184)
+    assert units.convert(4.184, "kJ/(mol*A)",
+                         "kcal/(mol*A)") == pytest.approx(1.0)
+    # one electron in coulombs
+    assert units.convert(1.0, "e", "C") == pytest.approx(
+        1.602176634e-19)
+
+
+def test_round_trip_all_units():
+    rng = np.random.default_rng(0)
+    for cat, table in units.conversion_factor.items():
+        base = next(iter(table))
+        for u in table:
+            x = float(rng.uniform(0.5, 2.0))
+            back = units.convert(units.convert(x, base, u), u, base)
+            assert back == pytest.approx(x, rel=1e-12), (cat, u)
+
+
+def test_array_elementwise():
+    out = units.convert(np.array([1.0, 2.0, 3.0]), "nm", "A")
+    np.testing.assert_allclose(out, [10.0, 20.0, 30.0])
+
+
+def test_cross_category_and_unknown_raise():
+    with pytest.raises(ValueError, match="cannot convert"):
+        units.convert(1.0, "nm", "ps")
+    with pytest.raises(ValueError, match="not recognized"):
+        units.convert(1.0, "parsec", "A")
+
+
+def test_get_conversion_factor_signature():
+    assert units.get_conversion_factor("length", "nm",
+                                       "A") == pytest.approx(10.0)
