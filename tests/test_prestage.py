@@ -125,3 +125,41 @@ def test_prestage_on_mesh_backend():
         prestage=True)
     np.testing.assert_allclose(np.asarray(m.results.rmsf),
                                s.results.rmsf, atol=1e-3)
+
+
+# window > chunk is coerced to chunk = window by the executor (a wire
+# window cannot outrun its chunk), so (4, 3) is the largest-window
+# distinct geometry; the coercion itself is pinned below
+@pytest.mark.parametrize("chunk,window", [(1, 1), (2, 1), (4, 3),
+                                          (3, 2), (6, 4)])
+def test_chunk_window_sweep_bit_identical(monkeypatch, chunk, window):
+    """Every chunk/window geometry reproduces the same staged bytes
+    (same hint evolution, same batch order) — the schedule knobs are
+    pure performance, never semantics."""
+    monkeypatch.setenv("MDTPU_PRESTAGE_CHUNK", str(chunk))
+    monkeypatch.setenv("MDTPU_WIRE_WINDOW", str(window))
+    u = make_protein_universe(n_residues=24, n_frames=48, noise=0.25)
+    u.trajectory.__dict__.pop("_quant_max_hints", None)
+    r = AlignedRMSF(u, select="name CA").run(
+        backend="jax", batch_size=8, transfer_dtype="int16",
+        block_cache=DeviceBlockCache(), prestage=True)
+    u.trajectory.__dict__.pop("_quant_max_hints", None)
+    ref = AlignedRMSF(u, select="name CA").run(
+        backend="jax", batch_size=8, transfer_dtype="int16",
+        block_cache=DeviceBlockCache())
+    np.testing.assert_array_equal(np.asarray(r.results.rmsf),
+                                  np.asarray(ref.results.rmsf))
+
+
+def test_window_exceeding_chunk_is_coerced(monkeypatch):
+    """MDTPU_WIRE_WINDOW > MDTPU_PRESTAGE_CHUNK runs with chunk raised
+    to the window (phase separation would otherwise break); results
+    stay bit-identical to the plain schedule."""
+    monkeypatch.setenv("MDTPU_PRESTAGE_CHUNK", "1")
+    monkeypatch.setenv("MDTPU_WIRE_WINDOW", "4")
+    u = make_protein_universe(n_residues=24, n_frames=32, noise=0.25)
+    events = _traced(u, monkeypatch)
+    RMSD(u.select_atoms("name CA")).run(backend="jax", batch_size=8,
+                                        prestage=True)
+    # effective chunk == window == 4: all 4 stages precede all 4 puts
+    assert events == ["stage"] * 4 + ["put"] * 4, events

@@ -183,6 +183,28 @@ assert e2 < 1e-3, f"cache-served second run diverged: {e2:.2e}"
 print(f"file_backed int16 prestage+cache err {e1:.2e}/{e2:.2e} "
       f"hits {cachef.hits}")
 
+# --- chunked prestage + windowed wire on chip: force multiple chunks
+# (4 blocks / chunk 2) with a 2-deep put window — the bounded-residency
+# schedule must reproduce the one-chunk run's staged bytes exactly ---
+_os.environ["MDTPU_PRESTAGE_CHUNK"] = "2"
+_os.environ["MDTPU_WIRE_WINDOW"] = "2"
+try:
+    # fresh hint state: a1 warmed the adaptive quantizer's scale hints,
+    # and a warm-hint re-stage quantizes at a DIFFERENT (coarser-range)
+    # scale — bit-equality needs the same cold-hint evolution
+    uf.trajectory.__dict__.pop("_quant_max_hints", None)
+    uf.trajectory.__dict__.pop("_host_stage_cache", None)
+    a3 = AlignedRMSF(uf, select="heavy").run(
+        backend="jax", batch_size=16, transfer_dtype="int16",
+        block_cache=DeviceBlockCache(), prestage=True)
+    e3 = float(np.abs(np.asarray(a3.results.rmsf)
+                      - np.asarray(a1.results.rmsf)).max())
+    assert e3 == 0.0, f"chunked schedule changed staged bytes: {e3:.2e}"
+    print("chunked prestage (chunk=2, window=2) bit-equal: ok")
+finally:
+    _os.environ.pop("MDTPU_PRESTAGE_CHUNK", None)
+    _os.environ.pop("MDTPU_WIRE_WINDOW", None)
+
 # --- round-5 delta wire format on chip: correlated trajectory (the
 # format's stated envelope), keyframe+residual reconstruction on
 # device, differenced against the serial f64 oracle ---
