@@ -44,7 +44,8 @@ from mdanalysis_mpi_tpu.analysis.pca import PCA
 from mdanalysis_mpi_tpu.analysis.msd import EinsteinMSD
 from mdanalysis_mpi_tpu.analysis.dihedrals import Dihedral, Ramachandran
 from mdanalysis_mpi_tpu.analysis.contacts import Contacts
-from mdanalysis_mpi_tpu.analysis.density import DensityAnalysis
+from mdanalysis_mpi_tpu.analysis.density import (Density,
+                                                 DensityAnalysis)
 from mdanalysis_mpi_tpu.analysis.hbonds import HydrogenBondAnalysis
 from mdanalysis_mpi_tpu.analysis.diffusionmap import (DistanceMatrix,
                                                       DiffusionMap)
@@ -81,6 +82,7 @@ __all__ = ["AnalysisBase", "AnalysisCollection", "Results",
            "InterRDF", "InterRDF_s", "ContactMap",
            "PairwiseDistances", "RadiusOfGyration", "PCA", "EinsteinMSD",
            "Dihedral", "Ramachandran", "Janin", "Contacts", "DensityAnalysis",
+           "Density",
            "HydrogenBondAnalysis", "DistanceMatrix", "DiffusionMap",
            "VelocityAutocorr", "LinearDensity", "GNMAnalysis",
            "SurvivalProbability", "DielectricConstant",

@@ -188,9 +188,138 @@ class DensityAnalysis(AnalysisBase):
                 "origin": origin,
                 "edges": [ex, ey, ez],
                 "edges_x": ex, "edges_y": ey, "edges_z": ez,
+                # upstream's unit-aware container (convert_density /
+                # DX export); `density` stays the plain ndarray —
+                # documented deviation, PARITY.md
+                "density_object": Density(grid / delta ** 3,
+                                          [ex, ey, ez]),
             }
 
         g = deferred_group(_finalize)
         for k in ("grid", "density", "n_outside", "origin", "edges",
-                  "edges_x", "edges_y", "edges_z"):
+                  "edges_x", "edges_y", "edges_z", "density_object"):
             self.results[k] = g[k]
+
+
+class Density:
+    """Grid + metadata container (upstream ``analysis.density.Density``):
+    unit-aware number-density grid with in-place
+    :meth:`convert_density` (via :mod:`mdanalysis_mpi_tpu.units`) and
+    OpenDX :meth:`export` for VMD/PyMOL interop.
+
+    ``grid`` is (nx, ny, nz); ``edges`` the three bin-edge arrays (Å).
+    The density unit starts as ``A^{-3}`` (the framework's base).
+    """
+
+    def __init__(self, grid: np.ndarray, edges, units: str = "A^{-3}"):
+        self.grid = np.asarray(grid, np.float64)
+        if self.grid.ndim != 3:
+            raise ValueError(f"grid must be 3-D, got {self.grid.shape}")
+        self.edges = [np.asarray(e, np.float64) for e in edges]
+        if len(self.edges) != 3 or any(
+                len(e) != n + 1 for e, n in zip(self.edges,
+                                                self.grid.shape)):
+            raise ValueError(
+                "edges must be three arrays of length grid.shape[i]+1")
+        self.units = {"length": "A", "density": units}
+
+    @property
+    def origin(self) -> np.ndarray:
+        return np.array([e[0] for e in self.edges])
+
+    @property
+    def delta(self) -> np.ndarray:
+        return np.array([e[1] - e[0] for e in self.edges])
+
+    def convert_density(self, unit: str = "water") -> "Density":
+        """In-place unit conversion of the grid values (upstream
+        semantics); returns self for chaining."""
+        from mdanalysis_mpi_tpu import units as u
+
+        try:
+            factor = u.get_conversion_factor(
+                "density", self.units["density"], unit)
+        except KeyError:
+            raise ValueError(
+                f"unknown density unit {unit!r}; known: "
+                f"{sorted(u.densityUnit_factor)}") from None
+        self.grid *= factor
+        self.units["density"] = unit
+        return self
+
+    def export(self, path: str, type: str = "DX") -> None:
+        """Write the grid as OpenDX (the VMD/PyMOL volumetric format;
+        upstream ``Density.export``)."""
+        if type.upper() != "DX":
+            raise ValueError(f"only DX export is supported, got {type!r}")
+        nx, ny, nz = self.grid.shape
+        o = self.origin
+        d = self.delta
+        with open(path, "w") as fh:
+            fh.write("# OpenDX density written by mdanalysis_mpi_tpu\n")
+            fh.write(f"object 1 class gridpositions counts "
+                     f"{nx} {ny} {nz}\n")
+            fh.write(f"origin {o[0]:.6f} {o[1]:.6f} {o[2]:.6f}\n")
+            fh.write(f"delta {d[0]:.6f} 0 0\n")
+            fh.write(f"delta 0 {d[1]:.6f} 0\n")
+            fh.write(f"delta 0 0 {d[2]:.6f}\n")
+            fh.write(f"object 2 class gridconnections counts "
+                     f"{nx} {ny} {nz}\n")
+            fh.write(f"object 3 class array type double rank 0 items "
+                     f"{self.grid.size} data follows\n")
+            flat = self.grid.ravel()        # C order: z fastest (DX)
+            full = len(flat) // 3 * 3       # 64M-voxel grids are legal:
+            if full:                        # C-speed formatting, not a
+                np.savetxt(fh, flat[:full].reshape(-1, 3),  # py loop
+                           fmt="%.10g")
+            if full < len(flat):
+                fh.write(" ".join(f"{v:.10g}" for v in flat[full:])
+                         + "\n")
+            fh.write('attribute "dep" string "positions"\n')
+            fh.write('object "density" class field\n')
+            fh.write('component "positions" value 1\n')
+            fh.write('component "connections" value 2\n')
+            fh.write('component "data" value 3\n')
+
+    @classmethod
+    def from_dx(cls, path: str, units: str = "A^{-3}") -> "Density":
+        """Read an OpenDX grid (regular deltas) back into a Density."""
+        counts = origin = None
+        deltas = []
+        values: list = []
+        n_items = None
+        with open(path) as fh:
+            for ln in fh:
+                s = ln.strip()
+                if not s or s.startswith("#"):
+                    continue
+                t = s.split()
+                if s.startswith("object") and "gridpositions" in s:
+                    counts = [int(x) for x in t[-3:]]
+                elif t[0] == "origin":
+                    origin = [float(x) for x in t[1:4]]
+                elif t[0] == "delta":
+                    deltas.append([float(x) for x in t[1:4]])
+                elif "data follows" in s:
+                    n_items = int(t[t.index("items") + 1])
+                elif n_items is not None and len(values) < n_items:
+                    try:
+                        values.extend(float(x) for x in t)
+                    except ValueError:
+                        break          # trailing attribute block
+        if counts is None or origin is None or len(deltas) != 3:
+            raise ValueError(f"{path!r} is not a regular-grid DX file")
+        for i, dv in enumerate(deltas):
+            off = [abs(dv[j]) for j in range(3) if j != i]
+            if max(off) > 1e-9 * max(abs(dv[i]), 1e-30):
+                raise ValueError(
+                    f"{path!r}: delta {i} has off-axis components "
+                    f"{dv} — sheared/rotated DX grids are not "
+                    "supported (regular axis-aligned grids only)")
+        d = [deltas[i][i] for i in range(3)]
+        if n_items is None or len(values) < n_items:
+            raise ValueError(f"{path!r}: truncated data section")
+        grid = np.asarray(values[:n_items], np.float64).reshape(counts)
+        edges = [origin[i] + d[i] * np.arange(counts[i] + 1)
+                 for i in range(3)]
+        return cls(grid, edges, units=units)

@@ -84,3 +84,82 @@ class TestDensity:
             DensityAnalysis(ow, delta=0.01).run(stop=1, backend="serial")
         with pytest.raises(ValueError, match="gridcenter"):
             DensityAnalysis(ow, xdim=10, ydim=10, zdim=10)
+
+
+class TestDensityObject:
+    def _density(self):
+        from mdanalysis_mpi_tpu.analysis.density import Density
+        grid = np.arange(24, dtype=np.float64).reshape(2, 3, 4)
+        edges = [np.arange(3) * 2.0, np.arange(4) * 2.0,
+                 np.arange(5) * 2.0]
+        return Density(grid, edges)
+
+    def test_convert_density_round_trip(self):
+        from mdanalysis_mpi_tpu import units
+        d = self._density()
+        raw = d.grid.copy()
+        d.convert_density("Molar")
+        factor = units.get_conversion_factor("density", "A^{-3}",
+                                             "Molar")
+        np.testing.assert_allclose(d.grid, raw * factor)
+        assert d.units["density"] == "Molar"
+        d.convert_density("A^{-3}")
+        np.testing.assert_allclose(d.grid, raw, rtol=1e-12)
+
+    def test_dx_export_import_round_trip(self, tmp_path):
+        from mdanalysis_mpi_tpu.analysis.density import Density
+        d = self._density()
+        p = str(tmp_path / "rho.dx")
+        d.export(p)
+        back = Density.from_dx(p)
+        np.testing.assert_allclose(back.grid, d.grid, rtol=1e-9)
+        for a, b in zip(back.edges, d.edges):
+            np.testing.assert_allclose(a, b, atol=1e-6)
+
+    def test_dx_header_structure(self, tmp_path):
+        d = self._density()
+        p = str(tmp_path / "rho.dx")
+        d.export(p)
+        text = open(p).read()
+        assert "object 1 class gridpositions counts 2 3 4" in text
+        assert "origin 0.000000 0.000000 0.000000" in text
+        assert 'component "data" value 3' in text
+
+    def test_analysis_results_density_object(self):
+        from mdanalysis_mpi_tpu.analysis.density import Density
+        u = make_water_universe(n_waters=27, n_frames=2, box=9.3)
+        a = DensityAnalysis(u.select_atoms("name OW"),
+                            delta=3.0).run()
+        obj = a.results.density_object
+        assert isinstance(obj, Density)
+        np.testing.assert_allclose(obj.grid,
+                                   np.asarray(a.results.density))
+        # conversion does not corrupt the separate plain ndarray
+        before = np.asarray(a.results.density).copy()
+        obj.convert_density("nm^{-3}")
+        assert obj.units["density"] == "nm^{-3}"
+        np.testing.assert_array_equal(np.asarray(a.results.density),
+                                      before)
+        with pytest.raises(ValueError, match="unknown density unit"):
+            obj.convert_density("bogus")
+
+    def test_validation(self):
+        from mdanalysis_mpi_tpu.analysis.density import Density
+        with pytest.raises(ValueError, match="3-D"):
+            Density(np.zeros((2, 2)), [np.arange(3)] * 3)
+        with pytest.raises(ValueError, match="edges"):
+            Density(np.zeros((2, 2, 2)), [np.arange(2)] * 3)
+        d = self._density()
+        with pytest.raises(ValueError, match="DX"):
+            d.export("/tmp/x.cube", type="CUBE")
+
+    def test_from_dx_rejects_sheared_grid(self, tmp_path):
+        from mdanalysis_mpi_tpu.analysis.density import Density
+        d = self._density()
+        p = str(tmp_path / "rho.dx")
+        d.export(p)
+        text = open(p).read().replace("delta 0 2.000000 0",
+                                      "delta 0.7 2.000000 0")
+        open(p, "w").write(text)
+        with pytest.raises(ValueError, match="off-axis"):
+            Density.from_dx(p)
