@@ -212,6 +212,8 @@ class Density:
     """
 
     def __init__(self, grid: np.ndarray, edges, units: str = "A^{-3}"):
+        from mdanalysis_mpi_tpu.units import densityUnit_factor
+
         self.grid = np.asarray(grid, np.float64)
         if self.grid.ndim != 3:
             raise ValueError(f"grid must be 3-D, got {self.grid.shape}")
@@ -221,6 +223,10 @@ class Density:
                                                 self.grid.shape)):
             raise ValueError(
                 "edges must be three arrays of length grid.shape[i]+1")
+        if units not in densityUnit_factor:
+            raise ValueError(
+                f"unknown density unit {units!r}; known: "
+                f"{sorted(densityUnit_factor)}")
         self.units = {"length": "A", "density": units}
 
     @property
@@ -236,13 +242,12 @@ class Density:
         semantics); returns self for chaining."""
         from mdanalysis_mpi_tpu import units as u
 
-        try:
-            factor = u.get_conversion_factor(
-                "density", self.units["density"], unit)
-        except KeyError:
+        if unit not in u.densityUnit_factor:
             raise ValueError(
                 f"unknown density unit {unit!r}; known: "
-                f"{sorted(u.densityUnit_factor)}") from None
+                f"{sorted(u.densityUnit_factor)}")
+        factor = u.get_conversion_factor(
+            "density", self.units["density"], unit)
         self.grid *= factor
         self.units["density"] = unit
         return self
@@ -253,16 +258,22 @@ class Density:
         if type.upper() != "DX":
             raise ValueError(f"only DX export is supported, got {type!r}")
         nx, ny, nz = self.grid.shape
-        o = self.origin
         d = self.delta
+        # DX grid positions are the SAMPLE POINTS — upstream
+        # (gridData/APBS/VMD) puts the origin at the first voxel
+        # CENTER, i.e. half a voxel inside the first bin edge.  The
+        # in-repo round trip cannot distinguish the two conventions
+        # (a symmetric shift cancels), so this is pinned explicitly
+        # in tests against the documented convention.
+        o = self.origin + 0.5 * d
         with open(path, "w") as fh:
             fh.write("# OpenDX density written by mdanalysis_mpi_tpu\n")
             fh.write(f"object 1 class gridpositions counts "
                      f"{nx} {ny} {nz}\n")
-            fh.write(f"origin {o[0]:.6f} {o[1]:.6f} {o[2]:.6f}\n")
-            fh.write(f"delta {d[0]:.6f} 0 0\n")
-            fh.write(f"delta 0 {d[1]:.6f} 0\n")
-            fh.write(f"delta 0 0 {d[2]:.6f}\n")
+            fh.write(f"origin {o[0]:.10g} {o[1]:.10g} {o[2]:.10g}\n")
+            fh.write(f"delta {d[0]:.10g} 0 0\n")
+            fh.write(f"delta 0 {d[1]:.10g} 0\n")
+            fh.write(f"delta 0 0 {d[2]:.10g}\n")
             fh.write(f"object 2 class gridconnections counts "
                      f"{nx} {ny} {nz}\n")
             fh.write(f"object 3 class array type double rank 0 items "
@@ -320,6 +331,8 @@ class Density:
         if n_items is None or len(values) < n_items:
             raise ValueError(f"{path!r}: truncated data section")
         grid = np.asarray(values[:n_items], np.float64).reshape(counts)
-        edges = [origin[i] + d[i] * np.arange(counts[i] + 1)
+        # DX origin is the first voxel CENTER (see export); the first
+        # bin EDGE sits half a voxel below it
+        edges = [origin[i] - 0.5 * d[i] + d[i] * np.arange(counts[i] + 1)
                  for i in range(3)]
         return cls(grid, edges, units=units)

@@ -33,11 +33,28 @@ def _dihedral_kernel(params, batch, boxes, mask):
 
 class Dihedral(AnalysisBase):
     """``Dihedral([ag, ...]).run().results.angles`` — each AtomGroup is
-    one dihedral: exactly 4 atoms, in order."""
+    one dihedral: exactly 4 atoms, in order.  A
+    :class:`~mdanalysis_mpi_tpu.core.topologyobjects.TopologyGroup` of
+    dihedrals/impropers is accepted directly
+    (``Dihedral(u.dihedrals).run()`` — all members, one batched
+    kernel)."""
 
     def __init__(self, atomgroups, verbose: bool = False):
         from mdanalysis_mpi_tpu.analysis.base import reject_updating_groups
+        from mdanalysis_mpi_tpu.core.topologyobjects import TopologyGroup
 
+        if isinstance(atomgroups, TopologyGroup):
+            tg = atomgroups
+            if tg.indices.shape[1] != 4:
+                raise ValueError(
+                    f"a {tg.kind} TopologyGroup has {tg.indices.shape[1]}"
+                    "-atom members; Dihedral needs 4-atom tuples "
+                    "(dihedrals or impropers)")
+            if len(tg) == 0:
+                raise ValueError("empty dihedral TopologyGroup")
+            super().__init__(tg._universe, verbose)
+            self._quads_global = tg.indices.copy()
+            return
         atomgroups = list(atomgroups)
         if not atomgroups:
             raise ValueError("need at least one 4-atom AtomGroup")

@@ -122,7 +122,12 @@ class TestDensityObject:
         d.export(p)
         text = open(p).read()
         assert "object 1 class gridpositions counts 2 3 4" in text
-        assert "origin 0.000000 0.000000 0.000000" in text
+        # the DX origin is the first voxel CENTER (edge 0 + delta/2 =
+        # 1.0 for these delta-2 edges) — the gridData/APBS/VMD
+        # convention; an edge-origin here would misregister maps by
+        # half a voxel in external viewers
+        assert "origin 1 1 1" in text
+        assert "delta 2 0 0" in text
         assert 'component "data" value 3' in text
 
     def test_analysis_results_density_object(self):
@@ -158,8 +163,8 @@ class TestDensityObject:
         d = self._density()
         p = str(tmp_path / "rho.dx")
         d.export(p)
-        text = open(p).read().replace("delta 0 2.000000 0",
-                                      "delta 0.7 2.000000 0")
+        text = open(p).read().replace("delta 0 2 0",
+                                      "delta 0.7 2 0")
         open(p, "w").write(text)
         with pytest.raises(ValueError, match="off-axis"):
             Density.from_dx(p)

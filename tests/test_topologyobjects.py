@@ -127,3 +127,18 @@ def test_minimum_image_bond_values():
     dims = np.array([10, 10, 10, 90, 90, 90], np.float32)
     u = Universe(top, MemoryReader(coords, dimensions=dims))
     np.testing.assert_allclose(u.bonds.values(), [1.0], atol=1e-5)
+
+
+def test_dihedral_analysis_accepts_topologygroup():
+    """Dihedral(u.dihedrals) runs the batched kernel over every proper
+    dihedral and matches the TopologyGroup's own per-frame values."""
+    from mdanalysis_mpi_tpu.analysis import Dihedral
+
+    u = _butane_like()
+    a = Dihedral(u.dihedrals).run()
+    assert np.asarray(a.results.angles).shape == (1, 1)
+    tg_val = u.dihedrals.values()[0]
+    np.testing.assert_allclose(abs(a.results.angles[0, 0]),
+                               abs(tg_val), atol=1e-4)
+    with pytest.raises(ValueError, match="4-atom"):
+        Dihedral(u.angles)
