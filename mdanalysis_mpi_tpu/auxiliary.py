@@ -121,6 +121,27 @@ class XVGReader(ArrayAuxReader):
         self._path = path
 
 
+class EDRReader:
+    """GROMACS ``.edr`` energy files — documented conversion path.
+
+    Upstream reads EDR through the ``pyedr`` package, which is not in
+    this environment, and the EDR binary layout is a versioned GROMACS
+    internal (the TPR rationale: a parser validated only against
+    self-written bytes would be circular).  Convert once —
+
+        gmx energy -f ener.edr -o energy.xvg
+
+    — and attach the XVG: ``u.trajectory.add_auxiliary("energy",
+    XVGReader("energy.xvg"))``.
+    """
+
+    def __init__(self, path: str):
+        raise ValueError(
+            f"EDR files are not read directly ({path}); convert once "
+            "with 'gmx energy -f ener.edr -o energy.xvg' and use "
+            "XVGReader — see auxiliary.EDRReader for why")
+
+
 class AuxHolder(dict):
     """Attribute-accessible per-frame aux namespace (``ts.aux.force``)."""
 

@@ -195,3 +195,14 @@ def test_attach_preserves_current_frame():
     u.trajectory.remove_auxiliary("e")
     assert u.trajectory.ts.frame == 2
     assert u.trajectory.ts.aux is None
+
+
+def test_edr_closed_with_guidance(tmp_path):
+    """EDR is a documented conversion path, not a parser: the error
+    carries the gmx recipe (the TPR/H5MD closure pattern)."""
+    from mdanalysis_mpi_tpu.auxiliary import EDRReader
+
+    p = tmp_path / "ener.edr"
+    p.write_bytes(b"\x00" * 16)
+    with pytest.raises(ValueError, match="gmx energy"):
+        EDRReader(str(p))
