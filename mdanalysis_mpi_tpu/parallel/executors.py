@@ -286,7 +286,8 @@ def quantize_block_delta(block: np.ndarray, n_anchors: int = 1,
     - ``res``  (B, S, 3) int8 — per-frame residuals, each frame with
       its OWN scale (``inv_res`` (B, 1, 1)), so one large step only
       coarsens its own frame;
-    - scales ``inv_abs`` (scalar) and ``inv_res``.
+    - scales ``inv_abs`` (n_anchors, 1, 1) — anchor-axis-sharded with
+      the keyframes — and per-frame ``inv_res``.
 
     Closed-loop: residual t is computed against the receiver's
     reconstruction x̂_{t−1}, so quantization errors do NOT random-walk —
@@ -318,7 +319,12 @@ def quantize_block_delta(block: np.ndarray, n_anchors: int = 1,
         n_valid = b
     m = float(np.abs(block).max()) if block.size else 1.0
     scale_abs = 32000.0 / max(m, 1e-30)
-    inv_abs = np.float32(1.0 / scale_abs)
+    # per-anchor (A, 1, 1) rather than one scalar: sharded along the
+    # anchor axis with the keyframes, each device (and, at N
+    # controllers, each PROCESS) dequants its anchor with its own
+    # locally-computed scale — no cross-process scale agreement needed
+    inv_abs = np.full((n_anchors, 1, 1), np.float32(1.0 / scale_abs),
+                      dtype=np.float32)
     key = np.round(block[::seg] * scale_abs).astype(np.int16)
     res = np.zeros(block.shape, dtype=np.int8)
     inv_res = np.ones((b, 1, 1), dtype=np.float32)
@@ -326,7 +332,7 @@ def quantize_block_delta(block: np.ndarray, n_anchors: int = 1,
         lo = a * seg
         if lo >= n_valid:
             break                        # whole segment is padding
-        xhat = key[a].astype(np.float32) * inv_abs
+        xhat = key[a].astype(np.float32) * inv_abs[a, 0, 0]
         for t in range(lo + 1, min(lo + seg, n_valid)):
             r = block[t] - xhat
             mr = float(np.abs(r).max())
@@ -898,10 +904,12 @@ class MeshExecutor:
             if delta:
                 # (res, key, inv_abs, inv_res, boxes, mask): residuals
                 # and per-frame scales shard with the frames; the
-                # keyframe array has one anchor PER DEVICE on axis 0,
-                # so each shard reconstructs from its own absolute
-                # anchor (no cross-shard cumsum dependency)
-                in_specs = (P(), P(axis), P(axis), P(), P(axis),
+                # keyframe array has one anchor PER DEVICE on axis 0 —
+                # and its (A, 1, 1) inv_abs shards WITH it — so each
+                # shard reconstructs from its own absolute anchor at
+                # its own locally-computed scale (no cross-shard cumsum
+                # dependency, no cross-process scale agreement)
+                in_specs = (P(), P(axis), P(axis), P(axis), P(axis),
                             P(axis), P(axis))
                 put_specs = (P(axis), P(axis), P(axis), P(axis))
             elif quantize:
@@ -961,12 +969,6 @@ class MeshExecutor:
                                                        *staged))
 
         n_proc = jax.process_count()
-        if n_proc > 1 and self.transfer_dtype == "delta":
-            raise ValueError(
-                "transfer_dtype='delta' is single-controller only: the "
-                "closed-loop residual stream would need per-process "
-                "keyframe agreement across DCN; use 'int16' at N "
-                "controllers")
         if n_proc > 1:
             # Multi-controller (DCN) path: every process runs this same
             # execute() over the same global frame schedule; frame-
@@ -1002,7 +1004,14 @@ class MeshExecutor:
                 quantize=_quant_mode(self.transfer_dtype),
                 local_divisor=n_proc, local_index=jax.process_index(),
                 inv_per_frame=True, prestage=self.prestage,
-                fused_call=fused_call)
+                fused_call=fused_call,
+                # delta at N controllers: each process quantizes its
+                # OWN slice with one anchor per LOCAL device; the
+                # (A, 1, 1) inv_abs shards with the keyframes, so no
+                # scale agreement ever crosses DCN
+                delta_anchors=(jax.local_device_count()
+                               if self.transfer_dtype == "delta"
+                               else 1))
 
         def put(staged):
             return _put_staged(staged, shardings)

@@ -45,7 +45,7 @@ def test_closed_loop_error_bounded_per_frame():
     assert key.shape == (1,) + block.shape[1:]
     xhat = _reconstruct(res, key, inv_abs, inv_res)
     err = np.abs(xhat - block).max(axis=(1, 2))          # per frame
-    bound = 0.51 * (inv_res[:, 0, 0] + inv_abs) + 1e-5
+    bound = 0.51 * (inv_res[:, 0, 0] + inv_abs[0, 0, 0]) + 1e-5
     assert (err <= bound).all(), (err / bound).max()
     # the LAST frame is no worse than the bound either — accumulation
     # would show up exactly here
@@ -63,8 +63,10 @@ def test_anchor_segments_and_pad_rows():
     assert key.shape == (4,) + block.shape[1:]
     for a in range(4):
         seg = slice(a * 8, (a + 1) * 8)
-        xhat = _reconstruct(res[seg], key[a:a + 1], inv_abs, inv_res[seg])
-        bound = 0.51 * (inv_res[seg, 0, 0] + inv_abs) + 1e-5
+        xhat = _reconstruct(res[seg], key[a:a + 1],
+                            inv_abs[a:a + 1], inv_res[seg])
+        bound = (0.51 * (inv_res[seg, 0, 0]
+                         + inv_abs[a, 0, 0]) + 1e-5)
         assert (np.abs(xhat - block[seg]).max(axis=(1, 2)) <= bound).all()
         assert (res[seg][0] == 0).all()          # anchor row: no residual
     # pad rows (n_valid onward) carry zero residuals and unit scales
@@ -141,14 +143,17 @@ def test_mesh_delta_parity_and_prestage():
                                   np.asarray(p.results.rmsf))
 
 
-def test_delta_multi_controller_refusal(monkeypatch):
-    import jax
-
-    u = make_md_universe(n_residues=8, n_frames=8)
-    monkeypatch.setattr(jax, "process_count", lambda: 2)
-    with pytest.raises(ValueError, match="single-controller"):
-        AlignedRMSF(u, select="name CA").run(
-            backend=MeshExecutor(batch_size=4, transfer_dtype="delta"))
+def test_delta_inv_abs_shards_with_anchors():
+    """The (A, 1, 1) inv_abs is the multi-controller enabler: one
+    locally-computed scale per anchor, sharded with the keyframes —
+    never a replicated scalar that N processes would have to agree
+    on."""
+    block = _walk_block(b=32)
+    res, key, inv_abs, inv_res = quantize_block_delta(block, n_anchors=4)
+    assert inv_abs.shape == (4, 1, 1)
+    assert key.shape[0] == 4
+    # all anchors of ONE local block share the block's scale
+    assert np.all(inv_abs == inv_abs[0, 0, 0])
 
 
 def test_delta_rejected_for_ring_kernels():
@@ -200,10 +205,12 @@ def test_quantize_block_delta_fuzz():
         seg = b // n_anchors
         for a in range(n_anchors):
             sl = slice(a * seg, (a + 1) * seg)
-            xhat = _reconstruct(res[sl], key[a:a + 1], inv_abs,
+            xhat = _reconstruct(res[sl], key[a:a + 1],
+                                inv_abs[a:a + 1],
                                 inv_res[sl])
             err = np.abs(xhat - block[sl]).max(axis=(1, 2))
-            bound = 0.51 * (inv_res[sl, 0, 0] + inv_abs) + 1e-6
+            bound = (0.51 * (inv_res[sl, 0, 0]
+                             + inv_abs[a, 0, 0]) + 1e-6)
             assert (err <= bound).all(), (err, bound)
 
     check()

@@ -113,6 +113,16 @@ hx = HELANAL(u, select="name CA").run(backend="mesh", batch_size=2)
 chains = [u.select_atoms("name CA")]
 pl = PersistenceLength(chains).run(backend="mesh", batch_size=2)
 
+# round-5 continuation: delta wire format at 2 controllers — each
+# process quantizes its own slice with one anchor per LOCAL device and
+# the (A, 1, 1) inv_abs shards with the keyframes (no DCN scale
+# agreement).  Needs the correlated fixture: delta's precision IS the
+# frame-to-frame step.
+from mdanalysis_mpi_tpu.testing import make_md_universe
+ud = make_md_universe(n_residues={n_res}, n_frames={n_frames}, seed=7)
+dl = AlignedRMSF(ud, select="name CA").run(backend="mesh", batch_size=2,
+                                           transfer_dtype="delta")
+
 if pid == 0:
     np.savez({out!r}, rmsf=a.results.rmsf, rmsf_i16=q.results.rmsf,
              helanal_twists=np.asarray(hx.results.local_twists),
@@ -123,7 +133,8 @@ if pid == 0:
              ld_mass_z=np.asarray(ld.results.z.mass_density),
              ld_mass_std_z=np.asarray(ld.results.z.mass_density_stddev),
              ld_charge_z=np.asarray(ld.results.z.charge_density),
-             gnm_eigenvalues=np.asarray(gn.results.eigenvalues))
+             gnm_eigenvalues=np.asarray(gn.results.eigenvalues),
+             rmsf_delta=dl.results.rmsf)
 """
 
 
@@ -227,4 +238,14 @@ class TestTwoProcessMesh:
         np.testing.assert_allclose(got["pl_autocorr"],
                                    spl.results.bond_autocorrelation,
                                    atol=1e-4)
+
+        # delta wire at 2 controllers vs the serial f64 oracle on the
+        # correlated fixture (the format's own precision envelope)
+        from mdanalysis_mpi_tpu.testing import make_md_universe
+
+        ud = make_md_universe(n_residues=N_RES, n_frames=N_FRAMES,
+                              seed=7)
+        sdl = AlignedRMSF(ud, select="name CA").run(backend="serial")
+        np.testing.assert_allclose(got["rmsf_delta"], sdl.results.rmsf,
+                                   atol=1e-3)
 
