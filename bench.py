@@ -22,9 +22,16 @@ series"):
 - ``cold_value`` — the same file-backed run with every cache empty:
   XTC decode + gather/quantize + wire + compute; what a one-shot user
   pays first.
-- ``f32_nocache_value`` — the round-1-comparable leg: 512-frame
+- ``f32_nocache_highrss_value`` — the r01-LINEAGE leg: 512-frame
   in-memory trajectory, float32 staging, host cache cleared per run,
-  no cross-run device cache.  Comparable to BENCH_r01.json's number.
+  no cross-run device cache.  Named ``_highrss`` (and no longer
+  "r01-comparable") since r5 moved it AFTER the flagship cold/steady
+  legs: it now runs with the process's device-put mirrors already
+  resident, deliberately absorbing the high-RSS handicap the cold leg
+  must not pay — same measurement recipe as BENCH_r01.json, different
+  process conditions.  ``accel_leg_order`` records the ordering in
+  the artifact so cross-round readers can see when the protocol
+  changed (ADVICE r5 low).
 
 Baseline note (BASELINE.md): the reference publishes no numbers and
 this environment has no MPI, so ``vs_baseline`` keeps the r01/r02
@@ -761,14 +768,17 @@ def main():
               vs_baseline=round(fps_per_chip / baseline_fps, 2),
               **_roofline(fps_per_chip, len(heavy_idx)))
 
-    # --- r01-comparable f32 leg, LAST among accelerator legs: every
+    # --- r01-LINEAGE f32 leg, LAST among accelerator legs: every
     # device_put leaves an unreclaimable host-side mirror on this
     # tunneled client, so any wire-heavy leg that runs before the cold
     # leg pushes the process toward the hypervisor's fast-page window
     # and handicaps cold's staging.  Cold (the protocol-critical
     # number) therefore goes first; this diagnostic leg absorbs the
-    # high-RSS handicap instead, and its ordering is part of the
-    # declared methodology. ---
+    # high-RSS handicap instead.  The artifact keys say so
+    # (``f32_nocache_highrss_*``, plus ``accel_leg_order``): the
+    # measurement recipe matches r01 but the process conditions do
+    # not, and cross-round readers must be able to tell (ADVICE r5
+    # low — the old ``f32_nocache_*`` keys implied comparability). ---
     AlignedRMSF(u_mem, select=SELECT).run(          # compile warm-up
         stop=2 * BATCH, backend=accel_backend, batch_size=BATCH,
         transfer_dtype="float32")
@@ -782,12 +792,17 @@ def main():
         jax.block_until_ready(r.results["rmsf"])
         r01_walls.append(time.perf_counter() - t0)
     f32_nocache_fps = R01_FRAMES / float(np.median(r01_walls)) / n_chips
-    _note(f"[bench] r01-comparable f32 no-cache: {f32_nocache_fps:.1f} "
-          f"f/s/chip")
-    _leg_done("f32 no-cache leg",
-              f32_nocache_value=round(f32_nocache_fps, 2),
-              f32_nocache_vs_baseline=round(
-                  f32_nocache_fps / baseline_fps, 2))
+    _note(f"[bench] r01-lineage f32 no-cache (high-RSS conditions): "
+          f"{f32_nocache_fps:.1f} f/s/chip")
+    _leg_done("f32 no-cache (high-RSS) leg",
+              f32_nocache_highrss_value=round(f32_nocache_fps, 2),
+              f32_nocache_highrss_vs_baseline=round(
+                  f32_nocache_fps / baseline_fps, 2),
+              # the accelerator legs in execution order, so artifact
+              # readers can see the r5+ protocol (f32 leg demoted to
+              # last, absorbing the high-RSS handicap)
+              accel_leg_order=["cold", "steady", "f32_nocache_highrss",
+                               "divergence_gate"])
 
 
 

@@ -255,6 +255,15 @@ class AlignTraj(AnalysisBase):
 
     def run(self, start=None, stop=None, step=None, frames=None,
             backend: str = "jax", batch_size: int | None = 64, **kwargs):
+        if kwargs.pop("resilient", False):
+            # this run() drives its own superpose-and-write loop, not
+            # the executor hooks the reliability layer wraps; accepting
+            # the kwarg would promise fault tolerance it cannot deliver
+            raise ValueError(
+                "AlignTraj does not support resilient= (it drives its "
+                "own write loop); wrap the call in your own retry, or "
+                "use AlignedRMSF/AverageStructure for resilient "
+                "reductions")
         from mdanalysis_mpi_tpu.io.memory import MemoryReader
 
         u = self._universe

@@ -279,7 +279,7 @@ class AnalysisBase:
 
     def run(self, start=None, stop=None, step=None, frames=None,
             backend: str = "serial", batch_size: int | None = None,
-            **executor_kwargs):
+            resilient=False, **executor_kwargs):
         """Iterate frames [start:stop:step] — or an explicit ``frames``
         index list (upstream's ``run(frames=...)``) — on the chosen
         backend.
@@ -288,7 +288,30 @@ class AnalysisBase:
         (single-device batched), ``"mesh"`` (sharded over all devices),
         or an executor instance.  Returns ``self`` (chainable:
         ``RMSF(ag).run().results.rmsf``, the RMSF.py:15 idiom).
+
+        ``resilient``: ``True`` (default policy) or a
+        :class:`~mdanalysis_mpi_tpu.reliability.ReliabilityPolicy`
+        opts into fault-tolerant execution (docs/RELIABILITY.md):
+        retry-with-backoff around staging/dispatch, corrupt-frame
+        retry → skip-with-count → abort, Mesh→Jax→Serial degradation
+        on persistent device failure, and — for reduction analyses on
+        a batch backend — automatic checkpointing via
+        ``utils/checkpoint.py`` so re-running the same call after a
+        crash resumes from the last folded partials.  The run's
+        :class:`~mdanalysis_mpi_tpu.reliability.ReliabilityReport`
+        lands in ``results.reliability``.
         """
+        if resilient:
+            from mdanalysis_mpi_tpu.reliability.policy import (
+                ReliabilityPolicy, run_resilient,
+            )
+
+            policy = (resilient if isinstance(resilient, ReliabilityPolicy)
+                      else ReliabilityPolicy())
+            return run_resilient(
+                self, policy, start=start, stop=stop, step=step,
+                frames=frames, backend=backend, batch_size=batch_size,
+                **executor_kwargs)
         import time
 
         from mdanalysis_mpi_tpu.utils.timers import TIMERS

@@ -122,6 +122,10 @@ class PCA(AnalysisBase):
         # two passes over the same frames/selection → share one HBM
         # block cache, exactly like AlignedRMSF (pass 2 reads
         # device-resident blocks instead of re-staging)
+        #
+        # resilient= rides the child run() calls, never the executor
+        # constructor (same per-pass contract as AlignedRMSF.run)
+        resilient = kwargs.pop("resilient", False)
         if isinstance(backend, str) and backend != "serial":
             from mdanalysis_mpi_tpu.parallel.executors import (
                 DeviceBlockCache, get_executor)
@@ -135,11 +139,24 @@ class PCA(AnalysisBase):
             self._universe, select=self._select, ref_frame=self._ref_frame,
             select_only=True, verbose=self._verbose,
         ).run(start, stop, step, frames=frames, backend=backend,
-              batch_size=batch_size, **kwargs)
+              batch_size=batch_size, resilient=resilient, **kwargs)
         # raw dict access: keep a device-resident average on device
         self._ref_sel = avg.results["positions"]
-        return super().run(start, stop, step, frames=frames,
-                           backend=backend, batch_size=batch_size, **kwargs)
+        out = super().run(start, stop, step, frames=frames,
+                          backend=backend, batch_size=batch_size,
+                          resilient=resilient, **kwargs)
+        if resilient:
+            # pass 2 overwrote results.reliability with its own report;
+            # merge pass 1's back in (the average the components were
+            # fit against may have dropped frames or run degraded)
+            from mdanalysis_mpi_tpu.reliability.policy import (
+                merge_reliability_results,
+            )
+
+            self.results.reliability = merge_reliability_results(
+                avg.results.get("reliability"),
+                self.results.get("reliability"))
+        return out
 
     def _prepare(self):
         u = self._universe

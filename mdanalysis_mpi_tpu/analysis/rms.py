@@ -410,6 +410,12 @@ class AlignedRMSF(AnalysisBase):
         # share one HBM block cache: pass 2 reads device-resident blocks
         # instead of re-staging (the reference re-decodes every frame in
         # pass 2, RMSF.py:124 — this is the TPU-native fix).
+        #
+        # resilient= applies PER PASS: each pass is its own reduction
+        # with its own checkpoint fingerprint and degradation chain
+        # (docs/RELIABILITY.md), so it rides the child run() calls
+        # below, never the executor constructor.
+        resilient = kwargs.pop("resilient", False)
         if isinstance(backend, str) and backend != "serial":
             from mdanalysis_mpi_tpu.parallel.executors import (
                 DeviceBlockCache, get_executor)
@@ -428,7 +434,7 @@ class AlignedRMSF(AnalysisBase):
             self._universe, select=self._select, ref_frame=self._ref_frame,
             select_only=True, verbose=self._verbose, engine=self._engine,
         ).run(start, stop, step, frames=frames, backend=backend,
-              batch_size=batch_size, **kwargs)
+              batch_size=batch_size, resilient=resilient, **kwargs)
         # raw dict access: keep the average device-resident between
         # passes (attribute access would fetch it to host)
         self._avg_sel = avg.results["positions"]        # (S, 3)
@@ -438,7 +444,8 @@ class AlignedRMSF(AnalysisBase):
             self._universe, self._select, self._avg_sel, self._verbose,
             engine=self._engine)
         moments_pass.run(start, stop, step, frames=frames, backend=backend,
-                         batch_size=batch_size, **kwargs)
+                         batch_size=batch_size, resilient=resilient,
+                         **kwargs)
         t, mean, m2 = moments_pass._total
         self._last_total = moments_pass._total    # fetch-free sync point
         self.n_frames = moments_pass.n_frames
@@ -450,6 +457,16 @@ class AlignedRMSF(AnalysisBase):
         self.results.m2 = m2
         # RMSF.py:146: sqrt(M2.sum(axis=xyz)/T)
         self.results.rmsf = rmsf_from_moments(t, m2)
+        if resilient:
+            # the per-pass reports land on the (internal) child
+            # analyses; merge them to the surface the user reads
+            from mdanalysis_mpi_tpu.reliability.policy import (
+                merge_reliability_results,
+            )
+
+            self.results.reliability = merge_reliability_results(
+                avg.results.get("reliability"),
+                moments_pass.results.get("reliability"))
         return self
 
 

@@ -136,8 +136,12 @@ class WaterBridgeAnalysis(AnalysisBase):
                 "water selection overlaps selection1/selection2 "
                 f"(atom {int(np.flatnonzero(both)[0])}) — a terminal "
                 "cannot also be a bridge node")
-        # water graph nodes: one per residue
-        self._w_node = {int(i): int(t.resids[i]) for i in w}
+        # water graph nodes: one per residue — keyed by the UNIQUE
+        # 0-based resindices, not resids: per-atom resids are non-unique
+        # (PDB wraparound at 9999, per-segment restarts), and two
+        # distinct waters sharing a resid would collapse into one node,
+        # fabricating bridges between far-apart molecules (ADVICE r5)
+        self._w_node = {int(i): int(t.resindices[i]) for i in w}
         # donor/hydrogen/acceptor classification over the union,
         # reusing HydrogenBondAnalysis' guessing machinery
         from mdanalysis_mpi_tpu.analysis.hbonds import HydrogenBondAnalysis

@@ -7,6 +7,7 @@ import os
 import numpy as np
 
 from mdanalysis_mpi_tpu.core.timestep import Timestep
+from mdanalysis_mpi_tpu.reliability import faults as _faults
 
 
 class BlockCache:
@@ -22,6 +23,7 @@ class BlockCache:
     def __init__(self, max_bytes: int):
         self._store: dict = {}
         self._bytes = 0
+        self._rejected = False
         self.max_bytes = max_bytes
         self.hits = 0
         self.misses = 0
@@ -38,16 +40,26 @@ class BlockCache:
         if self._bytes + nbytes <= self.max_bytes:
             self._store[key] = value
             self._bytes += nbytes
+        else:
+            # the cache just refused a block: record it, so `full`
+            # flips even when _bytes never lands exactly on the cap
+            self._rejected = True
 
     @property
     def full(self) -> bool:
-        """True once inserts have reached the byte cap (further puts
-        are no-ops; consumers can route overflow elsewhere)."""
-        return self._bytes >= self.max_bytes
+        """True once the cache has stopped accepting blocks — the byte
+        cap was reached exactly, OR an insert was rejected for not
+        fitting (the common over-cap case: _bytes stays below
+        max_bytes forever, so the byte comparison alone never flips).
+        Consumers route overflow elsewhere, e.g. the executors hand
+        staging back to the host stage cache once the device cache
+        stops accepting (executors._run_batches stage selection)."""
+        return self._rejected or self._bytes >= self.max_bytes
 
     def clear(self) -> None:
         self._store.clear()
         self._bytes = 0
+        self._rejected = False
 
 
 #: Host staged-block cache (``ReaderBase.stage_cached``).
@@ -264,7 +276,14 @@ class ReaderBase:
             i += self.n_frames
         if not 0 <= i < self.n_frames:
             raise IndexError(f"frame {i} out of range [0, {self.n_frames})")
-        self._ts = self._emit_cursor(self._read_frame(i))
+        ts = self._read_frame(i)
+        if _faults.plans():
+            # "read" fault site (reliability/faults.py): the per-frame
+            # cursor read — the serial oracle path and the policy
+            # layer's corrupt-frame salvage re-read both land here
+            ts.positions = _faults.fire("read", frame=i,
+                                        array=ts.positions)
+        self._ts = self._emit_cursor(ts)
         return self._ts
 
     def __iter__(self):

@@ -32,6 +32,16 @@ import jax.numpy as jnp
 from mdanalysis_mpi_tpu.ops.distances import _HI, pair_histogram
 
 
+def _axis_size(axis_name: str) -> int:
+    """Static ring size across the supported jax range:
+    ``jax.lax.axis_size`` where it exists, else the long-standing
+    ``psum(1, axis)`` idiom (also static under shard_map tracing)."""
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
 def ring_union_histogram(x_blk: jax.Array,    # (n_l, 3) local atom block
                          w_a: jax.Array,      # (n_l,) group-A weights
                          w_b: jax.Array,      # (n_l,) group-B weights
@@ -44,7 +54,7 @@ def ring_union_histogram(x_blk: jax.Array,    # (n_l, 3) local atom block
     contiguous block of the (padded) union atom array and returns its
     partial (nbins,) histogram — callers ``psum`` across the ring.
     """
-    p = jax.lax.axis_size(axis_name)
+    p = _axis_size(axis_name)
     me = jax.lax.axis_index(axis_name)
     n_l = x_blk.shape[0]
     tile = min(tile, n_l)    # a tile wider than the rotating block is
@@ -89,7 +99,7 @@ def ring_rdf_batch(batch_blk: jax.Array,     # (B, n_l, 3) local blocks
     """
     from mdanalysis_mpi_tpu.ops._boxmat import box_to_matrix
 
-    p = jax.lax.axis_size(axis_name)
+    p = _axis_size(axis_name)
 
     def per_frame(args):
         x, box6 = args

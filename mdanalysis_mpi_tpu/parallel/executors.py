@@ -38,7 +38,35 @@ from __future__ import annotations
 import numpy as np
 
 from mdanalysis_mpi_tpu.parallel.partition import iter_batches, pad_batch
+from mdanalysis_mpi_tpu.reliability import faults as _faults
 from mdanalysis_mpi_tpu.utils.timers import TIMERS
+
+
+def _shard_map():
+    """``jax.shard_map`` across the supported jax range: top-level when
+    present, else the experimental module — with the checking flag
+    picked by SIGNATURE (``check_vma=`` post-rename, ``check_rep=``
+    before it; some releases have a public jax.shard_map that still
+    takes check_rep, so attribute presence alone is not enough).
+    Returned as a uniform ``fn(f, mesh, in_specs, out_specs)`` with
+    replication/vma checking off."""
+    import functools
+    import inspect
+
+    import jax
+
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm
+    try:
+        params = inspect.signature(sm).parameters
+    except (TypeError, ValueError):      # C-accelerated / wrapped
+        params = {"check_vma": None}
+    if "check_vma" in params:
+        return functools.partial(sm, check_vma=False)
+    if "check_rep" in params:
+        return functools.partial(sm, check_rep=False)
+    return sm
 
 
 def _f32_precision(fn):
@@ -476,7 +504,7 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
                  quantize: bool = False, local_divisor: int = 1,
                  local_index: int = 0, inv_per_frame: bool = False,
                  prestage: bool = False, fused_call=None,
-                 delta_anchors: int = 1):
+                 delta_anchors: int = 1, reliability=None):
     """Shared batch loop: stage → kernel → DEVICE-side accumulation.
 
     ``prestage=True`` switches the schedule from interleaved
@@ -520,6 +548,12 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
     total = None
     parts_list = []
     bounds = list(iter_batches(0, len(frames), bs))
+    # reliability runtime (reliability/policy.ReliabilityRuntime), duck-
+    # called so this module never imports the policy layer: rt.op wraps
+    # failure-prone ops in retry/backoff/deadline, rt.salvage_block
+    # implements corrupt-frame retry → skip-with-count → abort
+    rt = reliability
+    validate = rt is not None and rt.policy.validate_frames
 
     # Cache-key namespace: a shared DeviceBlockCache must never serve
     # blocks staged for a different selection (exact content hash), a
@@ -536,8 +570,11 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
 
     def _key(ab):
         a, b = ab
+        # `validate` namespaces resilient-mode entries: their blocks may
+        # have salvage-dropped rows (and exact per-block quantize scales)
+        # a non-resilient run sharing the cache must not be served
         return (reader_fp, tuple(frames[a:b]), bs, quantize, sel_fp,
-                xform_fp, delta_anchors)
+                xform_fp, delta_anchors, validate)
 
     def _host_stage(batch_frames):
         """Pure host side of one batch: read+gather (+quantize) + pad.
@@ -572,15 +609,27 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
         # reconstruction dependency doesn't fit the codec's one-shot
         # per-block quantize
         q_inline = None if quantize == "delta" else quantize
+        # corrupt-frame validation needs pre-quantize coordinates, so
+        # the resilient path stages float32 and quantizes after the
+        # check (the fused decode→gather fast path is kept; only its
+        # in-C quantize leg is deferred)
+        q_fused = None if validate else q_inline
         if contiguous and stage is not None:
             # fused native gather(+quantize); see stage selection above
             block, boxes, inv_scale = stage(
-                batch_frames[0], batch_frames[-1] + 1, sel_idx, q_inline)
+                batch_frames[0], batch_frames[-1] + 1, sel_idx, q_fused)
         else:
             block, boxes = _stage(reader, batch_frames, sel_idx)
             inv_scale = None
-            if q_inline:
-                block, inv_scale = quantize_block(block, q_inline)
+        if _faults.plans():
+            block = _faults.fire("stage", frames=batch_frames, array=block)
+        n_dropped = 0
+        if validate:
+            block, boxes, n_dropped = rt.salvage_block(
+                reader, sel_idx, batch_frames, block, boxes,
+                series=fold is None)
+        if q_inline and inv_scale is None:
+            block, inv_scale = quantize_block(block, q_inline)
         if boxes is None:
             boxes = np.zeros((block.shape[0], 6), dtype=np.float32)
         padded, mask = pad_batch(block, pad_to)
@@ -590,7 +639,7 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
             res, dkey, inv_abs, inv_res = quantize_block_delta(
                 padded, delta_anchors, n_valid=block.shape[0])
             return ((res, dkey, inv_abs, inv_res, boxes_p, mask),
-                    res.nbytes + dkey.nbytes)
+                    -1 if n_dropped else res.nbytes + dkey.nbytes)
         if quantize and inv_per_frame:
             # multi-host int16: every process quantizes its own slice
             # with its own adaptive scale, so the scale travels WITH the
@@ -601,13 +650,22 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
                                 dtype=np.float32)
         staged = ((padded, inv_scale, boxes_p, mask) if quantize
                   else (padded, boxes_p, mask))
-        return staged, padded.nbytes
+        # nbytes=-1 marks a salvage-shortened block UNCACHEABLE: a
+        # cache hit in a later run would skip salvage and leave that
+        # run's reliability report blind to the dropped frames
+        return staged, -1 if n_dropped else padded.nbytes
 
     def _place(staged, key, nbytes):
         """Device side: transfer a host-staged tuple and cache it."""
-        if device_put_fn is not None:
-            staged = device_put_fn(staged)
-        if cache is not None:
+
+        def _put():
+            if _faults.plans():
+                _faults.fire("put")
+            return (device_put_fn(staged) if device_put_fn is not None
+                    else staged)
+
+        staged = _put() if rt is None else rt.op("put", _put)
+        if cache is not None and nbytes >= 0:
             # charge this process's resident share of the cached entry:
             # the host block nbytes IS the per-host charge (on
             # multi-host the staged slice is already 1/local_divisor of
@@ -628,21 +686,34 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
         if staged is not None:
             return staged
         with TIMERS.phase("stage"):
-            staged, nbytes = _host_stage(frames[a:b])
+            staged, nbytes = _stage_op(frames[a:b])
         return _place(staged, key, nbytes)
+
+    def _stage_op(batch_frames):
+        """_host_stage under the reliability retry/deadline envelope."""
+        if rt is None:
+            return _host_stage(batch_frames)
+        return rt.op("stage", lambda: _host_stage(batch_frames))
 
     def consume(staged):
         nonlocal total
         with TIMERS.phase("dispatch"):
+
+            def _dispatch():
+                if _faults.plans():
+                    _faults.fire("kernel")
+                if fold_j is None or total is None:
+                    return call(*staged)
+                if fused_call is not None:
+                    # merge folded into the kernel dispatch (_fused_step)
+                    return fused_call(total, *staged)
+                return fold_j(total, call(*staged))
+
+            out = _dispatch() if rt is None else rt.op("kernel", _dispatch)
             if fold_j is None:
-                parts_list.append(call(*staged))
-            elif total is None:
-                total = call(*staged)
-            elif fused_call is not None:
-                # merge folded into the kernel dispatch (see _fused_step)
-                total = fused_call(total, *staged)
+                parts_list.append(out)
             else:
-                total = fold_j(total, call(*staged))
+                total = out
 
     if prestage:
         # CHUNKED decode-then-wire (two measured constraints):
@@ -674,7 +745,7 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
                     continue
                 a, b = ab
                 with TIMERS.phase("stage"):
-                    staged_host, nbytes = _host_stage(frames[a:b])
+                    staged_host, nbytes = _stage_op(frames[a:b])
                 items.append((staged_host, None, key, nbytes))
             placed: dict[int, tuple] = {}
             nxt = 0
@@ -730,10 +801,37 @@ class SerialExecutor:
     RMSF.py:91-103/123-138, minus MPI)."""
 
     name = "serial"
+    # execute() accumulates inside the analysis and returns the full
+    # summary — NOT per-call partials (utils/checkpoint.py refuses it)
+    per_call_partials = False
+    reliability = None
+
+    def __init__(self, reliability=None):
+        if reliability is not None:
+            self.reliability = reliability
 
     def execute(self, analysis, reader, frames, batch_size=None):
-        for i in frames:
-            analysis._single_frame(reader[i])
+        rt = self.reliability
+        if rt is None:
+            for i in frames:
+                analysis._single_frame(reader[i])
+        else:
+            processed = []
+            for i in frames:
+                # validated read: retry transient/corrupt reads, then
+                # skip-with-count or abort per policy (None = skipped)
+                ts = rt.read_frame(reader, i)
+                if ts is not None:
+                    analysis._single_frame(ts)
+                    processed.append(i)
+            if len(processed) != len(frames):
+                # shrink the resolved frame list to what actually ran:
+                # _conclude builds per-frame columns (results.frames,
+                # time axes) from _frame_indices, and a dropped frame
+                # must not leave a full-length column misaligned
+                # against the shortened per-frame outputs
+                analysis._frame_indices = processed
+                analysis.n_frames = len(processed)
         return analysis._serial_summary()
 
 
@@ -744,11 +842,14 @@ class JaxExecutor:
     precision-policy docstring)."""
 
     name = "jax"
+    # execute() returns one partials pytree per call — checkpointable
+    per_call_partials = True
+    reliability = None
 
     def __init__(self, batch_size: int = 128, device=None,
                  block_cache: DeviceBlockCache | None = None,
                  transfer_dtype: str = "float32",
-                 prestage: bool = False):
+                 prestage: bool = False, reliability=None):
         _validate_transfer_dtype(transfer_dtype)
         self.batch_size = batch_size
         self.device = device
@@ -757,6 +858,8 @@ class JaxExecutor:
         # decode-then-wire cold schedule (see _run_batches); holds the
         # staged trajectory in host RAM for the length of the run
         self.prestage = prestage
+        if reliability is not None:
+            self.reliability = reliability
 
     def execute(self, analysis, reader, frames, batch_size=None):
         import jax
@@ -793,7 +896,7 @@ class JaxExecutor:
             analysis, reader, frames, bs,
             lambda *staged: kernel(params, *staged), sel_idx,
             device_put_fn=put, cache=self.block_cache, quantize=quantize,
-            prestage=self.prestage,
+            prestage=self.prestage, reliability=self.reliability,
             fused_call=(None if step is None else
                         lambda total, *staged: step(total, params,
                                                     *staged)))
@@ -810,12 +913,15 @@ class MeshExecutor:
     """
 
     name = "mesh"
+    # execute() returns one partials pytree per call — checkpointable
+    per_call_partials = True
+    reliability = None
 
     def __init__(self, batch_size: int = 64, devices=None,
                  axis_name: str = "data",
                  block_cache: DeviceBlockCache | None = None,
                  transfer_dtype: str = "float32",
-                 prestage: bool = False):
+                 prestage: bool = False, reliability=None):
         _validate_transfer_dtype(transfer_dtype)
         self.batch_size = batch_size
         self.devices = devices
@@ -824,6 +930,8 @@ class MeshExecutor:
         self.transfer_dtype = transfer_dtype
         # decode-then-wire cold schedule (see _run_batches)
         self.prestage = prestage
+        if reliability is not None:
+            self.reliability = reliability
 
     def _build(self, analysis, qn_fn=None):
         """``qn_fn``: the quantized-native kernel resolved ONCE by
@@ -832,8 +940,9 @@ class MeshExecutor:
         jitted ``build_params`` dispatch and to keep the kernel/params
         decision in one place."""
         import jax
-        from jax import shard_map
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        shard_map = _shard_map()
 
         devices = self.devices if self.devices is not None else jax.devices()
         quantize = _quant_mode(self.transfer_dtype) is not None
@@ -919,14 +1028,15 @@ class MeshExecutor:
                 in_specs = (P(), P(axis), P(axis), P(axis))
                 put_specs = (P(axis), P(axis), P(axis))
             frames_per_batch_factor = len(devices)
-        # check_vma=False: jnp.linalg.svd lowers to an iterative scan on
-        # TPU whose bool carry trips the varying-manual-axes check inside
-        # shard_map (works on CPU, fails on TPU); the kernel is purely
-        # per-shard + explicit psum, so the check adds nothing here.
+        # replication/vma checking stays off (bound inside _shard_map):
+        # jnp.linalg.svd lowers to an iterative scan on TPU whose bool
+        # carry trips the varying-manual-axes check inside shard_map
+        # (works on CPU, fails on TPU); the kernel is purely per-shard
+        # + explicit psum, so the check adds nothing here.
         gfn = jax.jit(shard_map(
             shard_fn, mesh=mesh,
             in_specs=in_specs,
-            out_specs=out_specs, check_vma=False))
+            out_specs=out_specs))
         # fused cross-batch fold (same dispatch-halving as the
         # single-device path, _fused_step): the replicated running total
         # rides into the shard_map as a P() input and the fold applies
@@ -941,7 +1051,7 @@ class MeshExecutor:
             gfn_fused = jax.jit(shard_map(
                 shard_fn_fused, mesh=mesh,
                 in_specs=(P(),) + in_specs,
-                out_specs=P(), check_vma=False))
+                out_specs=P()))
         shardings = tuple(NamedSharding(mesh, s) for s in put_specs)
         result = (frames_per_batch_factor, gfn, shardings,
                   custom[0] if custom is not None else None, gfn_fused)
@@ -1004,7 +1114,7 @@ class MeshExecutor:
                 quantize=_quant_mode(self.transfer_dtype),
                 local_divisor=n_proc, local_index=jax.process_index(),
                 inv_per_frame=True, prestage=self.prestage,
-                fused_call=fused_call,
+                fused_call=fused_call, reliability=self.reliability,
                 # delta at N controllers: each process quantizes its
                 # OWN slice with one anchor per LOCAL device; the
                 # (A, 1, 1) inv_abs shards with the keyframes, so no
@@ -1026,6 +1136,7 @@ class MeshExecutor:
             device_put_fn=put, cache=self.block_cache,
             quantize=_quant_mode(self.transfer_dtype),
             prestage=self.prestage, fused_call=fused_call,
+            reliability=self.reliability,
             # delta: one absolute anchor per device shard (see _build)
             delta_anchors=(bs_factor if self.transfer_dtype == "delta"
                            else 1))
@@ -1115,7 +1226,7 @@ class MeshExecutor:
             analysis, reader, frames, bs,
             lambda *staged: gfn(params, *staged), local_sel,
             device_put_fn=put, cache=self.block_cache, quantize=False,
-            prestage=self.prestage)
+            prestage=self.prestage, reliability=self.reliability)
 
 
 from mdanalysis_mpi_tpu.parallel.mpi import MPIExecutor  # noqa: E402

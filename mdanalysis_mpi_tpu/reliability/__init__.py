@@ -1,0 +1,42 @@
+"""Fault injection, retry/deadline policy, and graceful degradation.
+
+Three cooperating pieces (docs/RELIABILITY.md is the user guide):
+
+- :mod:`~mdanalysis_mpi_tpu.reliability.faults` — deterministic fault
+  injection at named sites (``read`` / ``stage`` / ``put`` /
+  ``kernel``) so every recovery path is testable on CPU.
+- :mod:`~mdanalysis_mpi_tpu.reliability.policy` — retry with
+  exponential backoff, soft per-op deadlines, corrupt-frame
+  retry→skip→abort semantics, the Mesh→Jax→Serial
+  :class:`~mdanalysis_mpi_tpu.reliability.policy.FallbackChain`, and
+  :func:`~mdanalysis_mpi_tpu.reliability.policy.run_resilient` (the
+  engine behind ``AnalysisBase.run(resilient=...)``).
+
+This ``__init__`` stays lazy for the policy layer: ``io.base`` and the
+executors import :mod:`.faults` (dependency-free) from their module
+scope, while :mod:`.policy` imports the executors — eager package
+imports here would complete that cycle.
+"""
+
+from mdanalysis_mpi_tpu.reliability import faults  # noqa: F401
+
+_LAZY = ("ReliabilityPolicy", "ReliabilityReport", "ReliabilityRuntime",
+         "FallbackChain", "run_resilient", "is_degradable",
+         "merge_reliability_results", "DeadlineExceeded",
+         "CorruptFrameError")
+
+
+def __getattr__(name):
+    if name in _LAZY or name == "policy":
+        # import_module, NOT `from ... import policy`: the from-form
+        # consults this package's attributes first, which re-enters
+        # this __getattr__ and recurses forever
+        import importlib
+
+        policy = importlib.import_module(
+            "mdanalysis_mpi_tpu.reliability.policy")
+        return policy if name == "policy" else getattr(policy, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+__all__ = ["faults", "policy", *_LAZY]

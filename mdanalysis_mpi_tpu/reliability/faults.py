@@ -1,0 +1,225 @@
+"""Deterministic fault injection at named sites.
+
+The recovery machinery in :mod:`mdanalysis_mpi_tpu.reliability.policy`
+(retry/backoff, corrupt-frame salvage, executor degradation, resume)
+only earns trust if every path is exercisable on CPU without real
+hardware faults.  This module is the lever: production code calls
+:func:`fire` at a handful of named sites, and tests arm
+:class:`FaultSpec` s that make those sites raise, stall, or corrupt the
+data flowing through them — deterministically (visit counters, no
+randomness), so a failing recovery test replays bit-for-bit.
+
+Sites (the complete set — grep for ``_faults.fire``):
+
+``"read"``
+    Per-frame cursor read (``ReaderBase.__getitem__``), the serial
+    oracle path and the policy layer's per-frame salvage re-read.
+    Payload: the frame's ``(n_atoms, 3)`` positions.
+``"stage"``
+    Host-side block staging in the batch executors
+    (``executors._run_batches._host_stage``), after decode+gather and
+    before quantization.  Payload: the float32 ``(B, S, 3)`` block;
+    ``frames`` carries the batch's frame indices so a spec can corrupt
+    one frame's row.
+``"put"``
+    Host→device transfer (``executors._run_batches._place``).  No
+    payload — raise/stall only.
+``"kernel"``
+    Batch-kernel dispatch (``executors._run_batches.consume``).  No
+    payload — raise (device-loss-shaped) / stall.
+
+When no specs are armed, the per-call overhead at a site is one module
+attribute load and a truthiness check (``if _faults.plans(): ...``).
+
+Exception taxonomy (what the policy layer keys off):
+
+- :class:`InjectedTransientError` — retryable AND degradable: the
+  shape of flaky I/O or a wedged staging client.
+- :class:`DeviceLossError` — retryable and degradable: the shape of
+  XLA device loss (the message carries ``DEVICE_LOST``, matching how
+  real ``XlaRuntimeError`` s print).
+- :class:`InjectedCrash` — neither: simulates a process-killing bug so
+  checkpoint/resume can be tested (nothing may swallow it).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+
+class InjectedTransientError(RuntimeError):
+    """Injected failure that retry is expected to heal (flaky I/O)."""
+
+
+class DeviceLossError(RuntimeError):
+    """Device-loss-shaped failure (``DEVICE_LOST``): retry may heal a
+    transient one; a persistent one triggers executor degradation."""
+
+
+class InjectedCrash(RuntimeError):
+    """Injected hard crash: NOT retryable, NOT degradable — stands in
+    for the process dying mid-run (checkpoint/resume tests)."""
+
+
+_DEFAULT_EXC = {
+    "read": InjectedTransientError,
+    "stage": InjectedTransientError,
+    "put": InjectedTransientError,
+    "kernel": DeviceLossError,
+}
+
+
+class FaultSpec:
+    """One armed fault: where it fires, what it does, and when.
+
+    ``site``     one of the documented site names.
+    ``kind``     ``"raise"`` | ``"stall"`` | ``"corrupt"``.
+    ``frames``   optional container of frame indices: the spec only
+                 matches calls touching one of these frames (and
+                 corruption applies only to their rows).
+    ``after``    skip this many matching visits before firing
+                 (deterministic placement: "crash on the 4th batch").
+    ``times``    fire at most this many times (None = every match).
+    ``exc``      exception class for ``kind="raise"`` (default per
+                 site: transient for read/stage/put, device-loss for
+                 kernel).
+    ``stall_s``  sleep duration for ``kind="stall"``.
+    ``corrupt``  ``"nan"`` (row → NaN), ``"garbage"`` (row → 1e9 —
+                 trips the max-coordinate sanity check), or
+                 ``"truncate"`` (drop the payload's last row — a short
+                 frame; per-frame payloads only).
+    """
+
+    def __init__(self, site: str, kind: str = "raise", *, frames=None,
+                 after: int = 0, times: int | None = 1, exc=None,
+                 stall_s: float = 0.05, corrupt: str = "nan"):
+        if kind not in ("raise", "stall", "corrupt"):
+            raise ValueError(f"unknown fault kind {kind!r}")
+        if corrupt not in ("nan", "garbage", "truncate"):
+            raise ValueError(f"unknown corruption {corrupt!r}")
+        self.site = site
+        self.kind = kind
+        self.frames = None if frames is None else set(int(f) for f in frames)
+        self.after = int(after)
+        self.times = times
+        self.exc = exc or _DEFAULT_EXC.get(site, InjectedTransientError)
+        self.stall_s = float(stall_s)
+        self.corrupt = corrupt
+        self.visits = 0
+        self.fired = 0
+
+    def _matches(self, frame, frames) -> bool:
+        if self.frames is None:
+            return True
+        if frame is not None:
+            return int(frame) in self.frames
+        if frames is not None:
+            return any(int(f) in self.frames for f in frames)
+        return False
+
+    def _corrupt_rows(self, frames) -> list[int] | None:
+        """Row indices (within the block payload) to corrupt, or None
+        for the whole payload."""
+        if self.frames is None or frames is None:
+            return None
+        return [j for j, f in enumerate(frames) if int(f) in self.frames]
+
+
+# Armed specs.  A plain list guarded by a lock for arm/disarm; fire()
+# reads it lock-free (the GIL makes list iteration safe, and tests
+# arm/disarm outside the measured region).
+_PLANS: list[FaultSpec] = []
+_LOCK = threading.Lock()
+
+
+def plans() -> bool:
+    """Truthy when any fault is armed — the hot-path guard."""
+    return bool(_PLANS)
+
+
+def arm(*specs: FaultSpec) -> None:
+    with _LOCK:
+        _PLANS.extend(specs)
+
+
+def disarm(*specs: FaultSpec) -> None:
+    with _LOCK:
+        for s in specs:
+            if s in _PLANS:
+                _PLANS.remove(s)
+
+
+def clear() -> None:
+    with _LOCK:
+        _PLANS.clear()
+
+
+class inject:
+    """Context manager arming ``specs`` for the enclosed block::
+
+        with faults.inject(FaultSpec("kernel", "raise", times=None)):
+            analysis.run(resilient=True, backend="mesh")
+    """
+
+    def __init__(self, *specs: FaultSpec):
+        self.specs = specs
+
+    def __enter__(self):
+        arm(*self.specs)
+        return self.specs
+
+    def __exit__(self, *exc):
+        disarm(*self.specs)
+        return False
+
+
+def _apply_corrupt(spec: FaultSpec, array, frames):
+    if array is None:
+        return None
+    if spec.corrupt == "truncate":
+        # short (truncated) frame: only meaningful for per-frame
+        # payloads; block payloads lose their last frame row
+        return array[:-1]
+    if not np.issubdtype(np.asarray(array).dtype, np.floating):
+        # quantized payloads cannot carry NaN; leave them alone (the
+        # float32 validation path is where corruption detection lives)
+        return array
+    value = np.nan if spec.corrupt == "nan" else np.float32(1e9)
+    rows = spec._corrupt_rows(frames)
+    out = np.array(array, copy=True)
+    if rows is None:
+        out[...] = value
+    else:
+        for j in rows:
+            out[j] = value
+    return out
+
+
+def fire(site: str, frame=None, frames=None, array=None):
+    """Run every armed spec matching ``site`` (and frame filter).
+
+    Returns the (possibly corrupted/replaced) ``array`` payload; may
+    raise or sleep instead, per the matching spec's ``kind``.  Visit
+    and fire counters advance deterministically per spec.
+    """
+    for spec in list(_PLANS):
+        if spec.site != site or not spec._matches(frame, frames):
+            continue
+        spec.visits += 1
+        if spec.visits <= spec.after:
+            continue
+        if spec.times is not None and spec.fired >= spec.times:
+            continue
+        spec.fired += 1
+        if spec.kind == "raise":
+            raise spec.exc(
+                f"injected fault at site {site!r} "
+                f"(visit {spec.visits}, fire {spec.fired})")
+        if spec.kind == "stall":
+            time.sleep(spec.stall_s)
+        else:
+            array = _apply_corrupt(spec, array, frames)
+    return array

@@ -26,12 +26,11 @@ from __future__ import annotations
 
 import hashlib
 import os
+import tempfile
 
 import numpy as np
 
-from mdanalysis_mpi_tpu.parallel.executors import (
-    JaxExecutor, MeshExecutor, get_executor,
-)
+from mdanalysis_mpi_tpu.parallel.executors import get_executor
 from mdanalysis_mpi_tpu.parallel.partition import iter_batches
 
 
@@ -58,13 +57,18 @@ def _fingerprint(analysis, frames) -> str:
     return h.hexdigest()
 
 
-def _save(path: str, frames_done: int, partials, fingerprint: str) -> None:
+def _save(path: str, frames_done: int, partials, fingerprint: str,
+          dropped=()) -> None:
     import jax
 
     leaves = [np.asarray(x) for x in jax.tree.leaves(partials)]
     tmp = path + ".tmp.npz"     # np.savez appends .npz to bare names
     np.savez(tmp, frames_done=np.int64(frames_done),
              fingerprint=np.str_(fingerprint),
+             # frames the resilient policy dropped from the durable
+             # chunks: a resumed process never re-stages those chunks,
+             # so its reliability report must inherit the record
+             dropped=np.asarray(sorted(dropped), dtype=np.int64),
              **{f"leaf_{i}": v for i, v in enumerate(leaves)})
     os.replace(tmp, path)       # atomic: a crash never half-writes
 
@@ -80,20 +84,37 @@ def _load(path: str, structure, fingerprint: str):
                 "analysis/trajectory/frame window/selection — refusing "
                 "to resume (delete it to start over)")
         frames_done = int(z["frames_done"])
-        leaves = [z[f"leaf_{i}"]
-                  for i in range(len(z.files) - 2)]   # - frames_done, fp
+        n_leaves = sum(1 for name in z.files if name.startswith("leaf_"))
+        leaves = [z[f"leaf_{i}"] for i in range(n_leaves)]
+        dropped = (z["dropped"] if "dropped" in z.files
+                   else np.empty(0, dtype=np.int64))
     treedef = jax.tree.structure(structure)
     if treedef.num_leaves != len(leaves):
         raise ValueError(
             f"checkpoint {path!r} has {len(leaves)} leaves but the "
             f"analysis' partials have {treedef.num_leaves} — wrong "
             "checkpoint for this analysis/selection?")
-    return frames_done, jax.tree.unflatten(treedef, leaves)
+    return frames_done, jax.tree.unflatten(treedef, leaves), dropped
 
 
-def run_checkpointed(analysis, path: str, chunk_frames: int = 4096,
+def checkpoint_path(analysis, frames, checkpoint_dir: str | None = None
+                    ) -> str:
+    """The derived default checkpoint file for this exact run: stable
+    across processes (sha256 fingerprint, not salted ``hash()``), so a
+    resumed process lands on the same file without the caller threading
+    a path through.  Directory: ``checkpoint_dir`` argument, else
+    ``$MDTPU_CHECKPOINT_DIR``, else the system temp dir."""
+    fp = _fingerprint(analysis, frames)
+    d = (checkpoint_dir or os.environ.get("MDTPU_CHECKPOINT_DIR")
+         or tempfile.gettempdir())
+    return os.path.join(d, f"mdtpu-ckpt-{fp[:24]}.npz")
+
+
+def run_checkpointed(analysis, path: str | None = None,
+                     chunk_frames: int = 4096,
                      start=None, stop=None, step=None, frames=None,
                      backend: str = "jax", batch_size: int | None = None,
+                     checkpoint_dir: str | None = None,
                      **executor_kwargs):
     """``analysis.run(...)`` with durable progress in ``path``.
 
@@ -102,8 +123,10 @@ def run_checkpointed(analysis, path: str, chunk_frames: int = 4096,
     checkpoint.  If ``path`` exists, already-covered frames are skipped
     and the saved partials seed the total — re-running the same call
     after a crash (or the driver killing the process) continues where
-    it stopped.  Deletes the checkpoint on successful completion and
-    returns the analysis (``.results`` populated as usual).
+    it stopped.  ``path=None`` derives a stable per-run default (see
+    :func:`checkpoint_path`) — what ``run(resilient=True)`` uses.
+    Deletes the checkpoint on successful completion and returns the
+    analysis (``.results`` populated as usual).
     """
     fold = analysis._device_fold_fn
     if fold is None:
@@ -112,11 +135,12 @@ def run_checkpointed(analysis, path: str, chunk_frames: int = 4096,
             "(_device_fold_fn is None); checkpointing applies to "
             "reduction analyses only")
     executor = get_executor(backend, **executor_kwargs)
-    if not isinstance(executor, (JaxExecutor, MeshExecutor)):
-        # whitelist, not blacklist: only the batch executors return
-        # per-call partials.  Serial AND MPI executors accumulate inside
-        # the analysis (each chunk's "partials" would contain all prior
-        # chunks, double-counting on fold).
+    if not getattr(executor, "per_call_partials", False):
+        # whitelist, not blacklist: only the batch executors (and
+        # batch-only fallback chains) declare per_call_partials.
+        # Serial AND MPI executors accumulate inside the analysis
+        # (each chunk's "partials" would contain all prior chunks,
+        # double-counting on fold).
         raise ValueError(
             "checkpointing needs an executor whose execute() returns "
             "per-call partials — backend='jax' or 'mesh' (serial/mpi "
@@ -129,21 +153,41 @@ def run_checkpointed(analysis, path: str, chunk_frames: int = 4096,
     analysis._frame_indices = frames
     analysis._prepare()
     fp = _fingerprint(analysis, frames)
+    if path is None:
+        path = checkpoint_path(analysis, frames,
+                               checkpoint_dir=checkpoint_dir)
+
+    # the resilient runtime (if any) behind this executor: its report
+    # inherits dropped-frame records from resumed checkpoints and
+    # contributes new ones to each saved chunk
+    rt = (getattr(executor, "_runtime", None)
+          or getattr(executor, "reliability", None))
 
     total = None
     done = 0
     if os.path.exists(path):
-        done, total = _load(path, analysis._identity_partials(), fp)
+        done, total, prev_dropped = _load(
+            path, analysis._identity_partials(), fp)
         if done > len(frames):
             raise ValueError(
                 f"checkpoint {path!r} covers {done} frames but this run "
                 f"has {len(frames)} — frame window mismatch")
+        if rt is not None:
+            # inherit (dedup'd) — these frames were dropped by the
+            # crashed process; this one never re-stages their chunks
+            for f in prev_dropped.tolist():
+                if int(f) not in rt.report.dropped_frames:
+                    rt.report.dropped_frames.append(int(f))
 
     for a, b in iter_batches(done, len(frames), chunk_frames):
         partials = executor.execute(analysis, analysis._universe.trajectory,
                                     frames[a:b], batch_size=batch_size)
         total = partials if total is None else fold(total, partials)
-        _save(path, b, total, fp)
+        if rt is None:
+            # 4-arg form kept for external wrappers around _save
+            _save(path, b, total, fp)
+        else:
+            _save(path, b, total, fp, rt.report.dropped_frames)
 
     if total is None:
         total = analysis._identity_partials()

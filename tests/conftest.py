@@ -35,3 +35,8 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers",
         "slow: subprocess/end-to-end tests on the order of a minute")
+    config.addinivalue_line(
+        "markers",
+        "reliability: fast, CPU-only, deterministic fault-injection "
+        "tests (reliability/ subsystem); in tier-1 by construction "
+        "(not slow) and selectable alone with `pytest -m reliability`")

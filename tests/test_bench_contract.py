@@ -50,13 +50,20 @@ def test_bench_json_contract(tmp_path):
         # #4), plus the r4 weather/retry telemetry
         for key in ("metric", "value", "unit", "vs_baseline",
                     "cold_value", "cold_vs_baseline",
-                    "f32_nocache_value", "f32_nocache_vs_baseline",
+                    # r5 ADVICE: the relocated f32 leg reports under
+                    # _highrss keys + explicit leg ordering, so
+                    # cross-round readers can tell its process
+                    # conditions changed
+                    "f32_nocache_highrss_value",
+                    "f32_nocache_highrss_vs_baseline",
+                    "accel_leg_order",
                     "serial_fps", "baseline_fps",
                     "serial_file_fps", "file_baseline_fps",
                     "cold_vs_file_baseline", "divergence",
                     "put_gbps", "decode_fps", "init_wait_s",
                     "init_probes", "init_log"):
             assert key in rec, f"missing {key} in {sorted(rec)}"
+        assert rec["accel_leg_order"][0] == "cold"
         assert rec["unit"] == "frames/s/chip"
         assert "file-backed XTC" in rec["metric"]
         assert "steady-state" in rec["metric"]

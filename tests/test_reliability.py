@@ -1,0 +1,600 @@
+"""Reliability subsystem: fault injection, retry/backoff/deadline
+policy, corrupt-frame salvage, Mesh→Jax→Serial degradation, and
+crash-then-resume checkpointing (docs/RELIABILITY.md).
+
+Everything here is CPU-only, deterministic (visit-counter fault
+placement, fixed seeds), and fast — the suite runs in tier-1 on every
+PR and is selectable alone with ``pytest -m reliability``.
+"""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from mdanalysis_mpi_tpu.analysis import RMSD, RMSF, AlignedRMSF
+from mdanalysis_mpi_tpu.io.base import BlockCache
+from mdanalysis_mpi_tpu.reliability import faults
+from mdanalysis_mpi_tpu.reliability.faults import (
+    DeviceLossError, FaultSpec, InjectedCrash, InjectedTransientError,
+)
+from mdanalysis_mpi_tpu.reliability.policy import (
+    CorruptFrameError, FallbackChain, ReliabilityPolicy,
+    ReliabilityRuntime, is_degradable,
+)
+from mdanalysis_mpi_tpu.testing import make_protein_universe
+
+pytestmark = pytest.mark.reliability
+
+N_FRAMES = 24
+
+
+@pytest.fixture(scope="module")
+def uni():
+    return make_protein_universe(n_residues=8, n_frames=N_FRAMES,
+                                 noise=0.25, seed=3)
+
+
+@pytest.fixture(scope="module")
+def oracle_rmsf(uni):
+    return RMSF(uni.select_atoms("name CA")).run(
+        backend="serial").results.rmsf
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_faults():
+    yield
+    faults.clear()
+
+
+def fast_policy(**kw):
+    kw.setdefault("backoff_s", 0.001)
+    kw.setdefault("checkpoint", False)
+    return ReliabilityPolicy(**kw)
+
+
+# ---------------- fault registry semantics ----------------
+
+class TestFaultInjection:
+    def test_after_and_times_are_deterministic(self):
+        spec = FaultSpec("kernel", "raise", after=2, times=1)
+        with faults.inject(spec):
+            faults.fire("kernel")            # visit 1: skipped (after)
+            faults.fire("kernel")            # visit 2: skipped (after)
+            with pytest.raises(DeviceLossError):
+                faults.fire("kernel")        # visit 3: fires
+            faults.fire("kernel")            # fired out (times=1)
+        assert (spec.visits, spec.fired) == (4, 1)
+
+    def test_inject_disarms_on_exit(self):
+        with faults.inject(FaultSpec("kernel", "raise", times=None)):
+            assert faults.plans()
+        assert not faults.plans()
+        faults.fire("kernel")                # disarmed: no raise
+
+    def test_frame_filter_and_row_corruption(self):
+        spec = FaultSpec("stage", "corrupt", frames=[5], times=None)
+        block = np.zeros((4, 3, 3), dtype=np.float32)
+        with faults.inject(spec):
+            out = faults.fire("stage", frames=[4, 5, 6, 7], array=block)
+            missed = faults.fire("stage", frames=[0, 1], array=block)
+        assert np.isnan(out[1]).all() and np.isfinite(out[0]).all()
+        assert np.isfinite(block).all()      # payload corrupted on a copy
+        assert missed is block               # non-matching call: untouched
+
+    def test_unfaulted_sites_cost_nothing(self, uni, oracle_rmsf):
+        # a non-resilient run with no armed faults is byte-identical
+        # behavior (the hot-path guard is a truthiness check)
+        r = RMSF(uni.select_atoms("name CA")).run(
+            backend="jax", batch_size=8).results.rmsf
+        np.testing.assert_allclose(r, oracle_rmsf, atol=1e-3)
+
+    def test_injection_without_policy_is_fatal(self, uni):
+        # faults are real: a NON-resilient run has no recovery layer
+        with faults.inject(FaultSpec("kernel", "raise", times=None)):
+            with pytest.raises(DeviceLossError):
+                RMSF(uni.select_atoms("name CA")).run(
+                    backend="jax", batch_size=8)
+
+
+# ---------------- BlockCache over-cap fallback (ADVICE r5 medium) ----
+
+class TestBlockCacheFull:
+    def test_rejected_insert_flips_full(self):
+        cache = BlockCache(max_bytes=100)
+        cache.put("a", 1, 60)
+        assert not cache.full                # below cap, nothing refused
+        cache.put("b", 2, 60)                # over cap: refused
+        assert cache.get("b") is None
+        assert cache.full                    # rejection recorded
+        cache.clear()
+        assert not cache.full                # reset with the bytes
+
+    def test_exact_fit_still_reports_full(self):
+        cache = BlockCache(max_bytes=100)
+        cache.put("a", 1, 100)
+        assert cache.full
+
+    def test_over_cap_trajectory_still_correct(self, uni, oracle_rmsf):
+        # a device cache far smaller than the staged trajectory must
+        # flip full (re-enabling the host stage-cache fallback) and
+        # never corrupt results
+        from mdanalysis_mpi_tpu.parallel.executors import DeviceBlockCache
+
+        cache = DeviceBlockCache(max_bytes=1)     # everything over-cap
+        r = AlignedRMSF(uni, select="name CA").run(
+            backend="jax", batch_size=8, block_cache=cache)
+        assert cache.full
+        ref = AlignedRMSF(uni, select="name CA").run(
+            backend="serial").results.rmsf
+        np.testing.assert_allclose(r.results.rmsf, ref,
+                                   rtol=5e-3, atol=1e-3)
+
+
+# ---------------- corrupt-frame policy ----------------
+
+class TestCorruptFrames:
+    def _persistent_corruption(self, frame):
+        # both the staged block AND the salvage re-read stay corrupt
+        return (FaultSpec("stage", "corrupt", frames=[frame], times=None),
+                FaultSpec("read", "corrupt", frames=[frame], times=None))
+
+    def test_skip_with_count_batch(self, uni):
+        with faults.inject(*self._persistent_corruption(5)):
+            r = RMSF(uni.select_atoms("name CA")).run(
+                resilient=fast_policy(), backend="jax", batch_size=8)
+        assert list(r.results.reliability["dropped_frames"]) == [5]
+        ref = RMSF(uni.select_atoms("name CA")).run(
+            frames=[i for i in range(N_FRAMES) if i != 5],
+            backend="serial").results.rmsf
+        np.testing.assert_allclose(r.results.rmsf, ref, atol=1e-3)
+
+    def test_transient_corruption_heals_by_reread(self, uni, oracle_rmsf):
+        with faults.inject(FaultSpec("stage", "corrupt", frames=[3],
+                                     times=1)):
+            r = RMSF(uni.select_atoms("name CA")).run(
+                resilient=fast_policy(), backend="jax", batch_size=8)
+        rel = r.results.reliability
+        assert list(rel["healed_frames"]) == [3]
+        assert len(rel["dropped_frames"]) == 0
+        np.testing.assert_allclose(r.results.rmsf, oracle_rmsf, atol=1e-3)
+
+    def test_abort_policy(self, uni):
+        with faults.inject(*self._persistent_corruption(5)):
+            with pytest.raises(CorruptFrameError):
+                RMSF(uni.select_atoms("name CA")).run(
+                    resilient=fast_policy(on_corrupt="abort"),
+                    backend="jax", batch_size=8)
+
+    def test_drop_budget_aborts(self, uni):
+        specs = (self._persistent_corruption(2)
+                 + self._persistent_corruption(3))
+        with faults.inject(*specs):
+            with pytest.raises(CorruptFrameError):
+                RMSF(uni.select_atoms("name CA")).run(
+                    resilient=fast_policy(max_dropped_frames=1),
+                    backend="jax", batch_size=8)
+
+    def test_garbage_coordinates_detected(self, uni):
+        # 1e9 Å coordinates are finite but absurd — the max_abs_coord
+        # sanity check must flag them like NaNs
+        specs = (FaultSpec("stage", "corrupt", frames=[4], times=None,
+                           corrupt="garbage"),
+                 FaultSpec("read", "corrupt", frames=[4], times=None,
+                           corrupt="garbage"))
+        with faults.inject(*specs):
+            r = RMSF(uni.select_atoms("name CA")).run(
+                resilient=fast_policy(), backend="jax", batch_size=8)
+        assert list(r.results.reliability["dropped_frames"]) == [4]
+
+    def test_batched_series_refuses_silent_skip(self, uni):
+        # positional outputs cannot drop a row without misaligning
+        # every later frame — must be loud, not silently wrong
+        with faults.inject(*self._persistent_corruption(5)):
+            with pytest.raises(CorruptFrameError, match="serial"):
+                RMSD(uni.select_atoms("name CA")).run(
+                    resilient=fast_policy(), backend="jax", batch_size=8)
+
+    def test_repeat_drop_charges_budget_once(self):
+        # a deadline-retried stage op (or second pass) re-dropping the
+        # SAME frame must not double-charge max_dropped_frames
+        rt = ReliabilityRuntime(fast_policy(max_dropped_frames=1))
+        rt._record_drop(5)
+        rt._record_drop(5)                   # same frame: no-op
+        assert rt.report.dropped_frames == [5]
+        with pytest.raises(CorruptFrameError):
+            rt._record_drop(6)               # second DISTINCT frame
+
+    def test_shared_cache_does_not_blind_second_run(self, uni):
+        # a salvage-shortened block must not be served from a shared
+        # DeviceBlockCache to a later resilient run — that run's
+        # report would show no drops for frames it never computed
+        from mdanalysis_mpi_tpu.parallel.executors import DeviceBlockCache
+
+        cache = DeviceBlockCache()
+        reports = []
+        for _ in range(2):
+            with faults.inject(*self._persistent_corruption(5)):
+                r = RMSF(uni.select_atoms("name CA")).run(
+                    resilient=fast_policy(), backend="jax",
+                    batch_size=8, block_cache=cache)
+            reports.append(list(r.results.reliability["dropped_frames"]))
+        assert reports == [[5], [5]]
+
+    def test_serial_skip_and_truncated_frame(self, uni):
+        specs = (FaultSpec("read", "corrupt", frames=[7], times=None),
+                 FaultSpec("read", "corrupt", frames=[9], times=None,
+                           corrupt="truncate"))
+        with faults.inject(*specs):
+            r = RMSF(uni.select_atoms("name CA")).run(
+                resilient=fast_policy(), backend="serial")
+        assert list(r.results.reliability["dropped_frames"]) == [7, 9]
+        ref = RMSF(uni.select_atoms("name CA")).run(
+            frames=[i for i in range(N_FRAMES) if i not in (7, 9)],
+            backend="serial").results.rmsf
+        np.testing.assert_allclose(r.results.rmsf, ref, atol=1e-6)
+
+
+# ---------------- retry / backoff / deadline ----------------
+
+class TestRetryPolicy:
+    def test_staging_retry_with_backoff(self, uni, oracle_rmsf):
+        with faults.inject(FaultSpec("stage", "raise", times=2)):
+            r = RMSF(uni.select_atoms("name CA")).run(
+                resilient=fast_policy(), backend="jax", batch_size=8)
+        assert r.results.reliability["retries"]["stage"] == 2
+        np.testing.assert_allclose(r.results.rmsf, oracle_rmsf, atol=1e-3)
+
+    def test_transfer_retry(self, uni, oracle_rmsf):
+        with faults.inject(FaultSpec("put", "raise", times=1)):
+            r = RMSF(uni.select_atoms("name CA")).run(
+                resilient=fast_policy(), backend="jax", batch_size=8)
+        assert r.results.reliability["retries"]["put"] == 1
+        np.testing.assert_allclose(r.results.rmsf, oracle_rmsf, atol=1e-3)
+
+    def test_stall_past_deadline_retried(self, uni, oracle_rmsf):
+        with faults.inject(FaultSpec("stage", "stall", stall_s=0.06,
+                                     times=1)):
+            r = RMSF(uni.select_atoms("name CA")).run(
+                resilient=fast_policy(stage_deadline_s=0.02),
+                backend="jax", batch_size=8)
+        assert r.results.reliability["deadline_misses"] == 1
+        np.testing.assert_allclose(r.results.rmsf, oracle_rmsf, atol=1e-3)
+
+    def test_retry_budget_exhaustion_raises(self, uni):
+        with faults.inject(FaultSpec("stage", "raise", times=None)):
+            with pytest.raises(InjectedTransientError):
+                RMSF(uni.select_atoms("name CA")).run(
+                    resilient=fast_policy(fallback=False),
+                    backend="jax", batch_size=8)
+
+    def test_programming_errors_not_retried(self):
+        rt = ReliabilityRuntime(fast_policy())
+        calls = []
+
+        def boom():
+            calls.append(1)
+            raise ValueError("not transient")
+
+        with pytest.raises(ValueError):
+            rt.op("stage", boom)
+        assert len(calls) == 1               # no retry burned on it
+
+
+# ---------------- graceful degradation ----------------
+
+class TestFallback:
+    def test_persistent_device_loss_completes_via_chain(self, uni,
+                                                        oracle_rmsf):
+        # the acceptance-criterion scenario: a persistent device-loss
+        # failure on every batch dispatch completes via Mesh→Jax→Serial
+        # instead of raising
+        with faults.inject(FaultSpec("kernel", "raise", times=None)):
+            r = RMSF(uni.select_atoms("name CA")).run(
+                resilient=fast_policy(), backend="mesh", batch_size=4)
+        np.testing.assert_allclose(r.results.rmsf, oracle_rmsf, atol=1e-6)
+        hops = [(f, t) for f, t, _ in r.results.reliability["fallbacks"]]
+        assert hops == [("mesh", "jax"), ("jax", "serial")]
+
+    def test_series_analysis_falls_back_to_serial(self, uni):
+        ref = RMSD(uni.select_atoms("name CA")).run(
+            backend="serial").results.rmsd
+        with faults.inject(FaultSpec("kernel", "raise", times=None)):
+            r = RMSD(uni.select_atoms("name CA")).run(
+                resilient=fast_policy(), backend="jax", batch_size=8)
+        np.testing.assert_allclose(r.results.rmsd, ref, atol=1e-6)
+        assert [(f, t) for f, t, _ in
+                r.results.reliability["fallbacks"]] == [("jax", "serial")]
+
+    def test_fallback_disabled_raises(self, uni):
+        with faults.inject(FaultSpec("kernel", "raise", times=None)):
+            with pytest.raises(DeviceLossError):
+                RMSF(uni.select_atoms("name CA")).run(
+                    resilient=fast_policy(fallback=False),
+                    backend="jax", batch_size=8)
+
+    def test_non_degradable_errors_propagate(self, uni):
+        # a crash-shaped failure must NOT be papered over by fallback
+        with faults.inject(FaultSpec("kernel", "raise", times=None,
+                                     exc=InjectedCrash)):
+            with pytest.raises(InjectedCrash):
+                RMSF(uni.select_atoms("name CA")).run(
+                    resilient=fast_policy(), backend="jax", batch_size=8)
+
+    def test_classification(self):
+        assert is_degradable(DeviceLossError("DEVICE_LOST"))
+        assert is_degradable(RuntimeError("RESOURCE_EXHAUSTED: hbm"))
+        assert not is_degradable(InjectedCrash("boom"))
+        assert not is_degradable(ValueError("bad argument"))
+
+    def test_chain_with_single_serial(self, uni, oracle_rmsf):
+        # serial backend resilient: chain degenerates, still reports
+        r = RMSF(uni.select_atoms("name CA")).run(
+            resilient=fast_policy(), backend="serial")
+        assert list(r.results.reliability["fallbacks"]) == []
+        np.testing.assert_allclose(r.results.rmsf, oracle_rmsf, atol=1e-6)
+
+    def test_fallback_chain_needs_executors(self):
+        with pytest.raises(ValueError):
+            FallbackChain([])
+
+    def test_demotion_is_sticky_across_calls(self):
+        # run_checkpointed calls execute() once per chunk; a dead
+        # member must not re-burn its retry budget every chunk
+        class Boom:
+            name = "boom"
+            calls = 0
+
+            def execute(self, *a, **k):
+                Boom.calls += 1
+                raise DeviceLossError("DEVICE_LOST")
+
+        class Ok:
+            name = "ok"
+            calls = 0
+
+            def execute(self, *a, **k):
+                Ok.calls += 1
+                return "partials"
+
+        rt = ReliabilityRuntime(fast_policy())
+        chain = FallbackChain([Boom(), Ok()], rt)
+        stub = type("A", (), {})()
+        assert chain.execute(stub, None, []) == "partials"
+        assert chain.execute(stub, None, []) == "partials"
+        assert Boom.calls == 1 and Ok.calls == 2
+        assert len(rt.report.fallbacks) == 1
+
+    def test_user_executor_instance_restored(self, uni, oracle_rmsf):
+        # resilient runs must not leave their runtime attached to a
+        # user-supplied executor: a later plain run through the same
+        # instance would silently salvage into a dead report
+        from mdanalysis_mpi_tpu.parallel.executors import JaxExecutor
+
+        ex = JaxExecutor(batch_size=8)
+        RMSF(uni.select_atoms("name CA")).run(
+            resilient=fast_policy(), backend=ex)
+        assert "reliability" not in ex.__dict__
+        r = RMSF(uni.select_atoms("name CA")).run(backend=ex)
+        assert "reliability" not in r.results
+        np.testing.assert_allclose(r.results.rmsf, oracle_rmsf, atol=1e-3)
+
+    def test_aligntraj_rejects_resilient_loudly(self, uni):
+        # a run() override that cannot honor resilient= must say so,
+        # not silently accept it and crash on the first fault
+        from mdanalysis_mpi_tpu.analysis import AlignTraj
+
+        with pytest.raises(ValueError, match="resilient"):
+            AlignTraj(uni, uni, select="name CA",
+                      in_memory=True).run(resilient=True)
+
+    def test_pca_surfaces_pass1_drops(self, uni):
+        from mdanalysis_mpi_tpu.analysis import PCA
+
+        specs = (FaultSpec("stage", "corrupt", frames=[5], times=None),
+                 FaultSpec("read", "corrupt", frames=[5], times=None))
+        with faults.inject(*specs):
+            r = PCA(uni, select="name CA", align=True,
+                    n_components=3).run(resilient=fast_policy(),
+                                        backend="jax", batch_size=8)
+        assert list(r.results.reliability["dropped_frames"]) == [5]
+
+    def test_deterministic_oserror_not_retried(self):
+        rt = ReliabilityRuntime(fast_policy())
+        calls = []
+
+        def missing():
+            calls.append(1)
+            raise FileNotFoundError("/no/such/trajectory.xtc")
+
+        with pytest.raises(FileNotFoundError):
+            rt.op("stage", missing)
+        assert len(calls) == 1               # fail-fast, no backoff burn
+
+    def test_pca_align_accepts_resilient(self, uni):
+        # PCA(align=True) orchestrates two passes like AlignedRMSF;
+        # resilient= must ride the child runs, not the executor ctor
+        from mdanalysis_mpi_tpu.analysis import PCA
+
+        ref = PCA(uni, select="name CA", align=True,
+                  n_components=3).run(backend="serial")
+        r = PCA(uni, select="name CA", align=True, n_components=3).run(
+            resilient=fast_policy(), backend="jax", batch_size=8)
+        np.testing.assert_allclose(np.abs(r.results.variance),
+                                   np.abs(ref.results.variance),
+                                   rtol=5e-3, atol=1e-4)
+
+    def test_serial_series_skip_keeps_frames_aligned(self, uni):
+        # a serial-path skip shrinks results.frames WITH the series:
+        # no full-length frame column misaligned against shorter data
+        from mdanalysis_mpi_tpu.analysis.base import AnalysisFromFunction
+
+        ag = uni.select_atoms("name CA")
+        with faults.inject(FaultSpec("read", "corrupt", frames=[3],
+                                     times=None)):
+            r = AnalysisFromFunction(
+                lambda g: g.positions.mean(), ag).run(
+                resilient=fast_policy(), backend="serial")
+        assert list(r.results.frames) == [i for i in range(N_FRAMES)
+                                          if i != 3]
+        assert len(r.results.timeseries) == N_FRAMES - 1
+
+    def test_flagship_two_pass_resilient(self, uni, tmp_path):
+        # AlignedRMSF overrides run() (two-pass orchestration); the
+        # resilient= kwarg rides each pass's child run, so a
+        # persistent device failure in EITHER pass completes serially
+        ref = AlignedRMSF(uni, select="name CA").run(
+            backend="serial").results.rmsf
+        pol = ReliabilityPolicy(backoff_s=0.001,
+                                checkpoint_dir=str(tmp_path))
+        with faults.inject(FaultSpec("kernel", "raise", times=None)):
+            r = AlignedRMSF(uni, select="name CA").run(
+                resilient=pol, backend="jax", batch_size=8)
+        np.testing.assert_allclose(r.results.rmsf, ref, atol=1e-6)
+        assert not glob.glob(os.path.join(str(tmp_path), "mdtpu-ckpt-*"))
+        # the per-pass reports are merged to the surface the user reads
+        assert r.results.reliability["fallbacks"]
+
+    def test_flagship_surfaces_dropped_frames(self, uni, tmp_path):
+        pol = ReliabilityPolicy(backoff_s=0.001,
+                                checkpoint_dir=str(tmp_path))
+        specs = (FaultSpec("stage", "corrupt", frames=[5], times=None),
+                 FaultSpec("read", "corrupt", frames=[5], times=None))
+        with faults.inject(*specs):
+            r = AlignedRMSF(uni, select="name CA").run(
+                resilient=pol, backend="jax", batch_size=8)
+        assert list(r.results.reliability["dropped_frames"]) == [5]
+
+    def test_mesh_only_ring_degrades_to_serial(self):
+        # a mesh-only (ring) reduction cannot use the single-device
+        # fallback; the chain must skip straight to serial, not fall
+        # off its own end
+        from mdanalysis_mpi_tpu.analysis import InterRDF
+
+        boxed = make_protein_universe(n_residues=8, n_frames=8,
+                                      noise=0.25, seed=3, box=30.0)
+        g1 = boxed.select_atoms("name CA")
+        ref = InterRDF(g1, g1, nbins=20, range=(0.5, 6.0)).run(
+            backend="serial").results.rdf
+        with faults.inject(FaultSpec("kernel", "raise", times=None)):
+            r = InterRDF(g1, g1, nbins=20, range=(0.5, 6.0),
+                         engine="ring").run(
+                resilient=fast_policy(), backend="mesh", batch_size=4)
+        np.testing.assert_allclose(r.results.rdf, ref, rtol=1e-5)
+        assert [(f, t) for f, t, _ in
+                r.results.reliability["fallbacks"]] == [("mesh", "serial")]
+
+
+# ---------------- crash → checkpoint → resume ----------------
+
+class TestAutoResume:
+    def _policy(self, tmp_path, **kw):
+        return ReliabilityPolicy(backoff_s=0.001, checkpoint_every=16,
+                                 checkpoint_dir=str(tmp_path), **kw)
+
+    def test_crash_then_resume_matches_uninterrupted(self, tmp_path):
+        u = make_protein_universe(n_residues=8, n_frames=64, noise=0.25,
+                                  seed=11)
+        oracle = RMSF(u.select_atoms("name CA")).run(
+            backend="serial").results.rmsf
+        pol = self._policy(tmp_path)
+        # crash on the 6th batch dispatch: chunk 3 of 4 (16-frame
+        # chunks, batch 8 → 2 dispatches per chunk)
+        crash = FaultSpec("kernel", "raise", after=5, times=1,
+                          exc=InjectedCrash)
+        with faults.inject(crash):
+            with pytest.raises(InjectedCrash):
+                RMSF(u.select_atoms("name CA")).run(
+                    resilient=pol, backend="jax", batch_size=8)
+        (path,) = glob.glob(os.path.join(str(tmp_path), "mdtpu-ckpt-*"))
+        with np.load(path) as z:
+            assert int(z["frames_done"]) == 32    # two chunks durable
+        # "new process": a fresh analysis object, same call — and count
+        # kernel dispatches to prove the durable chunks are NOT re-run
+        counter = FaultSpec("kernel", "raise", times=0)   # never fires
+        with faults.inject(counter):
+            r = RMSF(u.select_atoms("name CA")).run(
+                resilient=pol, backend="jax", batch_size=8)
+        assert counter.visits == 4            # frames 32..64 only
+        # resumed == uninterrupted within the framework's f32 tolerance
+        np.testing.assert_allclose(r.results.rmsf, oracle, atol=1e-3)
+        assert not glob.glob(os.path.join(str(tmp_path), "mdtpu-ckpt-*"))
+
+    def test_default_true_uses_default_policy(self, uni, oracle_rmsf,
+                                              monkeypatch, tmp_path):
+        monkeypatch.setenv("MDTPU_CHECKPOINT_DIR", str(tmp_path))
+        r = RMSF(uni.select_atoms("name CA")).run(
+            resilient=True, backend="jax", batch_size=8)
+        np.testing.assert_allclose(r.results.rmsf, oracle_rmsf, atol=1e-3)
+        assert "reliability" in r.results
+        assert not glob.glob(os.path.join(str(tmp_path), "mdtpu-ckpt-*"))
+
+    def test_checkpoint_path_is_stable(self, uni, tmp_path):
+        from mdanalysis_mpi_tpu.utils.checkpoint import checkpoint_path
+
+        a = RMSF(uni.select_atoms("name CA"))
+        a._frame_indices = list(range(N_FRAMES))
+        a.n_frames = N_FRAMES
+        a._prepare()
+        p1 = checkpoint_path(a, list(range(N_FRAMES)),
+                             checkpoint_dir=str(tmp_path))
+        p2 = checkpoint_path(a, list(range(N_FRAMES)),
+                             checkpoint_dir=str(tmp_path))
+        assert p1 == p2 and p1.startswith(str(tmp_path))
+        assert p1 != checkpoint_path(a, list(range(N_FRAMES - 1)),
+                                     checkpoint_dir=str(tmp_path))
+
+    def test_resume_inherits_dropped_frames(self, tmp_path):
+        # frames dropped in a durable chunk must survive the crash:
+        # the resumed process never re-stages that chunk, so its
+        # report inherits the record from the checkpoint file
+        u = make_protein_universe(n_residues=8, n_frames=64, noise=0.25,
+                                  seed=11)
+        pol = self._policy(tmp_path)
+        specs = (FaultSpec("stage", "corrupt", frames=[5], times=None),
+                 FaultSpec("read", "corrupt", frames=[5], times=None),
+                 FaultSpec("kernel", "raise", after=5, times=1,
+                           exc=InjectedCrash))
+        with faults.inject(*specs):
+            with pytest.raises(InjectedCrash):
+                RMSF(u.select_atoms("name CA")).run(
+                    resilient=pol, backend="jax", batch_size=8)
+        r = RMSF(u.select_atoms("name CA")).run(
+            resilient=pol, backend="jax", batch_size=8)
+        assert list(r.results.reliability["dropped_frames"]) == [5]
+
+    def test_chain_giveup_cleans_stale_checkpoint(self, tmp_path):
+        # batch chain dies persistently AFTER a chunk checkpointed;
+        # the serial completion must remove the stale file (its
+        # partials cover frames the serial run recomputed whole)
+        u = make_protein_universe(n_residues=8, n_frames=64, noise=0.25,
+                                  seed=11)
+        pol = self._policy(tmp_path)
+        with faults.inject(FaultSpec("kernel", "raise", times=None,
+                                     after=2)):
+            r = RMSF(u.select_atoms("name CA")).run(
+                resilient=pol, backend="jax", batch_size=8)
+        ref = RMSF(u.select_atoms("name CA")).run(
+            backend="serial").results.rmsf
+        np.testing.assert_allclose(r.results.rmsf, ref, atol=1e-3)
+        assert r.results.reliability["fallbacks"]
+        assert not glob.glob(os.path.join(str(tmp_path), "mdtpu-ckpt-*"))
+
+    def test_giveup_with_serial_skip_still_cleans_checkpoint(self,
+                                                             tmp_path):
+        # the serial completion SKIPS a corrupt frame, shrinking
+        # _frame_indices — the stale-checkpoint path must have been
+        # resolved against the full window the chunks fingerprinted,
+        # or the file survives and seeds a bogus future resume
+        u = make_protein_universe(n_residues=8, n_frames=64, noise=0.25,
+                                  seed=11)
+        pol = self._policy(tmp_path)
+        specs = (FaultSpec("kernel", "raise", times=None, after=2),
+                 FaultSpec("read", "corrupt", frames=[40], times=None),
+                 FaultSpec("stage", "corrupt", frames=[40], times=None))
+        with faults.inject(*specs):
+            r = RMSF(u.select_atoms("name CA")).run(
+                resilient=pol, backend="jax", batch_size=8)
+        assert 40 in list(r.results.reliability["dropped_frames"])
+        assert not glob.glob(os.path.join(str(tmp_path), "mdtpu-ckpt-*"))

@@ -154,3 +154,80 @@ def test_validation_errors():
     with pytest.raises(RuntimeError, match="run"):
         WaterBridgeAnalysis(u, "resname PROT",
                             "resname ACCP").count_by_time()
+
+
+# ---- duplicate-resid regression (ADVICE r5 high) ----
+
+def _duplicate_resid_universe(chain=False):
+    """Two DISTINCT waters sharing resid 2 (PDB wraparound /
+    per-segment restart shape): non-adjacent in the atom list, so the
+    topology derives distinct resindices for them.
+
+    ``chain=False``: W1 accepts from PROT near x=2.8; W2 donates to
+    ACCP near x=22.8; the waters are 17 Å apart with NO hbond between
+    them — no bridge exists at any order.  Keying water nodes by the
+    non-unique resid collapsed W1 and W2 into one node and fabricated
+    a first-order bridge here.
+
+    ``chain=True``: W2 moves to x=5.6 forming the genuine
+    PROT→W1→W2→ACCP chain — a second-order bridge that must still be
+    found (and must still be gated off at order=1) when its two waters
+    share a resid.
+    """
+    names, resnames, resids, elements, coords = [], [], [], [], []
+
+    def atom(name, resname, resid, element, xyz):
+        names.append(name)
+        resnames.append(resname)
+        resids.append(resid)
+        elements.append(element)
+        coords.append(xyz)
+
+    atom("OG", "PROT", 1, "O", [0.0, 0.0, 0.0])
+    atom("HG", "PROT", 1, "H", [1.0, 0.0, 0.0])
+    atom("OW", "SOL", 2, "O", [2.8, 0.0, 0.0])
+    atom("HW1", "SOL", 2, "H", [3.76, 0.0, 0.0])
+    atom("HW2", "SOL", 2, "H", [2.5, 0.9, 0.0])
+    if chain:
+        w2x, accx = 5.6, 8.4
+    else:
+        w2x, accx = 20.0, 22.8
+    atom("OD", "ACCP", 3, "O", [accx, 0.0, 0.0])
+    atom("CD", "ACCP", 3, "C", [accx + 1.2, 0.0, 0.0])
+    # W2: NON-adjacent to W1 and deliberately reusing resid 2
+    atom("OW", "SOL", 2, "O", [w2x, 0.0, 0.0])
+    atom("HW1", "SOL", 2, "H", [w2x + 0.96, 0.0, 0.0])
+    atom("HW2", "SOL", 2, "H", [w2x - 0.3, 0.9, 0.0])
+    top = Topology(names=np.array(names), resnames=np.array(resnames),
+                   resids=np.array(resids, np.int64),
+                   elements=np.array(elements))
+    # the scenario's premise: same resid, distinct residues
+    assert top.resindices[2] != top.resindices[7]
+    assert top.resids[2] == top.resids[7]
+    frames = np.asarray(coords, np.float32)[None]
+    dims = np.array([50, 50, 50, 90, 90, 90], np.float32)
+    return Universe(top, MemoryReader(frames, dimensions=dims))
+
+
+def test_duplicate_resids_do_not_fabricate_bridges():
+    u = _duplicate_resid_universe(chain=False)
+    for order in (1, 2):
+        wb = WaterBridgeAnalysis(u, "resname PROT", "resname ACCP",
+                                 order=order).run()
+        assert wb.count_by_time().tolist() == [0], (
+            f"order={order}: far-apart waters sharing a resid must not "
+            "merge into one bridge node")
+
+
+def test_duplicate_resids_keep_real_chain_and_order_gating():
+    u = _duplicate_resid_universe(chain=True)
+    # order=1 must NOT see the two-water chain (with resid-keyed nodes
+    # the merged W1/W2 node made it look first-order)
+    wb1 = WaterBridgeAnalysis(u, "resname PROT", "resname ACCP",
+                              order=1).run()
+    assert wb1.count_by_time().tolist() == [0]
+    wb2 = WaterBridgeAnalysis(u, "resname PROT", "resname ACCP",
+                              order=2).run()
+    bridges = wb2.results.timeseries[0]
+    assert len(bridges) == 1
+    assert len(bridges[0]) == 3            # prot→W1, W1→W2, W2→ACCP
