@@ -20,6 +20,12 @@ single device→host fetch collapses host→device throughput for the rest
 of the process (analysis/base.py Deferred rationale), so one early
 check would poison every later measurement.  Scale knob:
 BENCH_SUITE_SCALE (default 1.0) multiplies frame counts.
+
+Configs 4-5 additionally report ``host_vec_fps`` / ``vs_host_vec``
+(VERDICT r5 #6): a fused-f32 vectorized host loop with no per-frame
+Python machinery — the defensible host-optimal denominator — next to
+the f64 serial oracle's ``vs_serial``, so the artifact discloses both
+and device speedups are not inflated by an oracle-grade denominator.
 """
 
 import contextlib
@@ -96,6 +102,64 @@ def _serial_fps(make_analysis, n_frames) -> tuple[float, int, float]:
             return fps, stop, round(cv, 4) if cv != float("inf") else None
         fps_prev = fps
         window *= 2
+
+
+def _host_vec_fps(per_frame, u, idx, n_frames, block=32) -> float:
+    """Frames/sec of a VECTORIZED-HOST denominator leg (VERDICT r5 #6):
+    blocked ``read_block`` staging + one fused f32 numpy kernel per
+    frame, no AnalysisBase machinery, no f64 — the defensible
+    host-optimal number ``vs_host_vec`` is quoted against.  The f64
+    serial oracle keeps its correctness role; this leg only answers
+    "how fast could one tuned host core go", so suite speedups are not
+    inflated by a Python-loop/f64 denominator.  Measured BEFORE any
+    device contact (same CPU-quiet discipline as the serial legs)."""
+    reader = u.trajectory
+    per_frame(np.zeros((len(idx), 3), np.float32),
+              np.array([1e3] * 3, np.float32))        # warm-up/alloc
+    t0 = time.perf_counter()
+    for lo in range(0, n_frames, block):
+        hi = min(lo + block, n_frames)
+        frames, boxes = reader.read_block(lo, hi, sel=idx)
+        for f in range(hi - lo):
+            per_frame(np.asarray(frames[f], np.float32),
+                      None if boxes is None
+                      else np.asarray(boxes[f, :3], np.float32))
+    return n_frames / (time.perf_counter() - t0)
+
+
+def _rdf_frame_kernel(edges, exclude_self):
+    """Fused f32 per-frame RDF histogram (ortho minimum image)."""
+    edges32 = np.asarray(edges, np.float32)
+
+    def kernel(x, lengths):
+        d = x[:, None, :] - x[None, :, :]
+        if lengths is not None:
+            d -= np.round(d / lengths) * lengths
+        dist = np.sqrt(np.einsum("ijk,ijk->ij", d, d,
+                                 dtype=np.float32), dtype=np.float32)
+        if exclude_self:
+            np.fill_diagonal(dist, -1.0)
+        k = np.searchsorted(edges32, dist.ravel(), side="right") - 1
+        nb = len(edges32) - 1
+        valid = ((dist.ravel() >= edges32[0])
+                 & (dist.ravel() < edges32[-1]))
+        return np.bincount(np.where(valid, k, nb), minlength=nb + 1)[:-1]
+
+    return kernel
+
+
+def _contact_frame_kernel(cutoff):
+    """Fused f32 per-frame contact map (ortho minimum image)."""
+    c2 = np.float32(cutoff * cutoff)
+
+    def kernel(x, lengths):
+        d = x[:, None, :] - x[None, :, :]
+        if lengths is not None:
+            d -= np.round(d / lengths) * lengths
+        return (np.einsum("ijk,ijk->ij", d, d,
+                          dtype=np.float32) < c2)
+
+    return kernel
 
 
 #: the accelerator the measured configs actually ran on, captured by
@@ -208,6 +272,10 @@ def config4(stack):
     del stack
     u = make_water_universe(n_waters=2000, n_frames=int(32 * SCALE), seed=4)
     ow = u.select_atoms("name OW")
+    # vectorized-host denominator BEFORE any device contact (quiet CPU)
+    hv = _host_vec_fps(
+        _rdf_frame_kernel(np.linspace(0.0, 10.0, 76), exclude_self=True),
+        u, ow.indices, u.trajectory.n_frames)
     fps, serial, sf, scv, a = _timed(
         lambda: InterRDF(ow, ow, nbins=75, range=(0.0, 10.0)),
         u.trajectory.n_frames, dict(backend="jax", batch_size=8))
@@ -222,13 +290,20 @@ def config4(stack):
             "value": _r(fps), "unit": "frames/s", "backend": "jax",
             "serial_fps": round(serial, 2), "serial_frames": sf,
             "serial_cv": scv,
-            "vs_serial": _vs(fps, serial)}, check
+            "vs_serial": _vs(fps, serial),
+            # both denominators disclosed (VERDICT r5 #6): f64 oracle
+            # (correctness twin) AND the fused-f32 host-optimal loop
+            "host_vec_fps": _r(hv),
+            "vs_host_vec": _vs(fps, hv)}, check
 
 
 def config5(stack):
     del stack
     u = make_protein_universe(n_residues=500, n_frames=int(128 * SCALE),
                               noise=0.4, seed=5)
+    ca = u.select_atoms("name CA")
+    hv = _host_vec_fps(_contact_frame_kernel(8.0), u, ca.indices,
+                       u.trajectory.n_frames)
     fps, serial, sf, scv, a = _timed(
         lambda: ContactMap(u.select_atoms("name CA"), cutoff=8.0),
         u.trajectory.n_frames, dict(backend="jax", batch_size=32))
@@ -244,7 +319,9 @@ def config5(stack):
             "value": _r(fps), "unit": "frames/s", "backend": "jax",
             "serial_fps": round(serial, 2), "serial_frames": sf,
             "serial_cv": scv,
-            "vs_serial": _vs(fps, serial)}, check
+            "vs_serial": _vs(fps, serial),
+            "host_vec_fps": _r(hv),
+            "vs_host_vec": _vs(fps, hv)}, check
 
 
 def config6(stack):

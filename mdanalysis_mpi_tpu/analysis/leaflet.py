@@ -16,10 +16,11 @@ large components — the upper and lower leaflet; upstream's
 ``optimize_cutoff`` helper is mirrored as :func:`optimize_cutoff`.
 
 Host-side by design: one frame, one sparse neighbor search
-(``lib.distances.self_capped_distance`` — the blockwise kernel that
-never materializes the N² matrix), one union-find pass.  The per-frame
-batch machinery would add nothing — leaflet assignment is a
-topology-building step, not a trajectory reduction.
+(``lib.distances.self_capped_distance`` — the O(N) cell list by
+default, brute force as the selectable fallback; neither materializes
+the N² matrix), one union-find pass.  The per-frame batch machinery
+would add nothing — leaflet assignment is a topology-building step,
+not a trajectory reduction.
 """
 
 from __future__ import annotations
@@ -28,10 +29,12 @@ import numpy as np
 
 
 class LeafletFinder:
-    """``LeafletFinder(universe, select, cutoff=15.0, pbc=False)``."""
+    """``LeafletFinder(universe, select, cutoff=15.0, pbc=False,
+    engine='auto')``; ``engine`` is the pair-pruning backend knob
+    (``lib.distances.capped_distance``)."""
 
     def __init__(self, universe, select: str, cutoff: float = 15.0,
-                 pbc: bool = False):
+                 pbc: bool = False, engine: str = "auto"):
         if cutoff <= 0:
             raise ValueError(f"cutoff must be positive, got {cutoff}")
         self._u = universe
@@ -41,6 +44,7 @@ class LeafletFinder:
         self._ag = ag
         self._cutoff = float(cutoff)
         self._pbc = bool(pbc)
+        self._engine = engine
         self.run()
 
     def run(self) -> "LeafletFinder":
@@ -60,7 +64,8 @@ class LeafletFinder:
             box = ts.dimensions
         x = ts.positions[self._ag.indices].astype(np.float64)
         pairs = self_capped_distance(x, self._cutoff, box=box,
-                                     return_distances=False)
+                                     return_distances=False,
+                                     engine=self._engine)
         labels = label_components(len(x), pairs)
         comps: dict[int, list[int]] = {}
         for i, lab in enumerate(labels):
@@ -87,7 +92,8 @@ class LeafletFinder:
 
 def optimize_cutoff(universe, select: str, dmin: float = 10.0,
                     dmax: float = 20.0, step: float = 0.5,
-                    max_imbalance: float = 0.2, pbc: bool = False):
+                    max_imbalance: float = 0.2, pbc: bool = False,
+                    engine: str = "auto"):
     """Scan cutoffs and return ``(cutoff, n_components)`` minimizing
     the component count among cutoffs whose two largest leaflets are
     balanced within ``max_imbalance`` (upstream
@@ -102,7 +108,7 @@ def optimize_cutoff(universe, select: str, dmin: float = 10.0,
         # pbc without box) is cutoff-INdependent — swallowing it would
         # scan uselessly and misreport the real error as 'no cutoff'
         lf = LeafletFinder(universe, select, cutoff=float(cutoff),
-                           pbc=pbc)
+                           pbc=pbc, engine=engine)
         sizes = lf.sizes()
         if len(sizes) < 2:
             continue

@@ -430,7 +430,8 @@ class AtomGroup:
         return self._universe.impropers.atomgroup_intersection(self)
 
     def guess_bonds(self, fudge_factor: float = 0.55,
-                    lower_bound: float = 0.1) -> np.ndarray:
+                    lower_bound: float = 0.1,
+                    engine: str = "auto") -> np.ndarray:
         """Distance-based bond perception over THIS group's atoms
         (upstream ``AtomGroup.guess_bonds``): atoms i, j bond when
         ``lower_bound < d(i,j) < fudge_factor·(r_vdw(i)+r_vdw(j))``
@@ -438,7 +439,13 @@ class AtomGroup:
         The guessed bonds are merged into the universe topology —
         ``bonded`` selections and HydrogenBondAnalysis donor pairing
         work afterwards — and returned as an (n_bonds, 2) global-index
-        array.  Elements without a tabulated radius raise."""
+        array.  Elements without a tabulated radius raise.
+
+        ``engine`` selects the pair-pruning backend
+        (``lib.distances.capped_distance``); the default 'auto' uses
+        the O(N) cell list at scale — the bond-search cutoff is a few
+        Å, so perception over a 100k-atom frame is grid territory —
+        with brute force as the selectable/degenerate-box fallback."""
         from mdanalysis_mpi_tpu.core import tables
         from mdanalysis_mpi_tpu.lib.distances import self_capped_distance
 
@@ -459,7 +466,7 @@ class AtomGroup:
         max_cut = fudge_factor * 2.0 * float(radii.max())
         pairs, d = self_capped_distance(
             self.positions, max_cut, min_cutoff=lower_bound,
-            box=ts.dimensions, return_distances=True)
+            box=ts.dimensions, return_distances=True, engine=engine)
         keep = d < fudge_factor * (radii[pairs[:, 0]] + radii[pairs[:, 1]])
         bonds = self._indices[pairs[keep]]
         existing = t.bonds if t.bonds is not None else np.empty((0, 2),

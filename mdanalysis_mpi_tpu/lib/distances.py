@@ -155,19 +155,50 @@ def contact_matrix(coords, cutoff: float = 15.0, box=None,
     return distance_array(a, a, box=box, backend=backend) < cutoff
 
 
+#: ``engine="auto"`` switches from brute force to the cell list above
+#: this many candidate pairs (N·M); below it the vectorized brute block
+#: is faster than grid bookkeeping
+AUTO_GRID_MIN_PAIRS = 16_384
+#: ...and only when the grid actually prunes: with fewer total cells
+#: than ~2× the 27-stencil, nearly every atom is a candidate anyway
+AUTO_GRID_MIN_CELLS = 54
+
+
 def capped_distance(reference, configuration, max_cutoff: float,
                     min_cutoff: float | None = None, box=None,
-                    return_distances: bool = True, _self_upper=False):
+                    return_distances: bool = True, engine: str = "auto",
+                    _self_upper=False):
     """Pairs within ``max_cutoff`` (upstream ``lib.distances
     .capped_distance``): returns ``(pairs, distances)`` — pairs is
-    (K, 2) int ``[i_reference, j_configuration]`` — or just ``pairs``
-    with ``return_distances=False``.
+    (K, 2) int ``[i_reference, j_configuration]`` lexsorted by (i, j)
+    — or just ``pairs`` with ``return_distances=False``.
 
-    Blockwise over the reference axis so the full N×M matrix never
-    materializes (the same discipline as the device pair kernels,
-    SURVEY.md §5.7); minimum image under ``box`` when given.
-    ``_self_upper`` (internal, :func:`self_capped_distance`) keeps only
-    j > i pairs per block, before accumulation.
+    ``engine`` selects the pair-pruning backend (the knob mirrors
+    ``InterRDF``'s):
+
+    - ``"auto"`` (default): the O(N) cell list (``lib.nsgrid``) when
+      the query is large enough to profit and the grid applies;
+      otherwise brute force.  Every capped-radius consumer
+      (``guess_bonds``, LeafletFinder, HBond pruning,
+      AtomNeighborSearch) routes through here, so auto is the
+      one default that turns them ~linear at scale.
+    - ``"nsgrid"``: force the cell list; raises ``ValueError`` when the
+      grid cannot serve the query (degenerate box, or cutoff so large
+      that fewer than 3 cells fit per axis).
+    - ``"bruteforce"``: the documented fallback — blockwise over the
+      reference axis so the full N×M matrix never materializes (the
+      same discipline as the device pair kernels, SURVEY.md §5.7).
+    - ``"jax"``: the fixed-capacity device cell list
+      (``ops.neighbors``) — worthwhile for repeated large queries on an
+      accelerator, not single host calls.
+
+    The host engines (auto/nsgrid/bruteforce) emit IDENTICAL (pairs,
+    distances) in identical order; the device engine computes in f32,
+    so a pair whose true distance lies within f32 rounding of the
+    cutoff (~1e-5 Å at solvated-box coordinates) can flip across it —
+    same order and identity otherwise.  Minimum image under ``box``
+    when given.  ``_self_upper`` (internal,
+    :func:`self_capped_distance`) keeps only j > i pairs.
     """
     a = np.asarray(reference, dtype=np.float64).reshape(-1, 3)
     b = np.asarray(configuration, dtype=np.float64).reshape(-1, 3)
@@ -176,7 +207,42 @@ def capped_distance(reference, configuration, max_cutoff: float,
     if min_cutoff is not None and min_cutoff >= max_cutoff:
         raise ValueError(
             f"min_cutoff {min_cutoff} must be below max_cutoff {max_cutoff}")
+    if engine not in ("auto", "nsgrid", "bruteforce", "jax"):
+        raise ValueError(
+            "engine must be 'auto', 'nsgrid', 'bruteforce' or 'jax', "
+            f"got {engine!r}")
     dims = _dims_of(box)
+
+    if engine == "jax":
+        from mdanalysis_mpi_tpu.ops import neighbors
+
+        return neighbors.capped_distance(
+            a, b, max_cutoff, min_cutoff=min_cutoff, dims=dims,
+            return_distances=return_distances, self_upper=_self_upper)
+    if engine in ("auto", "nsgrid"):
+        from mdanalysis_mpi_tpu.lib import nsgrid
+
+        try:
+            plan = None
+            if engine == "auto":
+                if len(a) * len(b) < AUTO_GRID_MIN_PAIRS:
+                    raise nsgrid.GridUnsuitable("query too small to profit")
+                if len(a) and len(b):
+                    plan = nsgrid.make_plan(a, b, max_cutoff, dims)
+                    if int(np.prod(plan[0])) < AUTO_GRID_MIN_CELLS:
+                        raise nsgrid.GridUnsuitable(
+                            "grid prunes too little")
+            return nsgrid.capped_pairs(
+                a, b, max_cutoff, min_cutoff=min_cutoff, dims=dims,
+                return_distances=return_distances,
+                self_upper=_self_upper, plan=plan)
+        except nsgrid.GridUnsuitable as e:
+            if engine == "nsgrid":
+                raise ValueError(
+                    f"engine='nsgrid' cannot serve this query: {e}"
+                ) from e
+            # auto: documented brute-force fallback below
+
     from mdanalysis_mpi_tpu.ops import host
 
     pairs_i, pairs_j, dists = [], [], []
@@ -213,13 +279,15 @@ def capped_distance(reference, configuration, max_cutoff: float,
 
 def self_capped_distance(reference, max_cutoff: float,
                          min_cutoff: float | None = None, box=None,
-                         return_distances: bool = True):
+                         return_distances: bool = True,
+                         engine: str = "auto"):
     """Unique i<j pairs within ``max_cutoff`` of each other (upstream
-    ``lib.distances.self_capped_distance``)."""
+    ``lib.distances.self_capped_distance``); ``engine`` as in
+    :func:`capped_distance`."""
     return capped_distance(reference, reference, max_cutoff,
                            min_cutoff=min_cutoff, box=box,
                            return_distances=return_distances,
-                           _self_upper=True)
+                           engine=engine, _self_upper=True)
 
 
 def minimize_vectors(vectors, box) -> np.ndarray:

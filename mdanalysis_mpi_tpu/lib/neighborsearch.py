@@ -1,10 +1,11 @@
 """Neighbor search over AtomGroups (upstream
 ``MDAnalysis.lib.NeighborSearch.AtomNeighborSearch``).
 
-A thin object front over the blockwise capped-distance kernel
-(``lib.distances.capped_distance`` — no N×M materialization): build
-once over a (static) group, query with any coordinates or group, get
-the matching atoms back at atom / residue / segment granularity.
+A thin object front over the capped-distance engines
+(``lib.distances.capped_distance`` — cell list by default, brute force
+as fallback; no N×M materialization either way): build once over a
+(static) group, query with any coordinates or group, get the matching
+atoms back at atom / residue / segment granularity.
 """
 
 from __future__ import annotations
@@ -16,9 +17,11 @@ class AtomNeighborSearch:
     """``AtomNeighborSearch(ag, box=None).search(other, radius,
     level='A'|'R'|'S')`` → AtomGroup / ResidueGroup / SegmentGroup of
     the atoms of ``ag`` within ``radius`` of ``other`` (an AtomGroup or
-    (M, 3) coordinates)."""
+    (M, 3) coordinates).  ``engine`` selects the pair-pruning backend
+    (``lib.distances.capped_distance``: 'auto' picks the O(N) cell
+    list at scale, 'bruteforce'/'nsgrid'/'jax' force one)."""
 
-    def __init__(self, atomgroup, box=None):
+    def __init__(self, atomgroup, box=None, engine: str = "auto"):
         from mdanalysis_mpi_tpu.analysis.base import reject_updating_groups
 
         reject_updating_groups(atomgroup, owner="AtomNeighborSearch")
@@ -26,6 +29,7 @@ class AtomNeighborSearch:
             raise ValueError("cannot search an empty AtomGroup")
         self._ag = atomgroup
         self._box = box
+        self._engine = engine
 
     def search(self, other, radius: float, level: str = "A"):
         from mdanalysis_mpi_tpu.lib.distances import capped_distance
@@ -35,7 +39,8 @@ class AtomNeighborSearch:
         coords = (other.positions if hasattr(other, "positions")
                   else np.asarray(other, np.float64).reshape(-1, 3))
         pairs = capped_distance(self._ag.positions, coords, radius,
-                                box=self._box, return_distances=False)
+                                box=self._box, return_distances=False,
+                                engine=self._engine)
         hits = np.unique(pairs[:, 0]) if len(pairs) else np.empty(
             0, np.int64)
         ag = self._ag[hits] if len(hits) else self._ag[[]]

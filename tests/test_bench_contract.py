@@ -50,6 +50,11 @@ def test_bench_json_contract(tmp_path):
         # #4), plus the r4 weather/retry telemetry
         for key in ("metric", "value", "unit", "vs_baseline",
                     "cold_value", "cold_vs_baseline",
+                    # r6 (VERDICT r5 #3): the f32 HBM-resident steady
+                    # precision control next to the int16 headline,
+                    # with its divergence disclosed
+                    "f32_steady_value", "f32_steady_vs_baseline",
+                    "f32_steady_divergence",
                     # r5 ADVICE: the relocated f32 leg reports under
                     # _highrss keys + explicit leg ordering, so
                     # cross-round readers can tell its process
@@ -64,10 +69,14 @@ def test_bench_json_contract(tmp_path):
                     "init_probes", "init_log"):
             assert key in rec, f"missing {key} in {sorted(rec)}"
         assert rec["accel_leg_order"][0] == "cold"
+        assert "f32_steady" in rec["accel_leg_order"]
         assert rec["unit"] == "frames/s/chip"
         assert "file-backed XTC" in rec["metric"]
         assert "steady-state" in rec["metric"]
         assert rec["value"] > 0 and rec["cold_value"] > 0
+        assert rec["f32_steady_value"] > 0
+        # the f32 control must sit inside the same gate as the headline
+        assert 0 <= rec["f32_steady_divergence"] <= 1e-3
         assert rec["decode_fps"] > 0 and rec["put_gbps"] > 0
         assert "status" not in rec          # success record is final
         # the correctness gate actually gated (a number was compared)
@@ -110,6 +119,9 @@ def test_bench_outage_records_host_legs(tmp_path):
         BENCH_SERIAL_FRAMES="8",
         BENCH_SOURCE="file",
         BENCH_PARTIAL_PATH=partial,
+        # watch is the DEFAULT since r6; this test pins the fail-fast
+        # opt-out path (the watch paths have their own tests below)
+        BENCH_WATCH="0",
         BENCH_INIT_BUDGET="1",              # one probe, then exhaustion
         BENCH_PROBE_SLEEP="1",
         # keep one probe cheap even if the site hook rewrites the bogus
@@ -145,10 +157,10 @@ def test_bench_outage_records_host_legs(tmp_path):
 
 @pytest.mark.slow
 def test_bench_watch_full_outage_spans_horizon(tmp_path):
-    """--watch with the tunnel dead for the whole horizon: the record
-    must show probes continuing past the init budget and name the spent
-    horizon (VERDICT r4 #2: a full-outage run leaves an artifact whose
-    init_log spans the horizon)."""
+    """Watch mode (the DEFAULT since r6 — deliberately NOT opted into
+    here) with the tunnel dead for the whole horizon: the record must
+    show probes continuing past the init budget and name the spent
+    horizon (VERDICT r4 #2 / r5 #2)."""
     partial = str(tmp_path / "partial.json")
     gate = str(tmp_path / "never_created")
     env = dict(
@@ -158,11 +170,11 @@ def test_bench_watch_full_outage_spans_horizon(tmp_path):
         BENCH_ATOMS="2000", BENCH_FRAMES="96", BENCH_BATCH="32",
         BENCH_REPEATS="1", BENCH_SERIAL_FRAMES="8", BENCH_SOURCE="file",
         BENCH_PARTIAL_PATH=partial,
-        BENCH_WATCH="1",
         BENCH_INIT_BUDGET="1", BENCH_PROBE_SLEEP="1",
         BENCH_PROBE_TIMEOUT="30",
         BENCH_WATCH_HORIZON="40", BENCH_WATCH_SLEEP="2",
     )
+    env.pop("BENCH_WATCH", None)          # prove watch needs no opt-in
     try:
         proc = subprocess.run([sys.executable,
                                os.path.join(REPO, "bench.py")],
@@ -306,3 +318,50 @@ def test_suite_host_only_records_serial_rows(tmp_path):
     # config7 carries BOTH families' serial legs (GNM too)
     assert by_cfg[7]["gnm_serial_fps"] > 0
     assert by_cfg[7]["gnm_fps"] is None
+
+
+@pytest.mark.slow
+def test_bench_watch_derived_horizon(tmp_path):
+    """The r6 DEFAULT watch path: no BENCH_WATCH_HORIZON in the env, so
+    the horizon derives from BENCH_TOTAL_TIMEOUT minus the init budget
+    minus the measured-phase reserve (bench._watch_horizon) and the
+    total watchdog is NOT inflated.  A full outage must keep probing
+    into that derived window and then exhaust with the horizon named —
+    inside the total budget, well before this test's own timeout."""
+    partial = str(tmp_path / "partial.json")
+    gate = str(tmp_path / "never_created")
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        BENCH_PROBE_GATE=gate,            # never created -> dead tunnel
+        BENCH_ATOMS="2000", BENCH_FRAMES="96", BENCH_BATCH="32",
+        BENCH_REPEATS="1", BENCH_SERIAL_FRAMES="8", BENCH_SOURCE="file",
+        BENCH_PARTIAL_PATH=partial,
+        BENCH_INIT_BUDGET="1", BENCH_PROBE_SLEEP="1",
+        BENCH_PROBE_TIMEOUT="30", BENCH_WATCH_SLEEP="2",
+        # derived horizon = 640 - 1 - 600 = 39 s of watch probing
+        BENCH_TOTAL_TIMEOUT="640",
+    )
+    env.pop("BENCH_WATCH", None)
+    env.pop("BENCH_WATCH_HORIZON", None)
+    try:
+        proc = subprocess.run([sys.executable,
+                               os.path.join(REPO, "bench.py")],
+                              env=env, capture_output=True, text=True,
+                              timeout=600)
+        assert proc.returncode == 1, proc.stderr[-3000:]
+        rec = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert rec["value"] is None
+        # the derived horizon was engaged and named at exhaustion
+        assert "watch horizon 39s spent" in rec["error"]
+        # probing continued past the 1 s init budget into the window
+        assert len(rec["init_log"]) >= 3
+        assert rec["init_log"][-1]["t_s"] > 4
+        # ...but never past the un-inflated total budget
+        assert rec["init_log"][-1]["t_s"] < 640
+    finally:
+        import glob
+
+        for p in glob.glob(os.path.join(REPO, ".bench_data",
+                                        "flagship_2000a_96f_*")):
+            os.remove(p)
