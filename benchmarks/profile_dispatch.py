@@ -1,0 +1,136 @@
+"""Sweep the scan-folded dispatch group size (``scan_k``) on the
+steady-state flagship — the measurement behind docs/DISPATCH.md and
+PERF.md §11.
+
+For each K the protocol matches the bench's steady leg exactly: one
+populating run fills a FRESH DeviceBlockCache with K-grouped stacked
+superblocks, then ``PROFILE_DISPATCH_REPEATS`` timed HBM-resident runs.
+Every K is PARITY-GATED against the serial f64 oracle over a short
+window before its speed is recorded (a wrong-but-fast scan must not
+score — the same hard-fail contract as bench.py's divergence gate), and
+each row carries ``dispatch_count`` / ``ms_per_dispatch`` so the
+dispatch-amortization claim is attributable from the JSON alone.
+
+Prints one JSON line per K plus a final summary object naming the knee.
+Scales down for CPU smoke runs via PROFILE_DISPATCH_FRAMES/_ATOMS
+(tests/test_bench_contract.py pins the row schema at toy scale).
+
+Usage: python benchmarks/profile_dispatch.py            (real chip)
+       PROFILE_DISPATCH_KS=1,2,4,8,auto python benchmarks/profile_dispatch.py
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402  (repo-root bench.py: fixture + topology)
+from mdanalysis_mpi_tpu.analysis import AlignedRMSF  # noqa: E402
+from mdanalysis_mpi_tpu.utils.timers import TIMERS  # noqa: E402
+
+
+def main():
+    n_frames = int(os.environ.get("PROFILE_DISPATCH_FRAMES",
+                                  bench.N_FRAMES))
+    batch = int(os.environ.get("PROFILE_DISPATCH_BATCH", bench.BATCH))
+    repeats = int(os.environ.get("PROFILE_DISPATCH_REPEATS", 5))
+    oracle_frames = int(os.environ.get("PROFILE_DISPATCH_ORACLE_FRAMES",
+                                       min(n_frames, 2 * batch)))
+    tdtype = os.environ.get("BENCH_TRANSFER", "int16")
+    ks = [k.strip() for k in os.environ.get(
+        "PROFILE_DISPATCH_KS", "1,2,4,8,auto").split(",") if k.strip()]
+    u = bench.open_flagship(bench.N_ATOMS, bench.N_FRAMES)
+
+    import jax
+
+    from mdanalysis_mpi_tpu.parallel.executors import DeviceBlockCache
+
+    backend = "jax" if len(jax.devices()) == 1 else "mesh"
+    # serial f64 oracle over the gate window, BEFORE any device work
+    # (quiet host — the bench's measurement-order discipline)
+    s = AlignedRMSF(u, select=bench.SELECT).run(
+        stop=oracle_frames, backend="serial")
+    oracle = np.asarray(s.results.rmsf)
+
+    rows = []
+    for k in ks:
+        scan_k = k if k == "auto" else int(k)
+        # parity gate: same staging dtype + scan grouping as the timed
+        # runs, short window, fresh cache — populate then a cached
+        # (scan-hit) re-run, BOTH compared to the oracle
+        gate_cache = DeviceBlockCache(max_bytes=8 << 30)
+        errs = []
+        for _ in range(2):
+            rg = AlignedRMSF(u, select=bench.SELECT).run(
+                stop=oracle_frames, backend=backend, batch_size=batch,
+                transfer_dtype=tdtype, block_cache=gate_cache,
+                scan_k=scan_k)
+            errs.append(float(np.abs(
+                np.asarray(rg.results.rmsf) - oracle).max()))
+        gate_cache.drop()
+        divergence = max(errs)
+        # "not (err <= tol)": NaN must fail, not sail through
+        gate_ok = bool(divergence <= 1e-3)
+        row = {"scan_k_requested": k, "divergence": divergence,
+               "parity": "PASS" if gate_ok else "FAIL",
+               "batch": batch, "transfer_dtype": tdtype,
+               "platform": jax.default_backend()}
+        if not gate_ok:
+            row["value"] = None
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+            continue
+
+        dev_cache = DeviceBlockCache(max_bytes=8 << 30)
+        bench.clear_host_caches(u)
+        r = AlignedRMSF(u, select=bench.SELECT).run(   # populate
+            stop=n_frames, backend=backend, batch_size=batch,
+            transfer_dtype=tdtype, block_cache=dev_cache, scan_k=scan_k)
+        jax.block_until_ready(r.results["rmsf"])
+        # one warm cached run: the scan programs compile on their first
+        # HIT (the populate run's pass 1 dispatches per block), and a
+        # compile inside the timed loop would poison the median
+        r = AlignedRMSF(u, select=bench.SELECT).run(
+            stop=n_frames, backend=backend, batch_size=batch,
+            transfer_dtype=tdtype, block_cache=dev_cache, scan_k=scan_k)
+        jax.block_until_ready(r.results["rmsf"])
+        walls = []
+        dc0, ds0 = TIMERS.calls("dispatch"), TIMERS.seconds("dispatch")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            r = AlignedRMSF(u, select=bench.SELECT).run(
+                stop=n_frames, backend=backend, batch_size=batch,
+                transfer_dtype=tdtype, block_cache=dev_cache,
+                scan_k=scan_k)
+            jax.block_until_ready(r.results["rmsf"])
+            walls.append(time.perf_counter() - t0)
+        # release this K's superblocks AND their host mirrors before
+        # the next K re-stages (fast-page window, PERF.md §9b/§9d)
+        dev_cache.drop()
+        row.update({
+            "value": round(n_frames / float(np.median(walls)), 2),
+            "unit": "frames/s/chip (steady, HBM-resident)",
+            # the one telemetry definition bench.py's legs also use,
+            # so the committed sweep and BENCH_* artifacts can't drift
+            **bench.dispatch_stats(dc0, ds0, runs=repeats),
+        })
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    scored = [r for r in rows if r.get("value")]
+    best = max(scored, key=lambda r: r["value"]) if scored else None
+    print(json.dumps({
+        "summary": "scan_k sweep", "n_frames": n_frames, "batch": batch,
+        "rows": len(rows),
+        "best_scan_k": None if best is None else best["scan_k"],
+        "best_value": None if best is None else best["value"],
+        "all_parity_pass": all(r["parity"] == "PASS" for r in rows),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -22,6 +22,7 @@ class BlockCache:
 
     def __init__(self, max_bytes: int):
         self._store: dict = {}
+        self._sizes: dict = {}
         self._bytes = 0
         self._rejected = False
         self.max_bytes = max_bytes
@@ -36,14 +37,24 @@ class BlockCache:
             self.hits += 1
         return value
 
-    def put(self, key, value, nbytes: int) -> None:
-        if self._bytes + nbytes <= self.max_bytes:
+    def put(self, key, value, nbytes: int) -> bool:
+        """Insert; returns whether the entry was stored (callers that
+        must clean up a rejected value — e.g. the scan superblock
+        stacker — branch on this instead of probing the store)."""
+        # overwriting a key credits the replaced entry's bytes back
+        # first — without this, re-staging the same block (e.g. a
+        # resilient run salvaging different bytes) double-counts and
+        # silently flips `full`, demoting every later run to re-staging
+        freed = self._sizes.get(key, 0)
+        if self._bytes - freed + nbytes <= self.max_bytes:
             self._store[key] = value
-            self._bytes += nbytes
-        else:
-            # the cache just refused a block: record it, so `full`
-            # flips even when _bytes never lands exactly on the cap
-            self._rejected = True
+            self._sizes[key] = nbytes
+            self._bytes += nbytes - freed
+            return True
+        # the cache just refused a block: record it, so `full`
+        # flips even when _bytes never lands exactly on the cap
+        self._rejected = True
+        return False
 
     @property
     def full(self) -> bool:
@@ -58,6 +69,7 @@ class BlockCache:
 
     def clear(self) -> None:
         self._store.clear()
+        self._sizes.clear()
         self._bytes = 0
         self._rejected = False
 

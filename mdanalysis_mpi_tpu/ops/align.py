@@ -91,6 +91,46 @@ def superpose_batch(
     return jnp.einsum("bni,bij->bnj", coords - com[:, None, :], R, precision=_HI) + ref_com
 
 
+def aligned_moments_step(carry, sel_block, mask, sel_weights,
+                         ref_sel_centered, ref_com,
+                         rot_weights=None):
+    """Scan step of the flagship pass-2 reduction (carry+step form for
+    the scan-folded dispatch layer, docs/DISPATCH.md): superpose one
+    (B, S, 3) selection block onto the fixed reference, fold its
+    Welford moments into the (T, mean, M2) carry.  The executors build
+    the same program generically from ``_aligned_moments_kernel`` +
+    ``merge_moments``; this op-level form pins the algebra in isolation
+    (tests/test_scan_fold.py)."""
+    from mdanalysis_mpi_tpu.ops.moments import (batch_moments,
+                                                merge_moments)
+
+    aligned = superpose_selection_batch(
+        sel_block, sel_weights, ref_sel_centered, ref_com, rot_weights)
+    return merge_moments(carry, batch_moments(aligned, mask))
+
+
+def scan_aligned_moments(blocks, masks, sel_weights, ref_sel_centered,
+                         ref_com, rot_weights=None):
+    """Aligned moments of a stacked (K, B, S, 3) group in ONE
+    ``lax.scan`` — the whole reference pass-2 loop (RMSF.py:124-138)
+    as a single dispatchable program.  Carry seeds from block 0."""
+    from mdanalysis_mpi_tpu.ops.moments import batch_moments
+
+    first = batch_moments(
+        superpose_selection_batch(blocks[0], sel_weights,
+                                  ref_sel_centered, ref_com,
+                                  rot_weights), masks[0])
+
+    def step(carry, xm):
+        b, m = xm
+        return aligned_moments_step(carry, b, m, sel_weights,
+                                    ref_sel_centered, ref_com,
+                                    rot_weights), None
+
+    acc, _ = jax.lax.scan(step, first, (blocks[1:], masks[1:]))
+    return acc
+
+
 def superpose_selection_batch(
     sel_coords: jax.Array,        # (B, S, 3) selection-only frame batch
     sel_weights: jax.Array,       # (S,) COM weights

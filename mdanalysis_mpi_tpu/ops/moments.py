@@ -97,6 +97,47 @@ def psum_moments(t, mean, m2, axis_name: str):
     return t_tot, mean_tot, m2_tot
 
 
+# ---- scan (carry + step) forms ----------------------------------------
+#
+# The executors' scan-folded dispatch layer (parallel/executors.py,
+# docs/DISPATCH.md) folds K HBM-resident blocks inside ONE jitted
+# ``lax.scan`` instead of K Python-loop dispatches.  These are the
+# moment-op instances of that carry+step contract — the carry is the
+# (T, mean, M2) summary, the step is "batch moments of the next block,
+# Chan-merged into the carry" — exposed here so the algebra is testable
+# against :func:`reduce_moments` independent of the executor machinery.
+
+
+def moments_scan_step(carry, block, mask=None):
+    """One scan step: fold ``block``'s batch moments into ``carry``.
+
+    carry: a (T, mean, M2) summary; block: (B, N, 3); mask: (B,) or
+    None.  Exactly ``merge_moments(carry, batch_moments(block, mask))``
+    — associative with :func:`merge_moments`, so any grouping of blocks
+    into scans yields the same summary (f32 rounding aside, which the
+    parity suites gate)."""
+    return merge_moments(carry, batch_moments(block, mask))
+
+
+def scan_moments(blocks, masks=None):
+    """Moments of a stacked (K, B, N, 3) block group in ONE scan.
+
+    The carry seeds from block 0 (no identity element needed) and scans
+    blocks 1..K-1; equals ``reduce_moments(batch_moments(b) for b in
+    blocks)``.  ``masks``: (K, B) or None."""
+    first = batch_moments(blocks[0], None if masks is None else masks[0])
+
+    def step(carry, xm):
+        b, m = xm
+        return moments_scan_step(carry, b, m), None
+
+    rest = (blocks[1:],
+            jnp.ones(blocks[1:].shape[:2], blocks.dtype)
+            if masks is None else masks[1:])
+    acc, _ = jax.lax.scan(step, first, rest)
+    return acc
+
+
 _RMSF_FIN_JIT = None
 
 

@@ -43,3 +43,26 @@ def rmsd_batch(
         rot = kabsch_rotation_batch(cc, ref_sel_centered, rot_weights)
         cc = jnp.einsum("bni,bij->bnj", cc, rot, precision=_HI)
     return rmsd(cc, ref_sel_centered, rmsd_weights)
+
+
+def scan_rmsd_batch(
+    blocks: jax.Array,            # (K, B, S, 3) stacked block group
+    com_weights: jax.Array,
+    ref_sel_centered: jax.Array,
+    superposition: bool = True,
+    rot_weights: jax.Array | None = None,
+    rmsd_weights: jax.Array | None = None,
+) -> jax.Array:
+    """RMSD series of a stacked K-block group in ONE ``lax.scan``
+    dispatch (the series — emit, not carry — instance of the
+    scan-folded dispatch contract, docs/DISPATCH.md): per-step
+    :func:`rmsd_batch` values come back stacked (K, B) and flatten to
+    the (K·B,) frame order the per-block schedule concatenates to."""
+    def step(carry, block):
+        return carry, rmsd_batch(block, com_weights, ref_sel_centered,
+                                 superposition=superposition,
+                                 rot_weights=rot_weights,
+                                 rmsd_weights=rmsd_weights)
+
+    _, ys = jax.lax.scan(step, 0, blocks)
+    return ys.reshape(-1)

@@ -143,6 +143,232 @@ def _fused_step(kernel, fold):
     return fn
 
 
+#: Resolved ``scan_k`` of the most recent batch run (1 = per-block
+#: schedule).  Telemetry only — bench artifacts disclose it next to
+#: ``dispatch_count`` so the dispatch-batching claim is attributable
+#: from the JSON alone; never part of the results contract.
+LAST_SCAN_K = 1
+
+
+class _ScanCalls:
+    """The three single-dispatch scan programs a backend hands
+    ``_run_batches`` when the scan-folded schedule is active:
+    ``init(*stacked)`` (first group, no running total),
+    ``fused(total, *stacked)`` (later groups, fold into the total),
+    ``series(*stacked)`` (no fold: per-step partials emitted stacked and
+    flattened to frame order).  Reduction analyses set init/fused;
+    time-series analyses set series."""
+
+    __slots__ = ("init", "fused", "series")
+
+    def __init__(self, init=None, fused=None, series=None):
+        self.init = init
+        self.fused = fused
+        self.series = series
+
+
+def _scan_accum(kernel, fold, params, stacked):
+    """The ONE carry+step reduction body every scan program traces —
+    single-device (_scan_fns) and per-shard inside shard_map
+    (MeshExecutor._build_scan) alike, so the two schedules cannot
+    diverge.  The carry seeds from block 0's kernel output (no identity
+    element required of ``fold``); a K=1 group degenerates to a
+    zero-length scan."""
+    import jax
+
+    first = kernel(params, *(x[0] for x in stacked))
+
+    def step(carry, xs):
+        return fold(carry, kernel(params, *xs)), None
+
+    acc, _ = jax.lax.scan(step, first, tuple(x[1:] for x in stacked))
+    return acc
+
+
+def _scan_emit(kernel, params, stacked):
+    """Series twin of :func:`_scan_accum`: per-step partials emitted
+    stacked (K, B, ...) — flatten with :func:`_flatten_block_axis`."""
+    import jax
+
+    def step(carry, xs):
+        return carry, kernel(params, *xs)
+
+    _, ys = jax.lax.scan(step, 0, stacked)
+    return ys
+
+
+def _flatten_block_axis(ys):
+    """(K, B, ...) scan outputs → (K·B, ...): exactly the per-block
+    schedule's concatenation order."""
+    import jax
+
+    return jax.tree.map(
+        lambda y: y.reshape((y.shape[0] * y.shape[1],) + y.shape[2:]),
+        ys)
+
+
+def _make_scan_calls(fns, params) -> "_ScanCalls":
+    """Bind a ``(init, fused, series)`` jitted-program triple to this
+    run's params pytree — the one params-binding contract both
+    executors hand ``_run_batches``."""
+    s_init, s_fused, s_series = fns
+    return _ScanCalls(
+        init=(None if s_init is None
+              else lambda *st: s_init(params, *st)),
+        fused=(None if s_fused is None
+               else lambda tot, *st: s_fused(tot, params, *st)),
+        series=(None if s_series is None
+                else lambda *st: s_series(params, *st)))
+
+
+_SCAN_FN_CACHE: dict = {}
+
+
+def _scan_fns(kernel, fold):
+    """Jitted scan programs over a STACKED K-block group (leading block
+    axis): the dispatch-amortization lever VERDICT r5 #7 named.
+
+    The steady-state flagship is dispatch-bound: ~0.1 ms of fixed
+    per-dispatch latency × one dispatch per block (PERF.md §8d/§9e —
+    batch 512 won the sweep precisely because it meant FEWER
+    dispatches).  When the staged blocks are HBM-resident
+    (DeviceBlockCache), the per-block Python loop is pure overhead:
+    these programs run the SAME per-block kernel inside one
+    ``lax.scan`` over the group's stacked blocks, folding partials in
+    the scan carry, so K blocks cost one host dispatch instead of K.
+
+    Reduction form: the carry seeds from block 0's kernel output (no
+    identity-element requirement on ``fold``) and scans blocks 1..K-1;
+    a K=1 group degenerates to a zero-length scan.  Series form: the
+    carry is unused and per-step partials come back stacked (K, B, ...)
+    then reshape to (K·B, ...) — exactly the concatenation order of the
+    per-block schedule.  Compile cost is O(1) in K (scan is a loop
+    primitive; XLA retraces per distinct K shape, i.e. once plus once
+    for an uneven tail group).  Cache keyed on module-level function
+    identities, same contract as ``_jit_kernel``."""
+    key = (kernel, fold)
+    fns = _SCAN_FN_CACHE.get(key)
+    if fns is None:
+        import jax
+
+        if fold is not None:
+            def init(params, *stacked):
+                return _scan_accum(kernel, fold, params, stacked)
+
+            def fused(total, params, *stacked):
+                return fold(total,
+                            _scan_accum(kernel, fold, params, stacked))
+
+            fns = (jax.jit(_f32_precision(init)),
+                   jax.jit(_f32_precision(fused)), None)
+        else:
+            def series(params, *stacked):
+                return _flatten_block_axis(
+                    _scan_emit(kernel, params, stacked))
+
+            fns = (None, None, jax.jit(_f32_precision(series)))
+        _SCAN_FN_CACHE[key] = fns
+    return fns
+
+
+_STACK_CACHE: dict = {}
+
+
+def _stack_staged(blocks: list[tuple]):
+    """K per-block staged tuples → one stacked tuple with a leading
+    block axis — the HBM superblock the scan programs consume.
+
+    Device leaves stack ON DEVICE in one jitted dispatch (one HBM copy,
+    paid once on the populating pass; re-staging through the host would
+    cost wire bytes instead).  Host-side leaves (quantize scales that
+    ride the dispatch) stack with NumPy.  On the mesh path the inputs
+    carry their NamedShardings and GSPMD propagates the frame-axis
+    sharding to the stacked output (leading block axis unsharded)."""
+    import jax
+    import jax.numpy as jnp
+
+    n = len(blocks[0])
+    k = len(blocks)
+    dev_pos = tuple(i for i in range(n)
+                    if isinstance(blocks[0][i], jax.Array))
+    key = (n, dev_pos, k)
+    fn = _STACK_CACHE.get(key)
+    if fn is None:
+        m = len(dev_pos)
+
+        def stack(*flat):
+            return tuple(jnp.stack(flat[j * k:(j + 1) * k])
+                         for j in range(m))
+
+        fn = jax.jit(stack)
+        _STACK_CACHE[key] = fn
+    flat = [blocks[b][i] for i in dev_pos for b in range(k)]
+    stacked_dev = iter(fn(*flat))
+    return tuple(
+        next(stacked_dev) if i in dev_pos
+        else np.stack([np.asarray(blocks[b][i]) for b in range(k)])
+        for i in range(n))
+
+
+def _delete_staged(staged) -> None:
+    """Release a staged tuple's device buffers NOW (``Array.delete()``),
+    not when the GC gets around to it: on tunneled targets the client
+    pins ~1 host byte per device byte (PERF.md §9d), so silently
+    dropped buffers leak host RSS into the fast-page window §9b
+    diagnosed.  Safe on buffers with in-flight consumers — the runtime
+    holds its own reference until enqueued executions complete."""
+    import jax
+
+    for leaf in jax.tree.leaves(staged):
+        if hasattr(leaf, "delete"):
+            try:
+                leaf.delete()
+            except Exception:       # already deleted / donated
+                pass
+
+
+def _block_nbytes(bs: int, sel_idx, n_atoms: int,
+                  transfer_dtype: str) -> int:
+    """Estimated staged bytes of one (bs, S, 3) block — the auto scan_k
+    policy's unit (boxes/mask/keyframes are noise at these shapes)."""
+    s = n_atoms if sel_idx is None else len(sel_idx)
+    per = {"float32": 4, "int16": 2, "int8": 1, "delta": 1}[transfer_dtype]
+    return bs * s * 3 * per
+
+
+def _resolve_scan_k(setting, cache, n_blocks: int,
+                    block_nbytes: int) -> int:
+    """Effective scan group size for this run.
+
+    ``setting``: the executor's ``scan_k`` (None defers to env
+    ``MDTPU_SCAN_K``, default ``"auto"``).  Auto folds ALL of the run's
+    blocks into one scan group up to an HBM budget; the budget defaults
+    to the cache's own byte cap (the stacked groups ARE the cached
+    entries) and can be pinned via MDTPU_SCAN_HBM_BUDGET.  An explicit
+    integer is clamped to the block count AND the same byte budget —
+    an over-budget group would materialize a stacked superblock the
+    cache then rejects (one transient HBM spike, zero entries cached,
+    every later run re-staging: strictly worse than a smaller K).
+    Either way a DeviceBlockCache is REQUIRED: the scan dispatches only
+    over cached superblocks, so a cacheless run would pay the group
+    bookkeeping forever without a single scan ever firing — and worse,
+    report a scan_k in the telemetry that never describes a real
+    dispatch.  docs/DISPATCH.md discusses when scan_k=1 is right."""
+    if cache is None:
+        return 1
+    if setting is None:
+        setting = _os.environ.get("MDTPU_SCAN_K", "auto")
+    budget = int(_os.environ.get("MDTPU_SCAN_HBM_BUDGET", "0")
+                 or 0) or cache.max_bytes
+    budget_blocks = max(1, budget // max(block_nbytes, 1))
+    if isinstance(setting, str):
+        s = setting.strip().lower()
+        if s in ("auto", ""):
+            return max(1, min(n_blocks, budget_blocks))
+        setting = int(s)
+    return max(1, min(int(setting), max(n_blocks, 1), budget_blocks))
+
+
 def _uniform_stride(frames) -> int | None:
     """The constant positive stride of ``frames``, or None.  Strided
     windows (``run(step=N)``) then ride the readers' bulk ``read_block``
@@ -417,6 +643,20 @@ class DeviceBlockCache(BlockCache):
     def __init__(self, max_bytes: int = 4 << 30):
         super().__init__(max_bytes)
 
+    def put(self, key, value, nbytes: int) -> bool:
+        """Insert, explicitly ``Array.delete()``-ing any entry this
+        overwrites: a silently dropped device buffer keeps its ~1:1
+        host-side client mirror pinned (PERF.md §9d), leaking host RSS
+        into the fast-page window §9b diagnosed.  (The base policy
+        never evicts, so overwrite — same key restaged, e.g. after a
+        resilient run salvages different bytes — is the only way an
+        entry leaves the store outside :meth:`drop`.)"""
+        old = self._store.get(key)
+        stored = super().put(key, value, nbytes)
+        if stored and old is not None:
+            _delete_staged(old)
+        return stored
+
     def drop(self) -> None:
         """Release every cached device buffer NOW (``Array.delete()``),
         not when the GC gets around to it.  On tunneled targets the
@@ -427,15 +667,8 @@ class DeviceBlockCache(BlockCache):
         fresh allocation in the NEXT run pays 15-35× page-supply
         penalties.  Benchmarks re-running cold legs must drop the
         previous attempt's cache first."""
-        import jax
-
         for staged in self._store.values():
-            for leaf in jax.tree.leaves(staged):
-                if hasattr(leaf, "delete"):
-                    try:
-                        leaf.delete()
-                    except Exception:   # already deleted / donated
-                        pass
+            _delete_staged(staged)
         self.clear()
 
 
@@ -504,8 +737,20 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
                  quantize: bool = False, local_divisor: int = 1,
                  local_index: int = 0, inv_per_frame: bool = False,
                  prestage: bool = False, fused_call=None,
-                 delta_anchors: int = 1, reliability=None):
+                 delta_anchors: int = 1, reliability=None,
+                 scan_k: int = 1, scan_calls: "_ScanCalls | None" = None):
     """Shared batch loop: stage → kernel → DEVICE-side accumulation.
+
+    ``scan_k > 1`` (with ``scan_calls``) activates the SCAN-FOLDED
+    schedule: blocks are grouped into runs of ``scan_k``, a group whose
+    stacked superblock is HBM-resident (DeviceBlockCache hit) costs ONE
+    jitted ``lax.scan`` dispatch instead of K per-block dispatches
+    (partials fold on-device in the scan carry — the dispatch-overhead
+    amortization of VERDICT r5 #7), and a miss group runs the per-block
+    schedule then stacks its blocks on device into the cache entry the
+    next run's scan consumes.  ``scan_k=1`` is byte-for-byte today's
+    per-block schedule — same staging calls, same cache keys, same
+    jitted programs.
 
     ``prestage=True`` switches the schedule from interleaved
     (stage batch i+1 while the device consumes batch i) to CHUNKED
@@ -548,6 +793,10 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
     total = None
     parts_list = []
     bounds = list(iter_batches(0, len(frames), bs))
+    global LAST_SCAN_K
+    scan_active = (scan_k > 1 and scan_calls is not None
+                   and len(bounds) > 1)
+    LAST_SCAN_K = scan_k if scan_active else 1
     # reliability runtime (reliability/policy.ReliabilityRuntime), duck-
     # called so this module never imports the policy layer: rt.op wraps
     # failure-prone ops in retry/backoff/deadline, rt.salvage_block
@@ -656,7 +905,10 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
         return staged, -1 if n_dropped else padded.nbytes
 
     def _place(staged, key, nbytes):
-        """Device side: transfer a host-staged tuple and cache it."""
+        """Device side: transfer a host-staged tuple and cache it
+        (``key=None`` — the scan-folded schedule's per-block transfers
+        — skips the cache: the group's STACKED superblock is the entry,
+        written by _note_block_done when the group completes)."""
 
         def _put():
             if _faults.plans():
@@ -665,7 +917,7 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
                     else staged)
 
         staged = _put() if rt is None else rt.op("put", _put)
-        if cache is not None and nbytes >= 0:
+        if cache is not None and key is not None and nbytes >= 0:
             # charge this process's resident share of the cached entry:
             # the host block nbytes IS the per-host charge (on
             # multi-host the staged slice is already 1/local_divisor of
@@ -679,15 +931,17 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
         the device transfer.  Runs on the prefetch thread so the next
         batch stages while the device consumes the current one (the
         double-buffering from SURVEY.md §7 layer 5; NumPy releases the
-        GIL for the big copies)."""
+        GIL for the big copies).  Returns (staged, nbytes); nbytes is 0
+        for a cache hit (nothing new resident)."""
         a, b = ab
-        key = _key(ab)
-        staged = cache.get(key) if cache is not None else None
-        if staged is not None:
-            return staged
+        key = None if scan_active else _key(ab)
+        if key is not None and cache is not None:
+            staged = cache.get(key)
+            if staged is not None:
+                return staged, 0
         with TIMERS.phase("stage"):
             staged, nbytes = _stage_op(frames[a:b])
-        return _place(staged, key, nbytes)
+        return _place(staged, key, nbytes), nbytes
 
     def _stage_op(batch_frames):
         """_host_stage under the reliability retry/deadline envelope."""
@@ -715,6 +969,90 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
             else:
                 total = out
 
+    # ---- scan-folded dispatch bookkeeping (scan_active only) ----
+    #
+    # Blocks are grouped into runs of `scan_k` consecutive bounds (the
+    # last group is the K ∤ n_blocks uneven tail, its own smaller scan
+    # shape).  Groups are consumed strictly in schedule order — series
+    # partials must concatenate in frame order and the fold order stays
+    # deterministic — by flushing pending HIT groups before each miss
+    # block and once more after the loop.
+    if scan_active:
+        groups = [list(range(lo, min(lo + scan_k, len(bounds))))
+                  for lo in range(0, len(bounds), scan_k)]
+
+        def _group_key(g):
+            a, b = bounds[g[0]][0], bounds[g[-1]][1]
+            # same namespace fields as the per-block key, plus the
+            # group length: a scan superblock must never be served to a
+            # differently-grouped schedule (or to the per-block one)
+            return (reader_fp, tuple(frames[a:b]), bs, quantize, sel_fp,
+                    xform_fp, delta_anchors, validate, "scan", len(g))
+
+        group_keys = [_group_key(g) for g in groups]
+        group_hits = [cache.get(k) if cache is not None else None
+                      for k in group_keys]
+        block_group = {bi: gi for gi, g in enumerate(groups) for bi in g}
+        miss_blocks = [bi for gi, g in enumerate(groups)
+                       if group_hits[gi] is None for bi in g]
+        pending: dict[int, list] = {}
+        next_group = 0
+
+        def consume_scan(stacked):
+            """ONE dispatch for a whole HBM-resident K-block group."""
+            nonlocal total
+            with TIMERS.phase("dispatch"):
+
+                def _dispatch():
+                    if _faults.plans():
+                        _faults.fire("kernel")
+                    if fold_j is None:
+                        return scan_calls.series(*stacked)
+                    if total is None:
+                        return scan_calls.init(*stacked)
+                    return scan_calls.fused(total, *stacked)
+
+                out = (_dispatch() if rt is None
+                       else rt.op("kernel", _dispatch))
+                if fold_j is None:
+                    parts_list.append(out)
+                else:
+                    total = out
+
+        def _flush_hits_before(gi_limit):
+            """Consume, in order, every not-yet-consumed HIT group that
+            precedes ``gi_limit`` (miss groups advance the cursor in
+            _note_block_done, so everything walked here is a hit)."""
+            nonlocal next_group
+            while next_group < gi_limit:
+                consume_scan(group_hits[next_group])
+                next_group += 1
+
+        def _note_block_done(bi, staged, nbytes):
+            """Per-block-consumed miss bookkeeping: when the group
+            completes, stack its blocks on device into the cache
+            superblock (what the next run's scan dispatches over) and
+            explicitly release the per-block device buffers — silently
+            dropped buffers keep their host-side client mirrors pinned
+            (PERF.md §9d)."""
+            nonlocal next_group
+            gi = block_group[bi]
+            pending.setdefault(gi, []).append((staged, nbytes))
+            if bi != groups[gi][-1]:
+                return
+            blocks = pending.pop(gi)
+            next_group = gi + 1
+            # nbytes < 0 marks a salvage-shortened block: uncacheable,
+            # same rule as the per-block schedule
+            if (cache is not None and not cache.full
+                    and all(nb >= 0 for _, nb in blocks)):
+                stacked = _stack_staged([s for s, _ in blocks])
+                if not cache.put(group_keys[gi], stacked,
+                                 sum(nb for _, nb in blocks)):
+                    _delete_staged(stacked)   # rejected: don't leak HBM
+            for s, _ in blocks:
+                _delete_staged(s)
+
     if prestage:
         # CHUNKED decode-then-wire (two measured constraints):
         #
@@ -735,11 +1073,17 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
         window = max(1, int(_os.environ.get("MDTPU_WIRE_WINDOW", "4")))
         chunk = max(window,
                     int(_os.environ.get("MDTPU_PRESTAGE_CHUNK", "6")))
-        for clo in range(0, len(bounds), chunk):
+        # scan-folded runs stage only the blocks of MISS groups (hit
+        # groups are whole HBM-resident superblocks: nothing to decode
+        # or wire, one scan dispatch each, interleaved in order below)
+        seq = miss_blocks if scan_active else list(range(len(bounds)))
+        for clo in range(0, len(seq), chunk):
             items: list = []
-            for ab in bounds[clo:clo + chunk]:
-                key = _key(ab)
-                hit = cache.get(key) if cache is not None else None
+            for bi in seq[clo:clo + chunk]:
+                ab = bounds[bi]
+                key = None if scan_active else _key(ab)
+                hit = (cache.get(key)
+                       if key is not None and cache is not None else None)
                 if hit is not None:
                     items.append((None, hit, key, 0))
                     continue
@@ -757,26 +1101,53 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
                         with TIMERS.phase("wire"):
                             staged = _place(staged_host, key, nbytes)
                         last_placed = staged
-                    placed[nxt] = staged
+                    placed[nxt] = (staged, nbytes)
                     items[nxt] = None
                     nxt += 1
-                consume(placed.pop(i))
-            if last_placed is not None and clo + chunk < len(bounds):
+                staged, nbytes = placed.pop(i)
+                bi = seq[clo + i]
+                if scan_active:
+                    _flush_hits_before(block_group[bi])
+                consume(staged)
+                if scan_active:
+                    _note_block_done(bi, staged, nbytes)
+            if last_placed is not None and clo + chunk < len(seq):
                 # chunk barrier: drain in-flight transfers before the
                 # next chunk's decode starts (constraint 1) and let the
                 # chunk's host blocks free (constraint 2)
                 import jax
 
                 with TIMERS.phase("wire"):
-                    jax.block_until_ready(last_placed)
+                    if scan_active:
+                        # the chunk's per-block buffers may already be
+                        # Array.delete()d (a scan group completed on
+                        # the chunk's last block) — block on the latest
+                        # consume output instead, which transitively
+                        # drains every transfer that fed it
+                        tgt = (total if fold_j is not None
+                               else (parts_list[-1] if parts_list
+                                     else None))
+                        if tgt is not None:
+                            jax.block_until_ready(tgt)
+                    else:
+                        jax.block_until_ready(last_placed)
+        if scan_active:
+            _flush_hits_before(len(groups))
     else:
+        seq = miss_blocks if scan_active else list(range(len(bounds)))
         with _staging_pool() as pool:
-            fut = pool.submit(prepare, bounds[0]) if bounds else None
-            for i in range(len(bounds)):
-                staged = fut.result()
-                if i + 1 < len(bounds):
-                    fut = pool.submit(prepare, bounds[i + 1])
+            fut = pool.submit(prepare, bounds[seq[0]]) if seq else None
+            for j, bi in enumerate(seq):
+                staged, nbytes = fut.result()
+                if j + 1 < len(seq):
+                    fut = pool.submit(prepare, bounds[seq[j + 1]])
+                if scan_active:
+                    _flush_hits_before(block_group[bi])
                 consume(staged)
+                if scan_active:
+                    _note_block_done(bi, staged, nbytes)
+        if scan_active:
+            _flush_hits_before(len(groups))
     if fold is not None:
         if fold_j is not None and total is not None:
             import jax
@@ -849,7 +1220,8 @@ class JaxExecutor:
     def __init__(self, batch_size: int = 128, device=None,
                  block_cache: DeviceBlockCache | None = None,
                  transfer_dtype: str = "float32",
-                 prestage: bool = False, reliability=None):
+                 prestage: bool = False, reliability=None,
+                 scan_k: "int | str | None" = None):
         _validate_transfer_dtype(transfer_dtype)
         self.batch_size = batch_size
         self.device = device
@@ -858,6 +1230,9 @@ class JaxExecutor:
         # decode-then-wire cold schedule (see _run_batches); holds the
         # staged trajectory in host RAM for the length of the run
         self.prestage = prestage
+        # scan-folded dispatch group size: int, "auto" or None (None
+        # defers to env MDTPU_SCAN_K, default auto — docs/DISPATCH.md)
+        self.scan_k = scan_k
         if reliability is not None:
             self.reliability = reliability
 
@@ -889,6 +1264,15 @@ class JaxExecutor:
         step = _fused_step(wrapped, fold) if fold is not None else None
         frames = list(frames)
 
+        scan_k = _resolve_scan_k(
+            self.scan_k, self.block_cache,
+            -(-len(frames) // bs) if frames else 0,
+            _block_nbytes(bs, sel_idx, reader.n_atoms,
+                          self.transfer_dtype))
+        scan_calls = (None if scan_k <= 1
+                      else _make_scan_calls(_scan_fns(wrapped, fold),
+                                            params))
+
         def put(staged):
             return _put_staged(staged, (self.device,) * 4)
 
@@ -897,6 +1281,7 @@ class JaxExecutor:
             lambda *staged: kernel(params, *staged), sel_idx,
             device_put_fn=put, cache=self.block_cache, quantize=quantize,
             prestage=self.prestage, reliability=self.reliability,
+            scan_k=scan_k, scan_calls=scan_calls,
             fused_call=(None if step is None else
                         lambda total, *staged: step(total, params,
                                                     *staged)))
@@ -921,7 +1306,8 @@ class MeshExecutor:
                  axis_name: str = "data",
                  block_cache: DeviceBlockCache | None = None,
                  transfer_dtype: str = "float32",
-                 prestage: bool = False, reliability=None):
+                 prestage: bool = False, reliability=None,
+                 scan_k: "int | str | None" = None):
         _validate_transfer_dtype(transfer_dtype)
         self.batch_size = batch_size
         self.devices = devices
@@ -930,6 +1316,9 @@ class MeshExecutor:
         self.transfer_dtype = transfer_dtype
         # decode-then-wire cold schedule (see _run_batches)
         self.prestage = prestage
+        # scan-folded dispatch group size (docs/DISPATCH.md); the scan
+        # wraps INSIDE shard_map so the psum merge runs once per scan
+        self.scan_k = scan_k
         if reliability is not None:
             self.reliability = reliability
 
@@ -1058,6 +1447,96 @@ class MeshExecutor:
         _MESH_CACHE[key] = result
         return result
 
+    def _build_scan(self, analysis, qn_fn=None):
+        """Scan-group programs for the single-controller mesh path.
+
+        The per-shard ``lax.scan`` accumulates LOCAL partials across
+        the group's K blocks (fold is associative/commutative — the
+        same algebra that lets the reference merge per-rank summaries
+        in any order, RMSF.py:36-41) and the ``_device_combine`` psum
+        merge runs ONCE per scan, not once per block: a K-group costs
+        K× fewer ICI collectives and K× fewer host dispatches.  Series
+        analyses scan with stacked per-step outputs (frame axis stays
+        sharded through ``out_specs=P(None, axis)``) and flatten to
+        frame order inside the same jit.  Cached in _MESH_CACHE under
+        the same module-level-identity contract as ``_build``."""
+        import jax
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        shard_map = _shard_map()
+        devices = (self.devices if self.devices is not None
+                   else jax.devices())
+        delta = self.transfer_dtype == "delta"
+        quantize = _quant_mode(self.transfer_dtype) is not None
+        if qn_fn is not None:
+            f = qn_fn
+        else:
+            f = analysis._batch_fn()
+            if delta:
+                f = _delta_wrapper(f)
+            elif quantize:
+                f = _dequant_wrapper(f)
+        devcombine = analysis._device_combine
+        fold = analysis._device_fold_fn
+        key = (f, devcombine, fold, tuple(devices), self.axis_name,
+               "scan")
+        cached = _MESH_CACHE.get(key)
+        if cached is None:
+            mesh = Mesh(np.asarray(devices), (self.axis_name,))
+            kernel = _f32_precision(f)
+            axis = self.axis_name
+            if delta:
+                # stacked (res, key, inv_abs, inv_res, boxes, mask):
+                # every element gains a leading UNSHARDED block axis;
+                # frames/anchors stay sharded on their own axis
+                staged_specs = (P(None, axis),) * 6
+            elif quantize:
+                # stacked (q, inv, boxes, mask): the (K,) per-block
+                # scale array is replicated (single-controller — the
+                # scan path never runs multi-host, see execute)
+                staged_specs = (P(None, axis), P(), P(None, axis),
+                                P(None, axis))
+            else:
+                staged_specs = (P(None, axis),) * 3
+
+            if fold is not None:
+                # per-shard body is the SHARED _scan_accum — local
+                # partials across the group, then ONE devcombine psum
+                def shard_init(params, *stacked):
+                    return devcombine(
+                        _scan_accum(kernel, fold, params, stacked), axis)
+
+                def shard_fused(total, params, *stacked):
+                    return fold(total, devcombine(
+                        _scan_accum(kernel, fold, params, stacked),
+                        axis))
+
+                cached = (
+                    jax.jit(shard_map(shard_init, mesh=mesh,
+                                      in_specs=(P(),) + staged_specs,
+                                      out_specs=P())),
+                    jax.jit(shard_map(shard_fused, mesh=mesh,
+                                      in_specs=(P(), P()) + staged_specs,
+                                      out_specs=P())),
+                    None)
+            else:
+                def shard_series(params, *stacked):
+                    return _scan_emit(kernel, params, stacked)
+
+                inner = shard_map(shard_series, mesh=mesh,
+                                  in_specs=(P(),) + staged_specs,
+                                  out_specs=P(None, axis))
+
+                def series_fn(params, *stacked):
+                    # (K, B_global, ...) → (K·B_global, ...): the
+                    # per-block concatenation order, inside the jit
+                    return _flatten_block_axis(inner(params, *stacked))
+
+                cached = (None, None, jax.jit(series_fn))
+            _MESH_CACHE[key] = cached
+        s_init, s_fused, s_series = cached
+        return s_init, s_fused, s_series
+
     def execute(self, analysis, reader, frames, batch_size=None):
         import jax
 
@@ -1126,6 +1605,29 @@ class MeshExecutor:
         def put(staged):
             return _put_staged(staged, shardings)
 
+        # scan-folded dispatch (single-controller only: the multi-host
+        # path above assembles global arrays per block and keeps the
+        # per-block schedule).  Eligible when frames are mesh-sharded
+        # (no ring/custom specs) and the analysis is EITHER a reduction
+        # with both fold and psum merge (the scan carries local
+        # partials, one psum per group) OR a pure series (stacked
+        # per-step outputs); mixed declarations keep scan_k=1.
+        fold = analysis._device_fold_fn
+        devcombine = analysis._device_combine
+        scan_k = 1
+        scan_calls = None
+        if params_specs is None and (fold is None) == (devcombine is None):
+            scan_k = _resolve_scan_k(
+                self.scan_k, self.block_cache,
+                -(-len(frames) // global_bs) if frames else 0,
+                _block_nbytes(global_bs, sel_idx, reader.n_atoms,
+                              self.transfer_dtype))
+        if scan_k > 1:
+            scan_calls = _make_scan_calls(
+                self._build_scan(analysis,
+                                 qn_fn=qn[0] if qn is not None else None),
+                params)
+
         # With _device_combine, gfn outputs replicated merged partials;
         # without, out_specs=P(axis) concatenates per-device outputs along
         # axis 0 in device (= frame) order — either way one partials
@@ -1137,6 +1639,7 @@ class MeshExecutor:
             quantize=_quant_mode(self.transfer_dtype),
             prestage=self.prestage, fused_call=fused_call,
             reliability=self.reliability,
+            scan_k=scan_k, scan_calls=scan_calls,
             # delta: one absolute anchor per device shard (see _build)
             delta_anchors=(bs_factor if self.transfer_dtype == "delta"
                            else 1))

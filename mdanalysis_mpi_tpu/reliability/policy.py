@@ -491,6 +491,7 @@ def degradation_chain(base, runtime: ReliabilityRuntime):
         chain.append(JaxExecutor(batch_size=base.batch_size,
                                  transfer_dtype=base.transfer_dtype,
                                  prestage=base.prestage,
+                                 scan_k=base.scan_k,
                                  reliability=runtime))
     if not isinstance(base, SerialExecutor):
         chain.append(SerialExecutor(reliability=runtime))

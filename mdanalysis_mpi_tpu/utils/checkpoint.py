@@ -115,6 +115,7 @@ def run_checkpointed(analysis, path: str | None = None,
                      start=None, stop=None, step=None, frames=None,
                      backend: str = "jax", batch_size: int | None = None,
                      checkpoint_dir: str | None = None,
+                     delete_on_success: bool = True,
                      **executor_kwargs):
     """``analysis.run(...)`` with durable progress in ``path``.
 
@@ -125,9 +126,28 @@ def run_checkpointed(analysis, path: str | None = None,
     after a crash (or the driver killing the process) continues where
     it stopped.  ``path=None`` derives a stable per-run default (see
     :func:`checkpoint_path`) — what ``run(resilient=True)`` uses.
-    Deletes the checkpoint on successful completion and returns the
-    analysis (``.results`` populated as usual).
+    Deletes the checkpoint on successful completion
+    (``delete_on_success=False`` keeps it — what a multi-pass
+    orchestrator needs so a crash in a LATER pass resumes an earlier
+    pass from its completed summary instead of recomputing it) and
+    returns the analysis (``.results`` populated as usual).
+
+    Multi-pass analyses (the two-pass flagship ``AlignedRMSF``) declare
+    ``_run_checkpointed_multipass`` and orchestrate their own per-pass
+    checkpoints — each pass is a reduction with mergeable partials
+    (pass-1 coordinate sums, pass-2 moment triples) and its own
+    fingerprinted file, and chunk boundaries compose with scan-folded
+    dispatch (a checkpoint lands between executor calls, never
+    mid-scan).
     """
+    multi = getattr(analysis, "_run_checkpointed_multipass", None)
+    if multi is not None:
+        return multi(path=path, chunk_frames=chunk_frames, start=start,
+                     stop=stop, step=step, frames=frames,
+                     backend=backend, batch_size=batch_size,
+                     checkpoint_dir=checkpoint_dir,
+                     delete_on_success=delete_on_success,
+                     **executor_kwargs)
     fold = analysis._device_fold_fn
     if fold is None:
         raise ValueError(
@@ -192,6 +212,6 @@ def run_checkpointed(analysis, path: str | None = None,
     if total is None:
         total = analysis._identity_partials()
     analysis._conclude(total)
-    if os.path.exists(path):
+    if delete_on_success and os.path.exists(path):
         os.remove(path)
     return analysis

@@ -55,6 +55,13 @@ class PhaseTimers:
     def seconds(self, name: str) -> float:
         return self._acc.get(name, 0.0)
 
+    def calls(self, name: str) -> int:
+        """How many times ``name`` was entered — e.g. ``calls("dispatch")``
+        is the batch-kernel dispatch count, the number the scan-folded
+        schedule exists to shrink (bench artifacts report it per leg as
+        ``dispatch_count``)."""
+        return self._calls.get(name, 0)
+
     def report(self) -> dict:
         """{phase: {"seconds": total, "calls": n}} sorted by cost."""
         return {

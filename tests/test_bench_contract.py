@@ -66,13 +66,28 @@ def test_bench_json_contract(tmp_path):
                     "serial_file_fps", "file_baseline_fps",
                     "cold_vs_file_baseline", "divergence",
                     "put_gbps", "decode_fps", "init_wait_s",
-                    "init_probes", "init_log"):
+                    "init_probes", "init_log",
+                    # r7: dispatch telemetry next to the steady/cold
+                    # legs, so the scan-folded dispatch claim
+                    # (docs/DISPATCH.md) is attributable from the JSON
+                    # alone — same contract as put_gbps/decode_fps
+                    "dispatch_count", "ms_per_dispatch", "scan_k",
+                    "cold_dispatch_count", "cold_ms_per_dispatch"):
             assert key in rec, f"missing {key} in {sorted(rec)}"
         assert rec["accel_leg_order"][0] == "cold"
         assert "f32_steady" in rec["accel_leg_order"]
         assert rec["unit"] == "frames/s/chip"
         assert "file-backed XTC" in rec["metric"]
         assert "steady-state" in rec["metric"]
+        # the active scan_k is disclosed in the metric string and sane
+        assert f"scan_k={rec['scan_k']}" in rec["metric"]
+        assert rec["scan_k"] >= 1
+        assert rec["dispatch_count"] >= 1
+        assert rec["cold_dispatch_count"] >= 1
+        assert rec["ms_per_dispatch"] > 0
+        # every cold attempt carries its own dispatch attribution
+        for att in rec["cold_attempts"]:
+            assert att["dispatch_count"] >= 1 and "scan_k" in att
         assert rec["value"] > 0 and rec["cold_value"] > 0
         assert rec["f32_steady_value"] > 0
         # the f32 control must sit inside the same gate as the headline
@@ -318,6 +333,57 @@ def test_suite_host_only_records_serial_rows(tmp_path):
     # config7 carries BOTH families' serial legs (GNM too)
     assert by_cfg[7]["gnm_serial_fps"] > 0
     assert by_cfg[7]["gnm_fps"] is None
+
+
+@pytest.mark.slow
+def test_profile_dispatch_sweep_schema(tmp_path):
+    """benchmarks/profile_dispatch.py at toy scale on CPU: one row per
+    requested K, parity gated, with dispatch_count shrinking as K grows
+    — the committed-sweep schema PERF.md §11 reads from."""
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        # one device: under the test harness's 8-virtual-device flags
+        # the script would pick the mesh backend, whose global batch at
+        # this toy scale collapses to one block per pass and voids the
+        # dispatch-count arithmetic below
+        XLA_FLAGS="--xla_force_host_platform_device_count=1",
+        BENCH_ATOMS="2000", BENCH_FRAMES="96", BENCH_BATCH="16",
+        BENCH_SOURCE="file",
+        PROFILE_DISPATCH_FRAMES="96", PROFILE_DISPATCH_REPEATS="2",
+        PROFILE_DISPATCH_KS="1,3,auto",
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "benchmarks", "profile_dispatch.py")],
+            env=env, capture_output=True, text=True, timeout=600)
+        assert proc.returncode == 0, proc.stderr[-3000:]
+        lines = [json.loads(ln) for ln in proc.stdout.splitlines()
+                 if ln.startswith("{")]
+        summary = lines[-1]
+        rows = lines[:-1]
+        assert len(rows) == 3
+        by_k = {r["scan_k_requested"]: r for r in rows}
+        for r in rows:
+            assert r["parity"] == "PASS"
+            assert 0 <= r["divergence"] <= 1e-3
+            assert r["value"] > 0
+            assert r["ms_per_dispatch"] > 0
+        # 96 frames / batch 16 = 6 blocks × 2 passes: per-block = 12
+        # dispatches, K=3 → 4, auto (all 6 blocks, one group) → 2
+        assert by_k["1"]["dispatch_count"] == 12
+        assert by_k["3"]["dispatch_count"] == 4
+        assert by_k["auto"]["dispatch_count"] == 2
+        assert by_k["auto"]["scan_k"] == 6
+        assert summary["all_parity_pass"] is True
+        assert summary["best_scan_k"] in (1, 3, 6)
+    finally:
+        import glob
+
+        for p in glob.glob(os.path.join(REPO, ".bench_data",
+                                        "flagship_2000a_96f_*")):
+            os.remove(p)
 
 
 @pytest.mark.slow

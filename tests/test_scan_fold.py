@@ -1,0 +1,459 @@
+"""Scan-folded dispatch (docs/DISPATCH.md): K HBM-resident blocks per
+jitted ``lax.scan`` call instead of K Python-loop dispatches.
+
+Pinned here: parity of the scan schedule against the per-block schedule
+and the serial f64 oracle (jax + mesh, reduction + series, every
+staging dtype), the K ∤ n_blocks uneven tail, the bit-identical
+``scan_k=1`` degeneration, 8-device mesh agreement with ONE psum merge
+per scan, checkpoint-resume composition (a checkpoint lands between
+scans, never mid-scan), the dispatch-count arithmetic the bench
+telemetry reports, the op-level carry+step forms, and the explicit
+device-buffer release rules (overwritten cache entries and stacked
+per-block buffers must ``Array.delete()``, PERF.md §9d).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import mdanalysis_mpi_tpu.parallel.executors as ex
+from mdanalysis_mpi_tpu.analysis import AlignedRMSF, RMSD, RMSF, InterRDF
+from mdanalysis_mpi_tpu.parallel.executors import (
+    DeviceBlockCache, JaxExecutor, MeshExecutor, _resolve_scan_k,
+)
+from mdanalysis_mpi_tpu.testing import (
+    make_md_universe, make_protein_universe, make_water_universe,
+)
+from mdanalysis_mpi_tpu.utils.timers import TIMERS
+
+
+def _rmsf_err(r, oracle):
+    return float(np.abs(np.asarray(r.results.rmsf)
+                        - np.asarray(oracle.results.rmsf)).max())
+
+
+# ---- resolution policy ----
+
+def test_resolve_scan_k_policy(monkeypatch):
+    monkeypatch.delenv("MDTPU_SCAN_K", raising=False)
+    monkeypatch.delenv("MDTPU_SCAN_HBM_BUDGET", raising=False)
+    cache = DeviceBlockCache(max_bytes=100)
+    # no cache → no scan, explicit or auto: the scan dispatches only
+    # over cached superblocks, so a cacheless K would just be wrong
+    # telemetry plus bookkeeping (code-review finding)
+    assert _resolve_scan_k(None, None, 10, 10) == 1
+    assert _resolve_scan_k(4, None, 10, 10) == 1
+    # auto with a cache: all blocks up to the cache's byte budget
+    assert _resolve_scan_k("auto", cache, 10, 10) == 10
+    assert _resolve_scan_k("auto", cache, 10, 30) == 3
+    # explicit K: clamped to n_blocks AND the byte budget (an
+    # over-budget group would stack a superblock the cache rejects —
+    # one HBM spike, nothing cached)
+    assert _resolve_scan_k(4, cache, 10, 10) == 4
+    assert _resolve_scan_k(64, cache, 10, 10) == 10
+    assert _resolve_scan_k(8, cache, 10, 30) == 3
+    assert _resolve_scan_k(0, cache, 10, 10) == 1
+    # env knob (string forms)
+    monkeypatch.setenv("MDTPU_SCAN_K", "3")
+    assert _resolve_scan_k(None, cache, 10, 10) == 3
+    monkeypatch.setenv("MDTPU_SCAN_K", "auto")
+    monkeypatch.setenv("MDTPU_SCAN_HBM_BUDGET", "50")
+    assert _resolve_scan_k(None, cache, 10, 10) == 5
+    # empty schedule
+    assert _resolve_scan_k("auto", cache, 0, 10) == 1
+
+
+# ---- jax executor: reduction parity, tails, dispatch counts ----
+
+@pytest.fixture(scope="module")
+def prot_u():
+    # 52 frames / batch 8 → 7 blocks (last short): with scan_k=4 the
+    # groups are 4 + 3 — K ∤ n_blocks AND a mask-padded final block
+    return make_protein_universe(n_residues=16, n_frames=52, noise=0.2)
+
+
+@pytest.fixture(scope="module")
+def prot_oracle(prot_u):
+    return AlignedRMSF(prot_u, select="name CA").run(backend="serial")
+
+
+def test_scan_parity_and_uneven_tail_jax(prot_u, prot_oracle):
+    cache = DeviceBlockCache()
+    exe = JaxExecutor(batch_size=8, block_cache=cache,
+                      transfer_dtype="int16", scan_k=4)
+    r1 = AlignedRMSF(prot_u, select="name CA").run(backend=exe)
+    assert ex.LAST_SCAN_K == 4
+    # populate pass wrote GROUP entries (4-block and 3-block tail)
+    lens = sorted(key[-1] for key in cache._store)
+    assert lens == [3, 4]
+    r2 = AlignedRMSF(prot_u, select="name CA").run(backend=exe)
+    assert _rmsf_err(r1, prot_oracle) < 1e-3
+    assert _rmsf_err(r2, prot_oracle) < 1e-3
+    # steady parity also vs the populate run (scan-hit vs miss path)
+    assert float(np.abs(np.asarray(r1.results.rmsf)
+                        - np.asarray(r2.results.rmsf)).max()) < 1e-5
+
+
+def test_scan_dispatch_count_shrinks(prot_u, prot_oracle):
+    """The telemetry arithmetic bench.py reports: a steady K-grouped
+    run costs ceil(n_blocks/K) dispatches per pass, not n_blocks."""
+    cache = DeviceBlockCache()
+    exe = JaxExecutor(batch_size=8, block_cache=cache, scan_k=4)
+    AlignedRMSF(prot_u, select="name CA").run(backend=exe)   # populate
+    c0 = TIMERS.calls("dispatch")
+    r = AlignedRMSF(prot_u, select="name CA").run(backend=exe)
+    # 7 blocks → groups of 4+3 → 2 dispatches per pass, 2 passes
+    assert TIMERS.calls("dispatch") - c0 == 4
+    assert _rmsf_err(r, prot_oracle) < 1e-3
+
+
+def test_scan_k1_degenerates_bit_identically(prot_u):
+    """scan_k=1 IS the per-block schedule: same jitted programs, same
+    staging — bitwise-equal results to a run with no cache at all, and
+    the cache holds legacy per-block keys (no scan grouping)."""
+    plain = AlignedRMSF(prot_u, select="name CA").run(
+        backend="jax", batch_size=8, block_cache=None)
+    cache = DeviceBlockCache()
+    k1 = AlignedRMSF(prot_u, select="name CA").run(
+        backend=JaxExecutor(batch_size=8, block_cache=cache, scan_k=1))
+    assert ex.LAST_SCAN_K == 1
+    assert all("scan" not in key for key in cache._store)
+    assert np.array_equal(np.asarray(plain.results.rmsf),
+                          np.asarray(k1.results.rmsf))
+
+
+def test_scan_auto_engages_with_cache(prot_u, prot_oracle, monkeypatch):
+    monkeypatch.delenv("MDTPU_SCAN_K", raising=False)
+    cache = DeviceBlockCache()
+    r = AlignedRMSF(prot_u, select="name CA").run(
+        backend="jax", batch_size=8, block_cache=cache)
+    # tiny blocks, 4 GiB budget → auto folds all 7 blocks into one scan
+    assert ex.LAST_SCAN_K == 7
+    assert _rmsf_err(r, prot_oracle) < 1e-3
+    # env knob overrides auto through the same executor arg default
+    monkeypatch.setenv("MDTPU_SCAN_K", "2")
+    cache2 = DeviceBlockCache()
+    r2 = AlignedRMSF(prot_u, select="name CA").run(
+        backend="jax", batch_size=8, block_cache=cache2)
+    assert ex.LAST_SCAN_K == 2
+    assert _rmsf_err(r2, prot_oracle) < 1e-3
+
+
+def test_scan_series_rmsd_jax(prot_u):
+    ca = prot_u.select_atoms("name CA")
+    s = RMSD(ca).run(backend="serial")
+    cache = DeviceBlockCache()
+    exe = JaxExecutor(batch_size=8, block_cache=cache, scan_k=4)
+    r1 = RMSD(ca).run(backend=exe)
+    r2 = RMSD(ca).run(backend=exe)      # scan-hit path
+    for r in (r1, r2):
+        assert r.results.rmsd.shape == s.results.rmsd.shape
+        assert np.abs(r.results.rmsd - s.results.rmsd).max() < 1e-3
+
+
+def test_scan_delta_staging_jax():
+    # delta's precision envelope needs the correlated MD fixture
+    u = make_md_universe(n_residues=10, n_frames=48, seed=7)
+    s = AlignedRMSF(u, select="name CA").run(backend="serial")
+    cache = DeviceBlockCache()
+    exe = JaxExecutor(batch_size=8, block_cache=cache,
+                      transfer_dtype="delta", scan_k=3)
+    r1 = AlignedRMSF(u, select="name CA").run(backend=exe)
+    r2 = AlignedRMSF(u, select="name CA").run(backend=exe)
+    assert _rmsf_err(r1, s) < 1e-3
+    assert _rmsf_err(r2, s) < 1e-3
+
+
+# ---- mesh: 8-device agreement, one psum per scan ----
+
+def test_scan_mesh_agreement_reduction_and_series():
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    u = make_protein_universe(n_residues=12, n_frames=56, noise=0.2)
+    s = AlignedRMSF(u, select="name CA").run(backend="serial")
+    cache = DeviceBlockCache()
+    m = MeshExecutor(batch_size=2, block_cache=cache,
+                     transfer_dtype="int16", scan_k=3)
+    r1 = AlignedRMSF(u, select="name CA").run(backend=m)
+    # 56 frames / global batch 16 → 4 blocks → scan groups 3 + 1
+    assert sorted(key[-1] for key in cache._store) == [1, 3]
+    r2 = AlignedRMSF(u, select="name CA").run(backend=m)
+    assert _rmsf_err(r1, s) < 1e-3
+    assert _rmsf_err(r2, s) < 1e-3
+
+    ca = u.select_atoms("name CA")
+    sr = RMSD(ca).run(backend="serial")
+    mc = DeviceBlockCache()
+    ms = MeshExecutor(batch_size=2, block_cache=mc, scan_k=2)
+    a1 = RMSD(ca).run(backend=ms)
+    a2 = RMSD(ca).run(backend=ms)
+    for a in (a1, a2):
+        assert np.abs(a.results.rmsd - sr.results.rmsd).max() < 1e-3
+
+
+def test_scan_mesh_one_psum_per_scan():
+    """The mesh scan accumulates LOCAL partials across the group and
+    merges ONCE: the K=4 scan program contains exactly as many psums as
+    the single-block program (the moments merge is 3 psums — not 3·K)."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    u = make_protein_universe(n_residues=8, n_frames=64, noise=0.2)
+    ag = u.select_atoms("name CA")
+    a = RMSF(ag)
+    a.n_frames = 64
+    a._frame_indices = list(range(64))
+    a._prepare()
+    m = MeshExecutor(batch_size=2)
+    s_init, s_fused, s_series = m._build_scan(a)
+    params = a._batch_params()
+    s_atoms = len(ag.indices)
+    blk = lambda k: (np.zeros((k, 16, s_atoms, 3), np.float32),
+                     np.zeros((k, 16, 6), np.float32),
+                     np.ones((k, 16), np.float32))
+    scan_psums = str(jax.make_jaxpr(s_init)(params, *blk(4))).count("psum")
+    _, gfn, _, _, _ = m._build(a)
+    one_block = (np.zeros((16, s_atoms, 3), np.float32),
+                 np.zeros((16, 6), np.float32),
+                 np.ones((16,), np.float32))
+    block_psums = str(jax.make_jaxpr(gfn)(params, *one_block)).count("psum")
+    assert block_psums >= 1
+    assert scan_psums == block_psums
+
+
+def test_scan_mesh_rdf():
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    w = make_water_universe(n_waters=24, n_frames=32)
+    ow = w.select_atoms("name OW")
+    s = InterRDF(ow, ow, nbins=16, range=(0.0, 6.0)).run(backend="serial")
+    cache = DeviceBlockCache()
+    m = MeshExecutor(batch_size=2, block_cache=cache, scan_k=2)
+    g1 = InterRDF(ow, ow, nbins=16, range=(0.0, 6.0)).run(backend=m)
+    g2 = InterRDF(ow, ow, nbins=16, range=(0.0, 6.0)).run(backend=m)
+    for g in (g1, g2):
+        assert np.abs(np.asarray(g.results.rdf)
+                      - s.results.rdf).max() < 1e-3
+
+
+def test_scan_prestage_chunk_barrier(prot_u, prot_oracle, monkeypatch):
+    """Cold prestage run with a scan group completing ON a chunk's last
+    wired block: the chunk barrier must not block on the group's
+    already-released per-block buffers (code-review regression — it
+    used to raise 'Array has been deleted')."""
+    monkeypatch.setenv("MDTPU_PRESTAGE_CHUNK", "2")
+    monkeypatch.setenv("MDTPU_WIRE_WINDOW", "2")
+    cache = DeviceBlockCache()
+    exe = JaxExecutor(batch_size=8, block_cache=cache, scan_k=2,
+                      prestage=True)
+    r1 = AlignedRMSF(prot_u, select="name CA").run(backend=exe)
+    r2 = AlignedRMSF(prot_u, select="name CA").run(backend=exe)
+    assert _rmsf_err(r1, prot_oracle) < 1e-3
+    assert _rmsf_err(r2, prot_oracle) < 1e-3
+
+
+# ---- checkpoint composition ----
+
+def test_checkpoint_resume_composes_with_scan(tmp_path):
+    """Crash mid-run under the scan schedule, resume, match the
+    uninterrupted result: checkpoints land between executor calls so a
+    scan group never spans one."""
+    import mdanalysis_mpi_tpu.utils.checkpoint as ckpt_mod
+    from mdanalysis_mpi_tpu.utils.checkpoint import run_checkpointed
+
+    u = make_protein_universe(n_residues=12, n_frames=48, noise=0.2)
+    ag = u.select_atoms("name CA")
+    straight = RMSF(ag).run(backend="serial")
+
+    cache = DeviceBlockCache()
+    exe = JaxExecutor(batch_size=4, block_cache=cache, scan_k=2)
+    ck = str(tmp_path / "scan.ckpt.npz")
+    real_save = ckpt_mod._save
+    calls = {"n": 0}
+
+    def crashing_save(p, done, partials, fp, dropped=()):
+        real_save(p, done, partials, fp, dropped)
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("simulated crash")
+
+    ckpt_mod._save = crashing_save
+    try:
+        with pytest.raises(RuntimeError):
+            run_checkpointed(RMSF(ag), ck, chunk_frames=16, backend=exe)
+    finally:
+        ckpt_mod._save = real_save
+    assert os.path.exists(ck)
+    a2 = RMSF(ag)
+    run_checkpointed(a2, ck, chunk_frames=16, backend=exe)
+    assert not os.path.exists(ck)
+    assert np.abs(np.asarray(a2.results.rmsf)
+                  - straight.results.rmsf).max() < 1e-3
+
+
+def test_aligned_rmsf_checkpoint_multipass(tmp_path):
+    """The two-pass flagship checkpoints end-to-end (VERDICT r5 #5):
+    crash in pass 1 resumes; crash in pass 2 resumes WITHOUT redoing
+    pass 1 (its completed summary file survives); all files cleaned up
+    on success; scan-folded dispatch active throughout."""
+    import mdanalysis_mpi_tpu.utils.checkpoint as ckpt_mod
+    from mdanalysis_mpi_tpu.utils.checkpoint import run_checkpointed
+
+    u = make_protein_universe(n_residues=12, n_frames=32, noise=0.2)
+    s = AlignedRMSF(u, select="name CA").run(backend="serial")
+    td = str(tmp_path)
+
+    a = AlignedRMSF(u, select="name CA")
+    run_checkpointed(a, chunk_frames=8, backend="jax", batch_size=4,
+                     checkpoint_dir=td, scan_k=2)
+    assert _rmsf_err(a, s) < 1e-3
+    assert not os.listdir(td)           # both passes cleaned up
+
+    real_save = ckpt_mod._save
+    calls = {"n": 0}
+
+    def crash_at(n):
+        def crashing_save(p, done, partials, fp, dropped=()):
+            real_save(p, done, partials, fp, dropped)
+            calls["n"] += 1
+            if calls["n"] == n:
+                raise RuntimeError("simulated crash")
+        return crashing_save
+
+    # crash on the FIRST save (mid-pass-1), then resume
+    calls["n"] = 0
+    ckpt_mod._save = crash_at(1)
+    try:
+        with pytest.raises(RuntimeError):
+            run_checkpointed(AlignedRMSF(u, select="name CA"),
+                             chunk_frames=8, backend="jax",
+                             batch_size=4, checkpoint_dir=td, scan_k=2)
+    finally:
+        ckpt_mod._save = real_save
+    assert len(os.listdir(td)) == 1     # partial pass-1 file
+    a2 = AlignedRMSF(u, select="name CA")
+    run_checkpointed(a2, chunk_frames=8, backend="jax", batch_size=4,
+                     checkpoint_dir=td, scan_k=2)
+    assert _rmsf_err(a2, s) < 1e-3
+    assert not os.listdir(td)
+
+    # crash mid-pass-2 (4 pass-1 chunks, then the 2nd pass-2 save):
+    # the completed pass-1 summary must survive for the resume
+    calls["n"] = 0
+    ckpt_mod._save = crash_at(6)
+    try:
+        with pytest.raises(RuntimeError):
+            run_checkpointed(AlignedRMSF(u, select="name CA"),
+                             chunk_frames=8, backend="jax",
+                             batch_size=4, checkpoint_dir=td, scan_k=2)
+    finally:
+        ckpt_mod._save = real_save
+    assert len(os.listdir(td)) == 2     # completed pass 1 + partial pass 2
+    a3 = AlignedRMSF(u, select="name CA")
+    run_checkpointed(a3, chunk_frames=8, backend="jax", batch_size=4,
+                     checkpoint_dir=td, scan_k=2)
+    assert _rmsf_err(a3, s) < 1e-3
+    assert not os.listdir(td)
+
+
+# ---- buffer release rules (PERF.md §9d) ----
+
+def test_device_cache_overwrite_deletes_old_buffers():
+    import jax.numpy as jnp
+
+    cache = DeviceBlockCache()
+    old = (jnp.zeros(8), jnp.ones(8))
+    cache.put("k", old, 64)
+    new = (jnp.zeros(8), jnp.ones(8))
+    cache.put("k", new, 64)
+    assert all(leaf.is_deleted() for leaf in old)
+    assert not any(leaf.is_deleted() for leaf in new)
+    # overwrite credits the replaced bytes back — no double count, no
+    # silent `full` flip (code-review finding)
+    assert cache._bytes == 64
+    assert not cache.full
+    cache.drop()
+    assert all(leaf.is_deleted() for leaf in new)
+    assert len(cache._store) == 0
+    assert cache._bytes == 0
+
+
+def test_device_cache_overwrite_byte_accounting_near_cap():
+    import jax.numpy as jnp
+
+    cache = DeviceBlockCache(max_bytes=100)
+    a = (jnp.zeros(8),)
+    cache.put("k", a, 60)
+    # an overwrite that fits only AFTER crediting the old entry back
+    b = (jnp.zeros(8),)
+    cache.put("k", b, 80)
+    assert cache._bytes == 80 and not cache.full
+    assert all(leaf.is_deleted() for leaf in a)
+    # a genuinely-too-big overwrite is rejected; the old entry survives
+    c = (jnp.zeros(8),)
+    cache.put("k", c, 200)
+    assert cache.get("k") is b
+    assert not any(leaf.is_deleted() for leaf in b)
+    assert cache.full
+
+
+def test_scan_group_releases_per_block_buffers(prot_u, monkeypatch):
+    """Stacking a miss group must explicitly delete the K per-block
+    staged tuples it consumed (their host-side client mirrors would
+    otherwise stay pinned)."""
+    deleted = []
+    real = ex._delete_staged
+    monkeypatch.setattr(ex, "_delete_staged",
+                        lambda staged: (deleted.append(staged),
+                                        real(staged)))
+    cache = DeviceBlockCache()
+    RMSF(prot_u.select_atoms("name CA")).run(
+        backend=JaxExecutor(batch_size=8, block_cache=cache, scan_k=4))
+    # 7 blocks in 2 groups: every per-block tuple released, none of the
+    # 2 cached stacked superblocks
+    assert len(deleted) == 7
+    assert len(cache._store) == 2
+
+
+# ---- op-level carry+step forms ----
+
+def test_ops_scan_forms_match_sequential():
+    import jax.numpy as jnp
+
+    from mdanalysis_mpi_tpu.ops.align import scan_aligned_moments
+    from mdanalysis_mpi_tpu.ops.moments import (
+        batch_moments, reduce_moments, scan_moments,
+    )
+    from mdanalysis_mpi_tpu.ops.rmsd import rmsd_batch, scan_rmsd_batch
+
+    rng = np.random.default_rng(3)
+    blocks = jnp.asarray(rng.normal(size=(3, 4, 10, 3)), jnp.float32)
+    masks = jnp.asarray(
+        np.array([[1, 1, 1, 1], [1, 1, 1, 1], [1, 1, 0, 0]]), jnp.float32)
+    t, mu, m2 = scan_moments(blocks, masks)
+    rt, rmu, rm2 = reduce_moments(
+        [batch_moments(blocks[i], masks[i]) for i in range(3)])
+    assert float(t) == float(rt) == 10.0
+    np.testing.assert_allclose(np.asarray(mu), np.asarray(rmu),
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(m2), np.asarray(rm2),
+                               atol=1e-5)
+
+    w = jnp.ones(10)
+    ref = blocks[0, 0] - blocks[0, 0].mean(0)
+    com = jnp.zeros(3)
+    t2, _, m2a = scan_aligned_moments(blocks, masks, w, ref, com)
+    assert float(t2) == 10.0
+    assert np.isfinite(np.asarray(m2a)).all()
+
+    vals = scan_rmsd_batch(blocks, w, ref)
+    seq = jnp.concatenate([rmsd_batch(blocks[i], w, ref)
+                           for i in range(3)])
+    np.testing.assert_allclose(np.asarray(vals), np.asarray(seq),
+                               atol=1e-6)
