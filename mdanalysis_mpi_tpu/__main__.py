@@ -3,6 +3,11 @@
 The reference's only invocation is ``mpirun -np N python RMSF.py`` with
 every knob hardcoded (RMSF.py:34,56,63,77); this exposes the same
 pipeline (and the rest of the analyses) as a proper command.
+
+Multi-tenant mode: ``python -m mdanalysis_mpi_tpu batch jobs.json``
+runs a JSON job file through the serving scheduler (request
+coalescing, shared-cache admission, per-job reliability —
+docs/SERVICE.md; dispatched in ``utils/config.main``).
 """
 
 import sys

@@ -33,6 +33,7 @@ from mdanalysis_mpi_tpu.analysis.base import (AnalysisBase,
                                                AnalysisCollection,
                                                Results,
                                                AnalysisFromFunction,
+                                               UncoalescableAnalysisError,
                                                analysis_class)
 from mdanalysis_mpi_tpu.analysis.rms import RMSF, RMSD, AlignedRMSF, rmsd
 from mdanalysis_mpi_tpu.analysis.align import (AverageStructure, AlignTraj,

@@ -432,6 +432,41 @@ def analysis_class(function):
 import functools as _functools
 
 
+def needs_solo_on_batch(analysis) -> bool:
+    """True for analyses that cannot consume a collection's union
+    block on the batch backends: ring (atom-sharded) kernels — custom
+    shard specs — and mesh-only analyses.  THE one definition of
+    batch-path collection ineligibility, shared by
+    :class:`AnalysisCollection`'s own ring-children detection and the
+    serving coalescer (a drifting duplicate would build merged passes
+    that only fail at run time)."""
+    return (getattr(analysis, "_mesh_only", False)
+            or type(analysis)._batch_specs is not AnalysisBase._batch_specs)
+
+
+class UncoalescableAnalysisError(ValueError):
+    """An analysis whose algorithm lives in a ``run()`` override
+    (AlignedRMSF, PCA, AlignTraj, DiffusionMap, ...) cannot be driven
+    through a collection's per-frame/batch hooks — the collection never
+    calls the override, so accepting it would crash deep inside the
+    hooks with no hint of the real incompatibility.
+
+    A TYPED subclass of the historical ``ValueError`` (existing
+    ``except ValueError`` callers keep working) so the serving layer's
+    request coalescer (:mod:`mdanalysis_mpi_tpu.service.coalesce`) can
+    route on it: a job carrying such an analysis is submitted PER-JOB
+    (non-coalesced, its own solo pass) instead of failing the whole
+    merged batch.
+
+    ``analysis`` carries the offending instance, so a coalescer
+    probing a candidate member list can tell WHICH member to split out.
+    """
+
+    def __init__(self, message, analysis=None):
+        super().__init__(message)
+        self.analysis = analysis
+
+
 @_functools.lru_cache(maxsize=None)
 def _collection_kernel_for(fns):
     """One batch kernel running every child kernel on its slice of the
@@ -521,10 +556,14 @@ class AnalysisCollection(AnalysisBase):
             # would crash deep inside hooks with no hint of the real
             # incompatibility
             if type(a).run is not AnalysisBase.run:
-                raise ValueError(
+                raise UncoalescableAnalysisError(
                     f"{type(a).__name__} overrides run() (its "
                     "algorithm or signature lives there) and cannot "
-                    "join a collection; run it separately")
+                    "join a collection; run it separately — in the "
+                    "serving layer, submit it as its own per-job "
+                    "(non-coalesced) request: the scheduler's "
+                    "coalescer routes on this exception and gives it "
+                    "a solo pass", analysis=a)
         super().__init__(analyses[0]._universe, verbose)
         self.analyses = list(analyses)
         # batch-path eligibility is resolved lazily (properties below):
@@ -538,11 +577,9 @@ class AnalysisCollection(AnalysisBase):
         self._combines = tuple(a._device_combine for a in analyses)
         # side-effect-free ring detection: a child that declares custom
         # shard specs (or is mesh-only) cannot consume the collection's
-        # union block
+        # union block (shared predicate: needs_solo_on_batch)
         self._ring_children = [
-            type(a).__name__ for a in analyses
-            if (getattr(a, "_mesh_only", False)
-                or type(a)._batch_specs is not AnalysisBase._batch_specs)]
+            type(a).__name__ for a in analyses if needs_solo_on_batch(a)]
 
     def _mix_error(self):
         red = [type(a).__name__ for a, f in zip(self.analyses, self._folds)

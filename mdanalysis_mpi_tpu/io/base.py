@@ -3,6 +3,7 @@
 from __future__ import annotations
 
 import os
+import threading
 
 import numpy as np
 
@@ -18,6 +19,36 @@ class BlockCache:
     repeated sequential scans — the access pattern of every analysis
     here — keeping the head and re-staging the tail is optimal; FIFO/LRU
     would evict exactly the blocks the next scan needs first.
+
+    Thread safety: every mutation (``put``/``clear``/reservations/
+    pinning/eviction) runs under one re-entrant lock.  A single run
+    never needed it, but the serving layer
+    (:mod:`mdanalysis_mpi_tpu.service`) shares one cache between
+    scheduler workers, and unlocked concurrent ``put`` interleavings
+    corrupt the byte accounting (two threads both read ``_bytes``
+    before either adds) — the thread-safety audit the service PR's
+    stress test pins.  ``get`` takes the lock too: the hit/miss
+    counters feed serving telemetry and lost updates would skew the
+    reported hit rate.
+
+    Multi-tenant hooks (used by the service admission layer; no-ops for
+    solo runs):
+
+    - ``reserve(nbytes)`` / ``release(nbytes)`` — admission control:
+      a scheduler reserves a job's estimated working set before letting
+      it stage into the cache, so concurrently admitted jobs cannot
+      jointly overcommit the budget.  Reservations gate *admission
+      decisions* only — ``put`` keeps its own byte cap check (an
+      estimate is not a contract).
+    - ``pin(ns)`` / ``unpin(ns)`` — mark a key namespace (the leading
+      key element: the executors' cache keys lead with the reader
+      fingerprint) as belonging to a hot tenant.
+    - ``evict_unpinned()`` — drop every entry outside the pinned
+      namespaces, returning the evicted values so device-backed
+      subclasses can release their buffers.  This is the ONLY eviction
+      path: the no-eviction policy above still holds for cache-internal
+      behavior, and only an explicit admission decision ("make room for
+      a queued tenant without touching hot ones") triggers it.
     """
 
     def __init__(self, max_bytes: int):
@@ -25,17 +56,21 @@ class BlockCache:
         self._sizes: dict = {}
         self._bytes = 0
         self._rejected = False
+        self._reserved = 0
+        self._pinned_ns: set = set()
+        self._lock = threading.RLock()
         self.max_bytes = max_bytes
         self.hits = 0
         self.misses = 0
 
     def get(self, key):
-        value = self._store.get(key)
-        if value is None:
-            self.misses += 1
-        else:
-            self.hits += 1
-        return value
+        with self._lock:
+            value = self._store.get(key)
+            if value is None:
+                self.misses += 1
+            else:
+                self.hits += 1
+            return value
 
     def put(self, key, value, nbytes: int) -> bool:
         """Insert; returns whether the entry was stored (callers that
@@ -45,16 +80,17 @@ class BlockCache:
         # first — without this, re-staging the same block (e.g. a
         # resilient run salvaging different bytes) double-counts and
         # silently flips `full`, demoting every later run to re-staging
-        freed = self._sizes.get(key, 0)
-        if self._bytes - freed + nbytes <= self.max_bytes:
-            self._store[key] = value
-            self._sizes[key] = nbytes
-            self._bytes += nbytes - freed
-            return True
-        # the cache just refused a block: record it, so `full`
-        # flips even when _bytes never lands exactly on the cap
-        self._rejected = True
-        return False
+        with self._lock:
+            freed = self._sizes.get(key, 0)
+            if self._bytes - freed + nbytes <= self.max_bytes:
+                self._store[key] = value
+                self._sizes[key] = nbytes
+                self._bytes += nbytes - freed
+                return True
+            # the cache just refused a block: record it, so `full`
+            # flips even when _bytes never lands exactly on the cap
+            self._rejected = True
+            return False
 
     @property
     def full(self) -> bool:
@@ -68,10 +104,87 @@ class BlockCache:
         return self._rejected or self._bytes >= self.max_bytes
 
     def clear(self) -> None:
-        self._store.clear()
-        self._sizes.clear()
-        self._bytes = 0
-        self._rejected = False
+        with self._lock:
+            self._store.clear()
+            self._sizes.clear()
+            self._bytes = 0
+            self._rejected = False
+            self._reserved = 0
+
+    # ---- admission / pinning hooks (service layer) ----
+
+    @property
+    def available_bytes(self) -> int:
+        """Budget not yet held by stored entries OR outstanding
+        reservations — what an admission decision compares a job's
+        estimated working set against."""
+        with self._lock:
+            return max(0, self.max_bytes - self._bytes - self._reserved)
+
+    def reserve(self, nbytes: int) -> bool:
+        """Atomically claim ``nbytes`` of budget for a job about to
+        stage, or refuse (the scheduler then queues the job instead of
+        letting it thrash the cache).  Pair with :meth:`release` when
+        the job finishes — the bytes it actually cached are then
+        accounted as stored entries."""
+        with self._lock:
+            if nbytes <= self.max_bytes - self._bytes - self._reserved:
+                self._reserved += nbytes
+                return True
+            return False
+
+    def release(self, nbytes: int) -> None:
+        with self._lock:
+            self._reserved = max(0, self._reserved - nbytes)
+
+    def pin(self, ns) -> None:
+        """Pin a key namespace (``key[0]`` for tuple keys — the reader
+        fingerprint the executors lead their cache keys with): its
+        entries survive :meth:`evict_unpinned`."""
+        with self._lock:
+            self._pinned_ns.add(ns)
+
+    def unpin(self, ns) -> None:
+        with self._lock:
+            self._pinned_ns.discard(ns)
+
+    def _key_ns(self, key):
+        return key[0] if isinstance(key, tuple) and key else key
+
+    def ns_bytes(self, ns) -> int:
+        """Stored bytes under one key namespace — how much of the cache
+        a tenant already holds.  Admission uses it to let a RESIDENT
+        tenant ride its own entries when a fresh reservation would not
+        fit (its prior superblocks are the very budget the reservation
+        competes with; denying it would force re-staging blocks that
+        are already cached)."""
+        with self._lock:
+            return sum(size for key, size in self._sizes.items()
+                       if self._key_ns(key) == ns)
+
+    def unpinned_bytes(self) -> int:
+        """Stored bytes :meth:`evict_unpinned` would reclaim — what an
+        admission decision checks BEFORE evicting, so idle tenants'
+        staged superblocks are never destroyed when the reclaim could
+        not make the reservation fit anyway."""
+        with self._lock:
+            return sum(size for key, size in self._sizes.items()
+                       if self._key_ns(key) not in self._pinned_ns)
+
+    def evict_unpinned(self) -> list:
+        """Drop every entry whose namespace is not pinned, crediting
+        their bytes back (and un-flipping ``full`` — the cache accepts
+        inserts again).  Returns the evicted values; device-backed
+        subclasses release the buffers."""
+        with self._lock:
+            evicted = []
+            for key in [k for k in self._store
+                        if self._key_ns(k) not in self._pinned_ns]:
+                evicted.append(self._store.pop(key))
+                self._bytes -= self._sizes.pop(key)
+            if evicted:
+                self._rejected = False
+            return evicted
 
 
 #: Host staged-block cache (``ReaderBase.stage_cached``).

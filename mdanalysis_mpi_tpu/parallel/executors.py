@@ -629,6 +629,15 @@ def _delta_wrapper(fn):
 from mdanalysis_mpi_tpu.io.base import BlockCache  # noqa: E402
 
 
+def reader_fingerprint(reader):
+    """The leading element of every staged-block cache key
+    (``_run_batches._key`` / ``_group_key``) — a reader's identity in
+    the shared cache's key namespace.  The serving layer pins hot
+    tenants' entries by this value (``BlockCache.pin``), so it must
+    stay THE one definition both sides use."""
+    return getattr(reader, "_path", None) or id(reader)
+
+
 class DeviceBlockCache(BlockCache):
     """HBM-resident staged-block cache shared across trajectory passes.
 
@@ -650,9 +659,15 @@ class DeviceBlockCache(BlockCache):
         into the fast-page window §9b diagnosed.  (The base policy
         never evicts, so overwrite — same key restaged, e.g. after a
         resilient run salvages different bytes — is the only way an
-        entry leaves the store outside :meth:`drop`.)"""
-        old = self._store.get(key)
-        stored = super().put(key, value, nbytes)
+        entry leaves the store outside :meth:`drop` /
+        :meth:`evict_unpinned`.)  The read-old/insert pair runs under
+        the cache lock: with scheduler workers sharing one cache, two
+        racing same-key puts would otherwise both snapshot the SAME
+        ``old`` — one replaced buffer double-``delete()``d, the other
+        silently leaked with its host mirror pinned."""
+        with self._lock:
+            old = self._store.get(key)
+            stored = super().put(key, value, nbytes)
         if stored and old is not None:
             _delete_staged(old)
         return stored
@@ -667,9 +682,21 @@ class DeviceBlockCache(BlockCache):
         fresh allocation in the NEXT run pays 15-35× page-supply
         penalties.  Benchmarks re-running cold legs must drop the
         previous attempt's cache first."""
-        for staged in self._store.values():
+        with self._lock:
+            staged_all = list(self._store.values())
+            self.clear()
+        for staged in staged_all:
             _delete_staged(staged)
-        self.clear()
+
+    def evict_unpinned(self) -> list:
+        """Admission-driven eviction (service layer): drop entries
+        outside the pinned tenant namespaces AND release their device
+        buffers + host mirrors — freed budget a queued job can then
+        reserve without touching a hot tenant's superblocks."""
+        evicted = super().evict_unpinned()
+        for staged in evicted:
+            _delete_staged(staged)
+        return evicted
 
 
 class _InlinePool:
@@ -811,7 +838,7 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
     from mdanalysis_mpi_tpu.io.base import sel_fingerprint
 
     sel_fp = sel_fingerprint(sel_idx)
-    reader_fp = getattr(reader, "_path", None) or id(reader)
+    reader_fp = reader_fingerprint(reader)
     # a reader with transformations attached stages DIFFERENT bytes for
     # the same frames; the transformation tuple (set-once) namespaces
     # the cached entries

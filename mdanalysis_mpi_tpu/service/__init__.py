@@ -1,0 +1,47 @@
+"""Multi-tenant analysis serving layer.
+
+The ROADMAP north star is a system that "serves heavy traffic from
+millions of users", but every entry point below this package is a
+single blocking ``AnalysisBase.run()`` — one caller, one trajectory,
+one pass, exclusive ownership of the staged-block caches.  This
+package is the orchestration layer the task-parallel MD-analysis
+literature (Khoshlessan 2019, arXiv:1801.07630; Pretty Fast Analysis,
+arXiv:0808.2992) says the scale win actually comes from: a scheduler
+that shares decoded/staged trajectory data across concurrent analysis
+requests instead of re-reading per request.
+
+- :mod:`~mdanalysis_mpi_tpu.service.jobs` — the job model:
+  :class:`AnalysisJob` (analysis + frame window + backend + priority/
+  deadline/reliability) and the :class:`JobHandle` future callers wait
+  on.
+- :mod:`~mdanalysis_mpi_tpu.service.coalesce` — request coalescing:
+  jobs pending against the same (trajectory, frame window, backend)
+  merge into ONE staged pass via
+  :class:`~mdanalysis_mpi_tpu.analysis.base.AnalysisCollection`, with
+  per-job result fan-out; analyses that cannot coalesce
+  (:class:`~mdanalysis_mpi_tpu.analysis.base.UncoalescableAnalysisError`)
+  are routed to solo passes.
+- :mod:`~mdanalysis_mpi_tpu.service.scheduler` — the
+  :class:`Scheduler`: priority queue, worker threads, cache admission
+  control (jobs that would thrash the shared
+  :class:`~mdanalysis_mpi_tpu.parallel.executors.DeviceBlockCache`
+  run uncached or wait instead of evicting a hot tenant's
+  superblocks), per-job reliability integration.
+- :mod:`~mdanalysis_mpi_tpu.service.telemetry` — serving telemetry:
+  queue depth, p50/p99 queue wait and latency, coalesce and cache-hit
+  rates (the bench serving leg's fields).
+
+See docs/SERVICE.md for the job model and semantics, and
+``examples/serve_batch.py`` for a runnable mixed-workload script.
+"""
+
+from mdanalysis_mpi_tpu.service.jobs import (
+    AnalysisJob, JobDeadlineExpired, JobHandle, JobState,
+)
+from mdanalysis_mpi_tpu.service.scheduler import Scheduler
+from mdanalysis_mpi_tpu.service.telemetry import ServiceTelemetry
+
+__all__ = [
+    "AnalysisJob", "JobDeadlineExpired", "JobHandle", "JobState",
+    "Scheduler", "ServiceTelemetry",
+]

@@ -1,0 +1,172 @@
+"""``python -m mdanalysis_mpi_tpu batch <jobs.json>`` — the serving
+layer's CLI surface.
+
+The single-analysis CLI (``utils/config.py``) is one blocking run; this
+subcommand is the multi-tenant shape: a JSON job file declares N
+requests against one (topology, trajectory), and the scheduler runs
+them with request coalescing, admission control, and per-job
+reliability — then prints ONE JSON line: per-job outcomes plus the
+serving telemetry snapshot.
+
+Job file schema (see docs/SERVICE.md)::
+
+    {
+      "topology": "top.gro",
+      "trajectory": "traj.xtc",          // optional (topology coords)
+      "defaults": {"backend": "jax", "select": "protein"},
+      "workers": 1,                       // scheduler threads
+      "cache_mb": 4096,                   // shared HBM cache (batch
+                                          // backends; 0 disables)
+      "jobs": [
+        {"analysis": "rmsf", "priority": 5, "tenant": "alice"},
+        {"analysis": "rmsd", "select": "name CA", "output": "rmsd.npz"},
+        {"analysis": "rdf", "select": "name OW", "coalesce": false}
+      ]
+    }
+
+Per-job fields: every ``AnalysisConfig`` knob (``analysis``,
+``select``, ``start``/``stop``/``step``, ``nbins``, ...) plus the
+serving knobs ``priority``, ``deadline_s``, ``resilient`` (bool),
+``coalesce``, ``tenant``, and ``output`` (per-job ``.npz``).  All jobs
+share ONE Universe, so same-window requests coalesce into one staged
+pass.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import sys
+import time
+
+import numpy as np
+
+_JOB_FIELDS = ("priority", "deadline_s", "coalesce", "tenant")
+
+
+def _build_job(spec: dict, defaults: dict, universe):
+    from mdanalysis_mpi_tpu.service.jobs import AnalysisJob
+    from mdanalysis_mpi_tpu.utils.config import (
+        AnalysisConfig, build_analysis,
+    )
+
+    merged = {**defaults, **spec}
+    serving = {k: merged.pop(k) for k in _JOB_FIELDS if k in merged}
+    resilient = merged.pop("resilient", False)
+    output = merged.pop("output", None)
+    cfg_fields = {f.name for f in dataclasses.fields(AnalysisConfig)}
+    unknown = set(merged) - cfg_fields
+    if unknown:
+        raise ValueError(
+            f"unknown job fields {sorted(unknown)}; known: "
+            f"{sorted(cfg_fields | set(_JOB_FIELDS) | {'resilient', 'output'})}")
+    cfg = AnalysisConfig(**merged)
+    cfg.topology = cfg.topology or "-"   # validated via shared universe
+    executor_kwargs = {}
+    if cfg.backend in ("jax", "mesh") and cfg.transfer_dtype != "float32":
+        executor_kwargs["transfer_dtype"] = cfg.transfer_dtype
+    job = AnalysisJob(
+        build_analysis(cfg, universe=universe),
+        start=cfg.start, stop=cfg.stop, step=cfg.step,
+        backend=cfg.backend, batch_size=cfg.batch_size,
+        executor_kwargs=executor_kwargs, resilient=resilient,
+        **serving)
+    return job, cfg, output
+
+
+def batch_main(argv=None, universe=None) -> int:
+    """Entry point for the ``batch`` subcommand.  ``universe`` injects
+    a prebuilt Universe (tests; the job file then omits topology)."""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="mdanalysis_mpi_tpu batch",
+        description="run a multi-tenant job file through the serving "
+                    "scheduler (request coalescing + shared-cache "
+                    "admission; docs/SERVICE.md)")
+    p.add_argument("jobs_file", help="JSON job file (see module docs)")
+    ns = p.parse_args(argv)
+    with open(ns.jobs_file) as f:
+        spec = json.load(f)
+
+    from mdanalysis_mpi_tpu.service.scheduler import Scheduler
+
+    defaults = dict(spec.get("defaults", {}))
+    defaults.setdefault("topology", spec.get("topology", ""))
+    defaults.setdefault("trajectory", spec.get("trajectory"))
+    if universe is None:
+        from mdanalysis_mpi_tpu import Universe
+
+        u = Universe(defaults["topology"], defaults["trajectory"])
+    else:
+        u = universe
+
+    jobs = []
+    build_failures = []
+    for js in spec.get("jobs", []):
+        try:
+            jobs.append(_build_job(js, defaults, u))
+        except Exception as exc:
+            # a malformed request fails ITS job, not the whole file —
+            # the other tenants' submissions still run
+            build_failures.append((js, exc))
+    if not jobs and not build_failures:
+        raise SystemExit("job file has no jobs")
+
+    cache = None
+    cache_mb = spec.get("cache_mb", 4096)
+    if cache_mb and any(j.backend in ("jax", "mesh") for j, _, _ in jobs):
+        from mdanalysis_mpi_tpu.parallel.executors import DeviceBlockCache
+
+        cache = DeviceBlockCache(max_bytes=int(cache_mb) << 20)
+
+    t0 = time.perf_counter()
+    # queue the whole file BEFORE starting workers: same-window
+    # requests then coalesce maximally instead of being claimed one by
+    # one as they arrive
+    sched = Scheduler(n_workers=int(spec.get("workers", 1)),
+                      cache=cache, autostart=False)
+    handles = [sched.submit(j) for j, _, _ in jobs]
+    sched.start()
+    sched.drain()
+    sched.shutdown()
+    wall = time.perf_counter() - t0
+
+    records = []
+    rc = 0
+    for js, exc in build_failures:
+        records.append({
+            "analysis": js.get("analysis",
+                               defaults.get("analysis", "?")),
+            "tenant": js.get("tenant", "default"), "state": "failed",
+            "error": f"{type(exc).__name__}: {exc}"})
+        rc = 1
+    for handle, (job, cfg, output) in zip(handles, jobs):
+        rec = {"job_id": handle.job_id, "analysis": cfg.analysis,
+               "tenant": job.tenant, "state": handle.state,
+               "coalesced": handle.coalesced,
+               "queue_wait_s": (round(handle.queue_wait_s, 4)
+                                if handle.queue_wait_s is not None
+                                else None),
+               "latency_s": (round(handle.latency_s, 4)
+                             if handle.latency_s is not None else None)}
+        if handle.error is not None:
+            rec["error"] = f"{type(handle.error).__name__}: {handle.error}"
+            rc = 1
+        else:
+            results = job.analysis.results.materialize()
+            arrays = {k: np.asarray(v) for k, v in results.items()
+                      if isinstance(v, np.ndarray)
+                      or isinstance(v, (float, int))}
+            rec["results"] = {k: list(np.shape(v))
+                              for k, v in arrays.items()}
+            if output:
+                np.savez(output, **arrays)
+                rec["output"] = output
+        records.append(rec)
+
+    print(json.dumps({
+        "jobs": records, "wall_s": round(wall, 4),
+        "serving": sched.telemetry.snapshot(cache=cache),
+    }))
+    return rc

@@ -1,0 +1,232 @@
+"""Job model for the serving layer.
+
+An :class:`AnalysisJob` is one tenant's request: a constructed (not yet
+run) analysis, the frame window to run it over, the backend and batch
+geometry, and the serving knobs (priority, queue deadline, reliability
+policy, coalescing opt-out).  Submitting one to a
+:class:`~mdanalysis_mpi_tpu.service.scheduler.Scheduler` returns a
+:class:`JobHandle` — a thread-safe future carrying the job's state
+machine (PENDING → QUEUED → RUNNING → DONE/FAILED/EXPIRED) and the
+queue-wait/latency timestamps serving telemetry aggregates.
+
+Ownership contract: each job owns its analysis INSTANCE (results land
+on ``job.analysis.results``, exactly as a direct ``run()`` would leave
+them) — submitting one instance under two jobs would race their
+results.  Jobs that should coalesce must be built on a SHARED
+Universe/trajectory object: coalescing merges by trajectory identity
+(the same contract as
+:class:`~mdanalysis_mpi_tpu.analysis.base.AnalysisCollection`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+import time
+
+
+class JobState:
+    """String states (npz/JSON-friendly; no enum dependency)."""
+
+    PENDING = "pending"        # constructed, not yet submitted
+    QUEUED = "queued"          # in the scheduler's priority queue
+    RUNNING = "running"        # a worker is executing it (possibly
+    #                            as part of a coalesced pass)
+    DONE = "done"
+    FAILED = "failed"
+    EXPIRED = "expired"        # queue deadline passed before a worker
+    #                            picked it up
+
+
+class JobDeadlineExpired(RuntimeError):
+    """The job's ``deadline_s`` elapsed while it was still queued."""
+
+
+@dataclasses.dataclass
+class AnalysisJob:
+    """One tenant's analysis request.
+
+    ``analysis``
+        A constructed :class:`~mdanalysis_mpi_tpu.analysis.base.
+        AnalysisBase` instance (NOT yet run).  Results fan out to
+        ``analysis.results`` when the job completes.
+    ``start``/``stop``/``step``/``frames``
+        The frame window, exactly as ``run()`` takes it.  Part of the
+        coalesce key: only jobs over the SAME window merge into one
+        staged pass.
+    ``backend`` / ``batch_size`` / ``executor_kwargs``
+        Execution geometry, as ``run()`` takes it.  Also part of the
+        coalesce key.
+    ``priority``
+        Higher runs earlier; ties break FIFO (submission order).
+    ``deadline_s``
+        Soft QUEUE deadline in seconds from submission: a job still
+        queued when it expires fails with :class:`JobDeadlineExpired`
+        instead of running (the tenant has given up; running it would
+        burn capacity on an unwanted answer).  Per-op deadlines INSIDE
+        a run come from ``resilient`` (ReliabilityPolicy
+        .stage_deadline_s), not from this knob.
+    ``resilient``
+        ``False`` | ``True`` | a :class:`~mdanalysis_mpi_tpu.
+        reliability.ReliabilityPolicy` — per-job fault tolerance,
+        forwarded to ``run(resilient=...)``: retry/backoff, corrupt-
+        frame salvage, and Mesh→Jax→Serial degradation that demotes
+        the executor for THIS job only (each run builds its own
+        fallback chain; the process and other tenants keep their
+        backends).  Part of the coalesce key — jobs merge only with
+        identical policies, so one tenant's retry budget is never
+        silently applied to another's pass.
+    ``coalesce``
+        ``False`` opts this job out of request coalescing (always a
+        solo pass).
+    ``tenant``
+        Opaque label for telemetry/log attribution.
+    """
+
+    analysis: object
+    start: int | None = None
+    stop: int | None = None
+    step: int | None = None
+    frames: object = None
+    backend: str = "serial"
+    batch_size: int | None = None
+    executor_kwargs: dict = dataclasses.field(default_factory=dict)
+    priority: int = 0
+    deadline_s: float | None = None
+    resilient: object = False
+    coalesce: bool = True
+    tenant: str = "default"
+
+    def __post_init__(self):
+        from mdanalysis_mpi_tpu.reliability.policy import (
+            ReliabilityPolicy,
+        )
+
+        # normalize the bool-or-policy knob at CONSTRUCTION: a truthy
+        # non-policy value (resilient=1 — a natural mistake) would
+        # otherwise survive until the worker computes the coalesce key
+        # (dataclasses.astuple crash) and kill the claim
+        if not isinstance(self.resilient, ReliabilityPolicy):
+            self.resilient = bool(self.resilient)
+
+    def window_kwargs(self) -> dict:
+        return dict(start=self.start, stop=self.stop, step=self.step,
+                    frames=self.frames)
+
+    @property
+    def trajectory(self):
+        return self.analysis._universe.trajectory
+
+    def _resilient_key(self):
+        """Hashable image of the reliability spec for the coalesce
+        key (policies are dataclasses of scalars)."""
+        if not self.resilient:
+            return None
+        if self.resilient is True:
+            return True
+        return dataclasses.astuple(self.resilient)
+
+    def coalesce_key(self):
+        """Jobs with EQUAL keys may merge into one staged pass."""
+        frames = self.frames
+        if frames is not None:
+            frames = tuple(int(f) for f in frames)
+        return (id(self.trajectory), self.start, self.stop, self.step,
+                frames, self.backend, self.batch_size,
+                tuple(sorted(self.executor_kwargs.items(),
+                             key=lambda kv: kv[0])),
+                self._resilient_key())
+
+
+_job_ids = itertools.count(1)
+
+
+class JobHandle:
+    """Thread-safe future for one submitted job.
+
+    ``result(timeout)`` blocks until the job finishes and returns the
+    job's (run) analysis — or raises the job's failure.  Timestamps
+    (``submitted_t`` / ``started_t`` / ``finished_t``) feed the
+    queue-wait and latency percentiles in serving telemetry.
+    """
+
+    def __init__(self, job: AnalysisJob):
+        self.job = job
+        self.job_id = next(_job_ids)
+        self.state = JobState.PENDING
+        self.error: BaseException | None = None
+        #: True when the job ran as part of a merged (≥2-member)
+        #: coalesced pass — the telemetry coalesce-rate numerator
+        self.coalesced = False
+        self.submitted_t: float | None = None
+        self.started_t: float | None = None
+        self.finished_t: float | None = None
+        self._done = threading.Event()
+        # scheduler bookkeeping: admission deferral count (see
+        # Scheduler._pop_admissible)
+        self._deferrals = 0
+
+    # ---- lifecycle (called by the scheduler) ----
+
+    def _mark_queued(self) -> None:
+        self.state = JobState.QUEUED
+        self.submitted_t = time.monotonic()
+
+    def _mark_running(self) -> None:
+        self.state = JobState.RUNNING
+        self.started_t = time.monotonic()
+
+    def _mark_done(self) -> None:
+        self.state = JobState.DONE
+        self.finished_t = time.monotonic()
+        self._done.set()
+
+    def _mark_failed(self, exc: BaseException,
+                     state: str = JobState.FAILED) -> None:
+        self.error = exc
+        self.state = state
+        self.finished_t = time.monotonic()
+        self._done.set()
+
+    @property
+    def deadline_expired(self) -> bool:
+        return (self.job.deadline_s is not None
+                and self.submitted_t is not None
+                and time.monotonic() - self.submitted_t
+                > self.job.deadline_s)
+
+    # ---- caller surface ----
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def wait(self, timeout: float | None = None) -> bool:
+        return self._done.wait(timeout)
+
+    def result(self, timeout: float | None = None):
+        """The finished analysis (``.results`` populated), or raise the
+        job's failure; TimeoutError if still running after ``timeout``."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"job {self.job_id} still {self.state} after {timeout}s")
+        if self.error is not None:
+            raise self.error
+        return self.job.analysis
+
+    @property
+    def queue_wait_s(self) -> float | None:
+        if self.submitted_t is None or self.started_t is None:
+            return None
+        return self.started_t - self.submitted_t
+
+    @property
+    def latency_s(self) -> float | None:
+        if self.submitted_t is None or self.finished_t is None:
+            return None
+        return self.finished_t - self.submitted_t
+
+    def __repr__(self):
+        return (f"<JobHandle #{self.job_id} "
+                f"{type(self.job.analysis).__name__} "
+                f"tenant={self.job.tenant!r} {self.state}>")

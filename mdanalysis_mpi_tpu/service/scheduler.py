@@ -1,0 +1,481 @@
+"""The job scheduler: priority queue + coalescing + cache admission.
+
+One :class:`Scheduler` turns the library's blocking ``run()`` calls
+into a served workload: tenants ``submit()`` :class:`~mdanalysis_mpi_tpu
+.service.jobs.AnalysisJob`\\ s and get :class:`~mdanalysis_mpi_tpu.
+service.jobs.JobHandle` futures back; worker threads claim the
+highest-priority job PLUS every queued peer sharing its coalesce key,
+plan the batch into merged/solo passes
+(:mod:`~mdanalysis_mpi_tpu.service.coalesce`), and run them.
+
+Admission control (the shared-cache policy): when the scheduler owns a
+:class:`~mdanalysis_mpi_tpu.parallel.executors.DeviceBlockCache`, a
+batch-backend job must RESERVE its estimated staged working set before
+it may stage into the cache.  A job whose estimate
+
+- fits the available budget → admitted (reservation held for the run);
+- exceeds the whole cache → runs UNCACHED (it could never fit;
+  letting it insert would evict nothing — the cache never evicts — but
+  would burn the budget hot tenants are using);
+- fits the cache but not the current budget → the scheduler first
+  reclaims entries of tenants with no pending jobs
+  (``evict_unpinned()`` — pinned/hot tenants' superblocks are never
+  touched), then either admits, DEFERS the job behind other runnable
+  work, or — when nothing else is queued or the deferral budget is
+  spent — runs it uncached.  Queuing instead of evicting is the
+  whole point: a cold tenant must not thrash a hot tenant's
+  HBM-resident superblocks.
+
+Reliability integration: ``job.resilient`` forwards to
+``run(resilient=...)`` — each job run builds its OWN degradation chain
+(:class:`~mdanalysis_mpi_tpu.reliability.policy.FallbackChain`), so a
+device-loss-shaped failure demotes the executor for THAT job only; the
+process, the scheduler, and other tenants keep their backends.  A
+merged pass that fails re-runs its members solo (one bad tenant must
+not take down the batch it coalesced into).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+
+from mdanalysis_mpi_tpu.service import coalesce as _coalesce
+from mdanalysis_mpi_tpu.service.jobs import (
+    AnalysisJob, JobDeadlineExpired, JobHandle, JobState,
+)
+from mdanalysis_mpi_tpu.service.telemetry import ServiceTelemetry
+from mdanalysis_mpi_tpu.utils.log import get_logger
+from mdanalysis_mpi_tpu.utils.timers import TIMERS
+
+def reader_fingerprint(reader):
+    """Re-exported from the executor layer: the cache-key namespace
+    every staged-block key leads with — the scheduler pins hot
+    tenants' entries by this value."""
+    from mdanalysis_mpi_tpu.parallel.executors import (
+        reader_fingerprint as fp,
+    )
+
+    return fp(reader)
+
+
+class Scheduler:
+    """Multi-tenant job scheduler over the executor layer.
+
+    ``n_workers``
+        Worker threads claiming jobs (default 1: one staged pass at a
+        time — staging and dispatch share the host core, and
+        coalescing, not thread fan-out, is where the multi-tenant win
+        lives).  More workers overlap host-bound jobs; the shared
+        caches are lock-safe for it (the thread-safety audit in
+        ``io/base.py``/``DeviceBlockCache``).
+    ``cache``
+        Optional shared :class:`~mdanalysis_mpi_tpu.parallel.executors.
+        DeviceBlockCache` handed to admitted batch-backend jobs (see
+        the module docstring for the admission rules).  Jobs that pass
+        their own ``block_cache`` in ``executor_kwargs`` bypass
+        admission entirely.
+    ``autostart``
+        Start workers on construction.  ``False`` lets a caller queue
+        a burst first (tests pin priority order this way), then
+        :meth:`start`.
+    """
+
+    def __init__(self, n_workers: int = 1, cache=None,
+                 telemetry: ServiceTelemetry | None = None,
+                 max_deferrals: int = 3, autostart: bool = True):
+        self.cache = cache
+        self.telemetry = telemetry or ServiceTelemetry()
+        self.max_deferrals = max_deferrals
+        self.n_workers = max(1, int(n_workers))
+        self._queue: list = []        # (-priority, seq, handle)
+        # admission-deferred entries, parked until OTHER work actually
+        # runs (a deferred top-priority job back in the queue would
+        # just be re-claimed immediately — a busy-loop that never
+        # yields to the runnable work it deferred behind)
+        self._parked: list = []
+        self._active = 0              # workers currently running a batch
+        self._seq = itertools.count()
+        self._cond = threading.Condition()
+        self._inflight = 0            # queued + running handles
+        self._shutdown = False
+        self._workers: list[threading.Thread] = []
+        self._ns_active: dict = {}    # reader fingerprint → live jobs
+        self._log = get_logger("mdtpu.service")
+        if autostart:
+            self.start()
+
+    # ---- lifecycle ----
+
+    def start(self) -> None:
+        with self._cond:
+            if self._workers:
+                return
+            self._shutdown = False
+            for i in range(self.n_workers):
+                t = threading.Thread(target=self._worker, daemon=True,
+                                     name=f"mdtpu-serve-{i}")
+                self._workers.append(t)
+                t.start()
+
+    def drain(self, timeout: float | None = None) -> bool:
+        """Block until every submitted job reached a terminal state."""
+        if not self._workers:
+            self.start()
+        with self._cond:
+            return self._cond.wait_for(lambda: self._inflight == 0,
+                                       timeout)
+
+    def shutdown(self, wait: bool = True) -> None:
+        with self._cond:
+            self._shutdown = True
+            self._cond.notify_all()
+        if wait:
+            for t in self._workers:
+                t.join()
+        self._workers.clear()
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.drain()
+        self.shutdown()
+        return False
+
+    # ---- submission ----
+
+    def submit(self, job, **kwargs) -> JobHandle:
+        """Queue an :class:`AnalysisJob` (or an analysis instance, with
+        job fields as keyword arguments) and return its handle."""
+        if isinstance(job, AnalysisJob):
+            if kwargs:
+                raise TypeError(
+                    "submit() got both a prebuilt AnalysisJob and job "
+                    f"keyword arguments {sorted(kwargs)}; set those "
+                    "fields on the job itself (they would otherwise "
+                    "be silently discarded)")
+        else:
+            job = AnalysisJob(job, **kwargs)
+        handle = JobHandle(job)
+        # everything under one condition acquisition (its lock is
+        # re-entrant), with the shutdown check FIRST: a rejected
+        # submission must leave no side effects — in particular no
+        # namespace pin on a shared cache that no completion would
+        # ever release.  note_submit stays inside too, so the depth
+        # gauge can never see the dequeue of a job before its submit.
+        with self._cond:
+            if self._shutdown:
+                raise RuntimeError("scheduler is shut down")
+            handle._mark_queued()
+            self._note_ns_submit(job)
+            self._queue.append((-job.priority, next(self._seq), handle))
+            self._inflight += 1
+            self.telemetry.note_submit()
+            self._cond.notify()
+        return handle
+
+    def submit_all(self, jobs) -> list[JobHandle]:
+        return [self.submit(j) for j in jobs]
+
+    # ---- tenant pinning (hot tenants' cache entries survive
+    #      admission eviction) ----
+
+    def _note_ns_submit(self, job: AnalysisJob) -> None:
+        if self.cache is None:
+            return
+        ns = reader_fingerprint(job.trajectory)
+        with self._cond:
+            self._ns_active[ns] = self._ns_active.get(ns, 0) + 1
+            if self._ns_active[ns] == 1:
+                self.cache.pin(ns)
+
+    def _note_ns_done(self, job: AnalysisJob) -> None:
+        if self.cache is None:
+            return
+        ns = reader_fingerprint(job.trajectory)
+        with self._cond:
+            n = self._ns_active.get(ns, 0) - 1
+            if n <= 0:
+                self._ns_active.pop(ns, None)
+                self.cache.unpin(ns)
+            else:
+                self._ns_active[ns] = n
+
+    # ---- worker loop ----
+
+    def _worker(self) -> None:
+        while True:
+            with self._cond:
+                while True:
+                    if self._queue:
+                        break
+                    if self._parked and self._active == 0:
+                        # nothing queued AND no other worker mid-run
+                        # (whose finish could free budget): deferred
+                        # entries get their turn now
+                        self._unpark_locked()
+                        break
+                    if self._shutdown and not self._parked:
+                        return
+                    self._cond.wait()
+                batch, poison = self._claim_batch_locked()
+                self._active += 1
+            progressed = True      # safe default for the finally
+            try:
+                if poison is not None:
+                    # a job whose coalesce key cannot even be computed
+                    # (broken analysis/trajectory attribute) fails
+                    # ITSELF — never the worker thread
+                    for h in batch:
+                        self.telemetry.note_dequeue()
+                        h._mark_failed(poison)
+                        self._finish(h)
+                    progressed = True
+                else:
+                    progressed = self._process_batch(batch)
+            finally:
+                with self._cond:
+                    self._active -= 1
+                    if progressed:
+                        # something actually ran: deferred entries may
+                        # now find freed reservations
+                        self._unpark_locked()
+                    self._cond.notify_all()
+
+    def _unpark_locked(self) -> None:
+        if self._parked:
+            self._queue.extend(self._parked)
+            self._parked.clear()
+            self._cond.notify_all()
+
+    def _claim_batch_locked(self):
+        """Claim the best-priority entry plus every queued peer sharing
+        its coalesce key (lower-priority peers deliberately ride along:
+        amortizing the staged pass is worth the inversion).  O(queue)
+        per claim — a serving queue is small; revisit if it stops
+        being.  Returns ``(handles, poison)``: a non-None poison is
+        the key-computation failure of the best entry (claimed alone,
+        to be failed by the caller)."""
+        best = min(self._queue)
+        try:
+            key = best[2].job.coalesce_key()
+        except Exception as exc:
+            self._queue.remove(best)
+            return [best[2]], exc
+        claimed, rest = [], []
+        for entry in self._queue:
+            try:
+                same = entry[2].job.coalesce_key() == key
+            except Exception:
+                same = False     # surfaces when it becomes `best`
+            if same:
+                claimed.append(entry[2])
+            else:
+                rest.append(entry)
+        self._queue[:] = rest
+        return claimed, None
+
+    def _requeue(self, handles: list[JobHandle]) -> None:
+        """Park admission-deferred handles; they re-enter the queue
+        only after other work has actually run (see _worker) — putting
+        a top-priority entry straight back would re-claim it in a
+        tight loop without ever yielding to the work it deferred
+        behind."""
+        with self._cond:
+            for h in handles:
+                h._deferrals += 1
+                self._parked.append((-h.job.priority, next(self._seq),
+                                     h))
+                # balance the note_dequeue the claim already recorded —
+                # the handle is queued again, but NOT resubmitted
+                self.telemetry.note_requeue()
+
+    def _finish(self, handle: JobHandle) -> None:
+        self.telemetry.note_finish(handle)
+        self._note_ns_done(handle.job)
+        with self._cond:
+            self._inflight -= 1
+            self._cond.notify_all()
+
+    def _process_batch(self, batch: list[JobHandle]) -> bool:
+        """Run one claimed batch.  Returns True when any handle made
+        real progress (ran or reached a terminal state) — the signal
+        that parked (deferred) entries may find freed budget."""
+        progressed = False
+        live = []
+        for h in batch:
+            self.telemetry.note_dequeue()
+            if h.deadline_expired:
+                h._mark_failed(JobDeadlineExpired(
+                    f"job {h.job_id} ({h.job.tenant}) spent "
+                    f"{h.queue_wait_s or 0:.3f}s queued, over its "
+                    f"{h.job.deadline_s}s deadline"), JobState.EXPIRED)
+                self._finish(h)
+                progressed = True
+            else:
+                live.append(h)
+        if not live:
+            return progressed
+        # NOTHING may escape into _worker: an uncaught planning or
+        # admission error would kill the worker thread, stranding every
+        # queued job and hanging drain() — failures land on the
+        # affected handles instead
+        try:
+            units = _coalesce.plan_units(live)
+        except Exception as exc:
+            for h in live:
+                h._mark_failed(exc)
+                self._finish(h)
+            return True
+        for unit in units:
+            try:
+                if self._run_unit(unit):
+                    progressed = True
+            except Exception as exc:
+                for h in unit.handles:
+                    if not h.done():
+                        h._mark_failed(exc)
+                        self._finish(h)
+                progressed = True
+        return progressed
+
+    # ---- cache admission ----
+
+    def _estimate_bytes(self, job: AnalysisJob) -> int:
+        """Estimated staged working set of one pass over the job's
+        window: frames × n_atoms × 3 × transfer-dtype bytes.
+        Deliberately conservative (full atom count, not the selection
+        union — selections are not resolvable before ``_prepare``):
+        over-admitting thrashes hot tenants, over-estimating only
+        queues a job that might have fit."""
+        from mdanalysis_mpi_tpu.parallel.executors import _block_nbytes
+
+        n = len(job.analysis._frames(job.start, job.stop, job.step,
+                                     job.frames))
+        # the executors' own bytes-per-staged-block model (one
+        # definition: a dtype they learn to stage, admission learns to
+        # estimate — and an unknown dtype fails loudly in both places)
+        return _block_nbytes(n, None, job.trajectory.n_atoms,
+                             job.executor_kwargs.get("transfer_dtype",
+                                                     "float32"))
+
+    def _admit(self, unit) -> tuple[bool, int]:
+        """Admission decision for one execution unit.  Returns
+        ``(run_now, reserved_bytes)``; ``reserved_bytes < 0`` means
+        run WITHOUT the shared cache.  May requeue the unit's handles
+        (deferral) — then ``run_now`` is False."""
+        job = unit.handles[0].job
+        if (self.cache is None or job.backend not in ("jax", "mesh")
+                or "block_cache" in job.executor_kwargs):
+            return True, -1
+        est = self._estimate_bytes(job)
+        if est > self.cache.max_bytes:
+            self.telemetry.count("admission_uncached")
+            return True, -1
+        if self.cache.reserve(est):
+            self.telemetry.count("admission_reserved")
+            return True, est
+        if self.cache.ns_bytes(reader_fingerprint(job.trajectory)):
+            # the tenant already holds entries — its prior superblocks
+            # ARE the budget the reservation just lost to.  Admit
+            # without one: the pass rides its resident blocks (hits),
+            # and any overflow insert is capped by the cache itself.
+            self.telemetry.count("admission_resident")
+            return True, 0
+        # reclaim idle tenants' entries (never a pinned/hot tenant's)
+        # — but only when the reclaim can actually make the
+        # reservation fit: pointless eviction destroys staged
+        # superblocks a returning tenant would re-pay the full
+        # decode+stage cost for
+        reclaimable = self.cache.unpinned_bytes()
+        if reclaimable and est <= self.cache.available_bytes + reclaimable:
+            evicted = self.cache.evict_unpinned()
+            if evicted:
+                self.telemetry.count("admission_evictions", len(evicted))
+                if self.cache.reserve(est):
+                    self.telemetry.count("admission_reserved")
+                    return True, est
+        with self._cond:
+            # other runnable work = queued entries, or another worker
+            # mid-run (its reservation/entries may free; self is
+            # always active here, hence > 1)
+            can_defer = bool(self._queue) or self._active > 1
+        if can_defer and max(h._deferrals for h in unit.handles) \
+                < self.max_deferrals:
+            self.telemetry.count("admission_deferrals",
+                                 len(unit.handles))
+            self._requeue(unit.handles)
+            return False, 0
+        # starved or out of deferrals: run, but leave the cache alone
+        self.telemetry.count("admission_uncached")
+        return True, -1
+
+    # ---- execution ----
+
+    def _run_unit(self, unit) -> bool:
+        """Admit + execute one unit; False when it was deferred."""
+        run_now, reserved = self._admit(unit)
+        if not run_now:
+            return False
+        # unit-shape counters recorded only for units that actually
+        # RUN — a deferred unit comes back through here and must not
+        # double-count its pass
+        if unit.coalesced:
+            self.telemetry.count("coalesce_batches")
+        elif unit.solo_reason:
+            self.telemetry.count(unit.solo_reason)
+        job = unit.handles[0].job
+        kwargs = dict(job.executor_kwargs)
+        if reserved >= 0:
+            kwargs["block_cache"] = self.cache
+        for h in unit.handles:
+            h._mark_running()
+        try:
+            with TIMERS.phase("serve_job"):
+                unit.runnable.run(backend=job.backend,
+                                  batch_size=job.batch_size,
+                                  resilient=job.resilient,
+                                  **job.window_kwargs(), **kwargs)
+        except Exception as exc:
+            if unit.coalesced:
+                # one bad member must not fail the batch it merged
+                # into: fall back to solo passes with per-job outcomes
+                self.telemetry.count("coalesce_fallbacks")
+                self._log.warning(
+                    "coalesced pass of %d jobs failed (%s: %s); "
+                    "re-running members solo", len(unit.handles),
+                    type(exc).__name__, exc)
+                for h in unit.handles:
+                    self._run_solo(h, kwargs)
+            else:
+                for h in unit.handles:
+                    h._mark_failed(exc)
+                    self._finish(h)
+        else:
+            for h in unit.handles:
+                h.coalesced = unit.coalesced
+                h._mark_done()
+                self._finish(h)
+        finally:
+            if reserved > 0:
+                # the staged bytes are now accounted as cache entries
+                # (or were rejected by the cache's own cap check);
+                # either way the reservation's job is done
+                self.cache.release(reserved)
+        return True
+
+    def _run_solo(self, handle: JobHandle, kwargs: dict) -> None:
+        job = handle.job
+        try:
+            with TIMERS.phase("serve_job"):
+                job.analysis.run(backend=job.backend,
+                                 batch_size=job.batch_size,
+                                 resilient=job.resilient,
+                                 **job.window_kwargs(), **kwargs)
+        except Exception as exc:
+            handle._mark_failed(exc)
+        else:
+            handle._mark_done()
+        self._finish(handle)

@@ -1,0 +1,166 @@
+"""Serving telemetry: what the multi-tenant layer actually did.
+
+One :class:`ServiceTelemetry` per scheduler accumulates counters
+(submissions, completions, coalesce outcomes, admission decisions) and
+latency samples (queue wait, end-to-end job latency), and renders them
+as a flat JSON-friendly dict — the schema the bench serving leg embeds
+in the round artifact and ``tests/test_bench_contract.py`` pins.
+
+Everything here is lock-guarded: scheduler workers record concurrently
+and lost counter updates would make the reported rates lie.  Wall-clock
+phase time stays in :mod:`mdanalysis_mpi_tpu.utils.timers` (the
+per-run decomposition); this module owns the per-JOB distributions a
+serving operator reads (p50/p99, rates), and mirrors its snapshots
+through :func:`mdanalysis_mpi_tpu.utils.log.log_event` for the
+JSON-lines event stream.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+import numpy as np
+
+#: Sliding window for the latency/queue-wait percentile samples: a
+#: serving process runs indefinitely, so unbounded per-job appends
+#: would grow memory (and every snapshot's np.percentile cost) linearly
+#: with uptime.  p50/p99 over the most recent N jobs is what a serving
+#: operator wants anyway.
+MAX_SAMPLES = 4096
+
+
+def percentile(samples, q: float) -> float | None:
+    """``np.percentile`` with an empty-sample guard (None, not NaN:
+    the snapshot must stay JSON-serializable)."""
+    if not samples:
+        return None
+    return float(np.percentile(np.asarray(samples, dtype=np.float64), q))
+
+
+class ServiceTelemetry:
+    """Counters + latency distributions for one scheduler."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # job lifecycle
+        self.submitted = 0
+        self.completed = 0
+        self.failed = 0
+        self.expired = 0
+        # queue gauge
+        self.queue_depth = 0
+        self.queue_depth_peak = 0
+        # coalescing
+        self.coalesced_jobs = 0        # jobs that ran in a ≥2-member pass
+        self.coalesce_batches = 0      # merged passes executed
+        self.solo_jobs = 0             # jobs that ran as their own pass
+        self.uncoalescable_jobs = 0    # solo because typed-error routed
+        self.coalesce_fallbacks = 0    # merged pass failed → members re-run solo
+        # cache admission
+        self.admission_reserved = 0    # jobs admitted with a reservation
+        self.admission_resident = 0    # admitted riding resident entries
+        self.admission_deferrals = 0   # admissible-later jobs passed over
+        self.admission_uncached = 0    # jobs run without the shared cache
+        self.admission_evictions = 0   # evict_unpinned entries reclaimed
+        # distributions (seconds), bounded — see MAX_SAMPLES
+        self.queue_wait_samples: deque = deque(maxlen=MAX_SAMPLES)
+        self.latency_samples: deque = deque(maxlen=MAX_SAMPLES)
+
+    # ---- recording (scheduler-facing) ----
+
+    def note_submit(self) -> None:
+        with self._lock:
+            self.submitted += 1
+            self.queue_depth += 1
+            self.queue_depth_peak = max(self.queue_depth_peak,
+                                        self.queue_depth)
+
+    def note_dequeue(self) -> None:
+        with self._lock:
+            self.queue_depth -= 1
+
+    def note_requeue(self) -> None:
+        """An admission deferral put a claimed handle back in the
+        queue: the depth gauge recovers WITHOUT counting a new
+        submission."""
+        with self._lock:
+            self.queue_depth += 1
+            self.queue_depth_peak = max(self.queue_depth_peak,
+                                        self.queue_depth)
+
+    def note_finish(self, handle) -> None:
+        """Record a finished handle (any terminal state) with its
+        timing samples."""
+        from mdanalysis_mpi_tpu.service.jobs import JobState
+
+        with self._lock:
+            if handle.state == JobState.DONE:
+                self.completed += 1
+                if handle.coalesced:
+                    self.coalesced_jobs += 1
+            elif handle.state == JobState.EXPIRED:
+                self.expired += 1
+            else:
+                self.failed += 1
+            if handle.queue_wait_s is not None:
+                self.queue_wait_samples.append(handle.queue_wait_s)
+            if handle.latency_s is not None:
+                self.latency_samples.append(handle.latency_s)
+
+    def count(self, counter: str, n: int = 1) -> None:
+        """Increment a named counter (the scheduler's single entry
+        point for coalesce/admission bookkeeping)."""
+        with self._lock:
+            setattr(self, counter, getattr(self, counter) + n)
+
+    # ---- reading ----
+
+    def snapshot(self, cache=None) -> dict:
+        """Flat JSON-friendly dict of everything above, plus the shared
+        cache's hit/eviction view when one is attached (the
+        ``serving_*`` fields of the bench artifact)."""
+        with self._lock:
+            out = {
+                "jobs_submitted": self.submitted,
+                "jobs_completed": self.completed,
+                "jobs_failed": self.failed,
+                "jobs_expired": self.expired,
+                "queue_depth": self.queue_depth,
+                "queue_depth_peak": self.queue_depth_peak,
+                "coalesced_jobs": self.coalesced_jobs,
+                "coalesce_batches": self.coalesce_batches,
+                "solo_jobs": self.solo_jobs,
+                "uncoalescable_jobs": self.uncoalescable_jobs,
+                "coalesce_fallbacks": self.coalesce_fallbacks,
+                "admission_reserved": self.admission_reserved,
+                "admission_resident": self.admission_resident,
+                "admission_deferrals": self.admission_deferrals,
+                "admission_uncached": self.admission_uncached,
+                "admission_evictions": self.admission_evictions,
+                "p50_queue_wait_s": percentile(self.queue_wait_samples, 50),
+                "p99_queue_wait_s": percentile(self.queue_wait_samples, 99),
+                "p50_latency_s": percentile(self.latency_samples, 50),
+                "p99_latency_s": percentile(self.latency_samples, 99),
+            }
+            done = self.completed
+            out["coalesce_rate"] = (round(self.coalesced_jobs / done, 4)
+                                    if done else None)
+        if cache is not None:
+            lookups = cache.hits + cache.misses
+            out["cache_hits"] = cache.hits
+            out["cache_misses"] = cache.misses
+            out["cache_hit_rate"] = (round(cache.hits / lookups, 4)
+                                     if lookups else None)
+            out["cache_bytes"] = cache._bytes
+            out["cache_max_bytes"] = cache.max_bytes
+        else:
+            out["cache_hit_rate"] = None
+        return out
+
+    def log(self, cache=None, **extra) -> None:
+        """Emit the snapshot as a structured ``serving`` event
+        (JSON-lines under ``MDTPU_LOG_JSON=1``; INFO otherwise)."""
+        from mdanalysis_mpi_tpu.utils.log import log_event
+
+        log_event("serving", **{**self.snapshot(cache=cache), **extra})

@@ -151,7 +151,11 @@ def _parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="mdanalysis_mpi_tpu",
         description="TPU-native trajectory analysis "
-                    "(RMSF/RMSD/RDF/distances over pluggable backends)")
+                    "(RMSF/RMSD/RDF/distances over pluggable backends)",
+        epilog="Multi-tenant mode: `python -m mdanalysis_mpi_tpu batch "
+               "jobs.json` runs a JSON job file through the serving "
+               "scheduler (request coalescing, shared-cache admission, "
+               "per-job reliability) — docs/SERVICE.md.")
     p.add_argument("analysis", choices=ANALYSES)
     p.add_argument("topology", help="GRO/PSF/PDB/PQR/MOL2/CRD/PRMTOP/ITP/PDBQT/TXYZ topology file")
     p.add_argument("trajectory", nargs="*", default=None,
@@ -209,7 +213,14 @@ def _parser() -> argparse.ArgumentParser:
 def main(argv=None) -> int:
     from mdanalysis_mpi_tpu.utils.timers import TIMERS
 
-    ns = _parser().parse_args(argv)
+    args = sys.argv[1:] if argv is None else list(argv)
+    if args and args[0] == "batch":
+        # multi-tenant job-file mode (service/ subsystem): N analyses,
+        # one scheduler, request coalescing — docs/SERVICE.md
+        from mdanalysis_mpi_tpu.service.cli import batch_main
+
+        return batch_main(args[1:])
+    ns = _parser().parse_args(args)
     cfg = AnalysisConfig(
         analysis=ns.analysis, topology=ns.topology,
         trajectory=(None if not ns.trajectory

@@ -40,3 +40,9 @@ def pytest_configure(config):
         "reliability: fast, CPU-only, deterministic fault-injection "
         "tests (reliability/ subsystem); in tier-1 by construction "
         "(not slow) and selectable alone with `pytest -m reliability`")
+    config.addinivalue_line(
+        "markers",
+        "service: fast, CPU-only multi-tenant serving tests (service/ "
+        "subsystem: scheduler, coalescing, cache admission); in tier-1 "
+        "by construction (not slow) and selectable alone with "
+        "`pytest -m service`")

@@ -72,8 +72,33 @@ def test_bench_json_contract(tmp_path):
                     # (docs/DISPATCH.md) is attributable from the JSON
                     # alone — same contract as put_gbps/decode_fps
                     "dispatch_count", "ms_per_dispatch", "scan_k",
-                    "cold_dispatch_count", "cold_ms_per_dispatch"):
+                    "cold_dispatch_count", "cold_ms_per_dispatch",
+                    # r8: serving telemetry (service/ subsystem,
+                    # docs/SERVICE.md) — the host leg's fields survive
+                    # a tunnel-down artifact; the accel leg adds the
+                    # shared-cache hit rate
+                    "serving_n_jobs", "serving_jobs_per_s",
+                    "serving_p50_queue_wait_s",
+                    "serving_p99_queue_wait_s",
+                    "serving_p50_latency_s", "serving_p99_latency_s",
+                    "serving_coalesce_rate",
+                    "serving_coalesce_batches",
+                    "serving_accel_n_jobs", "serving_accel_jobs_per_s",
+                    "serving_accel_p50_latency_s",
+                    "serving_accel_p99_latency_s",
+                    "serving_accel_coalesce_rate",
+                    "serving_accel_cache_hit_rate"):
             assert key in rec, f"missing {key} in {sorted(rec)}"
+        # serving leg sanity: rates are true fractions; wave 2 of the
+        # accel leg was actually served from the shared cache; the
+        # host leg's mixed-window load keeps coalescing non-trivial
+        assert rec["serving_jobs_per_s"] > 0
+        assert 0 < rec["serving_coalesce_rate"] < 1
+        assert rec["serving_p99_latency_s"] >= rec["serving_p50_latency_s"]
+        assert rec["serving_accel_jobs_per_s"] > 0
+        assert 0 < rec["serving_accel_cache_hit_rate"] <= 1
+        assert rec["serving_accel_coalesce_rate"] == 1.0
+        assert "serving_accel" in rec["accel_leg_order"]
         assert rec["accel_leg_order"][0] == "cold"
         assert "f32_steady" in rec["accel_leg_order"]
         assert rec["unit"] == "frames/s/chip"
@@ -156,6 +181,11 @@ def test_bench_outage_records_host_legs(tmp_path):
         assert rec["serial_fps"] > 0
         assert rec["serial_file_fps"] > 0
         assert rec["decode_fps"] > 0
+        # r8: serving telemetry is a HOST leg — a tunnel-down artifact
+        # still carries jobs/s, p50/p99, and the coalesce rate
+        assert rec["serving_jobs_per_s"] > 0
+        assert 0 < rec["serving_coalesce_rate"] < 1
+        assert rec["serving_p99_latency_s"] >= rec["serving_p50_latency_s"]
         # the retry log shows what init actually did
         assert rec["init_log"] and rec["init_log"][0]["attempt"] == 1
         # the incremental file matches the emitted record's legs

@@ -190,6 +190,30 @@ def test_run_orchestrating_child_rejected():
         AnalysisCollection(AlignedRMSF(u, select="name CA"))
 
 
+def test_run_orchestrating_child_rejection_is_typed():
+    """The run()-override refusal is the TYPED
+    UncoalescableAnalysisError (still a ValueError for existing
+    callers), names the offending instance, and points at per-job
+    (non-coalesced) submission — the serving coalescer routes on
+    exactly this exception (service/coalesce.py)."""
+    from mdanalysis_mpi_tpu.analysis import (
+        AlignedRMSF, AlignTraj, PCA, UncoalescableAnalysisError,
+    )
+
+    u = _u()
+    for bad in (AlignedRMSF(u, select="name CA"),
+                PCA(u, select="name CA"),
+                AlignTraj(u, u, select="name CA", in_memory=True)):
+        with pytest.raises(UncoalescableAnalysisError) as ei:
+            AnalysisCollection(bad)
+        assert isinstance(ei.value, ValueError)   # back-compat contract
+        assert ei.value.analysis is bad           # coalescer routes on it
+        assert "per-job" in str(ei.value)
+        assert "non-coalesced" in str(ei.value)
+    # healthy members still pass after a refusal (no sticky state)
+    AnalysisCollection(RMSF(u.select_atoms("name CA")))
+
+
 def test_ring_child_rejected_on_batch_only():
     from mdanalysis_mpi_tpu.analysis import InterRDF
     from mdanalysis_mpi_tpu.testing import make_water_universe
