@@ -717,6 +717,14 @@ def serving_host_leg(u_mem) -> dict:
                            f"failed: {errs[0].error!r}")
     snap = sched.telemetry.snapshot()
     sched.telemetry.log(leg="serving_host")
+    # the unified observability metrics block (docs/OBSERVABILITY.md):
+    # one JSON document over the live registry (runs, reliability
+    # counters, queue-wait/latency histograms) plus the phase timers
+    # and this leg's serving telemetry — schema pinned by
+    # tests/test_bench_contract.py so metric renames break loudly
+    from mdanalysis_mpi_tpu.obs import unified_snapshot
+    from mdanalysis_mpi_tpu.utils.timers import TIMERS
+
     return {
         "serving_n_jobs": len(handles),
         "serving_jobs_per_s": round(len(handles) / wall, 2),
@@ -727,6 +735,8 @@ def serving_host_leg(u_mem) -> dict:
         "serving_coalesce_rate": snap["coalesce_rate"],
         "serving_coalesce_batches": snap["coalesce_batches"],
         "serving_backend": "serial",
+        "metrics": unified_snapshot(timers=TIMERS,
+                                    telemetry=sched.telemetry),
     }
 
 
@@ -815,6 +825,39 @@ def main():
           f"{baseline_fps:.1f}")
     _leg_done("serial in-memory leg", serial_fps=round(serial_fps, 2),
               baseline_fps=round(baseline_fps, 2))
+
+    # observability overhead leg (docs/OBSERVABILITY.md): the SAME
+    # flagship host protocol with span tracing recording in memory,
+    # against the tracing-off serial leg just measured — the delta is
+    # the price of turning the timeline on (target <3%; tracing-off
+    # overhead is a shared no-op span and is not separately
+    # measurable).  Host-side by construction: survives the outage
+    # protocol like every leg before first jax contact.
+    from mdanalysis_mpi_tpu import obs as _obs
+
+    if _obs.tracing_enabled():
+        # the operator asked for a real trace (MDTPU_TRACE_OUT): the
+        # "off" baseline above was already traced, so the delta would
+        # be a lie — and enable/discard here would clobber their file.
+        # Disclose instead of silently passing the target.
+        _note("[bench] obs overhead leg skipped: tracing already on")
+        _leg_done("obs overhead leg (skipped: tracing already on)",
+                  obs_traced_fps=None, obs_overhead_pct=None,
+                  obs_overhead_note="tracing enabled for the whole "
+                                    "bench (MDTPU_TRACE_OUT); the "
+                                    "on-vs-off delta is unmeasurable")
+    else:
+        _obs.enable_tracing()              # in-memory, no export file
+        obs_traced_fps, _ = timed_serial(u_mem)
+        _obs.disable_tracing(discard=True)
+        obs_overhead_pct = round(
+            max(0.0,
+                (serial_fps - obs_traced_fps) / serial_fps * 100.0), 2)
+        _note(f"[bench] obs overhead: traced {obs_traced_fps:.1f} f/s "
+              f"vs {serial_fps:.1f} -> {obs_overhead_pct}%")
+        _leg_done("obs overhead leg",
+                  obs_traced_fps=round(obs_traced_fps, 2),
+                  obs_overhead_pct=obs_overhead_pct)
 
     # serving telemetry, HOST side (service/ scheduler, serial backend
     # — still before any jax touch): survives a tunnel-down run per

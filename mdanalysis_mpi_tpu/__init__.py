@@ -48,7 +48,7 @@ def __getattr__(name):
         from mdanalysis_mpi_tpu.io.writer import Writer
 
         return Writer
-    if name in ("analysis", "ops", "parallel", "io", "utils"):
+    if name in ("analysis", "ops", "parallel", "io", "utils", "obs"):
         import importlib
         try:
             return importlib.import_module(f"mdanalysis_mpi_tpu.{name}")

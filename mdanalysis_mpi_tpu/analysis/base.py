@@ -80,7 +80,13 @@ def _materialize(value):
     if jax is not None and isinstance(value, jax.Array):
         import numpy as np
 
-        return np.asarray(value)
+        from mdanalysis_mpi_tpu.obs.spans import span as _span
+
+        # the deferred device→host readback: the "fetch" leaf of the
+        # span model (docs/OBSERVABILITY.md) — on tunneled targets this
+        # is where "device time" actually surfaces on the timeline
+        with _span("fetch"):
+            return np.asarray(value)
     return value
 
 
@@ -314,8 +320,11 @@ class AnalysisBase:
                 **executor_kwargs)
         import time
 
+        from mdanalysis_mpi_tpu import obs
         from mdanalysis_mpi_tpu.utils.timers import TIMERS
 
+        obs.maybe_enable_from_env()
+        cap = obs.start_run_capture()
         t0 = time.perf_counter()
         if not self._accepts_updating_groups:
             self._refuse_updating_groups()
@@ -326,23 +335,35 @@ class AnalysisBase:
         # first-frame-derived grids — use this instead of re-deriving)
         self._frame_indices = frames
         executor = get_executor(backend, **executor_kwargs)
-        with TIMERS.phase("prepare"):
-            self._prepare()
-        with TIMERS.phase("execute"):
-            total = executor.execute(self, self._universe.trajectory, frames,
-                                     batch_size=batch_size)
-        # raw partials handle: a fetch-free synchronization point for
-        # benchmarks (jax.block_until_ready drains the device queue
-        # without the readback that collapses tunneled links)
-        self._last_total = total
-        with TIMERS.phase("conclude"):
-            self._conclude(total)
+        backend_name = getattr(executor, "name", type(executor).__name__)
+        with obs.span("run", analysis=type(self).__name__,
+                      backend=backend_name, n_frames=self.n_frames):
+            with TIMERS.phase("prepare"):
+                self._prepare()
+            with TIMERS.phase("execute"):
+                total = executor.execute(self, self._universe.trajectory,
+                                         frames, batch_size=batch_size)
+            # raw partials handle: a fetch-free synchronization point for
+            # benchmarks (jax.block_until_ready drains the device queue
+            # without the readback that collapses tunneled links)
+            self._last_total = total
+            with TIMERS.phase("conclude"):
+                self._conclude(total)
+        obs.METRICS.inc("mdtpu_runs_total", backend=backend_name)
+        self.results.observability = obs.finish_run_capture(
+            cap, analysis=type(self).__name__, backend=backend_name,
+            n_frames=self.n_frames)
+        if obs.trace_path():
+            # file-backed tracing: keep the trace on disk current after
+            # every run (atomic rewrite), so a crash or kill still
+            # leaves a loadable timeline of everything completed
+            obs.export_trace()
         if self._verbose:
             from mdanalysis_mpi_tpu.utils.log import log_event
 
             wall = time.perf_counter() - t0
             log_event("run", analysis=type(self).__name__,
-                      backend=getattr(executor, "name", type(executor).__name__),
+                      backend=backend_name,
                       n_frames=self.n_frames, wall_s=round(wall, 4),
                       fps=round(self.n_frames / wall, 2) if wall > 0 else None)
         return self

@@ -462,16 +462,38 @@ class AlignedRMSF(AnalysisBase):
         # with its own checkpoint fingerprint and degradation chain
         # (docs/RELIABILITY.md), so it rides the child run() calls
         # below, never the executor constructor.
+        from mdanalysis_mpi_tpu import obs
+
         resilient = kwargs.pop("resilient", False)
         backend, kwargs = self._setup_backend(backend, kwargs)
-        avg = self._make_pass1().run(
-            start, stop, step, frames=frames, backend=backend,
-            batch_size=batch_size, resilient=resilient, **kwargs)
-        moments_pass = self._make_pass2(avg)
-        moments_pass.run(start, stop, step, frames=frames, backend=backend,
-                         batch_size=batch_size, resilient=resilient,
-                         **kwargs)
+        backend_name = (backend if isinstance(backend, str)
+                        else getattr(backend, "name",
+                                     type(backend).__name__))
+        obs.maybe_enable_from_env()
+        cap = obs.start_run_capture()
+        with obs.span("run", analysis=type(self).__name__,
+                      backend=backend_name):
+            with obs.span("pass", index=1, analysis="AverageStructure"):
+                avg = self._make_pass1().run(
+                    start, stop, step, frames=frames, backend=backend,
+                    batch_size=batch_size, resilient=resilient, **kwargs)
+            moments_pass = self._make_pass2(avg)
+            with obs.span("pass", index=2,
+                          analysis="_MomentsToReference"):
+                moments_pass.run(start, stop, step, frames=frames,
+                                 backend=backend, batch_size=batch_size,
+                                 resilient=resilient, **kwargs)
         self._finalize(moments_pass)
+        # the multi-pass RunReport covers BOTH passes (the child runs
+        # attach their own per-pass reports to internal analyses the
+        # user never sees)
+        self.results.observability = obs.finish_run_capture(
+            cap, analysis=type(self).__name__, backend=backend_name,
+            n_frames=self.n_frames)
+        if obs.trace_path():
+            # the child runs' auto-exports happened BEFORE the outer
+            # run/pass spans closed; re-export so the file carries them
+            obs.export_trace()
         if resilient:
             # the per-pass reports land on the (internal) child
             # analyses; merge them to the surface the user reads

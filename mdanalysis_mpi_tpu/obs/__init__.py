@@ -1,0 +1,50 @@
+"""Unified observability layer (docs/OBSERVABILITY.md).
+
+Three pieces over one package:
+
+- :mod:`~mdanalysis_mpi_tpu.obs.spans` — hierarchical span tracing
+  exported as Chrome trace-event JSON (Perfetto / ``chrome://tracing``),
+  finally making the prefetch-vs-dispatch overlap visible on a real
+  per-thread timeline.  Off by default; enabled by ``MDTPU_TRACE_OUT``
+  / ``--trace-out`` / :func:`enable_tracing`, and NEAR-FREE when
+  disabled (shared no-op span, no allocation).
+- :mod:`~mdanalysis_mpi_tpu.obs.metrics` — a counters/gauges/histograms
+  registry unifying what ``PhaseTimers``, ``BlockCache``,
+  ``ServiceTelemetry`` and the reliability report each track privately,
+  snapshotable as one JSON document and as Prometheus text exposition.
+- :mod:`~mdanalysis_mpi_tpu.obs.report` — the per-run ``RunReport``
+  attached under ``results.observability``.
+
+Import layering: this package imports ONLY the standard library — the
+rest of the repo (timers, executors, service, reliability) imports it,
+never the reverse, so instrumentation can thread anywhere without
+cycles.
+"""
+
+from mdanalysis_mpi_tpu.obs.metrics import (
+    METRICS, MetricsRegistry, to_prometheus, unified_snapshot,
+)
+from mdanalysis_mpi_tpu.obs.report import finish_capture, start_capture
+from mdanalysis_mpi_tpu.obs.spans import (
+    context as trace_context,
+    disable as disable_tracing,
+    enable as enable_tracing,
+    enabled as tracing_enabled,
+    export as export_trace,
+    maybe_enable_from_env,
+    span,
+    span_event,
+    trace_path,
+)
+
+# run-capture helpers under their obs.* names (AnalysisBase.run calls
+# obs.start_run_capture / obs.finish_run_capture)
+start_run_capture = start_capture
+finish_run_capture = finish_capture
+
+__all__ = [
+    "METRICS", "MetricsRegistry", "to_prometheus", "unified_snapshot",
+    "span", "span_event", "trace_context", "enable_tracing",
+    "disable_tracing", "tracing_enabled", "export_trace", "trace_path",
+    "maybe_enable_from_env", "start_run_capture", "finish_run_capture",
+]

@@ -1,0 +1,211 @@
+"""Metrics registry: counters, gauges, histograms — one snapshot.
+
+Before this module, four subsystems each tracked their numbers
+privately: ``PhaseTimers`` (phase seconds/calls), ``BlockCache``
+(hit/miss/bytes), ``ServiceTelemetry`` (job lifecycle, coalesce and
+admission counters, latency percentiles), and the reliability report
+(retries, drops, fallbacks).  The registry unifies them behind one
+schema:
+
+- **Live series** — recorded directly by instrumented code paths into
+  the process-global :data:`METRICS`: run counts, reliability retry /
+  drop / fallback / fault counters, and the fixed-bucket queue-wait and
+  job-latency histograms the scheduler feeds per finished job.
+- **Collected series** — adapters in :func:`unified_snapshot` pull a
+  ``PhaseTimers``, a ``BlockCache`` and a ``ServiceTelemetry`` into the
+  same document at snapshot time (they stay the single source of truth
+  for their own numbers; the registry does not fork the accounting).
+
+Snapshot shape (JSON-friendly, pinned by
+``tests/test_bench_contract.py``)::
+
+    {"mdtpu_runs_total": {"type": "counter",
+                          "values": {'backend="serial"': 3}},
+     "mdtpu_queue_wait_seconds": {"type": "histogram",
+        "values": {"": {"count": 9, "sum": 0.04,
+                        "buckets": {"0.001": 2, ..., "+Inf": 9}}}},
+     ...}
+
+:func:`to_prometheus` renders the same snapshot as Prometheus text
+exposition (``# TYPE`` lines, cumulative ``_bucket{le=...}`` series).
+Everything is lock-guarded; recording costs one lock + dict update —
+cheap enough to stay always-on (per block / per job, never per frame).
+"""
+
+from __future__ import annotations
+
+import threading
+
+#: Fixed histogram buckets for queue-wait / latency seconds ("le"
+#: upper bounds; "+Inf" is implicit).  Fixed by design: merged or
+#: long-lived snapshots stay comparable across processes and rounds.
+TIME_BUCKETS = (0.001, 0.005, 0.02, 0.1, 0.5, 1.0, 5.0, 30.0, 120.0)
+
+
+def label_key(labels: dict) -> str:
+    """Canonical label rendering: ``k="v"`` pairs, sorted, joined by
+    commas; "" for the unlabeled series."""
+    return ",".join(f'{k}="{labels[k]}"' for k in sorted(labels))
+
+
+class MetricsRegistry:
+    """Counters, gauges and fixed-bucket histograms, keyed by
+    ``(name, labels)``; one JSON snapshot, one Prometheus rendering."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # name -> {"type": t, "values": {label_key: scalar | hist}}
+        self._series: dict[str, dict] = {}
+        self._buckets: dict[str, tuple] = {}
+
+    def _values(self, name: str, typ: str) -> dict:
+        s = self._series.get(name)
+        if s is None:
+            s = {"type": typ, "values": {}}
+            self._series[name] = s
+        elif s["type"] != typ:
+            raise ValueError(
+                f"metric {name!r} is a {s['type']}, not a {typ}")
+        return s["values"]
+
+    def inc(self, name: str, value: float = 1, **labels) -> None:
+        key = label_key(labels)
+        with self._lock:
+            vals = self._values(name, "counter")
+            vals[key] = vals.get(key, 0) + value
+
+    def set_gauge(self, name: str, value: float, **labels) -> None:
+        with self._lock:
+            self._values(name, "gauge")[label_key(labels)] = value
+
+    def observe(self, name: str, value: float,
+                buckets: tuple = TIME_BUCKETS, **labels) -> None:
+        key = label_key(labels)
+        with self._lock:
+            bk = self._buckets.setdefault(name, tuple(buckets))
+            vals = self._values(name, "histogram")
+            h = vals.get(key)
+            if h is None:
+                h = {"count": 0, "sum": 0.0,
+                     "buckets": [0] * (len(bk) + 1)}
+                vals[key] = h
+            h["count"] += 1
+            h["sum"] += float(value)
+            # cumulative counts, the Prometheus "le" convention
+            for i, le in enumerate(bk):
+                if value <= le:
+                    h["buckets"][i] += 1
+            h["buckets"][-1] += 1                    # +Inf
+
+    def snapshot(self) -> dict:
+        """Deep-copied JSON document of every series (see module
+        docstring for the shape)."""
+        with self._lock:
+            out = {}
+            for name, s in self._series.items():
+                if s["type"] == "histogram":
+                    bk = self._buckets[name]
+                    les = [repr(float(le)) for le in bk] + ["+Inf"]
+                    vals = {
+                        k: {"count": h["count"],
+                            "sum": round(h["sum"], 6),
+                            "buckets": dict(zip(les, h["buckets"]))}
+                        for k, h in s["values"].items()}
+                else:
+                    vals = dict(s["values"])
+                out[name] = {"type": s["type"], "values": vals}
+            return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._series.clear()
+            self._buckets.clear()
+
+
+#: Process-global default registry — the live-series sink for
+#: AnalysisBase.run, the scheduler, and the reliability runtime.
+METRICS = MetricsRegistry()
+
+
+#: ServiceTelemetry snapshot key → metric name (all counters except the
+#: two depth gauges).  One table so the adapter and the schema test
+#: cannot drift apart.
+_TELEMETRY_COUNTERS = (
+    "jobs_submitted", "jobs_completed", "jobs_failed", "jobs_expired",
+    "coalesced_jobs", "coalesce_batches", "solo_jobs",
+    "uncoalescable_jobs", "coalesce_fallbacks", "admission_reserved",
+    "admission_resident", "admission_deferrals", "admission_uncached",
+    "admission_evictions",
+)
+_TELEMETRY_GAUGES = ("queue_depth", "queue_depth_peak")
+
+
+def unified_snapshot(timers=None, cache=None, telemetry=None,
+                     registry: MetricsRegistry | None = None) -> dict:
+    """One JSON document over the registry's live series PLUS the
+    private trackers handed in:
+
+    - ``timers`` (a :class:`~mdanalysis_mpi_tpu.utils.timers.
+      PhaseTimers`) → ``mdtpu_phase_seconds_total`` /
+      ``mdtpu_phase_calls_total`` per phase label;
+    - ``cache`` (a :class:`~mdanalysis_mpi_tpu.io.base.BlockCache`) →
+      hit/miss counters and byte gauges;
+    - ``telemetry`` (a :class:`~mdanalysis_mpi_tpu.service.telemetry.
+      ServiceTelemetry`) → the job lifecycle / coalesce / admission
+      counters and queue-depth gauges.
+
+    This is the ``metrics`` block bench legs embed and the schema
+    ``tests/test_bench_contract.py`` pins.
+    """
+    snap = (registry or METRICS).snapshot()
+    if timers is not None:
+        rep = timers.report()
+        snap["mdtpu_phase_seconds_total"] = {
+            "type": "counter",
+            "values": {label_key({"phase": k}): v["seconds"]
+                       for k, v in rep.items()}}
+        snap["mdtpu_phase_calls_total"] = {
+            "type": "counter",
+            "values": {label_key({"phase": k}): v["calls"]
+                       for k, v in rep.items()}}
+    if cache is not None:
+        snap["mdtpu_cache_hits_total"] = {
+            "type": "counter", "values": {"": cache.hits}}
+        snap["mdtpu_cache_misses_total"] = {
+            "type": "counter", "values": {"": cache.misses}}
+        snap["mdtpu_cache_bytes"] = {
+            "type": "gauge", "values": {"": cache._bytes}}
+        snap["mdtpu_cache_max_bytes"] = {
+            "type": "gauge", "values": {"": cache.max_bytes}}
+    if telemetry is not None:
+        t = telemetry.snapshot()
+        for key in _TELEMETRY_COUNTERS:
+            snap[f"mdtpu_{key}_total"] = {
+                "type": "counter", "values": {"": t[key]}}
+        for key in _TELEMETRY_GAUGES:
+            snap[f"mdtpu_{key}"] = {
+                "type": "gauge", "values": {"": t[key]}}
+    return snap
+
+
+def to_prometheus(snapshot: dict | None = None) -> str:
+    """Render a snapshot (default: the global registry's) as
+    Prometheus text exposition."""
+    if snapshot is None:
+        snapshot = METRICS.snapshot()
+    lines: list[str] = []
+    for name in sorted(snapshot):
+        m = snapshot[name]
+        lines.append(f"# TYPE {name} {m['type']}")
+        for lk, v in sorted(m["values"].items()):
+            if m["type"] == "histogram":
+                for le, c in v["buckets"].items():
+                    lbl = (lk + "," if lk else "") + f'le="{le}"'
+                    lines.append(f"{name}_bucket{{{lbl}}} {c}")
+                suffix = f"{{{lk}}}" if lk else ""
+                lines.append(f"{name}_sum{suffix} {v['sum']}")
+                lines.append(f"{name}_count{suffix} {v['count']}")
+            else:
+                suffix = f"{{{lk}}}" if lk else ""
+                lines.append(f"{name}{suffix} {v}")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,71 @@
+"""RunReport: one run's observability summary under
+``results.observability``.
+
+``AnalysisBase.run`` (and the multi-pass flagship's ``run`` override)
+captures the process-global phase timers before and after the run and
+attaches the DELTA — the run's own window into the timers, not the
+process's whole history — together with wall time, the dispatch count,
+the active ``scan_k``, and where the trace (if any) is being written.
+A plain JSON-friendly dict: ``.npz``/CLI serialization filters it out
+automatically (dicts are not arrays) and notebooks read it directly.
+
+Caveat (documented, not fixable at this altitude): the deltas are a
+TIME-WINDOW slice of the process-global ``TIMERS``, so when runs
+overlap — a multi-worker scheduler serving two jobs at once — each
+report's phases/dispatch_count include whatever the OTHER run recorded
+inside the window.  Per-job attribution under concurrency is the span
+trace's job (job-id-stamped spans, docs/OBSERVABILITY.md); the report
+is exact whenever runs don't overlap (solo runs, the default
+1-worker scheduler).
+
+Near-free by construction: capture is two small dict copies per run()
+call, nothing per frame or per block.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+def start_capture() -> dict:
+    """Snapshot the run-scoped baselines (call at run() entry)."""
+    from mdanalysis_mpi_tpu.utils.timers import TIMERS
+
+    acc, calls = TIMERS.snapshot()
+    return {"t0": time.perf_counter(), "acc": acc, "calls": calls}
+
+
+def finish_capture(cap: dict, analysis: str, backend: str,
+                   n_frames: int) -> dict:
+    """Build the RunReport dict from a :func:`start_capture` baseline."""
+    from mdanalysis_mpi_tpu.obs import spans as _spans
+    from mdanalysis_mpi_tpu.parallel import executors as _executors
+    from mdanalysis_mpi_tpu.utils.timers import TIMERS
+
+    wall = time.perf_counter() - cap["t0"]
+    acc1, calls1 = TIMERS.snapshot()
+    acc0, calls0 = cap["acc"], cap["calls"]
+    phases = {}
+    for name in acc1:
+        ds = acc1[name] - acc0.get(name, 0.0)
+        dc = calls1.get(name, 0) - calls0.get(name, 0)
+        if dc or ds > 0:
+            phases[name] = {"seconds": round(ds, 6), "calls": dc}
+    dispatches = calls1.get("dispatch", 0) - calls0.get("dispatch", 0)
+    report = {
+        "analysis": analysis,
+        "backend": backend,
+        "n_frames": n_frames,
+        "wall_s": round(wall, 6),
+        "fps": round(n_frames / wall, 2) if wall > 0 else None,
+        # per-phase seconds/calls for THIS run; staging overlaps device
+        # compute (prefetch thread), so the per-phase sum may exceed
+        # wall_s — that overlap is what the span trace makes visible
+        # (docs/OBSERVABILITY.md)
+        "phases": phases,
+        "dispatch_count": dispatches,
+        "scan_k": _executors.LAST_SCAN_K,
+        "tracing": _spans.enabled(),
+        "trace_out": _spans.trace_path(),
+    }
+    return report

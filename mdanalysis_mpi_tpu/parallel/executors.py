@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from mdanalysis_mpi_tpu.obs import spans as _spans
 from mdanalysis_mpi_tpu.parallel.partition import iter_batches, pad_batch
 from mdanalysis_mpi_tpu.reliability import faults as _faults
 from mdanalysis_mpi_tpu.utils.timers import TIMERS
@@ -892,10 +893,13 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
         q_fused = None if validate else q_inline
         if contiguous and stage is not None:
             # fused native gather(+quantize); see stage selection above
-            block, boxes, inv_scale = stage(
-                batch_frames[0], batch_frames[-1] + 1, sel_idx, q_fused)
+            with _spans.span("read", n_frames=len(batch_frames)):
+                block, boxes, inv_scale = stage(
+                    batch_frames[0], batch_frames[-1] + 1, sel_idx,
+                    q_fused)
         else:
-            block, boxes = _stage(reader, batch_frames, sel_idx)
+            with _spans.span("read", n_frames=len(batch_frames)):
+                block, boxes = _stage(reader, batch_frames, sel_idx)
             inv_scale = None
         if _faults.plans():
             block = _faults.fire("stage", frames=batch_frames, array=block)
@@ -953,6 +957,12 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
             cache.put(key, staged, nbytes)
         return staged
 
+    # trace-context hand-off: `prepare` runs on the prefetch thread,
+    # and the span context (job/tenant attribution the scheduler set)
+    # is thread-local — capture it on the submitting thread so staging
+    # spans carry the same job ids as the dispatch spans they overlap
+    trace_ctx = _spans.current_context()
+
     def prepare(ab):
         """Host side of one batch: read+gather (+quantize) and enqueue
         the device transfer.  Runs on the prefetch thread so the next
@@ -966,7 +976,8 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
             staged = cache.get(key)
             if staged is not None:
                 return staged, 0
-        with TIMERS.phase("stage"):
+        with _spans.saved_context(trace_ctx), \
+                TIMERS.phase("stage", lo=a, hi=b):
             staged, nbytes = _stage_op(frames[a:b])
         return _place(staged, key, nbytes), nbytes
 
@@ -978,7 +989,7 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
 
     def consume(staged):
         nonlocal total
-        with TIMERS.phase("dispatch"):
+        with TIMERS.phase("dispatch", scan_k=1):
 
             def _dispatch():
                 if _faults.plans():
@@ -1028,7 +1039,10 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
         def consume_scan(stacked):
             """ONE dispatch for a whole HBM-resident K-block group."""
             nonlocal total
-            with TIMERS.phase("dispatch"):
+            # span tag: this single dispatch covers a K-block scan
+            # group (the dispatch-count shrink docs/DISPATCH.md claims)
+            with TIMERS.phase("dispatch", scan_k=scan_k,
+                              blocks=int(stacked[0].shape[0])):
 
                 def _dispatch():
                     if _faults.plans():
@@ -1115,7 +1129,7 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
                     items.append((None, hit, key, 0))
                     continue
                 a, b = ab
-                with TIMERS.phase("stage"):
+                with TIMERS.phase("stage", lo=a, hi=b):
                     staged_host, nbytes = _stage_op(frames[a:b])
                 items.append((staged_host, None, key, nbytes))
             placed: dict[int, tuple] = {}

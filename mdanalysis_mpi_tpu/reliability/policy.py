@@ -264,11 +264,27 @@ class ReliabilityRuntime:
 
     def note_retry(self, site: str, exc) -> None:
         self.report.note_retry(site)
+        from mdanalysis_mpi_tpu.obs import METRICS, span_event
         from mdanalysis_mpi_tpu.utils.log import get_logger
 
+        reason = "deadline miss" if exc is None else type(exc).__name__
+        # reliability incidents as trace instants: a retry lands ON the
+        # timeline next to the span it delayed (docs/OBSERVABILITY.md)
+        span_event("retry", site=site, reason=reason)
+        METRICS.inc("mdtpu_retries_total", site=site)
         get_logger("mdtpu.reliability").info(
             "retrying %s op (%s)", site,
             "deadline miss" if exc is None else exc)
+
+    def _note_read_retry(self) -> None:
+        """Per-frame salvage re-read bookkeeping: report counter plus
+        the observability mirrors (no log line — a long salvage loop
+        must not spam INFO)."""
+        self.report.note_retry("read")
+        from mdanalysis_mpi_tpu.obs import METRICS, span_event
+
+        span_event("retry", site="read", reason="corrupt-or-transient")
+        METRICS.inc("mdtpu_retries_total", site="read")
 
     # ---- corrupt-frame validation + salvage ----
 
@@ -287,7 +303,7 @@ class ReliabilityRuntime:
         n_full = reader.n_atoms
         for attempt in range(self.policy.max_retries + 1):
             if attempt:
-                self.report.note_retry("read")
+                self._note_read_retry()
                 time.sleep(self.policy.backoff_s
                            * self.policy.backoff_factor ** (attempt - 1))
             try:
@@ -312,6 +328,10 @@ class ReliabilityRuntime:
             # distinct frame charges the max_dropped_frames budget once
             return
         self.report.dropped_frames.append(int(frame))
+        from mdanalysis_mpi_tpu.obs import METRICS, span_event
+
+        span_event("frame_drop", frame=int(frame))
+        METRICS.inc("mdtpu_dropped_frames_total")
         pol = self.policy
         from mdanalysis_mpi_tpu.utils.log import get_logger
 
@@ -377,7 +397,7 @@ class ReliabilityRuntime:
         n_full = reader.n_atoms
         for attempt in range(pol.max_retries + 1):
             if attempt:
-                self.report.note_retry("read")
+                self._note_read_retry()
                 time.sleep(pol.backoff_s
                            * pol.backoff_factor ** (attempt - 1))
             try:
@@ -465,6 +485,13 @@ class FallbackChain:
                           from_backend=getattr(ex, "name", "?"),
                           to_backend=getattr(nxt, "name", "?"),
                           error=str(exc))
+                from mdanalysis_mpi_tpu.obs import METRICS, span_event
+
+                span_event("executor_fallback",
+                           from_backend=getattr(ex, "name", "?"),
+                           to_backend=getattr(nxt, "name", "?"),
+                           error=type(exc).__name__)
+                METRICS.inc("mdtpu_executor_fallbacks_total")
                 if self._runtime is not None:
                     self._runtime.report.note_fallback(
                         getattr(ex, "name", "?"),

@@ -41,7 +41,8 @@ import time
 
 import numpy as np
 
-_JOB_FIELDS = ("priority", "deadline_s", "coalesce", "tenant")
+_JOB_FIELDS = ("priority", "deadline_s", "coalesce", "tenant",
+               "trace_id")
 
 
 def _build_job(spec: dict, defaults: dict, universe):
@@ -85,7 +86,20 @@ def batch_main(argv=None, universe=None) -> int:
                     "scheduler (request coalescing + shared-cache "
                     "admission; docs/SERVICE.md)")
     p.add_argument("jobs_file", help="JSON job file (see module docs)")
+    p.add_argument("--trace-out", default=None, metavar="FILE",
+                   help="write a Chrome trace-event JSON of every "
+                        "served pass's spans to FILE (open in Perfetto; "
+                        "merged passes carry all member job ids — env "
+                        "twin MDTPU_TRACE_OUT, docs/OBSERVABILITY.md)")
     ns = p.parse_args(argv)
+
+    import os
+
+    from mdanalysis_mpi_tpu import obs
+
+    trace_out = ns.trace_out or os.environ.get("MDTPU_TRACE_OUT")
+    if trace_out:
+        obs.enable_tracing(trace_out)
     with open(ns.jobs_file) as f:
         spec = json.load(f)
 
@@ -165,8 +179,11 @@ def batch_main(argv=None, universe=None) -> int:
                 rec["output"] = output
         records.append(rec)
 
+    if trace_out:
+        obs.export_trace(trace_out)
     print(json.dumps({
         "jobs": records, "wall_s": round(wall, 4),
         "serving": sched.telemetry.snapshot(cache=cache),
+        "trace_out": trace_out,
     }))
     return rc

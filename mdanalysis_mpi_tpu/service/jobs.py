@@ -82,6 +82,14 @@ class AnalysisJob:
         solo pass).
     ``tenant``
         Opaque label for telemetry/log attribution.
+    ``trace_id``
+        Opaque span-trace correlation id (docs/OBSERVABILITY.md).
+        None → the scheduler derives one from the job id at submission.
+        Propagated through the coalesced pass: every span a merged
+        pass records carries the trace ids of ALL member jobs, so a
+        shared timeline attributes to each tenant.  Deliberately NOT
+        part of the coalesce key — two requests must not fail to merge
+        because their trace ids differ.
     """
 
     analysis: object
@@ -97,6 +105,7 @@ class AnalysisJob:
     resilient: object = False
     coalesce: bool = True
     tenant: str = "default"
+    trace_id: str | None = None
 
     def __post_init__(self):
         from mdanalysis_mpi_tpu.reliability.policy import (

@@ -37,9 +37,11 @@ not take down the batch it coalesced into).
 
 from __future__ import annotations
 
+import contextlib
 import itertools
 import threading
 
+from mdanalysis_mpi_tpu import obs
 from mdanalysis_mpi_tpu.service import coalesce as _coalesce
 from mdanalysis_mpi_tpu.service.jobs import (
     AnalysisJob, JobDeadlineExpired, JobHandle, JobState,
@@ -159,6 +161,12 @@ class Scheduler:
         else:
             job = AnalysisJob(job, **kwargs)
         handle = JobHandle(job)
+        if job.trace_id is None:
+            # derived span-trace correlation id (docs/OBSERVABILITY.md):
+            # stable per submission, carried by every span the job's
+            # pass records — including a merged pass's, which carries
+            # ALL member trace ids
+            job.trace_id = f"job-{handle.job_id}"
         # everything under one condition acquisition (its lock is
         # re-entrant), with the shutdown check FIRST: a rejected
         # submission must leave no side effects — in particular no
@@ -416,6 +424,11 @@ class Scheduler:
 
     def _run_unit(self, unit) -> bool:
         """Admit + execute one unit; False when it was deferred."""
+        # honor MDTPU_TRACE_OUT BEFORE entering the trace context: the
+        # context is a no-op while tracing is off, and waiting for the
+        # run() inside to enable it would leave THIS unit's spans
+        # without their job attribution
+        obs.maybe_enable_from_env()
         run_now, reserved = self._admit(unit)
         if not run_now:
             return False
@@ -432,8 +445,22 @@ class Scheduler:
             kwargs["block_cache"] = self.cache
         for h in unit.handles:
             h._mark_running()
+        # span attribution (docs/OBSERVABILITY.md): every member job's
+        # id/tenant/trace id rides the serve_job span, and the thread
+        # context stamps them onto every span the pass records below
+        # (run, stage, dispatch, ...) — a merged pass's timeline
+        # attributes to EVERY member, not just the claiming job
+        attrs = dict(
+            job_ids=[h.job_id for h in unit.handles],
+            tenants=[h.job.tenant for h in unit.handles],
+            trace_ids=[h.job.trace_id for h in unit.handles])
+        merged_span = (obs.span("coalesced_pass",
+                                n_jobs=len(unit.handles))
+                       if unit.coalesced else contextlib.nullcontext())
         try:
-            with TIMERS.phase("serve_job"):
+            with obs.trace_context(**attrs), \
+                    TIMERS.phase("serve_job", coalesced=unit.coalesced), \
+                    merged_span:
                 unit.runnable.run(backend=job.backend,
                                   batch_size=job.batch_size,
                                   resilient=job.resilient,
@@ -464,12 +491,22 @@ class Scheduler:
                 # (or were rejected by the cache's own cap check);
                 # either way the reservation's job is done
                 self.cache.release(reserved)
+            # keep a file-backed trace current after each served unit:
+            # the serve_job span closes AFTER the inner run()'s own
+            # export, so without this the file would always trail the
+            # last unit's serving spans
+            if obs.trace_path():
+                obs.export_trace()
         return True
 
     def _run_solo(self, handle: JobHandle, kwargs: dict) -> None:
         job = handle.job
+        obs.maybe_enable_from_env()      # same contract as _run_unit
         try:
-            with TIMERS.phase("serve_job"):
+            with obs.trace_context(job_ids=[handle.job_id],
+                                   tenants=[job.tenant],
+                                   trace_ids=[job.trace_id]), \
+                    TIMERS.phase("serve_job", coalesced=False):
                 job.analysis.run(backend=job.backend,
                                  batch_size=job.batch_size,
                                  resilient=job.resilient,
@@ -479,3 +516,6 @@ class Scheduler:
         else:
             handle._mark_done()
         self._finish(handle)
+        if obs.trace_path():
+            obs.export_trace()       # same file-currency contract as
+            #                          _run_unit

@@ -92,6 +92,7 @@ class ServiceTelemetry:
     def note_finish(self, handle) -> None:
         """Record a finished handle (any terminal state) with its
         timing samples."""
+        from mdanalysis_mpi_tpu.obs.metrics import METRICS
         from mdanalysis_mpi_tpu.service.jobs import JobState
 
         with self._lock:
@@ -107,6 +108,16 @@ class ServiceTelemetry:
                 self.queue_wait_samples.append(handle.queue_wait_s)
             if handle.latency_s is not None:
                 self.latency_samples.append(handle.latency_s)
+        # fixed-bucket histograms in the process-global metrics
+        # registry (docs/OBSERVABILITY.md): unlike the bounded
+        # percentile deques above, these see EVERY job for the life of
+        # the process — the long-horizon serving distribution
+        if handle.queue_wait_s is not None:
+            METRICS.observe("mdtpu_queue_wait_seconds",
+                            handle.queue_wait_s)
+        if handle.latency_s is not None:
+            METRICS.observe("mdtpu_job_latency_seconds",
+                            handle.latency_s)
 
     def count(self, counter: str, n: int = 1) -> None:
         """Increment a named counter (the scheduler's single entry

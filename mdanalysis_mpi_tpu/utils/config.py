@@ -207,6 +207,11 @@ def _parser() -> argparse.ArgumentParser:
     p.add_argument("--trace", default=None, metavar="DIR",
                    help="write a jax.profiler trace (TensorBoard format) "
                         "of the run to DIR")
+    p.add_argument("--trace-out", default=None, metavar="FILE",
+                   help="write a Chrome trace-event JSON of the run's "
+                        "spans to FILE (open in Perfetto / "
+                        "chrome://tracing; env twin MDTPU_TRACE_OUT — "
+                        "docs/OBSERVABILITY.md)")
     return p
 
 
@@ -235,8 +240,12 @@ def main(argv=None) -> int:
         binsize=ns.binsize, gnm_cutoff=ns.gnm_cutoff,
         wb_order=ns.wb_order, wb_distance=ns.wb_distance,
         wb_angle=ns.wb_angle, water=ns.water)
+    from mdanalysis_mpi_tpu import obs
     from mdanalysis_mpi_tpu.utils.timers import device_trace
 
+    trace_out = ns.trace_out or os.environ.get("MDTPU_TRACE_OUT")
+    if trace_out:
+        obs.enable_tracing(trace_out)
     TIMERS.reset()
     t0 = time.perf_counter()
     with device_trace(ns.trace or os.environ.get("MDTPU_TRACE")):
@@ -247,6 +256,8 @@ def main(argv=None) -> int:
         # end-to-end number
         a.results.materialize()
     wall = time.perf_counter() - t0
+    if trace_out:
+        obs.export_trace(trace_out)
     arrays = {}
     for k, v in a.results.items():
         if isinstance(v, (list, tuple)) and any(
@@ -278,6 +289,7 @@ def main(argv=None) -> int:
         "frames_per_sec": round(a.n_frames / wall, 2) if wall > 0 else None,
         "results": {k: list(v.shape) for k, v in arrays.items()},
         "output": cfg.output, "phases": TIMERS.report(),
+        "trace_out": trace_out,
     }))
     return 0
 

@@ -3,17 +3,27 @@
 Plain-text logs via stdlib ``logging`` plus an optional JSON-lines
 event stream for machine consumption (the bench driver, notebooks).
 Level is controlled by ``MDTPU_LOG`` (default WARNING, so library use
-is silent); ``MDTPU_LOG_JSON=1`` switches events to one-JSON-per-line.
+is silent); ``MDTPU_LOG_JSON=1`` switches events to one-JSON-per-line
+on stderr, and ``MDTPU_LOG_JSON=<file>`` appends the same lines to a
+file — long serving runs persist their event stream without
+redirecting stderr (docs/OBSERVABILITY.md).
+
+Every JSON event carries ``ts`` (wall clock, ISO-8601 UTC), ``pid``
+and ``thread`` — without them a multi-worker serving log cannot be
+correlated with a span trace or across restarts.
 """
 
 from __future__ import annotations
 
+import datetime
 import json
 import logging
 import os
 import sys
+import threading
 
 _CONFIGURED = False
+_FILE_LOCK = threading.Lock()
 
 
 def get_logger(name: str = "mdtpu") -> logging.Logger:
@@ -33,12 +43,35 @@ def get_logger(name: str = "mdtpu") -> logging.Logger:
 def log_event(event: str, **fields) -> None:
     """Emit a structured event.
 
-    JSON line on stderr when ``MDTPU_LOG_JSON=1``; otherwise a normal
-    INFO log record (visible when ``MDTPU_LOG=INFO``).
+    ``MDTPU_LOG_JSON=1`` → one JSON line on stderr;
+    ``MDTPU_LOG_JSON=<path>`` → the same line appended to that file
+    (open-per-event append: survives rotation, needs no handler
+    lifecycle); unset → a normal INFO log record (visible when
+    ``MDTPU_LOG=INFO``).  JSON events carry ``ts``/``pid``/``thread``
+    identity fields; explicit same-named ``fields`` win.
     """
-    if os.environ.get("MDTPU_LOG_JSON") == "1":
-        print(json.dumps({"event": event, **fields}, default=str),
-              file=sys.stderr, flush=True)
+    mode = os.environ.get("MDTPU_LOG_JSON")
+    # the repo-wide knob convention: 0/false/no mean OFF, never a file
+    # named "0" in the cwd
+    if mode in (None, "", "0", "false", "no"):
+        mode = None
+    if mode:
+        rec = {
+            "event": event,
+            "ts": datetime.datetime.now(datetime.timezone.utc)
+            .isoformat(timespec="milliseconds"),
+            "pid": os.getpid(),
+            "thread": threading.current_thread().name,
+            **fields,
+        }
+        line = json.dumps(rec, default=str)
+        if mode in ("1", "true", "yes"):
+            print(line, file=sys.stderr, flush=True)
+        else:
+            # cross-thread append under one lock; cross-process safety
+            # rides POSIX O_APPEND line atomicity for these short lines
+            with _FILE_LOCK, open(mode, "a") as f:
+                f.write(line + "\n")
     else:
         get_logger().info("%s %s", event,
                           " ".join(f"{k}={v}" for k, v in fields.items()))

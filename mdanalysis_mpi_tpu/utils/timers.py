@@ -6,7 +6,9 @@ pinning (RMSF.py:20-25).  The framework replaces that with named phase
 accumulators so a run can be decomposed into host I/O / staging /
 kernel dispatch / conclude time.
 
-Notes on interpreting the numbers:
+Notes on interpreting the numbers (expanded in docs/OBSERVABILITY.md,
+which also shows how to SEE the overlaps these caveats describe on a
+per-thread span timeline):
 
 - Staging runs on a prefetch thread concurrently with device compute
   (double buffering), so phase sums may legitimately exceed the
@@ -15,16 +17,32 @@ Notes on interpreting the numbers:
   time to enqueue a batch kernel, not device execution.  Device time
   shows up as the tail of ``run`` (the final blocking fetch in
   ``_conclude``).
+
+Tracing piggyback: when span tracing is enabled
+(:mod:`mdanalysis_mpi_tpu.obs`), every ``phase()`` block also records a
+span with the same name on the current thread — the one instrumentation
+point that covers stage/dispatch/wire/serve_job everywhere they are
+timed.  Disabled-mode cost is one attribute check.
 """
 
 from __future__ import annotations
 
+import threading
 import time
 from contextlib import contextmanager
+
+from mdanalysis_mpi_tpu.obs import spans as _spans
 
 
 class PhaseTimers:
     """Accumulating named wall-clock phase timers.
+
+    Thread-safe: the process-global :data:`TIMERS` is mutated
+    concurrently by the serving scheduler's worker pool and the
+    executors' prefetch thread, and the unguarded dict read-modify-write
+    this class used to do lost updates under that load (the regression
+    test in ``tests/test_obs.py`` hammers ``phase()`` from N threads
+    and asserts exact call counts).
 
     >>> t = PhaseTimers()
     >>> with t.phase("stage"):
@@ -36,21 +54,30 @@ class PhaseTimers:
     def __init__(self):
         self._acc: dict[str, float] = {}
         self._calls: dict[str, int] = {}
+        self._lock = threading.Lock()
 
     @contextmanager
-    def phase(self, name: str):
+    def phase(self, name: str, **span_args):
+        """Time the enclosed block under ``name``.  ``span_args`` ride
+        the piggybacked span (e.g. ``scan_k``) when tracing is on;
+        they never touch the timer accounting."""
+        sp = _spans.span(name, **span_args)
+        sp.__enter__()
         t0 = time.perf_counter()
         try:
             yield
         finally:
             dt = time.perf_counter() - t0
-            self._acc[name] = self._acc.get(name, 0.0) + dt
-            self._calls[name] = self._calls.get(name, 0) + 1
+            sp.__exit__(None, None, None)
+            with self._lock:
+                self._acc[name] = self._acc.get(name, 0.0) + dt
+                self._calls[name] = self._calls.get(name, 0) + 1
 
     def add(self, name: str, seconds: float) -> None:
         """Record an externally measured duration under ``name``."""
-        self._acc[name] = self._acc.get(name, 0.0) + seconds
-        self._calls[name] = self._calls.get(name, 0) + 1
+        with self._lock:
+            self._acc[name] = self._acc.get(name, 0.0) + seconds
+            self._calls[name] = self._calls.get(name, 0) + 1
 
     def seconds(self, name: str) -> float:
         return self._acc.get(name, 0.0)
@@ -64,14 +91,24 @@ class PhaseTimers:
 
     def report(self) -> dict:
         """{phase: {"seconds": total, "calls": n}} sorted by cost."""
-        return {
-            k: {"seconds": round(self._acc[k], 6), "calls": self._calls[k]}
-            for k in sorted(self._acc, key=self._acc.get, reverse=True)
-        }
+        with self._lock:
+            return {
+                k: {"seconds": round(self._acc[k], 6),
+                    "calls": self._calls[k]}
+                for k in sorted(self._acc, key=self._acc.get,
+                                reverse=True)
+            }
+
+    def snapshot(self) -> tuple[dict, dict]:
+        """Consistent ``(seconds, calls)`` copies — what run-scoped
+        deltas (obs.report) subtract against."""
+        with self._lock:
+            return dict(self._acc), dict(self._calls)
 
     def reset(self) -> None:
-        self._acc.clear()
-        self._calls.clear()
+        with self._lock:
+            self._acc.clear()
+            self._calls.clear()
 
 
 #: Process-global default registry.  Executors and ``AnalysisBase.run``

@@ -46,3 +46,9 @@ def pytest_configure(config):
         "subsystem: scheduler, coalescing, cache admission); in tier-1 "
         "by construction (not slow) and selectable alone with "
         "`pytest -m service`")
+    config.addinivalue_line(
+        "markers",
+        "obs: fast, CPU-only observability tests (obs/ subsystem: "
+        "span tracing, metrics registry, trace export, run reports); "
+        "in tier-1 by construction (not slow) and selectable alone "
+        "with `pytest -m obs`")

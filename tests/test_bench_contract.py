@@ -17,6 +17,34 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+#: The observability metrics-snapshot schema (metric name → type) the
+#: bench artifact's ``metrics`` block must carry — renames break
+#: loudly here AND in the non-slow schema test below
+#: (docs/OBSERVABILITY.md).
+PINNED_METRICS = {
+    "mdtpu_runs_total": "counter",
+    "mdtpu_phase_seconds_total": "counter",
+    "mdtpu_phase_calls_total": "counter",
+    "mdtpu_jobs_submitted_total": "counter",
+    "mdtpu_jobs_completed_total": "counter",
+    "mdtpu_jobs_failed_total": "counter",
+    "mdtpu_jobs_expired_total": "counter",
+    "mdtpu_coalesced_jobs_total": "counter",
+    "mdtpu_coalesce_batches_total": "counter",
+    "mdtpu_solo_jobs_total": "counter",
+    "mdtpu_uncoalescable_jobs_total": "counter",
+    "mdtpu_coalesce_fallbacks_total": "counter",
+    "mdtpu_admission_reserved_total": "counter",
+    "mdtpu_admission_resident_total": "counter",
+    "mdtpu_admission_deferrals_total": "counter",
+    "mdtpu_admission_uncached_total": "counter",
+    "mdtpu_admission_evictions_total": "counter",
+    "mdtpu_queue_depth": "gauge",
+    "mdtpu_queue_depth_peak": "gauge",
+    "mdtpu_queue_wait_seconds": "histogram",
+    "mdtpu_job_latency_seconds": "histogram",
+}
+
 
 @pytest.mark.slow
 def test_bench_json_contract(tmp_path):
@@ -87,8 +115,26 @@ def test_bench_json_contract(tmp_path):
                     "serving_accel_p50_latency_s",
                     "serving_accel_p99_latency_s",
                     "serving_accel_coalesce_rate",
-                    "serving_accel_cache_hit_rate"):
+                    "serving_accel_cache_hit_rate",
+                    # r9: observability — the host-leg tracing-on/off
+                    # delta and the unified metrics block
+                    # (docs/OBSERVABILITY.md)
+                    "obs_overhead_pct", "obs_traced_fps", "metrics"):
             assert key in rec, f"missing {key} in {sorted(rec)}"
+        # observability overhead: tracing must be near-free on the
+        # flagship host protocol (<3% target at flagship scale; this
+        # toy-scale run allows timer noise headroom)
+        assert 0 <= rec["obs_overhead_pct"] < 15
+        assert rec["obs_traced_fps"] > 0
+        # the metrics block carries the pinned schema: names AND types
+        for name, typ in PINNED_METRICS.items():
+            assert name in rec["metrics"], f"missing metric {name}"
+            assert rec["metrics"][name]["type"] == typ
+        # the serving host leg's own activity is visible in the block
+        assert rec["metrics"]["mdtpu_jobs_completed_total"][
+            "values"][""] >= 10
+        assert rec["metrics"]["mdtpu_job_latency_seconds"][
+            "values"][""]["count"] >= 10
         # serving leg sanity: rates are true fractions; wave 2 of the
         # accel leg was actually served from the shared cache; the
         # host leg's mixed-window load keeps coalescing non-trivial
@@ -305,6 +351,37 @@ def test_bench_watch_recovers_mid_horizon(tmp_path):
         for p in glob.glob(os.path.join(REPO, ".bench_data",
                                         "flagship_2000a_96f_*")):
             os.remove(p)
+
+
+def test_metrics_snapshot_schema_pinned():
+    """The unified metrics snapshot (obs/metrics.py) carries every
+    pinned name at its pinned type — the in-process twin of the bench
+    artifact's ``metrics`` block check, running in tier-1 so a rename
+    fails fast without the slow subprocess run."""
+    sys.path.insert(0, REPO)
+    from mdanalysis_mpi_tpu.obs.metrics import (
+        MetricsRegistry, to_prometheus, unified_snapshot,
+    )
+    from mdanalysis_mpi_tpu.service.telemetry import ServiceTelemetry
+    from mdanalysis_mpi_tpu.utils.timers import PhaseTimers
+
+    timers = PhaseTimers()
+    with timers.phase("stage"):
+        pass
+    reg = MetricsRegistry()
+    reg.inc("mdtpu_runs_total", backend="serial")
+    reg.observe("mdtpu_queue_wait_seconds", 0.01)
+    reg.observe("mdtpu_job_latency_seconds", 0.02)
+    snap = unified_snapshot(timers=timers, telemetry=ServiceTelemetry(),
+                            registry=reg)
+    for name, typ in PINNED_METRICS.items():
+        assert name in snap, f"missing metric {name}"
+        assert snap[name]["type"] == typ, name
+    # the document is JSON- and Prometheus-renderable by contract
+    json.dumps(snap)
+    text = to_prometheus(snap)
+    assert "# TYPE mdtpu_jobs_submitted_total counter" in text
+    assert 'mdtpu_queue_wait_seconds_bucket{le="+Inf"} 1' in text
 
 
 def test_roofline_model_fields():
