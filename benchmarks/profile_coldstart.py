@@ -1,0 +1,218 @@
+"""Cold-start profile: the measurement behind docs/COLDSTART.md and
+PERF.md §12.
+
+Two legs, both measurable on the CPU platform (compile seconds,
+cache-miss counts and dispatch/hit counters are platform-local facts —
+the §9e protocol; no tunnel required):
+
+1. **Persistent-compile-cache two-process protocol** — the flagship
+   host protocol (20k-atom heavy-atom AlignedRMSF, int16 staging,
+   DeviceBlockCache, scan-folded dispatch) over the FIRST-CONTACT
+   window ``stop = 2*batch`` (the same window bench.py's cold-compile
+   leg times), run in fresh subprocesses sharing one compile-cache
+   directory.  Each child opens the pre-existing on-disk XTC (a
+   serving worker's trajectory already exists; fixture generation is
+   parent-side) and reports:
+
+   - ``boot_s`` — interpreter start → worker ready (imports + open +
+     executor construction).  Cache-independent by construction (no
+     jax compile happens before the first dispatch); disclosed, not
+     scored.
+   - ``ttfr_s`` — worker ready → first RMSF result materialized.  The
+     serving-system metric: workers import once at boot, the SLA
+     clock starts when work arrives.
+   - compile counters + a result checksum.
+
+   Repeated ``PROFILE_COLD_REPS`` times (fresh cache dir per cold
+   run), scored on the MEDIAN: this host's 2-core timing jitter is
+   larger than the margin, and a single lucky/unlucky pair would
+   over/under-claim.  Scored claims: every warm run compiles ZERO new
+   executables (``mdtpu_compile_cache_misses_total == 0``), results
+   bit-identical, and ``ttfr_bar_met`` records whether the median warm
+   TTFR is ≥50% below cold — NOT met on the CPU platform (compile is
+   only ~37% of cold TTFR here; PERF.md §12a records the negative
+   result and the TPU projection), so the exit code reflects the
+   mechanism claims, not the platform-bound bar.
+
+2. **Scheduler-prefetch wave-1 comparison** — the same 2-tenant burst
+   served twice from fresh caches: once claimed cold (the PR-4
+   baseline schedule) and once with ``Scheduler.prefetch_pending()``
+   staging the queued blocks before any claim.  Reported: each wave-1
+   RUN hit rate (prefetch staging probes excluded) + job parity.
+
+Writes PROFILE_COLDSTART.json (committed) and prints it.
+
+Usage: python benchmarks/profile_coldstart.py
+Scale knobs: PROFILE_COLD_ATOMS / PROFILE_COLD_FRAMES /
+PROFILE_COLD_BATCH / PROFILE_COLD_REPS (defaults sized for a
+CPU-platform record).
+"""
+
+import json
+import os
+import shutil
+import statistics
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N_ATOMS = int(os.environ.get("PROFILE_COLD_ATOMS", "20000"))
+N_FRAMES = int(os.environ.get("PROFILE_COLD_FRAMES", "256"))
+BATCH = int(os.environ.get("PROFILE_COLD_BATCH", "64"))
+N_REPS = int(os.environ.get("PROFILE_COLD_REPS", "3"))
+
+_CHILD = """
+import json, sys, time
+sys.path.insert(0, {repo!r})
+t_start = time.perf_counter()
+import numpy as np
+import bench
+from mdanalysis_mpi_tpu import Universe
+from mdanalysis_mpi_tpu.analysis import AlignedRMSF
+from mdanalysis_mpi_tpu.io.xtc import XTCReader
+from mdanalysis_mpi_tpu.parallel.executors import DeviceBlockCache, JaxExecutor
+from mdanalysis_mpi_tpu.utils import compile_cache as cc
+
+u = Universe(bench.make_topology({atoms}), XTCReader({path!r}))
+ex = JaxExecutor(batch_size={batch}, block_cache=DeviceBlockCache(8 << 30),
+                 transfer_dtype="int16")
+t_ready = time.perf_counter()
+r = AlignedRMSF(u, select=bench.SELECT).run(backend=ex, batch_size={batch},
+                                            stop={stop})
+rmsf = np.asarray(r.results.rmsf)          # first result materialized
+t_done = time.perf_counter()
+c = cc.counters()
+print(json.dumps({{
+    "boot_s": round(t_ready - t_start, 3),
+    "ttfr_s": round(t_done - t_ready, 3),
+    "compiles": c["mdtpu_compile_total"],
+    "compile_seconds": round(c["mdtpu_compile_seconds"], 3),
+    "cache_hits": c["mdtpu_compile_cache_hits_total"],
+    "cache_misses": c["mdtpu_compile_cache_misses_total"],
+    "checksum": float(rmsf.sum())}}))
+"""
+
+
+def _run_child(cache_dir: str, fixture: str) -> dict:
+    with tempfile.NamedTemporaryFile("w", suffix=".py",
+                                     delete=False) as f:
+        f.write(_CHILD.format(repo=REPO, atoms=N_ATOMS, path=fixture,
+                              batch=BATCH,
+                              stop=min(2 * BATCH, N_FRAMES)))
+        path = f.name
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               MDTPU_COMPILE_CACHE_DIR=cache_dir)
+    try:
+        proc = subprocess.run([sys.executable, path], env=env,
+                              capture_output=True, text=True,
+                              timeout=1800)
+        if proc.returncode != 0:
+            raise RuntimeError(proc.stderr[-3000:])
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    finally:
+        os.unlink(path)
+
+
+def _prefetch_leg() -> dict:
+    import bench
+    from mdanalysis_mpi_tpu.analysis import RMSF
+    from mdanalysis_mpi_tpu.parallel.executors import DeviceBlockCache
+    from mdanalysis_mpi_tpu.service import Scheduler
+
+    u = bench.make_system(N_ATOMS, min(N_FRAMES, 2 * BATCH))
+    window = min(N_FRAMES, 2 * BATCH)
+    out = {}
+    results = {}
+    for mode in ("baseline", "prefetch"):
+        cache = DeviceBlockCache(max_bytes=8 << 30)
+        sched = Scheduler(n_workers=1, cache=cache, autostart=False)
+        handles = [sched.submit(
+            RMSF(u.select_atoms(bench.SELECT)), backend="jax",
+            batch_size=BATCH, stop=window,
+            executor_kwargs={"transfer_dtype": "int16"}, tenant=t)
+            for t in ("a", "b")]
+        blocks = sched.prefetch_pending() if mode == "prefetch" else 0
+        h0, m0 = cache.hits, cache.misses
+        t0 = time.perf_counter()
+        sched.start()
+        assert sched.drain(timeout=1800)
+        sched.shutdown()
+        wall = time.perf_counter() - t0
+        errs = [h.error for h in handles if h.error is not None]
+        if errs:
+            raise RuntimeError(f"{mode} serving leg failed: {errs[0]!r}")
+        hits, misses = cache.hits - h0, cache.misses - m0
+        results[mode] = np.asarray(
+            handles[0].result().results.rmsf)
+        out[f"{mode}_wave1_hit_rate"] = (
+            round(hits / (hits + misses), 4) if hits + misses else None)
+        out[f"{mode}_wave1_wall_s"] = round(wall, 3)
+        if mode == "prefetch":
+            out["prefetch_blocks"] = blocks
+        cache.drop()
+    out["parity_max_err"] = float(
+        np.abs(results["baseline"] - results["prefetch"]).max())
+    return out
+
+
+def main():
+    rec = {
+        "metric": (f"cold-start protocol, {N_ATOMS}-atom heavy-atom "
+                   f"AlignedRMSF, first-contact window stop="
+                   f"{min(2 * BATCH, N_FRAMES)} of {N_FRAMES} frames, "
+                   f"batch {BATCH}, int16 staging, file-backed XTC, "
+                   "CPU platform per PERF.md §9e; ttfr_s = worker "
+                   "ready -> first result (boot_s disclosed beside "
+                   "it), median of "
+                   f"{N_REPS} fresh-process pairs"),
+        "n_atoms": N_ATOMS, "n_frames": N_FRAMES, "batch": BATCH,
+        "reps": N_REPS,
+    }
+    import bench
+    import jax
+
+    rec["platform"] = jax.default_backend()
+    rec["jax_version"] = jax.__version__
+
+    fixture = bench.ensure_flagship_xtc(N_ATOMS, N_FRAMES)
+    base = os.environ.get(
+        "PROFILE_COLD_CACHE_DIR",
+        tempfile.mkdtemp(prefix="mdtpu_coldstart_"))
+    colds, warms = [], []
+    for rep in range(N_REPS):
+        cache_dir = os.path.join(base, f"cc{rep}")
+        shutil.rmtree(cache_dir, ignore_errors=True)
+        colds.append(_run_child(cache_dir, fixture))
+        warms.append(_run_child(cache_dir, fixture))
+    rec["cold_runs"] = colds
+    rec["warm_runs"] = warms
+    rec["zero_new_compiles"] = all(
+        w["cache_misses"] == 0 for w in warms)
+    rec["result_parity"] = len(
+        {r["checksum"] for r in colds + warms}) == 1
+    cold_med = statistics.median(c["ttfr_s"] for c in colds)
+    warm_med = statistics.median(w["ttfr_s"] for w in warms)
+    rec["cold_ttfr_median_s"] = cold_med
+    rec["warm_ttfr_median_s"] = warm_med
+    rec["ttfr_reduction_pct"] = round(
+        (cold_med - warm_med) / cold_med * 100, 1)
+    rec["ttfr_bar_met"] = (rec["zero_new_compiles"]
+                           and rec["ttfr_reduction_pct"] >= 50.0)
+
+    rec["serving_prefetch"] = _prefetch_leg()
+
+    out = os.path.join(REPO, "PROFILE_COLDSTART.json")
+    with open(out, "w") as f:
+        json.dump(rec, f, indent=1)
+    print(json.dumps(rec))
+    return 0 if (rec["zero_new_compiles"] and rec["result_parity"]) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

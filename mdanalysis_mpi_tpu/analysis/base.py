@@ -211,6 +211,16 @@ class AnalysisBase:
     def _batch_select(self):
         return None
 
+    def _warmup_analyses(self):
+        """The constructed analyses whose batch kernels an AOT warmup
+        should precompile for this analysis (docs/COLDSTART.md) —
+        ``[self]`` for single-pass analyses.  Multi-pass wrappers
+        (AlignedRMSF) override with their pass analyses, substituting
+        runtime-input placeholders (e.g. a zeros reference) for
+        between-pass data: AOT lowering only bakes shapes/dtypes, so
+        placeholder VALUES never reach a compiled executable."""
+        return [self]
+
     # True when the batch kernel uses in-kernel mesh collectives (ring
     # engines) and therefore cannot run on the single-device backend
     _mesh_only = False

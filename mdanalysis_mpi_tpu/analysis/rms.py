@@ -441,6 +441,18 @@ class AlignedRMSF(AnalysisBase):
             self._universe, self._select, self._avg_sel, self._verbose,
             engine=self._engine)
 
+    def _warmup_analyses(self):
+        """Both pass kernels (docs/COLDSTART.md).  Pass 2's reference
+        coordinates are a runtime input of its kernel, so a zeros
+        placeholder of the right selection shape stands in for the
+        not-yet-computed average — AOT lowering bakes only the
+        shape/dtype."""
+        sel = self._universe.select_atoms(self._select)
+        zeros = np.zeros((len(sel), 3), dtype=np.float32)
+        return [self._make_pass1(),
+                _MomentsToReference(self._universe, self._select, zeros,
+                                    self._verbose, engine=self._engine)]
+
     def _finalize(self, moments_pass):
         t, mean, m2 = moments_pass._total
         self._last_total = moments_pass._total    # fetch-free sync point

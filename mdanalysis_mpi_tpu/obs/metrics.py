@@ -135,9 +135,25 @@ _TELEMETRY_COUNTERS = (
     "coalesced_jobs", "coalesce_batches", "solo_jobs",
     "uncoalescable_jobs", "coalesce_fallbacks", "admission_reserved",
     "admission_resident", "admission_deferrals", "admission_uncached",
-    "admission_evictions",
+    "admission_evictions", "prefetch_jobs", "prefetch_blocks",
+    "prefetch_skipped",
 )
 _TELEMETRY_GAUGES = ("queue_depth", "queue_depth_peak")
+
+#: Compile/AOT counters owned by utils/compile_cache.py (which imports
+#: this table — obs imports stdlib only).  Zero-injected into
+#: :func:`unified_snapshot` so the pinned schema
+#: (tests/test_bench_contract.py PINNED_METRICS) holds even in
+#: processes that never touched jax — e.g. the bench host legs, which
+#: deliberately run before any accelerator contact.
+COMPILE_METRICS = (
+    "mdtpu_compile_total",
+    "mdtpu_compile_seconds",
+    "mdtpu_compile_cache_hits_total",
+    "mdtpu_compile_cache_misses_total",
+    "mdtpu_aot_compiled_total",
+    "mdtpu_aot_dispatches_total",
+)
 
 
 def unified_snapshot(timers=None, cache=None, telemetry=None,
@@ -158,6 +174,8 @@ def unified_snapshot(timers=None, cache=None, telemetry=None,
     ``tests/test_bench_contract.py`` pins.
     """
     snap = (registry or METRICS).snapshot()
+    for name in COMPILE_METRICS:
+        snap.setdefault(name, {"type": "counter", "values": {"": 0}})
     if timers is not None:
         rep = timers.report()
         snap["mdtpu_phase_seconds_total"] = {

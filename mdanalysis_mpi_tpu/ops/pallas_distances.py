@@ -95,55 +95,69 @@ def _build_kernel(nbins: int, exclude_self: bool, interpret: bool):
             f"(got {nbins}); use the XLA engine for finer histograms")
     nb_pad = _ceil_to(nbins, 128)
 
-    def kernel(scal_ref, a_ref, b_ref, out_ref):
+    def kernel(scal_ref, edges_ref, a_ref, b_ref, out_ref):
         i = pl.program_id(0)
         j = pl.program_id(1)
-        r0 = scal_ref[0, 0]
-        inv_dr = scal_ref[0, 1]
         na = scal_ref[1, 0].astype(jnp.int32)
         nb = scal_ref[1, 1].astype(jnp.int32)
 
         # -- minimum-image squared distances for this (TILE_A, TILE_B)
-        # block, one axis at a time (VPU; no (TA,TB,3) intermediate) --
+        # block, one axis at a time (VPU; no (TA,TB,3) intermediate).
+        # The wrap is ``d - round(d / L) * L`` — the SAME expression
+        # (same rounding sequence) as ops.distances.minimum_image's
+        # orthorhombic branch.  The earlier ``d - L*round(d * (1/L))``
+        # form differs by an ulp for some displacements (two roundings
+        # via the precomputed reciprocal), which re-creates exactly the
+        # bin-edge ties the edge-exact binning below exists to kill. --
         d2 = jnp.zeros((TILE_A, TILE_B), jnp.float32)
         for ax in range(3):
             length = scal_ref[0, 2 + ax]
-            inv_len = scal_ref[0, 5 + ax]       # 0 when no box on this axis
+            safe = scal_ref[0, 5 + ax]          # L, or 1 when no box
             diff = (a_ref[ax, :].reshape(TILE_A, 1)
                     - b_ref[ax, :].reshape(1, TILE_B))
-            diff = diff - length * jnp.round(diff * inv_len)
+            shift = jnp.round(diff / safe) * safe
+            diff = jnp.where(length > 0.0, diff - shift, diff)
             d2 = d2 + diff * diff
         dist = jnp.sqrt(d2)
 
-        # -- uniform-grid bin index; invalid pairs (padding, self,
-        # out-of-range) are routed to a sentinel bin the count loop
-        # never reads, so no weight multiply is needed --
-        idx = jnp.floor((dist - r0) * inv_dr).astype(jnp.int32)
         ia = i * TILE_A + jax.lax.broadcasted_iota(
             jnp.int32, (TILE_A, TILE_B), 0)
         ib = j * TILE_B + jax.lax.broadcasted_iota(
             jnp.int32, (TILE_A, TILE_B), 1)
-        valid = ((ia < na) & (ib < nb) & (idx >= 0) & (idx < nbins))
+        valid = (ia < na) & (ib < nb)
         if exclude_self:
             valid = valid & (ia != ib)
-        idx = jnp.where(valid, jnp.clip(idx, 0, nbins - 1), nbins)
 
         # -- per-bin masked counts, statically unrolled.  Mosaic TC
         # kernels reject the reshapes/scatters every other histogram
         # formulation needs (value dynamic_slice, (TA,TB)→(P,1) shape
-        # casts, segment_sum); the equality-count loop is pure 2-D VPU
+        # casts, segment_sum); the interval-count loop is pure 2-D VPU
         # work.  Cost is pairs×nbins compares — the same asymptotic
-        # cost a one-hot matmul would pay building its operand --
+        # cost a one-hot matmul would pay building its operand.
+        #
+        # Bin k counts ``e_k <= d < e_{k+1}`` against the EXACT f32
+        # edge values (SMEM scalars) — the same predicate the XLA
+        # engine's ``searchsorted(edges, d, 'right')`` evaluates.  The
+        # previous ``floor((d - r0) * inv_dr)`` form disagreed with it
+        # on edge ties: a distance one rounding step below an edge can
+        # multiply up to exactly k, which floor puts in bin k while
+        # searchsorted keeps it in k-1 (the [300-515] parity failure —
+        # deterministic, 2 counts adrift).  Comparing against the same
+        # edge values both engines hold removes the arithmetic
+        # round-trip entirely; out-of-range pairs fall out of every
+        # interval, padding/self fall to ``valid``. --
         @pl.when((i == 0) & (j == 0))
         def _():
             out_ref[:] = jnp.zeros_like(out_ref)
 
-        counts = [jnp.sum((idx == k).astype(jnp.float32), keepdims=True)
+        ge = [dist >= edges_ref[0, k] for k in range(nbins + 1)]
+        counts = [jnp.sum((ge[k] & jnp.logical_not(ge[k + 1])
+                           & valid).astype(jnp.float32), keepdims=True)
                   for k in range(nbins)]
         counts.append(jnp.zeros((1, nb_pad - nbins), jnp.float32))
         out_ref[0:1, :] += jnp.concatenate(counts, axis=1)
 
-    def call(scal, a_t, b_t):
+    def call(scal, edges, a_t, b_t):
         n_pad_a = a_t.shape[1]
         n_pad_b = b_t.shape[1]
         grid = (n_pad_a // TILE_A, n_pad_b // TILE_B)
@@ -152,6 +166,8 @@ def _build_kernel(nbins: int, exclude_self: bool, interpret: bool):
             grid=grid,
             in_specs=[
                 pl.BlockSpec((2, 8), lambda i, j: (0, 0),
+                             memory_space=pltpu.SMEM),
+                pl.BlockSpec((1, nbins + 1), lambda i, j: (0, 0),
                              memory_space=pltpu.SMEM),
                 pl.BlockSpec((3, TILE_A), lambda i, j: (0, i),
                              memory_space=pltpu.VMEM),
@@ -162,34 +178,42 @@ def _build_kernel(nbins: int, exclude_self: bool, interpret: bool):
                                    memory_space=pltpu.VMEM),
             out_shape=jax.ShapeDtypeStruct((8, nb_pad), jnp.float32),
             interpret=interpret,
-        )(scal, a_t, b_t)
+        )(scal, edges, a_t, b_t)
 
     return call
 
 
 def _pack_scalars(r0, inv_dr, box):
     """Scalar ingredients for the kernel's SMEM block: (box lengths,
-    inverse lengths, r0, 1/dr) as f32.  Zero lengths (no box / boxless
-    frame) get inverse 0, which disables the wrap term in-kernel.
-    ``pair_histogram`` assembles these into the (2, 8) scalar block."""
+    division-safe lengths, r0, 1/dr) as f32.  Zero lengths (no box /
+    boxless frame) get safe length 1 and the kernel's ``length > 0``
+    select disables the wrap term.  ``pair_histogram`` assembles these
+    into the (2, 8) scalar block."""
     import jax.numpy as jnp
 
     lengths = (jnp.zeros(3, jnp.float32) if box is None
                else box[:3].astype(jnp.float32))
-    inv_len = jnp.where(lengths > 0, 1.0 / jnp.where(lengths > 0, lengths, 1.0),
-                        0.0)
-    return lengths, inv_len, jnp.float32(r0), jnp.float32(inv_dr)
+    safe_len = jnp.where(lengths > 0, lengths, 1.0)
+    return lengths, safe_len, jnp.float32(r0), jnp.float32(inv_dr)
 
 
 def pair_histogram(a, b, r0: float, dr: float, nbins: int,
                    box=None, exclude_self: bool = False,
-                   interpret: bool | None = None):
+                   interpret: bool | None = None, edges=None):
     """Histogram of pair distances on a uniform grid — Pallas engine.
 
     a: (N, 3) f32; b: (M, 3) f32; bins are ``r0 + k*dr`` for
     ``k = 0..nbins``; ``box``: (6,) dimensions (orthorhombic; lengths 0
     = no PBC) or None.  Returns (nbins,) f32 counts.  ``r0``/``dr`` may
     be traced scalars; shapes and ``nbins`` are static.
+
+    ``edges``: the (nbins+1,) edge array to bin against (cast f32).
+    Callers that HAVE the original edges (the RDF analysis) pass them
+    so the kernel compares against byte-identical values to the XLA
+    engine's ``searchsorted`` — exact engine parity including bin-edge
+    ties.  When omitted, edges are synthesized as ``r0 + k*dr`` in
+    float64 (matching a float64 ``np.linspace`` cast to f32) for
+    Python-scalar r0/dr, in f32 arithmetic for traced scalars.
     """
     import jax
     import jax.numpy as jnp
@@ -201,16 +225,29 @@ def pair_histogram(a, b, r0: float, dr: float, nbins: int,
                   ((0, _ceil_to(n_a, TILE_A) - n_a), (0, 0))).T
     b_t = jnp.pad(b.astype(jnp.float32),
                   ((0, _ceil_to(n_b, TILE_B) - n_b), (0, 0))).T
-    lengths, inv_len, r0f, inv_drf = _pack_scalars(
+    if edges is not None:
+        edges_row = jnp.asarray(edges, jnp.float32).reshape(1, nbins + 1)
+    elif isinstance(r0, (int, float)) and isinstance(dr, (int, float)):
+        e = (np.float64(r0)
+             + np.arange(nbins + 1, dtype=np.float64) * np.float64(dr))
+        edges_row = jnp.asarray(e, jnp.float32).reshape(1, nbins + 1)
+    else:
+        edges_row = (jnp.float32(r0)
+                     + jnp.arange(nbins + 1, dtype=jnp.float32)
+                     * jnp.float32(dr)).reshape(1, nbins + 1)
+    lengths, safe_len, r0f, inv_drf = _pack_scalars(
         r0, 1.0 / jnp.float32(dr), box)
     # (2, 8) f32 SMEM scalar block: row 0 = [r0, inv_dr, Lx, Ly, Lz,
-    # iLx, iLy, iLz]; row 1 = [n_a, n_b, unused...]
+    # safeLx, safeLy, safeLz] (safe = L, or 1 when no box on that
+    # axis — DIVISORS for the wrap, not reciprocals); row 1 =
+    # [n_a, n_b, unused...]  (slots 0-1 are kept for layout
+    # stability; the kernel bins against the edges block)
     scal = jnp.zeros((2, 8), jnp.float32)
     scal = scal.at[0, 0].set(r0f).at[0, 1].set(inv_drf)
-    scal = scal.at[0, 2:5].set(lengths).at[0, 5:8].set(inv_len)
+    scal = scal.at[0, 2:5].set(lengths).at[0, 5:8].set(safe_len)
     scal = scal.at[1, 0].set(n_a).at[1, 1].set(n_b)
     call = _build_kernel(int(nbins), bool(exclude_self), bool(interpret))
-    out = call(scal, a_t, b_t)
+    out = call(scal, edges_row, a_t, b_t)
     return out[0, :nbins]
 
 
@@ -237,8 +274,11 @@ def pair_histogram_batch(coords_a, coords_b, boxes, mask, edges,
     nbins = int(e.shape[0] - 1)
 
     def per_frame(a, b, box6):
+        # the ORIGINAL edges ride through so bin-edge semantics are
+        # byte-identical to the XLA engine (see pair_histogram)
         h = pair_histogram(a, b, r0, dr, nbins, box=box6,
-                           exclude_self=exclude_self, interpret=interpret)
+                           exclude_self=exclude_self, interpret=interpret,
+                           edges=np.asarray(e, np.float32))
         # same 1e-4-degree cut minimum_image uses to classify a box as
         # orthorhombic, so no box can be ortho-wrapped here that the
         # XLA engine would have triclinic-wrapped

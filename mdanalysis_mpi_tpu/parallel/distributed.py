@@ -49,6 +49,23 @@ def initialize(coordinator_address: str | None = None,
         return
     import jax
 
+    # CPU fleets (and the 2-controller CPU parity test) need a real
+    # cross-process collectives backend: without one, XLA:CPU raises
+    # "Multiprocess computations aren't implemented on the CPU
+    # backend" at the first psum.  jaxlib ships gloo for exactly this;
+    # opt it in ONLY when the platform is explicitly pinned to CPU
+    # (JAX_PLATFORMS / jax_platforms config).  Unset means
+    # autodetection — likely an accelerator fleet, where flipping the
+    # secondary CPU client's collectives is an unintended global
+    # config change.  Guarded: older jaxlibs without the knob keep
+    # today's behavior (mesh tests there run single-process).
+    try:
+        if "cpu" in str(jax.config.jax_platforms or "").lower():
+            jax.config.update("jax_cpu_collectives_implementation",
+                              "gloo")
+    except Exception:
+        pass
+
     if (coordinator_address is None and num_processes is None
             and process_id is None):
         try:

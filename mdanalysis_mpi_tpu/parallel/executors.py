@@ -40,6 +40,7 @@ import numpy as np
 from mdanalysis_mpi_tpu.obs import spans as _spans
 from mdanalysis_mpi_tpu.parallel.partition import iter_batches, pad_batch
 from mdanalysis_mpi_tpu.reliability import faults as _faults
+from mdanalysis_mpi_tpu.utils import compile_cache as _cc
 from mdanalysis_mpi_tpu.utils.timers import TIMERS
 
 
@@ -103,9 +104,10 @@ _MESH_CACHE: dict = {}
 def _jit_kernel(f):
     fn = _JIT_CACHE.get(f)
     if fn is None:
-        import jax
-
-        fn = jax.jit(_f32_precision(f))
+        # every jit here routes through the compile cache so the
+        # persistent on-disk cache is active for ANY entry point
+        # (docs/COLDSTART.md)
+        fn = _cc.jit(_f32_precision(f))
         _JIT_CACHE[f] = fn
     return fn
 
@@ -134,12 +136,10 @@ def _fused_step(kernel, fold):
     key = (kernel, fold)
     fn = _FUSED_STEP_CACHE.get(key)
     if fn is None:
-        import jax
-
         def step(total, params, *staged):
             return fold(total, kernel(params, *staged))
 
-        fn = jax.jit(_f32_precision(step))
+        fn = _cc.jit(_f32_precision(step))
         _FUSED_STEP_CACHE[key] = fn
     return fn
 
@@ -250,8 +250,6 @@ def _scan_fns(kernel, fold):
     key = (kernel, fold)
     fns = _SCAN_FN_CACHE.get(key)
     if fns is None:
-        import jax
-
         if fold is not None:
             def init(params, *stacked):
                 return _scan_accum(kernel, fold, params, stacked)
@@ -260,14 +258,14 @@ def _scan_fns(kernel, fold):
                 return fold(total,
                             _scan_accum(kernel, fold, params, stacked))
 
-            fns = (jax.jit(_f32_precision(init)),
-                   jax.jit(_f32_precision(fused)), None)
+            fns = (_cc.jit(_f32_precision(init)),
+                   _cc.jit(_f32_precision(fused)), None)
         else:
             def series(params, *stacked):
                 return _flatten_block_axis(
                     _scan_emit(kernel, params, stacked))
 
-            fns = (None, None, jax.jit(_f32_precision(series)))
+            fns = (None, None, _cc.jit(_f32_precision(series)))
         _SCAN_FN_CACHE[key] = fns
     return fns
 
@@ -301,7 +299,7 @@ def _stack_staged(blocks: list[tuple]):
             return tuple(jnp.stack(flat[j * k:(j + 1) * k])
                          for j in range(m))
 
-        fn = jax.jit(stack)
+        fn = _cc.jit(stack)
         _STACK_CACHE[key] = fn
     flat = [blocks[b][i] for i in dev_pos for b in range(k)]
     stacked_dev = iter(fn(*flat))
@@ -335,6 +333,70 @@ def _block_nbytes(bs: int, sel_idx, n_atoms: int,
     s = n_atoms if sel_idx is None else len(sel_idx)
     per = {"float32": 4, "int16": 2, "int8": 1, "delta": 1}[transfer_dtype]
     return bs * s * 3 * per
+
+
+def _staged_avals(bs: int, n_stage: int, quantize,
+                  delta_anchors: int = 1, inv_per_frame: bool = False,
+                  shardings=None):
+    """`jax.ShapeDtypeStruct`s of the staged tuple `_host_stage`
+    produces for this geometry — the shape contract the AOT warmup
+    surface lowers against (docs/COLDSTART.md).  MUST mirror
+    `_host_stage`/`_put_staged` exactly: an AOT executable registered
+    under these avals is later called with the real staged tuple, and
+    a drift here turns every warmup into a dead registry entry (the
+    executors fall back to the jit path on key mismatch, so drift is a
+    perf regression, not a crash).  ``shardings``: optional per-element
+    NamedShardings (mesh path), applied positionally like
+    ``_put_staged`` targets."""
+    import jax
+    import jax.numpy as jnp
+
+    S = jax.ShapeDtypeStruct
+    if quantize == "delta":
+        a = delta_anchors
+        avals = (S((bs, n_stage, 3), jnp.int8),
+                 S((a, n_stage, 3), jnp.int16),
+                 S((a, 1, 1), jnp.float32),
+                 S((bs, 1, 1), jnp.float32),
+                 S((bs, 6), jnp.float32),
+                 S((bs,), jnp.float32))
+    elif quantize:
+        inv = (S((bs, 1, 1), jnp.float32) if inv_per_frame
+               else S((), jnp.float32))
+        avals = (S((bs, n_stage, 3), jnp.dtype(quantize)), inv,
+                 S((bs, 6), jnp.float32),
+                 S((bs,), jnp.float32))
+    else:
+        avals = (S((bs, n_stage, 3), jnp.float32),
+                 S((bs, 6), jnp.float32),
+                 S((bs,), jnp.float32))
+    if shardings is not None:
+        avals = tuple(
+            S(a.shape, a.dtype, sharding=s) if s is not None else a
+            for a, s in zip(avals, shardings))
+    return avals
+
+
+def _stacked_avals(avals, k: int):
+    """Leading scan-group axis ``k`` prepended to every staged aval —
+    the stacked-superblock shapes the scan programs consume."""
+    import jax
+
+    return tuple(jax.ShapeDtypeStruct((k,) + tuple(a.shape), a.dtype)
+                 for a in avals)
+
+
+def _op_label(base_fn, transfer_dtype: str, backend: str,
+              role: str) -> str:
+    """Stable-across-processes AOT op label: the un-wrapped kernel's
+    module.qualname.name + staging dtype + executor backend + program
+    role (kernel / fused / scan_init / scan_fused / scan_series).
+    ``__name__`` rides along because factory-built kernels share a
+    qualname but stamp a distinctive name (the collection kernel names
+    its children) — without it two different collections with
+    identical staged shapes would collide on one executable."""
+    return (f"{base_fn.__module__}.{base_fn.__qualname__}"
+            f".{base_fn.__name__}|{transfer_dtype}|{backend}|{role}")
 
 
 def _resolve_scan_k(setting, cache, n_blocks: int,
@@ -760,13 +822,28 @@ def _staging_pool():
     return ThreadPoolExecutor(max_workers=1) if use_thread else _InlinePool()
 
 
+def _cold_pipeline_enabled() -> bool:
+    """Whether the prestage (cold) schedule double-buffers decode
+    against wire on a dedicated thread (docs/COLDSTART.md).  Same
+    default policy as ``_staging_pool``: on for multi-core hosts, off
+    on 1-core hosts where the transfer client and the decoder compete
+    for the only core (VERDICT r3 #2 measured decode dropping ~4× —
+    there the chunked decode-then-wire phase separation stays right).
+    Override via MDTPU_COLD_PIPELINE=0/1."""
+    pipe = _os.environ.get("MDTPU_COLD_PIPELINE")
+    if pipe is not None:
+        return pipe not in ("0", "false", "no")
+    return (_os.cpu_count() or 1) > 1
+
+
 def _run_batches(analysis, reader, frames, bs, call, sel_idx,
                  device_put_fn=None, cache: "DeviceBlockCache | None" = None,
                  quantize: bool = False, local_divisor: int = 1,
                  local_index: int = 0, inv_per_frame: bool = False,
                  prestage: bool = False, fused_call=None,
                  delta_anchors: int = 1, reliability=None,
-                 scan_k: int = 1, scan_calls: "_ScanCalls | None" = None):
+                 scan_k: int = 1, scan_calls: "_ScanCalls | None" = None,
+                 stage_only: bool = False):
     """Shared batch loop: stage → kernel → DEVICE-side accumulation.
 
     ``scan_k > 1`` (with ``scan_calls``) activates the SCAN-FOLDED
@@ -822,9 +899,13 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
     parts_list = []
     bounds = list(iter_batches(0, len(frames), bs))
     global LAST_SCAN_K
-    scan_active = (scan_k > 1 and scan_calls is not None
+    # stage_only (the scheduler-prefetch path, docs/COLDSTART.md):
+    # walk the exact staging schedule — same cache keys, same scan
+    # superblock grouping — but dispatch nothing; `call` may be None.
+    scan_active = (scan_k > 1 and (scan_calls is not None or stage_only)
                    and len(bounds) > 1)
-    LAST_SCAN_K = scan_k if scan_active else 1
+    if not stage_only:
+        LAST_SCAN_K = scan_k if scan_active else 1
     # reliability runtime (reliability/policy.ReliabilityRuntime), duck-
     # called so this module never imports the policy layer: rt.op wraps
     # failure-prone ops in retry/backoff/deadline, rt.salvage_block
@@ -1094,7 +1175,75 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
             for s, _ in blocks:
                 _delete_staged(s)
 
-    if prestage:
+    if stage_only:
+        staged_blocks = 0
+        seq = miss_blocks if scan_active else list(range(len(bounds)))
+        for bi in seq:
+            staged, nbytes = prepare(bounds[bi])
+            if nbytes:
+                staged_blocks += 1
+            if scan_active:
+                _note_block_done(bi, staged, nbytes)
+        return staged_blocks
+
+    if prestage and _cold_pipeline_enabled():
+        # DOUBLE-BUFFERED decode→wire (PERF.md §12): §8d measured the
+        # WIRE leg, not decode, as the cold wall once the link slowed
+        # (3 GB staged ≈ 46 s of a ~70 s cold wall at 0.8 GB/s), so
+        # strict decode-then-wire phase separation leaves the link
+        # idle while the decoder runs.  Here the wire of block i runs
+        # on a dedicated "mdtpu-wire" thread while block i+1 decodes
+        # on this one — decode hides entirely under the wire wall, and
+        # the overlap is VISIBLE as stage-vs-wire spans on distinct
+        # threads in the span trace (docs/COLDSTART.md shows the
+        # timeline).  Host residency stays bounded: at most
+        # MDTPU_WIRE_WINDOW blocks are decoded-but-unconsumed, the
+        # same constraint the chunk bound enforced.  1-core hosts keep
+        # the chunked schedule below (see _cold_pipeline_enabled).
+        from concurrent.futures import ThreadPoolExecutor
+
+        window = max(1, int(_os.environ.get("MDTPU_WIRE_WINDOW", "4")))
+        seq = miss_blocks if scan_active else list(range(len(bounds)))
+        wire_ctx = _spans.current_context()
+
+        def _wire(staged_host, key, nbytes):
+            # span context handed to the wire thread so wire spans
+            # carry the same job attribution as the stage spans they
+            # overlap (the PR-5 prefetch-thread contract)
+            with _spans.saved_context(wire_ctx), TIMERS.phase("wire"):
+                return _place(staged_host, key, nbytes)
+
+        with ThreadPoolExecutor(max_workers=1,
+                                thread_name_prefix="mdtpu-wire") as wpool:
+            futs: dict[int, tuple] = {}
+            nxt = 0
+            for i in range(len(seq)):
+                while nxt < len(seq) and nxt - i < window:
+                    ab = bounds[seq[nxt]]
+                    key = None if scan_active else _key(ab)
+                    hit = (cache.get(key)
+                           if key is not None and cache is not None
+                           else None)
+                    if hit is not None:
+                        futs[nxt] = (None, hit, 0)
+                    else:
+                        a, b = ab
+                        with TIMERS.phase("stage", lo=a, hi=b):
+                            sh, nb = _stage_op(frames[a:b])
+                        futs[nxt] = (wpool.submit(_wire, sh, key, nb),
+                                     None, nb)
+                    nxt += 1
+                fut, hit, nbytes = futs.pop(i)
+                staged = hit if fut is None else fut.result()
+                bi = seq[i]
+                if scan_active:
+                    _flush_hits_before(block_group[bi])
+                consume(staged)
+                if scan_active:
+                    _note_block_done(bi, staged, nbytes)
+        if scan_active:
+            _flush_hits_before(len(groups))
+    elif prestage:
         # CHUNKED decode-then-wire (two measured constraints):
         #
         # 1. Phase separation (VERDICT r3 #2): while the native decoder
@@ -1277,6 +1426,139 @@ class JaxExecutor:
         if reliability is not None:
             self.reliability = reliability
 
+    def _setup(self, analysis, reader):
+        """Kernel/params/selection resolution shared by ``execute``,
+        ``warmup`` and ``stage`` — one site, so the three paths cannot
+        disagree about what gets staged or dispatched."""
+        quantize = _quant_mode(self.transfer_dtype)
+        qn = _quantized_native(analysis, self.transfer_dtype)
+        if qn is not None:
+            wrapped, params, sel_idx = qn
+            base_fn = wrapped
+        else:
+            base_fn = analysis._batch_fn()
+            if self.transfer_dtype == "delta":
+                wrapped = _delta_wrapper(base_fn)
+            elif quantize:
+                wrapped = _dequant_wrapper(base_fn)
+            else:
+                wrapped = base_fn
+            params, sel_idx = _wrap_for_transfer(
+                analysis._batch_params(), analysis._batch_select(),
+                reader.n_atoms, self.transfer_dtype)
+        return wrapped, base_fn, params, sel_idx, quantize
+
+    def _scan_group_sizes(self, scan_k: int, n_blocks: int):
+        """(init_sizes, fused_sizes): the distinct stacked-group shapes
+        ``_run_batches``'s scan schedule will actually dispatch —
+        exactly what warmup must compile, nothing more."""
+        if scan_k <= 1 or n_blocks <= 1:
+            return set(), set()
+        n_groups = -(-n_blocks // scan_k)
+        tail = n_blocks % scan_k
+        init_sizes = {scan_k}          # group 0 is always a full group
+        fused_sizes = set()
+        if n_groups > 2 or (n_groups == 2 and not tail):
+            fused_sizes.add(scan_k)
+        if tail and n_groups > 1:
+            fused_sizes.add(tail)
+        return init_sizes, fused_sizes
+
+    def warmup(self, analysis, reader, frames, batch_size=None) -> int:
+        """AOT-compile every program ``execute`` would dispatch for
+        this exact geometry — ``jit(...).lower().compile()`` keyed by
+        (op, shape, dtype, backend, scan_k) — so the first real
+        dispatch skips tracing AND compilation (docs/COLDSTART.md).
+        The caller must have resolved frames and run
+        ``analysis._prepare()`` (AnalysisBase.run's own order).
+        Returns the number of executables registered."""
+        import jax
+
+        if getattr(analysis, "_mesh_only", False):
+            return 0
+        bs = batch_size or self.batch_size
+        try:
+            wrapped, base_fn, params, sel_idx, quantize = self._setup(
+                analysis, reader)
+        except NotImplementedError:
+            return 0          # serial-only analysis: nothing to compile
+        kernel = _jit_kernel(wrapped)
+        fold = analysis._device_fold_fn
+        frames = list(frames)
+        n_blocks = -(-len(frames) // bs) if frames else 0
+        if n_blocks == 0:
+            return 0
+        n_stage = reader.n_atoms if sel_idx is None else len(sel_idx)
+        avals = _staged_avals(bs, n_stage, quantize)
+        td = self.transfer_dtype
+        n = 0
+        if _cc.aot_compile(_op_label(base_fn, td, "jax", "kernel"),
+                           kernel, params, *avals) is not None:
+            n += 1
+        total_aval = (jax.eval_shape(kernel, params, *avals)
+                      if fold is not None else None)
+        if fold is not None and n_blocks > 1:
+            step = _fused_step(wrapped, fold)
+            if _cc.aot_compile(_op_label(base_fn, td, "jax", "fused"),
+                               step, total_aval, params,
+                               *avals) is not None:
+                n += 1
+        scan_k = _resolve_scan_k(
+            self.scan_k, self.block_cache, n_blocks,
+            _block_nbytes(bs, sel_idx, reader.n_atoms, td))
+        init_sizes, fused_sizes = self._scan_group_sizes(scan_k, n_blocks)
+        s_init, s_fused, s_series = (
+            _scan_fns(wrapped, fold) if init_sizes else (None,) * 3)
+        for k in sorted(init_sizes | fused_sizes):
+            st = _stacked_avals(avals, k)
+            if fold is not None:
+                if k in init_sizes and _cc.aot_compile(
+                        _op_label(base_fn, td, "jax", "scan_init"),
+                        s_init, params, *st, scan_k=k) is not None:
+                    n += 1
+                if k in fused_sizes and _cc.aot_compile(
+                        _op_label(base_fn, td, "jax", "scan_fused"),
+                        s_fused, total_aval, params, *st,
+                        scan_k=k) is not None:
+                    n += 1
+            elif _cc.aot_compile(
+                    _op_label(base_fn, td, "jax", "scan_series"),
+                    s_series, params, *st, scan_k=k) is not None:
+                n += 1
+        return n
+
+    def stage(self, analysis, reader, frames, batch_size=None) -> int:
+        """Stage this run's blocks into ``block_cache`` WITHOUT
+        dispatching any kernel — the scheduler-prefetch entry point
+        (docs/COLDSTART.md).  Walks the exact schedule ``execute``
+        would (same cache keys, same scan grouping), so a later run
+        over the same window is all cache hits.  Returns the number of
+        blocks staged (0 when there is no cache to stage into)."""
+        if self.block_cache is None or getattr(analysis, "_mesh_only",
+                                               False):
+            return 0
+        bs = batch_size or self.batch_size
+        try:
+            _w, _b, _params, sel_idx, quantize = self._setup(analysis,
+                                                             reader)
+        except NotImplementedError:
+            return 0          # serial-only analysis: nothing to stage
+        frames = list(frames)
+        scan_k = _resolve_scan_k(
+            self.scan_k, self.block_cache,
+            -(-len(frames) // bs) if frames else 0,
+            _block_nbytes(bs, sel_idx, reader.n_atoms,
+                          self.transfer_dtype))
+
+        def put(staged):
+            return _put_staged(staged, (self.device,) * 4)
+
+        return _run_batches(
+            analysis, reader, frames, bs, None, sel_idx,
+            device_put_fn=put, cache=self.block_cache, quantize=quantize,
+            reliability=self.reliability, scan_k=scan_k,
+            stage_only=True)
+
     def execute(self, analysis, reader, frames, batch_size=None):
         import jax
 
@@ -1285,21 +1567,8 @@ class JaxExecutor:
                 f"{type(analysis).__name__} uses an atom-sharded ring "
                 "kernel (mesh collectives); run it with backend='mesh'")
         bs = batch_size or self.batch_size
-        quantize = _quant_mode(self.transfer_dtype)
-        qn = _quantized_native(analysis, self.transfer_dtype)
-        if qn is not None:
-            wrapped, params, sel_idx = qn
-        else:
-            f = analysis._batch_fn()
-            if self.transfer_dtype == "delta":
-                wrapped = _delta_wrapper(f)
-            elif quantize:
-                wrapped = _dequant_wrapper(f)
-            else:
-                wrapped = f
-            params, sel_idx = _wrap_for_transfer(
-                analysis._batch_params(), analysis._batch_select(),
-                reader.n_atoms, self.transfer_dtype)
+        wrapped, base_fn, params, sel_idx, quantize = self._setup(
+            analysis, reader)
         kernel = _jit_kernel(wrapped)
         fold = analysis._device_fold_fn
         step = _fused_step(wrapped, fold) if fold is not None else None
@@ -1314,18 +1583,101 @@ class JaxExecutor:
                       else _make_scan_calls(_scan_fns(wrapped, fold),
                                             params))
 
+        call = lambda *staged: kernel(params, *staged)  # noqa: E731
+        fused_call = (None if step is None else
+                      lambda total, *staged: step(total, params, *staged))
+        # AOT-executable binding: when warmup registered executables
+        # for this exact geometry, dispatch through them — zero
+        # tracing, zero compile on first contact.  Keys derive from
+        # the same _staged_avals the warmup used, so a bound
+        # executable's shapes are correct by construction.  Skipped
+        # for an explicit non-default device (the executable's input
+        # placement would not match).
+        if self.device is None and _cc.aot_active():
+            td = self.transfer_dtype
+            n_stage = (reader.n_atoms if sel_idx is None
+                       else len(sel_idx))
+            avals = _staged_avals(bs, n_stage, quantize)
+            bound = False
+            comp_k = _cc.aot_get(_cc.aot_key(
+                _op_label(base_fn, td, "jax", "kernel"),
+                (params,) + avals))
+            if comp_k is not None:
+                call = lambda *staged: comp_k(params, *staged)  # noqa: E731
+                bound = True
+            if fold is not None:
+                total_aval = jax.eval_shape(kernel, params, *avals)
+                comp_f = _cc.aot_get(_cc.aot_key(
+                    _op_label(base_fn, td, "jax", "fused"),
+                    (total_aval, params) + avals))
+                if comp_f is not None:
+                    fused_call = (lambda total, *staged:
+                                  comp_f(total, params, *staged))
+                    bound = True
+            if scan_calls is not None:
+                comp_scan = {}
+                init_sizes, fused_sizes = self._scan_group_sizes(
+                    scan_k, -(-len(frames) // bs))
+                for k in init_sizes | fused_sizes:
+                    st = _stacked_avals(avals, k)
+                    if fold is not None:
+                        comp_scan[("scan_init", k)] = _cc.aot_get(
+                            _cc.aot_key(_op_label(base_fn, td, "jax",
+                                                  "scan_init"),
+                                        (params,) + st, scan_k=k))
+                        comp_scan[("scan_fused", k)] = _cc.aot_get(
+                            _cc.aot_key(_op_label(base_fn, td, "jax",
+                                                  "scan_fused"),
+                                        (total_aval, params) + st,
+                                        scan_k=k))
+                    else:
+                        comp_scan[("scan_series", k)] = _cc.aot_get(
+                            _cc.aot_key(_op_label(base_fn, td, "jax",
+                                                  "scan_series"),
+                                        (params,) + st, scan_k=k))
+                if any(v is not None for v in comp_scan.values()):
+                    scan_calls = self._bind_aot_scan(
+                        scan_calls, comp_scan, params, fold)
+                    bound = True
+            if bound:
+                _cc.note_aot_dispatch()
+
         def put(staged):
             return _put_staged(staged, (self.device,) * 4)
 
         return _run_batches(
-            analysis, reader, frames, bs,
-            lambda *staged: kernel(params, *staged), sel_idx,
+            analysis, reader, frames, bs, call, sel_idx,
             device_put_fn=put, cache=self.block_cache, quantize=quantize,
             prestage=self.prestage, reliability=self.reliability,
             scan_k=scan_k, scan_calls=scan_calls,
-            fused_call=(None if step is None else
-                        lambda total, *staged: step(total, params,
-                                                    *staged)))
+            fused_call=fused_call)
+
+    @staticmethod
+    def _bind_aot_scan(scan_calls: "_ScanCalls", comp_scan: dict,
+                       params, fold) -> "_ScanCalls":
+        """Rebind the scan-program triple so each dispatch picks the
+        AOT executable matching its stacked group size, falling back
+        to the jitted program for unwarmed sizes."""
+        jit_init, jit_fused = scan_calls.init, scan_calls.fused
+        jit_series = scan_calls.series
+        if fold is not None:
+            def init(*st):
+                c = comp_scan.get(("scan_init", st[0].shape[0]))
+                return (c(params, *st) if c is not None
+                        else jit_init(*st))
+
+            def fused(total, *st):
+                c = comp_scan.get(("scan_fused", st[0].shape[0]))
+                return (c(total, params, *st) if c is not None
+                        else jit_fused(total, *st))
+
+            return _ScanCalls(init=init, fused=fused)
+
+        def series(*st):
+            c = comp_scan.get(("scan_series", st[0].shape[0]))
+            return c(params, *st) if c is not None else jit_series(*st)
+
+        return _ScanCalls(series=series)
 
 
 class MeshExecutor:
@@ -1463,7 +1815,7 @@ class MeshExecutor:
         # carry trips the varying-manual-axes check inside shard_map
         # (works on CPU, fails on TPU); the kernel is purely per-shard
         # + explicit psum, so the check adds nothing here.
-        gfn = jax.jit(shard_map(
+        gfn = _cc.jit(shard_map(
             shard_fn, mesh=mesh,
             in_specs=in_specs,
             out_specs=out_specs))
@@ -1478,7 +1830,7 @@ class MeshExecutor:
                 # between batch 1 (gfn) and batches 2+ (gfn_fused)
                 return fold(total, shard_fn(params, *staged))
 
-            gfn_fused = jax.jit(shard_map(
+            gfn_fused = _cc.jit(shard_map(
                 shard_fn_fused, mesh=mesh,
                 in_specs=(P(),) + in_specs,
                 out_specs=P()))
@@ -1553,10 +1905,10 @@ class MeshExecutor:
                         axis))
 
                 cached = (
-                    jax.jit(shard_map(shard_init, mesh=mesh,
+                    _cc.jit(shard_map(shard_init, mesh=mesh,
                                       in_specs=(P(),) + staged_specs,
                                       out_specs=P())),
-                    jax.jit(shard_map(shard_fused, mesh=mesh,
+                    _cc.jit(shard_map(shard_fused, mesh=mesh,
                                       in_specs=(P(), P()) + staged_specs,
                                       out_specs=P())),
                     None)
@@ -1573,10 +1925,129 @@ class MeshExecutor:
                     # per-block concatenation order, inside the jit
                     return _flatten_block_axis(inner(params, *stacked))
 
-                cached = (None, None, jax.jit(series_fn))
+                cached = (None, None, _cc.jit(series_fn))
             _MESH_CACHE[key] = cached
         s_init, s_fused, s_series = cached
         return s_init, s_fused, s_series
+
+    def warmup(self, analysis, reader, frames, batch_size=None) -> int:
+        """Precompile the mesh programs for this geometry: the jitted
+        shard_map kernel (and fused fold form) is lowered + compiled
+        with the dispatch-time input shardings, populating the
+        persistent compile cache so a fresh process's first mesh
+        dispatch is a disk deserialization, not an XLA compile
+        (docs/COLDSTART.md).  The mesh path keeps jit dispatch (no
+        AOT-executable binding — sharded executables are bound at the
+        jit layer), and the scan-group programs compile lazily; both
+        still hit tier 1 once any process has run them.
+        Single-controller, frame-sharded kernels only."""
+        import jax
+
+        if jax.process_count() > 1:
+            return 0
+        bs = batch_size or self.batch_size
+        try:
+            qn = (_quantized_native(analysis, self.transfer_dtype)
+                  if analysis._batch_specs(self.axis_name) is None
+                  else None)
+            bs_factor, gfn, shardings, params_specs, gfn_fused = \
+                self._build(analysis,
+                            qn_fn=qn[0] if qn is not None else None)
+        except NotImplementedError:
+            return 0          # serial-only analysis: nothing to compile
+        if params_specs is not None:
+            return 0          # ring path: per-process staging shapes
+        global_bs = bs * bs_factor
+        if qn is not None:
+            params, sel_idx = qn[1], qn[2]
+        else:
+            params, sel_idx = _wrap_for_transfer(
+                analysis._batch_params(), analysis._batch_select(),
+                reader.n_atoms, self.transfer_dtype)
+        frames = list(frames)
+        n_blocks = -(-len(frames) // global_bs) if frames else 0
+        if n_blocks == 0:
+            return 0
+        quantize = _quant_mode(self.transfer_dtype)
+        n_stage = reader.n_atoms if sel_idx is None else len(sel_idx)
+        # positional shardings matching _put_staged targets: device-put
+        # elements carry their NamedSharding, host-side scale arrays
+        # ride the dispatch unsharded
+        if quantize == "delta":
+            sh = (shardings[0], shardings[1], None, None,
+                  shardings[2], shardings[3])
+            anchors = bs_factor
+        elif quantize:
+            sh = (shardings[0], None, shardings[1], shardings[2])
+            anchors = 1
+        else:
+            sh = shardings
+            anchors = 1
+        avals = _staged_avals(global_bs, n_stage, quantize,
+                              delta_anchors=anchors, shardings=sh)
+        td = self.transfer_dtype
+        base_fn = qn[0] if qn is not None else analysis._batch_fn()
+        n = 0
+        if _cc.aot_compile(_op_label(base_fn, td, "mesh", "kernel"),
+                           gfn, params, *avals) is not None:
+            n += 1
+        if gfn_fused is not None and n_blocks > 1:
+            total_aval = jax.eval_shape(gfn, params, *avals)
+            if _cc.aot_compile(_op_label(base_fn, td, "mesh", "fused"),
+                               gfn_fused, total_aval, params,
+                               *avals) is not None:
+                n += 1
+        return n
+
+    def stage(self, analysis, reader, frames, batch_size=None) -> int:
+        """Scheduler-prefetch staging for the mesh path: populate
+        ``block_cache`` with this run's (sharded) staged blocks without
+        dispatching — same keys and scan grouping as ``execute``.
+        Single-controller, frame-sharded kernels only."""
+        import jax
+
+        if self.block_cache is None or jax.process_count() > 1:
+            return 0
+        bs = batch_size or self.batch_size
+        try:
+            qn = (_quantized_native(analysis, self.transfer_dtype)
+                  if analysis._batch_specs(self.axis_name) is None
+                  else None)
+            bs_factor, _gfn, shardings, params_specs, _gf = self._build(
+                analysis, qn_fn=qn[0] if qn is not None else None)
+        except NotImplementedError:
+            return 0          # serial-only analysis: nothing to stage
+        if params_specs is not None:
+            return 0
+        global_bs = bs * bs_factor
+        if qn is not None:
+            sel_idx = qn[2]
+        else:
+            _, sel_idx = _wrap_for_transfer(
+                analysis._batch_params(), analysis._batch_select(),
+                reader.n_atoms, self.transfer_dtype)
+        frames = list(frames)
+        fold = analysis._device_fold_fn
+        devcombine = analysis._device_combine
+        scan_k = 1
+        if (fold is None) == (devcombine is None):
+            scan_k = _resolve_scan_k(
+                self.scan_k, self.block_cache,
+                -(-len(frames) // global_bs) if frames else 0,
+                _block_nbytes(global_bs, sel_idx, reader.n_atoms,
+                              self.transfer_dtype))
+
+        def put(staged):
+            return _put_staged(staged, shardings)
+
+        return _run_batches(
+            analysis, reader, frames, global_bs, None, sel_idx,
+            device_put_fn=put, cache=self.block_cache,
+            quantize=_quant_mode(self.transfer_dtype),
+            reliability=self.reliability, scan_k=scan_k,
+            stage_only=True,
+            delta_anchors=(bs_factor if self.transfer_dtype == "delta"
+                           else 1))
 
     def execute(self, analysis, reader, frames, batch_size=None):
         import jax
@@ -1783,6 +2254,46 @@ _EXECUTORS = {
     "mesh": MeshExecutor,
     "mpi": MPIExecutor,
 }
+
+
+def warmup_analysis(analysis, executor, start=None, stop=None,
+                    step=None, frames=None, batch_size=None) -> int:
+    """AOT-warm every kernel ``analysis.run(backend=executor, ...)``
+    would compile over this window (docs/COLDSTART.md): resolves the
+    frame window and runs ``_prepare`` exactly as ``run()`` would,
+    then hands each of the analysis' ``_warmup_analyses()`` to the
+    executor's ``warmup``.  Returns executables registered; 0 for
+    executors/analyses with nothing to precompile."""
+    if not hasattr(executor, "warmup"):
+        return 0
+    n = 0
+    for a in analysis._warmup_analyses():
+        fl = list(a._frames(start, stop, step, frames))
+        a.n_frames = len(fl)
+        a._frame_indices = fl
+        a._prepare()
+        n += executor.warmup(a, a._universe.trajectory, fl,
+                             batch_size=batch_size)
+    return n
+
+
+def stage_analysis(analysis, executor, start=None, stop=None,
+                   step=None, frames=None, batch_size=None) -> int:
+    """Prefetch-stage the blocks ``analysis.run(backend=executor,
+    ...)`` would stage, into the executor's ``block_cache``, without
+    dispatching any kernel (the scheduler-prefetch entry point —
+    docs/COLDSTART.md).  Returns blocks newly staged."""
+    if not hasattr(executor, "stage"):
+        return 0
+    n = 0
+    for a in analysis._warmup_analyses():
+        fl = list(a._frames(start, stop, step, frames))
+        a.n_frames = len(fl)
+        a._frame_indices = fl
+        a._prepare()
+        n += executor.stage(a, a._universe.trajectory, fl,
+                            batch_size=batch_size)
+    return n
 
 
 def get_executor(backend, **kwargs):

@@ -91,6 +91,17 @@ def batch_main(argv=None, universe=None) -> int:
                         "served pass's spans to FILE (open in Perfetto; "
                         "merged passes carry all member job ids — env "
                         "twin MDTPU_TRACE_OUT, docs/OBSERVABILITY.md)")
+    p.add_argument("--warmup", action="store_true",
+                   help="AOT-precompile the coalesce-key shapes the "
+                        "job file needs before the first claim "
+                        "(jit(...).lower().compile() through the "
+                        "persistent compile cache — docs/COLDSTART.md); "
+                        "the warmup wall lands in the output JSON as "
+                        "warmup_seconds")
+    p.add_argument("--prefetch", action="store_true",
+                   help="stage queued jobs' blocks into the shared "
+                        "cache before their claim (scheduler-driven "
+                        "prefetch, docs/COLDSTART.md)")
     ns = p.parse_args(argv)
 
     import os
@@ -139,8 +150,17 @@ def batch_main(argv=None, universe=None) -> int:
     # requests then coalesce maximally instead of being claimed one by
     # one as they arrive
     sched = Scheduler(n_workers=int(spec.get("workers", 1)),
-                      cache=cache, autostart=False)
+                      cache=cache, autostart=False,
+                      prefetch=bool(ns.prefetch))
+    warmup_stats = None
+    if ns.warmup:
+        warmup_stats = sched.warmup([j for j, _, _ in jobs])
     handles = [sched.submit(j) for j, _, _ in jobs]
+    if ns.prefetch:
+        # synchronous first pass before workers start: wave-1 claims
+        # then ride staged blocks; the background thread covers jobs
+        # submitted later
+        sched.prefetch_pending()
     sched.start()
     sched.drain()
     sched.shutdown()
@@ -181,9 +201,13 @@ def batch_main(argv=None, universe=None) -> int:
 
     if trace_out:
         obs.export_trace(trace_out)
-    print(json.dumps({
+    out = {
         "jobs": records, "wall_s": round(wall, 4),
         "serving": sched.telemetry.snapshot(cache=cache),
         "trace_out": trace_out,
-    }))
+    }
+    if warmup_stats is not None:
+        out["warmup_seconds"] = warmup_stats["seconds"]
+        out["warmup_executables"] = warmup_stats["executables"]
+    print(json.dumps(out))
     return rc

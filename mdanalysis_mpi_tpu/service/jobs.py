@@ -175,6 +175,12 @@ class JobHandle:
         # scheduler bookkeeping: admission deferral count (see
         # Scheduler._pop_admissible)
         self._deferrals = 0
+        #: True once scheduler-driven prefetch staged this job's
+        #: blocks into the shared cache (docs/COLDSTART.md)
+        self.prefetched = False
+        # prefetch in progress: the claim path skips held handles so
+        # the staging completes before the job is claimed
+        self._prefetch_hold = False
 
     # ---- lifecycle (called by the scheduler) ----
 

@@ -85,11 +85,18 @@ class Scheduler:
 
     def __init__(self, n_workers: int = 1, cache=None,
                  telemetry: ServiceTelemetry | None = None,
-                 max_deferrals: int = 3, autostart: bool = True):
+                 max_deferrals: int = 3, autostart: bool = True,
+                 prefetch: bool = False):
         self.cache = cache
         self.telemetry = telemetry or ServiceTelemetry()
         self.max_deferrals = max_deferrals
         self.n_workers = max(1, int(n_workers))
+        # scheduler-driven prefetch (docs/COLDSTART.md): a background
+        # thread stages queued jobs' blocks into the shared cache
+        # while every worker is busy, so wave-1 cold misses become
+        # hits.  Also available synchronously via prefetch_pending().
+        self.prefetch = bool(prefetch) and cache is not None
+        self._prefetch_thread: threading.Thread | None = None
         self._queue: list = []        # (-priority, seq, handle)
         # admission-deferred entries, parked until OTHER work actually
         # runs (a deferred top-priority job back in the queue would
@@ -119,6 +126,12 @@ class Scheduler:
                                      name=f"mdtpu-serve-{i}")
                 self._workers.append(t)
                 t.start()
+            if self.prefetch and self._prefetch_thread is None:
+                t = threading.Thread(target=self._prefetch_worker,
+                                     daemon=True,
+                                     name="mdtpu-prefetch")
+                self._prefetch_thread = t
+                t.start()
 
     def drain(self, timeout: float | None = None) -> bool:
         """Block until every submitted job reached a terminal state."""
@@ -135,7 +148,10 @@ class Scheduler:
         if wait:
             for t in self._workers:
                 t.join()
+            if self._prefetch_thread is not None:
+                self._prefetch_thread.join()
         self._workers.clear()
+        self._prefetch_thread = None
 
     def __enter__(self):
         self.start()
@@ -213,11 +229,18 @@ class Scheduler:
 
     # ---- worker loop ----
 
+    def _claimable_locked(self) -> list:
+        """Queue entries a worker may claim now: prefetch-held handles
+        are skipped — their staging completes (and releases the hold)
+        before they become claimable, which is what "staged before the
+        job is claimed" means (docs/COLDSTART.md)."""
+        return [e for e in self._queue if not e[2]._prefetch_hold]
+
     def _worker(self) -> None:
         while True:
             with self._cond:
                 while True:
-                    if self._queue:
+                    if self._claimable_locked():
                         break
                     if self._parked and self._active == 0:
                         # nothing queued AND no other worker mid-run
@@ -225,7 +248,13 @@ class Scheduler:
                         # entries get their turn now
                         self._unpark_locked()
                         break
-                    if self._shutdown and not self._parked:
+                    # exit only when NOTHING is queued at all: a
+                    # prefetch-held entry is still queued work — its
+                    # hold is released (with a notify) by the prefetch
+                    # routine's finally, so wait for it rather than
+                    # stranding the job in 'queued' forever
+                    if (self._shutdown and not self._parked
+                            and not self._queue):
                         return
                     self._cond.wait()
                 batch, poison = self._claim_batch_locked()
@@ -266,7 +295,7 @@ class Scheduler:
         being.  Returns ``(handles, poison)``: a non-None poison is
         the key-computation failure of the best entry (claimed alone,
         to be failed by the caller)."""
-        best = min(self._queue)
+        best = min(self._claimable_locked())
         try:
             key = best[2].job.coalesce_key()
         except Exception as exc:
@@ -275,7 +304,17 @@ class Scheduler:
         claimed, rest = [], []
         for entry in self._queue:
             try:
-                same = entry[2].job.coalesce_key() == key
+                # a prefetch-held peer stays queued: its staging is
+                # mid-flight, and the blocks it stages are this very
+                # key's — it rides them as hits when claimed next.
+                # Known tradeoff: a same-key job claimed DURING the
+                # hold runs its own (hit-resident) pass instead of
+                # coalescing with the held peers — one extra dispatch
+                # pass over staged blocks, bounded by the hold's
+                # staging wall; blocking the claim on the hold would
+                # trade worker idle time for it instead.
+                same = (not entry[2]._prefetch_hold
+                        and entry[2].job.coalesce_key() == key)
             except Exception:
                 same = False     # surfaces when it becomes `best`
             if same:
@@ -348,6 +387,171 @@ class Scheduler:
                         self._finish(h)
                 progressed = True
         return progressed
+
+    # ---- warmup + scheduler-driven prefetch (docs/COLDSTART.md) ----
+
+    def _plan_for(self, handles: list[JobHandle]):
+        """Coalesce-plan ``handles`` exactly as a claim would: bucket
+        by coalesce key (failures dropped — they surface at claim
+        time), then :func:`~mdanalysis_mpi_tpu.service.coalesce.
+        plan_units` per bucket.  Used by warmup and prefetch so what
+        they compile/stage is what the claim will actually run."""
+        buckets: dict = {}
+        for h in handles:
+            try:
+                buckets.setdefault(h.job.coalesce_key(), []).append(h)
+            except Exception:
+                continue
+        units = []
+        for group in buckets.values():
+            try:
+                units.extend(_coalesce.plan_units(group))
+            except Exception:
+                continue
+        return units
+
+    def warmup(self, jobs) -> dict:
+        """AOT-precompile every program the given jobs (AnalysisJobs
+        or analysis instances) will need, BEFORE submission: plans the
+        coalesce units a claim would produce and hands each unit's
+        runnable to the executor's warmup
+        (``jit(...).lower().compile()`` keyed by op/shape/dtype/
+        backend/scan_k — utils/compile_cache.py).  With the persistent
+        compile cache on, a warmed fresh worker's first dispatch skips
+        tracing AND compilation.  Returns
+        ``{"executables": n, "seconds": wall}``."""
+        import time
+
+        from mdanalysis_mpi_tpu.parallel.executors import (
+            get_executor, warmup_analysis,
+        )
+
+        t0 = time.perf_counter()
+        handles = [JobHandle(j if isinstance(j, AnalysisJob)
+                             else AnalysisJob(j)) for j in jobs]
+        n = 0
+        for unit in self._plan_for(handles):
+            job = unit.handles[0].job
+            if job.backend not in ("jax", "mesh"):
+                continue
+            kwargs = {k: v for k, v in job.executor_kwargs.items()
+                      if k != "block_cache"}
+            kwargs["block_cache"] = (
+                job.executor_kwargs.get("block_cache") or self.cache)
+            try:
+                ex = get_executor(job.backend, **kwargs)
+                n += warmup_analysis(unit.runnable, ex,
+                                     batch_size=job.batch_size,
+                                     **job.window_kwargs())
+            except Exception as exc:
+                # warmup is an optimization: a job whose kernels fail
+                # to precompile still runs (and surfaces its real
+                # error, if any, at claim time)
+                self._log.warning("warmup skipped for %s: %s",
+                                  type(job.analysis).__name__, exc)
+        return {"executables": n,
+                "seconds": round(time.perf_counter() - t0, 4)}
+
+    def prefetch_pending(self, max_units: int | None = None) -> int:
+        """Stage queued (unclaimed) jobs' blocks into the shared cache
+        ahead of their claim — synchronously, in priority order.
+        Respects admission control (reserve-or-skip; NEVER evicts —
+        prefetch is opportunistic and must not displace a hot
+        tenant's superblocks) and tenant pinning.  Returns blocks
+        staged.  The background twin (``prefetch=True``) calls this
+        while all workers are busy.
+
+        Resilient jobs are not prefetched: their claim-time staging
+        runs under a per-run ReliabilityRuntime whose salvage state
+        namespaces the cache keys (``validate=True``) — a plain
+        prefetch would stage ``validate=False`` twins the run can
+        never hit, dead weight in a never-evicting shared cache."""
+        staged = 0
+        units_done = 0
+        while max_units is None or units_done < max_units:
+            with self._cond:
+                pending = [e[2] for e in sorted(self._queue)
+                           if not e[2]._prefetch_hold
+                           and not e[2].prefetched
+                           and not e[2].job.resilient
+                           and e[2].job.backend in ("jax", "mesh")
+                           and "block_cache" not in
+                           e[2].job.executor_kwargs]
+                if self.cache is None or not pending:
+                    break
+                units = self._plan_for(pending)
+                if not units:
+                    break
+                unit = units[0]
+                for h in unit.handles:
+                    h._prefetch_hold = True
+            try:
+                staged += self._prefetch_unit(unit)
+            finally:
+                with self._cond:
+                    for h in unit.handles:
+                        h._prefetch_hold = False
+                        h.prefetched = True
+                    self._cond.notify_all()
+            units_done += 1
+        return staged
+
+    def _prefetch_unit(self, unit) -> int:
+        """Stage one planned unit's blocks (no dispatch).  Admission:
+        reserve the estimate, or ride resident entries; otherwise skip
+        — deferral and eviction are claim-time decisions."""
+        from mdanalysis_mpi_tpu.parallel.executors import (
+            get_executor, stage_analysis,
+        )
+
+        job = unit.handles[0].job
+        est = self._estimate_bytes(job)
+        reserved = 0
+        if est > self.cache.max_bytes:
+            self.telemetry.count("prefetch_skipped")
+            return 0
+        if self.cache.reserve(est):
+            reserved = est
+        elif not self.cache.ns_bytes(reader_fingerprint(job.trajectory)):
+            self.telemetry.count("prefetch_skipped")
+            return 0
+        try:
+            kwargs = {k: v for k, v in job.executor_kwargs.items()
+                      if k != "block_cache"}
+            ex = get_executor(job.backend, block_cache=self.cache,
+                              **kwargs)
+            n = stage_analysis(unit.runnable, ex,
+                               batch_size=job.batch_size,
+                               **job.window_kwargs())
+        except Exception as exc:
+            self.telemetry.count("prefetch_skipped")
+            self._log.warning("prefetch failed for %s: %s",
+                              type(job.analysis).__name__, exc)
+            return 0
+        finally:
+            if reserved:
+                # staged bytes are now accounted as cache entries
+                self.cache.release(reserved)
+        if n:
+            self.telemetry.count("prefetch_jobs", len(unit.handles))
+            self.telemetry.count("prefetch_blocks", n)
+        return n
+
+    def _prefetch_worker(self) -> None:
+        """Background prefetch: while every worker is mid-run and
+        unclaimed jobs wait, stage the next unit's blocks so its
+        wave-1 misses become hits."""
+        while True:
+            with self._cond:
+                while not self._shutdown and not (
+                        self._active >= self.n_workers
+                        and any(not e[2]._prefetch_hold
+                                and not e[2].prefetched
+                                for e in self._queue)):
+                    self._cond.wait(0.05)
+                if self._shutdown:
+                    return
+            self.prefetch_pending(max_units=1)
 
     # ---- cache admission ----
 

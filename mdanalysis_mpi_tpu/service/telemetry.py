@@ -63,6 +63,10 @@ class ServiceTelemetry:
         self.admission_deferrals = 0   # admissible-later jobs passed over
         self.admission_uncached = 0    # jobs run without the shared cache
         self.admission_evictions = 0   # evict_unpinned entries reclaimed
+        # scheduler-driven prefetch (docs/COLDSTART.md)
+        self.prefetch_jobs = 0         # queued jobs whose blocks staged
+        self.prefetch_blocks = 0       # blocks staged ahead of claim
+        self.prefetch_skipped = 0      # skipped by admission/budget
         # distributions (seconds), bounded — see MAX_SAMPLES
         self.queue_wait_samples: deque = deque(maxlen=MAX_SAMPLES)
         self.latency_samples: deque = deque(maxlen=MAX_SAMPLES)
@@ -149,6 +153,9 @@ class ServiceTelemetry:
                 "admission_deferrals": self.admission_deferrals,
                 "admission_uncached": self.admission_uncached,
                 "admission_evictions": self.admission_evictions,
+                "prefetch_jobs": self.prefetch_jobs,
+                "prefetch_blocks": self.prefetch_blocks,
+                "prefetch_skipped": self.prefetch_skipped,
                 "p50_queue_wait_s": percentile(self.queue_wait_samples, 50),
                 "p99_queue_wait_s": percentile(self.queue_wait_samples, 99),
                 "p50_latency_s": percentile(self.latency_samples, 50),

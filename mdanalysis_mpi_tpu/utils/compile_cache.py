@@ -1,0 +1,343 @@
+"""Persistent compilation cache + AOT warmup surface (docs/COLDSTART.md).
+
+PERF.md §8d measured the serving cold path at 141.8 f/s against the
+≥250 bar, and every fresh worker process re-pays jit tracing + XLA
+compilation for kernels the fleet has compiled thousands of times
+before.  This module removes that tax in three tiers:
+
+1. **Persistent (on-disk) compilation cache** — :func:`ensure_enabled`
+   points JAX's own compilation cache at a derived per-project
+   directory (``MDTPU_COMPILE_CACHE_DIR``, default
+   ``~/.cache/mdanalysis_mpi_tpu/xla/jax-<version>``), so a fresh
+   process's XLA compiles are disk deserializations, not compiles.
+   JAX keys entries on the computation fingerprint + compile options +
+   jax/jaxlib version, so stale entries can never be served; our
+   directory adds a ``jax-<version>`` component purely so wholesale
+   invalidation is one ``rm -rf`` of an obviously-named dir.
+   Opt out with ``MDTPU_COMPILE_CACHE=0``.
+
+2. **AOT executables** — :func:`aot_compile` runs
+   ``jit(fn).lower(*avals).compile()`` ahead of the first dispatch and
+   registers the compiled executable under a key of
+   ``(op, shapes/dtypes, backend, scan_k)``.  The executors
+   (:mod:`~mdanalysis_mpi_tpu.parallel.executors`) consult
+   :func:`aot_get` with the same key at ``execute()`` time and bind
+   the dispatch directly to the executable — the first real dispatch
+   of a warmed shape skips tracing AND compilation entirely.  Where
+   the running jax supports :mod:`jax.export`, the lowered module is
+   also serialized beside the compile cache, so a later process skips
+   the Python-level trace too (its XLA compile then hits tier 1).
+
+3. **Compile observability** — monitoring listeners mirror JAX's own
+   compile events into :data:`~mdanalysis_mpi_tpu.obs.metrics.METRICS`
+   (names pinned by tests/test_bench_contract.py)::
+
+       mdtpu_compile_total              # XLA backend_compile requests
+       mdtpu_compile_seconds            # total seconds inside them
+       mdtpu_compile_cache_hits_total   # served from the persistent cache
+       mdtpu_compile_cache_misses_total # actually compiled (new entries)
+       mdtpu_aot_compiled_total         # executables built by warmup
+       mdtpu_aot_dispatches_total       # run() calls bound to one
+
+   "A fresh worker compiled zero new executables" is then a checkable
+   claim: ``mdtpu_compile_cache_misses_total == 0``.
+
+Everything degrades gracefully: a jax without the config knobs, an
+unwritable cache dir, or an un-exportable program (some shard_map
+forms) falls back to today's behavior with the failure disclosed once
+via the logger, never raised into an analysis run.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+
+from mdanalysis_mpi_tpu.obs.metrics import COMPILE_METRICS, METRICS
+from mdanalysis_mpi_tpu.utils.log import get_logger
+
+_log = get_logger("mdtpu.compile_cache")
+
+_lock = threading.Lock()
+_state = {
+    "enabled": None,       # None = not attempted, False = off/failed,
+    #                        str = active cache dir
+    "listeners": False,
+}
+
+# COMPILE_METRICS (the names this module records) lives in
+# obs.metrics so unified_snapshot can zero-inject them without obs
+# importing anything beyond the stdlib; re-exported here for callers.
+
+
+def cache_dir() -> str:
+    """The derived persistent-cache directory (not created here)."""
+    env = os.environ.get("MDTPU_COMPILE_CACHE_DIR")
+    if env:
+        return env
+    try:
+        import jax
+
+        ver = jax.__version__
+    except Exception:                       # pragma: no cover
+        ver = "unknown"
+    base = os.environ.get("XDG_CACHE_HOME",
+                          os.path.join(os.path.expanduser("~"), ".cache"))
+    return os.path.join(base, "mdanalysis_mpi_tpu", "xla", f"jax-{ver}")
+
+
+def _install_listeners() -> None:
+    """Mirror jax's compile/cache monitoring events into METRICS.
+    Idempotent; the listeners are process-global and cheap (one counter
+    bump per COMPILE, never per dispatch)."""
+    if _state["listeners"]:
+        return
+    try:
+        from jax._src import monitoring
+    except Exception:                       # pragma: no cover
+        return
+
+    def _on_event(name: str, **kw) -> None:
+        if name == "/jax/compilation_cache/cache_hits":
+            METRICS.inc("mdtpu_compile_cache_hits_total")
+        elif name == "/jax/compilation_cache/cache_misses":
+            METRICS.inc("mdtpu_compile_cache_misses_total")
+
+    def _on_duration(name: str, secs: float, **kw) -> None:
+        if name == "/jax/core/compile/backend_compile_duration":
+            METRICS.inc("mdtpu_compile_total")
+            METRICS.inc("mdtpu_compile_seconds", secs)
+
+    monitoring.register_event_listener(_on_event)
+    monitoring.register_event_duration_secs_listener(_on_duration)
+    _state["listeners"] = True
+
+
+def ensure_enabled() -> str | None:
+    """Enable the persistent compilation cache (idempotent, thread-
+    safe).  Returns the active cache dir, or None when disabled
+    (``MDTPU_COMPILE_CACHE=0``) or unsupported.  Called by every jit
+    construction site in the executors, so ANY entry point — library
+    run(), scheduler worker, CLI — gets the cache without opting in.
+    """
+    with _lock:
+        if _state["enabled"] is not None:
+            return _state["enabled"] or None
+        if os.environ.get("MDTPU_COMPILE_CACHE", "1") in (
+                "0", "false", "no"):
+            _state["enabled"] = False
+            return None
+        try:
+            import jax
+
+            # an operator who already configured jax's own cache
+            # (JAX_COMPILATION_CACHE_DIR / jax.config.update — e.g. a
+            # fleet-shared dir) keeps their dir AND their thresholds;
+            # we only observe it
+            theirs = getattr(jax.config, "jax_compilation_cache_dir",
+                             None)
+            if theirs:
+                _install_listeners()
+                _state["enabled"] = theirs
+                return theirs
+            d = cache_dir()
+            os.makedirs(d, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", d)
+            # cache EVERY executable: the kernels here are small and
+            # fast to compile individually, but a serving worker pays
+            # dozens of them before its first result — the default
+            # min-size/min-time thresholds would skip exactly the
+            # entries the cold path needs
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                              -1)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              0.0)
+            # jax initializes its cache ONCE, lazily, at the first
+            # compile — and a library user's first jit routinely runs
+            # before any executor is built (reader utilities, analysis
+            # _prepare).  If that happened with no dir configured, the
+            # memoized "disabled" state would silently swallow this
+            # whole feature; reset so the next compile re-initializes
+            # against the dir just set.
+            try:
+                from jax._src import compilation_cache as _jcc
+
+                _jcc.reset_cache()
+            except Exception:               # pragma: no cover
+                pass
+        except Exception as exc:            # unwritable dir / old jax
+            _log.warning("persistent compile cache disabled: %s", exc)
+            _state["enabled"] = False
+            return None
+        _install_listeners()
+        _state["enabled"] = d
+        return d
+
+
+def jit(fn, **kwargs):
+    """``jax.jit`` with the persistent cache guaranteed enabled first —
+    the one constructor the executor layer routes through."""
+    import jax
+
+    ensure_enabled()
+    return jax.jit(fn, **kwargs)
+
+
+def counters() -> dict:
+    """Current compile/cache counter values (0 when never recorded)."""
+    snap = METRICS.snapshot()
+    out = {}
+    for name in COMPILE_METRICS:
+        vals = snap.get(name, {}).get("values", {})
+        out[name] = vals.get("", 0)
+    return out
+
+
+# ---------------------------------------------------------------------
+# AOT executable registry
+# ---------------------------------------------------------------------
+
+_AOT: dict = {}
+_AOT_LOCK = threading.Lock()
+
+
+def _aval_sig(avals) -> tuple:
+    """Canonical (shape, dtype) signature of an aval tuple — the
+    shape/dtype part of every AOT key.  Concrete arrays and
+    ShapeDtypeStructs normalize identically; None leaves and Python
+    scalars are carried by repr (they are part of the traced
+    structure)."""
+    import jax
+
+    sig = []
+    for leaf in jax.tree.leaves(avals, is_leaf=lambda x: x is None):
+        if leaf is None:
+            sig.append("none")
+        elif hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            sig.append((tuple(leaf.shape), str(leaf.dtype)))
+        else:
+            sig.append((type(leaf).__name__,))
+    return tuple(sig)
+
+
+def aot_key(op: str, args, backend: str | None = None,
+            scan_k: int = 1) -> tuple:
+    """The AOT registry key: (op label, arg shapes/dtypes, backend,
+    scan_k).  ``op`` must name the underlying kernel stably across
+    processes (module.qualname + staging dtype + program role — the
+    executors build it), so a serialized executable written by one
+    worker is findable by the next."""
+    if backend is None:
+        import jax
+
+        backend = jax.default_backend()
+    return (op, _aval_sig(args), backend, int(scan_k))
+
+
+def aot_get(key: tuple):
+    """The compiled executable registered under ``key``, or None."""
+    with _AOT_LOCK:
+        return _AOT.get(key)
+
+
+def aot_active() -> bool:
+    """True when any executable is registered — the executors' cheap
+    guard before computing lookup keys on the dispatch path."""
+    return bool(_AOT)
+
+
+def _export_enabled() -> bool:
+    """Whether the serialized-executable tier (jax.export round trips
+    to disk) is active.  OFF by default: on jax 0.4.x CPU, calling a
+    DESERIALIZED exported module works correctly but corrupts
+    interpreter teardown (reproducible exit-time segfault after a
+    clean run — measured during this PR; the tier-1 subprocess tests
+    would read it as rc=139).  The persistent XLA cache (tier 1)
+    already removes the cross-process COMPILE cost; this tier only
+    shaves the Python re-trace, so it stays opt-in
+    (``MDTPU_AOT_EXPORT=1``) until a jax upgrade clears the teardown
+    path."""
+    return os.environ.get("MDTPU_AOT_EXPORT", "0") in ("1", "true",
+                                                       "yes")
+
+
+def _export_path(key: tuple) -> str | None:
+    if not _export_enabled():
+        return None
+    d = ensure_enabled()
+    if d is None:
+        return None
+    h = hashlib.sha256(repr(key).encode()).hexdigest()[:32]
+    return os.path.join(d, "aot", f"{h}.jaxexport")
+
+
+def aot_compile(op: str, jit_fn, *args, scan_k: int = 1):
+    """AOT-compile ``jit_fn`` for ``args`` (concrete values and/or
+    ``jax.ShapeDtypeStruct``\\ s) and register the executable.
+
+    Returns the registry key, or None when compilation failed (logged;
+    the executors then stay on the jit path).  Reuses an existing
+    entry; otherwise tries the serialized-export tier (skips Python
+    tracing; its XLA compile hits the persistent disk cache), else
+    lowers + compiles (which POPULATES both tiers for the next
+    process).  Failures fall back tier by tier and are logged —
+    warmup must never be able to fail a run.
+    """
+    import jax
+
+    ensure_enabled()
+    key = aot_key(op, args, scan_k=scan_k)
+    with _AOT_LOCK:
+        if key in _AOT:
+            return key
+    compiled = None
+    path = _export_path(key)
+    if path is not None and os.path.exists(path):
+        try:
+            from jax import export as jexport
+
+            with open(path, "rb") as f:
+                exported = jexport.deserialize(bytearray(f.read()))
+            compiled = jax.jit(exported.call).lower(*args).compile()
+        except Exception as exc:
+            _log.warning("stale/unreadable AOT export %s: %s", path, exc)
+            compiled = None
+    if compiled is None:
+        try:
+            compiled = jit_fn.lower(*args).compile()
+        except Exception as exc:
+            # e.g. an aval drift vs the kernel's real inputs: the
+            # executors fall back to plain jit dispatch (the
+            # _staged_avals "perf regression, not a crash" contract)
+            _log.warning("AOT compile failed for %s: %s", op, exc)
+            return None
+        if path is not None:
+            try:
+                from jax import export as jexport
+
+                data = jexport.export(jit_fn)(*args).serialize()
+                os.makedirs(os.path.dirname(path), exist_ok=True)
+                tmp = f"{path}.tmp.{os.getpid()}"
+                with open(tmp, "wb") as f:
+                    f.write(data)
+                os.replace(tmp, path)
+            except Exception as exc:
+                # not exportable (some shard_map/scan forms) — tier 1
+                # still covers the next process's compile
+                _log.debug("AOT export skipped for %s: %s", op, exc)
+    METRICS.inc("mdtpu_aot_compiled_total")
+    with _AOT_LOCK:
+        _AOT[key] = compiled
+    return key
+
+
+def note_aot_dispatch() -> None:
+    """Executor-side: a run bound its dispatch to an AOT executable."""
+    METRICS.inc("mdtpu_aot_dispatches_total")
+
+
+def clear_aot() -> None:
+    """Drop the in-memory registry (tests)."""
+    with _AOT_LOCK:
+        _AOT.clear()

@@ -43,6 +43,17 @@ PINNED_METRICS = {
     "mdtpu_queue_depth_peak": "gauge",
     "mdtpu_queue_wait_seconds": "histogram",
     "mdtpu_job_latency_seconds": "histogram",
+    # cold-path overhaul (docs/COLDSTART.md): compile observability +
+    # scheduler-driven prefetch
+    "mdtpu_compile_total": "counter",
+    "mdtpu_compile_seconds": "counter",
+    "mdtpu_compile_cache_hits_total": "counter",
+    "mdtpu_compile_cache_misses_total": "counter",
+    "mdtpu_aot_compiled_total": "counter",
+    "mdtpu_aot_dispatches_total": "counter",
+    "mdtpu_prefetch_jobs_total": "counter",
+    "mdtpu_prefetch_blocks_total": "counter",
+    "mdtpu_prefetch_skipped_total": "counter",
 }
 
 
@@ -145,8 +156,17 @@ def test_bench_json_contract(tmp_path):
         assert 0 < rec["serving_accel_cache_hit_rate"] <= 1
         assert rec["serving_accel_coalesce_rate"] == 1.0
         assert "serving_accel" in rec["accel_leg_order"]
-        assert rec["accel_leg_order"][0] == "cold"
+        # §9e reorder: the clean-process compile leg records first,
+        # then the cold attempts
+        assert rec["accel_leg_order"][:2] == ["cold_compile", "cold"]
         assert "f32_steady" in rec["accel_leg_order"]
+        # cold-compile leg fields (docs/COLDSTART.md)
+        assert rec["cold_compile_fps"] > 0
+        assert rec["warmup_seconds"] > 0
+        assert isinstance(rec["compile_cache_hit"], bool)
+        # prefetched serving wave: wave-1 dispatches ran hit-resident
+        assert rec["serving_accel_wave1_hit_rate"] == 1.0
+        assert rec["serving_accel_prefetch_blocks"] >= 1
         assert rec["unit"] == "frames/s/chip"
         assert "file-backed XTC" in rec["metric"]
         assert "steady-state" in rec["metric"]

@@ -1,0 +1,184 @@
+"""Persistent compilation cache + AOT warmup (utils/compile_cache.py,
+docs/COLDSTART.md).
+
+The cold-path contract this file pins:
+
+- compile activity is observable: jax's compile/cache events mirror
+  into the obs metrics registry under the PINNED names;
+- AOT warmup registers executables keyed by (op, shape, dtype,
+  backend, scan_k) and ``execute`` binds its dispatches to them
+  (``mdtpu_aot_dispatches_total`` moves) with serial-oracle parity;
+- the TWO-PROCESS acceptance: with a shared cache dir, a second fresh
+  process running the flagship-shaped protocol compiles ZERO new
+  executables (``mdtpu_compile_cache_misses_total == 0``) and reaches
+  its first result faster than the cold-cache process.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_cache_dir_env_override(monkeypatch):
+    from mdanalysis_mpi_tpu.utils import compile_cache as cc
+
+    monkeypatch.setenv("MDTPU_COMPILE_CACHE_DIR", "/tmp/somewhere")
+    assert cc.cache_dir() == "/tmp/somewhere"
+    monkeypatch.delenv("MDTPU_COMPILE_CACHE_DIR")
+    # derived default names the jax version, so wholesale invalidation
+    # is one obvious rm -rf (jax's own entry keys do the fine-grained
+    # invalidation)
+    assert f"jax-{jax.__version__}" in cc.cache_dir()
+
+
+def test_compile_metrics_zero_injected_without_jax_contact():
+    """The pinned compile metric names appear (zeroed) in a unified
+    snapshot from a registry that never saw a compile — the bench host
+    legs' schema depends on this."""
+    from mdanalysis_mpi_tpu.obs.metrics import (
+        COMPILE_METRICS, MetricsRegistry, unified_snapshot,
+    )
+
+    snap = unified_snapshot(registry=MetricsRegistry())
+    for name in COMPILE_METRICS:
+        assert name in snap
+        assert snap[name]["type"] == "counter"
+
+
+def test_ensure_enabled_and_counters(tmp_path, monkeypatch):
+    """ensure_enabled points jax's cache at the derived dir and the
+    monitoring listeners feed mdtpu_compile_* counters."""
+    from mdanalysis_mpi_tpu.utils import compile_cache as cc
+
+    d = cc.ensure_enabled()
+    if d is None:
+        pytest.skip("compile cache disabled in this environment")
+    c0 = cc.counters()
+
+    @jax.jit
+    def f(x):
+        return x * 3.0 + 1.0
+
+    f(np.arange(8, dtype=np.float32))
+    c1 = cc.counters()
+    assert c1["mdtpu_compile_total"] > c0["mdtpu_compile_total"]
+    assert c1["mdtpu_compile_seconds"] > c0["mdtpu_compile_seconds"]
+    # the compile either hit the on-disk cache or wrote a new entry
+    assert (c1["mdtpu_compile_cache_hits_total"]
+            + c1["mdtpu_compile_cache_misses_total"]) > (
+        c0["mdtpu_compile_cache_hits_total"]
+        + c0["mdtpu_compile_cache_misses_total"])
+
+
+def test_aot_warmup_binds_dispatch_with_parity():
+    """warmup_analysis registers executables; a following run binds its
+    dispatches to them (counter moves) and matches the serial f64
+    oracle within the int16 staging tolerance."""
+    from mdanalysis_mpi_tpu.analysis import AlignedRMSF
+    from mdanalysis_mpi_tpu.parallel.executors import (
+        DeviceBlockCache, JaxExecutor, warmup_analysis,
+    )
+    from mdanalysis_mpi_tpu.testing import make_protein_universe
+    from mdanalysis_mpi_tpu.utils import compile_cache as cc
+
+    u = make_protein_universe(n_residues=24, n_frames=16, noise=0.3,
+                              seed=3)
+    oracle = AlignedRMSF(u, select="name CA").run(backend="serial")
+    ex = JaxExecutor(batch_size=4,
+                     block_cache=DeviceBlockCache(max_bytes=1 << 30),
+                     transfer_dtype="int16")
+    n = warmup_analysis(AlignedRMSF(u, select="name CA"), ex,
+                        batch_size=4)
+    assert n >= 2            # both pass kernels at minimum
+    c0 = cc.counters()
+    r = AlignedRMSF(u, select="name CA").run(backend=ex, batch_size=4)
+    c1 = cc.counters()
+    assert (c1["mdtpu_aot_dispatches_total"]
+            > c0["mdtpu_aot_dispatches_total"])
+    np.testing.assert_allclose(r.results.rmsf, oracle.results.rmsf,
+                               atol=1e-3)
+
+
+def test_aot_key_distinguishes_shapes():
+    from mdanalysis_mpi_tpu.utils import compile_cache as cc
+
+    a4 = jax.ShapeDtypeStruct((4, 10, 3), np.float32)
+    a8 = jax.ShapeDtypeStruct((8, 10, 3), np.float32)
+    assert cc.aot_key("op", (a4,)) != cc.aot_key("op", (a8,))
+    assert cc.aot_key("op", (a4,)) != cc.aot_key("op", (a4,), scan_k=2)
+    assert cc.aot_key("op", (a4,)) == cc.aot_key("op", (a4,))
+
+
+_CHILD = """
+import json, os, sys, time
+sys.path.insert(0, {repo!r})
+t_start = time.perf_counter()
+import numpy as np
+from mdanalysis_mpi_tpu.testing import make_protein_universe
+from mdanalysis_mpi_tpu.analysis import AlignedRMSF
+from mdanalysis_mpi_tpu.parallel.executors import DeviceBlockCache, JaxExecutor
+from mdanalysis_mpi_tpu.utils import compile_cache as cc
+
+# the flagship shape class: AlignedRMSF (two-pass superposition +
+# moments), int16 staging, DeviceBlockCache, scan-folded dispatch —
+# scaled to CI size
+u = make_protein_universe(n_residues=24, n_frames=16, noise=0.3, seed=3)
+ex = JaxExecutor(batch_size=4, block_cache=DeviceBlockCache(1 << 30),
+                 transfer_dtype="int16")
+r = AlignedRMSF(u, select="name CA").run(backend=ex, batch_size=4)
+rmsf = np.asarray(r.results.rmsf)       # first result materialized
+t_first = time.perf_counter() - t_start
+c = cc.counters()
+print(json.dumps({{"ttfr_s": t_first,
+                  "compiles": c["mdtpu_compile_total"],
+                  "compile_seconds": c["mdtpu_compile_seconds"],
+                  "hits": c["mdtpu_compile_cache_hits_total"],
+                  "misses": c["mdtpu_compile_cache_misses_total"],
+                  "rmsf0": float(rmsf[0])}}))
+"""
+
+
+def test_second_process_compiles_zero_new_executables(tmp_path):
+    """THE two-process acceptance: same cache dir, same flagship-shape
+    protocol; the second (fresh) process's XLA compiles must ALL be
+    persistent-cache hits — zero new executables — and its seconds
+    spent inside backend_compile must collapse."""
+    script = tmp_path / "child.py"
+    script.write_text(_CHILD.format(repo=REPO))
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               MDTPU_COMPILE_CACHE_DIR=str(tmp_path / "cc"))
+    out = []
+    for _ in range(2):
+        proc = subprocess.run([sys.executable, str(script)], env=env,
+                              capture_output=True, text=True,
+                              timeout=300)
+        assert proc.returncode == 0, proc.stderr[-3000:]
+        out.append(json.loads(proc.stdout.strip().splitlines()[-1]))
+    cold, warm = out
+    # both processes computed the same answer
+    assert cold["rmsf0"] == pytest.approx(warm["rmsf0"], rel=1e-6)
+    # process 1 (cold cache) actually compiled new entries
+    assert cold["misses"] > 0
+    # process 2: ZERO new executables — every compile request was a
+    # persistent-cache deserialization
+    assert warm["misses"] == 0, (
+        f"second process compiled {warm['misses']} new executables; "
+        f"counters: {warm}")
+    assert warm["hits"] > 0
+    # the mechanism's direct timing claim: near-zero seconds INSIDE
+    # backend_compile (cache hits skip it).  NOT a wall-clock TTFR
+    # comparison — at this tiny shape compile is a sliver of the ~1s
+    # child wall, so warm-vs-cold TTFR is scheduler noise on a loaded
+    # CI host; the flagship TTFR record lives in
+    # PROFILE_COLDSTART.json (median of N pairs) instead.
+    assert warm["compile_seconds"] < cold["compile_seconds"], (cold,
+                                                               warm)

@@ -149,29 +149,45 @@ def _free_port() -> int:
 class TestTwoProcessMesh:
     def test_parity_two_controllers(self, tmp_path):
         out = str(tmp_path / "results.npz")
-        coord = f"127.0.0.1:{_free_port()}"
-        script = tmp_path / "child.py"
-        script.write_text(CHILD.format(repo=REPO, coord=coord, out=out,
-                                       n_res=N_RES, n_frames=N_FRAMES))
         env = dict(os.environ,
                    JAX_PLATFORMS="cpu",
                    XLA_FLAGS="--xla_force_host_platform_device_count=4")
-        procs = [subprocess.Popen([sys.executable, str(script), str(i)],
-                                  env=env, stdout=subprocess.PIPE,
-                                  stderr=subprocess.STDOUT)
-                 for i in range(2)]
-        outputs = []
-        for p in procs:
-            try:
-                stdout, _ = p.communicate(timeout=240)
-            except subprocess.TimeoutExpired:
-                for q in procs:
-                    q.kill()
-                pytest.fail("2-process mesh run timed out")
-            outputs.append(stdout.decode(errors="replace"))
-        for i, p in enumerate(procs):
-            assert p.returncode == 0, (
-                f"process {i} failed:\n{outputs[i][-3000:]}")
+        # one retry with a fresh port: the two coordinated children
+        # share this host's 2 cores with the rest of the suite, and a
+        # load spike can skew them past jax's distributed
+        # init/shutdown barriers (~37s quiet-host wall, but in-suite
+        # walls of minutes were measured).  A genuine collectives/
+        # parity bug fails BOTH attempts — identical code, identical
+        # inputs; only scheduler timing varies between them.
+        for attempt in (0, 1):
+            coord = f"127.0.0.1:{_free_port()}"
+            script = tmp_path / "child.py"
+            script.write_text(CHILD.format(repo=REPO, coord=coord,
+                                           out=out, n_res=N_RES,
+                                           n_frames=N_FRAMES))
+            procs = [subprocess.Popen(
+                [sys.executable, str(script), str(i)],
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT) for i in range(2)]
+            outputs, timed_out = [], False
+            for p in procs:
+                try:
+                    stdout, _ = p.communicate(timeout=300)
+                except subprocess.TimeoutExpired:
+                    for q in procs:
+                        q.kill()
+                        q.wait()
+                    timed_out = True
+                    break
+                outputs.append(stdout.decode(errors="replace"))
+            if not timed_out and all(p.returncode == 0 for p in procs):
+                break
+            if attempt == 1:
+                if timed_out:
+                    pytest.fail("2-process mesh run timed out twice")
+                for i, p in enumerate(procs):
+                    assert p.returncode == 0, (
+                        f"process {i} failed:\n{outputs[i][-3000:]}")
 
         # oracles in-parent (single process, serial f64)
         from mdanalysis_mpi_tpu.testing import make_protein_universe

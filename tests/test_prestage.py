@@ -8,6 +8,15 @@ device contact, then streams the puts.  Pinned here: the schedule
 actually separates the phases, results are bit-identical to the
 interleaved schedule, and a shared DeviceBlockCache still serves the
 second run without re-staging.
+
+The schedule-order tests force ``MDTPU_COLD_PIPELINE=0``: on
+multi-core hosts the cold path now defaults to the DOUBLE-BUFFERED
+decode→wire pipeline (wire of block i overlaps decode of block i+1 on
+a dedicated thread — docs/COLDSTART.md), which deliberately
+interleaves the very events the chunked schedule separates.  Chunked
+stays the 1-core default and these tests pin ITS contract; the
+pipelined schedule's own order/parity tests live in
+tests/test_cold_prefetch.py.
 """
 
 import numpy as np
@@ -56,6 +65,7 @@ def _traced(u, monkeypatch):
 
 
 def test_prestage_stages_every_batch_before_first_put(monkeypatch):
+    monkeypatch.setenv("MDTPU_COLD_PIPELINE", "0")
     u = make_protein_universe(n_residues=30, n_frames=32, noise=0.2)
     events = _traced(u, monkeypatch)
     RMSD(u.select_atoms("name CA")).run(backend="jax", batch_size=8,
@@ -70,6 +80,7 @@ def test_prestage_chunked_schedule(monkeypatch):
     CHUNK: both of a chunk's stages land before its first put, and the
     next chunk's stages start only after the previous chunk wired —
     bounded host residency without decode/transfer interleaving."""
+    monkeypatch.setenv("MDTPU_COLD_PIPELINE", "0")
     monkeypatch.setenv("MDTPU_PRESTAGE_CHUNK", "2")
     monkeypatch.setenv("MDTPU_WIRE_WINDOW", "2")
     u = make_protein_universe(n_residues=30, n_frames=32, noise=0.2)
@@ -155,6 +166,7 @@ def test_window_exceeding_chunk_is_coerced(monkeypatch):
     """MDTPU_WIRE_WINDOW > MDTPU_PRESTAGE_CHUNK runs with chunk raised
     to the window (phase separation would otherwise break); results
     stay bit-identical to the plain schedule."""
+    monkeypatch.setenv("MDTPU_COLD_PIPELINE", "0")
     monkeypatch.setenv("MDTPU_PRESTAGE_CHUNK", "1")
     monkeypatch.setenv("MDTPU_WIRE_WINDOW", "4")
     u = make_protein_universe(n_residues=24, n_frames=32, noise=0.25)

@@ -45,14 +45,34 @@ class TestRingEngine:
 
     def test_subset_groups_as_weights(self):
         """O-H RDF: distinct overlapping-universe groups ride the union
-        array as weight vectors — no gathers inside the ring."""
+        array as weight vectors — no gathers inside the ring.
+
+        Two gates: the ring must match the frame-sharded XLA engine
+        BIT-EXACTLY (same f32 distances, same bucketize — any weight/
+        union/offset bug shows here), and match the serial f64 oracle
+        up to bin-edge ties: the O-H bond-length peak piles near-equal
+        distances onto bin edges, where f32-vs-f64 rounding moves a
+        count to the adjacent bin (1 pair here; same tie class
+        test_pallas.py::test_pallas_vs_serial tolerates with
+        atol=1.0 — this test's old blanket rtol=1e-4 on normalized
+        g(r) could not express that)."""
         u = make_water_universe(n_waters=40, n_frames=2, seed=3)
         ring = _rdf(u, "ring", sel1="name OW", sel2="name HW1",
                     backend="mesh", batch_size=2)
+        xla = _rdf(u, "xla", sel1="name OW", sel2="name HW1",
+                   backend="jax", batch_size=2)
         serial = _rdf(u, "xla", sel1="name OW", sel2="name HW1",
                       backend="serial")
+        np.testing.assert_allclose(ring.results.count, xla.results.count,
+                                   rtol=0, atol=0)
+        np.testing.assert_allclose(ring.results.rdf, xla.results.rdf,
+                                   rtol=1e-6)
+        # f64 oracle: counts within one edge-tie flip per bin, and the
+        # normalized g(r) within the tie-induced envelope
+        np.testing.assert_allclose(ring.results.count,
+                                   serial.results.count, atol=1.0)
         np.testing.assert_allclose(ring.results.rdf, serial.results.rdf,
-                                   rtol=1e-4)
+                                   rtol=2e-2, atol=5e-3)
 
     def test_padding_weights_are_inert(self):
         """Union (3N atoms, not a multiple of 512) is padded with
