@@ -740,6 +740,72 @@ def serving_host_leg(u_mem) -> dict:
     }
 
 
+def serving_fault_leg(u_mem) -> dict:
+    """Fault-wave sub-leg of the serving host leg
+    (docs/RELIABILITY.md, "Serving supervision"): the SAME synthetic
+    load twice — a clean wave, then a wave with ONE injected worker
+    death mid-wave — so the artifact carries the price of a
+    supervised recovery (lease reap + solo requeue + worker respawn)
+    next to the clean-path number.  Serial backend by construction:
+    survives the outage protocol like every host leg."""
+    from mdanalysis_mpi_tpu.analysis import RMSF
+    from mdanalysis_mpi_tpu.reliability import faults
+    from mdanalysis_mpi_tpu.service import Scheduler
+
+    import contextlib
+
+    window = SERIAL_FRAMES
+
+    def wave(spec=None):
+        sched = Scheduler(n_workers=2, autostart=False,
+                          supervision_interval_s=0.02)
+        # start staggers the windows into 4 distinct coalesce keys, so
+        # the wave is claimed as several batches and the injected
+        # death strands one claim mid-wave, not the whole queue
+        handles = [
+            sched.submit(RMSF(u_mem.select_atoms(SELECT)),
+                         backend="serial", start=i % 4, stop=window,
+                         coalesce=False, tenant=f"w{i}")
+            for i in range(8)
+        ]
+        t0 = time.perf_counter()
+        with (faults.inject(spec) if spec is not None
+              else contextlib.nullcontext()):
+            sched.start()
+            if not sched.drain(timeout=600):
+                raise RuntimeError("serving fault leg: drain timed out")
+            sched.shutdown()
+        wall = time.perf_counter() - t0
+        errs = [h for h in handles if h.error is not None]
+        if errs:
+            raise RuntimeError(f"serving fault leg: {len(errs)} jobs "
+                               f"failed: {errs[0].error!r}")
+        return len(handles) / wall, sched.telemetry
+
+    clean_jps, _ = wave()
+    fault_jps, telemetry = wave(
+        faults.FaultSpec("worker", "raise", times=1))
+    snap = telemetry.snapshot()
+    if not snap["lease_expired"]:
+        raise RuntimeError("serving fault leg: the injected worker "
+                           "death was never reaped — supervision is "
+                           "not engaging")
+    telemetry.log(leg="serving_fault")
+    return {
+        "serving_fault_clean_jobs_per_s": round(clean_jps, 2),
+        "serving_fault_recovery_jobs_per_s": round(fault_jps, 2),
+        "serving_fault_recovery_p99_latency_s": round(
+            snap["p99_latency_s"], 4),
+        # the price of one mid-wave worker death (reap + requeue +
+        # respawn), as a fraction of the clean wave's throughput
+        "serving_fault_recovery_overhead_pct": round(
+            (clean_jps - fault_jps) / clean_jps * 100.0, 2),
+        "serving_fault_lease_expired": snap["lease_expired"],
+        "serving_fault_jobs_requeued": snap["jobs_requeued"],
+        "serving_fault_workers_respawned": snap["workers_respawned"],
+    }
+
+
 def serving_accel_leg(u_file, accel_backend: str, tdtype: str,
                       jax) -> dict:
     """Multi-tenant load on the accelerator backend with one SHARED
@@ -888,6 +954,16 @@ def main():
     _note(f"[bench] serving (host): {serving['serving_jobs_per_s']} "
           f"jobs/s, coalesce rate {serving['serving_coalesce_rate']}")
     _leg_done("serving host leg", **serving)
+
+    # fault-wave sub-leg (docs/RELIABILITY.md): one injected worker
+    # death mid-wave vs a clean wave — the supervised-recovery price,
+    # still host-side so it survives a tunnel-down artifact
+    fault_wave = serving_fault_leg(u_mem)
+    _note(f"[bench] serving fault wave: "
+          f"{fault_wave['serving_fault_recovery_jobs_per_s']} jobs/s "
+          f"with 1 worker death (clean "
+          f"{fault_wave['serving_fault_clean_jobs_per_s']})")
+    _leg_done("serving fault-wave leg", **fault_wave)
 
     u_file = open_flagship(N_ATOMS, N_FRAMES)
     src_label = ("file-backed XTC" if SOURCE == "file"

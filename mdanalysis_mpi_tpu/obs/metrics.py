@@ -136,7 +136,8 @@ _TELEMETRY_COUNTERS = (
     "uncoalescable_jobs", "coalesce_fallbacks", "admission_reserved",
     "admission_resident", "admission_deferrals", "admission_uncached",
     "admission_evictions", "prefetch_jobs", "prefetch_blocks",
-    "prefetch_skipped",
+    "prefetch_skipped", "jobs_aborted", "breaker_reroutes",
+    "workers_respawned",
 )
 _TELEMETRY_GAUGES = ("queue_depth", "queue_depth_peak")
 
@@ -153,6 +154,26 @@ COMPILE_METRICS = (
     "mdtpu_compile_cache_misses_total",
     "mdtpu_aot_compiled_total",
     "mdtpu_aot_dispatches_total",
+)
+
+#: Circuit-breaker series owned by reliability/breaker.py (written
+#: live into the global registry on every state transition).
+#: Zero-injected into :func:`unified_snapshot` so the pinned schema
+#: (tests/test_bench_contract.py PINNED_METRICS) holds in processes
+#: where no breaker ever tripped — the healthy case.
+BREAKER_COUNTERS = ("mdtpu_breaker_transitions_total",)
+BREAKER_GAUGES = ("mdtpu_breaker_state",)
+
+#: Supervision counters owned by service/scheduler.py, written live
+#: into the global registry at the incident site — WITH labels
+#: (``mdtpu_lease_expired_total`` carries ``reason=``) that a flat
+#: ServiceTelemetry mapping would overwrite, so these are deliberately
+#: NOT in :data:`_TELEMETRY_COUNTERS`.  Zero-injected like the breaker
+#: series so the pinned schema holds in healthy processes.
+SUPERVISION_COUNTERS = (
+    "mdtpu_lease_expired_total",
+    "mdtpu_jobs_quarantined_total",
+    "mdtpu_jobs_requeued_total",
 )
 
 
@@ -174,8 +195,13 @@ def unified_snapshot(timers=None, cache=None, telemetry=None,
     ``tests/test_bench_contract.py`` pins.
     """
     snap = (registry or METRICS).snapshot()
-    for name in COMPILE_METRICS:
+    for name in COMPILE_METRICS + BREAKER_COUNTERS + \
+            SUPERVISION_COUNTERS:
         snap.setdefault(name, {"type": "counter", "values": {"": 0}})
+    for name in BREAKER_GAUGES:
+        # 0 == closed (reliability/breaker.py STATE_VALUES): a process
+        # that never tripped a breaker reports the healthy state
+        snap.setdefault(name, {"type": "gauge", "values": {"": 0}})
     if timers is not None:
         rep = timers.report()
         snap["mdtpu_phase_seconds_total"] = {

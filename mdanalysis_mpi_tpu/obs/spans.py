@@ -122,6 +122,26 @@ def n_events() -> int:
         return len(_STATE.events)
 
 
+def tail(limit: int = 50, trace_id: str | None = None) -> list[dict]:
+    """The most recent recorded events (copies), newest last —
+    optionally only those whose merged args carry ``trace_id`` in
+    their ``trace_ids``/``trace_id`` attribution.  Used by the serving
+    supervisor to capture a quarantined job's last span trace into its
+    diagnostics; empty when tracing is off."""
+    with _LOCK:
+        events = list(_STATE.events)
+    if trace_id is not None:
+        def _matches(ev):
+            args = ev.get("args") or {}
+            return (trace_id in (args.get("trace_ids") or ())
+                    or args.get("trace_id") == trace_id)
+
+        events = [ev for ev in events if _matches(ev)]
+    else:
+        events = [ev for ev in events if ev.get("ph") != "M"]
+    return [dict(ev) for ev in events[-limit:]]
+
+
 def _merged_args(args: dict) -> dict:
     ctx = getattr(_CTX, "args", None)
     if not ctx:

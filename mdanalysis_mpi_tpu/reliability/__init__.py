@@ -1,16 +1,25 @@
 """Fault injection, retry/deadline policy, and graceful degradation.
 
-Three cooperating pieces (docs/RELIABILITY.md is the user guide):
+Three cooperating modules (docs/RELIABILITY.md is the user guide;
+its fourth piece — serving supervision: leases, quarantine, the
+crash-consistent journal — lives in :mod:`mdanalysis_mpi_tpu.service`
+and consumes the breaker and fault sites below):
 
 - :mod:`~mdanalysis_mpi_tpu.reliability.faults` — deterministic fault
   injection at named sites (``read`` / ``stage`` / ``put`` /
-  ``kernel``) so every recovery path is testable on CPU.
+  ``kernel`` / ``worker`` / ``probe``) so every recovery path is
+  testable on CPU.
 - :mod:`~mdanalysis_mpi_tpu.reliability.policy` — retry with
   exponential backoff, soft per-op deadlines, corrupt-frame
   retry→skip→abort semantics, the Mesh→Jax→Serial
   :class:`~mdanalysis_mpi_tpu.reliability.policy.FallbackChain`, and
   :func:`~mdanalysis_mpi_tpu.reliability.policy.run_resilient` (the
   engine behind ``AnalysisBase.run(resilient=...)``).
+- :mod:`~mdanalysis_mpi_tpu.reliability.breaker` — per-(backend, mesh)
+  circuit breakers: the cross-job memory of a failing backend that the
+  serving scheduler consults before dispatching, so an outage is paid
+  once instead of per job (closed → open after K consecutive faults →
+  half-open probe → closed).
 
 This ``__init__`` stays lazy for the policy layer: ``io.base`` and the
 executors import :mod:`.faults` (dependency-free) from their module
@@ -25,18 +34,26 @@ _LAZY = ("ReliabilityPolicy", "ReliabilityReport", "ReliabilityRuntime",
          "merge_reliability_results", "DeadlineExceeded",
          "CorruptFrameError")
 
+#: breaker.py is dependency-light (stdlib + obs) but kept lazy for
+#: symmetry — nothing below the service layer needs it at import time.
+_LAZY_BREAKER = ("CircuitBreaker", "BreakerBoard")
+
 
 def __getattr__(name):
+    import importlib
+
     if name in _LAZY or name == "policy":
         # import_module, NOT `from ... import policy`: the from-form
         # consults this package's attributes first, which re-enters
         # this __getattr__ and recurses forever
-        import importlib
-
         policy = importlib.import_module(
             "mdanalysis_mpi_tpu.reliability.policy")
         return policy if name == "policy" else getattr(policy, name)
+    if name in _LAZY_BREAKER or name == "breaker":
+        breaker = importlib.import_module(
+            "mdanalysis_mpi_tpu.reliability.breaker")
+        return breaker if name == "breaker" else getattr(breaker, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
-__all__ = ["faults", "policy", *_LAZY]
+__all__ = ["faults", "policy", "breaker", *_LAZY, *_LAZY_BREAKER]

@@ -26,7 +26,23 @@ Sites (the complete set — grep for ``_faults.fire``):
     payload — raise/stall only.
 ``"kernel"``
     Batch-kernel dispatch (``executors._run_batches.consume``).  No
-    payload — raise (device-loss-shaped) / stall.
+    payload — raise (device-loss-shaped) / stall.  A ``stall`` spec
+    with ``stall_s`` past a scheduler lease TTL is the canonical
+    "hung dispatch" injection (docs/RELIABILITY.md, serving
+    supervision).
+``"worker"``
+    Scheduler worker boundary (``service.scheduler.Scheduler._worker``,
+    right after a batch claim).  No payload.  The process-level site:
+    the default exception is :class:`InjectedWorkerDeath`, a
+    ``BaseException`` nothing in the run layers catches, so the worker
+    THREAD dies with its lease held — the supervisor's reap path.  A
+    ``stall`` spec here is a wedged claim loop instead.
+``"probe"``
+    Circuit-breaker half-open probe
+    (``service.scheduler.Scheduler._probe_backend``), fired before the
+    warmup-shaped no-op dispatch.  No payload — raise (device-loss,
+    the default) keeps the breaker open; not firing lets the probe
+    succeed and close it.
 
 When no specs are armed, the per-call overhead at a site is one module
 attribute load and a truthiness check (``if _faults.plans(): ...``).
@@ -40,6 +56,9 @@ Exception taxonomy (what the policy layer keys off):
   real ``XlaRuntimeError`` s print).
 - :class:`InjectedCrash` — neither: simulates a process-killing bug so
   checkpoint/resume can be tested (nothing may swallow it).
+- :class:`InjectedWorkerDeath` — a ``BaseException``: simulates a
+  worker thread dying mid-claim (the scheduler supervisor, not any
+  retry envelope, is what must recover from it).
 """
 
 from __future__ import annotations
@@ -64,11 +83,21 @@ class InjectedCrash(RuntimeError):
     for the process dying mid-run (checkpoint/resume tests)."""
 
 
+class InjectedWorkerDeath(BaseException):
+    """Injected worker-thread death: a ``BaseException`` so no run- or
+    policy-layer ``except Exception`` can swallow it — the thread dies
+    with its lease held, exactly like a segfaulting C extension or an
+    OOM kill would leave it, and the scheduler SUPERVISOR (lease reap +
+    respawn) is the only recovery path."""
+
+
 _DEFAULT_EXC = {
     "read": InjectedTransientError,
     "stage": InjectedTransientError,
     "put": InjectedTransientError,
     "kernel": DeviceLossError,
+    "worker": InjectedWorkerDeath,
+    "probe": DeviceLossError,
 }
 
 

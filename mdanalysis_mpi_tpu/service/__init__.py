@@ -30,18 +30,27 @@ requests instead of re-reading per request.
 - :mod:`~mdanalysis_mpi_tpu.service.telemetry` — serving telemetry:
   queue depth, p50/p99 queue wait and latency, coalesce and cache-hit
   rates (the bench serving leg's fields).
+- :mod:`~mdanalysis_mpi_tpu.service.supervision` — job leases renewed
+  by phase-entry heartbeats, zombie-worker fencing, and quarantine
+  diagnostics capture (docs/RELIABILITY.md, "Serving supervision").
+- :mod:`~mdanalysis_mpi_tpu.service.journal` — the crash-consistent
+  JSONL job journal behind ``Scheduler(journal=)`` / ``batch
+  --journal`` and :meth:`Scheduler.recover`.
 
 See docs/SERVICE.md for the job model and semantics, and
 ``examples/serve_batch.py`` for a runnable mixed-workload script.
 """
 
 from mdanalysis_mpi_tpu.service.jobs import (
-    AnalysisJob, JobDeadlineExpired, JobHandle, JobState,
+    AnalysisJob, JobDeadlineExpired, JobHandle, JobQuarantinedError,
+    JobState, SchedulerShutdownError,
 )
+from mdanalysis_mpi_tpu.service.journal import JobJournal
 from mdanalysis_mpi_tpu.service.scheduler import Scheduler
 from mdanalysis_mpi_tpu.service.telemetry import ServiceTelemetry
 
 __all__ = [
-    "AnalysisJob", "JobDeadlineExpired", "JobHandle", "JobState",
-    "Scheduler", "ServiceTelemetry",
+    "AnalysisJob", "JobDeadlineExpired", "JobHandle",
+    "JobJournal", "JobQuarantinedError", "JobState",
+    "Scheduler", "SchedulerShutdownError", "ServiceTelemetry",
 ]

@@ -35,14 +35,46 @@ pass.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
 import sys
+import threading
 import time
 
 import numpy as np
 
 _JOB_FIELDS = ("priority", "deadline_s", "coalesce", "tenant",
                "trace_id")
+
+
+def _job_fingerprint(index: int, spec: dict) -> str:
+    """Journal identity of one job-file entry: position + a digest of
+    the spec itself.  Reproducible across process restarts by
+    construction (the file is the same file), which is what lets
+    ``--journal`` recovery match a resubmitted job to its pre-crash
+    records and skip the ones already done."""
+    digest = hashlib.sha1(
+        json.dumps(spec, sort_keys=True).encode()).hexdigest()[:12]
+    return f"{index}:{digest}"
+
+
+def _result_arrays(analysis) -> dict:
+    results = analysis.results.materialize()
+    return {k: np.asarray(v) for k, v in results.items()
+            if isinstance(v, (np.ndarray, float, int))}
+
+
+def _output_writer(output: str):
+    """Done-callback persisting a finished job's arrays to its .npz —
+    EAGERLY, on the worker thread that resolved the handle, before the
+    scheduler's journal marks the job finished.  A ``kill -9`` between
+    a job's completion and the end of the batch therefore cannot lose
+    its output: either the npz is on disk, or the journal still says
+    pending and the restarted process re-runs the job."""
+    def write(handle):
+        if handle.error is None:
+            np.savez(output, **_result_arrays(handle.job.analysis))
+    return write
 
 
 def _build_job(spec: dict, defaults: dict, universe):
@@ -102,6 +134,14 @@ def batch_main(argv=None, universe=None) -> int:
                    help="stage queued jobs' blocks into the shared "
                         "cache before their claim (scheduler-driven "
                         "prefetch, docs/COLDSTART.md)")
+    p.add_argument("--journal", default=None, metavar="FILE",
+                   help="crash-consistent job journal (append-only "
+                        "JSONL, docs/RELIABILITY.md): every lifecycle "
+                        "transition is logged with fsync batching, and "
+                        "re-running the SAME command after a crash "
+                        "replays the journal — jobs already done or "
+                        "quarantined are skipped, unfinished ones "
+                        "re-run")
     ns = p.parse_args(argv)
 
     import os
@@ -114,6 +154,7 @@ def batch_main(argv=None, universe=None) -> int:
     with open(ns.jobs_file) as f:
         spec = json.load(f)
 
+    from mdanalysis_mpi_tpu.service.journal import SETTLED_STATES
     from mdanalysis_mpi_tpu.service.scheduler import Scheduler
 
     defaults = dict(spec.get("defaults", {}))
@@ -126,16 +167,41 @@ def batch_main(argv=None, universe=None) -> int:
     else:
         u = universe
 
+    # --journal recovery: replay the journal BEFORE building jobs, so
+    # a restarted process resubmits exactly the jobs the journal shows
+    # unfinished and skips the ones already done (their outputs were
+    # written eagerly, see _output_writer) or quarantined
+    import os as _os
+
+    recovered = None
+    if ns.journal and _os.path.exists(ns.journal):
+        recovered = Scheduler.recover(ns.journal)
+
     jobs = []
     build_failures = []
-    for js in spec.get("jobs", []):
+    recovered_records = []
+    for i, js in enumerate(spec.get("jobs", [])):
+        fp = _job_fingerprint(i, js)
+        if recovered is not None:
+            state = recovered["jobs"].get(fp, {}).get("state")
+            if state in SETTLED_STATES:
+                recovered_records.append({
+                    "analysis": js.get("analysis",
+                                       defaults.get("analysis", "?")),
+                    "tenant": js.get("tenant", "default"),
+                    "state": state, "recovered": True,
+                    "fingerprint": fp,
+                    "output": js.get("output")})
+                continue
         try:
-            jobs.append(_build_job(js, defaults, u))
+            job, cfg, output = _build_job(js, defaults, u)
+            job.fingerprint = fp
+            jobs.append((job, cfg, output))
         except Exception as exc:
             # a malformed request fails ITS job, not the whole file —
             # the other tenants' submissions still run
             build_failures.append((js, exc))
-    if not jobs and not build_failures:
+    if not jobs and not build_failures and not recovered_records:
         raise SystemExit("job file has no jobs")
 
     cache = None
@@ -151,22 +217,62 @@ def batch_main(argv=None, universe=None) -> int:
     # one as they arrive
     sched = Scheduler(n_workers=int(spec.get("workers", 1)),
                       cache=cache, autostart=False,
-                      prefetch=bool(ns.prefetch))
+                      prefetch=bool(ns.prefetch),
+                      lease_ttl_s=float(spec.get("lease_ttl_s", 30.0)),
+                      poison_threshold=int(
+                          spec.get("poison_threshold", 2)),
+                      supervise=bool(spec.get("supervise", True)),
+                      journal=ns.journal)
     warmup_stats = None
     if ns.warmup:
         warmup_stats = sched.warmup([j for j, _, _ in jobs])
-    handles = [sched.submit(j) for j, _, _ in jobs]
+    handles = []
+    for job, _cfg, output in jobs:
+        h = sched.submit(job)
+        if output:
+            # persist per job, at completion time, BEFORE the journal's
+            # finish record: a crash mid-batch then never strands a
+            # finished-but-unwritten job (see _output_writer)
+            h.add_done_callback(_output_writer(output))
+        handles.append(h)
     if ns.prefetch:
         # synchronous first pass before workers start: wave-1 claims
         # then ride staged blocks; the background thread covers jobs
         # submitted later
         sched.prefetch_pending()
+
+    # SIGINT/SIGTERM: drain in-flight units, abort everything still
+    # queued (typed SchedulerShutdownError → "aborted" records), and
+    # STILL emit the JSON summary — an operator's ^C must not leave a
+    # half-written report.  The handler only sets a flag: the abort
+    # itself runs on the main loop below, outside signal context.
+    import signal
+
+    stop = threading.Event()
+    restore = {}
+    try:
+        for signum in (signal.SIGINT, signal.SIGTERM):
+            restore[signum] = signal.signal(
+                signum, lambda *_: stop.set())
+    except ValueError:
+        pass         # not the main thread (in-process tests)
+
     sched.start()
-    sched.drain()
-    sched.shutdown()
+    interrupted = False
+    try:
+        while not sched.drain(timeout=0.2):
+            if stop.is_set() and not interrupted:
+                interrupted = True
+                sched.abort_queued(
+                    "SIGINT/SIGTERM received: in-flight units drain, "
+                    "queued jobs abort")
+        sched.shutdown()
+    finally:
+        for signum, handler in restore.items():
+            signal.signal(signum, handler)
     wall = time.perf_counter() - t0
 
-    records = []
+    records = list(recovered_records)
     rc = 0
     for js, exc in build_failures:
         records.append({
@@ -187,15 +293,29 @@ def batch_main(argv=None, universe=None) -> int:
         if handle.error is not None:
             rec["error"] = f"{type(handle.error).__name__}: {handle.error}"
             rc = 1
+            diag = getattr(handle.error, "diagnostics", None)
+            if diag:
+                # the quarantine surface (docs/RELIABILITY.md): what
+                # the supervisor captured at each incident, minus the
+                # span dumps (the trace file has those) — enough for
+                # an operator to see WHY without grepping logs
+                rec["quarantine"] = {
+                    "reason": diag.get("reason"),
+                    "fault_count": diag.get("fault_count"),
+                    "last_worker": diag.get("last_worker"),
+                    "incidents": [
+                        {k: v for k, v in inc.items()
+                         if k != "last_spans"}
+                        for inc in diag.get("incidents", [])],
+                }
         else:
             results = job.analysis.results.materialize()
-            arrays = {k: np.asarray(v) for k, v in results.items()
-                      if isinstance(v, np.ndarray)
-                      or isinstance(v, (float, int))}
             rec["results"] = {k: list(np.shape(v))
-                              for k, v in arrays.items()}
+                              for k, v in results.items()
+                              if isinstance(v, (np.ndarray, float, int))}
             if output:
-                np.savez(output, **arrays)
+                # written eagerly by the done-callback (see
+                # _output_writer) — only the record points at it here
                 rec["output"] = output
         records.append(rec)
 
@@ -205,7 +325,16 @@ def batch_main(argv=None, universe=None) -> int:
         "jobs": records, "wall_s": round(wall, 4),
         "serving": sched.telemetry.snapshot(cache=cache),
         "trace_out": trace_out,
+        "interrupted": interrupted,
+        "quarantined": [h.job.fingerprint for h in sched.quarantined],
     }
+    if sched.breakers is not None:
+        out["breakers"] = {
+            (backend if mesh is None else f"{backend}@{mesh}"): st
+            for (backend, mesh), st in sched.breakers.states().items()}
+    if ns.journal:
+        out["journal"] = ns.journal
+        out["recovered_skipped"] = len(recovered_records)
     if warmup_stats is not None:
         out["warmup_seconds"] = warmup_stats["seconds"]
         out["warmup_executables"] = warmup_stats["executables"]

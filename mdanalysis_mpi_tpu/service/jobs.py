@@ -37,10 +37,39 @@ class JobState:
     FAILED = "failed"
     EXPIRED = "expired"        # queue deadline passed before a worker
     #                            picked it up
+    QUARANTINED = "quarantined"  # poisoned the workers that claimed it
+    #                              (lease expiries / worker deaths) too
+    #                              many times — parked with diagnostics
+    ABORTED = "aborted"        # scheduler shut down / drained before a
+    #                            worker could claim it
 
 
 class JobDeadlineExpired(RuntimeError):
     """The job's ``deadline_s`` elapsed while it was still queued."""
+
+
+class SchedulerShutdownError(RuntimeError):
+    """The scheduler shut down (or aborted) with this job still
+    queued: the job will never run.  Raised from ``handle.result()``
+    so callers blocked on a future don't hang forever on a
+    ``shutdown(wait=False)`` or a drained SIGTERM."""
+
+
+class JobQuarantinedError(RuntimeError):
+    """The job was quarantined: its lease expired or its worker died
+    ``poison_threshold`` times, so the scheduler stopped retrying it
+    (one poison tenant must not monopolize workers forever).
+
+    ``diagnostics`` carries what the supervisor captured at each
+    incident: reason (lease_expired / worker_death), worker name,
+    lease TTL, the fault-site error + traceback when the worker died
+    by exception, and the job's last span-trace events when tracing
+    was enabled (docs/RELIABILITY.md, "Serving supervision").
+    """
+
+    def __init__(self, message, diagnostics: dict | None = None):
+        super().__init__(message)
+        self.diagnostics = diagnostics or {}
 
 
 @dataclasses.dataclass
@@ -90,6 +119,16 @@ class AnalysisJob:
         shared timeline attributes to each tenant.  Deliberately NOT
         part of the coalesce key — two requests must not fail to merge
         because their trace ids differ.
+    ``fingerprint``
+        Stable identity for the crash-consistent journal
+        (docs/RELIABILITY.md): recovery matches a resubmitted job to
+        its journal records by this string, so it must be reproducible
+        across process restarts (the ``batch --journal`` CLI derives
+        it from the job's SPEC + position in the file).  None → the
+        scheduler derives one from the job's window/backend/tenant
+        plus a per-scheduler occurrence counter (stable only when jobs
+        are resubmitted in the same order).  Not part of the coalesce
+        key.
     """
 
     analysis: object
@@ -106,6 +145,7 @@ class AnalysisJob:
     coalesce: bool = True
     tenant: str = "default"
     trace_id: str | None = None
+    fingerprint: str | None = None
 
     def __post_init__(self):
         from mdanalysis_mpi_tpu.reliability.policy import (
@@ -171,18 +211,72 @@ class JobHandle:
         self.submitted_t: float | None = None
         self.started_t: float | None = None
         self.finished_t: float | None = None
+        #: last supervision requeue (lease reap / worker death), None
+        #: until one happens — queue_wait_s measures from here so a
+        #: requeued job's wait reflects ITS wait, not the dead
+        #: attempt's run time (that skew is the requeue satellite fix)
+        self.requeued_t: float | None = None
         self._done = threading.Event()
         # scheduler bookkeeping: admission deferral count (see
         # Scheduler._pop_admissible)
         self._deferrals = 0
+        # supervision incidents (lease expiries / worker deaths) — at
+        # poison_threshold the job is quarantined with this log
+        self._faults = 0
+        self._fault_log: list[dict] = []
+        # ownership token of the worker currently running this handle
+        # (the lease's token) — a reaped worker's late completion
+        # finds it changed/cleared and is discarded
+        self._owner = None
+        # a supervision requeue claims this handle ALONE from then on:
+        # its batch already sank one worker, so its coalesced peers
+        # must not ride (or be sunk by) it again
+        self._solo_only = False
         #: True once scheduler-driven prefetch staged this job's
         #: blocks into the shared cache (docs/COLDSTART.md)
         self.prefetched = False
         # prefetch in progress: the claim path skips held handles so
         # the staging completes before the job is claimed
         self._prefetch_hold = False
+        # completion callbacks, fired on the resolving worker thread
+        # BEFORE the scheduler's journal "finish" record lands — so a
+        # callback that persists the job's results (the batch CLI's
+        # per-job .npz) is on disk before the journal says "done" and
+        # a crash between the two re-runs the job instead of losing
+        # its output (docs/RELIABILITY.md, "Serving supervision")
+        self._callbacks: list = []
 
     # ---- lifecycle (called by the scheduler) ----
+
+    def add_done_callback(self, fn) -> None:
+        """Call ``fn(handle)`` when the job reaches a terminal state
+        (immediately if it already has).  Runs on the resolving
+        thread — a worker for normal outcomes, the supervisor for
+        quarantines; exceptions are logged and swallowed — a failing
+        callback must not corrupt the scheduler's accounting."""
+        self._callbacks.append(fn)
+        if self._done.is_set():
+            self._fire_callbacks()
+
+    def _fire_callbacks(self) -> None:
+        while True:
+            try:
+                # pop-then-run, no check-then-pop: add_done_callback
+                # on an already-done handle fires concurrently with
+                # the resolving worker, and two threads passing the
+                # same truthiness check would race for the last
+                # element (list.pop itself is atomic)
+                fn = self._callbacks.pop(0)
+            except IndexError:
+                return
+            try:
+                fn(self)
+            except Exception:
+                from mdanalysis_mpi_tpu.utils.log import get_logger
+
+                get_logger("mdtpu.service").warning(
+                    "job %d done-callback failed", self.job_id,
+                    exc_info=True)
 
     def _mark_queued(self) -> None:
         self.state = JobState.QUEUED
@@ -196,6 +290,7 @@ class JobHandle:
         self.state = JobState.DONE
         self.finished_t = time.monotonic()
         self._done.set()
+        self._fire_callbacks()
 
     def _mark_failed(self, exc: BaseException,
                      state: str = JobState.FAILED) -> None:
@@ -203,13 +298,20 @@ class JobHandle:
         self.state = state
         self.finished_t = time.monotonic()
         self._done.set()
+        self._fire_callbacks()
 
     @property
     def deadline_expired(self) -> bool:
+        # a supervision-requeued job measures from its LAST requeue,
+        # same start as queue_wait_s: the first attempt DID get
+        # claimed in time, and booking the dead attempt's run time
+        # against the queue deadline would fail the retry instantly
+        # with a message claiming it never left the queue
+        start = (self.requeued_t if self.requeued_t is not None
+                 else self.submitted_t)
         return (self.job.deadline_s is not None
-                and self.submitted_t is not None
-                and time.monotonic() - self.submitted_t
-                > self.job.deadline_s)
+                and start is not None
+                and time.monotonic() - start > self.job.deadline_s)
 
     # ---- caller surface ----
 
@@ -231,9 +333,15 @@ class JobHandle:
 
     @property
     def queue_wait_s(self) -> float | None:
-        if self.submitted_t is None or self.started_t is None:
+        """Seconds spent queued before the (most recent) claim.  A
+        requeued job measures from its LAST requeue, not its original
+        submission — otherwise the dead attempt's run time would be
+        booked as queue wait and skew the serving p50/p99."""
+        start = (self.requeued_t if self.requeued_t is not None
+                 else self.submitted_t)
+        if start is None or self.started_t is None:
             return None
-        return self.started_t - self.submitted_t
+        return self.started_t - start
 
     @property
     def latency_s(self) -> float | None:

@@ -1,0 +1,143 @@
+"""Crash-consistent job journal: append-only JSONL + fsync batching.
+
+A crashed ``batch`` process (power loss, ``kill -9``, OOM) must not
+lose its queue: ``Scheduler(journal=path)`` logs every job-lifecycle
+transition — ``submit`` / ``claim`` / ``requeue`` / ``quarantine`` /
+``finish`` — as one JSON object per line, and
+:func:`replay` reconstructs each job's last known state from whatever
+prefix of the file survived the crash (a torn final line — the write
+the crash interrupted — is skipped, not fatal).  Jobs are identified
+by their :attr:`~mdanalysis_mpi_tpu.service.jobs.AnalysisJob.
+fingerprint`, which must be reproducible across process restarts; the
+``batch --journal`` CLI derives it from the job's spec + position in
+the job file, so a restarted process resubmits exactly the jobs the
+journal shows as unfinished and skips the ones already done
+(docs/RELIABILITY.md, "Serving supervision").
+
+Durability model (fsync batching): every record is flushed to the OS
+immediately; ``fsync`` is paid either when ``fsync_batch`` unsynced
+records accumulate or — always — on *terminal* records (``finish`` /
+``quarantine``), because those are the ones recovery must never
+double-run.  A crash can therefore lose at most the last
+``fsync_batch`` non-terminal records, which recovery treats as
+"still pending" — jobs re-run, never vanish.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+#: Every terminal journal state a ``finish``/``quarantine`` record can
+#: carry.
+TERMINAL_STATES = ("done", "quarantined", "failed", "expired",
+                   "aborted")
+
+#: Terminal states a recovering ``batch --journal`` process does NOT
+#: resubmit: the job ran to a settled verdict (its output is on disk,
+#: or it failed/expired deterministically, or it was quarantined as
+#: poison).  ``aborted`` is deliberately absent — an operator's ^C
+#: aborts the queue, and the re-run must run those jobs
+#: (service/cli.py consumes this).
+SETTLED_STATES = ("done", "quarantined", "failed", "expired")
+
+#: States a later ``submit`` record may NOT resurrect during replay:
+#: a done or quarantined job is settled forever, but an aborted /
+#: failed / expired one is legitimately resubmitted by a restarted
+#: ``batch --journal`` process (an operator's ^C aborts the queue;
+#: the re-run must run those jobs, and its submit records must flip
+#: their replayed state back to ``queued``).
+_PROTECTED_STATES = ("done", "quarantined")
+
+
+class JobJournal:
+    """Append-side of the journal (one per scheduler)."""
+
+    def __init__(self, path, fsync_batch: int = 16):
+        self.path = str(path)
+        self.fsync_batch = max(1, int(fsync_batch))
+        self._lock = threading.Lock()
+        self._f = open(self.path, "a", encoding="utf-8")
+        self._unsynced = 0
+
+    def record(self, ev: str, fingerprint: str | None,
+               durable: bool = False, **fields) -> None:
+        """Append one event.  ``durable=True`` forces an immediate
+        fsync (terminal events); otherwise the fsync is batched."""
+        rec = {"ev": ev, "fp": fingerprint,
+               "t": round(time.time(), 3), **fields}
+        line = json.dumps(rec, sort_keys=True) + "\n"
+        with self._lock:
+            if self._f.closed:
+                return
+            self._f.write(line)
+            self._f.flush()
+            self._unsynced += 1
+            if durable or self._unsynced >= self.fsync_batch:
+                os.fsync(self._f.fileno())
+                self._unsynced = 0
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f.closed:
+                return
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def replay(path) -> dict:
+    """Reconstruct per-job state from a journal file.
+
+    Returns ``{fingerprint: {"state", "claims", "submits",
+    "requeues", "reason"}}`` where ``state`` is the job's LAST
+    recorded transition: ``queued`` (submitted or requeued, not yet
+    finished), ``claimed`` (a worker took it and no terminal record
+    followed — the crash caught it mid-run; it must re-run), or a
+    terminal state from the ``finish``/``quarantine`` record.
+    Unparseable lines (the torn tail of a crashed write) are skipped.
+    """
+    jobs: dict = {}
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue                 # torn write at the crash point
+            fp = rec.get("fp")
+            ev = rec.get("ev")
+            if fp is None or ev is None:
+                continue
+            st = jobs.setdefault(fp, {"state": None, "claims": 0,
+                                      "submits": 0, "requeues": 0,
+                                      "reason": None})
+            if ev == "submit":
+                st["submits"] += 1
+                if st["state"] not in _PROTECTED_STATES:
+                    st["state"] = "queued"
+            elif ev == "claim":
+                st["claims"] += 1
+                if st["state"] not in _PROTECTED_STATES:
+                    st["state"] = "claimed"
+            elif ev == "requeue":
+                st["requeues"] += 1
+                if st["state"] not in _PROTECTED_STATES:
+                    st["state"] = "queued"
+            elif ev == "quarantine":
+                st["state"] = "quarantined"
+                st["reason"] = rec.get("reason")
+            elif ev == "finish":
+                st["state"] = rec.get("state", "done")
+    return jobs

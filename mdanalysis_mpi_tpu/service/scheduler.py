@@ -33,6 +33,25 @@ device-loss-shaped failure demotes the executor for THAT job only; the
 process, the scheduler, and other tenants keep their backends.  A
 merged pass that fails re-runs its members solo (one bad tenant must
 not take down the batch it coalesced into).
+
+Supervision (docs/RELIABILITY.md, "Serving supervision"): every claim
+grants a **lease** (:mod:`~mdanalysis_mpi_tpu.service.supervision`)
+that the worker renews implicitly on every timed-phase entry; a
+supervisor thread reaps expired leases and leases held by dead
+threads, requeues the stranded handles onto fresh workers (solo — a
+batch that sank a worker must not re-merge), **quarantines** a job
+after ``poison_threshold`` incidents with its captured diagnostics,
+respawns dead worker threads, and fences wedged ones so a zombie's
+late completion can neither corrupt the re-run's accumulators nor
+double-resolve the handle.  Per-(backend, mesh) **circuit breakers**
+(:mod:`~mdanalysis_mpi_tpu.reliability.breaker`) remember dispatch
+faults across jobs: while a backend's breaker is open, new units route
+down the same Mesh→Jax→Serial order the FallbackChain uses, and a
+half-open breaker is probed with a warmup-shaped no-op before traffic
+is restored.  With ``journal=``, every lifecycle transition lands in a
+crash-consistent JSONL journal
+(:mod:`~mdanalysis_mpi_tpu.service.journal`) that :meth:`Scheduler.
+recover` replays after a process crash.
 """
 
 from __future__ import annotations
@@ -40,15 +59,28 @@ from __future__ import annotations
 import contextlib
 import itertools
 import threading
+import time
+import traceback as _traceback
 
 from mdanalysis_mpi_tpu import obs
+from mdanalysis_mpi_tpu.reliability import breaker as _breaker
+from mdanalysis_mpi_tpu.reliability import faults as _faults
 from mdanalysis_mpi_tpu.service import coalesce as _coalesce
+from mdanalysis_mpi_tpu.service import journal as _journal
+from mdanalysis_mpi_tpu.service import supervision as _supervision
 from mdanalysis_mpi_tpu.service.jobs import (
-    AnalysisJob, JobDeadlineExpired, JobHandle, JobState,
+    AnalysisJob, JobDeadlineExpired, JobHandle, JobQuarantinedError,
+    JobState, SchedulerShutdownError,
 )
 from mdanalysis_mpi_tpu.service.telemetry import ServiceTelemetry
+from mdanalysis_mpi_tpu.utils import timers as _timers
 from mdanalysis_mpi_tpu.utils.log import get_logger
 from mdanalysis_mpi_tpu.utils.timers import TIMERS
+
+#: The degradation order breaker routing walks — the same one
+#: reliability.policy.degradation_chain builds (serial is the floor:
+#: it has no device to lose, so it never carries a breaker).
+ROUTE_ORDER = ("mesh", "jax", "serial")
 
 def reader_fingerprint(reader):
     """Re-exported from the executor layer: the cache-key namespace
@@ -81,16 +113,57 @@ class Scheduler:
         Start workers on construction.  ``False`` lets a caller queue
         a burst first (tests pin priority order this way), then
         :meth:`start`.
+    ``lease_ttl_s`` / ``poison_threshold`` / ``supervise``
+        Serving supervision (docs/RELIABILITY.md): claims hold leases
+        renewed by phase-entry heartbeats; the supervisor reaps
+        expired/dead holders, requeues their batches, and quarantines
+        a job after ``poison_threshold`` incidents.  ``supervise=False``
+        disables leases and the supervisor thread entirely.
+    ``breakers``
+        A shared :class:`~mdanalysis_mpi_tpu.reliability.breaker.
+        BreakerBoard`, ``None`` for a private default board, or
+        ``False`` to disable breaker routing.
+    ``journal``
+        Path (or open :class:`~mdanalysis_mpi_tpu.service.journal.
+        JobJournal`) for the crash-consistent lifecycle journal;
+        :meth:`recover` replays it after a crash.
     """
 
     def __init__(self, n_workers: int = 1, cache=None,
                  telemetry: ServiceTelemetry | None = None,
                  max_deferrals: int = 3, autostart: bool = True,
-                 prefetch: bool = False):
+                 prefetch: bool = False, lease_ttl_s: float = 30.0,
+                 poison_threshold: int = 2, supervise: bool = True,
+                 supervision_interval_s: float = 0.05,
+                 breakers=None, journal=None, clock=time.monotonic):
         self.cache = cache
         self.telemetry = telemetry or ServiceTelemetry()
         self.max_deferrals = max_deferrals
         self.n_workers = max(1, int(n_workers))
+        # ---- supervision state ----
+        self.supervise = bool(supervise)
+        self.lease_ttl_s = float(lease_ttl_s)
+        self.poison_threshold = max(1, int(poison_threshold))
+        self.supervision_interval_s = float(supervision_interval_s)
+        self._clock = clock
+        self._sup = _supervision.LeaseTable(clock=clock)
+        self._sup_thread: threading.Thread | None = None
+        # incidents parked until their fenced (wedged-but-alive)
+        # worker exits: [(handle, thread, grace_deadline)]
+        self._pending_requeues: list = []
+        #: quarantined handles, with diagnostics on their errors
+        self.quarantined: list[JobHandle] = []
+        # ---- breaker routing ----
+        if breakers is False:
+            self.breakers = None
+        else:
+            self.breakers = breakers or _breaker.BreakerBoard()
+        # ---- crash-consistent journal ----
+        self._owns_journal = isinstance(journal, (str, bytes)) or \
+            hasattr(journal, "__fspath__")
+        self.journal = (_journal.JobJournal(journal)
+                        if self._owns_journal else journal)
+        self._fp_counts: dict = {}      # derived-fingerprint occurrence
         # scheduler-driven prefetch (docs/COLDSTART.md): a background
         # thread stages queued jobs' blocks into the shared cache
         # while every worker is busy, so wave-1 cold misses become
@@ -122,7 +195,8 @@ class Scheduler:
                 return
             self._shutdown = False
             for i in range(self.n_workers):
-                t = threading.Thread(target=self._worker, daemon=True,
+                t = threading.Thread(target=self._worker_outer,
+                                     daemon=True,
                                      name=f"mdtpu-serve-{i}")
                 self._workers.append(t)
                 t.start()
@@ -131,6 +205,16 @@ class Scheduler:
                                      daemon=True,
                                      name="mdtpu-prefetch")
                 self._prefetch_thread = t
+                t.start()
+            if self.supervise and self._sup_thread is None:
+                # heartbeats ride phase entries (utils/timers.py): the
+                # hook renews the calling worker's lease, and aborts a
+                # fenced zombie at its next phase boundary
+                _timers.add_phase_hook(self._sup.heartbeat)
+                t = threading.Thread(target=self._supervisor,
+                                     daemon=True,
+                                     name="mdtpu-supervisor")
+                self._sup_thread = t
                 t.start()
 
     def drain(self, timeout: float | None = None) -> bool:
@@ -142,16 +226,100 @@ class Scheduler:
                                        timeout)
 
     def shutdown(self, wait: bool = True) -> None:
+        """Stop the scheduler.  ``wait=True`` lets the workers drain
+        whatever is still queued, then joins them.  ``wait=False``
+        ABORTS every job no worker has claimed yet — each unclaimed
+        handle fails with a typed :class:`~mdanalysis_mpi_tpu.service.
+        jobs.SchedulerShutdownError` (state ``aborted``) so a caller
+        blocked on ``handle.result()`` gets its answer instead of
+        hanging forever on a future no worker will ever resolve."""
+        if not wait:
+            self.abort_queued(
+                "scheduler shut down (wait=False) with this job still "
+                "queued; it will never run")
         with self._cond:
             self._shutdown = True
             self._cond.notify_all()
         if wait:
-            for t in self._workers:
-                t.join()
-            if self._prefetch_thread is not None:
-                self._prefetch_thread.join()
+            # same bounded re-snapshot join as the wait=False path: a
+            # fenced never-waking zombie stays alive until the
+            # supervisor writes it off and drops it from the pool, and
+            # an unbounded join on a stale snapshot would wait on the
+            # zombie forever
+            self._finalize_shutdown()
+        else:
+            # in-flight units must still be able to finish
+            # (abort_queued's contract): tearing down here would stop
+            # lease renewal (the phase hook is the heartbeat channel),
+            # so the supervisor would reap and fence HEALTHY in-flight
+            # workers and their handles would never resolve — and the
+            # closed journal would drop their finish records.  A
+            # background finalizer waits the pool out, then performs
+            # the same teardown the wait=True path does inline.
+            threading.Thread(target=self._finalize_shutdown,
+                             daemon=True,
+                             name="mdtpu-finalize").start()
+
+    def _finalize_shutdown(self) -> None:
+        # re-snapshot until quiet: the supervisor can still replace a
+        # written-off wedged worker in the pool after our first look,
+        # and a stale snapshot would either miss the replacement or
+        # join a zombie the write-off already removed
+        while True:
+            workers = [t for t in list(self._workers) if t.is_alive()]
+            if not workers:
+                break
+            for t in workers:
+                # bounded join, then re-snapshot: a wedged worker
+                # stays alive until the supervisor writes it off and
+                # drops it from the pool — an unbounded join here
+                # would wait on the zombie forever instead
+                t.join(timeout=1.0)
+        pf = self._prefetch_thread
+        if pf is not None:
+            pf.join()
+        st = self._sup_thread
+        if st is not None:
+            st.join()
+        self._teardown()
+
+    def _teardown(self) -> None:
+        """Idempotent final cleanup, only once no worker can still
+        need a heartbeat or a journal record."""
+        _timers.remove_phase_hook(self._sup.heartbeat)
+        if self.journal is not None and self._owns_journal:
+            self.journal.close()
         self._workers.clear()
         self._prefetch_thread = None
+        self._sup_thread = None
+
+    def abort_queued(self, reason: str = "scheduler draining") -> list:
+        """Fail every queued/parked handle no worker has claimed with
+        :class:`~mdanalysis_mpi_tpu.service.jobs.
+        SchedulerShutdownError` (state ``aborted``); in-flight units
+        are left to finish.  Returns the aborted handles.  The
+        ``batch`` CLI's SIGINT/SIGTERM handler calls this so a drained
+        process still emits its full JSON summary."""
+        with self._cond:
+            entries = self._queue + self._parked
+            self._queue.clear()
+            self._parked.clear()
+            for _, _, h in entries:
+                self.telemetry.note_dequeue()
+            self._cond.notify_all()
+        aborted = []
+        for _, _, h in entries:
+            if h.done():
+                continue
+            h._mark_failed(SchedulerShutdownError(
+                f"job {h.job_id} ({h.job.tenant}): {reason}"),
+                JobState.ABORTED)
+            self._finish(h)
+            aborted.append(h)
+        if aborted:
+            self._log.warning("aborted %d unclaimed jobs (%s)",
+                              len(aborted), reason)
+        return aborted
 
     def __enter__(self):
         self.start()
@@ -192,13 +360,36 @@ class Scheduler:
         with self._cond:
             if self._shutdown:
                 raise RuntimeError("scheduler is shut down")
+            if job.fingerprint is None:
+                job.fingerprint = self._derive_fingerprint(job)
             handle._mark_queued()
             self._note_ns_submit(job)
             self._queue.append((-job.priority, next(self._seq), handle))
             self._inflight += 1
             self.telemetry.note_submit()
-            self._cond.notify()
+            # notify_all, NOT notify(): the supervisor (and prefetch)
+            # threads wait on this same condition, and a single notify
+            # can land on one of them instead of an idle worker — the
+            # woken supervisor just re-waits, and the submission sits
+            # unclaimed forever (observed as an intermittent drain
+            # hang once supervise=True made the extra waiter default)
+            self._cond.notify_all()
+        if self.journal is not None:
+            self.journal.record(
+                "submit", job.fingerprint, tenant=job.tenant,
+                analysis=type(job.analysis).__name__)
         return handle
+
+    def _derive_fingerprint(self, job: AnalysisJob) -> str:
+        """Journal identity when the caller supplied none: the job's
+        window/backend/tenant plus an occurrence counter — stable only
+        when jobs are resubmitted in the same order (the CLI derives a
+        stronger one from the job-file spec + position)."""
+        base = (f"{job.tenant}|{type(job.analysis).__name__}|"
+                f"{job.start}:{job.stop}:{job.step}|{job.backend}")
+        n = self._fp_counts.get(base, 0)
+        self._fp_counts[base] = n + 1
+        return f"{base}#{n}"
 
     def submit_all(self, jobs) -> list[JobHandle]:
         return [self.submit(j) for j in jobs]
@@ -236,10 +427,48 @@ class Scheduler:
         job is claimed" means (docs/COLDSTART.md)."""
         return [e for e in self._queue if not e[2]._prefetch_hold]
 
+    def _worker_outer(self) -> None:
+        """Thread target: records a dying worker's diagnostics for the
+        supervisor (which folds them into the stranded jobs' fault
+        logs, reaps the held lease, and respawns the thread).  A
+        normal loop exit (shutdown) records nothing."""
+        try:
+            self._worker()
+        except BaseException as exc:
+            name = threading.current_thread().name
+            with self._cond:
+                if not isinstance(exc, _supervision.WorkerFenced):
+                    # a fence death records nothing: its lease was
+                    # already reaped (the fence IS the reap's doing),
+                    # so the record would never be consumed and a
+                    # long-lived scheduler would leak one entry per
+                    # fence event
+                    self._sup.record_worker_death(
+                        name, f"{type(exc).__name__}: {exc}",
+                        _traceback.format_exc())
+                self._sup.fenced.discard(threading.current_thread())
+                self._cond.notify_all()
+            if not isinstance(exc, _supervision.WorkerFenced):
+                self._log.warning("worker %s died: %s: %s", name,
+                                  type(exc).__name__, exc)
+            # swallow: the thread is gone either way, and re-raising
+            # would only spam the interpreter's thread-excepthook
+
     def _worker(self) -> None:
         while True:
             with self._cond:
                 while True:
+                    # a reaped-but-alive worker must exit, not claim:
+                    # the fence only fires at phase ENTRIES, so a
+                    # zombie that finished its revoked batch without
+                    # another phase would otherwise claim fresh jobs
+                    # and die at THEIR first phase — charging a
+                    # poison incident to innocent handles
+                    if (threading.current_thread()
+                            in self._sup.fenced):
+                        raise _supervision.WorkerFenced(
+                            "worker was reaped (lease expired); "
+                            "exiting instead of claiming new work")
                     if self._claimable_locked():
                         break
                     if self._parked and self._active == 0:
@@ -257,21 +486,41 @@ class Scheduler:
                             and not self._queue):
                         return
                     self._cond.wait()
-                batch, poison = self._claim_batch_locked()
+                batch, poison, token = self._claim_batch_locked()
                 self._active += 1
+                # dequeue accounting at CLAIM time (not per-unit):
+                # the supervisor's requeue of a reaped batch can then
+                # balance the gauge without guessing how far the dead
+                # worker got
+                for _ in batch:
+                    self.telemetry.note_dequeue()
+            if self.journal is not None and poison is None:
+                me = threading.current_thread().name
+                for h in batch:
+                    self.journal.record("claim", h.job.fingerprint,
+                                        worker=me)
             progressed = True      # safe default for the finally
             try:
+                # the process-level fault site (reliability/faults.py
+                # "worker"): an InjectedWorkerDeath here unwinds the
+                # whole thread with its lease held — the supervisor's
+                # reap path, not any retry envelope, must recover
+                if _faults.plans():
+                    _faults.fire("worker")
                 if poison is not None:
                     # a job whose coalesce key cannot even be computed
                     # (broken analysis/trajectory attribute) fails
                     # ITSELF — never the worker thread
                     for h in batch:
-                        self.telemetry.note_dequeue()
-                        h._mark_failed(poison)
-                        self._finish(h)
+                        self._complete(h, token, exc=poison)
                     progressed = True
                 else:
-                    progressed = self._process_batch(batch)
+                    progressed = self._process_batch(batch, token)
+                # normal end of batch: hand the lease back.  NOT in
+                # the finally — a dying/fenced worker must leave its
+                # lease held so the reaper sees the stranded batch
+                with self._cond:
+                    self._sup.release(threading.current_thread())
             finally:
                 with self._cond:
                     self._active -= 1
@@ -292,15 +541,24 @@ class Scheduler:
         its coalesce key (lower-priority peers deliberately ride along:
         amortizing the staged pass is worth the inversion).  O(queue)
         per claim — a serving queue is small; revisit if it stops
-        being.  Returns ``(handles, poison)``: a non-None poison is
-        the key-computation failure of the best entry (claimed alone,
-        to be failed by the caller)."""
+        being.  Returns ``(handles, poison, token)``: a non-None
+        poison is the key-computation failure of the best entry
+        (claimed alone, to be failed by the caller); ``token`` is the
+        granted lease's ownership token (see :meth:`_complete`).
+
+        A supervision-requeued handle (``_solo_only``) is claimed
+        ALONE and never rides as a peer: its previous batch already
+        sank a worker, and one poison tenant must not sink the merged
+        pass twice."""
         best = min(self._claimable_locked())
         try:
             key = best[2].job.coalesce_key()
         except Exception as exc:
             self._queue.remove(best)
-            return [best[2]], exc
+            return [best[2]], exc, self._grant_locked([best[2]])
+        if best[2]._solo_only:
+            self._queue.remove(best)
+            return [best[2]], None, self._grant_locked([best[2]])
         claimed, rest = [], []
         for entry in self._queue:
             try:
@@ -314,6 +572,7 @@ class Scheduler:
                 # staging wall; blocking the claim on the hold would
                 # trade worker idle time for it instead.
                 same = (not entry[2]._prefetch_hold
+                        and not entry[2]._solo_only
                         and entry[2].job.coalesce_key() == key)
             except Exception:
                 same = False     # surfaces when it becomes `best`
@@ -322,7 +581,41 @@ class Scheduler:
             else:
                 rest.append(entry)
         self._queue[:] = rest
-        return claimed, None
+        return claimed, None, self._grant_locked(claimed)
+
+    def _grant_locked(self, handles):
+        """Grant this worker's lease over the claimed handles and
+        return its ownership token (always minted, even with
+        supervision off — the zombie-fencing guard in
+        :meth:`_complete` costs nothing and keeps one code path)."""
+        if not self.supervise:
+            token = object()
+            for h in handles:
+                h._owner = token
+            return token
+        ttl = self._lease_ttl(handles)
+        return self._sup.grant(handles, ttl).token
+
+    def _lease_ttl(self, handles) -> float:
+        """TTL for one claimed batch: the configured floor, widened by
+        the batch's estimated staged bytes (a healthy worker moves at
+        least LEASE_MIN_BYTES_PER_S between phase entries), tightened
+        by the tightest member deadline — never below the floor."""
+        est = 0
+        deadline = None
+        for h in handles:
+            try:
+                # jax-free estimate (the executors' _block_nbytes
+                # needs jax): frames x atoms x 3 x 4B staged f32
+                n = len(h.job.analysis._frames(
+                    h.job.start, h.job.stop, h.job.step, h.job.frames))
+                est += n * h.job.trajectory.n_atoms * 12
+            except Exception:
+                pass
+            if h.job.deadline_s is not None:
+                deadline = (h.job.deadline_s if deadline is None
+                            else min(deadline, h.job.deadline_s))
+        return _supervision.derive_ttl(self.lease_ttl_s, est, deadline)
 
     def _requeue(self, handles: list[JobHandle]) -> None:
         """Park admission-deferred handles; they re-enter the queue
@@ -333,33 +626,75 @@ class Scheduler:
         with self._cond:
             for h in handles:
                 h._deferrals += 1
+                # a parked handle rides no lease and belongs to no
+                # worker until its next claim
+                h._owner = None
+                self._sup.drop_handle(h)
                 self._parked.append((-h.job.priority, next(self._seq),
                                      h))
                 # balance the note_dequeue the claim already recorded —
                 # the handle is queued again, but NOT resubmitted
                 self.telemetry.note_requeue()
 
+    def _complete(self, handle: JobHandle, token,
+                  exc: BaseException | None = None,
+                  state: str = JobState.FAILED) -> bool:
+        """Guarded terminal marking: only the worker still OWNING the
+        handle (its claim's lease token) may resolve it.  A reaped
+        worker's late completion — the zombie woke after its batch was
+        requeued — finds the token changed and is DISCARDED: the
+        requeued attempt owns the handle's accounting now, and a
+        double `_finish` would corrupt the inflight count and the
+        telemetry."""
+        with self._cond:
+            if handle._owner is not token or handle.done():
+                return False
+            handle._owner = None
+            # drop the handle from its lease HERE, inside the lock:
+            # _mark_done below runs outside it (callbacks do disk
+            # I/O), and a reap landing in that window would otherwise
+            # see an unresolved stranded handle and requeue or
+            # quarantine a job that just completed — a double
+            # terminal record and a corrupted inflight count
+            self._sup.drop_handle(handle)
+        if exc is None:
+            handle._mark_done()
+        else:
+            handle._mark_failed(exc, state)
+        self._finish(handle)
+        return True
+
     def _finish(self, handle: JobHandle) -> None:
         self.telemetry.note_finish(handle)
         self._note_ns_done(handle.job)
+        if (self.journal is not None
+                and handle.state != JobState.QUARANTINED):
+            # terminal records are the ones recovery must never
+            # double-run: fsync immediately, not batched.  A
+            # quarantined handle already wrote its own terminal record
+            # (with the reason) in _quarantine — exactly one
+            # terminal record per job, so recovery and the chaos
+            # tests' exactly-once accounting can count them.
+            self.journal.record("finish", handle.job.fingerprint,
+                                state=handle.state, durable=True)
         with self._cond:
+            self._sup.drop_handle(handle)
             self._inflight -= 1
             self._cond.notify_all()
 
-    def _process_batch(self, batch: list[JobHandle]) -> bool:
+    def _process_batch(self, batch: list[JobHandle], token) -> bool:
         """Run one claimed batch.  Returns True when any handle made
         real progress (ran or reached a terminal state) — the signal
         that parked (deferred) entries may find freed budget."""
         progressed = False
         live = []
         for h in batch:
-            self.telemetry.note_dequeue()
             if h.deadline_expired:
-                h._mark_failed(JobDeadlineExpired(
+                self._complete(h, token, exc=JobDeadlineExpired(
                     f"job {h.job_id} ({h.job.tenant}) spent "
                     f"{h.queue_wait_s or 0:.3f}s queued, over its "
-                    f"{h.job.deadline_s}s deadline"), JobState.EXPIRED)
-                self._finish(h)
+                    f"{h.job.deadline_s}s deadline"),
+                    state=JobState.EXPIRED)
                 progressed = True
             else:
                 live.append(h)
@@ -373,20 +708,234 @@ class Scheduler:
             units = _coalesce.plan_units(live)
         except Exception as exc:
             for h in live:
-                h._mark_failed(exc)
-                self._finish(h)
+                self._complete(h, token, exc=exc)
             return True
         for unit in units:
             try:
-                if self._run_unit(unit):
+                if self._run_unit(unit, token):
                     progressed = True
             except Exception as exc:
                 for h in unit.handles:
-                    if not h.done():
-                        h._mark_failed(exc)
-                        self._finish(h)
+                    self._complete(h, token, exc=exc)
                 progressed = True
         return progressed
+
+    # ---- supervision: reap / requeue / quarantine / respawn ----
+
+    def _supervisor(self) -> None:
+        """Supervisor loop: reap expired or dead-held leases, release
+        parked requeues whose fenced worker exited, respawn dead
+        worker threads.  Exits once the scheduler is shut down and no
+        lease or live worker remains."""
+        while True:
+            with self._cond:
+                quarantines = self._reap_locked()
+                alive = [t for t in self._workers if t.is_alive()]
+                stop = (self._shutdown and not self._sup.leases
+                        and not self._pending_requeues and not alive)
+                if not stop and not quarantines:
+                    self._cond.wait(self.supervision_interval_s)
+            # quarantine OUTSIDE the condition lock: it fires the
+            # handle's done-callbacks (the batch CLI writes an .npz
+            # there) and a durable journal fsync — holding the lock
+            # through disk I/O would stall every claim/submit/finish
+            for h, incident in quarantines:
+                self._quarantine(h, incident)
+            if stop:
+                # a worker death AFTER shutdown can requeue a handle
+                # no one will ever claim (respawn stops at shutdown):
+                # resolve it instead of hanging its waiters forever
+                if self._queue or self._parked:
+                    self.abort_queued(
+                        "scheduler shut down with no remaining "
+                        "workers to claim this requeued job")
+                return
+
+    def _reap_locked(self) -> list:
+        """Reap due leases; returns ``(handle, incident)`` pairs that
+        crossed the poison threshold, for the caller to quarantine
+        AFTER releasing the condition lock (quarantine does disk
+        I/O: done-callbacks + a durable journal record)."""
+        quarantines = []
+        now = self._clock()
+        for lease in self._sup.expired(now):
+            worker = lease.worker
+            self._sup.leases.pop(worker, None)
+            dead = not worker.is_alive()
+            reason = "worker_death" if dead else "lease_expired"
+            death = self._sup.worker_deaths.pop(worker.name, None)
+            self.telemetry.count("lease_expired")
+            obs.METRICS.inc("mdtpu_lease_expired_total", reason=reason)
+            obs.span_event("lease_reaped", worker=worker.name,
+                           reason=reason,
+                           n_jobs=len(lease.handles))
+            self._log.warning(
+                "reaping lease of %s (%s): %d job(s) stranded",
+                worker.name, reason, len(lease.handles))
+            if not dead:
+                # wedged, not dead: fence the zombie (its next phase
+                # entry raises WorkerFenced) and HOLD the requeue
+                # until it actually exits — re-running the same
+                # analysis instance while the zombie still writes its
+                # accumulators would corrupt the results.  The grace
+                # deadline bounds a thread hung inside one phase
+                # forever: after one more TTL the requeue proceeds
+                # anyway (disclosed risk, docs/RELIABILITY.md).
+                self._sup.fenced.add(worker)
+            for h in list(lease.handles):
+                if h.done():
+                    continue
+                h._owner = None
+                h._faults += 1
+                incident = _supervision.capture_diagnostics(
+                    h, reason=reason, worker=worker.name,
+                    ttl=lease.ttl, death=death)
+                h._fault_log.append(incident)
+                if h._faults >= self.poison_threshold:
+                    quarantines.append((h, incident))
+                elif dead:
+                    self._requeue_supervised_locked(h)
+                else:
+                    self._pending_requeues.append(
+                        (h, worker, now + lease.ttl))
+        # release held requeues whose fenced worker exited — or whose
+        # grace ran out (a thread hung inside ONE phase beyond reap +
+        # one TTL).  In the grace case the zombie stays FENCED: if it
+        # ever wakes, its next phase entry still aborts it instead of
+        # racing the re-run for the analysis accumulators.  It is also
+        # written off as lost capacity: replaced in the pool below (a
+        # daemon thread, so neither shutdown's joins nor process exit
+        # wait on it) — without this, n_workers=1 plus one forever-hung
+        # dispatch would leave the requeued job unclaimable and wedge
+        # drain()/shutdown() for good.
+        if self._pending_requeues:
+            still = []
+            for h, worker, grace in self._pending_requeues:
+                if not worker.is_alive():
+                    self._sup.fenced.discard(worker)
+                    if not h.done():
+                        self._requeue_supervised_locked(h)
+                elif now >= grace:
+                    self._write_off_locked(worker)
+                    if not h.done():
+                        self._requeue_supervised_locked(h)
+                else:
+                    still.append((h, worker, grace))
+            self._pending_requeues[:] = still
+        # respawn dead worker threads (never past shutdown): pool
+        # capacity must survive worker deaths, or one poison job
+        # could bleed the scheduler down to zero workers
+        if not self._shutdown:
+            for i, t in enumerate(self._workers):
+                if not t.is_alive():
+                    # a death recorded with no lease to reap (the
+                    # worker died between batches) has no consumer:
+                    # discard it here rather than leak it forever
+                    self._sup.worker_deaths.pop(t.name, None)
+                    nt = threading.Thread(target=self._worker_outer,
+                                          daemon=True,
+                                          name=f"{t.name}r")
+                    self._workers[i] = nt
+                    self.telemetry.count("workers_respawned")
+                    self._log.warning("respawned dead worker %s as %s",
+                                      t.name, nt.name)
+                    nt.start()
+        return quarantines
+
+    def _write_off_locked(self, worker: threading.Thread) -> None:
+        """Replace a forever-wedged (fenced, grace-expired, still
+        alive) worker in the pool: the respawn loop above only sees
+        DEAD threads, and shutdown/supervisor exit must not wait on a
+        thread that may never wake.  The zombie keeps its fence — a
+        late wakeup aborts at its next phase entry."""
+        for i, t in enumerate(self._workers):
+            if t is worker:
+                nt = threading.Thread(target=self._worker_outer,
+                                      daemon=True, name=f"{t.name}w")
+                self._workers[i] = nt
+                self.telemetry.count("workers_respawned")
+                self._log.warning(
+                    "writing off wedged worker %s (still alive, grace "
+                    "spent); replacement %s started", t.name, nt.name)
+                nt.start()
+                return
+
+    def _requeue_supervised_locked(self, h: JobHandle) -> None:
+        """Put a reaped handle back in the queue — solo from now on,
+        with its wait clock restarted (the requeue satellite fix:
+        queue_wait must measure THIS wait, not the dead attempt's run
+        time)."""
+        h.state = JobState.QUEUED
+        h.requeued_t = self._clock()
+        h.started_t = None
+        h._solo_only = True
+        self._queue.append((-h.job.priority, next(self._seq), h))
+        self.telemetry.note_requeue()
+        self.telemetry.count("jobs_requeued")
+        obs.METRICS.inc("mdtpu_jobs_requeued_total")
+        obs.span_event("job_requeued", job_id=h.job_id,
+                       tenant=h.job.tenant, faults=h._faults)
+        if self.journal is not None:
+            self.journal.record("requeue", h.job.fingerprint,
+                                faults=h._faults)
+        self._cond.notify_all()
+
+    def _quarantine(self, h: JobHandle, incident: dict) -> None:
+        """Park a poison job with its diagnostics instead of retrying
+        forever: typed error on the handle, counter + trace event, and
+        a durable journal record.  Called WITHOUT the condition lock
+        (the supervisor drops it first): `_mark_failed` fires the
+        handle's done-callbacks and the journal record fsyncs — disk
+        I/O that must not stall claims.  Safe unlocked: the handle
+        left its lease with `_owner` cleared at reap time, so a
+        zombie's late `_complete` is already fenced off."""
+        if h.done():
+            return
+        diagnostics = {
+            "incidents": list(h._fault_log),
+            "reason": incident.get("reason"),
+            "last_worker": incident.get("worker"),
+            "fault_count": h._faults,
+        }
+        err = JobQuarantinedError(
+            f"job {h.job_id} ({h.job.tenant}, "
+            f"{type(h.job.analysis).__name__}) quarantined after "
+            f"{h._faults} supervision incidents "
+            f"(last: {incident.get('reason')})", diagnostics)
+        h._mark_failed(err, JobState.QUARANTINED)
+        self.quarantined.append(h)
+        obs.METRICS.inc("mdtpu_jobs_quarantined_total")
+        obs.span_event("job_quarantined", job_id=h.job_id,
+                       tenant=h.job.tenant,
+                       reason=incident.get("reason"))
+        self._log.error("quarantined job %d (%s): %s", h.job_id,
+                        h.job.tenant, incident.get("reason"))
+        if self.journal is not None:
+            self.journal.record("quarantine", h.job.fingerprint,
+                                reason=incident.get("reason"),
+                                durable=True)
+        self._finish(h)
+
+    @staticmethod
+    def recover(path) -> dict:
+        """Replay a journal after a crash: ``{"jobs": {fp: record},
+        "done": set, "quarantined": set, "pending": [fp, ...]}`` where
+        ``pending`` is every job submitted (or claimed — the crash
+        caught it mid-run) but never finished; those are the ones a
+        restarted process must resubmit.  Idempotence contract: the
+        caller derives the same fingerprints it used before the crash
+        (the ``batch --journal`` CLI derives them from the job-file
+        spec + position)."""
+        jobs = _journal.replay(path)
+        return {
+            "jobs": jobs,
+            "done": {fp for fp, r in jobs.items()
+                     if r["state"] == "done"},
+            "quarantined": {fp for fp, r in jobs.items()
+                            if r["state"] == "quarantined"},
+            "pending": [fp for fp, r in jobs.items()
+                        if r["state"] in ("queued", "claimed")],
+        }
 
     # ---- warmup + scheduler-driven prefetch (docs/COLDSTART.md) ----
 
@@ -624,9 +1173,84 @@ class Scheduler:
         self.telemetry.count("admission_uncached")
         return True, -1
 
+    # ---- breaker routing (reliability/breaker.py) ----
+
+    def _route_backend(self, job: AnalysisJob) -> str:
+        """The backend this claim should actually dispatch against:
+        the job's own backend when its breaker is closed (or breakers
+        are off), otherwise the first non-open backend DOWN the same
+        Mesh → Jax → Serial order the FallbackChain walks.  A
+        half-open breaker is probed with a warmup-shaped no-op first —
+        probe success restores traffic (and closes the breaker), probe
+        failure re-opens it and the walk continues down.  Serial is
+        the floor: it has no device to lose and never carries a
+        breaker."""
+        if self.breakers is None or job.backend not in ROUTE_ORDER:
+            return job.backend
+        for backend in ROUTE_ORDER[ROUTE_ORDER.index(job.backend):]:
+            if backend == "serial":
+                break
+            br = self.breakers.get(backend)
+            st = br.state
+            if st == _breaker.OPEN:
+                continue
+            if st == _breaker.HALF_OPEN:
+                if not br.probe(lambda b=backend:
+                                self._probe_backend(b)):
+                    continue
+            return backend
+        return "serial"
+
+    def _probe_backend(self, backend: str) -> None:
+        """Half-open probe: a warmup-shaped no-op dispatch against the
+        backend — cheap, shape-stable, no tenant data at risk.  Raises
+        on failure (the breaker re-opens); the ``probe`` fault site
+        lets tests pin the failure deterministically."""
+        if _faults.plans():
+            _faults.fire("probe")
+        import jax
+        import jax.numpy as jnp
+
+        jax.block_until_ready(jnp.zeros((8, 3)) + 1.0)
+
+    def _note_backend_result(self, backend: str,
+                             exc: BaseException | None,
+                             analyses=()) -> None:
+        """Feed the breaker after a dispatched unit: any success
+        resets it; a degradable (device-loss / exhausted-transient)
+        failure counts toward the trip threshold.  Non-degradable
+        failures (corrupt data, programming errors) don't — a breaker
+        reroute would just replay them on the next backend down.
+
+        ``analyses`` are the unit's member analyses, consulted on
+        SUCCESS: a resilient run reports success even when its
+        FallbackChain internally degraded off ``backend`` — that
+        degradation IS the breaker signal, or resilient tenants would
+        keep a dead backend's breaker closed forever while every job
+        re-paid the full retry/degrade cost the breaker exists to
+        eliminate."""
+        if self.breakers is None or backend == "serial" \
+                or backend not in ROUTE_ORDER:
+            return
+        br = self.breakers.get(backend)
+        if exc is None:
+            for a in analyses:
+                rel = getattr(getattr(a, "results", None),
+                              "reliability", None)
+                fallbacks = (rel or {}).get("fallbacks", ())
+                if any(frm == backend for frm, _to, _r in fallbacks):
+                    br.record_failure()
+                    return
+            br.record_success()
+            return
+        from mdanalysis_mpi_tpu.reliability.policy import is_degradable
+
+        if is_degradable(exc):
+            br.record_failure()
+
     # ---- execution ----
 
-    def _run_unit(self, unit) -> bool:
+    def _run_unit(self, unit, token) -> bool:
         """Admit + execute one unit; False when it was deferred."""
         # honor MDTPU_TRACE_OUT BEFORE entering the trace context: the
         # context is a no-op while tracing is off, and waiting for the
@@ -644,6 +1268,12 @@ class Scheduler:
         elif unit.solo_reason:
             self.telemetry.count(unit.solo_reason)
         job = unit.handles[0].job
+        backend = self._route_backend(job)
+        if backend != job.backend:
+            self.telemetry.count("breaker_reroutes", len(unit.handles))
+            self._log.warning(
+                "breaker open for %r: routing %d job(s) to %r",
+                job.backend, len(unit.handles), backend)
         kwargs = dict(job.executor_kwargs)
         if reserved >= 0:
             kwargs["block_cache"] = self.cache
@@ -665,30 +1295,38 @@ class Scheduler:
             with obs.trace_context(**attrs), \
                     TIMERS.phase("serve_job", coalesced=unit.coalesced), \
                     merged_span:
-                unit.runnable.run(backend=job.backend,
+                unit.runnable.run(backend=backend,
                                   batch_size=job.batch_size,
                                   resilient=job.resilient,
                                   **job.window_kwargs(), **kwargs)
         except Exception as exc:
+            self._note_backend_result(backend, exc)
             if unit.coalesced:
                 # one bad member must not fail the batch it merged
-                # into: fall back to solo passes with per-job outcomes
+                # into: fall back to solo passes with per-job outcomes.
+                # Requeue-style accounting (the satellite fix): each
+                # member's wait clock restarts here, so the merged
+                # pass's doomed run time isn't booked as queue wait.
                 self.telemetry.count("coalesce_fallbacks")
                 self._log.warning(
                     "coalesced pass of %d jobs failed (%s: %s); "
                     "re-running members solo", len(unit.handles),
                     type(exc).__name__, exc)
                 for h in unit.handles:
-                    self._run_solo(h, kwargs)
+                    h.requeued_t = self._clock()
+                    self.telemetry.count("jobs_requeued")
+                    obs.METRICS.inc("mdtpu_jobs_requeued_total")
+                    self._run_solo(h, kwargs, token)
             else:
                 for h in unit.handles:
-                    h._mark_failed(exc)
-                    self._finish(h)
+                    self._complete(h, token, exc=exc)
         else:
+            self._note_backend_result(
+                backend, None,
+                analyses=[h.job.analysis for h in unit.handles])
             for h in unit.handles:
                 h.coalesced = unit.coalesced
-                h._mark_done()
-                self._finish(h)
+                self._complete(h, token)
         finally:
             if reserved > 0:
                 # the staged bytes are now accounted as cache entries
@@ -703,23 +1341,30 @@ class Scheduler:
                 obs.export_trace()
         return True
 
-    def _run_solo(self, handle: JobHandle, kwargs: dict) -> None:
+    def _run_solo(self, handle: JobHandle, kwargs: dict,
+                  token) -> None:
         job = handle.job
         obs.maybe_enable_from_env()      # same contract as _run_unit
+        backend = self._route_backend(job)
+        if backend != job.backend:
+            self.telemetry.count("breaker_reroutes")
+        handle._mark_running()
         try:
             with obs.trace_context(job_ids=[handle.job_id],
                                    tenants=[job.tenant],
                                    trace_ids=[job.trace_id]), \
                     TIMERS.phase("serve_job", coalesced=False):
-                job.analysis.run(backend=job.backend,
+                job.analysis.run(backend=backend,
                                  batch_size=job.batch_size,
                                  resilient=job.resilient,
                                  **job.window_kwargs(), **kwargs)
         except Exception as exc:
-            handle._mark_failed(exc)
+            self._note_backend_result(backend, exc)
+            self._complete(handle, token, exc=exc)
         else:
-            handle._mark_done()
-        self._finish(handle)
+            self._note_backend_result(backend, None,
+                                      analyses=[job.analysis])
+            self._complete(handle, token)
         if obs.trace_path():
             obs.export_trace()       # same file-currency contract as
             #                          _run_unit

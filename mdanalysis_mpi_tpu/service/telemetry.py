@@ -67,6 +67,15 @@ class ServiceTelemetry:
         self.prefetch_jobs = 0         # queued jobs whose blocks staged
         self.prefetch_blocks = 0       # blocks staged ahead of claim
         self.prefetch_skipped = 0      # skipped by admission/budget
+        # serving supervision (docs/RELIABILITY.md)
+        self.quarantined = 0           # jobs parked with diagnostics
+        self.aborted = 0               # failed by shutdown/signal drain
+        self.lease_expired = 0         # leases reaped (TTL or death)
+        self.jobs_requeued = 0         # supervision requeues (reap or
+        #                                merged-pass fallback)
+        self.breaker_reroutes = 0      # units routed off a tripped
+        #                                backend
+        self.workers_respawned = 0     # dead worker threads replaced
         # distributions (seconds), bounded — see MAX_SAMPLES
         self.queue_wait_samples: deque = deque(maxlen=MAX_SAMPLES)
         self.latency_samples: deque = deque(maxlen=MAX_SAMPLES)
@@ -106,6 +115,10 @@ class ServiceTelemetry:
                     self.coalesced_jobs += 1
             elif handle.state == JobState.EXPIRED:
                 self.expired += 1
+            elif handle.state == JobState.QUARANTINED:
+                self.quarantined += 1
+            elif handle.state == JobState.ABORTED:
+                self.aborted += 1
             else:
                 self.failed += 1
             if handle.queue_wait_s is not None:
@@ -156,6 +169,12 @@ class ServiceTelemetry:
                 "prefetch_jobs": self.prefetch_jobs,
                 "prefetch_blocks": self.prefetch_blocks,
                 "prefetch_skipped": self.prefetch_skipped,
+                "jobs_quarantined": self.quarantined,
+                "jobs_aborted": self.aborted,
+                "lease_expired": self.lease_expired,
+                "jobs_requeued": self.jobs_requeued,
+                "breaker_reroutes": self.breaker_reroutes,
+                "workers_respawned": self.workers_respawned,
                 "p50_queue_wait_s": percentile(self.queue_wait_samples, 50),
                 "p99_queue_wait_s": percentile(self.queue_wait_samples, 99),
                 "p50_latency_s": percentile(self.latency_samples, 50),

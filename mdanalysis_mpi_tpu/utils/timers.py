@@ -33,6 +33,38 @@ from contextlib import contextmanager
 
 from mdanalysis_mpi_tpu.obs import spans as _spans
 
+# ---- phase hooks (the serving layer's heartbeat channel) ----
+#
+# The scheduler supervisor (service/supervision.py) needs a liveness
+# signal from INSIDE a running job: a worker that is making progress
+# enters timed phases (stage/dispatch/wire/prepare/...) continuously,
+# while a hung dispatch or dead thread stops.  Rather than threading a
+# callback through every executor, hooks registered here fire on every
+# phase ENTRY on the entering thread — the scheduler's hook renews the
+# calling worker's lease; everything else costs one truthiness check.
+_PHASE_HOOKS: list = []
+
+
+def add_phase_hook(fn) -> None:
+    """Register ``fn(phase_name)`` to run at every phase entry (any
+    PhaseTimers instance, the entering thread).  Hook exceptions are
+    swallowed — instrumentation must never fail a run."""
+    if fn not in _PHASE_HOOKS:
+        _PHASE_HOOKS.append(fn)
+
+
+def remove_phase_hook(fn) -> None:
+    if fn in _PHASE_HOOKS:
+        _PHASE_HOOKS.remove(fn)
+
+
+def _fire_phase_hooks(name: str) -> None:
+    for fn in list(_PHASE_HOOKS):
+        try:
+            fn(name)
+        except Exception:
+            pass
+
 
 class PhaseTimers:
     """Accumulating named wall-clock phase timers.
@@ -61,6 +93,8 @@ class PhaseTimers:
         """Time the enclosed block under ``name``.  ``span_args`` ride
         the piggybacked span (e.g. ``scan_k``) when tracing is on;
         they never touch the timer accounting."""
+        if _PHASE_HOOKS:
+            _fire_phase_hooks(name)
         sp = _spans.span(name, **span_args)
         sp.__enter__()
         t0 = time.perf_counter()

@@ -54,6 +54,17 @@ PINNED_METRICS = {
     "mdtpu_prefetch_jobs_total": "counter",
     "mdtpu_prefetch_blocks_total": "counter",
     "mdtpu_prefetch_skipped_total": "counter",
+    # serving supervision (docs/RELIABILITY.md): lease reaping,
+    # poison-job quarantine, supervision requeues, signal-drain
+    # aborts, worker respawns, and the per-backend circuit breakers
+    "mdtpu_lease_expired_total": "counter",
+    "mdtpu_jobs_quarantined_total": "counter",
+    "mdtpu_jobs_requeued_total": "counter",
+    "mdtpu_jobs_aborted_total": "counter",
+    "mdtpu_workers_respawned_total": "counter",
+    "mdtpu_breaker_reroutes_total": "counter",
+    "mdtpu_breaker_transitions_total": "counter",
+    "mdtpu_breaker_state": "gauge",
 }
 
 
@@ -127,6 +138,16 @@ def test_bench_json_contract(tmp_path):
                     "serving_accel_p99_latency_s",
                     "serving_accel_coalesce_rate",
                     "serving_accel_cache_hit_rate",
+                    # r10: the serving fault-wave sub-leg
+                    # (docs/RELIABILITY.md): one injected worker death
+                    # mid-wave vs a clean wave — host-side, so it
+                    # also survives a tunnel-down artifact
+                    "serving_fault_clean_jobs_per_s",
+                    "serving_fault_recovery_jobs_per_s",
+                    "serving_fault_recovery_p99_latency_s",
+                    "serving_fault_recovery_overhead_pct",
+                    "serving_fault_lease_expired",
+                    "serving_fault_workers_respawned",
                     # r9: observability — the host-leg tracing-on/off
                     # delta and the unified metrics block
                     # (docs/OBSERVABILITY.md)
@@ -156,6 +177,13 @@ def test_bench_json_contract(tmp_path):
         assert 0 < rec["serving_accel_cache_hit_rate"] <= 1
         assert rec["serving_accel_coalesce_rate"] == 1.0
         assert "serving_accel" in rec["accel_leg_order"]
+        # fault-wave sub-leg: the injected worker death was really
+        # reaped, recovered jobs still flowed, and the recovery price
+        # is recorded next to the clean wave
+        assert rec["serving_fault_recovery_jobs_per_s"] > 0
+        assert rec["serving_fault_lease_expired"] >= 1
+        assert rec["serving_fault_workers_respawned"] >= 1
+        assert rec["serving_fault_recovery_p99_latency_s"] >= 0
         # §9e reorder: the clean-process compile leg records first,
         # then the cold attempts
         assert rec["accel_leg_order"][:2] == ["cold_compile", "cold"]
@@ -252,6 +280,10 @@ def test_bench_outage_records_host_legs(tmp_path):
         assert rec["serving_jobs_per_s"] > 0
         assert 0 < rec["serving_coalesce_rate"] < 1
         assert rec["serving_p99_latency_s"] >= rec["serving_p50_latency_s"]
+        # r10: the fault-wave sub-leg is host-side too — supervised
+        # recovery is measured even with the tunnel down
+        assert rec["serving_fault_recovery_jobs_per_s"] > 0
+        assert rec["serving_fault_lease_expired"] >= 1
         # the retry log shows what init actually did
         assert rec["init_log"] and rec["init_log"][0]["attempt"] == 1
         # the incremental file matches the emitted record's legs
