@@ -12,9 +12,14 @@ docs/SERVICE.md; dispatched in ``utils/config.main``).
 
 import sys
 
-from mdanalysis_mpi_tpu.utils.platform import honor_cpu_request
+if not (len(sys.argv) > 1 and sys.argv[1] == "lint"):
+    # platform re-pinning imports jax; the lint subcommand's fast AST
+    # mode is contractually jax-free (<30 s, docs/LINT.md — pinned by
+    # tests/test_lint.py via the CLI's `jax_imported` disclosure), and
+    # its --jaxpr mode pins the CPU platform itself before jax init
+    from mdanalysis_mpi_tpu.utils.platform import honor_cpu_request
 
-honor_cpu_request()
+    honor_cpu_request()
 
 from mdanalysis_mpi_tpu.utils.config import main
 

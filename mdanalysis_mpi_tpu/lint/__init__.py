@@ -1,0 +1,40 @@
+"""``mdtpu lint`` — repo-native static analysis (docs/LINT.md).
+
+Three of the last four PRs shipped hand-found bugs from the same
+recurring classes: unlocked shared-state read-modify-writes (the PR-5
+``PhaseTimers`` race, the PR-4 ``DeviceBlockCache`` double-delete),
+condition-variable misuse (the PR-7 ``submit()`` lost-wakeup via
+``notify()``), swallowed ``BaseException`` control flow (the
+``WorkerFenced``/``InjectedWorkerDeath`` fencing channel), and jaxpr
+invariants (one psum per mesh scan) that only runtime tests pinned.
+This package encodes those conventions as named rules so the classes
+are caught at review time instead of in chaos suites:
+
+- :mod:`~mdanalysis_mpi_tpu.lint.concurrency` — MDT0xx: lock
+  discipline, condition-variable wakeups, fencing-exception flow,
+  thread daemon/join hygiene.  Pure stdlib :mod:`ast`.
+- :mod:`~mdanalysis_mpi_tpu.lint.jaxcontracts` — MDT1xx: host side
+  effects inside jit/shard_map/scan-traced code (AST call-graph walk),
+  plus lowering-based jaxpr contracts (one psum per mesh scan,
+  captured-constant byte budget) that need jax.
+- :mod:`~mdanalysis_mpi_tpu.lint.schema` — MDT2xx: schema drift
+  between the metric/span names the code records, the pinned schema in
+  ``tests/test_bench_contract.py``, and the catalog in
+  ``docs/OBSERVABILITY.md``.
+
+Entry points: ``python -m mdanalysis_mpi_tpu lint`` (CLI — rule ids,
+JSON output, baseline suppression), :func:`run_lint` (library), and
+the tree-wide self-check in ``tests/test_lint.py`` (``lint`` pytest
+marker, tier-1).  The AST and schema passes import stdlib only, so the
+default (fast) mode runs before any jax import.
+"""
+
+from mdanalysis_mpi_tpu.lint.core import (
+    Baseline, Finding, LintReport, Rule, all_rules, iter_python_files,
+    rule_ids, run_lint,
+)
+
+__all__ = [
+    "Baseline", "Finding", "LintReport", "Rule", "all_rules",
+    "iter_python_files", "rule_ids", "run_lint",
+]

@@ -1,0 +1,136 @@
+"""``python -m mdanalysis_mpi_tpu lint`` — the checker's command line.
+
+Fast by default: only the stdlib-``ast`` passes run, no jax import
+(the CLI discloses ``jax_imported`` in its JSON output and tests pin
+it).  ``--jaxpr`` adds the lowering-based MDT11x contracts, forcing an
+8-virtual-device CPU platform first so the mesh program lowers without
+hardware.
+
+Exit codes: 0 clean (no unbaselined findings), 1 findings, 2 usage.
+
+Baseline workflow (docs/LINT.md): ``--baseline-write`` records every
+current finding with ``justification: "TODO: justify"``; entries only
+suppress once a real justification replaces the TODO — the bootstrap
+cannot be silently shipped.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from mdanalysis_mpi_tpu.lint.core import (
+    Baseline, all_rules, find_repo_root, run_lint,
+)
+
+#: Default baseline file, repo-relative.
+BASELINE_NAME = ".mdtpu_lint_baseline.json"
+
+
+def _parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="mdanalysis_mpi_tpu lint",
+        description="repo-native static analysis: concurrency "
+                    "discipline, jit/jaxpr contracts, schema drift "
+                    "(docs/LINT.md)")
+    p.add_argument("--root", default=None,
+                   help="repo root to lint (default: the installed "
+                        "package's parent)")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated rule ids to run (default all)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="one JSON document on stdout instead of text")
+    p.add_argument("--jaxpr", action="store_true",
+                   help="also CPU-lower the registered executor "
+                        "programs and check the MDT11x jaxpr "
+                        "contracts (imports jax)")
+    p.add_argument("--baseline", default=None, metavar="FILE",
+                   help=f"baseline suppression file (default "
+                        f"<root>/{BASELINE_NAME})")
+    p.add_argument("--baseline-write", action="store_true",
+                   help="bootstrap: write every current finding to "
+                        "the baseline file with a TODO justification "
+                        "and exit 0")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    return p
+
+
+def lint_main(argv=None) -> int:
+    ns = _parser().parse_args(argv)
+    if ns.list_rules:
+        for rule in sorted(all_rules().values(), key=lambda r: r.id):
+            print(f"{rule.id}  {rule.name:32s} [{rule.family}] "
+                  f"{rule.summary}")
+        return 0
+    root = find_repo_root(ns.root)
+    baseline_path = ns.baseline or os.path.join(root, BASELINE_NAME)
+    rules = (None if ns.rules is None
+             else [r.strip() for r in ns.rules.split(",") if r.strip()])
+    if rules is not None:
+        # a typo'd id would filter every finding away and leave a CI
+        # gate permanently green — unknown ids are a usage error
+        known = set(all_rules()) | {"MDT000"}   # MDT000: unparseable
+        unknown = sorted(set(rules) - known)
+        if unknown:
+            print(f"unknown rule id(s): {', '.join(unknown)} "
+                  f"(see --list-rules)", file=sys.stderr)
+            return 2
+    if ns.jaxpr:
+        # CPU-lowering is the contract (no hardware required), and the
+        # mesh program needs a multi-device axis to lower the psum
+        # against: force the 8-virtual-device CPU platform BEFORE jax
+        # init (same trick as tests/conftest.py — jax.config outranks
+        # an axon site hook's env re-assert)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    report = run_lint(root=root, rules=rules, jaxpr=ns.jaxpr,
+                      baseline=baseline_path)
+
+    if ns.baseline_write:
+        merged = Baseline.load(baseline_path)
+        # dedup by finding key: a re-run (TODO entries don't suppress,
+        # so the findings come back) must not append duplicates that
+        # each need hand-justifying later
+        have = {(e.get("rule"), e.get("path"), e.get("symbol"),
+                 e.get("detail", "")) for e in merged.entries}
+        fresh = [f for f in report.findings if f.key() not in have]
+        merged.entries += Baseline.from_findings(fresh).entries
+        merged.save(baseline_path)
+        print(f"wrote {len(fresh)} new finding(s) to {baseline_path} "
+              f"({len(report.findings) - len(fresh)} already present); "
+              f"add justifications to activate them", file=sys.stderr)
+        return 0
+
+    # surface the outcome in the unified metrics snapshot
+    # (docs/OBSERVABILITY.md): obs imports stdlib only — still jax-free
+    from mdanalysis_mpi_tpu.obs.metrics import METRICS
+
+    METRICS.set_gauge("mdtpu_lint_rules", len(report.rules))
+    METRICS.set_gauge("mdtpu_lint_findings", len(report.findings))
+
+    if ns.as_json:
+        doc = report.to_json()
+        doc["jax_imported"] = "jax" in sys.modules
+        doc["baseline"] = baseline_path
+        print(json.dumps(doc))
+    else:
+        for f in report.findings:
+            print(f.render())
+        for note in report.notes:
+            print(f"note: {note}", file=sys.stderr)
+        print(f"{len(report.findings)} finding(s), "
+              f"{len(report.baselined)} baselined, "
+              f"{report.suppressed} pragma-suppressed, "
+              f"{report.files} files, {len(report.rules)} rules",
+              file=sys.stderr)
+    return 0 if report.clean else 1

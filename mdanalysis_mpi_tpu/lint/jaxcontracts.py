@@ -1,0 +1,380 @@
+"""jit/jaxpr contract rules (MDT1xx).
+
+Two tiers:
+
+- **AST tier** (stdlib-only, always runs): :func:`check_module` finds
+  functions routed into jax tracing — arguments of ``jit(...)`` /
+  ``_cc.jit(...)`` / ``shard_map(...)`` / ``jax.lax.scan(...)`` /
+  ``vmap(...)``, unwrapped through single-argument wrappers like
+  ``_f32_precision(f)`` — walks the same-module call graph from those
+  roots, and flags host side effects inside anything traced:
+
+  - **MDT101 host-side-effect-in-traced** — calls into ``time.*`` /
+    ``random.*`` / ``np.*`` / ``numpy.*``, ``print(...)``, or
+    ``.item()``: these run at trace time (a silent constant-fold at
+    best) or fail under jit (a concrete-value error at worst), and
+    none of them do what the author meant on re-execution of the
+    compiled program.
+  - **MDT102 global-state-in-traced** — ``global``/``nonlocal``
+    declarations inside a traced function: mutation only happens at
+    trace time, so cached executions silently skip it (the compile
+    cache makes this a one-process-in-N heisenbug).
+
+- **Lowering tier** (``--jaxpr``, needs jax):
+  :func:`check_lowered_programs` CPU-lowers the registered executor
+  programs and checks the jaxpr invariants runtime tests used to pin:
+
+  - **MDT110 one-psum-per-scan** — the mesh scan program accumulates
+    LOCAL partials across a scan group and psum-merges ONCE per scan
+    (PR-3's dispatch contract): collectives inside a scan body
+    multiply ICI traffic by K and void the scan fold's entire point.
+  - **MDT111 captured-constant-budget** — no big ndarrays baked into
+    jitted closures: a captured constant is re-shipped with every
+    executable and silently bloats the compile cache.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from mdanalysis_mpi_tpu.lint.core import Finding, Rule, register
+
+register(Rule(
+    "MDT101", "host-side-effect-in-traced", "jit",
+    "time/random/np/print/.item() inside a jit/shard_map/scan-traced "
+    "function",
+    "host ops inside traced code constant-fold at trace time or fail "
+    "under jit; the compile cache (PR-6) makes the trace-once "
+    "semantics extra surprising"))
+register(Rule(
+    "MDT102", "global-state-in-traced", "jit",
+    "global/nonlocal mutation inside a traced function",
+    "mutation happens at trace time only - cache-hit executions "
+    "silently skip it"))
+register(Rule(
+    "MDT110", "one-psum-per-scan", "jaxpr",
+    "collective (psum) inside a lax.scan body of a mesh program",
+    "PR-3 pinned the mesh scan to ONE psum merge per scan group; a "
+    "psum in the scan body costs K collectives per group", True))
+register(Rule(
+    "MDT111", "captured-constant-budget", "jaxpr",
+    "jitted program captures ndarray constants over the byte budget",
+    "closures that bake coordinate arrays into the program re-ship "
+    "them with every executable and bloat the persistent compile "
+    "cache (PR-6)", True))
+
+#: Name roots whose attribute-calls are host-side inside a trace.
+_HOST_ROOTS = {"time", "random", "np", "numpy"}
+
+#: Wrapper/transform callables whose first argument is (or wraps) the
+#: traced function.
+_TRACE_ENTRY_ATTRS = {"jit", "shard_map", "vmap", "scan", "pmap"}
+
+#: Per-program captured-constant budget for MDT111 (bytes).  Small
+#: broadcast constants (masks, identity quaternions) are fine; a
+#: coordinate block is not.
+CONST_BUDGET_BYTES = 1 << 20
+
+
+# ---------------------------------------------------------------- AST tier
+
+def _unwrap_traced_arg(node: ast.AST) -> ast.AST:
+    """Peel single-positional-argument wrapper calls:
+    ``jit(_f32_precision(f))`` → ``f``."""
+    while isinstance(node, ast.Call) and node.args:
+        node = node.args[0]
+    return node
+
+
+class _Scope:
+    """One lexical function-def scope: local defs by name + parent."""
+
+    def __init__(self, parent=None):
+        self.parent = parent
+        self.defs: dict[str, ast.AST] = {}
+
+    def resolve(self, name: str):
+        scope = self
+        while scope is not None:
+            if name in scope.defs:
+                return scope.defs[name]
+            scope = scope.parent
+        return None
+
+
+def _index_scopes(tree: ast.Module):
+    """Map every FunctionDef node to its enclosing :class:`_Scope`
+    (so ``Name`` references at a call site resolve lexically)."""
+    scope_of: dict[ast.AST, _Scope] = {}
+    node_scope: dict[ast.AST, _Scope] = {}
+
+    def walk(node, scope):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scope.defs[child.name] = child
+                inner = _Scope(scope)
+                node_scope[child] = inner
+                scope_of[child] = scope
+                walk(child, inner)
+            else:
+                walk(child, scope)
+
+    top = _Scope()
+    walk(tree, top)
+    return scope_of, node_scope, top
+
+
+def _qualname(node: ast.AST, parents: dict) -> str:
+    parts = []
+    cur = node
+    while cur is not None:
+        name = getattr(cur, "name", None)
+        if name and isinstance(cur, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+            parts.append(name)
+        cur = parents.get(cur)
+    return ".".join(reversed(parts)) or "<module>"
+
+
+def check_module(tree: ast.Module, rel: str) -> list[Finding]:
+    findings: list[Finding] = []
+    parents: dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+
+    scope_of, node_scope, top = _index_scopes(tree)
+
+    # roots: defs handed to jit/shard_map/scan/vmap anywhere in the file
+    roots: list[ast.AST] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name = (fn.id if isinstance(fn, ast.Name)
+                else fn.attr if isinstance(fn, ast.Attribute) else None)
+        if name not in _TRACE_ENTRY_ATTRS or not node.args:
+            continue
+        target = _unwrap_traced_arg(node.args[0])
+        if isinstance(target, ast.Name):
+            # resolve lexically from the call site's enclosing scope
+            encl = node
+            while encl is not None and encl not in node_scope:
+                encl = parents.get(encl)
+            scope = node_scope.get(encl, top)
+            resolved = scope.resolve(target.id)
+            if resolved is not None:
+                roots.append(resolved)
+        elif isinstance(target, ast.Lambda):
+            roots.append(target)
+
+    # transitive closure over same-module calls
+    traced: set = set()
+    stack = list(roots)
+    while stack:
+        fndef = stack.pop()
+        if id(fndef) in {id(t) for t in traced}:
+            continue
+        traced.add(fndef)
+        scope = node_scope.get(fndef, top)
+        for node in ast.walk(fndef):
+            if isinstance(node, ast.Call) and isinstance(node.func,
+                                                         ast.Name):
+                callee = scope.resolve(node.func.id)
+                if callee is not None:
+                    stack.append(callee)
+
+    for fndef in traced:
+        sym = _qualname(fndef, parents)
+        for node in ast.walk(fndef):
+            if isinstance(node, ast.Call):
+                fn = node.func
+                if isinstance(fn, ast.Name) and fn.id == "print":
+                    findings.append(Finding(
+                        "MDT101", rel, node.lineno, sym,
+                        "print() inside a traced function runs at "
+                        "trace time only", detail="print"))
+                elif isinstance(fn, ast.Attribute):
+                    root = fn.value
+                    while isinstance(root, ast.Attribute):
+                        root = root.value
+                    if (isinstance(root, ast.Name)
+                            and root.id in _HOST_ROOTS):
+                        findings.append(Finding(
+                            "MDT101", rel, node.lineno, sym,
+                            f"host call `{root.id}.{fn.attr}` inside a "
+                            f"traced function (trace-time constant "
+                            f"fold / concretization error)",
+                            detail=f"{root.id}.{fn.attr}"))
+                    elif fn.attr == "item" and not node.args:
+                        findings.append(Finding(
+                            "MDT101", rel, node.lineno, sym,
+                            ".item() inside a traced function forces a "
+                            "host readback (concretization error "
+                            "under jit)", detail=".item"))
+            elif isinstance(node, (ast.Global, ast.Nonlocal)):
+                findings.append(Finding(
+                    "MDT102", rel, node.lineno, sym,
+                    f"`{'global' if isinstance(node, ast.Global) else 'nonlocal'} "
+                    f"{', '.join(node.names)}` inside a traced function "
+                    f"mutates at trace time only",
+                    detail=",".join(node.names)))
+    return findings
+
+
+# ------------------------------------------------------------ lowering tier
+
+def _iter_subjaxprs(jaxpr):
+    """Yield every (primitive_name, sub-jaxpr) pair reachable from
+    ``jaxpr`` (eqn params holding Jaxpr/ClosedJaxpr, incl. in lists)."""
+    from jax.extend import core as jcore
+
+    def inner_jaxprs(value):
+        if isinstance(value, jcore.ClosedJaxpr):
+            yield value.jaxpr
+        elif isinstance(value, jcore.Jaxpr):
+            yield value
+        elif isinstance(value, (list, tuple)):
+            for v in value:
+                yield from inner_jaxprs(v)
+
+    for eqn in jaxpr.eqns:
+        for value in eqn.params.values():
+            for sub in inner_jaxprs(value):
+                yield eqn.primitive.name, sub
+                yield from _iter_subjaxprs(sub)
+
+
+def count_psums(jaxpr) -> int:
+    n = sum(1 for eqn in jaxpr.eqns if eqn.primitive.name == "psum")
+    for _, sub in _iter_subjaxprs(jaxpr):
+        n += sum(1 for eqn in sub.eqns if eqn.primitive.name == "psum")
+    return n
+
+
+def scan_psum_violations(closed_jaxpr) -> list[tuple[str, int]]:
+    """``(context, n_psums_in_scan_body)`` for every ``lax.scan`` body
+    that contains a collective — the MDT110 predicate.  Empty means
+    the program hoists its merges out of every scan."""
+    out = []
+
+    def visit(jaxpr, trail):
+        for eqn in jaxpr.eqns:
+            for value in eqn.params.values():
+                from jax.extend import core as jcore
+
+                subs = []
+                if isinstance(value, jcore.ClosedJaxpr):
+                    subs = [value.jaxpr]
+                elif isinstance(value, jcore.Jaxpr):
+                    subs = [value]
+                elif isinstance(value, (list, tuple)):
+                    subs = [v.jaxpr if isinstance(v, jcore.ClosedJaxpr)
+                            else v for v in value
+                            if isinstance(v, (jcore.Jaxpr,
+                                              jcore.ClosedJaxpr))]
+                for sub in subs:
+                    t = trail + [eqn.primitive.name]
+                    if eqn.primitive.name == "scan":
+                        n = count_psums(sub)
+                        if n > 0:
+                            out.append(("/".join(t), n))
+                    visit(sub, t)
+
+    visit(closed_jaxpr.jaxpr, [])
+    return out
+
+
+def captured_const_bytes(closed_jaxpr) -> int:
+    """Total bytes of ndarray-like constants the program captured."""
+    total = 0
+    for c in closed_jaxpr.consts:
+        nbytes = getattr(c, "nbytes", None)
+        if nbytes:
+            total += int(nbytes)
+    return total
+
+
+def _registered_programs():
+    """Name → (closed_jaxpr, expected_total_psums | None) for the
+    executor programs the contracts pin.  Built at CPU scale from the
+    package's own synthetic fixtures — no hardware, no trajectory
+    files."""
+    import jax
+    import numpy as np
+
+    from mdanalysis_mpi_tpu.analysis.rms import RMSF
+    from mdanalysis_mpi_tpu.parallel.executors import MeshExecutor
+    from mdanalysis_mpi_tpu.testing import make_protein_universe
+
+    u = make_protein_universe(n_residues=8, n_frames=64, noise=0.2)
+    ag = u.select_atoms("name CA")
+    a = RMSF(ag)
+    a.n_frames = 64
+    a._frame_indices = list(range(64))
+    a._prepare()
+    m = MeshExecutor(batch_size=2)
+    params = a._batch_params()
+    s_atoms = len(ag.indices)
+    n_dev = len(jax.devices())
+    gb = 2 * n_dev                      # global batch
+    blk = lambda k: (np.zeros((k, gb, s_atoms, 3), np.float32),
+                     np.zeros((k, gb, 6), np.float32),
+                     np.ones((k, gb), np.float32))
+    one = (np.zeros((gb, s_atoms, 3), np.float32),
+           np.zeros((gb, 6), np.float32),
+           np.ones((gb,), np.float32))
+    s_init, s_fused, _ = m._build_scan(a)
+    _, gfn, _, _, _ = m._build(a)
+    block_jaxpr = jax.make_jaxpr(gfn)(params, *one)
+    block_psums = count_psums(block_jaxpr)
+    return {
+        "mesh_block_rmsf": (block_jaxpr, None),
+        "mesh_scan_rmsf_init": (
+            jax.make_jaxpr(s_init)(params, *blk(4)), block_psums),
+        "mesh_scan_rmsf_fused": (
+            jax.make_jaxpr(s_fused)(
+                jax.eval_shape(s_init, params, *blk(1)), params,
+                *blk(3)),
+            block_psums),
+    }
+
+
+def check_lowered_programs(notes: list[str]) -> list[Finding]:
+    """The MDT110/MDT111 pass over the registered executor programs.
+    Appends a note and returns no findings when jax is unavailable."""
+    try:
+        import jax  # noqa: F401
+    except Exception as exc:            # pragma: no cover - env-dependent
+        notes.append(f"jaxpr contracts skipped: jax unavailable ({exc})")
+        return []
+    findings: list[Finding] = []
+    programs = _registered_programs()
+    rel = "mdanalysis_mpi_tpu/parallel/executors.py"
+    for name, (jaxpr, expected_psums) in programs.items():
+        for ctx, n in scan_psum_violations(jaxpr):
+            findings.append(Finding(
+                "MDT110", rel, 0, name,
+                f"scan body contains {n} psum(s) (at {ctx}): the mesh "
+                f"scan contract is local accumulation with ONE merge "
+                f"per scan", detail=ctx))
+        if expected_psums is not None:
+            total = count_psums(jaxpr)
+            if total != expected_psums:
+                findings.append(Finding(
+                    "MDT110", rel, 0, name,
+                    f"program has {total} psums, expected "
+                    f"{expected_psums} (the single-block program's "
+                    f"count) — the merge multiplied with the scan",
+                    detail="total"))
+        nbytes = captured_const_bytes(jaxpr)
+        if nbytes > CONST_BUDGET_BYTES:
+            findings.append(Finding(
+                "MDT111", rel, 0, name,
+                f"program captures {nbytes} bytes of ndarray "
+                f"constants (budget {CONST_BUDGET_BYTES}): data must "
+                f"flow in as arguments, not baked into the closure",
+                detail="consts"))
+    notes.append(f"jaxpr contracts checked {len(programs)} programs")
+    return findings

@@ -58,7 +58,9 @@ class MetricsRegistry:
         self._series: dict[str, dict] = {}
         self._buckets: dict[str, tuple] = {}
 
-    def _values(self, name: str, typ: str) -> dict:
+    def _values_locked(self, name: str, typ: str) -> dict:
+        # `_locked` suffix: the caller holds self._lock (the repo
+        # convention `mdtpu lint` MDT001 enforces — docs/LINT.md)
         s = self._series.get(name)
         if s is None:
             s = {"type": typ, "values": {}}
@@ -71,19 +73,19 @@ class MetricsRegistry:
     def inc(self, name: str, value: float = 1, **labels) -> None:
         key = label_key(labels)
         with self._lock:
-            vals = self._values(name, "counter")
+            vals = self._values_locked(name, "counter")
             vals[key] = vals.get(key, 0) + value
 
     def set_gauge(self, name: str, value: float, **labels) -> None:
         with self._lock:
-            self._values(name, "gauge")[label_key(labels)] = value
+            self._values_locked(name, "gauge")[label_key(labels)] = value
 
     def observe(self, name: str, value: float,
                 buckets: tuple = TIME_BUCKETS, **labels) -> None:
         key = label_key(labels)
         with self._lock:
             bk = self._buckets.setdefault(name, tuple(buckets))
-            vals = self._values(name, "histogram")
+            vals = self._values_locked(name, "histogram")
             h = vals.get(key)
             if h is None:
                 h = {"count": 0, "sum": 0.0,
@@ -176,6 +178,27 @@ SUPERVISION_COUNTERS = (
     "mdtpu_jobs_requeued_total",
 )
 
+#: Reliability-runtime counters (reliability/policy.py, faults.py) —
+#: labeled at the incident site (``site=``), recorded live.  Newly
+#: zero-injected so the healthy-process snapshot carries the full
+#: schema and the names can be pinned (`mdtpu lint` MDT201 flagged
+#: them as recorded-but-unpinned).
+RELIABILITY_COUNTERS = (
+    "mdtpu_retries_total",
+    "mdtpu_dropped_frames_total",
+    "mdtpu_executor_fallbacks_total",
+    "mdtpu_faults_injected_total",
+)
+
+#: Static-analysis outcome gauges (lint/cli.py sets them after a run:
+#: how many rules ran, how many unbaselined findings remain —
+#: docs/LINT.md).  Zero-injected so the schema holds in processes
+#: that never linted.
+LINT_GAUGES = (
+    "mdtpu_lint_rules",
+    "mdtpu_lint_findings",
+)
+
 
 def unified_snapshot(timers=None, cache=None, telemetry=None,
                      registry: MetricsRegistry | None = None) -> dict:
@@ -196,11 +219,12 @@ def unified_snapshot(timers=None, cache=None, telemetry=None,
     """
     snap = (registry or METRICS).snapshot()
     for name in COMPILE_METRICS + BREAKER_COUNTERS + \
-            SUPERVISION_COUNTERS:
+            SUPERVISION_COUNTERS + RELIABILITY_COUNTERS:
         snap.setdefault(name, {"type": "counter", "values": {"": 0}})
-    for name in BREAKER_GAUGES:
+    for name in BREAKER_GAUGES + LINT_GAUGES:
         # 0 == closed (reliability/breaker.py STATE_VALUES): a process
-        # that never tripped a breaker reports the healthy state
+        # that never tripped a breaker reports the healthy state;
+        # likewise 0 lint rules/findings means "never linted here"
         snap.setdefault(name, {"type": "gauge", "values": {"": 0}})
     if timers is not None:
         rep = timers.report()

@@ -156,8 +156,16 @@ class CircuitBreaker:
             self.probes += 1
         try:
             fn()
-        except BaseException:
+        except BaseException as exc:
             self.record_failure()
+            if not isinstance(exc, Exception):
+                # BaseException-based control flow (worker fencing:
+                # WorkerFenced, InjectedWorkerDeath, KeyboardInterrupt)
+                # must keep unwinding the thread — the probe records
+                # the failed attempt but never swallows the fence
+                # (`mdtpu lint` MDT003; regression in
+                # tests/test_supervision.py)
+                raise
             return False
         self.record_success()
         return True

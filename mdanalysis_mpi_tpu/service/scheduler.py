@@ -289,9 +289,14 @@ class Scheduler:
         _timers.remove_phase_hook(self._sup.heartbeat)
         if self.journal is not None and self._owns_journal:
             self.journal.close()
-        self._workers.clear()
-        self._prefetch_thread = None
-        self._sup_thread = None
+        # under the condition like every other mutation of the pool
+        # bookkeeping (`mdtpu lint` MDT001): the pool is quiescent by
+        # the time _teardown runs, but a concurrent start() must see
+        # either the old pool or the cleared one, never a half-clear
+        with self._cond:
+            self._workers.clear()
+            self._prefetch_thread = None
+            self._sup_thread = None
 
     def abort_queued(self, reason: str = "scheduler draining") -> list:
         """Fail every queued/parked handle no worker has claimed with

@@ -225,6 +225,14 @@ def main(argv=None) -> int:
         from mdanalysis_mpi_tpu.service.cli import batch_main
 
         return batch_main(args[1:])
+    if args and args[0] == "lint":
+        # repo-native static analysis (lint/ subsystem): concurrency
+        # discipline, jit/jaxpr contracts, schema drift — docs/LINT.md.
+        # Dispatched before the analysis parser AND before any jax
+        # import so the fast AST mode stays jax-free.
+        from mdanalysis_mpi_tpu.lint.cli import lint_main
+
+        return lint_main(args[1:])
     ns = _parser().parse_args(args)
     cfg = AnalysisConfig(
         analysis=ns.analysis, topology=ns.topology,

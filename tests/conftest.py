@@ -52,3 +52,9 @@ def pytest_configure(config):
         "span tracing, metrics registry, trace export, run reports); "
         "in tier-1 by construction (not slow) and selectable alone "
         "with `pytest -m obs`")
+    config.addinivalue_line(
+        "markers",
+        "lint: fast static-analysis tests (lint/ subsystem: rule "
+        "fixtures, seeded-bug corpus, tree-wide self-check); in "
+        "tier-1 by construction (not slow) and selectable alone "
+        "with `pytest -m lint`")

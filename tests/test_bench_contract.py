@@ -65,6 +65,16 @@ PINNED_METRICS = {
     "mdtpu_breaker_reroutes_total": "counter",
     "mdtpu_breaker_transitions_total": "counter",
     "mdtpu_breaker_state": "gauge",
+    # static analysis (docs/LINT.md): reliability-runtime counters and
+    # the lint outcome gauges are zero-injected so the healthy-process
+    # snapshot carries the full schema (`mdtpu lint` MDT201 flagged
+    # them as recorded-but-unpinned)
+    "mdtpu_retries_total": "counter",
+    "mdtpu_dropped_frames_total": "counter",
+    "mdtpu_executor_fallbacks_total": "counter",
+    "mdtpu_faults_injected_total": "counter",
+    "mdtpu_lint_rules": "gauge",
+    "mdtpu_lint_findings": "gauge",
 }
 
 
@@ -590,3 +600,49 @@ def test_bench_watch_derived_horizon(tmp_path):
         for p in glob.glob(os.path.join(REPO, ".bench_data",
                                         "flagship_2000a_96f_*")):
             os.remove(p)
+
+
+#: The static-analysis rule catalog (docs/LINT.md), pinned like the
+#: metric schema above: a rule rename or drop is a contract change for
+#: every baseline file and suppression pragma in the field, so it must
+#: be loud here — not discovered when a baseline silently stops
+#: matching.
+PINNED_LINT_RULES = (
+    # concurrency discipline (MDT0xx)
+    "MDT001",   # unlocked-shared-state (PR-5 PhaseTimers race)
+    "MDT002",   # notify-with-multiple-waiters (PR-7 lost-wakeup)
+    "MDT003",   # fencing-swallow (WorkerFenced/InjectedWorkerDeath)
+    "MDT004",   # thread-daemon-discipline
+    # jit/jaxpr contracts (MDT1xx)
+    "MDT101",   # host-side-effect-in-traced
+    "MDT102",   # global-state-in-traced
+    "MDT110",   # one-psum-per-scan (lowering tier)
+    "MDT111",   # captured-constant-budget (lowering tier)
+    # schema drift (MDT2xx)
+    "MDT201",   # metric-not-pinned
+    "MDT202",   # pinned-metric-unregistered
+    "MDT203",   # metric-undocumented
+    "MDT204",   # span-undocumented
+    "MDT205",   # bench-key-drift
+)
+
+
+def test_lint_rule_ids_pinned():
+    sys.path.insert(0, REPO)
+    from mdanalysis_mpi_tpu.lint import rule_ids
+
+    assert rule_ids() == tuple(sorted(PINNED_LINT_RULES))
+
+
+def test_lint_tree_clean():
+    """`python -m mdanalysis_mpi_tpu lint` exits 0 on this repo: zero
+    unbaselined findings from the fast AST+schema passes against the
+    committed baseline — the in-process twin of the CLI acceptance
+    gate, running in tier-1 so a regression is caught pre-commit."""
+    sys.path.insert(0, REPO)
+    from mdanalysis_mpi_tpu.lint import run_lint
+
+    report = run_lint(root=REPO, baseline=os.path.join(
+        REPO, ".mdtpu_lint_baseline.json"))
+    assert report.clean, "\n".join(
+        f.render() for f in report.findings)

@@ -1,0 +1,609 @@
+"""`mdtpu lint` — per-rule fixtures, seeded-bug corpus, tree self-check.
+
+Three layers (docs/LINT.md):
+
+- **Per-rule minimal fixtures** — each rule gets the smallest positive
+  that fires it and the nearest negative that must not.
+- **Seeded-bug corpus** — the historical bugs the rules encode,
+  REINTRODUCED into the real modules' source: stripping the PR-5
+  ``PhaseTimers.phase`` lock must trip MDT001; reverting the PR-7
+  ``submit()`` ``notify_all()`` to ``notify()`` must trip MDT002.
+- **Tree self-check** — the repo lints clean (zero unbaselined
+  findings) with the fast AST+schema passes; rule-id pinning lives in
+  ``tests/test_bench_contract.py``.
+"""
+
+import ast
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from mdanalysis_mpi_tpu.lint import concurrency, jaxcontracts, schema  # noqa: E402
+from mdanalysis_mpi_tpu.lint.core import (  # noqa: E402
+    Baseline, Finding, pragma_suppressed, rule_ids, run_lint,
+)
+
+
+def _rules(findings):
+    return {f.rule for f in findings}
+
+
+def _check(src: str, rel: str = "mdanalysis_mpi_tpu/service/mod.py"):
+    tree = ast.parse(src)
+    return (concurrency.check_module(tree, rel)
+            + jaxcontracts.check_module(tree, rel))
+
+
+# ---------------------------------------------------- MDT001 lock discipline
+
+_LOCKED_CLASS = """
+import threading
+
+class Counters:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._acc = dict()
+
+    def bump(self, name):
+        with self._lock:
+            self._acc[name] = self._acc.get(name, 0) + 1
+
+    def {method}(self, name):
+{body}
+"""
+
+
+def test_mdt001_positive_unlocked_rmw():
+    src = _LOCKED_CLASS.format(
+        method="racy",
+        body="        self._acc[name] = self._acc.get(name, 0) + 1")
+    found = [f for f in _check(src) if f.rule == "MDT001"]
+    assert len(found) == 1
+    assert found[0].symbol == "Counters.racy"
+    assert found[0].detail == "_acc"
+
+
+def test_mdt001_negative_locked_everywhere():
+    src = _LOCKED_CLASS.format(
+        method="fine",
+        body="        with self._lock:\n"
+             "            self._acc[name] = 0")
+    assert "MDT001" not in _rules(_check(src))
+
+
+def test_mdt001_negative_locked_suffix_convention():
+    # caller-holds-lock helpers are exempt by the `_locked` suffix
+    src = _LOCKED_CLASS.format(
+        method="clear_locked",
+        body="        self._acc[name] = 0")
+    assert "MDT001" not in _rules(_check(src))
+
+
+def test_mdt001_negative_init_and_unshared():
+    # __init__ writes and attrs never mutated under the lock are fine
+    src = _LOCKED_CLASS.format(
+        method="other",
+        body="        self.unrelated = name")
+    assert "MDT001" not in _rules(_check(src))
+
+
+def test_mdt001_mutating_calls_count():
+    src = _LOCKED_CLASS.format(
+        method="racy",
+        body="        self._acc.update(dict(name=1))")
+    found = [f for f in _check(src) if f.rule == "MDT001"]
+    assert len(found) == 1 and found[0].detail == "_acc"
+
+
+# ------------------------------------------------- MDT002 condition wakeups
+
+_COND_CLASS = """
+import threading
+
+class Q:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._items = []
+
+    def put(self, x):
+        with self._cond:
+            self._items.append(x)
+            self._cond.{wake}()
+
+    def get(self):
+        with self._cond:
+            while not self._items:
+                self._cond.wait()
+            return self._items.pop()
+
+    def drain(self):
+        with self._cond:
+            self._cond.wait_for(lambda: not self._items)
+"""
+
+
+def test_mdt002_positive_notify_two_waiters():
+    found = [f for f in _check(_COND_CLASS.format(wake="notify"))
+             if f.rule == "MDT002"]
+    assert len(found) == 1
+    assert found[0].symbol == "Q.put"
+
+
+def test_mdt002_negative_notify_all():
+    assert "MDT002" not in _rules(_check(_COND_CLASS.format(
+        wake="notify_all")))
+
+
+def test_mdt002_negative_single_waiter():
+    # one wait site: a single wakeup cannot land on the wrong waiter
+    src = _COND_CLASS.format(wake="notify").replace(
+        "    def drain(self):\n"
+        "        with self._cond:\n"
+        "            self._cond.wait_for(lambda: not self._items)\n", "")
+    assert "MDT002" not in _rules(_check(src))
+
+
+# --------------------------------------------------- MDT003 fencing swallow
+
+def test_mdt003_positive_bare_except_in_service():
+    src = ("def loop():\n"
+           "    try:\n"
+           "        work()\n"
+           "    except BaseException:\n"
+           "        pass\n")
+    found = [f for f in _check(src) if f.rule == "MDT003"]
+    assert len(found) == 1 and found[0].symbol == "loop"
+
+
+def test_mdt003_negative_reraise_or_fencing_aware():
+    reraise = ("def loop():\n"
+               "    try:\n"
+               "        work()\n"
+               "    except BaseException:\n"
+               "        cleanup()\n"
+               "        raise\n")
+    aware = ("def loop():\n"
+             "    try:\n"
+             "        work()\n"
+             "    except BaseException as exc:\n"
+             "        if isinstance(exc, WorkerFenced):\n"
+             "            handle(exc)\n")
+    plain = ("def loop():\n"
+             "    try:\n"
+             "        work()\n"
+             "    except Exception:\n"
+             "        pass\n")
+    for src in (reraise, aware, plain):
+        assert "MDT003" not in _rules(_check(src))
+
+
+def test_mdt003_scoped_to_service_and_reliability():
+    src = ("def loop():\n"
+           "    try:\n"
+           "        work()\n"
+           "    except BaseException:\n"
+           "        pass\n")
+    out_of_scope = (concurrency.check_module(
+        ast.parse(src), "mdanalysis_mpi_tpu/analysis/mod.py"))
+    assert "MDT003" not in _rules(out_of_scope)
+
+
+# ------------------------------------------------ MDT004 thread discipline
+
+def test_mdt004_positive_and_negative():
+    pos = "import threading\nt = threading.Thread(target=f)\n"
+    neg = ("import threading\n"
+           "t = threading.Thread(target=f, daemon=True)\n"
+           "u = threading.Thread(target=f, daemon=False)\n")
+    assert "MDT004" in _rules(_check(pos))
+    assert "MDT004" not in _rules(_check(neg))
+
+
+# --------------------------------------------- MDT101/102 traced host effects
+
+_TRACED = """
+import jax
+import jax.numpy as jnp
+import numpy as np
+import time
+
+def kernel(params, x):
+{kbody}
+    return out
+
+def untraced(x):
+    return np.asarray(x)          # host helper: NOT traced
+
+fn = jax.jit(kernel)
+"""
+
+
+def test_mdt101_positive_np_time_print_item():
+    for body, detail in (
+            ("    out = np.asarray(x)", "np.asarray"),
+            ("    t = time.perf_counter()\n    out = x * t",
+             "time.perf_counter"),
+            ("    print(x)\n    out = x", "print"),
+            ("    out = x.sum().item()", ".item")):
+        found = [f for f in _check(_TRACED.format(kbody=body))
+                 if f.rule == "MDT101"]
+        assert found, body
+        assert found[0].detail == detail
+        assert found[0].symbol == "kernel"
+        # the host helper outside the trace is never flagged
+        assert all(f.symbol != "untraced" for f in found)
+
+
+def test_mdt101_negative_pure_jnp():
+    src = _TRACED.format(kbody="    out = jnp.sum(x) * params")
+    assert "MDT101" not in _rules(_check(src))
+
+
+def test_mdt101_traces_through_wrappers_and_callgraph():
+    src = """
+import jax
+import numpy as np
+
+def _prec(f):
+    return f
+
+def helper(x):
+    return np.log(x)              # reached via kernel -> helper
+
+def kernel(params, x):
+    return helper(x)
+
+fn = jax.jit(_prec(kernel))
+"""
+    found = [f for f in _check(src) if f.rule == "MDT101"]
+    assert [f.symbol for f in found] == ["helper"]
+
+
+def test_mdt101_scan_body_is_traced():
+    src = """
+import jax
+import time
+
+def outer(xs):
+    def step(carry, x):
+        time.sleep(0)             # host effect inside the scan body
+        return carry + x, None
+    acc, _ = jax.lax.scan(step, 0.0, xs)
+    return acc
+"""
+    found = [f for f in _check(src) if f.rule == "MDT101"]
+    assert found and found[0].symbol == "outer.step"
+
+
+def test_mdt102_global_in_traced():
+    src = """
+import jax
+
+COUNT = 0
+
+def kernel(x):
+    global COUNT
+    COUNT += 1
+    return x
+
+def host_counter():
+    global COUNT
+    COUNT += 1
+
+fn = jax.jit(kernel)
+"""
+    found = [f for f in _check(src) if f.rule == "MDT102"]
+    assert [f.symbol for f in found] == ["kernel"]
+
+
+# ------------------------------------------------ MDT110/111 jaxpr contracts
+
+def test_mdt110_positive_psum_inside_scan_body():
+    jax = pytest.importorskip("jax")
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from mdanalysis_mpi_tpu.parallel.executors import _shard_map
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >=2 (virtual) devices")
+    shard_map = _shard_map()
+    devs = jax.devices()[:2]
+    mesh = Mesh(np.asarray(devs), ("d",))
+
+    import jax.numpy as jnp
+
+    def bad(xs):                    # psum INSIDE the scan body: K merges
+        def step(carry, x):
+            return carry + jax.lax.psum(x, "d"), None
+
+        acc, _ = jax.lax.scan(step, jnp.zeros_like(xs[0]), xs)
+        return acc
+
+    def good(xs):                   # local accumulation, ONE merge
+        def step(carry, x):
+            return carry + x, None
+
+        acc, _ = jax.lax.scan(step, jnp.zeros_like(xs[0]), xs)
+        return jax.lax.psum(acc, "d")
+
+    xs = np.zeros((4, 2), np.float32)
+    f_bad = shard_map(bad, mesh=mesh, in_specs=(P(None, "d"),),
+                      out_specs=P())
+    f_good = shard_map(good, mesh=mesh, in_specs=(P(None, "d"),),
+                       out_specs=P())
+    assert jaxcontracts.scan_psum_violations(jax.make_jaxpr(f_bad)(xs))
+    assert not jaxcontracts.scan_psum_violations(
+        jax.make_jaxpr(f_good)(xs))
+
+
+def test_mdt110_real_mesh_scan_program_clean():
+    """Acceptance: the registered mesh scan program verifies
+    one-psum-per-scan via CPU lowering — no TPU required."""
+    jax = pytest.importorskip("jax")
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    notes = []
+    findings = jaxcontracts.check_lowered_programs(notes)
+    assert findings == []
+    assert any("3 programs" in n for n in notes)
+
+
+def test_mdt111_captured_constant_budget():
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+    import numpy as np
+
+    big = np.zeros((1 << 19,), np.float32)          # 2 MiB
+
+    def baked(x):
+        return x + jnp.asarray(big)
+
+    def argpassed(x, c):
+        return x + c
+
+    x = np.zeros((1 << 19,), np.float32)
+    j_bad = jax.make_jaxpr(baked)(x)
+    j_good = jax.make_jaxpr(argpassed)(x, big)
+    assert jaxcontracts.captured_const_bytes(j_bad) \
+        > jaxcontracts.CONST_BUDGET_BYTES
+    assert jaxcontracts.captured_const_bytes(j_good) \
+        <= jaxcontracts.CONST_BUDGET_BYTES
+
+
+# ------------------------------------------------------ MDT20x schema drift
+
+def _schema_repo(tmp_path, *, recorded="mdtpu_widgets_total",
+                 pinned='{"mdtpu_widgets_total": "counter"}',
+                 doc="`mdtpu_widgets_total` and the `stage` / `run` "
+                     "span with `lease_reaped` instants",
+                 span="stage", bench_keys=("metric",),
+                 bench_src='rec = {"metric": 1}\n'):
+    root = tmp_path
+    pkg = root / "mdanalysis_mpi_tpu"
+    (pkg / "obs").mkdir(parents=True)
+    (pkg / "__init__.py").write_text("")
+    (pkg / "obs" / "__init__.py").write_text("")
+    (pkg / "obs" / "metrics.py").write_text(
+        "COMPILE_METRICS = ()\n")
+    (pkg / "rec.py").write_text(
+        f'from x import METRICS, phase\n'
+        f'METRICS.inc("{recorded}")\n'
+        f'with phase("{span}"):\n    pass\n')
+    (root / "tests").mkdir()
+    (root / "tests" / "test_bench_contract.py").write_text(
+        f"PINNED_METRICS = {pinned}\n"
+        f"def test_keys():\n"
+        f"    rec = {{}}\n"
+        f"    for key in ({', '.join(repr(k) for k in bench_keys)},):\n"
+        f"        assert key in rec\n")
+    (root / "docs").mkdir()
+    (root / "docs" / "OBSERVABILITY.md").write_text(doc + "\n")
+    (root / "bench.py").write_text(bench_src)
+    notes = []
+    return schema.check_repo(str(root), notes), notes
+
+
+def test_schema_pass_clean_on_aligned_repo(tmp_path):
+    findings, _ = _schema_repo(tmp_path)
+    assert findings == []
+
+
+def test_mdt201_recorded_but_not_pinned(tmp_path):
+    findings, _ = _schema_repo(tmp_path, pinned="{}")
+    assert {"MDT201"} <= _rules(findings)
+    assert any(f.detail == "mdtpu_widgets_total" for f in findings
+               if f.rule == "MDT201")
+
+
+def test_mdt202_pinned_but_unregistered(tmp_path):
+    findings, _ = _schema_repo(
+        tmp_path,
+        pinned='{"mdtpu_widgets_total": "counter", '
+               '"mdtpu_ghost_total": "counter"}')
+    assert any(f.rule == "MDT202" and f.detail == "mdtpu_ghost_total"
+               for f in findings)
+
+
+def test_mdt203_recorded_but_undocumented(tmp_path):
+    findings, _ = _schema_repo(
+        tmp_path, doc="`stage` spans only, with `lease_reaped`")
+    assert any(f.rule == "MDT203"
+               and f.detail == "mdtpu_widgets_total" for f in findings)
+
+
+def test_mdt203_brace_families_and_labels_expand(tmp_path):
+    # {a,b} families expand; {label} annotations are stripped
+    findings, _ = _schema_repo(
+        tmp_path, recorded="mdtpu_jobs_done_total",
+        pinned='{"mdtpu_jobs_done_total": "counter"}',
+        doc="`mdtpu_jobs_{done,failed}_total{backend}` plus spans "
+            "`stage` `run` `lease_reaped`")
+    assert "MDT203" not in _rules(findings)
+
+
+def test_mdt204_span_undocumented(tmp_path):
+    findings, _ = _schema_repo(tmp_path, span="mystery_phase")
+    assert any(f.rule == "MDT204" and f.detail == "mystery_phase"
+               for f in findings)
+
+
+def test_mdt205_bench_key_drift(tmp_path):
+    findings, _ = _schema_repo(
+        tmp_path, bench_keys=("metric", "vanished_field"))
+    assert any(f.rule == "MDT205" and f.detail == "vanished_field"
+               for f in findings)
+
+
+# --------------------------------------------------------- seeded-bug corpus
+
+def test_seeded_pr5_phasetimers_race_trips_mdt001():
+    """Reintroducing the PR-5 race — PhaseTimers.phase accumulating
+    into the shared dicts WITHOUT the lock — must trip MDT001."""
+    path = os.path.join(REPO, "mdanalysis_mpi_tpu", "utils",
+                        "timers.py")
+    with open(path) as f:
+        src = f.read()
+    clean = concurrency.check_module(
+        ast.parse(src), "mdanalysis_mpi_tpu/utils/timers.py")
+    assert "MDT001" not in _rules(clean)    # the fixed tree is clean
+
+    locked = ("            with self._lock:\n"
+              "                self._acc[name] = "
+              "self._acc.get(name, 0.0) + dt\n"
+              "                self._calls[name] = "
+              "self._calls.get(name, 0) + 1")
+    racy = ("            self._acc[name] = "
+            "self._acc.get(name, 0.0) + dt\n"
+            "            self._calls[name] = "
+            "self._calls.get(name, 0) + 1")
+    assert locked in src, "seed site moved; update the fixture"
+    seeded = src.replace(locked, racy)
+    found = [f for f in concurrency.check_module(
+        ast.parse(seeded), "mdanalysis_mpi_tpu/utils/timers.py")
+        if f.rule == "MDT001"]
+    assert {f.detail for f in found} == {"_acc", "_calls"}
+    assert all(f.symbol == "PhaseTimers.phase" for f in found)
+
+
+def test_seeded_pr7_notify_lost_wakeup_trips_mdt002():
+    """Reverting Scheduler.submit's notify_all() to notify() — the
+    PR-7 lost-wakeup — must trip MDT002."""
+    path = os.path.join(REPO, "mdanalysis_mpi_tpu", "service",
+                        "scheduler.py")
+    with open(path) as f:
+        src = f.read()
+    rel = "mdanalysis_mpi_tpu/service/scheduler.py"
+    assert "MDT002" not in _rules(
+        concurrency.check_module(ast.parse(src), rel))
+
+    assert "self._cond.notify_all()" in src
+    seeded = src.replace("self._cond.notify_all()",
+                         "self._cond.notify()", 1)
+    found = [f for f in concurrency.check_module(
+        ast.parse(seeded), rel) if f.rule == "MDT002"]
+    assert found and all(f.detail == "_cond" for f in found)
+
+
+# ----------------------------------------------- suppression: pragma+baseline
+
+def test_pragma_suppresses_line():
+    f = Finding("MDT004", "m.py", 2, "mod", "msg", "Thread")
+    lines = ["import threading",
+             "t = threading.Thread(target=f)  # mdtpu-lint: "
+             "disable=MDT004"]
+    assert pragma_suppressed(lines, f)
+    assert not pragma_suppressed(
+        ["import threading", "t = threading.Thread(target=f)"], f)
+
+
+def test_baseline_requires_justification():
+    f = Finding("MDT205", "tests/test_bench_contract.py", 0,
+                "test_bench_json_contract", "msg", "some_key")
+    todo = Baseline.from_findings([f])
+    assert not todo.match(f)        # TODO entries never suppress
+    justified = Baseline.from_findings([f], justification="dynamic key")
+    assert justified.match(f)
+    # round-trips through disk
+    assert justified.entries[0]["justification"] == "dynamic key"
+
+
+def test_baseline_save_load_roundtrip(tmp_path):
+    f = Finding("MDT205", "p.py", 0, "s", "m", "k")
+    b = Baseline.from_findings([f], justification="because")
+    path = str(tmp_path / "base.json")
+    b.save(path)
+    assert Baseline.load(path).match(f)
+
+
+# ------------------------------------------------------- tree-wide self-check
+
+def test_tree_lints_clean():
+    """The repo itself: zero unbaselined findings from the fast
+    passes, with the committed baseline."""
+    report = run_lint(root=REPO, baseline=os.path.join(
+        REPO, ".mdtpu_lint_baseline.json"))
+    assert report.clean, "\n".join(f.render() for f in report.findings)
+    assert report.files > 100
+    # the committed baseline is small and fully justified
+    assert len(report.baselined) == 2
+
+
+@pytest.mark.slow
+def test_cli_fast_mode_is_jax_free(tmp_path):
+    """`python -m mdanalysis_mpi_tpu lint --json`: exit 0 on the repo,
+    and the fast mode never imports jax (the <30 s pre-jax gate)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "mdanalysis_mpi_tpu", "lint", "--json",
+         "--root", REPO],
+        env=env, capture_output=True, text=True, timeout=120, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["clean"] is True
+    assert doc["jax_imported"] is False
+    assert doc["n_baselined"] == 2
+    assert sorted(doc["rules"]) == list(rule_ids())
+
+
+def test_cli_rejects_unknown_rule_ids(capsys):
+    """A typo'd --rules id must be a usage error (exit 2), not a
+    silently-empty filter that leaves a CI gate permanently green."""
+    from mdanalysis_mpi_tpu.lint.cli import lint_main
+
+    assert lint_main(["--rules", "MDT01,MDT004", "--root", REPO]) == 2
+    assert "MDT01" in capsys.readouterr().err
+
+
+def test_cli_baseline_write_is_idempotent(tmp_path):
+    """Re-running --baseline-write (TODO entries don't suppress, so
+    the findings come back) must not append duplicate entries."""
+    from mdanalysis_mpi_tpu.lint.cli import lint_main
+
+    base = str(tmp_path / "base.json")
+    for _ in range(2):
+        assert lint_main(["--rules", "MDT205", "--root", REPO,
+                          "--baseline", base,
+                          "--baseline-write"]) == 0
+    with open(base) as f:
+        entries = json.load(f)["findings"]
+    assert len(entries) == 2            # the two cold_* keys, once
+
+
+def test_cli_list_rules_and_rule_count():
+    from mdanalysis_mpi_tpu.lint import all_rules
+
+    rules = all_rules()
+    assert len(rules) >= 8
+    for rule in rules.values():
+        assert rule.summary and rule.history
+    assert {r.family for r in rules.values()} == {
+        "concurrency", "jit", "jaxpr", "schema"}

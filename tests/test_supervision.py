@@ -316,6 +316,34 @@ def test_breaker_trip_halfopen_probe_recovery_unit():
     assert "mdtpu_breaker_transitions_total" in snap
 
 
+def test_breaker_probe_reraises_fencing_base_exceptions():
+    """`mdtpu lint` MDT003 regression: a half-open probe that dies on
+    BaseException-based control flow (a WorkerFenced fence firing at a
+    phase entry inside the probe fn, an injected worker death) must
+    record the failure AND keep unwinding the worker thread — the old
+    blanket `except BaseException: return False` swallowed the fence,
+    so a reaped zombie kept running its loop instead of exiting."""
+    from mdanalysis_mpi_tpu.service.supervision import WorkerFenced
+
+    clock = _FakeClock()
+    br = breaker.CircuitBreaker(("jax", None), threshold=1,
+                                cooldown_s=1.0, clock=clock)
+    br.record_failure()
+    clock.t += 1.1
+    assert br.state == breaker.HALF_OPEN
+    with pytest.raises(WorkerFenced):
+        br.probe(lambda: (_ for _ in ()).throw(
+            WorkerFenced("reaped mid-probe")))
+    # the failed attempt still re-opened the breaker on its way out
+    assert br.state == breaker.OPEN
+    # ordinary Exceptions keep the old contract: swallowed, False
+    clock.t += 1.1
+    assert br.state == breaker.HALF_OPEN
+    assert br.probe(lambda: (_ for _ in ()).throw(
+        faults.DeviceLossError("still dead"))) is False
+    assert br.state == breaker.OPEN
+
+
 def test_breaker_routes_claims_off_tripped_backend_then_recovers():
     """K consecutive dispatch faults trip the jax breaker; while open,
     new claims route DOWN to serial (and still complete); after the
