@@ -62,6 +62,16 @@ class BlockCache:
         self.max_bytes = max_bytes
         self.hits = 0
         self.misses = 0
+        # host-side stage-time fingerprints (utils/integrity.py
+        # staged_fingerprint), keyed like _store — the SDC scrubber's
+        # reference copy (docs/RELIABILITY.md §5).  Entries without
+        # one (multi-host slices, pre-integrity callers) are never
+        # scrubbed.
+        self._fps: dict = {}
+        #: high-water mark of stored + reserved bytes — the staged
+        #: admission pressure gauge (`mdtpu_staged_bytes_peak`) the
+        #: memory watchdog reads
+        self.bytes_peak = 0
 
     def get(self, key):
         with self._lock:
@@ -86,6 +96,11 @@ class BlockCache:
                 self._store[key] = value
                 self._sizes[key] = nbytes
                 self._bytes += nbytes - freed
+                # a replaced entry's fingerprint no longer describes
+                # the stored value; the caller re-notes the fresh one
+                self._fps.pop(key, None)
+                self.bytes_peak = max(self.bytes_peak,
+                                      self._bytes + self._reserved)
                 return True
             # the cache just refused a block: record it, so `full`
             # flips even when _bytes never lands exactly on the cap
@@ -107,6 +122,7 @@ class BlockCache:
         with self._lock:
             self._store.clear()
             self._sizes.clear()
+            self._fps.clear()
             self._bytes = 0
             self._rejected = False
             self._reserved = 0
@@ -130,6 +146,8 @@ class BlockCache:
         with self._lock:
             if nbytes <= self.max_bytes - self._bytes - self._reserved:
                 self._reserved += nbytes
+                self.bytes_peak = max(self.bytes_peak,
+                                      self._bytes + self._reserved)
                 return True
             return False
 
@@ -182,9 +200,54 @@ class BlockCache:
                         if self._key_ns(k) not in self._pinned_ns]:
                 evicted.append(self._store.pop(key))
                 self._bytes -= self._sizes.pop(key)
+                self._fps.pop(key, None)
             if evicted:
                 self._rejected = False
             return evicted
+
+    # ---- SDC-scrub fingerprint hooks (docs/RELIABILITY.md §5) ----
+
+    def note_fingerprint(self, key, fp, expect=None) -> None:
+        """Record the host-side stage-time fingerprint of a stored
+        entry (``utils.integrity.staged_fingerprint`` tuple).  No-op
+        for keys not currently stored — the entry may already have
+        been evicted/overwritten by the time the stager gets here.
+        ``expect`` (identity-compared, like :meth:`quarantine`) pins
+        the fingerprint to the VALUE it describes: a racing same-key
+        put must not end up paired with the loser's fingerprint — the
+        scrubber would falsely quarantine a clean block."""
+        with self._lock:
+            if key in self._store and (
+                    expect is None or self._store[key] is expect):
+                self._fps[key] = tuple(fp)
+
+    def fingerprint(self, key):
+        with self._lock:
+            return self._fps.get(key)
+
+    def scrub_items(self) -> list:
+        """Snapshot of ``(key, value, fingerprint)`` for every stored
+        entry that carries a fingerprint — what one scrub pass
+        verifies."""
+        with self._lock:
+            return [(k, self._store[k], self._fps[k])
+                    for k in list(self._store) if k in self._fps]
+
+    def quarantine(self, key, expect) -> bool:
+        """Drop ``key`` iff it still stores ``expect`` (identity): the
+        scrubber's remove path, raced safely against concurrent
+        overwrites.  Returns whether the entry was removed; subclasses
+        release device buffers on True."""
+        with self._lock:
+            if self._store.get(key) is not expect:
+                return False
+            self._store.pop(key)
+            self._bytes -= self._sizes.pop(key, 0)
+            self._fps.pop(key, None)
+            # freed budget: the cache accepts inserts again, so the
+            # re-staged replacement block has somewhere to land
+            self._rejected = False
+            return True
 
 
 #: Host staged-block cache (``ReaderBase.stage_cached``).

@@ -13,6 +13,9 @@ are caught at review time instead of in chaos suites:
 - :mod:`~mdanalysis_mpi_tpu.lint.concurrency` — MDT0xx: lock
   discipline, condition-variable wakeups, fencing-exception flow,
   thread daemon/join hygiene.  Pure stdlib :mod:`ast`.
+- :mod:`~mdanalysis_mpi_tpu.lint.persistence` — MDT005: non-atomic
+  artifact writes in the persistence modules (the tmp→fsync→rename
+  convention of docs/RELIABILITY.md §5).  Pure stdlib :mod:`ast`.
 - :mod:`~mdanalysis_mpi_tpu.lint.jaxcontracts` — MDT1xx: host side
   effects inside jit/shard_map/scan-traced code (AST call-graph walk),
   plus lowering-based jaxpr contracts (one psum per mesh scan,

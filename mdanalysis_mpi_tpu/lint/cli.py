@@ -33,7 +33,8 @@ def _parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="mdanalysis_mpi_tpu lint",
         description="repo-native static analysis: concurrency "
-                    "discipline, jit/jaxpr contracts, schema drift "
+                    "discipline, persistence atomicity, jit/jaxpr "
+                    "contracts, schema drift "
                     "(docs/LINT.md)")
     p.add_argument("--root", default=None,
                    help="repo root to lint (default: the installed "

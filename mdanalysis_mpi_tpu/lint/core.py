@@ -38,7 +38,8 @@ class Rule:
 
     id: str
     name: str
-    family: str            # "concurrency" | "jit" | "jaxpr" | "schema"
+    family: str            # "concurrency" | "persistence" | "jit"
+    #                        # | "jaxpr" | "schema"
     summary: str
     history: str
     needs_jax: bool = False
@@ -77,7 +78,7 @@ def register(rule: Rule) -> Rule:
 def _load_passes() -> None:
     # import for side effect: each pass module registers its rules
     from mdanalysis_mpi_tpu.lint import (  # noqa: F401
-        concurrency, jaxcontracts, schema,
+        concurrency, jaxcontracts, persistence, schema,
     )
 
 
@@ -221,7 +222,9 @@ def run_lint(root: str | None = None, rules=None, jaxpr: bool = False,
     also run the lowering-based MDT11x contracts (imports jax).
     ``baseline``: a :class:`Baseline` or a path to one.
     """
-    from mdanalysis_mpi_tpu.lint import concurrency, jaxcontracts, schema
+    from mdanalysis_mpi_tpu.lint import (
+        concurrency, jaxcontracts, persistence, schema,
+    )
 
     root = find_repo_root(root)
     pkg = os.path.join(root, "mdanalysis_mpi_tpu")
@@ -248,6 +251,7 @@ def run_lint(root: str | None = None, rules=None, jaxpr: bool = False,
             continue
         file_findings = []
         file_findings += concurrency.check_module(tree, rel)
+        file_findings += persistence.check_module(tree, rel)
         file_findings += jaxcontracts.check_module(tree, rel)
         kept = []
         for f in file_findings:

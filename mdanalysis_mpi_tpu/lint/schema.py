@@ -72,6 +72,9 @@ _TABLE_TYPES = {
     "SUPERVISION_COUNTERS": "counter",
     "RELIABILITY_COUNTERS": "counter",
     "LINT_GAUGES": "gauge",
+    "INTEGRITY_COUNTERS": "counter",
+    "INTEGRITY_GAUGES": "gauge",
+    "SCRUB_COUNTERS": "counter",
 }
 
 _RECORD_TYPES = {"inc": "counter", "observe": "histogram",

@@ -137,9 +137,9 @@ _TELEMETRY_COUNTERS = (
     "coalesced_jobs", "coalesce_batches", "solo_jobs",
     "uncoalescable_jobs", "coalesce_fallbacks", "admission_reserved",
     "admission_resident", "admission_deferrals", "admission_uncached",
-    "admission_evictions", "prefetch_jobs", "prefetch_blocks",
-    "prefetch_skipped", "jobs_aborted", "breaker_reroutes",
-    "workers_respawned",
+    "admission_evictions", "admission_shed_serial", "prefetch_jobs",
+    "prefetch_blocks", "prefetch_skipped", "jobs_aborted",
+    "breaker_reroutes", "workers_respawned",
 )
 _TELEMETRY_GAUGES = ("queue_depth", "queue_depth_peak")
 
@@ -199,6 +199,38 @@ LINT_GAUGES = (
     "mdtpu_lint_findings",
 )
 
+#: End-to-end data-integrity counters (utils/integrity.py, the
+#: journal's in-memory degradation, obs' own disclosed write drops —
+#: docs/RELIABILITY.md §5).  Labeled at the incident site
+#: (``artifact=`` / ``sink=``), recorded live; zero-injected so the
+#: healthy-process snapshot carries the full schema.
+INTEGRITY_COUNTERS = (
+    "mdtpu_integrity_write_errors_total",
+    "mdtpu_integrity_verifications_total",
+    "mdtpu_integrity_corrupt_total",
+    "mdtpu_obs_write_errors_total",
+)
+
+#: Integrity gauges: ``mdtpu_integrity_journal_degraded`` flips to 1
+#: when the journal falls back to in-memory on a failed write;
+#: ``mdtpu_staged_bytes_peak`` is the staged-pressure high-water the
+#: scheduler's memory watchdog reads (0 = never under pressure /
+#: no cache attached).
+INTEGRITY_GAUGES = (
+    "mdtpu_integrity_journal_degraded",
+    "mdtpu_staged_bytes_peak",
+)
+
+#: SDC-scrub counters (DeviceBlockCache.scrub, the scheduler's
+#: ``scrub=`` thread — docs/RELIABILITY.md §5): passes run, resident
+#: blocks verified, mismatches quarantined.
+SCRUB_COUNTERS = (
+    "mdtpu_scrub_passes_total",
+    "mdtpu_scrub_blocks_total",
+    "mdtpu_scrub_corrupt_total",
+    "mdtpu_scrub_fetch_errors_total",
+)
+
 
 def unified_snapshot(timers=None, cache=None, telemetry=None,
                      registry: MetricsRegistry | None = None) -> dict:
@@ -219,9 +251,10 @@ def unified_snapshot(timers=None, cache=None, telemetry=None,
     """
     snap = (registry or METRICS).snapshot()
     for name in COMPILE_METRICS + BREAKER_COUNTERS + \
-            SUPERVISION_COUNTERS + RELIABILITY_COUNTERS:
+            SUPERVISION_COUNTERS + RELIABILITY_COUNTERS + \
+            INTEGRITY_COUNTERS + SCRUB_COUNTERS:
         snap.setdefault(name, {"type": "counter", "values": {"": 0}})
-    for name in BREAKER_GAUGES + LINT_GAUGES:
+    for name in BREAKER_GAUGES + LINT_GAUGES + INTEGRITY_GAUGES:
         # 0 == closed (reliability/breaker.py STATE_VALUES): a process
         # that never tripped a breaker reports the healthy state;
         # likewise 0 lint rules/findings means "never linted here"

@@ -315,9 +315,19 @@ def export(path: str | None = None) -> str | None:
     doc = {"traceEvents": events, "displayTimeUnit": "ms",
            "otherData": {"tool": "mdanalysis_mpi_tpu",
                          "dropped_events": dropped}}
-    with _EXPORT_LOCK:
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(doc, f)
-        os.replace(tmp, path)
+    try:
+        with _EXPORT_LOCK:
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+            os.replace(tmp, path)
+    except OSError:
+        # a full disk (or unwritable path) must not fail the RUN the
+        # auto-export piggybacks on — but the drop is counted and
+        # disclosed, never silent (docs/RELIABILITY.md §5;
+        # intra-package import, obs stays stdlib-only externally)
+        from mdanalysis_mpi_tpu.obs.metrics import METRICS
+
+        METRICS.inc("mdtpu_obs_write_errors_total", sink="trace")
+        return None
     return path

@@ -41,6 +41,7 @@ from mdanalysis_mpi_tpu.obs import spans as _spans
 from mdanalysis_mpi_tpu.parallel.partition import iter_batches, pad_batch
 from mdanalysis_mpi_tpu.reliability import faults as _faults
 from mdanalysis_mpi_tpu.utils import compile_cache as _cc
+from mdanalysis_mpi_tpu.utils import integrity as _integrity
 from mdanalysis_mpi_tpu.utils.timers import TIMERS
 
 
@@ -564,6 +565,15 @@ import os as _os
 _DEVICE_GATHER_FRACTION = float(
     _os.environ.get("MDTPU_DEVICE_GATHER_FRACTION", "1.1"))
 
+# Host-side stage-time fingerprints for cached blocks (the SDC-scrub
+# reference copy, docs/RELIABILITY.md §5): per-array zlib CRCs over
+# the staged host bytes, recorded into the DeviceBlockCache beside the
+# entry.  ~GB/s on the host, a fraction of a ms per flagship block —
+# MDTPU_INTEGRITY_FINGERPRINTS=0 opts out for hosts where even that
+# matters.
+_INTEGRITY_FINGERPRINTS = _os.environ.get(
+    "MDTPU_INTEGRITY_FINGERPRINTS", "1") not in ("0", "false", "no")
+
 
 def quantize_block(block: np.ndarray, dtype: str = "int16"):
     """Quantize an (B, S, 3) float32 block to ``dtype`` + inverse scale.
@@ -714,6 +724,10 @@ class DeviceBlockCache(BlockCache):
 
     def __init__(self, max_bytes: int = 4 << 30):
         super().__init__(max_bytes)
+        # rotating scrub cursor: bounded scrub passes (max_entries)
+        # resume where the last one stopped instead of re-verifying
+        # the same head entries forever
+        self._scrub_cursor = 0
 
     def put(self, key, value, nbytes: int) -> bool:
         """Insert, explicitly ``Array.delete()``-ing any entry this
@@ -760,6 +774,90 @@ class DeviceBlockCache(BlockCache):
         for staged in evicted:
             _delete_staged(staged)
         return evicted
+
+    def quarantine(self, key, expect) -> bool:
+        """Scrub-path removal (base semantics) + device-buffer
+        release: a corrupt superblock must leave HBM NOW so the
+        re-stage has budget to land in."""
+        removed = super().quarantine(key, expect)
+        if removed:
+            _delete_staged(expect)
+        return removed
+
+    def scrub(self, max_entries: int | None = None) -> dict:
+        """One SDC-scrub pass (docs/RELIABILITY.md §5): re-fetch every
+        fingerprinted resident entry device→host, recompute its
+        per-array CRCs, and compare against the host-side fingerprint
+        recorded at stage time.  A mismatch — a bit flipped on the
+        host→device wire, in HBM, or in the stacked superblock — is
+        QUARANTINED: the entry (and its device buffers) are dropped,
+        so the next pass over those frames re-stages clean bytes from
+        the source trajectory instead of serving corrupt ones forever.
+
+        Deliberately fetch-heavy: run it on idle cycles (the
+        scheduler's ``scrub=`` thread only scrubs while no worker is
+        mid-run).  Entries without a fingerprint (multi-host slices)
+        are skipped.  Returns ``{"checked", "corrupt", "bytes"}``;
+        outcomes land in ``mdtpu_scrub_*`` metrics and a
+        ``scrub_corrupt`` trace instant per quarantined entry.
+        """
+        from mdanalysis_mpi_tpu import obs
+
+        items = self.scrub_items()
+        if max_entries is not None and items:
+            # rotate: a bounded pass picks up where the previous one
+            # stopped, so every resident entry is eventually verified
+            take = min(max_entries, len(items))
+            with self._lock:
+                start = self._scrub_cursor % len(items)
+                self._scrub_cursor = start + take
+            items = (items + items)[start:start + take]
+        checked = corrupt = nbytes = fetch_errors = 0
+        for key, value, fp in items:
+            try:
+                actual = _integrity.staged_fingerprint(value)
+            except Exception as exc:
+                with self._lock:
+                    still_stored = self._store.get(key) is value
+                if not still_stored:
+                    # entry overwritten/deleted mid-fetch: nothing to
+                    # say about bytes that no longer exist
+                    continue
+                # the entry is still resident and the device refused
+                # the re-fetch (device loss, collapsed link): the
+                # scrubber is BLIND, and a blind protection layer
+                # must say so — never report a clean pass
+                fetch_errors += 1
+                obs.METRICS.inc("mdtpu_scrub_fetch_errors_total")
+                from mdanalysis_mpi_tpu.utils.log import get_logger
+
+                get_logger("mdtpu").warning(
+                    "scrub could not re-fetch resident block %r "
+                    "(%s: %s) — SDC verification is NOT running for "
+                    "this entry", self._key_ns(key),
+                    type(exc).__name__, exc)
+                continue
+            checked += 1
+            nbytes += sum(getattr(x, "nbytes", 0) for x in value)
+            if tuple(actual) == tuple(fp):
+                continue
+            corrupt += 1
+            quarantined = self.quarantine(key, value)
+            obs.METRICS.inc("mdtpu_scrub_corrupt_total")
+            obs.span_event("scrub_corrupt", ns=str(self._key_ns(key)),
+                           quarantined=quarantined)
+            from mdanalysis_mpi_tpu.utils.log import get_logger
+
+            get_logger("mdtpu").error(
+                "SDC detected: cached block %r fails its stage-time "
+                "fingerprint%s — the next pass re-stages it",
+                self._key_ns(key),
+                "" if quarantined else " (already replaced)")
+        obs.METRICS.inc("mdtpu_scrub_passes_total")
+        if checked:
+            obs.METRICS.inc("mdtpu_scrub_blocks_total", checked)
+        return {"checked": checked, "corrupt": corrupt,
+                "bytes": nbytes, "fetch_errors": fetch_errors}
 
 
 class _InlinePool:
@@ -1016,11 +1114,44 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
         # run's reliability report blind to the dropped frames
         return staged, -1 if n_dropped else padded.nbytes
 
-    def _place(staged, key, nbytes):
+    # stage-time integrity fingerprints (docs/RELIABILITY.md §5):
+    # per-array host CRCs recorded beside each cache entry so the SDC
+    # scrubber can re-fetch and compare.  Multi-host slices are never
+    # fingerprinted — the cached global array carries OTHER hosts'
+    # bytes too, and a local-slice fingerprint would false-positive.
+    fingerprinting = (_INTEGRITY_FINGERPRINTS and cache is not None
+                      and local_divisor == 1)
+    # scan-group accumulator: gi -> (blocks_chained, per-array crcs).
+    # _stack_staged stacks each leaf along a new leading axis in block
+    # order, so chaining the per-block CRCs at stage time equals the
+    # fetched superblock's fingerprint — no device fetch needed here.
+    group_fp_acc: dict = {}
+
+    def _place(staged, key, nbytes, bi=None):
         """Device side: transfer a host-staged tuple and cache it
         (``key=None`` — the scan-folded schedule's per-block transfers
         — skips the cache: the group's STACKED superblock is the entry,
-        written by _note_block_done when the group completes)."""
+        written by _note_block_done when the group completes).  Also
+        the integrity boundary: the host-side fingerprint is computed
+        HERE, before the transfer, and the ``bitflip`` SDC fault site
+        fires between the two — a corrupted device copy under a clean
+        fingerprint, which is exactly what the scrubber must catch."""
+        fp = None
+        if fingerprinting and nbytes >= 0 and not cache.full:
+            # a full cache will refuse the insert (scan groups check
+            # the same flag in _note_block_done): don't pay the CRC
+            # for a fingerprint nothing would store
+            if key is not None:
+                fp = _integrity.staged_fingerprint(staged)
+            elif scan_active and bi is not None:
+                gi = block_group[bi]
+                n, crcs = group_fp_acc.get(gi, (0, None))
+                group_fp_acc[gi] = (
+                    n + 1, _integrity.staged_fingerprint(staged, crcs))
+        if _faults.plans():
+            first = _faults.fire("bitflip", array=staged[0])
+            if first is not staged[0]:
+                staged = (first,) + tuple(staged[1:])
 
         def _put():
             if _faults.plans():
@@ -1035,7 +1166,8 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
             # multi-host the staged slice is already 1/local_divisor of
             # the global batch, and a global sharded array keeps exactly
             # those bytes resident per host)
-            cache.put(key, staged, nbytes)
+            if cache.put(key, staged, nbytes) and fp is not None:
+                cache.note_fingerprint(key, fp, expect=staged)
         return staged
 
     # trace-context hand-off: `prepare` runs on the prefetch thread,
@@ -1044,13 +1176,14 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
     # spans carry the same job ids as the dispatch spans they overlap
     trace_ctx = _spans.current_context()
 
-    def prepare(ab):
+    def prepare(bi):
         """Host side of one batch: read+gather (+quantize) and enqueue
         the device transfer.  Runs on the prefetch thread so the next
         batch stages while the device consumes the current one (the
         double-buffering from SURVEY.md §7 layer 5; NumPy releases the
         GIL for the big copies).  Returns (staged, nbytes); nbytes is 0
         for a cache hit (nothing new resident)."""
+        ab = bounds[bi]
         a, b = ab
         key = None if scan_active else _key(ab)
         if key is not None and cache is not None:
@@ -1060,7 +1193,7 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
         with _spans.saved_context(trace_ctx), \
                 TIMERS.phase("stage", lo=a, hi=b):
             staged, nbytes = _stage_op(frames[a:b])
-        return _place(staged, key, nbytes), nbytes
+        return _place(staged, key, nbytes, bi), nbytes
 
     def _stage_op(batch_frames):
         """_host_stage under the reliability retry/deadline envelope."""
@@ -1164,6 +1297,9 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
                 return
             blocks = pending.pop(gi)
             next_group = gi + 1
+            # the chained stage-time fingerprint (see _place): only
+            # trustworthy when every block of the group contributed
+            fp_n, fp = group_fp_acc.pop(gi, (0, None))
             # nbytes < 0 marks a salvage-shortened block: uncacheable,
             # same rule as the per-block schedule
             if (cache is not None and not cache.full
@@ -1172,6 +1308,9 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
                 if not cache.put(group_keys[gi], stacked,
                                  sum(nb for _, nb in blocks)):
                     _delete_staged(stacked)   # rejected: don't leak HBM
+                elif fp is not None and fp_n == len(blocks):
+                    cache.note_fingerprint(group_keys[gi], fp,
+                                           expect=stacked)
             for s, _ in blocks:
                 _delete_staged(s)
 
@@ -1179,7 +1318,7 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
         staged_blocks = 0
         seq = miss_blocks if scan_active else list(range(len(bounds)))
         for bi in seq:
-            staged, nbytes = prepare(bounds[bi])
+            staged, nbytes = prepare(bi)
             if nbytes:
                 staged_blocks += 1
             if scan_active:
@@ -1206,12 +1345,12 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
         seq = miss_blocks if scan_active else list(range(len(bounds)))
         wire_ctx = _spans.current_context()
 
-        def _wire(staged_host, key, nbytes):
+        def _wire(staged_host, key, nbytes, bi):
             # span context handed to the wire thread so wire spans
             # carry the same job attribution as the stage spans they
             # overlap (the PR-5 prefetch-thread contract)
             with _spans.saved_context(wire_ctx), TIMERS.phase("wire"):
-                return _place(staged_host, key, nbytes)
+                return _place(staged_host, key, nbytes, bi)
 
         with ThreadPoolExecutor(max_workers=1,
                                 thread_name_prefix="mdtpu-wire") as wpool:
@@ -1230,7 +1369,8 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
                         a, b = ab
                         with TIMERS.phase("stage", lo=a, hi=b):
                             sh, nb = _stage_op(frames[a:b])
-                        futs[nxt] = (wpool.submit(_wire, sh, key, nb),
+                        futs[nxt] = (wpool.submit(_wire, sh, key, nb,
+                                                  seq[nxt]),
                                      None, nb)
                     nxt += 1
                 fut, hit, nbytes = futs.pop(i)
@@ -1275,21 +1415,22 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
                 hit = (cache.get(key)
                        if key is not None and cache is not None else None)
                 if hit is not None:
-                    items.append((None, hit, key, 0))
+                    items.append((None, hit, key, 0, bi))
                     continue
                 a, b = ab
                 with TIMERS.phase("stage", lo=a, hi=b):
                     staged_host, nbytes = _stage_op(frames[a:b])
-                items.append((staged_host, None, key, nbytes))
+                items.append((staged_host, None, key, nbytes, bi))
             placed: dict[int, tuple] = {}
             nxt = 0
             last_placed = None
             for i in range(len(items)):
                 while nxt < len(items) and nxt - i < window:
-                    staged_host, staged, key, nbytes = items[nxt]
+                    staged_host, staged, key, nbytes, bi = items[nxt]
                     if staged is None:
                         with TIMERS.phase("wire"):
-                            staged = _place(staged_host, key, nbytes)
+                            staged = _place(staged_host, key, nbytes,
+                                            bi)
                         last_placed = staged
                     placed[nxt] = (staged, nbytes)
                     items[nxt] = None
@@ -1326,11 +1467,11 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
     else:
         seq = miss_blocks if scan_active else list(range(len(bounds)))
         with _staging_pool() as pool:
-            fut = pool.submit(prepare, bounds[seq[0]]) if seq else None
+            fut = pool.submit(prepare, seq[0]) if seq else None
             for j, bi in enumerate(seq):
                 staged, nbytes = fut.result()
                 if j + 1 < len(seq):
-                    fut = pool.submit(prepare, bounds[seq[j + 1]])
+                    fut = pool.submit(prepare, seq[j + 1])
                 if scan_active:
                     _flush_hits_before(block_group[bi])
                 consume(staged)

@@ -43,6 +43,17 @@ Sites (the complete set — grep for ``_faults.fire``):
     warmup-shaped no-op dispatch.  No payload — raise (device-loss,
     the default) keeps the breaker open; not firing lets the probe
     succeed and close it.
+``"bitflip"``
+    Silent-data-corruption injection on the host→device wire
+    (``executors._run_batches._place``), fired AFTER the stage-time
+    integrity fingerprint is computed and BEFORE the device transfer
+    — so the cached device copy is corrupt while the recorded
+    fingerprint describes the clean bytes, exactly the SDC shape the
+    ``DeviceBlockCache.scrub`` pass exists to catch
+    (docs/RELIABILITY.md §5).  Payload: the block's primary staged
+    array; the default action is ``corrupt="bitflip"`` — ONE flipped
+    high bit in element 0, deterministic and sign-bit-sized so parity
+    tests see it loudly if it ever reaches a result.
 
 When no specs are armed, the per-call overhead at a site is one module
 attribute load and a truthiness check (``if _faults.plans(): ...``).
@@ -98,6 +109,7 @@ _DEFAULT_EXC = {
     "kernel": DeviceLossError,
     "worker": InjectedWorkerDeath,
     "probe": DeviceLossError,
+    "bitflip": InjectedTransientError,
 }
 
 
@@ -117,17 +129,32 @@ class FaultSpec:
                  kernel).
     ``stall_s``  sleep duration for ``kind="stall"``.
     ``corrupt``  ``"nan"`` (row → NaN), ``"garbage"`` (row → 1e9 —
-                 trips the max-coordinate sanity check), or
-                 ``"truncate"`` (drop the payload's last row — a short
-                 frame; per-frame payloads only).
+                 trips the max-coordinate sanity check), ``"truncate"``
+                 (drop the payload's last row — a short frame;
+                 per-frame payloads only), or ``"bitflip"`` (XOR the
+                 top bit of element 0's last byte — works on ANY
+                 dtype, including quantized int16 blocks, where it is
+                 the sign bit: a large, deterministic SDC).
+                 ``FaultSpec("bitflip")`` defaults to
+                 ``kind="corrupt", corrupt="bitflip"`` — the one
+                 corrupting site.
     """
 
-    def __init__(self, site: str, kind: str = "raise", *, frames=None,
-                 after: int = 0, times: int | None = 1, exc=None,
-                 stall_s: float = 0.05, corrupt: str = "nan"):
+    def __init__(self, site: str, kind: str | None = None, *,
+                 frames=None, after: int = 0, times: int | None = 1,
+                 exc=None, stall_s: float = 0.05,
+                 corrupt: str | None = None):
+        # per-site defaults resolved from None sentinels, so an
+        # EXPLICIT kind="raise" at the bitflip site stays a raise —
+        # only the omitted defaults flip to the site's natural shape
+        # (corrupt/bitflip for the SDC site, raise/nan elsewhere)
+        if kind is None:
+            kind = "corrupt" if site == "bitflip" else "raise"
+        if corrupt is None:
+            corrupt = "bitflip" if site == "bitflip" else "nan"
         if kind not in ("raise", "stall", "corrupt"):
             raise ValueError(f"unknown fault kind {kind!r}")
-        if corrupt not in ("nan", "garbage", "truncate"):
+        if corrupt not in ("nan", "garbage", "truncate", "bitflip"):
             raise ValueError(f"unknown corruption {corrupt!r}")
         self.site = site
         self.kind = kind
@@ -212,6 +239,15 @@ def _apply_corrupt(spec: FaultSpec, array, frames):
         # short (truncated) frame: only meaningful for per-frame
         # payloads; block payloads lose their last frame row
         return array[:-1]
+    if spec.corrupt == "bitflip":
+        # one flipped high bit in element 0 — dtype-agnostic (the
+        # last byte of a little-endian element is its sign/exponent
+        # byte, so the value change is LARGE and any parity check
+        # that ever sees it fails loudly)
+        out = np.array(array, copy=True)
+        flat = out.view(np.uint8).reshape(-1)
+        flat[out.dtype.itemsize - 1] ^= 0x80
+        return out
     if not np.issubdtype(np.asarray(array).dtype, np.floating):
         # quantized payloads cannot carry NaN; leave them alone (the
         # float32 validation path is where corruption detection lives)

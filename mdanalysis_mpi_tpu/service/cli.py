@@ -70,10 +70,24 @@ def _output_writer(output: str):
     scheduler's journal marks the job finished.  A ``kill -9`` between
     a job's completion and the end of the batch therefore cannot lose
     its output: either the npz is on disk, or the journal still says
-    pending and the restarted process re-runs the job."""
+    pending and the restarted process re-runs the job.
+
+    Integrity (docs/RELIABILITY.md §5): the file is digest-stamped and
+    written tmp→fsync→rename, so a restart can VERIFY it before
+    trusting it.  A write failure (ENOSPC, EIO) fails THE JOB — the
+    typed :class:`~mdanalysis_mpi_tpu.utils.integrity.
+    ArtifactWriteError` lands on ``handle.output_error`` and the job's
+    JSON record reports ``failed`` — never the worker thread (the
+    done-callback contract swallows everything else)."""
+    from mdanalysis_mpi_tpu.utils import integrity
+
     def write(handle):
         if handle.error is None:
-            np.savez(output, **_result_arrays(handle.job.analysis))
+            try:
+                integrity.write_npz_atomic(
+                    output, _result_arrays(handle.job.analysis))
+            except integrity.ArtifactWriteError as exc:
+                handle.output_error = exc
     return write
 
 
@@ -177,22 +191,53 @@ def batch_main(argv=None, universe=None) -> int:
     if ns.journal and _os.path.exists(ns.journal):
         recovered = Scheduler.recover(ns.journal)
 
+    from mdanalysis_mpi_tpu.utils import integrity as _integrity
+
     jobs = []
     build_failures = []
     recovered_records = []
+    outputs_corrupt_rerun = 0
     for i, js in enumerate(spec.get("jobs", [])):
         fp = _job_fingerprint(i, js)
         if recovered is not None:
             state = recovered["jobs"].get(fp, {}).get("state")
             if state in SETTLED_STATES:
-                recovered_records.append({
-                    "analysis": js.get("analysis",
-                                       defaults.get("analysis", "?")),
-                    "tenant": js.get("tenant", "default"),
-                    "state": state, "recovered": True,
-                    "fingerprint": fp,
-                    "output": js.get("output")})
-                continue
+                # trust-but-verify (docs/RELIABILITY.md §5): a "done"
+                # journal record is only as good as the artifact it
+                # points at — a digest mismatch, a torn file, or a
+                # deleted output means the job must RE-RUN, not be
+                # skipped on the journal's word
+                out_path = js.get("output")
+                if state == "done" and out_path:
+                    try:
+                        _integrity.verify_npz(out_path)
+                    except (_integrity.IntegrityError, OSError) as exc:
+                        outputs_corrupt_rerun += 1
+                        print(f"[batch] recovered job {fp} is 'done' "
+                              f"but its output failed verification "
+                              f"({type(exc).__name__}); re-running",
+                              file=sys.stderr)
+                        # fall through to the normal build path below
+                    else:
+                        recovered_records.append({
+                            "analysis": js.get(
+                                "analysis",
+                                defaults.get("analysis", "?")),
+                            "tenant": js.get("tenant", "default"),
+                            "state": state, "recovered": True,
+                            "fingerprint": fp,
+                            "output": out_path,
+                            "output_verified": True})
+                        continue
+                else:
+                    recovered_records.append({
+                        "analysis": js.get(
+                            "analysis", defaults.get("analysis", "?")),
+                        "tenant": js.get("tenant", "default"),
+                        "state": state, "recovered": True,
+                        "fingerprint": fp,
+                        "output": out_path})
+                    continue
         try:
             job, cfg, output = _build_job(js, defaults, u)
             job.fingerprint = fp
@@ -290,7 +335,17 @@ def batch_main(argv=None, universe=None) -> int:
                                 else None),
                "latency_s": (round(handle.latency_s, 4)
                              if handle.latency_s is not None else None)}
-        if handle.error is not None:
+        output_error = getattr(handle, "output_error", None)
+        if handle.error is None and output_error is not None:
+            # the analysis ran, but its artifact never landed (disk
+            # full / I/O error): the JOB is failed — its caller would
+            # otherwise trust an output that does not exist — while
+            # the worker and every other tenant carried on
+            rec["state"] = "failed"
+            rec["error"] = (f"{type(output_error).__name__}: "
+                            f"{output_error}")
+            rc = 1
+        elif handle.error is not None:
             rec["error"] = f"{type(handle.error).__name__}: {handle.error}"
             rc = 1
             diag = getattr(handle.error, "diagnostics", None)
@@ -335,6 +390,9 @@ def batch_main(argv=None, universe=None) -> int:
     if ns.journal:
         out["journal"] = ns.journal
         out["recovered_skipped"] = len(recovered_records)
+        # "done" jobs whose npz failed digest verification at restart:
+        # re-run instead of skipped (docs/RELIABILITY.md §5)
+        out["outputs_corrupt_rerun"] = outputs_corrupt_rerun
     if warmup_stats is not None:
         out["warmup_seconds"] = warmup_stats["seconds"]
         out["warmup_executables"] = warmup_stats["executables"]

@@ -21,6 +21,21 @@ records accumulate or — always — on *terminal* records (``finish`` /
 double-run.  A crash can therefore lose at most the last
 ``fsync_batch`` non-terminal records, which recovery treats as
 "still pending" — jobs re-run, never vanish.
+
+Integrity model (docs/RELIABILITY.md §5): every record carries a
+``crc`` field — CRC32C over its own canonical JSON — and
+:func:`replay` VERIFIES it.  A torn final line (the write the crash
+interrupted) is still skipped, but a record inside the surviving
+prefix that parses and fails its CRC — bit rot, a concurrent writer,
+hand editing — raises a typed
+:class:`~mdanalysis_mpi_tpu.utils.integrity.JournalCorruptError`
+instead of silently replaying corrupt job state.  And a journal whose
+disk fills mid-run DEGRADES instead of killing the scheduler: the
+first ``OSError`` flips the journal to in-memory mode (records land in
+:attr:`JobJournal.memory_records`), counted loudly as
+``mdtpu_integrity_write_errors_total{artifact="journal"}`` plus the
+``mdtpu_integrity_journal_degraded`` gauge — the serving process keeps
+running; only its crash-recovery story is (disclosed as) gone.
 """
 
 from __future__ import annotations
@@ -29,6 +44,9 @@ import json
 import os
 import threading
 import time
+
+from mdanalysis_mpi_tpu.utils import integrity as _integrity
+from mdanalysis_mpi_tpu.utils.log import get_logger
 
 #: Every terminal journal state a ``finish``/``quarantine`` record can
 #: carry.
@@ -61,31 +79,91 @@ class JobJournal:
         self._lock = threading.Lock()
         self._f = open(self.path, "a", encoding="utf-8")
         self._unsynced = 0
+        #: flipped by the first failed write: the journal stopped
+        #: persisting and keeps records in memory instead (loud
+        #: counter + gauge; the scheduler keeps serving)
+        self.degraded = False
+        #: records accepted after degradation — still inspectable in
+        #: process, just no longer crash-durable.  BOUNDED: a serving
+        #: process can outlive its full disk by days, and the
+        #: disk-exhaustion incident must not morph into a memory-
+        #: exhaustion crash — past the cap the oldest records drop,
+        #: counted in :attr:`memory_dropped`.
+        self.memory_records: list[dict] = []
+        self.memory_max = 10_000
+        self.memory_dropped = 0
 
     def record(self, ev: str, fingerprint: str | None,
                durable: bool = False, **fields) -> None:
-        """Append one event.  ``durable=True`` forces an immediate
-        fsync (terminal events); otherwise the fsync is batched."""
+        """Append one CRC-framed event.  ``durable=True`` forces an
+        immediate fsync (terminal events); otherwise the fsync is
+        batched.  A write failure (ENOSPC, EIO, ...) degrades the
+        journal to in-memory — counted, never fatal to the worker."""
         rec = {"ev": ev, "fp": fingerprint,
                "t": round(time.time(), 3), **fields}
+        rec["crc"] = _integrity.record_crc(rec)
         line = json.dumps(rec, sort_keys=True) + "\n"
         with self._lock:
             if self._f.closed:
                 return
-            self._f.write(line)
-            self._f.flush()
-            self._unsynced += 1
-            if durable or self._unsynced >= self.fsync_batch:
-                os.fsync(self._f.fileno())
-                self._unsynced = 0
+            if self.degraded:
+                self._remember_locked(rec)
+                return
+            try:
+                self._f.write(line)
+                self._f.flush()
+                self._unsynced += 1
+                if durable or self._unsynced >= self.fsync_batch:
+                    os.fsync(self._f.fileno())
+                    self._unsynced = 0
+            except OSError as exc:
+                self._degrade_locked(rec, exc)
+
+    def _remember_locked(self, rec: dict) -> None:
+        # caller holds self._lock
+        self.memory_records.append(rec)
+        if len(self.memory_records) > self.memory_max:
+            del self.memory_records[0]
+            self.memory_dropped += 1
+
+    def _degrade_locked(self, rec: "dict | None", exc: OSError) -> None:
+        # caller holds self._lock.  The scheduler (and its workers)
+        # must survive a full disk: from here on records accumulate in
+        # memory, and the loss of crash-durability is DISCLOSED — a
+        # pinned counter, a gauge, and a warning — never silent.
+        from mdanalysis_mpi_tpu.obs import METRICS
+
+        self.degraded = True
+        if rec is not None:
+            self._remember_locked(rec)
+        _integrity.note_write_error("journal", self.path)
+        METRICS.set_gauge("mdtpu_integrity_journal_degraded", 1)
+        get_logger("mdtpu.service").warning(
+            "journal %s degraded to in-memory after write failure "
+            "(%s: %s): records are no longer crash-durable",
+            self.path, type(exc).__name__, exc)
 
     def close(self) -> None:
         with self._lock:
             if self._f.closed:
                 return
-            self._f.flush()
-            os.fsync(self._f.fileno())
-            self._f.close()
+            try:
+                self._f.flush()
+                os.fsync(self._f.fileno())
+            except OSError as exc:
+                if not self.degraded:
+                    self._degrade_locked(None, exc)
+            finally:
+                try:
+                    self._f.close()
+                except OSError as exc:
+                    # close() re-attempts the buffered flush; on a
+                    # full disk that raises AGAIN — swallow it (the
+                    # degradation already counted the loss) so
+                    # Scheduler.shutdown() never dies on the exact
+                    # failure the ladder promises to survive
+                    if not self.degraded:
+                        self._degrade_locked(None, exc)
 
     def __enter__(self):
         return self
@@ -104,40 +182,76 @@ def replay(path) -> dict:
     finished), ``claimed`` (a worker took it and no terminal record
     followed — the crash caught it mid-run; it must re-run), or a
     terminal state from the ``finish``/``quarantine`` record.
-    Unparseable lines (the torn tail of a crashed write) are skipped.
+
+    Integrity (docs/RELIABILITY.md §5): every record must verify its
+    CRC32C frame.  Only the FINAL non-empty line may be unparseable —
+    that is the torn write the crash interrupted, and it is skipped;
+    an unparseable *interior* line, a record with no ``crc``, or a
+    record whose CRC mismatches raises a typed
+    :class:`~mdanalysis_mpi_tpu.utils.integrity.JournalCorruptError`:
+    recovery must reject corrupt history, not replay it.  One
+    grandfather clause: a journal where NO record carries a ``crc``
+    was written before CRC framing existed and replays with a warning
+    (an upgrade must not strand a healthy crash journal); a journal
+    where SOME records carry frames and others don't is tampered or
+    torn mid-record and is rejected.
     """
     jobs: dict = {}
-    with open(path, encoding="utf-8") as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                rec = json.loads(line)
-            except ValueError:
-                continue                 # torn write at the crash point
-            fp = rec.get("fp")
-            ev = rec.get("ev")
-            if fp is None or ev is None:
-                continue
-            st = jobs.setdefault(fp, {"state": None, "claims": 0,
-                                      "submits": 0, "requeues": 0,
-                                      "reason": None})
-            if ev == "submit":
-                st["submits"] += 1
-                if st["state"] not in _PROTECTED_STATES:
-                    st["state"] = "queued"
-            elif ev == "claim":
-                st["claims"] += 1
-                if st["state"] not in _PROTECTED_STATES:
-                    st["state"] = "claimed"
-            elif ev == "requeue":
-                st["requeues"] += 1
-                if st["state"] not in _PROTECTED_STATES:
-                    st["state"] = "queued"
-            elif ev == "quarantine":
-                st["state"] = "quarantined"
-                st["reason"] = rec.get("reason")
-            elif ev == "finish":
-                st["state"] = rec.get("state", "done")
+    # errors="replace": a flipped byte that breaks UTF-8 must surface
+    # as an unparseable RECORD (typed rejection / torn-tail skip, per
+    # position), not as a UnicodeDecodeError escaping the replay
+    with open(path, encoding="utf-8", errors="replace") as f:
+        lines = [ln.strip() for ln in f]
+    lines = [(i + 1, ln) for i, ln in enumerate(lines) if ln]
+    parsed: list = []
+    for pos, (lineno, line) in enumerate(lines):
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            if pos == len(lines) - 1:
+                continue         # torn write at the crash point
+            raise _integrity.JournalCorruptError(
+                f"journal {path!r} line {lineno} does not parse but "
+                "is not the torn tail — the file is corrupt, refusing "
+                "to replay it (recover from a backup or delete it to "
+                "start over)", artifact="journal", path=str(path))
+        parsed.append((lineno, rec))
+    legacy = parsed and all(rec.get("crc") is None
+                            for _, rec in parsed)
+    if legacy:
+        get_logger("mdtpu.service").warning(
+            "journal %s carries no CRC frames (written before "
+            "integrity framing): replaying unverified", path)
+    for lineno, rec in parsed:
+        if not legacy and not _integrity.verify_record(rec):
+            _integrity.note_corrupt("journal", str(path))
+            raise _integrity.JournalCorruptError(
+                f"journal {path!r} line {lineno} fails its CRC frame "
+                "— the record's bytes are not the bytes that were "
+                "written; refusing to replay corrupt job state",
+                artifact="journal", path=str(path))
+        fp = rec.get("fp")
+        ev = rec.get("ev")
+        if fp is None or ev is None:
+            continue
+        st = jobs.setdefault(fp, {"state": None, "claims": 0,
+                                  "submits": 0, "requeues": 0,
+                                  "reason": None})
+        if ev == "submit":
+            st["submits"] += 1
+            if st["state"] not in _PROTECTED_STATES:
+                st["state"] = "queued"
+        elif ev == "claim":
+            st["claims"] += 1
+            if st["state"] not in _PROTECTED_STATES:
+                st["state"] = "claimed"
+        elif ev == "requeue":
+            st["requeues"] += 1
+            if st["state"] not in _PROTECTED_STATES:
+                st["state"] = "queued"
+        elif ev == "quarantine":
+            st["state"] = "quarantined"
+            st["reason"] = rec.get("reason")
+        elif ev == "finish":
+            st["state"] = rec.get("state", "done")
     return jobs

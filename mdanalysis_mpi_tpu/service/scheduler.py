@@ -127,6 +127,23 @@ class Scheduler:
         Path (or open :class:`~mdanalysis_mpi_tpu.service.journal.
         JobJournal`) for the crash-consistent lifecycle journal;
         :meth:`recover` replays it after a crash.
+    ``scrub`` / ``scrub_interval_s``
+        Opt-in SDC scrubbing (docs/RELIABILITY.md §5): a background
+        thread re-fetches the shared cache's idle superblocks every
+        ``scrub_interval_s`` (only while no worker is mid-run — the
+        fetch competes for the host core and, on tunneled targets, the
+        link) and compares them against the host-side fingerprints
+        recorded at stage time; a mismatch quarantines the entry so
+        the next pass re-stages clean bytes.  :meth:`scrub_now` is the
+        synchronous form.
+    ``mem_guard_bytes``
+        Admission-level memory watchdog: an upper bound on the total
+        ESTIMATED staged bytes in flight across workers (cached or
+        not).  A batch-backend unit whose estimate would cross the
+        guard is shed to the serial backend (frame-at-a-time, no block
+        residency) instead of letting the allocator OOM the process —
+        counted as ``admission_shed_serial``.  ``None`` (default)
+        disables the guard.
     """
 
     def __init__(self, n_workers: int = 1, cache=None,
@@ -135,7 +152,9 @@ class Scheduler:
                  prefetch: bool = False, lease_ttl_s: float = 30.0,
                  poison_threshold: int = 2, supervise: bool = True,
                  supervision_interval_s: float = 0.05,
-                 breakers=None, journal=None, clock=time.monotonic):
+                 breakers=None, journal=None, clock=time.monotonic,
+                 scrub: bool = False, scrub_interval_s: float = 5.0,
+                 mem_guard_bytes: int | None = None):
         self.cache = cache
         self.telemetry = telemetry or ServiceTelemetry()
         self.max_deferrals = max_deferrals
@@ -170,6 +189,14 @@ class Scheduler:
         # hits.  Also available synchronously via prefetch_pending().
         self.prefetch = bool(prefetch) and cache is not None
         self._prefetch_thread: threading.Thread | None = None
+        # ---- integrity: SDC scrubbing + memory watchdog
+        #      (docs/RELIABILITY.md §5) ----
+        self.scrub = (bool(scrub) and cache is not None
+                      and hasattr(cache, "scrub"))
+        self.scrub_interval_s = float(scrub_interval_s)
+        self._scrub_thread: threading.Thread | None = None
+        self.mem_guard_bytes = mem_guard_bytes
+        self._staged_inflight = 0     # estimated staged bytes mid-run
         self._queue: list = []        # (-priority, seq, handle)
         # admission-deferred entries, parked until OTHER work actually
         # runs (a deferred top-priority job back in the queue would
@@ -205,6 +232,12 @@ class Scheduler:
                                      daemon=True,
                                      name="mdtpu-prefetch")
                 self._prefetch_thread = t
+                t.start()
+            if self.scrub and self._scrub_thread is None:
+                t = threading.Thread(target=self._scrub_worker,
+                                     daemon=True,
+                                     name="mdtpu-scrub")
+                self._scrub_thread = t
                 t.start()
             if self.supervise and self._sup_thread is None:
                 # heartbeats ride phase entries (utils/timers.py): the
@@ -278,6 +311,9 @@ class Scheduler:
         pf = self._prefetch_thread
         if pf is not None:
             pf.join()
+        sc = self._scrub_thread
+        if sc is not None:
+            sc.join()
         st = self._sup_thread
         if st is not None:
             st.join()
@@ -296,6 +332,7 @@ class Scheduler:
         with self._cond:
             self._workers.clear()
             self._prefetch_thread = None
+            self._scrub_thread = None
             self._sup_thread = None
 
     def abort_queued(self, reason: str = "scheduler draining") -> list:
@@ -1107,6 +1144,55 @@ class Scheduler:
                     return
             self.prefetch_pending(max_units=1)
 
+    # ---- SDC scrubbing + memory watchdog
+    #      (docs/RELIABILITY.md §5 "Integrity model") ----
+
+    def scrub_now(self, max_entries: int | None = None) -> dict:
+        """One synchronous scrub pass over the shared cache: re-fetch
+        fingerprinted resident entries, compare against the stage-time
+        host fingerprints, quarantine mismatches (the next pass over
+        those frames re-stages clean bytes).  Returns the cache's
+        ``{"checked", "corrupt", "bytes"}`` stats (empty dict when the
+        cache has no scrub support)."""
+        if self.cache is None or not hasattr(self.cache, "scrub"):
+            return {}
+        stats = self.cache.scrub(max_entries=max_entries)
+        if stats.get("corrupt"):
+            self._log.error(
+                "scrub pass quarantined %d corrupt cache entr%s "
+                "(%d checked)", stats["corrupt"],
+                "y" if stats["corrupt"] == 1 else "ies",
+                stats["checked"])
+        return stats
+
+    #: Entries one background scrub iteration verifies: keeps each
+    #: pass short so the idle check stays honest — a job submitted
+    #: mid-pass waits at most a few fetches, not a full-cache sweep
+    #: (the cache rotates a cursor, so coverage is complete across
+    #: iterations).
+    SCRUB_BATCH = 8
+
+    def _scrub_worker(self) -> None:
+        """Background scrubber (``scrub=True``): every
+        ``scrub_interval_s``, IF no worker is mid-run — the
+        device→host re-fetch competes for the host core and the
+        transfer link, so scrubbing rides idle cycles only — verify
+        the next :data:`SCRUB_BATCH` fingerprinted cache entries."""
+        while True:
+            with self._cond:
+                # predicate check BEFORE the wait too: a shutdown
+                # notify that fired while this thread was out
+                # scrubbing must not be re-waited-out for a whole
+                # interval (same pattern as _prefetch_worker)
+                if self._shutdown:
+                    return
+                self._cond.wait(self.scrub_interval_s)
+                if self._shutdown:
+                    return
+                if self._active > 0 or self._queue or self._parked:
+                    continue          # busy: keep the host core free
+            self.scrub_now(max_entries=self.SCRUB_BATCH)
+
     # ---- cache admission ----
 
     def _estimate_bytes(self, job: AnalysisJob) -> int:
@@ -1279,9 +1365,19 @@ class Scheduler:
             self._log.warning(
                 "breaker open for %r: routing %d job(s) to %r",
                 job.backend, len(unit.handles), backend)
+        backend, mem_charged = self._mem_guarded_backend(
+            backend, job, len(unit.handles))
         kwargs = dict(job.executor_kwargs)
         if reserved >= 0:
             kwargs["block_cache"] = self.cache
+        if backend == "serial":
+            # a breaker reroute or memory-guard shed landed a
+            # batch-geometry job on the serial floor: serial streams
+            # frame-at-a-time and refuses batch kwargs (cache,
+            # transfer dtype, scan_k) — forwarding them would turn
+            # the graceful route into a TypeError
+            kwargs = {k: v for k, v in kwargs.items()
+                      if k == "reliability"}
         for h in unit.handles:
             h._mark_running()
         # span attribution (docs/OBSERVABILITY.md): every member job's
@@ -1317,6 +1413,13 @@ class Scheduler:
                     "coalesced pass of %d jobs failed (%s: %s); "
                     "re-running members solo", len(unit.handles),
                     type(exc).__name__, exc)
+                # the failed pass's staged bytes are no longer in
+                # flight: release its memory-guard charge BEFORE the
+                # solo re-runs, or each retry would see the dead
+                # unit's estimate still counted and shed to serial
+                # against a guard that is not actually exceeded
+                self._mem_release(mem_charged)
+                mem_charged = 0
                 for h in unit.handles:
                     h.requeued_t = self._clock()
                     self.telemetry.count("jobs_requeued")
@@ -1338,6 +1441,13 @@ class Scheduler:
                 # (or were rejected by the cache's own cap check);
                 # either way the reservation's job is done
                 self.cache.release(reserved)
+            self._mem_release(mem_charged)
+            if self.cache is not None:
+                # staged-pressure high-water (docs/RELIABILITY.md §5):
+                # refreshed after every served unit, once the unit's
+                # reservations/inserts have moved the peak
+                obs.METRICS.set_gauge("mdtpu_staged_bytes_peak",
+                                      self.cache.bytes_peak)
             # keep a file-backed trace current after each served unit:
             # the serve_job span closes AFTER the inner run()'s own
             # export, so without this the file would always trail the
@@ -1346,6 +1456,51 @@ class Scheduler:
                 obs.export_trace()
         return True
 
+    def _mem_guarded_backend(self, backend: str, job: AnalysisJob,
+                             n_handles: int = 1) -> tuple:
+        """Memory watchdog (docs/RELIABILITY.md §5): reservation-aware
+        backpressure BEFORE the allocator OOMs.  A batch-backend unit
+        charges its estimated staged working set against
+        ``mem_guard_bytes`` while it runs (cached or uncached — the
+        bytes are resident either way); a unit whose charge would
+        cross the guard runs SERIAL instead: frame-at-a-time, no block
+        residency, slower but alive.  Returns ``(backend, charged)``;
+        release ``charged`` via :meth:`_mem_release` when the unit
+        finishes.  Mesh-only (ring-kernel) analyses cannot shed and
+        run as asked — disclosed in the log."""
+        if (self.mem_guard_bytes is None
+                or backend not in ("jax", "mesh")):
+            return backend, 0
+        try:
+            est = self._estimate_bytes(job)
+        except Exception:
+            return backend, 0
+        with self._cond:
+            if self._staged_inflight + est <= self.mem_guard_bytes:
+                self._staged_inflight += est
+                return backend, est
+        if getattr(job.analysis, "_mesh_only", False):
+            self._log.warning(
+                "memory guard: %s would cross mem_guard_bytes but is "
+                "mesh-only; running on %r anyway",
+                type(job.analysis).__name__, backend)
+            return backend, 0
+        self.telemetry.count("admission_shed_serial", n_handles)
+        obs.span_event("admission_shed_serial", tenant=job.tenant,
+                       est_bytes=est)
+        self._log.warning(
+            "memory guard: shedding %d job(s) (%s, ~%d MB staged) to "
+            "the serial backend — %d MB already in flight against a "
+            "%d MB guard", n_handles, type(job.analysis).__name__,
+            est >> 20, self._staged_inflight >> 20,
+            self.mem_guard_bytes >> 20)
+        return "serial", 0
+
+    def _mem_release(self, charged: int) -> None:
+        if charged:
+            with self._cond:
+                self._staged_inflight -= charged
+
     def _run_solo(self, handle: JobHandle, kwargs: dict,
                   token) -> None:
         job = handle.job
@@ -1353,6 +1508,12 @@ class Scheduler:
         backend = self._route_backend(job)
         if backend != job.backend:
             self.telemetry.count("breaker_reroutes")
+        backend, mem_charged = self._mem_guarded_backend(backend, job)
+        if backend == "serial":
+            # same batch-kwarg filter as _run_unit (breaker reroute /
+            # memory-guard shed to the serial floor)
+            kwargs = {k: v for k, v in kwargs.items()
+                      if k == "reliability"}
         handle._mark_running()
         try:
             with obs.trace_context(job_ids=[handle.job_id],
@@ -1370,6 +1531,8 @@ class Scheduler:
             self._note_backend_result(backend, None,
                                       analyses=[job.analysis])
             self._complete(handle, token)
+        finally:
+            self._mem_release(mem_charged)
         if obs.trace_path():
             obs.export_trace()       # same file-currency contract as
             #                          _run_unit

@@ -63,6 +63,8 @@ class ServiceTelemetry:
         self.admission_deferrals = 0   # admissible-later jobs passed over
         self.admission_uncached = 0    # jobs run without the shared cache
         self.admission_evictions = 0   # evict_unpinned entries reclaimed
+        self.admission_shed_serial = 0  # memory-guard sheds to serial
+        #                                 (docs/RELIABILITY.md §5)
         # scheduler-driven prefetch (docs/COLDSTART.md)
         self.prefetch_jobs = 0         # queued jobs whose blocks staged
         self.prefetch_blocks = 0       # blocks staged ahead of claim
@@ -166,6 +168,7 @@ class ServiceTelemetry:
                 "admission_deferrals": self.admission_deferrals,
                 "admission_uncached": self.admission_uncached,
                 "admission_evictions": self.admission_evictions,
+                "admission_shed_serial": self.admission_shed_serial,
                 "prefetch_jobs": self.prefetch_jobs,
                 "prefetch_blocks": self.prefetch_blocks,
                 "prefetch_skipped": self.prefetch_skipped,

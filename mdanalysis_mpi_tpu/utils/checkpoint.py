@@ -32,6 +32,10 @@ import numpy as np
 
 from mdanalysis_mpi_tpu.parallel.executors import get_executor
 from mdanalysis_mpi_tpu.parallel.partition import iter_batches
+from mdanalysis_mpi_tpu.utils import integrity as _integrity
+from mdanalysis_mpi_tpu.utils.integrity import (
+    ArtifactWriteError, CheckpointCorruptError,
+)
 
 
 def _fingerprint(analysis, frames) -> str:
@@ -57,37 +61,75 @@ def _fingerprint(analysis, frames) -> str:
     return h.hexdigest()
 
 
+def _spill_twin(path: str) -> str:
+    """The spill-dir twin of checkpoint ``path``: basename prefixed
+    with a digest of the PRIMARY path, so two runs whose checkpoints
+    merely share a basename (`c.npz` in different dirs) can never
+    collide in — or wrongly adopt from — the shared spill dir."""
+    tag = hashlib.sha256(
+        os.path.abspath(path).encode()).hexdigest()[:10]
+    return os.path.join(_integrity.spill_dir(),
+                        f"{tag}-{os.path.basename(path)}")
+
+
 def _save(path: str, frames_done: int, partials, fingerprint: str,
-          dropped=()) -> None:
+          dropped=()) -> str:
+    """Atomically persist one checkpoint (tmp → fsync → rename), with
+    a content digest stamped in so :func:`_load` can refuse corrupt
+    bytes instead of merging them into wrong numbers.
+
+    Returns the path actually written: on an exhausted primary
+    directory (ENOSPC/EIO-class :class:`ArtifactWriteError`) the write
+    RETRIES in the spill dir (``MDTPU_SPILL_DIR``, else the system
+    temp dir) — the degradation ladder of docs/RELIABILITY.md §5 —
+    and only raises when the spill dir is exhausted too.
+    """
     import jax
 
     leaves = [np.asarray(x) for x in jax.tree.leaves(partials)]
-    tmp = path + ".tmp.npz"     # np.savez appends .npz to bare names
-    np.savez(tmp, frames_done=np.int64(frames_done),
-             fingerprint=np.str_(fingerprint),
-             # frames the resilient policy dropped from the durable
-             # chunks: a resumed process never re-stages those chunks,
-             # so its reliability report must inherit the record
-             dropped=np.asarray(sorted(dropped), dtype=np.int64),
-             **{f"leaf_{i}": v for i, v in enumerate(leaves)})
-    os.replace(tmp, path)       # atomic: a crash never half-writes
+    arrays = {"frames_done": np.int64(frames_done),
+              "fingerprint": np.str_(fingerprint),
+              # frames the resilient policy dropped from the durable
+              # chunks: a resumed process never re-stages those chunks,
+              # so its reliability report must inherit the record
+              "dropped": np.asarray(sorted(dropped), dtype=np.int64),
+              **{f"leaf_{i}": v for i, v in enumerate(leaves)}}
+    try:
+        _integrity.write_npz_atomic(path, arrays, artifact="checkpoint")
+        return path
+    except ArtifactWriteError:
+        spill = _spill_twin(path)
+        if os.path.abspath(spill) == os.path.abspath(path):
+            raise              # no distinct spill target: nothing to try
+        from mdanalysis_mpi_tpu.utils.log import get_logger
+
+        get_logger("mdtpu").warning(
+            "checkpoint write to %s failed; retrying in spill dir %s",
+            path, os.path.dirname(spill))
+        _integrity.write_npz_atomic(spill, arrays, artifact="checkpoint")
+        return spill
 
 
 def _load(path: str, structure, fingerprint: str):
     import jax
 
-    with np.load(path) as z:
-        saved_fp = str(z["fingerprint"]) if "fingerprint" in z.files else None
-        if saved_fp != fingerprint:
-            raise ValueError(
-                f"checkpoint {path!r} was written for a different "
-                "analysis/trajectory/frame window/selection — refusing "
-                "to resume (delete it to start over)")
-        frames_done = int(z["frames_done"])
-        n_leaves = sum(1 for name in z.files if name.startswith("leaf_"))
-        leaves = [z[f"leaf_{i}"] for i in range(n_leaves)]
-        dropped = (z["dropped"] if "dropped" in z.files
-                   else np.empty(0, dtype=np.int64))
+    # typed integrity gate FIRST (docs/RELIABILITY.md §5): an
+    # unreadable container, a missing digest stamp (legacy or
+    # truncated file), or a content-digest mismatch raises
+    # CheckpointCorruptError — resume-from-corrupt must refuse, never
+    # fold flipped bits into the partials and report wrong numbers
+    z = _integrity.verify_npz(path, artifact="checkpoint")
+    saved_fp = str(z["fingerprint"]) if "fingerprint" in z else None
+    if saved_fp != fingerprint:
+        raise ValueError(
+            f"checkpoint {path!r} was written for a different "
+            "analysis/trajectory/frame window/selection — refusing "
+            "to resume (delete it to start over)")
+    frames_done = int(z["frames_done"])
+    n_leaves = sum(1 for name in z if name.startswith("leaf_"))
+    leaves = [z[f"leaf_{i}"] for i in range(n_leaves)]
+    dropped = (z["dropped"] if "dropped" in z
+               else np.empty(0, dtype=np.int64))
     treedef = jax.tree.structure(structure)
     if treedef.num_leaves != len(leaves):
         raise ValueError(
@@ -183,6 +225,16 @@ def run_checkpointed(analysis, path: str | None = None,
     rt = (getattr(executor, "_runtime", None)
           or getattr(executor, "reliability", None))
 
+    if not os.path.exists(path):
+        # a previous attempt may have spilled when the primary dir was
+        # exhausted (_save's degradation ladder): resume from the
+        # path-namespaced spill twin rather than silently recomputing
+        # from frame 0
+        spill_twin = _spill_twin(path)
+        if (os.path.abspath(spill_twin) != os.path.abspath(path)
+                and os.path.exists(spill_twin)):
+            path = spill_twin
+
     total = None
     done = 0
     if os.path.exists(path):
@@ -205,9 +257,13 @@ def run_checkpointed(analysis, path: str | None = None,
         total = partials if total is None else fold(total, partials)
         if rt is None:
             # 4-arg form kept for external wrappers around _save
-            _save(path, b, total, fp)
+            saved = _save(path, b, total, fp)
         else:
-            _save(path, b, total, fp, rt.report.dropped_frames)
+            saved = _save(path, b, total, fp, rt.report.dropped_frames)
+        # _save returns the path actually written (the spill twin when
+        # the primary dir was exhausted); wrappers that predate the
+        # return value yield None — keep the primary path then
+        path = saved or path
 
     if total is None:
         total = analysis._identity_partials()

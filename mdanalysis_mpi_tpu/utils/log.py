@@ -24,6 +24,7 @@ import threading
 
 _CONFIGURED = False
 _FILE_LOCK = threading.Lock()
+_DROP_WARNED = False          # one warning per process, drops counted
 
 
 def get_logger(name: str = "mdtpu") -> logging.Logger:
@@ -70,8 +71,26 @@ def log_event(event: str, **fields) -> None:
         else:
             # cross-thread append under one lock; cross-process safety
             # rides POSIX O_APPEND line atomicity for these short lines
-            with _FILE_LOCK, open(mode, "a") as f:
-                f.write(line + "\n")
+            try:
+                with _FILE_LOCK, open(mode, "a") as f:
+                    f.write(line + "\n")
+            except OSError as exc:
+                # a full disk / unwritable event file must not fail
+                # the caller — but the drop is COUNTED
+                # (mdtpu_obs_write_errors_total{sink="log_json"}) and
+                # warned once, never silently swallowed
+                # (docs/RELIABILITY.md §5)
+                from mdanalysis_mpi_tpu.obs import METRICS
+
+                METRICS.inc("mdtpu_obs_write_errors_total",
+                            sink="log_json")
+                global _DROP_WARNED
+                if not _DROP_WARNED:
+                    _DROP_WARNED = True
+                    get_logger().warning(
+                        "MDTPU_LOG_JSON append to %s failed (%s); "
+                        "events are being dropped (counted in "
+                        "mdtpu_obs_write_errors_total)", mode, exc)
     else:
         get_logger().info("%s %s", event,
                           " ".join(f"{k}={v}" for k, v in fields.items()))

@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# One local gate = what the repo holds itself to (README "Testing"):
+#
+#   1. `mdtpu lint` fast mode — the repo-native static analysis
+#      (docs/LINT.md): concurrency discipline, persistence atomicity,
+#      jit contracts (AST tier), schema drift.  Jax-free, <30 s.
+#   2. The tier-1 pytest line from ROADMAP.md, verbatim — including
+#      its DOTS_PASSED accounting, so a local run reads exactly like
+#      the driver's.
+#
+# Exit code is non-zero if either stage fails.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== [1/2] mdtpu lint (fast mode) =="
+python -m mdanalysis_mpi_tpu lint
+
+echo "== [2/2] tier-1 pytest (ROADMAP.md verify line) =="
+set -o pipefail
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
+exit "$rc"

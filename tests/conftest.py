@@ -58,3 +58,9 @@ def pytest_configure(config):
         "fixtures, seeded-bug corpus, tree-wide self-check); in "
         "tier-1 by construction (not slow) and selectable alone "
         "with `pytest -m lint`")
+    config.addinivalue_line(
+        "markers",
+        "integrity: fast, CPU-only data-integrity tests (checksummed "
+        "artifacts, SDC scrubbing, exhaustion-graceful persistence — "
+        "docs/RELIABILITY.md §5); in tier-1 by construction (not "
+        "slow) and selectable alone with `pytest -m integrity`")

@@ -75,6 +75,22 @@ PINNED_METRICS = {
     "mdtpu_faults_injected_total": "counter",
     "mdtpu_lint_rules": "gauge",
     "mdtpu_lint_findings": "gauge",
+    # end-to-end data integrity (docs/RELIABILITY.md §5): typed
+    # persistence-write failures, digest verifications/mismatches,
+    # disclosed obs write drops, the journal's in-memory degradation
+    # flag, the staged-pressure high-water, SDC scrub outcomes, and
+    # the memory watchdog's shed-to-serial counter
+    "mdtpu_integrity_write_errors_total": "counter",
+    "mdtpu_integrity_verifications_total": "counter",
+    "mdtpu_integrity_corrupt_total": "counter",
+    "mdtpu_obs_write_errors_total": "counter",
+    "mdtpu_integrity_journal_degraded": "gauge",
+    "mdtpu_staged_bytes_peak": "gauge",
+    "mdtpu_scrub_passes_total": "counter",
+    "mdtpu_scrub_blocks_total": "counter",
+    "mdtpu_scrub_corrupt_total": "counter",
+    "mdtpu_scrub_fetch_errors_total": "counter",
+    "mdtpu_admission_shed_serial_total": "counter",
 }
 
 
@@ -158,6 +174,14 @@ def test_bench_json_contract(tmp_path):
                     "serving_fault_recovery_overhead_pct",
                     "serving_fault_lease_expired",
                     "serving_fault_workers_respawned",
+                    # r11: end-to-end integrity sub-leg
+                    # (docs/RELIABILITY.md §5) — persistence-stack
+                    # overhead vs the plain wave (<3% target at
+                    # flagship scale) + stage-time fingerprint
+                    # throughput; host-side, survives outage
+                    "integrity_overhead_pct",
+                    "integrity_jobs_per_s",
+                    "integrity_fingerprint_gbps",
                     # r9: observability — the host-leg tracing-on/off
                     # delta and the unified metrics block
                     # (docs/OBSERVABILITY.md)
@@ -168,6 +192,15 @@ def test_bench_json_contract(tmp_path):
         # toy-scale run allows timer noise headroom)
         assert 0 <= rec["obs_overhead_pct"] < 15
         assert rec["obs_traced_fps"] > 0
+        # integrity sub-leg: the persistence stack ran (jobs/s > 0),
+        # its overhead is a sane percentage (<3% target at flagship
+        # scale; toy-scale fsyncs get generous headroom), every
+        # stamped output re-verified, and the stage-time fingerprint
+        # path moves real bytes
+        assert rec["integrity_jobs_per_s"] > 0
+        assert 0 <= rec["integrity_overhead_pct"] <= 100
+        assert rec["integrity_fingerprint_gbps"] > 0
+        assert rec["integrity_outputs_verified"] == 8
         # the metrics block carries the pinned schema: names AND types
         for name, typ in PINNED_METRICS.items():
             assert name in rec["metrics"], f"missing metric {name}"
@@ -613,6 +646,8 @@ PINNED_LINT_RULES = (
     "MDT002",   # notify-with-multiple-waiters (PR-7 lost-wakeup)
     "MDT003",   # fencing-swallow (WorkerFenced/InjectedWorkerDeath)
     "MDT004",   # thread-daemon-discipline
+    # persistence discipline (docs/RELIABILITY.md §5)
+    "MDT005",   # non-atomic-artifact-write (torn .npz outputs)
     # jit/jaxpr contracts (MDT1xx)
     "MDT101",   # host-side-effect-in-traced
     "MDT102",   # global-state-in-traced

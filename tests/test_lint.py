@@ -26,7 +26,9 @@ pytestmark = pytest.mark.lint
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-from mdanalysis_mpi_tpu.lint import concurrency, jaxcontracts, schema  # noqa: E402
+from mdanalysis_mpi_tpu.lint import (  # noqa: E402
+    concurrency, jaxcontracts, persistence, schema,
+)
 from mdanalysis_mpi_tpu.lint.core import (  # noqa: E402
     Baseline, Finding, pragma_suppressed, rule_ids, run_lint,
 )
@@ -205,6 +207,116 @@ def test_mdt004_positive_and_negative():
            "u = threading.Thread(target=f, daemon=False)\n")
     assert "MDT004" in _rules(_check(pos))
     assert "MDT004" not in _rules(_check(neg))
+
+
+# ------------------------------------------- MDT005 non-atomic writes
+
+
+def _check_persist(src: str,
+                   rel: str = "mdanalysis_mpi_tpu/service/mod.py"):
+    return persistence.check_module(ast.parse(src), rel)
+
+
+def test_mdt005_positive_bare_open_write():
+    src = ("def save(path, data):\n"
+           "    with open(path, 'w') as f:\n"
+           "        f.write(data)\n")
+    found = [f for f in _check_persist(src) if f.rule == "MDT005"]
+    assert len(found) == 1
+    assert found[0].symbol == "save"
+
+
+def test_mdt005_positive_bare_savez():
+    src = ("import numpy as np\n"
+           "def save(path, arrays):\n"
+           "    np.savez(path, **arrays)\n")
+    assert "MDT005" in _rules(_check_persist(src))
+
+
+def test_mdt005_negative_tmp_rename():
+    src = ("import os\n"
+           "def save(path, data):\n"
+           "    tmp = path + '.tmp'\n"
+           "    with open(tmp, 'w') as f:\n"
+           "        f.write(data)\n"
+           "    os.replace(tmp, path)\n")
+    assert "MDT005" not in _rules(_check_persist(src))
+
+
+def test_mdt005_negative_rename_blesses_scope():
+    # the rename alone (even without a tmp-named target) completes
+    # the pattern within the scope
+    src = ("import os\n"
+           "def save(path, scratch, data):\n"
+           "    with open(scratch, 'w') as f:\n"
+           "        f.write(data)\n"
+           "    os.rename(scratch, path)\n")
+    assert "MDT005" not in _rules(_check_persist(src))
+
+
+def test_mdt005_negative_append_mode_and_reads():
+    # append-only logs (the journal) are crash-consistent by
+    # construction; reads are out of scope entirely
+    src = ("def log(path, line):\n"
+           "    with open(path, 'a') as f:\n"
+           "        f.write(line)\n"
+           "def load(path):\n"
+           "    with open(path) as f:\n"
+           "        return f.read()\n")
+    assert "MDT005" not in _rules(_check_persist(src))
+
+
+def test_mdt005_scoped_to_persistence_modules():
+    src = ("def save(path, data):\n"
+           "    with open(path, 'w') as f:\n"
+           "        f.write(data)\n")
+    out_of_scope = persistence.check_module(
+        ast.parse(src), "mdanalysis_mpi_tpu/analysis/rms.py")
+    assert "MDT005" not in _rules(out_of_scope)
+
+
+def test_mdt005_exclusive_create_and_keyword_target():
+    # "x" tears exactly like "w"; and spelling the target as file=
+    # must not dodge the rule (review findings)
+    pos_x = ("def save(path, data):\n"
+             "    with open(path, 'xb') as f:\n"
+             "        f.write(data)\n")
+    pos_kw = ("def save(path, data):\n"
+              "    with open(file=path, mode='w') as f:\n"
+              "        f.write(data)\n")
+    assert "MDT005" in _rules(_check_persist(pos_x))
+    assert "MDT005" in _rules(_check_persist(pos_kw))
+
+
+def test_mdt005_closure_rename_does_not_bless_outer_write():
+    # the inverse of judged-alone: a rename tucked inside a deferred
+    # closure must NOT make the enclosing scope's in-place write
+    # atomic (review finding)
+    src = ("import os\n"
+           "def save(path, src_, dst, data):\n"
+           "    def later():\n"
+           "        os.replace(src_, dst)\n"
+           "    with open(path, 'w') as f:\n"
+           "        f.write(data)\n"
+           "    return later\n")
+    found = [f for f in _check_persist(src) if f.rule == "MDT005"]
+    assert len(found) == 1
+    assert found[0].symbol == "save"
+
+
+def test_mdt005_nested_function_judged_alone():
+    # the closure writes in place; the enclosing function's rename
+    # must NOT bless it (each scope carries its own pattern)
+    src = ("import os\n"
+           "def outer(path, data):\n"
+           "    def cb(p):\n"
+           "        with open(p, 'w') as f:\n"
+           "            f.write(data)\n"
+           "    os.replace(path + '.tmp', path)\n"
+           "    return cb\n")
+    found = [f for f in _check_persist(src) if f.rule == "MDT005"]
+    assert len(found) == 1
+    assert found[0].symbol == "outer.cb"
 
 
 # --------------------------------------------- MDT101/102 traced host effects
@@ -606,4 +718,4 @@ def test_cli_list_rules_and_rule_count():
     for rule in rules.values():
         assert rule.summary and rule.history
     assert {r.family for r in rules.values()} == {
-        "concurrency", "jit", "jaxpr", "schema"}
+        "concurrency", "persistence", "jit", "jaxpr", "schema"}
