@@ -903,6 +903,98 @@ def integrity_leg(u_mem) -> dict:
     }
 
 
+def fleet_serving_leg() -> dict:
+    """Fleet serving sub-leg (docs/RELIABILITY.md §6): K tenants
+    across TWO real host worker processes under a
+    :class:`~mdanalysis_mpi_tpu.service.fleet.FleetController` —
+    wave 1 cold (tenant state builds on each home host), wave 2 clean
+    (sticky routing: every job lands home with its tenant state
+    resident — the home-hit rate recorded here), wave 3 with one host
+    ``kill -9``'d mid-wave (migration onto the survivor + degraded
+    placement).  Clean-vs-loss jobs/s and the recovery overhead land
+    next to the membership/fencing counters, and the journal's
+    exactly-once audit (one accepted terminal record per job) is a
+    recorded FIELD, not just a test assertion.  Host-side by
+    construction (serial hosts, jax-free children): survives the
+    outage protocol like every leg before first jax contact."""
+    import shutil
+    import tempfile
+
+    from mdanalysis_mpi_tpu.service import fleet as _fleet
+    from mdanalysis_mpi_tpu.service.fleet import DONE, FleetController
+    from mdanalysis_mpi_tpu.service.journal import replay_fleet
+
+    fixture = {"kind": "protein", "n_residues": 12, "n_frames": 16,
+               "noise": 0.25, "seed": 9}
+    tenants = [f"ft{i}" for i in range(4)]
+    workdir = tempfile.mkdtemp(prefix="mdtpu-fleet-leg-")
+    all_jobs = []
+    try:
+        with FleetController(workdir, host_ttl_s=2.0) as ctrl:
+            for _ in range(2):
+                # the run-delay knob guarantees the wave-3 kill lands
+                # on in-flight work instead of racing millisecond jobs
+                ctrl.spawn_host(hb_interval_s=0.1,
+                                env={"MDTPU_FLEET_RUN_DELAY": "0.15"})
+            if not ctrl.wait_hosts(2, timeout=120.0):
+                raise RuntimeError("fleet leg: hosts never joined")
+
+            def wave(kill: bool = False):
+                t0 = time.perf_counter()
+                jobs = [ctrl.submit({"analysis": "rmsf",
+                                     "fixture": fixture, "tenant": t})
+                        for t in tenants for _ in range(2)]
+                all_jobs.extend(jobs)
+                if kill:
+                    victim = sorted(ctrl.placement.hosts())[0]
+                    if not ctrl.kill_host(victim):
+                        raise RuntimeError(
+                            "fleet leg: victim host not running")
+                if not ctrl.drain(timeout=300.0):
+                    raise RuntimeError("fleet leg: drain timed out")
+                bad = [j for j in jobs if j.state != DONE]
+                if bad:
+                    raise RuntimeError(
+                        f"fleet leg: {len(bad)} jobs not done "
+                        f"({bad[0].state}: {bad[0].error})")
+                return len(jobs) / (time.perf_counter() - t0)
+
+            wave()                              # cold: residency builds
+            before = ctrl.telemetry.snapshot()
+            clean_jps = wave()                  # clean steady wave
+            mid = ctrl.telemetry.snapshot()
+            loss_jps = wave(kill=True)          # host-loss wave
+            snap = ctrl.telemetry.snapshot()
+            stats = ctrl.stats()
+        wave2_n = mid["home_hits"] + mid["home_misses"] \
+            - before["home_hits"] - before["home_misses"]
+        wave2_hits = mid["home_hits"] - before["home_hits"]
+        meta = replay_fleet(os.path.join(workdir, _fleet.JOURNAL_NAME))
+        exactly_once = (
+            len(meta["finishes"]) == len(all_jobs)
+            and all(n == 1 for n in meta["finishes"].values()))
+        return {
+            "fleet_hosts": 2,
+            "fleet_n_jobs": len(all_jobs),
+            "fleet_clean_jobs_per_s": round(clean_jps, 2),
+            "fleet_loss_jobs_per_s": round(loss_jps, 2),
+            # the price of one mid-wave host kill (EOF detection +
+            # migration + survivor re-run), vs the clean wave
+            "fleet_recovery_overhead_pct": round(
+                max(0.0, (clean_jps - loss_jps) / clean_jps * 100.0),
+                2),
+            "fleet_wave2_home_hit_rate": (
+                round(wave2_hits / wave2_n, 4) if wave2_n else None),
+            "fleet_hosts_lost": snap["hosts_lost"],
+            "fleet_jobs_migrated": snap["jobs_migrated"],
+            "fleet_epoch_fenced_rejects": snap["epoch_fenced_rejects"],
+            "fleet_exactly_once": exactly_once,
+            "fleet_epoch": stats["epoch"],
+        }
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def serving_accel_leg(u_file, accel_backend: str, tdtype: str,
                       jax) -> dict:
     """Multi-tenant load on the accelerator backend with one SHARED
@@ -1073,6 +1165,18 @@ def main():
           f"persistence stack on; fingerprints "
           f"{integ['integrity_fingerprint_gbps']} GB/s)")
     _leg_done("integrity leg", **integ)
+
+    # fleet serving sub-leg (docs/RELIABILITY.md §6): K tenants across
+    # 2 real host processes, clean wave vs one kill -9 mid-wave —
+    # migration, degraded placement and the exactly-once audit, still
+    # host-side so a tunnel-down artifact carries it
+    fleet = fleet_serving_leg()
+    _note(f"[bench] fleet serving: clean "
+          f"{fleet['fleet_clean_jobs_per_s']} jobs/s, host-loss "
+          f"{fleet['fleet_loss_jobs_per_s']} jobs/s "
+          f"({fleet['fleet_jobs_migrated']} migrated, wave-2 home-hit "
+          f"rate {fleet['fleet_wave2_home_hit_rate']})")
+    _leg_done("fleet serving leg", **fleet)
 
     u_file = open_flagship(N_ATOMS, N_FRAMES)
     src_label = ("file-backed XTC" if SOURCE == "file"

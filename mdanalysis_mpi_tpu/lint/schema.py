@@ -75,6 +75,8 @@ _TABLE_TYPES = {
     "INTEGRITY_COUNTERS": "counter",
     "INTEGRITY_GAUGES": "gauge",
     "SCRUB_COUNTERS": "counter",
+    "FLEET_COUNTERS": "counter",
+    "FLEET_GAUGES": "gauge",
 }
 
 _RECORD_TYPES = {"inc": "counter", "observe": "histogram",

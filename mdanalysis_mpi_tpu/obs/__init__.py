@@ -32,6 +32,7 @@ from mdanalysis_mpi_tpu.obs.spans import (
     enabled as tracing_enabled,
     export as export_trace,
     maybe_enable_from_env,
+    set_process_args,
     span,
     span_event,
     trace_path,
@@ -46,5 +47,6 @@ __all__ = [
     "METRICS", "MetricsRegistry", "to_prometheus", "unified_snapshot",
     "span", "span_event", "trace_context", "enable_tracing",
     "disable_tracing", "tracing_enabled", "export_trace", "trace_path",
-    "maybe_enable_from_env", "start_run_capture", "finish_run_capture",
+    "maybe_enable_from_env", "set_process_args", "start_run_capture",
+    "finish_run_capture",
 ]

@@ -231,6 +231,23 @@ SCRUB_COUNTERS = (
     "mdtpu_scrub_fetch_errors_total",
 )
 
+#: Fleet-tier series (service/fleet.py, docs/RELIABILITY.md §6):
+#: host-loss migration and epoch fencing, recorded live at the
+#: controller's incident sites (labeled ``reason=``) and zero-injected
+#: so a process that never ran a fleet still carries the schema.
+FLEET_COUNTERS = (
+    "mdtpu_hosts_lost_total",
+    "mdtpu_jobs_migrated_total",
+    "mdtpu_epoch_fenced_rejects_total",
+)
+
+#: Fleet gauges: live host membership and the controller's fencing
+#: epoch (0 = this process is not a fleet controller).
+FLEET_GAUGES = (
+    "mdtpu_hosts_alive",
+    "mdtpu_controller_epoch",
+)
+
 
 def unified_snapshot(timers=None, cache=None, telemetry=None,
                      registry: MetricsRegistry | None = None) -> dict:
@@ -252,9 +269,10 @@ def unified_snapshot(timers=None, cache=None, telemetry=None,
     snap = (registry or METRICS).snapshot()
     for name in COMPILE_METRICS + BREAKER_COUNTERS + \
             SUPERVISION_COUNTERS + RELIABILITY_COUNTERS + \
-            INTEGRITY_COUNTERS + SCRUB_COUNTERS:
+            INTEGRITY_COUNTERS + SCRUB_COUNTERS + FLEET_COUNTERS:
         snap.setdefault(name, {"type": "counter", "values": {"": 0}})
-    for name in BREAKER_GAUGES + LINT_GAUGES + INTEGRITY_GAUGES:
+    for name in BREAKER_GAUGES + LINT_GAUGES + INTEGRITY_GAUGES \
+            + FLEET_GAUGES:
         # 0 == closed (reliability/breaker.py STATE_VALUES): a process
         # that never tripped a breaker reports the healthy state;
         # likewise 0 lint rules/findings means "never linted here"

@@ -43,10 +43,15 @@ import time
 
 class _TraceState:
     __slots__ = ("enabled", "path", "events", "max_events", "dropped",
-                 "t0", "named_tids")
+                 "t0", "named_tids", "proc_args")
 
     def __init__(self):
         self.enabled = False
+        #: process-wide args merged (lowest precedence) into every
+        #: recorded span/event — the fleet host workers stamp their
+        #: host id here so a merged fleet timeline attributes every
+        #: row to its host (docs/OBSERVABILITY.md)
+        self.proc_args: dict | None = None
         self.path: str | None = None
         self.events: list[dict] = []
         # bounded buffer: a long serving process with tracing left on
@@ -144,11 +149,25 @@ def tail(limit: int = 50, trace_id: str | None = None) -> list[dict]:
 
 def _merged_args(args: dict) -> dict:
     ctx = getattr(_CTX, "args", None)
-    if not ctx:
+    proc = _STATE.proc_args
+    if not ctx and not proc:
         return args
-    merged = dict(ctx)
+    merged = dict(proc) if proc else {}
+    if ctx:
+        merged.update(ctx)
     merged.update(args)
     return merged
+
+
+def set_process_args(**args) -> None:
+    """Merge ``args`` into EVERY span/event this process records, for
+    the life of the process (lowest precedence — thread contexts and
+    per-span args override).  The fleet tier's per-host attribution
+    channel: each ``fleet-host`` worker stamps ``fleet_host=<id>``
+    once at startup, so every row of its trace names the host it ran
+    on.  Pass nothing to clear."""
+    with _LOCK:
+        _STATE.proc_args = dict(args) if args else None
 
 
 def _append(ev: dict, tid: int, thread_name: str) -> None:

@@ -123,6 +123,45 @@ def global_from_local(local, mesh, spec, global_shape=None):
         sharding, local, global_shape)
 
 
+def local_host_copy(x):
+    """Host copy of THIS process's slice of an array — the inverse of
+    :func:`global_from_local`, and the fetch half of multi-host SDC
+    scrubbing (docs/RELIABILITY.md §5): stage-time fingerprints cover
+    the process-local staged bytes, so the scrub comparison must fetch
+    exactly those bytes back, never another host's shard (which
+    ``np.asarray`` on a multi-process global array cannot fetch at
+    all).
+
+    Single-process / fully-addressable arrays (and plain numpy) take
+    the exact ``np.asarray`` path.  For a multi-process global array,
+    the unique addressable shards are reassembled in index order along
+    the sharded axis — by the :func:`global_from_local` invariant they
+    are this process's contiguous block of every sharded axis, so the
+    result equals the locally staged array bit for bit; a replicated
+    array has one unique (full) shard.
+    """
+    import numpy as np
+
+    shards = getattr(x, "addressable_shards", None)
+    if shards is None or getattr(x, "is_fully_addressable", True):
+        return np.asarray(x)
+    uniq: dict = {}
+    for shard in shards:
+        key = tuple((s.start, s.stop, s.step) for s in shard.index)
+        if key not in uniq:
+            uniq[key] = np.asarray(shard.data)
+    if len(uniq) == 1:
+        return next(iter(uniq.values()))
+    # the varying dimension is the sharded axis; shards are disjoint
+    # contiguous slices there (process-contiguous by construction)
+    keys = sorted(uniq)
+    axis = next(d for d in range(len(keys[0]))
+                if len({k[d] for k in keys}) > 1)
+    ordered = sorted(uniq.items(),
+                     key=lambda kv: (kv[0][axis][0] or 0))
+    return np.concatenate([v for _, v in ordered], axis=axis)
+
+
 def global_batch_from_local(local_batch, mesh, axis_name: str = "data"):
     """Frame-axis convenience wrapper over :func:`global_from_local`:
     this process's (B_local, ...) staged frames — B_local = B_global /

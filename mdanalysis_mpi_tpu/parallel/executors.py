@@ -796,12 +796,20 @@ class DeviceBlockCache(BlockCache):
 
         Deliberately fetch-heavy: run it on idle cycles (the
         scheduler's ``scrub=`` thread only scrubs while no worker is
-        mid-run).  Entries without a fingerprint (multi-host slices)
-        are skipped.  Returns ``{"checked", "corrupt", "bytes"}``;
-        outcomes land in ``mdtpu_scrub_*`` metrics and a
-        ``scrub_corrupt`` trace instant per quarantined entry.
+        mid-run).  Fingerprints are recorded over the PROCESS-LOCAL
+        staged bytes, so on a multi-host mesh the comparison re-fetches
+        only this process's shard of each global array
+        (:func:`~mdanalysis_mpi_tpu.parallel.distributed.
+        local_host_copy`) — never another host's bytes, which a local
+        fingerprint could never match.  Returns ``{"checked",
+        "corrupt", "bytes"}``; outcomes land in ``mdtpu_scrub_*``
+        metrics and a ``scrub_corrupt`` trace instant per quarantined
+        entry.
         """
         from mdanalysis_mpi_tpu import obs
+        from mdanalysis_mpi_tpu.parallel.distributed import (
+            local_host_copy,
+        )
 
         items = self.scrub_items()
         if max_entries is not None and items:
@@ -815,7 +823,8 @@ class DeviceBlockCache(BlockCache):
         checked = corrupt = nbytes = fetch_errors = 0
         for key, value, fp in items:
             try:
-                actual = _integrity.staged_fingerprint(value)
+                actual = _integrity.staged_fingerprint(
+                    [local_host_copy(x) for x in value])
             except Exception as exc:
                 with self._lock:
                     still_stored = self._store.get(key) is value
@@ -1116,11 +1125,12 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
 
     # stage-time integrity fingerprints (docs/RELIABILITY.md §5):
     # per-array host CRCs recorded beside each cache entry so the SDC
-    # scrubber can re-fetch and compare.  Multi-host slices are never
-    # fingerprinted — the cached global array carries OTHER hosts'
-    # bytes too, and a local-slice fingerprint would false-positive.
-    fingerprinting = (_INTEGRITY_FINGERPRINTS and cache is not None
-                      and local_divisor == 1)
+    # scrubber can re-fetch and compare.  On multi-host the staged
+    # tuple IS this process's shard of the global batch, and the
+    # scrubber compares against a local-shard re-fetch
+    # (``distributed.local_host_copy``) — fleet hosts get the same
+    # scrub coverage as single-host caches (the PR-9 gap).
+    fingerprinting = _INTEGRITY_FINGERPRINTS and cache is not None
     # scan-group accumulator: gi -> (blocks_chained, per-array crcs).
     # _stack_staged stacks each leaf along a new leading axis in block
     # order, so chaining the per-block CRCs at stage time equals the

@@ -36,6 +36,44 @@ def static_blocks(n_frames: int, n_blocks: int) -> list[range]:
     return blocks
 
 
+def shard_windows(n_frames: int | None, start: int | None,
+                  stop: int | None, step: int | None,
+                  n_shards: int) -> list:
+    """Split one job's frame window into ``n_shards`` contiguous
+    sub-windows — the fleet tier's trajectory sharding
+    (docs/RELIABILITY.md §6): each shard is an independent
+    ``(start, stop, step)`` job on some host, and the controller
+    concatenates the per-frame result series back in shard order (the
+    task-parallel map-reduce decomposition of PAPERS.md 1801.07630).
+
+    Shards partition the window's frame INDEX SEQUENCE
+    (``range(start, stop, step)``), so non-unit steps shard exactly:
+    the union of the sub-windows visits the same frames in the same
+    order.  Returns one ``(start, stop, step)`` per shard, ``None``
+    for shards left empty (``n_shards > n_window_frames``).
+    ``n_frames`` bounds an open window (``stop=None``); with neither
+    a ``stop`` nor ``n_frames`` the window is unbounded and unsplittable.
+    """
+    step = 1 if step is None else int(step)
+    lo = 0 if start is None else int(start)
+    hi = stop if stop is not None else n_frames
+    if hi is None:
+        raise ValueError(
+            "shard_windows needs a bounded window: pass stop= or "
+            "n_frames=")
+    if n_frames is not None:
+        hi = min(int(hi), int(n_frames))
+    idx = range(lo, hi, step)
+    out = []
+    for block in static_blocks(len(idx), n_shards):
+        if len(block) == 0:
+            out.append(None)
+            continue
+        out.append((idx[block.start], idx[block.stop - 1] + step,
+                    step))
+    return out
+
+
 def iter_batches(start: int, stop: int, batch_size: int):
     """Yield (a, b) batch bounds covering [start, stop) in chunks of at
     most ``batch_size`` frames."""

@@ -35,22 +35,35 @@ requests instead of re-reading per request.
   diagnostics capture (docs/RELIABILITY.md, "Serving supervision").
 - :mod:`~mdanalysis_mpi_tpu.service.journal` — the crash-consistent
   JSONL job journal behind ``Scheduler(journal=)`` / ``batch
-  --journal`` and :meth:`Scheduler.recover`.
+  --journal`` and :meth:`Scheduler.recover`; epoch-stamped records +
+  :func:`~mdanalysis_mpi_tpu.service.journal.replay_fleet` fencing for
+  the fleet tier.
+- :mod:`~mdanalysis_mpi_tpu.service.placement` /
+  :mod:`~mdanalysis_mpi_tpu.service.fleet` — the controller tier
+  (docs/RELIABILITY.md §6): sticky tenant→home-host rendezvous
+  placement, host membership via heartbeat leases, host-loss migration
+  with journal-level exactly-once, and controller failover via
+  epoch-fenced journal adoption.
 
 See docs/SERVICE.md for the job model and semantics, and
 ``examples/serve_batch.py`` for a runnable mixed-workload script.
 """
 
+from mdanalysis_mpi_tpu.service.fleet import FleetController, FleetJob
 from mdanalysis_mpi_tpu.service.jobs import (
     AnalysisJob, JobDeadlineExpired, JobHandle, JobQuarantinedError,
     JobState, SchedulerShutdownError,
 )
-from mdanalysis_mpi_tpu.service.journal import JobJournal
+from mdanalysis_mpi_tpu.service.journal import JobJournal, replay_fleet
+from mdanalysis_mpi_tpu.service.placement import PlacementTable
 from mdanalysis_mpi_tpu.service.scheduler import Scheduler
-from mdanalysis_mpi_tpu.service.telemetry import ServiceTelemetry
+from mdanalysis_mpi_tpu.service.telemetry import (
+    FleetTelemetry, ServiceTelemetry,
+)
 
 __all__ = [
-    "AnalysisJob", "JobDeadlineExpired", "JobHandle",
-    "JobJournal", "JobQuarantinedError", "JobState",
-    "Scheduler", "SchedulerShutdownError", "ServiceTelemetry",
+    "AnalysisJob", "FleetController", "FleetJob", "FleetTelemetry",
+    "JobDeadlineExpired", "JobHandle", "JobJournal",
+    "JobQuarantinedError", "JobState", "PlacementTable", "Scheduler",
+    "SchedulerShutdownError", "ServiceTelemetry", "replay_fleet",
 ]

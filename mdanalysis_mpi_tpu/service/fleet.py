@@ -1,0 +1,1461 @@
+"""Elastic fleet serving: a controller tier over N host worker
+processes (docs/RELIABILITY.md §6, ROADMAP item 1).
+
+The PR-4..PR-9 serving stack supervises *workers inside one process*;
+this module promotes every one of those primitives one level up, to
+host granularity:
+
+- **Controller** (:class:`FleetController`): owns the tenant→host
+  placement table (:mod:`~mdanalysis_mpi_tpu.service.placement` —
+  sticky rendezvous routing, so a hot tenant's superblocks stay
+  resident in its home host's ``DeviceBlockCache`` and its
+  Universe/reader state in the host's tenant cache), the epoch-stamped
+  CRC journal (exactly-once application of completions), and host
+  membership via heartbeat leases.
+- **Hosts** (:func:`host_main`, the ``fleet-host`` CLI): one OS
+  process each, running jobs through their own local
+  :class:`~mdanalysis_mpi_tpu.service.scheduler.Scheduler` (worker
+  leases, breakers, prefetch — the whole PR-7 stack — still apply
+  *inside* each host).  Hosts dial the controller's socket, found via
+  an atomically-replaced address file beside the journal, heartbeat on
+  an interval, and stream completions back (resent until acked — the
+  controller's assignment-token check makes re-delivery idempotent).
+- **Host loss**: a ``kill -9``'d host EOFs its socket (fast path); a
+  partitioned/wedged one misses heartbeats until its lease expires
+  (slow path).  Either way its in-flight jobs are REQUEUED onto
+  survivors (``jobs_migrated``), its tenants re-placed (and re-warmed
+  by the survivors' tenant caches / scheduler prefetch on first
+  touch), and placement degrades to fewer hosts — down to one, never
+  to failure.  The lost host's per-host circuit breaker records the
+  failure, so a flapping host trips out of placement.
+- **Controller failover** (:meth:`FleetController.adopt`): a standby
+  replays the CRC journal (:func:`~mdanalysis_mpi_tpu.service.journal.
+  replay_fleet`), BUMPS the epoch, writes an ``epoch`` record and the
+  new address file; hosts reconnect on their next heartbeat tick,
+  syncing their in-flight jobs and unacked completions into the new
+  controller.  **Epoch fencing** is the ``WorkerFenced`` ownership
+  token one level up: every command and completion carries
+  ``(epoch, assign_seq, host)``, hosts reject commands from stale
+  epochs, the controller rejects completions whose token is not the
+  job's CURRENT assignment, and replay rejects records a zombie
+  controller appended under an old epoch — counted as
+  ``epoch_fenced_rejects``, never applied.
+
+Wire format: one JSON object per line over a loopback/LAN TCP socket.
+Deliberately dependency-free (stdlib sockets): the controller and its
+hosts share a machine or a rack; cross-DC serving is out of scope
+(docs/RELIABILITY.md §6 "Scope").
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+from mdanalysis_mpi_tpu import obs
+from mdanalysis_mpi_tpu.reliability import breaker as _breaker
+from mdanalysis_mpi_tpu.service import journal as _journal
+from mdanalysis_mpi_tpu.service import placement as _placement
+from mdanalysis_mpi_tpu.service.telemetry import FleetTelemetry
+from mdanalysis_mpi_tpu.utils.log import get_logger
+
+#: Files the fleet keeps in its working directory: the epoch-stamped
+#: CRC journal, and the atomically-replaced controller address file
+#: hosts poll for discovery + failover.
+JOURNAL_NAME = "fleet_journal.jsonl"
+ADDR_NAME = "controller.addr"
+
+#: Job states a :class:`FleetJob` moves through (strings, like
+#: service.jobs.JobState).
+QUEUED = "queued"
+ASSIGNED = "assigned"
+DONE = "done"
+FAILED = "failed"
+QUARANTINED = "quarantined"
+
+_TERMINAL = (DONE, FAILED, QUARANTINED)
+
+#: Fleet-only job-spec keys stripped before the host builds the
+#: analysis (everything else is the ``batch`` CLI's job schema).
+_FLEET_SPEC_KEYS = ("fixture", "shards")
+
+
+def _send_line(sock: socket.socket, lock: threading.Lock,
+               msg: dict) -> bool:
+    """One JSON line onto the wire; False (never raise) on a dead
+    socket — the caller's lease/EOF machinery owns the failure."""
+    data = (json.dumps(msg) + "\n").encode()
+    try:
+        with lock:
+            sock.sendall(data)
+        return True
+    except OSError:
+        return False
+
+
+def _write_addr_file(workdir: str, host: str, port: int,
+                     epoch: int) -> str:
+    """Atomically publish the active controller's address + epoch:
+    hosts must never read a torn address, and a standby's adoption
+    must flip every host in one rename.  The shared integrity helper
+    (tmp → fsync → os.replace) also counts and types a failed write —
+    an ENOSPC during failover surfaces as a typed
+    :class:`~mdanalysis_mpi_tpu.utils.integrity.ArtifactWriteError`
+    out of the adoption, never a silently unpublished controller."""
+    from mdanalysis_mpi_tpu.utils import integrity as _integrity
+
+    path = os.path.join(workdir, ADDR_NAME)
+    data = json.dumps({"host": host, "port": port,
+                       "epoch": epoch}).encode()
+    _integrity.atomic_write_bytes(path, data,
+                                  artifact="controller_addr")
+    return path
+
+
+def _read_addr_file(workdir: str) -> dict | None:
+    try:
+        with open(os.path.join(workdir, ADDR_NAME),
+                  encoding="utf-8") as f:
+            info = json.loads(f.read())
+    except (OSError, ValueError):
+        return None
+    if not isinstance(info, dict) or "port" not in info:
+        return None
+    return info
+
+
+class FleetJob:
+    """Controller-side record + waitable handle for one fleet job."""
+
+    __slots__ = ("fp", "spec", "tenant", "state", "host",
+                 "assign_seq", "assign_epoch", "results", "error",
+                 "migrations", "resident", "parent", "children",
+                 "shard_index", "_event")
+
+    def __init__(self, fp: str, spec: dict, tenant: str):
+        self.fp = fp
+        self.spec = spec
+        self.tenant = tenant
+        self.state = QUEUED
+        self.host: str | None = None
+        self.assign_seq: int | None = None
+        self.assign_epoch: int | None = None
+        self.results: dict | None = None
+        self.error: str | None = None
+        self.migrations = 0
+        self.resident: bool | None = None
+        self.parent: FleetJob | None = None
+        self.children: list[FleetJob] | None = None
+        self.shard_index: int | None = None
+        self._event = threading.Event()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self, timeout: float | None = None) -> bool:
+        return self._event.wait(timeout)
+
+    def result_arrays(self) -> dict:
+        """``{name: np.ndarray}`` of the finished job's results (raises
+        the job's failure message as RuntimeError otherwise)."""
+        import numpy as np
+
+        if not self._event.is_set():
+            raise TimeoutError(f"fleet job {self.fp} still {self.state}")
+        if self.state != DONE:
+            raise RuntimeError(
+                f"fleet job {self.fp} {self.state}: {self.error}")
+        return {k: np.asarray(v) for k, v in (self.results or {}).items()}
+
+    def __repr__(self):
+        return (f"<FleetJob {self.fp} tenant={self.tenant!r} "
+                f"{self.state}@{self.host}>")
+
+
+class _Host:
+    """Controller-side state of one connected host."""
+
+    __slots__ = ("hid", "sock", "send_lock", "deadline", "inflight",
+                 "proc", "alive", "epoch")
+
+    def __init__(self, hid: str, sock: socket.socket, deadline: float,
+                 epoch: int, proc=None):
+        self.hid = hid
+        self.sock = sock
+        self.send_lock = threading.Lock()
+        self.deadline = deadline
+        self.inflight: set[str] = set()
+        self.proc = proc
+        self.alive = True
+        self.epoch = epoch
+
+
+class FleetController:
+    """The controller tier: tenant placement, host leases, migration,
+    epoch-fenced journal ownership.
+
+    ``workdir``
+        Directory holding the fleet journal + controller address file
+        (the unit of adoption: a standby pointed at the same workdir
+        takes the fleet over).
+    ``epoch``
+        This controller's fencing epoch (default 1 for a fresh fleet;
+        :meth:`adopt` derives ``last + 1`` from the journal).
+    ``host_ttl_s`` / ``tick_s``
+        Host heartbeat lease TTL and the supervisor tick.  A host is
+        declared lost when its socket EOFs (a ``kill -9``, fast) or
+        its lease expires (a partition/wedge, bounded by the TTL).
+    ``poison_migrations``
+        A job migrated this many times (its host died under it each
+        time) is QUARANTINED instead of migrated again — one poison
+        job must not bleed the fleet host by host.
+    ``respawn_hosts``
+        Replace a lost spawned host with a fresh process (capacity
+        recovery).  Default False: placement DEGRADES to the
+        survivors, which is the behavior the chaos suite pins.
+    """
+
+    def __init__(self, workdir, epoch: int = 1, host_ttl_s: float = 3.0,
+                 tick_s: float = 0.05, poison_migrations: int = 3,
+                 respawn_hosts: bool = False, breakers=None,
+                 telemetry: FleetTelemetry | None = None,
+                 bind_host: str = "127.0.0.1", clock=time.monotonic,
+                 _recovered: dict | None = None):
+        self.workdir = str(workdir)
+        os.makedirs(self.workdir, exist_ok=True)
+        self.epoch = int(epoch)
+        self.host_ttl_s = float(host_ttl_s)
+        self.tick_s = float(tick_s)
+        self.poison_migrations = max(1, int(poison_migrations))
+        self.respawn_hosts = bool(respawn_hosts)
+        self.telemetry = telemetry or FleetTelemetry()
+        self.breakers = breakers or _breaker.BreakerBoard(
+            threshold=3, cooldown_s=5.0, clock=clock)
+        self.placement = _placement.PlacementTable(
+            breakers=self.breakers)
+        self._clock = clock
+        self._log = get_logger("mdtpu.fleet")
+        self._lock = threading.RLock()
+        self._hosts: dict[str, _Host] = {}
+        self._jobs: dict[str, FleetJob] = {}
+        self._pending: list[str] = []
+        self._assign_seq = 0
+        self._job_seq = 0
+        self._host_seq = 0
+        self._shutdown = False
+        self._wedged = False
+        self._procs: list = []
+        self.journal = _journal.JobJournal(
+            os.path.join(self.workdir, JOURNAL_NAME), epoch=self.epoch)
+        # epoch record FIRST and durable: from this line on, every
+        # older-epoch append in the journal is a zombie's and replay
+        # fences it (docs/RELIABILITY.md §6)
+        self.journal.record("epoch", None, durable=True,
+                            controller=os.getpid())
+        obs.METRICS.set_gauge("mdtpu_controller_epoch", self.epoch)
+        obs.span_event("epoch_adopted", epoch=self.epoch)
+        if _recovered:
+            self._resubmit_recovered(_recovered)
+        # listener + address publication (bound-socket port handoff:
+        # the controller binds port 0 itself and hands the RESOLVED
+        # port to hosts via the address file — no free-port race)
+        self._listener = socket.socket(socket.AF_INET,
+                                       socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET,
+                                  socket.SO_REUSEADDR, 1)
+        self._listener.bind((bind_host, 0))
+        self._listener.listen(64)
+        self.address = self._listener.getsockname()
+        _write_addr_file(self.workdir, self.address[0],
+                         self.address[1], self.epoch)
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name="mdtpu-fleet-accept")
+        self._accept_thread.start()
+        self._sup_thread = threading.Thread(
+            target=self._supervisor, daemon=True,
+            name="mdtpu-fleet-supervisor")
+        self._sup_thread.start()
+
+    # ---- adoption / failover ----
+
+    @classmethod
+    def adopt(cls, workdir, **kwargs) -> "FleetController":
+        """Standby takeover: replay the fleet journal, bump the epoch
+        past every record in it, resubmit the unfinished jobs, publish
+        the new address.  The zombie controller's subsequent journal
+        appends (old epoch) are fenced at the next replay; its
+        subsequent commands are fenced by every host that has seen the
+        new address file."""
+        path = os.path.join(str(workdir), JOURNAL_NAME)
+        recovered = None
+        epoch = 1
+        if os.path.exists(path):
+            recovered = _journal.replay_fleet(path)
+            epoch = recovered["epoch"] + 1
+        return cls(workdir, epoch=epoch, _recovered=recovered,
+                   **kwargs)
+
+    def _resubmit_recovered(self, recovered: dict) -> None:
+        n = 0
+        for fp, rec in recovered["jobs"].items():
+            if rec["state"] not in ("queued", "claimed"):
+                continue
+            spec = rec.get("spec")
+            if spec is None:
+                self._log.warning(
+                    "adopted journal job %s has no spec record; it "
+                    "cannot be re-run from the journal alone", fp)
+                continue
+            job = FleetJob(fp, dict(spec),
+                           rec.get("tenant") or "default")
+            with self._lock:
+                self._jobs[fp] = job
+                self._pending.append(fp)
+            n += 1
+        if n:
+            self._log.warning(
+                "adoption (epoch %d): %d unfinished job(s) re-owned "
+                "from the journal", self.epoch, n)
+
+    # ---- host lifecycle ----
+
+    def spawn_host(self, host_id: str | None = None,
+                   backend: str = "serial", cache_mb: int = 0,
+                   workers: int = 1, env: dict | None = None,
+                   hb_interval_s: float = 0.25):
+        """Start one ``fleet-host`` worker process against this
+        fleet's workdir.  Returns the Popen handle (also tracked for
+        shutdown)."""
+        with self._lock:
+            if host_id is None:
+                host_id = f"host{self._host_seq}"
+            self._host_seq += 1
+        cmd = [sys.executable, "-m", "mdanalysis_mpi_tpu",
+               "fleet-host", "--workdir", self.workdir,
+               "--host-id", host_id, "--backend", backend,
+               "--cache-mb", str(cache_mb),
+               "--workers", str(workers),
+               "--hb-interval", str(hb_interval_s)]
+        child_env = dict(os.environ)
+        # the host must import THIS package however the controller was
+        # launched (repo checkout, odd cwd): pin our root on the path
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        child_env["PYTHONPATH"] = pkg_root + (
+            os.pathsep + child_env["PYTHONPATH"]
+            if child_env.get("PYTHONPATH") else "")
+        if env:
+            child_env.update(env)
+        proc = subprocess.Popen(cmd, env=child_env)
+        proc._mdtpu_host_id = host_id
+        with self._lock:
+            self._procs.append(proc)
+        return proc
+
+    def kill_host(self, host_id: str, sig: int = 9) -> bool:
+        """Chaos hook: ``kill -9`` (by default) a spawned host process
+        mid-wave.  Returns whether a live process was signalled."""
+        import signal as _signal
+
+        with self._lock:
+            procs = list(self._procs)
+        for proc in procs:
+            if getattr(proc, "_mdtpu_host_id", None) == host_id \
+                    and proc.poll() is None:
+                proc.send_signal(sig if sig else _signal.SIGKILL)
+                return True
+        return False
+
+    def wait_hosts(self, n: int, timeout: float = 30.0) -> bool:
+        """Block until ``n`` hosts are alive members (spawn is async:
+        the child has to import, connect and hello)."""
+        deadline = self._clock() + timeout
+        while self._clock() < deadline:
+            with self._lock:
+                alive = sum(1 for h in self._hosts.values() if h.alive)
+            if alive >= n:
+                return True
+            time.sleep(0.02)
+        return False
+
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                sock, _addr = self._listener.accept()
+            except OSError:
+                return               # listener closed: shutdown
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(target=self._serve_conn, args=(sock,),
+                             daemon=True,
+                             name="mdtpu-fleet-conn").start()
+
+    def _serve_conn(self, sock: socket.socket) -> None:
+        """Per-connection reader: hello handshake, then heartbeats /
+        completions / fence notices until EOF."""
+        hid = None
+        try:
+            f = sock.makefile("r", encoding="utf-8")
+            for line in f:
+                try:
+                    msg = json.loads(line)
+                except ValueError:
+                    continue
+                if self._wedged:
+                    # a wedged controller is the zombie under test: it
+                    # neither applies messages nor renews leases
+                    continue
+                ev = msg.get("ev")
+                if ev == "hello":
+                    hid = self._host_hello(sock, msg)
+                elif hid is None:
+                    continue          # no handshake yet
+                elif ev == "hb":
+                    self._host_beat(hid)
+                elif ev == "done":
+                    self._apply_done(hid, msg)
+                elif ev == "fenced":
+                    self._note_fenced(hid, msg)
+        except OSError:
+            pass
+        finally:
+            with self._lock:
+                # only the host's CURRENT connection may declare it
+                # lost: a replaced (reconnected) socket's late EOF
+                # must not reap the live successor
+                current = (hid is not None
+                           and self._hosts.get(hid) is not None
+                           and self._hosts[hid].sock is sock)
+            if current and not self._shutdown:
+                self._lose_host(hid, "socket_eof")
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _host_hello(self, sock: socket.socket, msg: dict) -> str:
+        hid = str(msg.get("host"))
+        now = self._clock()
+        rejoin = False
+        with self._lock:
+            prev = self._hosts.get(hid)
+            rejoin = prev is not None
+            host = _Host(hid, sock, now + self.host_ttl_s, self.epoch)
+            self._hosts[hid] = host
+            # sync: jobs the host is still running under a previous
+            # controller (or a previous connection) stay ITS — adopt
+            # the host's assignment token so its eventual completion
+            # matches exactly; anything we don't know is ignored
+            reported = set()
+            for rec in msg.get("inflight", ()):
+                fp = rec.get("fp")
+                job = self._jobs.get(fp)
+                if job is None or job.state in _TERMINAL:
+                    continue
+                reported.add(fp)
+                if fp in self._pending:
+                    self._pending.remove(fp)
+                job.state = ASSIGNED
+                job.host = hid
+                job.assign_seq = rec.get("assign")
+                job.assign_epoch = rec.get("epoch")
+                host.inflight.add(fp)
+            # a SAME-ID replacement process (operator respawn after a
+            # kill -9 whose EOF we haven't seen yet) reports a fresh
+            # inflight set: anything the PREVIOUS incarnation was
+            # assigned but this one doesn't know died with it —
+            # migrate now, or those jobs are stranded forever (the new
+            # lease keeps renewing, so no reap would ever catch them)
+            orphans, poisoned = [], []
+            for fp in sorted(prev.inflight - reported) if prev else ():
+                job = self._jobs.get(fp)
+                if job is None or job.state in _TERMINAL:
+                    continue
+                job.migrations += 1
+                job.host = None
+                job.assign_seq = None
+                job.assign_epoch = None
+                if job.migrations >= self.poison_migrations:
+                    # same poison fence as _lose_host: a job that
+                    # kills its host every run must not keep cycling
+                    # through same-id respawns forever
+                    job.state = QUARANTINED
+                    job.error = (f"quarantined after {job.migrations} "
+                                 f"host losses (last: {hid}, "
+                                 "host_replaced)")
+                    poisoned.append(job)
+                else:
+                    job.state = QUEUED
+                    self._pending.append(fp)
+                    orphans.append(job)
+            self.placement.add_host(hid)
+            n_alive = sum(1 for h in self._hosts.values() if h.alive)
+        for job in orphans:
+            self.telemetry.count("jobs_migrated")
+            obs.METRICS.inc("mdtpu_jobs_migrated_total")
+            obs.span_event("job_migrated", host=hid, fp=job.fp,
+                           tenant=job.tenant)
+            self.journal.record("requeue", job.fp, from_host=hid,
+                                reason="host_replaced")
+        for job in poisoned:
+            self.journal.record(
+                "quarantine", job.fp,
+                reason="poison_migrations:host_replaced", durable=True)
+            obs.METRICS.inc("mdtpu_jobs_quarantined_total")
+            job._event.set()
+            if job.parent is not None:
+                self._merge_parent(job.parent)
+        self.telemetry.count("hosts_rejoined" if rejoin
+                             else "hosts_joined")
+        self.breakers.get(hid, mesh="fleet").record_success()
+        obs.METRICS.set_gauge("mdtpu_hosts_alive", n_alive)
+        obs.span_event("host_joined", host=hid, rejoin=rejoin,
+                       epoch=self.epoch)
+        self._log.info("host %s joined (epoch %d, %d alive)", hid,
+                       self.epoch, n_alive)
+        # completions the host could not deliver to the old controller
+        for done in msg.get("done", ()):
+            self._apply_done(hid, done)
+        self._dispatch()
+        return hid
+
+    def _host_beat(self, hid: str) -> None:
+        rejoined = False
+        with self._lock:
+            host = self._hosts.get(hid)
+            if host is None:
+                return
+            host.deadline = self._clock() + self.host_ttl_s
+            if not host.alive:
+                # a lease-reaped host whose partition healed: it is a
+                # member again (its migrated jobs stay migrated — the
+                # assignment tokens moved on, so its late completions
+                # fence out), and its breaker decides eligibility
+                host.alive = True
+                rejoined = True
+                self.placement.add_host(hid)
+                n_alive = sum(1 for h in self._hosts.values()
+                              if h.alive)
+        if rejoined:
+            self.telemetry.count("hosts_rejoined")
+            obs.METRICS.set_gauge("mdtpu_hosts_alive", n_alive)
+            obs.span_event("host_joined", host=hid, rejoin=True,
+                           epoch=self.epoch)
+            self._log.warning("host %s rejoined after lease reap", hid)
+            self._dispatch()
+
+    def _note_fenced(self, hid: str, msg: dict) -> None:
+        """A host refused a stale-epoch command (the zombie controller
+        is still sending): count + disclose it here, on the CURRENT
+        controller, where the operator is looking."""
+        self.telemetry.count("epoch_fenced_rejects")
+        obs.METRICS.inc("mdtpu_epoch_fenced_rejects_total",
+                        reason="stale_epoch_cmd")
+        obs.span_event("epoch_fenced_reject", host=hid,
+                       reason="stale_epoch_cmd",
+                       from_epoch=msg.get("from_epoch"))
+        self._log.warning(
+            "host %s fenced a stale-epoch command (epoch %s < %d)",
+            hid, msg.get("from_epoch"), self.epoch)
+
+    # ---- submission / dispatch ----
+
+    def submit(self, spec: dict, tenant: str = "default",
+               fingerprint: str | None = None) -> FleetJob:
+        """Queue one job spec (the ``batch`` CLI's job schema plus the
+        fleet fields ``fixture`` and ``shards``).  Returns a waitable
+        :class:`FleetJob`.  With ``shards=N`` the frame window is
+        split into N contiguous sub-windows (``parallel.partition.
+        shard_windows``) run as independent sub-jobs across the fleet,
+        and the parent's results are the frame-axis concatenation of
+        the shards' — time-series analyses only (per-frame rows), the
+        task-parallel decomposition of PAPERS.md 1801.07630."""
+        spec = dict(spec)
+        tenant = str(spec.get("tenant", tenant))
+        spec["tenant"] = tenant
+        shards = int(spec.pop("shards", 0) or 0)
+        dispatchable: list[FleetJob] = []
+        # fingerprint derivation AND registration under ONE lock
+        # scope: two concurrent submits deriving the same auto
+        # fingerprint would otherwise silently overwrite each other's
+        # FleetJob (one handle orphaned forever, two journal submits
+        # for one fp).  The counter survives deletes, unlike len().
+        with self._lock:
+            if self._shutdown:
+                raise RuntimeError("fleet controller is shut down")
+            if fingerprint is None:
+                fingerprint = (f"{tenant}|{spec.get('analysis', '?')}"
+                               f"#{self._job_seq}")
+            self._job_seq += 1
+            job = FleetJob(fingerprint, spec, tenant)
+            if shards > 1:
+                self._register_sharded_locked(job, shards)
+                dispatchable = job.children
+                if not dispatchable:
+                    # an empty frame window shards into nothing: with
+                    # no child to ever complete, the parent would hang
+                    # drain()/wait() forever — fail it NOW, typed
+                    job.state = FAILED
+                    job.error = ("sharded window is empty (no frames "
+                                 "between start and stop)")
+            else:
+                self._jobs[fingerprint] = job
+                dispatchable = [job]
+        if shards > 1 and not dispatchable:
+            job._event.set()
+            return job
+        # journal the spec-bearing submit record BEFORE the job
+        # becomes dispatchable: the supervisor tick can assign within
+        # milliseconds, and a crash after its `assign` but before this
+        # `submit` would leave adopt() a claimed job with no spec —
+        # unrecoverable work despite the journal contract
+        for d in dispatchable:
+            self.telemetry.count("jobs_submitted")
+            self.journal.record("submit", d.fp, tenant=d.tenant,
+                                spec=d.spec)
+        with self._lock:
+            for d in dispatchable:
+                self._pending.append(d.fp)
+        self._dispatch()
+        return job
+
+    def _register_sharded_locked(self, parent: FleetJob,
+                                 shards: int) -> None:
+        # caller holds self._lock
+        from mdanalysis_mpi_tpu.parallel.partition import shard_windows
+
+        spec = parent.spec
+        n_frames = spec.get("fixture", {}).get("n_frames")
+        windows = shard_windows(n_frames, spec.get("start"),
+                                spec.get("stop"), spec.get("step"),
+                                shards)
+        parent.children = []
+        for i, win in enumerate(windows):
+            if win is None:
+                continue
+            sub = {k: v for k, v in spec.items()}
+            sub["start"], sub["stop"], sub["step"] = win
+            child = FleetJob(f"{parent.fp}/s{i}", sub, parent.tenant)
+            child.parent = parent
+            child.shard_index = i
+            parent.children.append(child)
+        self._jobs[parent.fp] = parent
+        for child in parent.children:
+            self._jobs[child.fp] = child
+
+    def _dispatch(self) -> None:
+        """Assign every pending job to its tenant's home host (sticky
+        placement).  Socket sends and journal records run OUTSIDE the
+        lock; a failed send loses the host (which re-pends the job)."""
+        if self._wedged:
+            return
+        sends = []
+        with self._lock:
+            still = []
+            for fp in self._pending:
+                job = self._jobs.get(fp)
+                if job is None or job.state in _TERMINAL:
+                    continue
+                # a sharded child routes by (tenant, shard): the whole
+                # point of trajectory sharding is spreading one
+                # tenant's window over the fleet, so the shards must
+                # not all ride the tenant's sticky home
+                key = (job.tenant if job.shard_index is None
+                       else f"{job.tenant}#s{job.shard_index}")
+                hid = self.placement.assign(key)
+                host = self._hosts.get(hid) if hid else None
+                if host is None or not host.alive:
+                    still.append(fp)     # degraded to zero hosts: park
+                    continue
+                self._assign_seq += 1
+                job.state = ASSIGNED
+                job.host = hid
+                job.assign_seq = self._assign_seq
+                job.assign_epoch = self.epoch
+                host.inflight.add(fp)
+                sends.append((host, job,
+                              {"cmd": "run", "fp": fp,
+                               "assign": job.assign_seq,
+                               "epoch": self.epoch,
+                               "job": job.spec}))
+            self._pending[:] = still
+        lost = set()
+        for host, job, msg in sends:
+            self.journal.record("assign", job.fp, host=host.hid)
+            if host.hid not in lost and \
+                    not _send_line(host.sock, host.send_lock, msg):
+                lost.add(host.hid)
+        for hid in lost:
+            self._lose_host(hid, "send_failed")
+
+    # ---- completion application (exactly-once) ----
+
+    def _apply_done(self, hid: str, msg: dict) -> None:
+        """Apply one host completion iff its ``(host, epoch, assign)``
+        token IS the job's current assignment — the epoch fence, one
+        level up from ``Scheduler._complete``'s lease token.  A zombie
+        host's completion for a migrated job, or any stale-epoch
+        leftover, is rejected and counted; a duplicate re-delivery of
+        the ALREADY-APPLIED completion (the host resends until acked)
+        is re-acked silently."""
+        fp = msg.get("fp")
+        token = (hid, msg.get("epoch"), msg.get("assign"))
+        reject = None
+        with self._lock:
+            job = self._jobs.get(fp)
+            if job is None:
+                reject = "unknown_job"
+            elif job.state in _TERMINAL:
+                cur = (job.host, job.assign_epoch, job.assign_seq)
+                reject = "duplicate" if cur == token else \
+                    "stale_assignment"
+            elif (job.host, job.assign_epoch,
+                  job.assign_seq) != token:
+                if job.host is None and \
+                        (msg.get("epoch") or 0) <= self.epoch:
+                    # adoption: a journal-recovered job no controller
+                    # has re-dispatched, completed by the host that
+                    # was running it under the old epoch — honoring
+                    # it IS exactly-once (re-running would not be).
+                    # The job adopts the host's token.
+                    job.host, job.assign_epoch, job.assign_seq = token
+                    if fp in self._pending:
+                        self._pending.remove(fp)
+                else:
+                    reject = ("stale_epoch"
+                              if (msg.get("epoch") or 0) < self.epoch
+                              and job.assign_epoch != msg.get("epoch")
+                              else "stale_assignment")
+            if reject is None:
+                job.state = DONE if msg.get("state") == "done" \
+                    else FAILED
+                job.results = msg.get("results")
+                job.error = msg.get("error")
+                job.resident = msg.get("resident")
+                host = self._hosts.get(hid)
+                if host is not None:
+                    host.inflight.discard(fp)
+                    host.deadline = self._clock() + self.host_ttl_s
+        ack = {"cmd": "ack", "fp": fp}
+        host = self._hosts.get(hid)
+        if reject is not None:
+            if reject != "duplicate":
+                self.telemetry.count("epoch_fenced_rejects")
+                obs.METRICS.inc("mdtpu_epoch_fenced_rejects_total",
+                                reason=reject)
+                obs.span_event("epoch_fenced_reject", host=hid,
+                               fp=fp, reason=reject)
+                self._log.warning(
+                    "rejected completion of %s from %s (%s): token "
+                    "%r is not the current assignment", fp, hid,
+                    reject, token)
+            if host is not None:
+                _send_line(host.sock, host.send_lock, ack)
+            return
+        # accepted: durable terminal record BEFORE the ack — exactly
+        # the journal-then-ack order that makes re-delivery idempotent
+        # across controller crashes (replay sees the finish; the
+        # resent completion is rejected as duplicate)
+        self.journal.record("finish", fp, state=job.state,
+                            durable=True)
+        self.telemetry.count("jobs_completed" if job.state == DONE
+                             else "jobs_failed")
+        if job.resident is not None:
+            self.telemetry.count("home_hits" if job.resident
+                                 else "home_misses")
+        self.breakers.get(hid, mesh="fleet").record_success()
+        if host is not None:
+            _send_line(host.sock, host.send_lock, ack)
+        job._event.set()
+        if job.parent is not None:
+            self._merge_parent(job.parent)
+        self._dispatch()
+
+    def _merge_parent(self, parent: FleetJob) -> None:
+        """Complete a sharded parent once every child is terminal:
+        frame-axis concatenation of the shards' result arrays, in
+        shard order (partition-aware merge — the map-reduce half of
+        the task-parallel decomposition)."""
+        import numpy as np
+
+        with self._lock:
+            children = list(parent.children or ())
+            if parent.state in _TERMINAL or \
+                    not all(c.done() for c in children):
+                return
+            failed = [c for c in children if c.state != DONE]
+            if failed:
+                parent.state = FAILED
+                parent.error = (f"{len(failed)} shard(s) failed: "
+                                f"{failed[0].error}")
+            else:
+                merged: dict = {}
+                ordered = sorted(children,
+                                 key=lambda c: c.shard_index)
+                for name in (ordered[0].results or {}):
+                    try:
+                        arrays = [np.asarray(c.results[name])
+                                  for c in ordered]
+                        # a concatenation is only a correct merge when
+                        # each shard's leading axis IS its frame
+                        # window — anything else (per-atom RMSF, a
+                        # scalar) would concat fine and be silently
+                        # WRONG, the exact failure class PR-9 forbids
+                        for c, arr in zip(ordered, arrays):
+                            n = len(range(c.spec["start"],
+                                          c.spec["stop"],
+                                          c.spec["step"]))
+                            if arr.ndim == 0 or arr.shape[0] != n:
+                                raise ValueError(
+                                    f"shard {c.shard_index} produced "
+                                    f"shape {arr.shape}, not a "
+                                    f"{n}-frame series")
+                        merged[name] = np.concatenate(
+                            arrays, axis=0).tolist()
+                    except (KeyError, ValueError) as exc:
+                        parent.state = FAILED
+                        parent.error = (
+                            f"shard merge failed for {name!r}: {exc} "
+                            "(sharded jobs must produce per-frame "
+                            "series)")
+                        break
+                else:
+                    parent.state = DONE
+                    parent.results = merged
+        parent._event.set()
+
+    # ---- host loss / migration ----
+
+    def _lose_host(self, hid: str, reason: str) -> None:
+        with self._lock:
+            host = self._hosts.get(hid)
+            if host is None or not host.alive or self._shutdown \
+                    or self._wedged:
+                # a wedged (zombie) controller must not act on the
+                # fleet — migration is the adopting standby's job
+                return
+            host.alive = False
+            self.placement.remove_host(hid)
+            migrate, quarantine = [], []
+            for fp in sorted(host.inflight):
+                job = self._jobs.get(fp)
+                if job is None or job.state in _TERMINAL:
+                    continue
+                job.migrations += 1
+                job.state = QUEUED
+                # the assignment token moves on NOW: the dead/zombie
+                # host's eventual completion can no longer match
+                job.host = None
+                job.assign_seq = None
+                job.assign_epoch = None
+                if job.migrations >= self.poison_migrations:
+                    quarantine.append(job)
+                else:
+                    migrate.append(job)
+                    self._pending.append(fp)
+            host.inflight.clear()
+            n_alive = sum(1 for h in self._hosts.values() if h.alive)
+        self.telemetry.count("hosts_lost")
+        obs.METRICS.inc("mdtpu_hosts_lost_total", reason=reason)
+        obs.METRICS.set_gauge("mdtpu_hosts_alive", n_alive)
+        obs.span_event("host_lost", host=hid, reason=reason,
+                       n_migrated=len(migrate))
+        self.breakers.get(hid, mesh="fleet").record_failure()
+        self._log.warning(
+            "host %s lost (%s): %d job(s) migrating to %d survivor(s)",
+            hid, reason, len(migrate), n_alive)
+        for job in migrate:
+            self.telemetry.count("jobs_migrated")
+            obs.METRICS.inc("mdtpu_jobs_migrated_total")
+            obs.span_event("job_migrated", host=hid, fp=job.fp,
+                           tenant=job.tenant)
+            self.journal.record("requeue", job.fp, from_host=hid,
+                                reason=reason)
+        for job in quarantine:
+            with self._lock:
+                job.state = QUARANTINED
+                job.error = (f"quarantined after {job.migrations} "
+                             f"host losses (last: {hid}, {reason})")
+            self.journal.record("quarantine", job.fp,
+                                reason=f"poison_migrations:{reason}",
+                                durable=True)
+            obs.METRICS.inc("mdtpu_jobs_quarantined_total")
+            job._event.set()
+            if job.parent is not None:
+                # a quarantined shard is its parent's LAST terminal
+                # child as far as _apply_done is concerned — without
+                # this, the parent never resolves and drain() hangs
+                self._merge_parent(job.parent)
+        if self.respawn_hosts and not self._shutdown:
+            self.spawn_host()
+        self._dispatch()
+
+    # ---- supervisor ----
+
+    def _supervisor(self) -> None:
+        while True:
+            time.sleep(self.tick_s)
+            if self._shutdown:
+                return
+            if self._wedged:
+                continue
+            now = self._clock()
+            with self._lock:
+                expired = [h.hid for h in self._hosts.values()
+                           if h.alive and h.deadline <= now]
+                dead_procs = [
+                    getattr(p, "_mdtpu_host_id", None)
+                    for p in self._procs
+                    if p.poll() is not None
+                    and getattr(p, "_mdtpu_host_id", None)
+                    in self._hosts
+                    and self._hosts[p._mdtpu_host_id].alive]
+            for hid in expired:
+                self._lose_host(hid, "lease_expired")
+            for hid in dead_procs:
+                if hid is not None:
+                    self._lose_host(hid, "host_death")
+            self._dispatch()
+
+    # ---- lifecycle ----
+
+    def drain(self, timeout: float | None = None) -> bool:
+        """Block until every submitted job is terminal."""
+        deadline = None if timeout is None else self._clock() + timeout
+        while True:
+            with self._lock:
+                jobs = list(self._jobs.values())
+            open_jobs = [j for j in jobs if not j.done()]
+            if not open_jobs:
+                return True
+            if deadline is not None and self._clock() >= deadline:
+                return False
+            open_jobs[0].wait(0.05)
+
+    def wedge(self) -> None:
+        """Chaos hook: this controller stops processing — incoming
+        messages are dropped, leases stop renewing, dispatch stops —
+        but its sockets and journal stay OPEN: the zombie-controller
+        shape epoch fencing exists for."""
+        with self._lock:
+            self._wedged = True
+        self._log.error("controller (epoch %d) wedged — standing by "
+                        "for adoption", self.epoch)
+
+    def zombie_send(self, host_id: str, spec: dict | None = None) -> bool:
+        """Chaos hook for a WEDGED controller: send one (stale-epoch)
+        run command down its old socket to ``host_id``, as a zombie
+        that briefly wakes would.  Returns whether the bytes left."""
+        with self._lock:
+            host = self._hosts.get(host_id)
+        if host is None:
+            return False
+        return _send_line(host.sock, host.send_lock, {
+            "cmd": "run", "fp": f"zombie-{self.epoch}",
+            "assign": -1, "epoch": self.epoch,
+            "job": spec or {"analysis": "rmsf"}})
+
+    def jobs(self) -> dict:
+        """``{fingerprint: FleetJob}`` snapshot (a standby's adopted
+        jobs are ITS objects — the failover tests read results from
+        the adopting controller, not the wedged one)."""
+        with self._lock:
+            return dict(self._jobs)
+
+    def stats(self) -> dict:
+        """Flat JSON snapshot: fleet telemetry + membership +
+        placement (the fleet bench leg's fields)."""
+        with self._lock:
+            alive = sorted(h.hid for h in self._hosts.values()
+                           if h.alive)
+            jobs = list(self._jobs.values())
+        out = self.telemetry.snapshot()
+        out.update({
+            "epoch": self.epoch,
+            "hosts_alive": len(alive),
+            "hosts": alive,
+            "jobs_total": len(jobs),
+            "jobs_done": sum(1 for j in jobs if j.state == DONE),
+            "placement": self.placement.snapshot(),
+        })
+        return out
+
+    def shutdown(self) -> None:
+        with self._lock:
+            if self._shutdown:
+                return
+            self._shutdown = True
+            hosts = list(self._hosts.values())
+            procs = list(self._procs)
+        for host in hosts:
+            _send_line(host.sock, host.send_lock,
+                       {"cmd": "stop", "epoch": self.epoch})
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        for proc in procs:
+            try:
+                proc.wait(timeout=5.0)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+        for host in hosts:
+            try:
+                host.sock.close()
+            except OSError:
+                pass
+        self.journal.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False
+
+
+# ---------------------------------------------------------------------------
+# host worker process (the `fleet-host` CLI)
+# ---------------------------------------------------------------------------
+
+def _build_universe(spec: dict):
+    """Tenant state: a synthetic fixture (``fixture`` key — the chaos
+    tests' deterministic shape, reproducible in every process from the
+    seed alone) or real files."""
+    fixture = spec.get("fixture")
+    if fixture:
+        from mdanalysis_mpi_tpu import testing as _testing
+
+        kind = fixture.get("kind", "protein")
+        kwargs = {k: v for k, v in fixture.items() if k != "kind"}
+        if kind == "protein":
+            return _testing.make_protein_universe(**kwargs)
+        if kind == "md":
+            return _testing.make_md_universe(**kwargs)
+        raise ValueError(f"unknown fixture kind {kind!r}")
+    from mdanalysis_mpi_tpu import Universe
+
+    return Universe(spec["topology"], spec.get("trajectory"))
+
+
+def _tenant_key(spec: dict) -> str:
+    """The identity of a tenant's resident state on a host: its data
+    source.  Wave 2 of a sticky tenant hits this key on its home host
+    — the host-level analog of a cache hit."""
+    fixture = spec.get("fixture")
+    src = fixture if fixture else {"topology": spec.get("topology"),
+                                   "trajectory": spec.get("trajectory")}
+    return json.dumps({"tenant": spec.get("tenant"), "src": src},
+                      sort_keys=True)
+
+
+class _HostWorker:
+    """One fleet host: local scheduler + controller link."""
+
+    def __init__(self, workdir: str, host_id: str, backend: str,
+                 cache_mb: int, workers: int, hb_interval_s: float):
+        from mdanalysis_mpi_tpu.service.scheduler import Scheduler
+
+        self.workdir = workdir
+        self.host_id = host_id
+        self.backend = backend
+        self.hb_interval_s = hb_interval_s
+        cache = None
+        if backend in ("jax", "mesh"):
+            # the `fleet-host` entry skips the top-level platform
+            # re-pin so SERIAL hosts stay jax-free; a device-backend
+            # host pays it here, before its first dispatch
+            from mdanalysis_mpi_tpu.utils.platform import (
+                honor_cpu_request,
+            )
+
+            honor_cpu_request()
+        if cache_mb and backend in ("jax", "mesh"):
+            from mdanalysis_mpi_tpu.parallel.executors import (
+                DeviceBlockCache,
+            )
+
+            cache = DeviceBlockCache(max_bytes=int(cache_mb) << 20)
+        self.cache = cache
+        self.sched = Scheduler(n_workers=workers, cache=cache,
+                               prefetch=cache is not None)
+        self._log = get_logger("mdtpu.fleet")
+        self._lock = threading.Lock()
+        self._universes: dict[str, object] = {}
+        self._inflight: dict[str, tuple] = {}   # fp -> (assign, epoch)
+        self._unacked: dict[str, dict] = {}     # fp -> done msg
+        self._fenced = 0
+        self._epoch = 0
+        self._sock: socket.socket | None = None
+        self._send_lock = threading.Lock()
+        self._stop = threading.Event()
+        # deterministic partition fault for the chaos tests:
+        # MDTPU_FLEET_HB_PAUSE="<fp-substring>:<seconds>" silences ALL
+        # outgoing traffic (heartbeats AND completions) for <seconds>
+        # once a matching run command arrives — the lease expires, the
+        # controller migrates, and this host's late completion must
+        # fence out
+        self._pause_until = 0.0
+        self._pause_spec = os.environ.get("MDTPU_FLEET_HB_PAUSE")
+        self._run_delay = float(
+            os.environ.get("MDTPU_FLEET_RUN_DELAY", "0") or 0)
+        # span attribution per host (docs/OBSERVABILITY.md): every
+        # span/instant this process records carries its host id
+        obs.set_process_args(fleet_host=host_id)
+
+    # ---- outgoing ----
+
+    def _paused(self) -> bool:
+        return time.monotonic() < self._pause_until
+
+    def _send(self, msg: dict) -> bool:
+        if self._paused():
+            return False
+        sock = self._sock
+        if sock is None:
+            return False
+        return _send_line(sock, self._send_lock, msg)
+
+    # ---- controller link ----
+
+    def _connect(self, info: dict) -> None:
+        try:
+            sock = socket.create_connection(
+                (info.get("host", "127.0.0.1"), info["port"]),
+                timeout=5.0)
+        except OSError:
+            return
+        sock.settimeout(None)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        with self._lock:
+            self._epoch = int(info.get("epoch", 0))
+            # the OLD socket stays open and its reader keeps running:
+            # a zombie controller's late commands must be READ to be
+            # fenced (and EOF cleans it up)
+            self._sock = sock
+            hello = {"ev": "hello", "host": self.host_id,
+                     "pid": os.getpid(), "epoch": self._epoch,
+                     "inflight": [
+                         {"fp": fp, "assign": a, "epoch": e}
+                         for fp, (a, e) in self._inflight.items()],
+                     "done": list(self._unacked.values())}
+        _send_line(sock, self._send_lock, hello)
+        threading.Thread(target=self._reader, args=(sock,),
+                         daemon=True,
+                         name=f"mdtpu-fleet-{self.host_id}-rx").start()
+        self._log.info("host %s connected to controller (epoch %d)",
+                       self.host_id, self._epoch)
+
+    def _reader(self, sock: socket.socket) -> None:
+        try:
+            f = sock.makefile("r", encoding="utf-8")
+            for line in f:
+                try:
+                    msg = json.loads(line)
+                except ValueError:
+                    continue
+                cmd = msg.get("cmd")
+                if cmd == "run":
+                    self._handle_run(msg)
+                elif cmd == "ack":
+                    with self._lock:
+                        self._unacked.pop(msg.get("fp"), None)
+                elif cmd == "stop":
+                    with self._lock:
+                        stale = (msg.get("epoch") or 0) < self._epoch
+                    if stale:
+                        # a zombie controller must not be able to
+                        # stop the fleet's hosts — same fence as run
+                        self._fenced += 1
+                        self._send({"ev": "fenced",
+                                    "host": self.host_id,
+                                    "fp": None,
+                                    "from_epoch": msg.get("epoch")})
+                        continue
+                    self._stop.set()
+                    return
+        except OSError:
+            pass
+        finally:
+            with self._lock:
+                if self._sock is sock:
+                    self._sock = None     # reconnect on next hb tick
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    # ---- command handling ----
+
+    def _handle_run(self, msg: dict) -> None:
+        fp = msg.get("fp")
+        with self._lock:
+            epoch = self._epoch
+        if (msg.get("epoch") or 0) < epoch:
+            # epoch fence, host side: a zombie controller's command.
+            # Refused here AND disclosed to the CURRENT controller.
+            self._fenced += 1
+            obs.span_event("epoch_fenced_reject", fp=fp,
+                           reason="stale_epoch_cmd",
+                           from_epoch=msg.get("epoch"))
+            self._log.warning(
+                "host %s fenced stale-epoch command for %s "
+                "(epoch %s < %d)", self.host_id, fp,
+                msg.get("epoch"), epoch)
+            self._send({"ev": "fenced", "host": self.host_id,
+                        "fp": fp, "from_epoch": msg.get("epoch")})
+            return
+        spec = dict(msg.get("job") or {})
+        if self._pause_spec:
+            sub, _, secs = self._pause_spec.partition(":")
+            if sub and sub in str(fp):
+                # one-shot per host: a migrated matching job must not
+                # re-partition every host it lands on forever
+                self._pause_spec = None
+                self._pause_until = time.monotonic() + float(secs or 1)
+                self._log.warning(
+                    "host %s: simulating partition for %ss (fault "
+                    "knob)", self.host_id, secs)
+        if self._run_delay:
+            # deterministic chaos window (MDTPU_FLEET_RUN_DELAY): the
+            # job is accepted but held here, so a kill -9 / wedge
+            # landing "mid-wave" in a test reliably finds work in
+            # flight instead of racing millisecond jobs
+            time.sleep(self._run_delay)
+        token = (msg.get("assign"), msg.get("epoch"))
+        with self._lock:
+            self._inflight[fp] = token
+        try:
+            handle, resident = self._submit_local(fp, spec)
+        except Exception as exc:
+            self._finish(fp, token, state="failed",
+                         error=f"{type(exc).__name__}: {exc}",
+                         resident=False)
+            return
+        handle.add_done_callback(
+            lambda h, fp=fp, token=token, resident=resident:
+            self._on_local_done(fp, token, resident, h))
+
+    def _submit_local(self, fp: str, spec: dict):
+        from mdanalysis_mpi_tpu.service.cli import _build_job
+
+        key = _tenant_key(spec)
+        with self._lock:
+            u = self._universes.get(key)
+            resident = u is not None
+        if u is None:
+            u = _build_universe(spec)
+            with self._lock:
+                self._universes[key] = u
+        clean = {k: v for k, v in spec.items()
+                 if k not in _FLEET_SPEC_KEYS}
+        clean.setdefault("backend", self.backend)
+        clean.pop("output", None)     # results travel the wire instead
+        job, _cfg, _output = _build_job(clean, {}, u)
+        job.fingerprint = fp
+        return self.sched.submit(job), resident
+
+    def _on_local_done(self, fp: str, token, resident: bool,
+                       handle) -> None:
+        from mdanalysis_mpi_tpu.service.cli import _result_arrays
+
+        if handle.error is None:
+            try:
+                results = {k: v.tolist()
+                           for k, v in
+                           _result_arrays(handle.job.analysis).items()}
+                self._finish(fp, token, state="done",
+                             results=results, resident=resident)
+                return
+            except Exception as exc:
+                self._finish(fp, token, state="failed",
+                             error=f"{type(exc).__name__}: {exc}",
+                             resident=resident)
+                return
+        self._finish(fp, token, state="failed",
+                     error=f"{type(handle.error).__name__}: "
+                           f"{handle.error}", resident=resident)
+
+    def _finish(self, fp: str, token, **fields) -> None:
+        msg = {"ev": "done", "host": self.host_id, "fp": fp,
+               "assign": token[0], "epoch": token[1], **fields}
+        with self._lock:
+            self._inflight.pop(fp, None)
+            self._unacked[fp] = msg
+        self._send(msg)
+
+    # ---- main loop ----
+
+    def run(self) -> int:
+        while not self._stop.is_set():
+            info = _read_addr_file(self.workdir)
+            with self._lock:
+                sock = self._sock
+                epoch = self._epoch
+            if info is not None and (sock is None
+                                     or int(info.get("epoch", 0))
+                                     > epoch):
+                if not self._paused():
+                    # failover: a newer controller published itself —
+                    # switch, syncing in-flight + unacked completions
+                    self._connect(info)
+            self._send({"ev": "hb", "host": self.host_id,
+                        "epoch": self._epoch})
+            # completion re-delivery until acked (idempotent on the
+            # controller: token match → duplicate → re-ack)
+            with self._lock:
+                unacked = list(self._unacked.values())
+            for msg in unacked:
+                self._send(msg)
+            self._stop.wait(self.hb_interval_s)
+        self.sched.shutdown(wait=False)
+        return 0
+
+
+def host_main(argv=None) -> int:
+    """Entry point of the ``fleet-host`` subcommand (one fleet host
+    worker process; spawned by :meth:`FleetController.spawn_host`)."""
+    import argparse
+
+    p = argparse.ArgumentParser(prog="mdanalysis_mpi_tpu fleet-host")
+    p.add_argument("--workdir", required=True)
+    p.add_argument("--host-id", required=True)
+    p.add_argument("--backend", default="serial")
+    p.add_argument("--cache-mb", type=int, default=0)
+    p.add_argument("--workers", type=int, default=1)
+    p.add_argument("--hb-interval", type=float, default=0.25)
+    ns = p.parse_args(argv)
+    worker = _HostWorker(ns.workdir, ns.host_id, ns.backend,
+                         ns.cache_mb, ns.workers, ns.hb_interval)
+    return worker.run()
+
+
+# ---------------------------------------------------------------------------
+# dryrun smoke (scripts/verify.sh) + fleet CLI
+# ---------------------------------------------------------------------------
+
+def fleet_smoke(workdir=None, n_hosts: int = 2,
+                kill_mid_wave: bool = True) -> dict:
+    """The dryrun serving leg at smoke scale: K tenants across
+    ``n_hosts`` host processes, one ``kill -9`` mid-wave, exactly-once
+    audited against the journal.  Returns the outcome record
+    (``ok`` + the controller stats); raises nothing — failures land in
+    the record so the caller can print-and-exit."""
+    import shutil
+    import tempfile
+
+    # ALWAYS a fresh subdirectory (under the caller's dir when given):
+    # a reused journal would carry earlier smokes' identical
+    # fingerprints, making any exactly-once audit ambiguous
+    if workdir is not None:
+        os.makedirs(str(workdir), exist_ok=True)
+    workdir = tempfile.mkdtemp(prefix="mdtpu-fleet-smoke-",
+                               dir=workdir)
+    fixture = {"kind": "protein", "n_residues": 8, "n_frames": 10,
+               "noise": 0.2, "seed": 3}
+    record: dict = {"ok": False}
+    try:
+        with FleetController(workdir, host_ttl_s=2.0) as ctrl:
+            for _ in range(n_hosts):
+                ctrl.spawn_host()
+            if not ctrl.wait_hosts(n_hosts, timeout=60.0):
+                record["error"] = "hosts never joined"
+                return record
+            jobs = [ctrl.submit({"analysis": "rmsf",
+                                 "fixture": fixture,
+                                 "tenant": f"t{i % 4}"})
+                    for i in range(8)]
+            if kill_mid_wave:
+                victim = sorted(ctrl.placement.hosts())[0]
+                ctrl.kill_host(victim)
+            if not ctrl.drain(timeout=120.0):
+                record["error"] = "drain timed out"
+                return record
+            record["jobs_done"] = sum(1 for j in jobs
+                                      if j.state == DONE)
+            record["stats"] = ctrl.stats()
+        meta = _journal.replay_fleet(
+            os.path.join(workdir, JOURNAL_NAME))
+        # audit THIS run's jobs only: a reused --workdir journal
+        # legitimately carries earlier runs' finishes too
+        record["exactly_once"] = all(
+            meta["finishes"].get(j.fp) == 1 for j in jobs)
+        record["ok"] = (record["jobs_done"] == len(jobs)
+                        and record["exactly_once"])
+        return record
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+def fleet_main(argv=None) -> int:
+    """Entry point of the ``fleet`` subcommand: ``--smoke`` runs the
+    dryrun chaos smoke (scripts/verify.sh stage 2); otherwise a JSON
+    job file (the ``batch`` schema plus ``hosts``/``fixture``/
+    ``shards`` fields) is served across spawned host processes."""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="mdanalysis_mpi_tpu fleet",
+        description="serve a job file across N fleet host processes "
+                    "(controller tier: sticky placement, host-loss "
+                    "migration, epoch-fenced journal — "
+                    "docs/RELIABILITY.md §6)")
+    p.add_argument("jobs_file", nargs="?", default=None)
+    p.add_argument("--smoke", action="store_true",
+                   help="run the dryrun chaos smoke (2 hosts, one "
+                        "kill -9 mid-wave, exactly-once audit) and "
+                        "exit 0/1")
+    p.add_argument("--hosts", type=int, default=2)
+    p.add_argument("--workdir", default=None,
+                   help="fleet journal/address directory (default: "
+                        "a temp dir; pass the SAME dir to a standby "
+                        "for adoption)")
+    p.add_argument("--backend", default="serial")
+    p.add_argument("--cache-mb", type=int, default=0)
+    ns = p.parse_args(argv)
+
+    if ns.smoke:
+        record = fleet_smoke(workdir=ns.workdir)
+        print(json.dumps(record))
+        return 0 if record.get("ok") else 1
+    if not ns.jobs_file:
+        p.error("a jobs file (or --smoke) is required")
+    with open(ns.jobs_file, encoding="utf-8") as f:
+        spec = json.load(f)
+
+    import shutil
+    import tempfile
+
+    owns = ns.workdir is None
+    workdir = ns.workdir or tempfile.mkdtemp(prefix="mdtpu-fleet-")
+    n_hosts = int(spec.get("hosts", ns.hosts))
+    defaults = dict(spec.get("defaults", {}))
+    t0 = time.perf_counter()
+    try:
+        with FleetController(workdir) as ctrl:
+            for _ in range(n_hosts):
+                ctrl.spawn_host(backend=ns.backend,
+                                cache_mb=ns.cache_mb)
+            if not ctrl.wait_hosts(n_hosts, timeout=120.0):
+                print(json.dumps({"error": "hosts never joined"}))
+                return 1
+            jobs = [ctrl.submit({**defaults, **js})
+                    for js in spec.get("jobs", [])]
+            ok = ctrl.drain(timeout=float(spec.get("timeout_s", 3600)))
+            records = [{"fp": j.fp, "tenant": j.tenant,
+                        "state": j.state, "host": j.host,
+                        "error": j.error} for j in jobs]
+            out = {"jobs": records,
+                   "wall_s": round(time.perf_counter() - t0, 4),
+                   "drained": ok, "fleet": ctrl.stats()}
+        print(json.dumps(out))
+        return 0 if ok and all(j.state == DONE for j in jobs) else 1
+    finally:
+        if owns:
+            shutil.rmtree(workdir, ignore_errors=True)

@@ -73,9 +73,18 @@ _PROTECTED_STATES = ("done", "quarantined")
 class JobJournal:
     """Append-side of the journal (one per scheduler)."""
 
-    def __init__(self, path, fsync_batch: int = 16):
+    def __init__(self, path, fsync_batch: int = 16,
+                 epoch: int | None = None):
         self.path = str(path)
         self.fsync_batch = max(1, int(fsync_batch))
+        #: Controller epoch stamped into every record (fleet tier,
+        #: docs/RELIABILITY.md §6).  None — the single-process
+        #: scheduler journal — writes epoch-less records, which replay
+        #: treats as epoch 0 (always current).  A standby that adopts
+        #: the journal constructs its JobJournal with the BUMPED epoch,
+        #: and :func:`replay_fleet` then fences every record a zombie
+        #: controller appends under the old one.
+        self.epoch = epoch
         self._lock = threading.Lock()
         self._f = open(self.path, "a", encoding="utf-8")
         self._unsynced = 0
@@ -101,6 +110,8 @@ class JobJournal:
         journal to in-memory — counted, never fatal to the worker."""
         rec = {"ev": ev, "fp": fingerprint,
                "t": round(time.time(), 3), **fields}
+        if self.epoch is not None:
+            rec.setdefault("epoch", self.epoch)
         rec["crc"] = _integrity.record_crc(rec)
         line = json.dumps(rec, sort_keys=True) + "\n"
         with self._lock:
@@ -197,6 +208,17 @@ def replay(path) -> dict:
     torn mid-record and is rejected.
     """
     jobs: dict = {}
+    for rec in _verified_records(path):
+        _fold_record(jobs, rec)
+    return jobs
+
+
+def _verified_records(path) -> list[dict]:
+    """Parse + CRC-verify a journal file: every surviving record, in
+    order (the shared front half of :func:`replay` and
+    :func:`replay_fleet` — torn-tail skip, typed interior rejection,
+    and the pre-CRC grandfather clause live HERE so the two replays
+    cannot drift on what counts as a valid record)."""
     # errors="replace": a flipped byte that breaks UTF-8 must surface
     # as an unparseable RECORD (typed rejection / torn-tail skip, per
     # position), not as a UnicodeDecodeError escaping the replay
@@ -222,6 +244,7 @@ def replay(path) -> dict:
         get_logger("mdtpu.service").warning(
             "journal %s carries no CRC frames (written before "
             "integrity framing): replaying unverified", path)
+    out = []
     for lineno, rec in parsed:
         if not legacy and not _integrity.verify_record(rec):
             _integrity.note_corrupt("journal", str(path))
@@ -230,28 +253,86 @@ def replay(path) -> dict:
                 "— the record's bytes are not the bytes that were "
                 "written; refusing to replay corrupt job state",
                 artifact="journal", path=str(path))
-        fp = rec.get("fp")
-        ev = rec.get("ev")
-        if fp is None or ev is None:
+        out.append(rec)
+    return out
+
+
+def _fold_record(jobs: dict, rec: dict) -> None:
+    """Fold one verified record into the per-job state map (shared by
+    both replays; ``assign`` is the fleet tier's name for ``claim`` —
+    a host took the job)."""
+    fp = rec.get("fp")
+    ev = rec.get("ev")
+    if fp is None or ev is None:
+        return
+    st = jobs.setdefault(fp, {"state": None, "claims": 0,
+                              "submits": 0, "requeues": 0,
+                              "reason": None})
+    if ev == "submit":
+        st["submits"] += 1
+        if st["state"] not in _PROTECTED_STATES:
+            st["state"] = "queued"
+    elif ev in ("claim", "assign"):
+        st["claims"] += 1
+        if st["state"] not in _PROTECTED_STATES:
+            st["state"] = "claimed"
+    elif ev == "requeue":
+        st["requeues"] += 1
+        if st["state"] not in _PROTECTED_STATES:
+            st["state"] = "queued"
+    elif ev == "quarantine":
+        st["state"] = "quarantined"
+        st["reason"] = rec.get("reason")
+    elif ev == "finish":
+        st["state"] = rec.get("state", "done")
+
+
+def replay_fleet(path) -> dict:
+    """Fleet-journal replay with **epoch fencing**
+    (docs/RELIABILITY.md §6): records carry the writing controller's
+    epoch, ``epoch`` records mark a controller (re)taking ownership,
+    and any record stamped with an epoch OLDER than the highest
+    ``epoch`` record seen so far is a zombie controller's append —
+    REJECTED (counted, never folded), so a wedged old controller that
+    keeps writing after a standby adopted the journal cannot corrupt
+    the replayed job state.
+
+    Returns ``{"jobs": {fp: record}, "epoch": last adopted epoch,
+    "stale_records": zombie appends rejected, "finishes": {fp: n}}``
+    — ``finishes`` counts ACCEPTED terminal records per job, the
+    exactly-once ledger the chaos tests audit.  Epoch-less records
+    (a pre-fleet journal) are treated as epoch 0: always current
+    until the first ``epoch`` record appears.
+    """
+    jobs: dict = {}
+    finishes: dict = {}
+    current = 0
+    stale = 0
+    for rec in _verified_records(path):
+        e = rec.get("epoch")
+        if rec.get("ev") == "epoch":
+            if e is not None and e >= current:
+                current = e
+            else:
+                stale += 1
             continue
-        st = jobs.setdefault(fp, {"state": None, "claims": 0,
-                                  "submits": 0, "requeues": 0,
-                                  "reason": None})
-        if ev == "submit":
-            st["submits"] += 1
-            if st["state"] not in _PROTECTED_STATES:
-                st["state"] = "queued"
-        elif ev == "claim":
-            st["claims"] += 1
-            if st["state"] not in _PROTECTED_STATES:
-                st["state"] = "claimed"
-        elif ev == "requeue":
-            st["requeues"] += 1
-            if st["state"] not in _PROTECTED_STATES:
-                st["state"] = "queued"
-        elif ev == "quarantine":
-            st["state"] = "quarantined"
-            st["reason"] = rec.get("reason")
-        elif ev == "finish":
-            st["state"] = rec.get("state", "done")
-    return jobs
+        if e is not None and e < current:
+            stale += 1
+            continue
+        _fold_record(jobs, rec)
+        if rec.get("ev") == "submit" and rec.get("fp") in jobs:
+            # the fleet submit record carries the job's SPEC: a
+            # standby can re-own unfinished jobs from the journal
+            # alone, without the original submitter
+            jobs[rec["fp"]]["spec"] = rec.get("spec")
+            jobs[rec["fp"]]["tenant"] = rec.get("tenant")
+        if rec.get("ev") in ("finish", "quarantine") \
+                and rec.get("fp") is not None:
+            finishes[rec["fp"]] = finishes.get(rec["fp"], 0) + 1
+    if stale:
+        get_logger("mdtpu.service").warning(
+            "journal %s: rejected %d record(s) from stale controller "
+            "epochs (< %d) — a zombie controller kept writing after "
+            "adoption", path, stale, current)
+    return {"jobs": jobs, "epoch": current, "stale_records": stale,
+            "finishes": finishes}

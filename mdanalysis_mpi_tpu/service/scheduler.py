@@ -979,6 +979,16 @@ class Scheduler:
                         if r["state"] in ("queued", "claimed")],
         }
 
+    @staticmethod
+    def recover_fleet(path) -> dict:
+        """Fleet-journal twin of :meth:`recover`
+        (docs/RELIABILITY.md §6): same per-job replay, PLUS epoch
+        fencing — records a zombie controller appended under a stale
+        epoch are rejected and counted — and the ``finishes``
+        exactly-once ledger.  What :meth:`FleetController.adopt` (and
+        the chaos tests' audits) read."""
+        return _journal.replay_fleet(path)
+
     # ---- warmup + scheduler-driven prefetch (docs/COLDSTART.md) ----
 
     def _plan_for(self, handles: list[JobHandle]):

@@ -204,3 +204,53 @@ class ServiceTelemetry:
         from mdanalysis_mpi_tpu.utils.log import log_event
 
         log_event("serving", **{**self.snapshot(cache=cache), **extra})
+
+
+class FleetTelemetry:
+    """Controller-tier counters (docs/RELIABILITY.md §6): host
+    membership, host-loss migration, epoch fencing, and the sticky-
+    routing residency outcome.  One per
+    :class:`~mdanalysis_mpi_tpu.service.fleet.FleetController`; the
+    controller mirrors the load-bearing series into the process-global
+    metrics registry (``mdtpu_hosts_alive`` & co) at each incident
+    site — this object is the flat JSON view the fleet bench leg and
+    the ``fleet`` CLI embed."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.hosts_joined = 0          # hello handshakes accepted
+        self.hosts_lost = 0            # leases expired / sockets EOFed
+        self.hosts_rejoined = 0        # lost hosts that came back
+        self.jobs_submitted = 0
+        self.jobs_completed = 0
+        self.jobs_failed = 0
+        self.jobs_migrated = 0         # in-flight jobs requeued off a
+        #                                lost host onto survivors
+        self.epoch_fenced_rejects = 0  # stale-epoch/stale-assignment
+        #                                commands + completions refused
+        self.home_hits = 0             # jobs that found their tenant's
+        #                                state resident on the home host
+        self.home_misses = 0           # jobs that had to build it
+
+    def count(self, counter: str, n: int = 1) -> None:
+        with self._lock:
+            setattr(self, counter, getattr(self, counter) + n)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = {
+                "hosts_joined": self.hosts_joined,
+                "hosts_lost": self.hosts_lost,
+                "hosts_rejoined": self.hosts_rejoined,
+                "jobs_submitted": self.jobs_submitted,
+                "jobs_completed": self.jobs_completed,
+                "jobs_failed": self.jobs_failed,
+                "jobs_migrated": self.jobs_migrated,
+                "epoch_fenced_rejects": self.epoch_fenced_rejects,
+                "home_hits": self.home_hits,
+                "home_misses": self.home_misses,
+            }
+        lookups = out["home_hits"] + out["home_misses"]
+        out["home_hit_rate"] = (round(out["home_hits"] / lookups, 4)
+                                if lookups else None)
+        return out

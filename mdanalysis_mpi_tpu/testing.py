@@ -17,6 +17,30 @@ from mdanalysis_mpi_tpu.core.universe import Universe
 from mdanalysis_mpi_tpu.io.memory import MemoryReader
 
 
+def handoff_port(host: str = "127.0.0.1"):
+    """Bound-socket port handoff for multi-process coordination tests.
+
+    Binds port 0 (the kernel picks a genuinely free port) and returns
+    ``(holder_socket, port)`` with the reservation STILL HELD: the
+    caller keeps the holder open while it prepares its children and
+    closes it at the last moment before spawning them, shrinking the
+    classic free-port race from "whole test setup" to microseconds.
+    ``SO_REUSEADDR`` is set so the children's coordinator (which sets
+    it too) can bind the port the instant the holder releases it.
+
+    This replaced the 2-controller gloo test's retry-once-on-a-fresh-
+    port band-aid, and the fleet tests coordinate the same way (the
+    fleet controller itself never races at all — it binds port 0 and
+    hands the RESOLVED port to its hosts via the address file).
+    """
+    import socket
+
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    s.bind((host, 0))
+    return s, s.getsockname()[1]
+
+
 def random_rotation_matrices(n: int, rng: np.random.Generator) -> np.ndarray:
     """(n, 3, 3) uniform random rotations (QR of Gaussian, sign-fixed)."""
     a = rng.normal(size=(n, 3, 3))

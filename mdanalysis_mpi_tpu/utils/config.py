@@ -225,6 +225,19 @@ def main(argv=None) -> int:
         from mdanalysis_mpi_tpu.service.cli import batch_main
 
         return batch_main(args[1:])
+    if args and args[0] == "fleet":
+        # controller tier: a job file across N host worker processes
+        # (sticky placement, host-loss migration, epoch fencing —
+        # docs/RELIABILITY.md §6)
+        from mdanalysis_mpi_tpu.service.fleet import fleet_main
+
+        return fleet_main(args[1:])
+    if args and args[0] == "fleet-host":
+        # internal: one fleet host worker (spawned by
+        # FleetController.spawn_host; not an operator surface)
+        from mdanalysis_mpi_tpu.service.fleet import host_main
+
+        return host_main(args[1:])
     if args and args[0] == "lint":
         # repo-native static analysis (lint/ subsystem): concurrency
         # discipline, jit/jaxpr contracts, schema drift — docs/LINT.md.

@@ -91,6 +91,14 @@ PINNED_METRICS = {
     "mdtpu_scrub_corrupt_total": "counter",
     "mdtpu_scrub_fetch_errors_total": "counter",
     "mdtpu_admission_shed_serial_total": "counter",
+    # fleet tier (docs/RELIABILITY.md §6): host membership, host-loss
+    # migration, and epoch fencing — recorded live by the controller
+    # (service/fleet.py), zero-injected everywhere else
+    "mdtpu_hosts_alive": "gauge",
+    "mdtpu_hosts_lost_total": "counter",
+    "mdtpu_jobs_migrated_total": "counter",
+    "mdtpu_controller_epoch": "gauge",
+    "mdtpu_epoch_fenced_rejects_total": "counter",
 }
 
 
@@ -182,6 +190,18 @@ def test_bench_json_contract(tmp_path):
                     "integrity_overhead_pct",
                     "integrity_jobs_per_s",
                     "integrity_fingerprint_gbps",
+                    # fleet serving sub-leg (docs/RELIABILITY.md §6):
+                    # K tenants across 2 real host processes, clean
+                    # wave vs one kill -9 mid-wave — host-side, so a
+                    # tunnel-down artifact still carries the fleet's
+                    # migration/fencing/exactly-once record
+                    "fleet_clean_jobs_per_s",
+                    "fleet_loss_jobs_per_s",
+                    "fleet_recovery_overhead_pct",
+                    "fleet_wave2_home_hit_rate",
+                    "fleet_hosts_lost", "fleet_jobs_migrated",
+                    "fleet_epoch_fenced_rejects",
+                    "fleet_exactly_once",
                     # r9: observability — the host-leg tracing-on/off
                     # delta and the unified metrics block
                     # (docs/OBSERVABILITY.md)
@@ -220,6 +240,15 @@ def test_bench_json_contract(tmp_path):
         assert 0 < rec["serving_accel_cache_hit_rate"] <= 1
         assert rec["serving_accel_coalesce_rate"] == 1.0
         assert "serving_accel" in rec["accel_leg_order"]
+        # fleet sub-leg: one host really was kill -9'd mid-wave, every
+        # job still completed exactly once (journal-audited), and the
+        # clean wave-2 ran fully home-resident (sticky routing)
+        assert rec["fleet_clean_jobs_per_s"] > 0
+        assert rec["fleet_loss_jobs_per_s"] > 0
+        assert rec["fleet_hosts_lost"] == 1
+        assert rec["fleet_exactly_once"] is True
+        assert rec["fleet_wave2_home_hit_rate"] == 1.0
+        assert rec["fleet_jobs_migrated"] >= 0
         # fault-wave sub-leg: the injected worker death was really
         # reaped, recovered jobs still flowed, and the recovery price
         # is recorded next to the clean wave
@@ -327,6 +356,11 @@ def test_bench_outage_records_host_legs(tmp_path):
         # recovery is measured even with the tunnel down
         assert rec["serving_fault_recovery_jobs_per_s"] > 0
         assert rec["serving_fault_lease_expired"] >= 1
+        # r12: the fleet sub-leg is host-side (serial host processes)
+        # — the kill -9 migration record survives the outage too
+        assert rec["fleet_loss_jobs_per_s"] > 0
+        assert rec["fleet_hosts_lost"] == 1
+        assert rec["fleet_exactly_once"] is True
         # the retry log shows what init actually did
         assert rec["init_log"] and rec["init_log"][0]["attempt"] == 1
         # the incremental file matches the emitted record's legs

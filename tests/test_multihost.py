@@ -32,7 +32,6 @@ against the serial f64 oracle computed in-process.
 """
 
 import os
-import socket
 import subprocess
 import sys
 
@@ -123,6 +122,22 @@ ud = make_md_universe(n_residues={n_res}, n_frames={n_frames}, seed=7)
 dl = AlignedRMSF(ud, select="name CA").run(backend="mesh", batch_size=2,
                                            transfer_dtype="delta")
 
+# multi-host SDC scrub coverage (the PR-9 fingerprint gap, closed):
+# a cached 2-controller run records PER-HOST-SHARD stage-time
+# fingerprints, and scrub() re-fetches only this process's shard of
+# each global array (distributed.local_host_copy) — every resident
+# entry verified, none blind (fetch_errors), none falsely corrupt
+from mdanalysis_mpi_tpu.parallel.executors import DeviceBlockCache
+cache = DeviceBlockCache(max_bytes=1 << 30)
+cc = AlignedRMSF(u, select="name CA").run(backend="mesh", batch_size=2,
+                                          block_cache=cache)
+stats = cache.scrub()
+assert stats["checked"] >= 1, stats
+assert stats["corrupt"] == 0, stats
+assert stats["fetch_errors"] == 0, stats
+import numpy as np
+np.testing.assert_allclose(cc.results.rmsf, a.results.rmsf, atol=1e-5)
+
 if pid == 0:
     np.savez({out!r}, rmsf=a.results.rmsf, rmsf_i16=q.results.rmsf,
              helanal_twists=np.asarray(hx.results.local_twists),
@@ -138,56 +153,46 @@ if pid == 0:
 """
 
 
-def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
-
-
 class TestTwoProcessMesh:
     def test_parity_two_controllers(self, tmp_path):
+        from mdanalysis_mpi_tpu.testing import handoff_port
+
         out = str(tmp_path / "results.npz")
         env = dict(os.environ,
                    JAX_PLATFORMS="cpu",
                    XLA_FLAGS="--xla_force_host_platform_device_count=4")
-        # one retry with a fresh port: the two coordinated children
-        # share this host's 2 cores with the rest of the suite, and a
-        # load spike can skew them past jax's distributed
-        # init/shutdown barriers (~37s quiet-host wall, but in-suite
-        # walls of minutes were measured).  A genuine collectives/
-        # parity bug fails BOTH attempts — identical code, identical
-        # inputs; only scheduler timing varies between them.
-        for attempt in (0, 1):
-            coord = f"127.0.0.1:{_free_port()}"
-            script = tmp_path / "child.py"
-            script.write_text(CHILD.format(repo=REPO, coord=coord,
-                                           out=out, n_res=N_RES,
-                                           n_frames=N_FRAMES))
-            procs = [subprocess.Popen(
-                [sys.executable, str(script), str(i)],
-                env=env, stdout=subprocess.PIPE,
-                stderr=subprocess.STDOUT) for i in range(2)]
-            outputs, timed_out = [], False
-            for p in procs:
-                try:
-                    stdout, _ = p.communicate(timeout=300)
-                except subprocess.TimeoutExpired:
-                    for q in procs:
-                        q.kill()
-                        q.wait()
-                    timed_out = True
-                    break
-                outputs.append(stdout.decode(errors="replace"))
-            if not timed_out and all(p.returncode == 0 for p in procs):
-                break
-            if attempt == 1:
-                if timed_out:
-                    pytest.fail("2-process mesh run timed out twice")
-                for i, p in enumerate(procs):
-                    assert p.returncode == 0, (
-                        f"process {i} failed:\n{outputs[i][-3000:]}")
+        # bound-socket port handoff (testing.handoff_port): the port is
+        # HELD — bound, verifiably ours — through the whole test setup
+        # and released only at the moment the children spawn, so the
+        # coordinator child (which sets SO_REUSEADDR too) binds a port
+        # nothing else could have grabbed meanwhile.  This replaced the
+        # PR-6 retry-once-on-a-fresh-port band-aid: the flake WAS the
+        # free-port race (close-then-reuse left the whole child-script
+        # formatting window open), not the collectives.
+        holder, port = handoff_port()
+        coord = f"127.0.0.1:{port}"
+        script = tmp_path / "child.py"
+        script.write_text(CHILD.format(repo=REPO, coord=coord,
+                                       out=out, n_res=N_RES,
+                                       n_frames=N_FRAMES))
+        holder.close()
+        procs = [subprocess.Popen(
+            [sys.executable, str(script), str(i)],
+            env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT) for i in range(2)]
+        outputs = []
+        for p in procs:
+            try:
+                stdout, _ = p.communicate(timeout=420)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                    q.wait()
+                pytest.fail("2-process mesh run timed out")
+            outputs.append(stdout.decode(errors="replace"))
+        for i, p in enumerate(procs):
+            assert p.returncode == 0, (
+                f"process {i} failed:\n{outputs[i][-3000:]}")
 
         # oracles in-parent (single process, serial f64)
         from mdanalysis_mpi_tpu.testing import make_protein_universe
