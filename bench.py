@@ -653,6 +653,79 @@ def _measure_decode_fps(u_file, heavy_sel) -> float:
     return fps
 
 
+def store_host_leg(u_file, heavy_sel, s_oracle, decode_fps) -> dict:
+    """Ingest-once chunked block store vs file decode (docs/STORE.md)
+    — host-side, before any jax contact, so the store record survives
+    the outage protocol.  Protocol: one timed COLD ingest of a
+    BENCH_STORE_FRAMES window (chunk = the staging batch), then the
+    cold first-pass staging schedule re-run from the store — batch-
+    sized ``stage_block`` calls in the store's own int16 wire format,
+    fresh reader so every chunk fetch pays its read-time fingerprint
+    verification — against the ``decode_fps`` the file reader just
+    recorded for the SAME staging call.  Parity is gated (serial
+    AlignedRMSF off the store vs the file-reader oracle, 1e-3 — the
+    same bar as every staging dtype) and a failed gate withholds the
+    speedup ratio instead of scoring it.  ``store_chunk_crc_rejects``
+    comes from the live metrics registry: a clean pass must read 0."""
+    base = {"store_ingest_fps": None, "store_read_fps": None,
+            "store_vs_decode": None, "store_divergence": None,
+            "store_parity": None, "store_chunk_crc_rejects": None}
+    if SOURCE != "file":
+        base["store_note"] = "BENCH_SOURCE=memory: no file to ingest"
+        return base
+    import shutil
+
+    from mdanalysis_mpi_tpu.io.store import StoreReader, ingest
+    from mdanalysis_mpi_tpu.obs import METRICS
+
+    # never smaller than the serial parity window: the parity gate
+    # below compares stop=SERIAL_FRAMES runs, and a store shorter than
+    # that would silently clamp the store run's window and fail parity
+    # as a protocol artifact
+    window = min(N_FRAMES,
+                 max(SERIAL_FRAMES,
+                     int(os.environ.get("BENCH_STORE_FRAMES", "1024"))))
+    store_dir = u_file.trajectory.filename + f".store_b{BATCH}"
+    shutil.rmtree(store_dir, ignore_errors=True)   # timed ingest is COLD
+    try:
+        summary = ingest(u_file.trajectory, store_dir,
+                         chunk_frames=BATCH, quant="int16",
+                         stop=window)
+        # warm page-in + native-lib load on a throwaway reader, then a
+        # FRESH reader so the timed pass pays cold chunk fetch + CRC
+        StoreReader(store_dir).stage_block(
+            0, min(8, window), sel=heavy_sel, quantize=True)
+        reader = StoreReader(store_dir)
+        t0 = time.perf_counter()
+        for lo in range(0, window, BATCH):
+            reader.stage_block(lo, min(lo + BATCH, window),
+                               sel=heavy_sel, quantize=True)
+        read_fps = window / (time.perf_counter() - t0)
+        u_store = Universe(u_file.topology, StoreReader(store_dir))
+        s_store = AlignedRMSF(u_store, select=SELECT).run(
+            stop=SERIAL_FRAMES, backend="serial")
+        div = float(np.abs(np.asarray(s_store.results.rmsf)
+                           - np.asarray(s_oracle.results.rmsf)).max())
+        parity = "PASS" if div <= 1e-3 else "FAIL"
+        rejects = METRICS.snapshot().get(
+            "mdtpu_store_chunk_crc_rejects_total",
+            {"values": {}})["values"].get("", 0)
+        base.update(
+            store_ingest_fps=round(summary["store_ingest_fps"], 2),
+            store_read_fps=round(read_fps, 2),
+            store_vs_decode=(round(read_fps / decode_fps, 2)
+                             if parity == "PASS" and decode_fps > 0
+                             else None),
+            store_divergence=round(div, 8), store_parity=parity,
+            store_chunk_crc_rejects=int(rejects),
+            store_window_frames=window,
+            store_chunks=summary["n_chunks"],
+            store_bytes=summary["bytes"])
+        return base
+    finally:
+        shutil.rmtree(store_dir, ignore_errors=True)
+
+
 def dispatch_stats(calls0: int, secs0: float, runs: int = 1) -> dict:
     """Dispatch telemetry for a timed leg, from TIMERS snapshots taken
     before it ran: batch-kernel dispatches per run, mean host ms per
@@ -1194,6 +1267,20 @@ def main():
     if decode_fps == decode_fps:           # not NaN
         _note(f"[bench] host decode+stage: {decode_fps:.1f} f/s")
         _leg_done("host decode leg", decode_fps=round(decode_fps, 2))
+
+    # block-store sub-leg (docs/STORE.md): cold ingest + cold store
+    # reads vs the file-decode rate just measured — still host-side,
+    # so a tunnel-down artifact carries the store record too
+    store = store_host_leg(u_file, heavy_idx, s_oracle, decode_fps)
+    if store.get("store_read_fps"):
+        _note(f"[bench] store: ingest "
+              f"{store['store_ingest_fps']} f/s, read "
+              f"{store['store_read_fps']} f/s "
+              f"({store['store_vs_decode']}x vs file decode, parity "
+              f"{store['store_parity']}, "
+              f"{store['store_chunk_crc_rejects']} CRC rejects)")
+    _leg_done("store leg", **store)
+    clear_host_caches(u_file)
 
     n_chips = _wait_for_accelerator()
     if WATCH:

@@ -1,0 +1,186 @@
+"""Block-store profile: the measurement behind docs/STORE.md and
+PERF.md §13.
+
+Host-only by construction (no jax import): the store attacks the COLD
+first-pass decode wall, which is a host fact — the same reason
+``decode_fps`` is a host leg in bench.py.  Four claims, all measured
+at the flagship host shape on whatever machine runs this:
+
+1. **Cold read speedup** — the cold first-pass staging schedule
+   (batch-sized ``stage_block`` calls, int16 wire, heavy-atom
+   selection) run from the file reader (fused C++ XDR decode) and
+   from an ingested store (raw chunk slices + read-time fingerprint
+   verification), median of PROFILE_STORE_REPS cold passes each.
+   The ratio is the leg's headline.
+2. **Ingest amortization** — the one-time ingest pass costs about one
+   decode pass (it IS one decode pass plus quantize + write), so the
+   store pays for itself on the second cold read; ``ingest_fps`` and
+   the break-even pass count are recorded.
+3. **Parity** — serial AlignedRMSF off the store vs off the file,
+   gated at the 1e-3 staging-dtype bar (int16 tier: ONE quantization
+   round trip, the same error class as the int16 wire format).
+4. **Corrupt-chunk rejection** — one flipped payload byte in one
+   chunk: the read must raise a typed ``StoreCorruptError`` and count
+   ``mdtpu_store_chunk_crc_rejects_total``, never serve wrong bytes.
+
+Also records the quantized-tier economics: int16 vs f32 store bytes
+and cold-read rates (the "quantized I/O tier" half of the claim).
+
+Writes PROFILE_STORE.json (committed) and prints it.
+
+Usage: python benchmarks/profile_store.py
+Scale knobs: PROFILE_STORE_ATOMS / PROFILE_STORE_FRAMES /
+PROFILE_STORE_BATCH / PROFILE_STORE_REPS (defaults sized for a
+CPU-platform record at the PERF.md §12 flagship host shape).
+"""
+
+import json
+import os
+import shutil
+import statistics
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N_ATOMS = int(os.environ.get("PROFILE_STORE_ATOMS", "20000"))
+N_FRAMES = int(os.environ.get("PROFILE_STORE_FRAMES", "1024"))
+BATCH = int(os.environ.get("PROFILE_STORE_BATCH", "64"))
+N_REPS = int(os.environ.get("PROFILE_STORE_REPS", "5"))
+
+os.environ.setdefault("BENCH_ATOMS", str(N_ATOMS))
+os.environ.setdefault("BENCH_FRAMES", str(N_FRAMES))
+
+import bench  # noqa: E402  (fixture helpers; honor_cpu_request inside)
+from mdanalysis_mpi_tpu.analysis import AlignedRMSF  # noqa: E402
+from mdanalysis_mpi_tpu.core.universe import Universe  # noqa: E402
+from mdanalysis_mpi_tpu.io.store import StoreReader, ingest  # noqa: E402
+from mdanalysis_mpi_tpu.io.xtc import XTCReader  # noqa: E402
+from mdanalysis_mpi_tpu.obs import METRICS  # noqa: E402
+from mdanalysis_mpi_tpu.utils.integrity import IntegrityError  # noqa: E402
+
+
+def _note(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _rejects() -> int:
+    return METRICS.snapshot().get(
+        "mdtpu_store_chunk_crc_rejects_total",
+        {"values": {}})["values"].get("", 0)
+
+
+def _cold_stage_pass(reader, sel) -> float:
+    """One cold staging pass: the batch schedule _run_batches walks,
+    int16 wire — returns frames/s."""
+    t0 = time.perf_counter()
+    for lo in range(0, N_FRAMES, BATCH):
+        reader.stage_block(lo, min(lo + BATCH, N_FRAMES), sel=sel,
+                           quantize=True)
+    return N_FRAMES / (time.perf_counter() - t0)
+
+
+def main() -> int:
+    xtc = bench.ensure_flagship_xtc(N_ATOMS, N_FRAMES)
+    topo = bench.make_topology(N_ATOMS)
+    u_file = Universe(topo, XTCReader(xtc))
+    sel = u_file.select_atoms("heavy").indices
+    rec = {
+        "metric": f"block store vs file decode, {N_ATOMS}-atom "
+                  f"{N_FRAMES}-frame heavy-atom staging schedule, "
+                  f"batch {BATCH}, int16 wire, cold passes, "
+                  f"median of {N_REPS} (host-only — docs/STORE.md)",
+        "n_atoms": N_ATOMS, "n_frames": N_FRAMES, "batch": BATCH,
+        "reps": N_REPS,
+        "file_bytes": os.path.getsize(xtc),
+    }
+
+    # --- file-decode cold passes (fresh reader per rep: the offset
+    # index is on-disk-cached, the decode is not) ---
+    decode = []
+    for _ in range(N_REPS):
+        r = XTCReader(xtc)
+        r.stage_block(0, min(8, N_FRAMES), sel=sel, quantize=True)
+        decode.append(_cold_stage_pass(r, sel))
+    decode_fps = statistics.median(decode)
+    rec["decode_fps"] = round(decode_fps, 1)
+    _note(f"[store] file decode: {decode_fps:.1f} f/s "
+          f"(reps {[round(d) for d in decode]})")
+
+    stores = {}
+    try:
+        for quant in ("int16", "f32"):
+            out = xtc + f".profile_store_{quant}"
+            shutil.rmtree(out, ignore_errors=True)
+            summary = ingest(xtc, out, chunk_frames=BATCH, quant=quant)
+            stores[quant] = out
+            rec[f"{quant}_ingest_fps"] = summary["store_ingest_fps"]
+            rec[f"{quant}_store_bytes"] = summary["bytes"]
+            reads = []
+            for _ in range(N_REPS):
+                r = StoreReader(out)       # fresh: cold chunk fetches
+                reads.append(_cold_stage_pass(r, sel))
+            fps = statistics.median(reads)
+            rec[f"{quant}_read_fps"] = round(fps, 1)
+            rec[f"{quant}_vs_decode"] = round(fps / decode_fps, 2)
+            _note(f"[store] {quant} store: ingest "
+                  f"{summary['store_ingest_fps']} f/s, cold read "
+                  f"{fps:.1f} f/s = {fps / decode_fps:.2f}x decode "
+                  f"({summary['bytes'] / 1e6:.0f} MB)")
+
+        # break-even: passes until ingest + k store reads < k decodes
+        ing_s = N_FRAMES / rec["int16_ingest_fps"]
+        read_s = N_FRAMES / rec["int16_read_fps"]
+        dec_s = N_FRAMES / decode_fps
+        rec["int16_break_even_passes"] = (
+            round(ing_s / (dec_s - read_s), 2)
+            if dec_s > read_s else None)
+
+        # --- parity gate (the staging-dtype bar) ---
+        s_file = AlignedRMSF(u_file, select="heavy").run(
+            stop=min(128, N_FRAMES), backend="serial")
+        u_store = Universe(topo, StoreReader(stores["int16"]))
+        s_store = AlignedRMSF(u_store, select="heavy").run(
+            stop=min(128, N_FRAMES), backend="serial")
+        div = float(np.abs(np.asarray(s_store.results.rmsf)
+                           - np.asarray(s_file.results.rmsf)).max())
+        rec["divergence"] = div
+        rec["parity"] = "PASS" if div <= 1e-3 else "FAIL"
+        _note(f"[store] parity vs file reader: {div:.2e} "
+              f"({rec['parity']})")
+
+        # --- corrupt-chunk rejection proof ---
+        victim = os.path.join(stores["int16"], "chunk-00000001.mdtc")
+        blob = bytearray(open(victim, "rb").read())
+        blob[-17] ^= 0x08
+        with open(victim, "wb") as f:
+            f.write(bytes(blob))
+        before = _rejects()
+        try:
+            StoreReader(stores["int16"]).read_block(BATCH, 2 * BATCH)
+        except IntegrityError as exc:
+            rec["corrupt_chunk_rejected"] = type(exc).__name__
+        else:
+            rec["corrupt_chunk_rejected"] = None
+        rec["crc_rejects_counted"] = _rejects() - before
+    finally:
+        for out in stores.values():
+            shutil.rmtree(out, ignore_errors=True)
+
+    rec["ok"] = bool(
+        rec["parity"] == "PASS"
+        and rec["int16_vs_decode"] > 1.0
+        and rec["corrupt_chunk_rejected"] == "StoreCorruptError"
+        and rec["crc_rejects_counted"] >= 1)
+    out_path = os.path.join(REPO, "PROFILE_STORE.json")
+    with open(out_path, "w") as f:
+        f.write(json.dumps(rec, indent=1) + "\n")
+    print(json.dumps(rec, indent=1))
+    return 0 if rec["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

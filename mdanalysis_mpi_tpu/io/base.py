@@ -268,6 +268,20 @@ class BlockCache:
 HostStageCache = BlockCache
 
 
+#: THE quantization policy numbers, one copy for every tier that
+#: quantizes coordinates — the wire formats (``executors.
+#: quantize_block``), the readers' fused int16 staging
+#: (``ReaderBase.QUANT_TARGET`` below mirrors the int16 entry), and
+#: the block store's ingest (docs/STORE.md).  ``QUANT_TARGETS`` is the
+#: symmetric-scale target (resolution = max|x| / target);
+#: ``QUANT_INT_MAX`` the representable bound overflow checks compare
+#: against.  Retuning a target here retunes every tier together — a
+#: store ingested under one policy must never be served raw against a
+#: reader expecting another.
+QUANT_TARGETS = {"int16": 32000.0, "int8": 120.0}
+QUANT_INT_MAX = {"int16": 32767.0, "int8": 127.0}
+
+
 def norm_quantize(quantize) -> str | None:
     """Normalize a staging-quantization request: ``False``/``None`` →
     None, ``True`` → ``"int16"`` (backward compatible), ``"int16"`` /
@@ -329,11 +343,11 @@ class ReaderBase:
     def _read_frame(self, i: int) -> Timestep:
         raise NotImplementedError
 
-    # Adaptive int16 staging-scale policy — ONE copy of the numbers
-    # (io/xtc.py's fused path and _quantize_staged below must quantize
-    # with bit-identical scales): target 32000 of the int16 range,
-    # ×1.05 drift margin on the previous max, float64 scale arithmetic.
-    QUANT_TARGET = 32000.0
+    # Adaptive int16 staging-scale policy (io/xtc.py's fused path and
+    # _quantize_staged below must quantize with bit-identical scales):
+    # the module-level QUANT_TARGETS int16 entry, ×1.05 drift margin
+    # on the previous max, float64 scale arithmetic.
+    QUANT_TARGET = QUANT_TARGETS["int16"]
     QUANT_MARGIN = 1.05
 
     def _quant_hints(self) -> dict:

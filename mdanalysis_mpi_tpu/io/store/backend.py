@@ -1,0 +1,89 @@
+"""Pluggable store backends: a named byte namespace.
+
+The reader and ingester address chunks by NAME only (``manifest.json``,
+``chunk-00000042.mdtc``); everything about *where* those bytes live is
+behind this four-method interface.  A local directory is the shipped
+backend; an object store (GCS/S3-style) implements the same four
+methods later — which is why the interface is bytes-in/bytes-out with
+no seek/stream surface: chunk granularity IS the access granularity
+(a chunk equals one staged block, so partial-chunk reads would only
+re-create the random-access problem the store exists to solve).
+"""
+
+from __future__ import annotations
+
+import os
+
+from mdanalysis_mpi_tpu.utils import integrity as _integrity
+
+
+class StoreBackend:
+    """Abstract chunk-store backend (local dir now, object store
+    later).  Implementations must make :meth:`put_bytes` atomic —
+    a reader must never observe a torn chunk (the local backend
+    rides ``utils.integrity.atomic_write_bytes``'s
+    tmp → fsync → rename)."""
+
+    def put_bytes(self, name: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def get_bytes(self, name: str) -> bytes:
+        raise NotImplementedError
+
+    def exists(self, name: str) -> bool:
+        raise NotImplementedError
+
+    def delete_bytes(self, name: str) -> None:
+        """Remove ``name`` if present (idempotent).  The ingester
+        deletes a pre-existing manifest BEFORE overwriting chunks, so
+        a crashed re-ingest leaves a directory that is not a store
+        (the fresh-ingest invariant) instead of a valid-looking
+        manifest over half-replaced chunks."""
+        raise NotImplementedError
+
+    def list_names(self) -> list[str]:
+        raise NotImplementedError
+
+    def describe(self) -> str:
+        """Human-readable location (error messages, manifests)."""
+        return type(self).__name__
+
+
+class LocalDirBackend(StoreBackend):
+    """Chunks as files under one directory.
+
+    Writes are atomic (``utils.integrity.atomic_write_bytes``:
+    tmp → fsync → rename, ENOSPC-class failures mapped to typed
+    :class:`~mdanalysis_mpi_tpu.utils.integrity.ArtifactWriteError`
+    and counted), so a crashed ingest leaves whole chunks or no
+    chunks — and since the manifest is written LAST, no manifest at
+    all: the half-ingested directory is simply not a store."""
+
+    def __init__(self, root: str):
+        self.root = os.fspath(root)
+
+    def put_bytes(self, name: str, data: bytes) -> None:
+        os.makedirs(self.root, exist_ok=True)
+        _integrity.atomic_write_bytes(
+            os.path.join(self.root, name), data, artifact="store")
+
+    def get_bytes(self, name: str) -> bytes:
+        with open(os.path.join(self.root, name), "rb") as f:
+            return f.read()
+
+    def exists(self, name: str) -> bool:
+        return os.path.exists(os.path.join(self.root, name))
+
+    def delete_bytes(self, name: str) -> None:
+        try:
+            os.remove(os.path.join(self.root, name))
+        except FileNotFoundError:
+            pass
+
+    def list_names(self) -> list[str]:
+        if not os.path.isdir(self.root):
+            return []
+        return sorted(os.listdir(self.root))
+
+    def describe(self) -> str:
+        return self.root

@@ -1,0 +1,179 @@
+"""Ingest-once: re-chunk a trajectory into the block store.
+
+One sequential decode pass — the LAST one the trajectory ever needs.
+Frames are read through the source reader's bulk ``read_block`` (the
+fused native decode when the format has one), re-chunked to the
+staging geometry (``chunk_frames`` = the frame block ``_run_batches``
+stages; default 512, the flagship batch), quantized once with the
+executors' wire policy, framed with per-array fingerprints
+(``codec.encode_chunk``), and written through the backend's atomic
+puts.  The CRC-sealed manifest lands LAST, so a crash mid-ingest
+leaves a directory that simply is not a store yet.
+
+Quantization policy (int16/int8): ONE store-wide scale, derived from
+the first chunk's max |coordinate| with the readers' drift margin
+(``ReaderBase.QUANT_MARGIN``), so chunk-spanning reads can serve raw
+quantized slices under a single ``inv_scale`` — the condition for the
+:class:`~mdanalysis_mpi_tpu.io.store.reader.StoreReader` staging fast
+path.  A chunk whose range outgrows the margin falls back to its own
+exact scale (recorded per chunk; readers detect the mismatch and
+requantize through f32 instead of serving mixed-scale bytes).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+from mdanalysis_mpi_tpu.io.base import (
+    QUANT_INT_MAX, QUANT_TARGETS, ReaderBase, norm_quantize,
+)
+from mdanalysis_mpi_tpu.io.store import codec
+from mdanalysis_mpi_tpu.io.store.backend import LocalDirBackend
+from mdanalysis_mpi_tpu.io.store.manifest import (
+    FORMAT, MANIFEST_NAME, VERSION, dump_manifest,
+)
+
+#: Default chunk geometry: the flagship staging batch (bench.py
+#: BENCH_BATCH default) — chunk = the block ``_run_batches`` stages,
+#: so a store-backed run's every stage call is one chunk slice.
+DEFAULT_CHUNK_FRAMES = 512
+
+def _count(metric: str) -> None:
+    # lazy obs import, the utils/integrity.py convention
+    from mdanalysis_mpi_tpu.obs import METRICS
+
+    METRICS.inc(metric)
+
+
+def norm_store_quant(quant) -> str:
+    """Store quantization tier: ``"int16"`` / ``"int8"`` /
+    ``"f32"`` (accepting ``None``/``False``/``"float32"`` spellings
+    for the passthrough tier)."""
+    if quant in (None, False, "f32", "float32"):
+        return "f32"
+    return norm_quantize(quant)
+
+
+def ingest(trajectory, out: str | None = None,
+           chunk_frames: int | None = None, quant="int16",
+           backend=None, stop: int | None = None) -> dict:
+    """Ingest ``trajectory`` (a path or an open ReaderBase) into a
+    block store at ``out`` (or through an explicit ``backend``).
+
+    ``stop`` bounds the ingested window to frames ``[0, stop)`` —
+    the bench's cold-leg protocol ingests a measurement window, not
+    the whole fixture.  Returns a summary dict (frame/chunk/byte
+    counts, ``store_ingest_fps``).
+    """
+    owned = None
+    if hasattr(trajectory, "read_block"):
+        reader = trajectory
+    else:
+        from mdanalysis_mpi_tpu.io import trajectory_files
+
+        # opened here, closed here (finally below) — a long-lived
+        # process re-ingesting many trajectories must not leak source
+        # handles; caller-owned readers stay open
+        reader = owned = trajectory_files.open(os.fspath(trajectory))
+    try:
+        if backend is None:
+            if out is None:
+                raise ValueError(
+                    "ingest needs an output path or a backend")
+            backend = LocalDirBackend(out)
+        return _ingest(reader, backend, chunk_frames, quant, stop)
+    finally:
+        if owned is not None:
+            owned.close()
+
+
+def _ingest(reader, backend, chunk_frames, quant, stop) -> dict:
+    qmode = norm_store_quant(quant)
+    cf = int(chunk_frames or DEFAULT_CHUNK_FRAMES)
+    if cf < 1:
+        raise ValueError(f"chunk_frames must be >= 1, got {cf}")
+    n_frames = reader.n_frames
+    if stop is not None:
+        n_frames = min(n_frames, int(stop))
+    # re-ingest over an existing store: kill the old manifest FIRST,
+    # so a crash mid-overwrite leaves "not a store" (the fresh-ingest
+    # invariant) — never a valid-looking manifest whose fingerprints
+    # reject every half-replaced chunk
+    backend.delete_bytes(MANIFEST_NAME)
+    t0 = time.perf_counter()
+    entries = []
+    total_bytes = 0
+    scale = None          # store-wide scale, seeded by chunk 0
+    overflow_chunks = 0
+    for ci, lo in enumerate(range(0, n_frames, cf)):
+        hi = min(lo + cf, n_frames)
+        block, boxes = reader.read_block(lo, hi)
+        times = reader.frame_times(range(lo, hi))
+        arrays: dict = {}
+        meta = {"start": lo, "stop": hi, "quant": qmode}
+        if qmode == "f32":
+            arrays["coords"] = np.asarray(block, dtype=np.float32)
+        else:
+            target = QUANT_TARGETS[qmode]
+            m = float(np.abs(block).max()) if block.size else 1.0
+            if scale is None:
+                scale = target / (max(m, 1e-30)
+                                  * ReaderBase.QUANT_MARGIN)
+            s = scale
+            if m * s > QUANT_INT_MAX[qmode]:
+                # range outgrew the store-wide margin: exact per-chunk
+                # scale (readers fall back to f32 requant across it)
+                s = target / max(m, 1e-30)
+                overflow_chunks += 1
+            arrays["coords"] = np.round(block * s).astype(qmode)
+            meta["inv_scale"] = float(1.0 / s)
+        if boxes is not None:
+            arrays["boxes"] = np.ascontiguousarray(boxes, np.float32)
+        if times is not None:
+            arrays["times"] = np.ascontiguousarray(times, np.float32)
+        blob, fps = codec.encode_chunk(arrays, meta)
+        name = codec.chunk_name(ci)
+        backend.put_bytes(name, blob)
+        entry = {"i": ci, "start": lo, "stop": hi, "file": name,
+                 "nbytes": len(blob),
+                 "arrays": list(arrays), "fps": fps}
+        if "inv_scale" in meta:
+            entry["inv_scale"] = meta["inv_scale"]
+        entries.append(entry)
+        total_bytes += len(blob)
+        _count("mdtpu_store_chunks_ingested_total")
+    man = {
+        "format": FORMAT, "version": VERSION,
+        "n_frames": int(n_frames), "n_atoms": int(reader.n_atoms),
+        "chunk_frames": cf, "quant": qmode,
+        "has_boxes": any("boxes" in e["arrays"] for e in entries),
+        "has_times": any("times" in e["arrays"] for e in entries),
+        "source": getattr(reader, "filename", None),
+        # chunks that fell back to their own exact scale: every
+        # stage request spanning one requantizes through f32 instead
+        # of serving raw slices — disclosed, never silent (the "no
+        # silent caps" rule), because it quietly costs the store its
+        # headline fast path
+        "scale_overflow_chunks": overflow_chunks,
+        "chunks": entries,
+    }
+    backend.put_bytes(MANIFEST_NAME, dump_manifest(man))
+    # a re-ingest with fewer/larger chunks must not strand the old
+    # geometry's files as unreferenced disk forever
+    kept = {e["file"] for e in entries}
+    for name in backend.list_names():
+        if name.startswith("chunk-") and name not in kept:
+            backend.delete_bytes(name)
+    wall = time.perf_counter() - t0
+    return {
+        "store": backend.describe(), "quant": qmode,
+        "n_frames": int(n_frames), "n_chunks": len(entries),
+        "chunk_frames": cf, "bytes": total_bytes,
+        "scale_overflow_chunks": overflow_chunks,
+        "wall_s": round(wall, 4),
+        "store_ingest_fps": (round(n_frames / wall, 2) if wall > 0
+                             else None),
+    }

@@ -1,0 +1,132 @@
+"""Store manifest: the chunk index, CRC-sealed.
+
+One JSON document (``manifest.json``) describing the whole store:
+frame/atom counts, the chunk geometry, the quantization tier, and one
+entry per chunk carrying its file name, byte size and stage-time
+fingerprint list.  Sealed with the same CRC32C record framing as
+journal records (``utils.integrity.record_crc``) and written LAST by
+the ingester, so a crashed ingest never leaves a readable-but-partial
+store — no manifest, no store.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from mdanalysis_mpi_tpu.io.store.backend import LocalDirBackend
+from mdanalysis_mpi_tpu.utils import integrity as _integrity
+
+FORMAT = "mdtpu-store"
+VERSION = 1
+MANIFEST_NAME = "manifest.json"
+
+
+def dump_manifest(man: dict) -> bytes:
+    man = dict(man)
+    man.pop("crc", None)
+    man["crc"] = _integrity.record_crc(man)
+    return json.dumps(man, sort_keys=True).encode()
+
+
+def parse_manifest(data: bytes, path: str = MANIFEST_NAME) -> dict:
+    try:
+        man = json.loads(data)
+    except Exception as exc:
+        _integrity.note_corrupt("store", path)
+        raise _integrity.integrity_error(
+            "store", f"store manifest {path!r} is unparseable "
+                     f"({type(exc).__name__}: {exc})", path) from exc
+    return validate_manifest(man, path)
+
+
+def validate_manifest(man, path: str = MANIFEST_NAME) -> dict:
+    """Format/version/CRC checks over an already-parsed manifest dict
+    (split from :func:`parse_manifest` so callers that must sniff AND
+    load — :func:`store_meta` — parse the O(chunks) JSON once)."""
+    if not isinstance(man, dict) or man.get("format") != FORMAT:
+        _integrity.note_corrupt("store", path)
+        raise _integrity.integrity_error(
+            "store", f"{path!r} is not a {FORMAT} manifest", path)
+    if int(man.get("version", 0)) > VERSION:
+        raise _integrity.integrity_error(
+            "store", f"store manifest {path!r} is version "
+                     f"{man.get('version')}; this reader understands "
+                     f"<= {VERSION}", path)
+    if not _integrity.verify_record(man):
+        _integrity.note_corrupt("store", path)
+        raise _integrity.integrity_error(
+            "store", f"store manifest {path!r} fails its CRC32C — "
+                     "the chunk index cannot be trusted", path)
+    return man
+
+
+def load_manifest(backend) -> dict:
+    path = os.path.join(backend.describe(), MANIFEST_NAME)
+    try:
+        data = backend.get_bytes(MANIFEST_NAME)
+    except OSError as exc:
+        raise FileNotFoundError(
+            f"no store manifest at {path!r} ({exc}); run "
+            f"`python -m mdanalysis_mpi_tpu ingest` first") from exc
+    return parse_manifest(data, path)
+
+
+def is_store(path) -> bool:
+    """Cheap sniff: does ``path`` look like an ingested store?  (A
+    directory carrying a ``manifest.json`` that declares the store
+    format — full CRC verification happens at open.)"""
+    if not isinstance(path, (str, os.PathLike)) or not os.path.isdir(path):
+        return False
+    mpath = os.path.join(os.fspath(path), MANIFEST_NAME)
+    try:
+        with open(mpath, "rb") as f:
+            return json.load(f).get("format") == FORMAT
+    except Exception:
+        return False
+
+
+#: (path → (mtime_ns, size, manifest)) — ingest-once means a store's
+#: manifest changes only on re-ingest (atomic replace bumps mtime), so
+#: repeat lookups (every sharded submit on the fleet controller, under
+#: its lock) hit this instead of re-parsing + re-CRCing an O(chunks)
+#: JSON document per submit.  Bounded; stale entries evict on mismatch.
+_META_CACHE: dict = {}
+_META_CACHE_MAX = 8
+
+
+def store_meta(path) -> dict | None:
+    """Verified manifest for a store directory, or None when ``path``
+    is not one — the fleet controller's lightweight accessor for
+    routing per-shard chunk ranges (``chunk_frames``/``n_frames``)
+    without opening a reader."""
+    if not isinstance(path, (str, os.PathLike)):
+        return None
+    path = os.fspath(path)
+    # O(1) stat first: a cache hit must not pay the is_store sniff's
+    # full O(chunks) json.load (the fleet controller calls this per
+    # sharded submit, under its lock)
+    try:
+        st = os.stat(os.path.join(path, MANIFEST_NAME))
+        stamp = (st.st_mtime_ns, st.st_size)
+    except OSError:
+        return None
+    hit = _META_CACHE.get(path)
+    if hit is not None and hit[0] == stamp:
+        return hit[1]
+    # one parse total: sniff (unparseable / foreign manifest.json →
+    # "not a store") and verification (OUR format failing its CRC →
+    # typed refusal) share the same json.loads
+    mpath = os.path.join(path, MANIFEST_NAME)
+    try:
+        with open(mpath, "rb") as f:
+            man = json.loads(f.read())
+    except Exception:
+        return None
+    if not isinstance(man, dict) or man.get("format") != FORMAT:
+        return None
+    man = validate_manifest(man, mpath)
+    while len(_META_CACHE) >= _META_CACHE_MAX:
+        _META_CACHE.pop(next(iter(_META_CACHE)))
+    _META_CACHE[path] = (stamp, man)
+    return man

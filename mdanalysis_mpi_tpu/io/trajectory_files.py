@@ -18,6 +18,22 @@ def register(extension: str, opener) -> None:
 
 
 def open(path: str, n_atoms: int | None = None):
+    if os.path.isdir(path):
+        # an ingested block store (docs/STORE.md) opens wherever a
+        # trajectory path is accepted — Universe(top, store_dir),
+        # batch/fleet job specs — so "prefer the store" is just a
+        # path swap for every caller.  Opened directly (no is_store
+        # pre-sniff: that would parse the O(chunks) manifest twice);
+        # a CORRUPT manifest surfaces as its typed StoreCorruptError.
+        from mdanalysis_mpi_tpu.io.store import StoreReader
+
+        try:
+            return StoreReader(path, n_atoms=n_atoms)
+        except FileNotFoundError as exc:
+            raise ValueError(
+                f"{path!r} is a directory but not an ingested block "
+                f"store (no valid manifest.json); run "
+                f"`python -m mdanalysis_mpi_tpu ingest` first") from exc
     ext = os.path.splitext(path)[1].lower().lstrip(".")
     if not ext:
         # extensionless conventions (DL_POLY's HISTORY): the basename

@@ -75,6 +75,7 @@ _TABLE_TYPES = {
     "INTEGRITY_COUNTERS": "counter",
     "INTEGRITY_GAUGES": "gauge",
     "SCRUB_COUNTERS": "counter",
+    "STORE_COUNTERS": "counter",
     "FLEET_COUNTERS": "counter",
     "FLEET_GAUGES": "gauge",
 }

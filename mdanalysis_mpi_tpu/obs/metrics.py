@@ -231,6 +231,17 @@ SCRUB_COUNTERS = (
     "mdtpu_scrub_fetch_errors_total",
 )
 
+#: Block-store counters (io/store — docs/STORE.md): chunks written at
+#: ingest, chunks fetched+verified at read, and read-time fingerprint
+#: rejections (the SDC-scrub comparison moved to the read boundary).
+#: Recorded live at the codec boundary; zero-injected so a process
+#: that never touched a store still carries the schema.
+STORE_COUNTERS = (
+    "mdtpu_store_chunks_ingested_total",
+    "mdtpu_store_chunks_read_total",
+    "mdtpu_store_chunk_crc_rejects_total",
+)
+
 #: Fleet-tier series (service/fleet.py, docs/RELIABILITY.md §6):
 #: host-loss migration and epoch fencing, recorded live at the
 #: controller's incident sites (labeled ``reason=``) and zero-injected
@@ -269,7 +280,8 @@ def unified_snapshot(timers=None, cache=None, telemetry=None,
     snap = (registry or METRICS).snapshot()
     for name in COMPILE_METRICS + BREAKER_COUNTERS + \
             SUPERVISION_COUNTERS + RELIABILITY_COUNTERS + \
-            INTEGRITY_COUNTERS + SCRUB_COUNTERS + FLEET_COUNTERS:
+            INTEGRITY_COUNTERS + SCRUB_COUNTERS + STORE_COUNTERS + \
+            FLEET_COUNTERS:
         snap.setdefault(name, {"type": "counter", "values": {"": 0}})
     for name in BREAKER_GAUGES + LINT_GAUGES + INTEGRITY_GAUGES \
             + FLEET_GAUGES:

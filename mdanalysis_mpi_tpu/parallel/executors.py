@@ -590,7 +590,9 @@ def quantize_block(block: np.ndarray, dtype: str = "int16"):
     staging dtype; unfit for Å-precision observables on wide systems —
     the bench's divergence gate fails loudly rather than score it.
     """
-    target = {"int16": 32000.0, "int8": 120.0}[dtype]
+    from mdanalysis_mpi_tpu.io.base import QUANT_TARGETS
+
+    target = QUANT_TARGETS[dtype]
     m = float(np.abs(block).max()) if block.size else 1.0
     scale = target / max(m, 1e-30)
     q = np.round(block * scale).astype(dtype)

@@ -38,7 +38,8 @@ def static_blocks(n_frames: int, n_blocks: int) -> list[range]:
 
 def shard_windows(n_frames: int | None, start: int | None,
                   stop: int | None, step: int | None,
-                  n_shards: int) -> list:
+                  n_shards: int, chunk_frames: int | None = None
+                  ) -> list:
     """Split one job's frame window into ``n_shards`` contiguous
     sub-windows — the fleet tier's trajectory sharding
     (docs/RELIABILITY.md §6): each shard is an independent
@@ -53,6 +54,15 @@ def shard_windows(n_frames: int | None, start: int | None,
     for shards left empty (``n_shards > n_window_frames``).
     ``n_frames`` bounds an open window (``stop=None``); with neither
     a ``stop`` nor ``n_frames`` the window is unbounded and unsplittable.
+
+    ``chunk_frames`` (a block store's chunk geometry, docs/STORE.md)
+    aligns shard boundaries to chunk multiples for unit-step windows,
+    so each shard child's reads cover whole chunks and no chunk is
+    fetched by two hosts: shards get balanced CHUNK counts (edge
+    chunks may be partial where the window itself starts/ends
+    mid-chunk).  The union/order contract is unchanged.  Non-unit
+    steps visit frames the chunk grid cannot describe, so alignment
+    is skipped there.
     """
     step = 1 if step is None else int(step)
     lo = 0 if start is None else int(start)
@@ -63,6 +73,17 @@ def shard_windows(n_frames: int | None, start: int | None,
             "n_frames=")
     if n_frames is not None:
         hi = min(int(hi), int(n_frames))
+    if chunk_frames and step == 1 and hi > lo:
+        cf = int(chunk_frames)
+        chunks = range(lo // cf, (hi - 1) // cf + 1)
+        out = []
+        for block in static_blocks(len(chunks), n_shards):
+            if len(block) == 0:
+                out.append(None)
+                continue
+            c0, c1 = chunks[block.start], chunks[block.stop - 1]
+            out.append((max(lo, c0 * cf), min(hi, (c1 + 1) * cf), 1))
+        return out
     idx = range(lo, hi, step)
     out = []
     for block in static_blocks(len(idx), n_shards):

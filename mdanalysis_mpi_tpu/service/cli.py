@@ -148,6 +148,14 @@ def batch_main(argv=None, universe=None) -> int:
                    help="stage queued jobs' blocks into the shared "
                         "cache before their claim (scheduler-driven "
                         "prefetch, docs/COLDSTART.md)")
+    p.add_argument("--store", default=None, metavar="DIR",
+                   help="prefer an ingested block store (docs/"
+                        "STORE.md) over the job file's trajectory "
+                        "when DIR holds one: every tenant then "
+                        "random-access-reads its chunks instead of "
+                        "re-decoding the file; falls back to the job "
+                        "file's trajectory (with a stderr note) when "
+                        "DIR is not a store")
     p.add_argument("--journal", default=None, metavar="FILE",
                    help="crash-consistent job journal (append-only "
                         "JSONL, docs/RELIABILITY.md): every lifecycle "
@@ -174,6 +182,15 @@ def batch_main(argv=None, universe=None) -> int:
     defaults = dict(spec.get("defaults", {}))
     defaults.setdefault("topology", spec.get("topology", ""))
     defaults.setdefault("trajectory", spec.get("trajectory"))
+    if ns.store:
+        from mdanalysis_mpi_tpu.io.store import is_store
+
+        if is_store(ns.store):
+            defaults["trajectory"] = ns.store
+        else:
+            print(f"[batch] --store {ns.store!r} holds no ingested "
+                  f"store; using the job file's trajectory",
+                  file=sys.stderr)
     if universe is None:
         from mdanalysis_mpi_tpu import Universe
 

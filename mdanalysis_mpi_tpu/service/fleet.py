@@ -631,9 +631,20 @@ class FleetController:
 
         spec = parent.spec
         n_frames = spec.get("fixture", {}).get("n_frames")
+        # a store-backed tenant shards on CHUNK boundaries
+        # (docs/STORE.md): each shard child then fetches whole chunks
+        # and no chunk is read by two hosts — and the manifest bounds
+        # an otherwise-open window, so store jobs shard without an
+        # explicit stop
+        chunk_frames = None
+        store = _store_meta(spec)
+        if store is not None:
+            chunk_frames = store["chunk_frames"]
+            if n_frames is None:
+                n_frames = store["n_frames"]
         windows = shard_windows(n_frames, spec.get("start"),
                                 spec.get("stop"), spec.get("step"),
-                                shards)
+                                shards, chunk_frames=chunk_frames)
         parent.children = []
         for i, win in enumerate(windows):
             if win is None:
@@ -1023,6 +1034,18 @@ class FleetController:
 # ---------------------------------------------------------------------------
 # host worker process (the `fleet-host` CLI)
 # ---------------------------------------------------------------------------
+
+def _store_meta(spec: dict) -> dict | None:
+    """Verified block-store manifest for a job spec whose trajectory
+    is an ingested store directory (docs/STORE.md), else None — what
+    the controller consults to route per-shard chunk ranges."""
+    traj = spec.get("trajectory")
+    if not traj:
+        return None
+    from mdanalysis_mpi_tpu.io.store import store_meta
+
+    return store_meta(traj)
+
 
 def _build_universe(spec: dict):
     """Tenant state: a synthetic fixture (``fixture`` key — the chaos
@@ -1436,6 +1459,12 @@ def fleet_main(argv=None) -> int:
     workdir = ns.workdir or tempfile.mkdtemp(prefix="mdtpu-fleet-")
     n_hosts = int(spec.get("hosts", ns.hosts))
     defaults = dict(spec.get("defaults", {}))
+    # top-level (topology, trajectory) fold into every job, the batch
+    # CLI's documented job-file shape — a fleet job file should not
+    # need them repeated per job or nested under "defaults"
+    for key in ("topology", "trajectory"):
+        if spec.get(key) is not None:
+            defaults.setdefault(key, spec[key])
     t0 = time.perf_counter()
     try:
         with FleetController(workdir) as ctrl:

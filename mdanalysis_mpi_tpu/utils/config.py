@@ -238,6 +238,14 @@ def main(argv=None) -> int:
         from mdanalysis_mpi_tpu.service.fleet import host_main
 
         return host_main(args[1:])
+    if args and args[0] == "ingest":
+        # block-store ingest (io/store subsystem): one decode pass
+        # re-chunks a trajectory into the random-access quantized
+        # store — docs/STORE.md.  Dispatched before the analysis
+        # parser AND before any jax import (a host decode pass).
+        from mdanalysis_mpi_tpu.io.store.cli import ingest_main
+
+        return ingest_main(args[1:])
     if args and args[0] == "lint":
         # repo-native static analysis (lint/ subsystem): concurrency
         # discipline, jit/jaxpr contracts, schema drift — docs/LINT.md.

@@ -112,10 +112,18 @@ class ResultCorruptError(IntegrityError):
     trust the artifact."""
 
 
+class StoreCorruptError(IntegrityError):
+    """A block-store chunk or manifest fails its CRC framing or its
+    manifest fingerprint (docs/STORE.md): the reader must refuse the
+    chunk — dequantizing flipped bits produces silently wrong
+    coordinates in every analysis downstream."""
+
+
 _EXC_BY_ARTIFACT = {
     "journal": JournalCorruptError,
     "checkpoint": CheckpointCorruptError,
     "npz": ResultCorruptError,
+    "store": StoreCorruptError,
 }
 
 

@@ -4,10 +4,13 @@
 #   1. `mdtpu lint` fast mode — the repo-native static analysis
 #      (docs/LINT.md): concurrency discipline, persistence atomicity,
 #      jit contracts (AST tier), schema drift.  Jax-free, <30 s.
-#   2. The fleet dryrun smoke (docs/RELIABILITY.md §6): 2 real host
+#   2. The block-store ingest→read smoke (docs/STORE.md): write a
+#      tiny XTC, ingest it, prove read parity vs the file reader and
+#      typed corrupt-chunk rejection.  Jax-free, ~1 s.
+#   3. The fleet dryrun smoke (docs/RELIABILITY.md §6): 2 real host
 #      processes, one kill -9 mid-wave, exactly-once audited against
 #      the epoch-stamped journal.  Jax-free, ~10 s.
-#   3. The tier-1 pytest line from ROADMAP.md, verbatim — including
+#   4. The tier-1 pytest line from ROADMAP.md, verbatim — including
 #      its DOTS_PASSED accounting, so a local run reads exactly like
 #      the driver's.
 #
@@ -15,13 +18,16 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== [1/3] mdtpu lint (fast mode) =="
+echo "== [1/4] mdtpu lint (fast mode) =="
 python -m mdanalysis_mpi_tpu lint
 
-echo "== [2/3] fleet dryrun smoke (kill -9 + exactly-once audit) =="
+echo "== [2/4] block-store ingest→read smoke =="
+python -m mdanalysis_mpi_tpu ingest --smoke
+
+echo "== [3/4] fleet dryrun smoke (kill -9 + exactly-once audit) =="
 python -m mdanalysis_mpi_tpu fleet --smoke
 
-echo "== [3/3] tier-1 pytest (ROADMAP.md verify line) =="
+echo "== [4/4] tier-1 pytest (ROADMAP.md verify line) =="
 set -o pipefail
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \

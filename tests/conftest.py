@@ -60,6 +60,13 @@ def pytest_configure(config):
         "with `pytest -m lint`")
     config.addinivalue_line(
         "markers",
+        "store: fast, CPU-only block-store tests (io/store subsystem: "
+        "ingest/read round trips, chunk fingerprint verification, "
+        "chunk-aligned shard routing — docs/STORE.md); in tier-1 by "
+        "construction (not slow) and selectable alone with "
+        "`pytest -m store`")
+    config.addinivalue_line(
+        "markers",
         "integrity: fast, CPU-only data-integrity tests (checksummed "
         "artifacts, SDC scrubbing, exhaustion-graceful persistence — "
         "docs/RELIABILITY.md §5); in tier-1 by construction (not "

@@ -91,6 +91,12 @@ PINNED_METRICS = {
     "mdtpu_scrub_corrupt_total": "counter",
     "mdtpu_scrub_fetch_errors_total": "counter",
     "mdtpu_admission_shed_serial_total": "counter",
+    # block store (docs/STORE.md): ingest/read chunk accounting and
+    # read-time fingerprint rejections — recorded live at the codec
+    # boundary (io/store), zero-injected everywhere else
+    "mdtpu_store_chunks_ingested_total": "counter",
+    "mdtpu_store_chunks_read_total": "counter",
+    "mdtpu_store_chunk_crc_rejects_total": "counter",
     # fleet tier (docs/RELIABILITY.md §6): host membership, host-loss
     # migration, and epoch fencing — recorded live by the controller
     # (service/fleet.py), zero-injected everywhere else
@@ -190,6 +196,13 @@ def test_bench_json_contract(tmp_path):
                     "integrity_overhead_pct",
                     "integrity_jobs_per_s",
                     "integrity_fingerprint_gbps",
+                    # r13: block-store sub-leg (docs/STORE.md) — cold
+                    # ingest + cold store reads vs the file-decode
+                    # rate, parity-gated, with read-time CRC-reject
+                    # accounting; host-side, survives outage
+                    "store_ingest_fps", "store_read_fps",
+                    "store_vs_decode", "store_divergence",
+                    "store_parity", "store_chunk_crc_rejects",
                     # fleet serving sub-leg (docs/RELIABILITY.md §6):
                     # K tenants across 2 real host processes, clean
                     # wave vs one kill -9 mid-wave — host-side, so a
@@ -240,6 +253,17 @@ def test_bench_json_contract(tmp_path):
         assert 0 < rec["serving_accel_cache_hit_rate"] <= 1
         assert rec["serving_accel_coalesce_rate"] == 1.0
         assert "serving_accel" in rec["accel_leg_order"]
+        # store sub-leg: the ingest and the store read both ran, the
+        # store read is parity-gated against the file-reader oracle
+        # at the staging-dtype bar, no chunk failed its read-time
+        # fingerprint verification, and the speedup ratio was scored
+        # (a FAIL parity withholds it)
+        assert rec["store_ingest_fps"] > 0
+        assert rec["store_read_fps"] > 0
+        assert rec["store_parity"] == "PASS"
+        assert 0 <= rec["store_divergence"] <= 1e-3
+        assert rec["store_chunk_crc_rejects"] == 0
+        assert rec["store_vs_decode"] > 0
         # fleet sub-leg: one host really was kill -9'd mid-wave, every
         # job still completed exactly once (journal-audited), and the
         # clean wave-2 ran fully home-resident (sticky routing)
@@ -356,6 +380,10 @@ def test_bench_outage_records_host_legs(tmp_path):
         # recovery is measured even with the tunnel down
         assert rec["serving_fault_recovery_jobs_per_s"] > 0
         assert rec["serving_fault_lease_expired"] >= 1
+        # r13: the store sub-leg is host-side too — a tunnel-down
+        # artifact still records the ingest/read rates and parity
+        assert rec["store_read_fps"] > 0
+        assert rec["store_parity"] == "PASS"
         # r12: the fleet sub-leg is host-side (serial host processes)
         # — the kill -9 migration record survives the outage too
         assert rec["fleet_loss_jobs_per_s"] > 0

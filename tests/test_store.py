@@ -1,0 +1,440 @@
+"""Native chunked block store (mdanalysis_mpi_tpu/io/store — docs/STORE.md).
+
+Round-trip parity (ingest → read vs the source reader, every
+quantization tier), the raw-slice staging fast path, read-time
+fingerprint verification (corrupt chunks, swapped chunks, corrupt
+manifests all rejected TYPED and counted), exact-slice chunk fetch
+accounting, chunk-aligned shard routing, the executor boundary
+(jax backend + DeviceBlockCache off a store), and the CLI surfaces.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from mdanalysis_mpi_tpu.core.topology import Topology
+from mdanalysis_mpi_tpu.core.universe import Universe
+from mdanalysis_mpi_tpu.io.memory import MemoryReader
+from mdanalysis_mpi_tpu.io.store import (
+    LocalDirBackend, StoreReader, ingest, is_store, store_meta,
+)
+from mdanalysis_mpi_tpu.obs import METRICS
+from mdanalysis_mpi_tpu.utils.integrity import (
+    IntegrityError, StoreCorruptError,
+)
+
+pytestmark = pytest.mark.store
+
+
+def _rejects() -> int:
+    return METRICS.snapshot().get(
+        "mdtpu_store_chunk_crc_rejects_total",
+        {"values": {}})["values"].get("", 0)
+
+
+def _topology(n_atoms: int) -> Topology:
+    names = np.tile(np.array(["CA", "HA"]), n_atoms // 2 + 1)[:n_atoms]
+    return Topology(names=names, resnames=np.full(n_atoms, "ALA"),
+                    resids=np.arange(n_atoms) // 2 + 1)
+
+
+def _source(n_frames=40, n_atoms=60, seed=0, scale=12.0):
+    rng = np.random.default_rng(seed)
+    base = rng.normal(scale=scale, size=(n_atoms, 3)).astype(np.float32)
+    frames = base[None] + rng.normal(
+        scale=0.4, size=(n_frames, n_atoms, 3)).astype(np.float32)
+    dims = np.tile(np.array([40.0, 40, 40, 90, 90, 90],
+                            dtype=np.float32), (n_frames, 1))
+    times = np.arange(n_frames, dtype=np.float64) * 2.0
+    return MemoryReader(frames, dimensions=dims, times=times), frames
+
+
+class TestRoundTrip:
+    def test_int16_parity_and_metadata(self, tmp_path):
+        src, frames = _source()
+        out = str(tmp_path / "s16")
+        summary = ingest(src, out, chunk_frames=16, quant="int16")
+        assert summary["n_chunks"] == 3          # 16+16+8
+        assert summary["n_frames"] == 40
+        sr = StoreReader(out)
+        assert sr.n_frames == 40 and sr.n_atoms == 60
+        assert sr.quant == "int16" and sr.chunk_frames == 16
+        got, boxes = sr.read_block(0, 40)
+        # one int16 round trip: resolution = max|x| * margin / 32000
+        tol = float(np.abs(frames).max()) * 1.05 / 32000.0
+        assert float(np.abs(got - frames).max()) <= tol + 1e-6
+        assert boxes.shape == (40, 6)
+        np.testing.assert_allclose(boxes[0], [40, 40, 40, 90, 90, 90])
+        # per-frame cursor reads carry time + dims
+        ts = sr[17]
+        assert ts.frame == 17 and ts.time == pytest.approx(34.0)
+        assert ts.dimensions is not None
+        # frame_times serves without decoding coordinates
+        np.testing.assert_allclose(
+            sr.frame_times(range(5, 9)), [10.0, 12.0, 14.0, 16.0])
+        # strided + selected block reads
+        sel = np.arange(0, 60, 3)
+        got_s, _ = sr.read_block(4, 36, sel=sel, step=4)
+        assert got_s.shape == (8, 20, 3)
+        assert float(np.abs(got_s - frames[4:36:4][:, sel]).max()) \
+            <= tol + 1e-6
+
+    def test_f32_tier_is_bit_exact(self, tmp_path):
+        src, frames = _source(n_frames=10)
+        out = str(tmp_path / "sf32")
+        ingest(src, out, chunk_frames=4, quant="f32")
+        sr = StoreReader(out)
+        got, _ = sr.read_block(0, 10)
+        np.testing.assert_array_equal(got, frames)
+        # f32 staging requests pass straight through
+        block, _boxes, inv = sr.stage_block(0, 8)
+        assert inv is None and block.dtype == np.float32
+        np.testing.assert_array_equal(block, frames[:8])
+
+    def test_int8_tier_coarse_round_trip(self, tmp_path):
+        src, frames = _source(n_frames=12)
+        out = str(tmp_path / "s8")
+        ingest(src, out, chunk_frames=6, quant="int8")
+        got, _ = StoreReader(out).read_block(0, 12)
+        tol = float(np.abs(frames).max()) * 1.05 / 120.0
+        assert float(np.abs(got - frames).max()) <= tol + 1e-6
+
+
+class TestStagingFastPath:
+    def test_serves_raw_quantized_slices(self, tmp_path):
+        src, frames = _source()
+        out = str(tmp_path / "s")
+        ingest(src, out, chunk_frames=16, quant="int16")
+        sr = StoreReader(out)
+        sel = np.arange(10, 50)
+        q, boxes, inv = sr.stage_block(16, 32, sel=sel, quantize="int16")
+        assert q.dtype == np.int16 and q.shape == (16, 40, 3)
+        assert isinstance(inv, np.float32)
+        assert boxes.shape == (16, 6)
+        # dequantized staged bytes match the source inside one step
+        deq = q.astype(np.float32) * inv
+        assert float(np.abs(deq - frames[16:32][:, sel]).max()) \
+            <= float(inv) / 2 + 1e-6
+        # chunk-spanning request under the store-wide uniform scale
+        q2, _b2, inv2 = sr.stage_block(8, 40, sel=None, quantize=True)
+        assert q2.dtype == np.int16 and q2.shape == (32, 60, 3)
+        assert float(inv2) == pytest.approx(float(inv))
+        deq2 = q2.astype(np.float32) * inv2
+        assert float(np.abs(deq2 - frames[8:40]).max()) \
+            <= float(inv2) / 2 + 1e-6
+
+    def test_mixed_scale_chunks_requantize_not_misdequantize(
+            self, tmp_path):
+        # chunk 1's range outgrows the store-wide margin -> it gets an
+        # exact per-chunk scale, and a request spanning both chunks
+        # must requantize through f32 rather than serve mixed-scale
+        # bytes under one inv_scale
+        frames = np.zeros((16, 20, 3), dtype=np.float32)
+        rng = np.random.default_rng(1)
+        frames[:8] = rng.normal(scale=5.0, size=(8, 20, 3))
+        frames[8:] = rng.normal(scale=500.0, size=(8, 20, 3))
+        out = str(tmp_path / "mixed")
+        summary = ingest(MemoryReader(frames), out, chunk_frames=8,
+                         quant="int16")
+        # the degraded fast path is DISCLOSED, not silent
+        assert summary["scale_overflow_chunks"] == 1
+        man = store_meta(out)
+        assert man["scale_overflow_chunks"] == 1
+        scales = [c["inv_scale"] for c in man["chunks"]]
+        assert scales[0] != scales[1]
+        sr = StoreReader(out)
+        q, _boxes, inv = sr.stage_block(0, 16, quantize="int16")
+        assert q.dtype == np.int16
+        deq = np.asarray(q, np.float32) * np.asarray(inv, np.float32)
+        # exact-per-block requantize resolution (quantize_block policy)
+        tol = float(np.abs(frames).max()) / 32000.0
+        assert float(np.abs(deq - frames).max()) <= tol + 1e-6
+
+    def test_exact_slice_fetches(self, tmp_path):
+        # a shard child reading its window must fetch ONLY the chunks
+        # covering it — the fleet's fetch-exactly-your-slice contract
+        src, _frames = _source(n_frames=64)
+        out = str(tmp_path / "slices")
+        ingest(src, out, chunk_frames=8, quant="int16")
+
+        class CountingBackend(LocalDirBackend):
+            def __init__(self, root):
+                super().__init__(root)
+                self.fetched = []
+
+            def get_bytes(self, name):
+                self.fetched.append(name)
+                return super().get_bytes(name)
+
+        be = CountingBackend(out)
+        sr = StoreReader(out, backend=be)
+        be.fetched.clear()                       # drop the manifest get
+        sr.stage_block(24, 40, quantize="int16")
+        assert be.fetched == ["chunk-00000003.mdtc",
+                              "chunk-00000004.mdtc"]
+
+
+class TestVerifiedReads:
+    def test_corrupt_payload_rejected_typed_and_counted(self, tmp_path):
+        src, _ = _source()
+        out = str(tmp_path / "c")
+        ingest(src, out, chunk_frames=16, quant="int16")
+        path = os.path.join(out, "chunk-00000001.mdtc")
+        blob = bytearray(open(path, "rb").read())
+        blob[-5] ^= 0x10
+        with open(path, "wb") as f:
+            f.write(bytes(blob))
+        sr = StoreReader(out)
+        before = _rejects()
+        with pytest.raises(StoreCorruptError):
+            sr.read_block(16, 32)
+        assert _rejects() == before + 1
+        # frames outside the corrupt chunk still serve
+        assert sr.read_block(0, 16)[0].shape == (16, 60, 3)
+
+    def test_corrupt_header_and_truncation_rejected(self, tmp_path):
+        src, _ = _source(n_frames=8)
+        out = str(tmp_path / "h")
+        ingest(src, out, chunk_frames=8, quant="int16")
+        path = os.path.join(out, "chunk-00000000.mdtc")
+        orig = open(path, "rb").read()
+        blob = bytearray(orig)
+        blob[20] ^= 0x01                         # inside the header JSON
+        with open(path, "wb") as f:
+            f.write(bytes(blob))
+        with pytest.raises(IntegrityError):
+            StoreReader(out).read_block(0, 8)
+        with open(path, "wb") as f:
+            f.write(orig[:-100])                 # truncated payload
+        with pytest.raises(StoreCorruptError):
+            StoreReader(out).read_block(0, 8)
+
+    def test_swapped_self_valid_chunks_rejected(self, tmp_path):
+        # two chunks, each internally consistent, swapped on disk:
+        # self-CRCs pass, the manifest fingerprint comparison must not
+        src, _ = _source(n_frames=32)
+        out = str(tmp_path / "swap")
+        ingest(src, out, chunk_frames=16, quant="int16")
+        a = os.path.join(out, "chunk-00000000.mdtc")
+        b = os.path.join(out, "chunk-00000001.mdtc")
+        da, db = open(a, "rb").read(), open(b, "rb").read()
+        with open(a, "wb") as f:
+            f.write(db)
+        with open(b, "wb") as f:
+            f.write(da)
+        with pytest.raises(StoreCorruptError):
+            StoreReader(out).read_block(0, 16)
+
+    def test_missing_chunk_rejected_typed_and_counted(self, tmp_path):
+        # a chunk the manifest promises but the backend cannot produce
+        # is truncation taken to its limit: same typed taxonomy, same
+        # counter — never a raw FileNotFoundError
+        src, _ = _source(n_frames=32)
+        out = str(tmp_path / "gone")
+        ingest(src, out, chunk_frames=16, quant="int16")
+        os.remove(os.path.join(out, "chunk-00000001.mdtc"))
+        before = _rejects()
+        with pytest.raises(StoreCorruptError, match="unreadable"):
+            StoreReader(out).read_block(16, 32)
+        assert _rejects() == before + 1
+
+    def test_reingest_kills_manifest_first(self, tmp_path):
+        # a crashed re-ingest must leave "not a store", never a valid
+        # old manifest over half-replaced chunks: the manifest dies
+        # before the first chunk write
+        src, _ = _source(n_frames=16)
+        out = str(tmp_path / "reingest")
+        ingest(src, out, chunk_frames=8, quant="int16")
+        assert is_store(out)
+
+        class CrashingReader(MemoryReader):
+            def read_block(self, start, stop, sel=None, step=1):
+                if start > 0:
+                    raise RuntimeError("simulated ingest crash")
+                return MemoryReader.read_block(self, start, stop,
+                                               sel=sel, step=step)
+
+        src2, _ = _source(n_frames=16, seed=3)
+        crasher = CrashingReader(src2._coords)
+        with pytest.raises(RuntimeError):
+            ingest(crasher, out, chunk_frames=8, quant="int16")
+        assert not is_store(out)             # no manifest, no store
+        # a fresh ingest over the wreckage recovers cleanly
+        ingest(src, out, chunk_frames=8, quant="int16")
+        assert StoreReader(out).read_block(0, 16)[0].shape == (16, 60, 3)
+
+    def test_reingest_sweeps_orphan_chunks(self, tmp_path):
+        # re-chunking to a coarser geometry must not strand the old
+        # geometry's files as unreferenced disk
+        src, _ = _source(n_frames=16)
+        out = str(tmp_path / "rechunk")
+        ingest(src, out, chunk_frames=4, quant="int16")    # 4 chunks
+        ingest(src, out, chunk_frames=8, quant="int16")    # 2 chunks
+        names = sorted(f for f in os.listdir(out)
+                       if f.startswith("chunk-"))
+        assert names == ["chunk-00000000.mdtc", "chunk-00000001.mdtc"]
+        assert StoreReader(out).read_block(0, 16)[0].shape == (16, 60, 3)
+
+    def test_corrupt_manifest_rejected(self, tmp_path):
+        src, _ = _source(n_frames=8)
+        out = str(tmp_path / "m")
+        ingest(src, out, chunk_frames=8, quant="int16")
+        mpath = os.path.join(out, "manifest.json")
+        man = json.loads(open(mpath).read())
+        man["n_frames"] = 9999                   # tampered, CRC stale
+        with open(mpath, "w") as f:
+            f.write(json.dumps(man))
+        with pytest.raises(StoreCorruptError):
+            StoreReader(out)
+        # and is_store still sniffs it (format field intact) while
+        # store_meta refuses it typed
+        assert is_store(out)
+        with pytest.raises(StoreCorruptError):
+            store_meta(out)
+
+
+class TestShardRouting:
+    def test_shard_windows_chunk_aligned(self):
+        from mdanalysis_mpi_tpu.parallel.partition import shard_windows
+
+        wins = shard_windows(100, None, None, None, 3, chunk_frames=16)
+        # boundaries land on chunk multiples; union covers [0, 100)
+        # exactly, in order
+        assert wins == [(0, 48, 1), (48, 80, 1), (80, 100, 1)]
+        covered = []
+        for s, e, st in wins:
+            assert s % 16 == 0 or s == 0
+            assert e % 16 == 0 or e == 100
+            covered.extend(range(s, e, st))
+        assert covered == list(range(100))
+        # windows that start mid-chunk keep their exact bounds
+        wins = shard_windows(100, 10, 90, 1, 2, chunk_frames=32)
+        assert wins == [(10, 64, 1), (64, 90, 1)]
+        assert [f for w in wins for f in range(*w)] == list(range(10, 90))
+        # non-unit steps skip alignment but keep the exact-union
+        # contract
+        wins = shard_windows(100, 0, 100, 3, 2, chunk_frames=16)
+        assert [f for w in wins for f in range(*w)] \
+            == list(range(0, 100, 3))
+        # unchanged default path
+        assert shard_windows(10, None, None, None, 2) \
+            == [(0, 5, 1), (5, 10, 1)]
+
+    def test_fleet_store_meta_routes_chunk_geometry(self, tmp_path):
+        from mdanalysis_mpi_tpu.service.fleet import _store_meta
+
+        src, _ = _source(n_frames=48)
+        out = str(tmp_path / "fleet_store")
+        ingest(src, out, chunk_frames=12, quant="int16")
+        meta = _store_meta({"trajectory": out, "topology": "x.gro"})
+        assert meta["chunk_frames"] == 12 and meta["n_frames"] == 48
+        assert _store_meta({"trajectory": str(tmp_path)}) is None
+        assert _store_meta({"fixture": {"n_frames": 8}}) is None
+
+
+class TestExecutorBoundary:
+    def test_jax_int16_parity_and_device_cache(self, tmp_path):
+        jax = pytest.importorskip("jax")  # noqa: F841
+        from mdanalysis_mpi_tpu.analysis import AlignedRMSF
+        from mdanalysis_mpi_tpu.parallel.executors import (
+            DeviceBlockCache, reader_fingerprint,
+        )
+
+        src, frames = _source(n_frames=32, n_atoms=40)
+        out = str(tmp_path / "exec")
+        ingest(src, out, chunk_frames=8, quant="int16")
+        topo = _topology(40)
+        u_mem = Universe(topo, src)
+        u_store = Universe(topo, StoreReader(out))
+        # the store dir IS the reader's cache-key namespace
+        assert reader_fingerprint(u_store.trajectory) == out
+        s = AlignedRMSF(u_mem, select="heavy").run(backend="serial")
+        cache = DeviceBlockCache()
+        a1 = AlignedRMSF(u_store, select="heavy").run(
+            backend="jax", batch_size=8, transfer_dtype="int16",
+            block_cache=cache)
+        err = float(np.abs(np.asarray(a1.results.rmsf)
+                           - s.results.rmsf).max())
+        assert err < 1e-3, err
+        # pass 2 of a second run rides HBM-resident superblocks — the
+        # stage_cached boundary is unchanged by construction
+        m0 = cache.misses
+        a2 = AlignedRMSF(u_store, select="heavy").run(
+            backend="jax", batch_size=8, transfer_dtype="int16",
+            block_cache=cache)
+        assert cache.misses == m0 and cache.hits > 0
+        err2 = float(np.abs(np.asarray(a2.results.rmsf)
+                            - s.results.rmsf).max())
+        assert err2 < 1e-3, err2
+
+
+class TestCLI:
+    def test_universe_opens_store_dir(self, tmp_path):
+        src, _ = _source(n_frames=8, n_atoms=20)
+        out = str(tmp_path / "u")
+        ingest(src, out, chunk_frames=4, quant="int16")
+        u = Universe(_topology(20), out)
+        assert isinstance(u.trajectory, StoreReader)
+        assert u.trajectory.n_frames == 8
+        with pytest.raises(ValueError, match="not an ingested block"):
+            Universe(_topology(20), str(tmp_path))
+
+    def test_ingest_cli_roundtrip_and_idempotence(self, tmp_path,
+                                                  capsys):
+        from mdanalysis_mpi_tpu.io.store.cli import ingest_main
+        from mdanalysis_mpi_tpu.io.xtc import write_xtc
+
+        rng = np.random.default_rng(5)
+        frames = rng.normal(scale=9.0, size=(12, 30, 3)).astype(
+            np.float32)
+        xtc = str(tmp_path / "t.xtc")
+        write_xtc(xtc, frames)
+        out = str(tmp_path / "t.store")
+        assert ingest_main([xtc, "--out", out, "--chunk-frames", "4",
+                            "--quant", "int16"]) == 0
+        rec = json.loads(capsys.readouterr().out.strip())
+        assert rec["n_chunks"] == 3 and rec["store_ingest_fps"] > 0
+        # ingest-once: a second invocation is an answer, not a re-run
+        assert ingest_main([xtc, "--out", out]) == 0
+        rec2 = json.loads(capsys.readouterr().out.strip())
+        assert rec2["already_ingested"] is True
+        assert rec2["n_chunks"] == 3
+
+    def test_ingest_smoke_gate(self, capsys):
+        from mdanalysis_mpi_tpu.io.store.cli import ingest_main
+
+        assert ingest_main(["--smoke"]) == 0
+        rec = json.loads(capsys.readouterr().out.strip())
+        assert rec["ok"] is True
+        assert rec["corrupt_chunk_rejected"] == "StoreCorruptError"
+
+    def test_batch_prefers_store(self, tmp_path, capsys):
+        # the job file's trajectory path is DELETED after ingest, so
+        # the batch run can only succeed by reading the store — the
+        # strongest possible "--store was actually used" proof
+        from mdanalysis_mpi_tpu.io.gro import write_gro
+        from mdanalysis_mpi_tpu.io.xtc import write_xtc
+        from mdanalysis_mpi_tpu.service.cli import batch_main
+
+        topo = _topology(20)
+        rng = np.random.default_rng(9)
+        frames = rng.normal(scale=8.0, size=(12, 20, 3)).astype(
+            np.float32)
+        gro = str(tmp_path / "top.gro")
+        write_gro(gro, topo, frames[0])
+        xtc = str(tmp_path / "t.xtc")
+        write_xtc(xtc, frames)
+        store = str(tmp_path / "t.store")
+        ingest(xtc, store, chunk_frames=4, quant="int16")
+        os.remove(xtc)
+        jobs = str(tmp_path / "jobs.json")
+        with open(jobs, "w") as f:
+            json.dump({"topology": gro, "trajectory": xtc,
+                       "jobs": [{"analysis": "rmsf",
+                                 "select": "heavy",
+                                 "backend": "serial"}]}, f)
+        assert batch_main([jobs, "--store", store]) == 0
+        rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert rec["jobs"][0]["state"] == "done"
