@@ -1068,6 +1068,100 @@ def fleet_serving_leg() -> dict:
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def obs_federation_leg() -> dict:
+    """Fleet-observability federation sub-leg
+    (docs/OBSERVABILITY.md "Fleet federation"): the price of the
+    heartbeat piggyback.  Two 2-host fleets run the same warmed
+    8-job wave — one with federation shipping disabled
+    (``obs_interval_s=0``), one shipping metric deltas every 100 ms
+    plus in-memory trace batches (``MDTPU_FLEET_TRACE``) — and the
+    jobs/s delta lands as ``obs_federation_overhead_pct`` (<3%
+    target at flagship scale), next to the ship/trace accounting
+    from the merged fleet snapshot.  Host-side by construction
+    (serial hosts, jax-free children): survives the outage protocol
+    like the fleet leg."""
+    import shutil
+    import tempfile
+
+    from mdanalysis_mpi_tpu.service.fleet import DONE, FleetController
+
+    fixture = {"kind": "protein", "n_residues": 10, "n_frames": 12,
+               "noise": 0.25, "seed": 11}
+    tenants = [f"ofed{i}" for i in range(4)]
+
+    def run_fleet(obs_interval: float, trace: bool):
+        workdir = tempfile.mkdtemp(prefix="mdtpu-obsfed-")
+        try:
+            with FleetController(workdir, host_ttl_s=2.0,
+                                 trace=trace,
+                                 obs_interval_s=obs_interval) as ctrl:
+                for _ in range(2):
+                    ctrl.spawn_host(hb_interval_s=0.1)
+                if not ctrl.wait_hosts(2, timeout=120.0):
+                    raise RuntimeError(
+                        "obs federation leg: hosts never joined")
+
+                def wave():
+                    t0 = time.perf_counter()
+                    jobs = [ctrl.submit({"analysis": "rmsf",
+                                         "fixture": fixture,
+                                         "tenant": t})
+                            for t in tenants for _ in range(2)]
+                    if not ctrl.drain(timeout=300.0):
+                        raise RuntimeError(
+                            "obs federation leg: drain timed out")
+                    bad = [j for j in jobs if j.state != DONE]
+                    if bad:
+                        raise RuntimeError(
+                            f"obs federation leg: {len(bad)} jobs "
+                            f"not done ({bad[0].state}: "
+                            f"{bad[0].error})")
+                    return len(jobs) / (time.perf_counter() - t0)
+
+                wave()                     # cold: residency builds
+                jps = wave()               # the timed steady wave
+                extras = {}
+                if obs_interval > 0:
+                    # let the last heartbeat ships land, then read
+                    # the host-side accounting out of the MERGED view
+                    deadline = time.monotonic() + 5.0
+                    while time.monotonic() < deadline:
+                        snap = ctrl.fleet_snapshot()
+                        ships = sum(
+                            snap["mdtpu_fleet_obs_metrics_ships_total"]
+                            ["values"].values())
+                        trace_events = sum(
+                            snap["mdtpu_fleet_obs_trace_events_total"]
+                            ["values"].values())
+                        done = sum(
+                            snap["mdtpu_jobs_completed_total"]
+                            ["values"].values())
+                        if ships and trace_events and done >= 16:
+                            break
+                        time.sleep(0.1)
+                    extras = {
+                        "obs_federation_metrics_ships": int(ships),
+                        "obs_federation_trace_events": int(
+                            trace_events)}
+                return jps, extras
+        finally:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+    plain_jps, _ = run_fleet(0.0, trace=False)
+    fed_jps, extras = run_fleet(0.1, trace=True)
+    out = {
+        "obs_federation_plain_jobs_per_s": round(plain_jps, 2),
+        "obs_federation_jobs_per_s": round(fed_jps, 2),
+        # the piggyback price vs the plain wave (<3% target at
+        # flagship scale; clamped at 0 like the fleet recovery
+        # overhead — toy-scale waves jitter both ways)
+        "obs_federation_overhead_pct": round(
+            max(0.0, (plain_jps - fed_jps) / plain_jps * 100.0), 2),
+    }
+    out.update(extras)
+    return out
+
+
 def serving_accel_leg(u_file, accel_backend: str, tdtype: str,
                       jax) -> dict:
     """Multi-tenant load on the accelerator backend with one SHARED
@@ -1250,6 +1344,20 @@ def main():
           f"({fleet['fleet_jobs_migrated']} migrated, wave-2 home-hit "
           f"rate {fleet['fleet_wave2_home_hit_rate']})")
     _leg_done("fleet serving leg", **fleet)
+
+    # fleet-observability federation sub-leg (docs/OBSERVABILITY.md
+    # "Fleet federation"): heartbeat-piggyback overhead vs a plain
+    # fleet wave, with the ship/trace accounting — host-side, so it
+    # survives the outage protocol too
+    ofed = obs_federation_leg()
+    _note(f"[bench] obs federation: "
+          f"{ofed['obs_federation_jobs_per_s']} jobs/s federated vs "
+          f"{ofed['obs_federation_plain_jobs_per_s']} plain -> "
+          f"{ofed['obs_federation_overhead_pct']}% "
+          f"({ofed.get('obs_federation_metrics_ships', 0)} ships, "
+          f"{ofed.get('obs_federation_trace_events', 0)} trace "
+          f"events)")
+    _leg_done("obs federation leg", **ofed)
 
     u_file = open_flagship(N_ATOMS, N_FRAMES)
     src_label = ("file-backed XTC" if SOURCE == "file"

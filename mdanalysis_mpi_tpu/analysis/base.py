@@ -336,29 +336,40 @@ class AnalysisBase:
         obs.maybe_enable_from_env()
         cap = obs.start_run_capture()
         t0 = time.perf_counter()
-        if not self._accepts_updating_groups:
-            self._refuse_updating_groups()
-        frames = list(self._frames(start, stop, step, frames))
-        self.n_frames = len(frames)
-        # the resolved frame list, readable from _prepare/_conclude
-        # (analyses that need frame numbers — time-series frame columns,
-        # first-frame-derived grids — use this instead of re-deriving)
-        self._frame_indices = frames
-        executor = get_executor(backend, **executor_kwargs)
-        backend_name = getattr(executor, "name", type(executor).__name__)
-        with obs.span("run", analysis=type(self).__name__,
-                      backend=backend_name, n_frames=self.n_frames):
-            with TIMERS.phase("prepare"):
-                self._prepare()
-            with TIMERS.phase("execute"):
-                total = executor.execute(self, self._universe.trajectory,
-                                         frames, batch_size=batch_size)
-            # raw partials handle: a fetch-free synchronization point for
-            # benchmarks (jax.block_until_ready drains the device queue
-            # without the readback that collapses tunneled links)
-            self._last_total = total
-            with TIMERS.phase("conclude"):
-                self._conclude(total)
+        try:
+            if not self._accepts_updating_groups:
+                self._refuse_updating_groups()
+            frames = list(self._frames(start, stop, step, frames))
+            self.n_frames = len(frames)
+            # the resolved frame list, readable from _prepare/_conclude
+            # (analyses that need frame numbers — time-series frame
+            # columns, first-frame-derived grids — use this instead of
+            # re-deriving)
+            self._frame_indices = frames
+            executor = get_executor(backend, **executor_kwargs)
+            backend_name = getattr(executor, "name",
+                                   type(executor).__name__)
+            with obs.span("run", analysis=type(self).__name__,
+                          backend=backend_name, n_frames=self.n_frames):
+                with TIMERS.phase("prepare"):
+                    self._prepare()
+                with TIMERS.phase("execute"):
+                    total = executor.execute(
+                        self, self._universe.trajectory, frames,
+                        batch_size=batch_size)
+                # raw partials handle: a fetch-free synchronization
+                # point for benchmarks (jax.block_until_ready drains
+                # the device queue without the readback that collapses
+                # tunneled links)
+                self._last_total = total
+                with TIMERS.phase("conclude"):
+                    self._conclude(total)
+        except BaseException:
+            # a raising run never reaches finish_run_capture: release
+            # its phase window or every failed job would leak one into
+            # the process-global registry (obs/report.py)
+            obs.abandon_run_capture(cap)
+            raise
         obs.METRICS.inc("mdtpu_runs_total", backend=backend_name)
         self.results.observability = obs.finish_run_capture(
             cap, analysis=type(self).__name__, backend=backend_name,

@@ -483,19 +483,28 @@ class AlignedRMSF(AnalysisBase):
                                      type(backend).__name__))
         obs.maybe_enable_from_env()
         cap = obs.start_run_capture()
-        with obs.span("run", analysis=type(self).__name__,
-                      backend=backend_name):
-            with obs.span("pass", index=1, analysis="AverageStructure"):
-                avg = self._make_pass1().run(
-                    start, stop, step, frames=frames, backend=backend,
-                    batch_size=batch_size, resilient=resilient, **kwargs)
-            moments_pass = self._make_pass2(avg)
-            with obs.span("pass", index=2,
-                          analysis="_MomentsToReference"):
-                moments_pass.run(start, stop, step, frames=frames,
-                                 backend=backend, batch_size=batch_size,
-                                 resilient=resilient, **kwargs)
-        self._finalize(moments_pass)
+        try:
+            with obs.span("run", analysis=type(self).__name__,
+                          backend=backend_name):
+                with obs.span("pass", index=1,
+                              analysis="AverageStructure"):
+                    avg = self._make_pass1().run(
+                        start, stop, step, frames=frames,
+                        backend=backend, batch_size=batch_size,
+                        resilient=resilient, **kwargs)
+                moments_pass = self._make_pass2(avg)
+                with obs.span("pass", index=2,
+                              analysis="_MomentsToReference"):
+                    moments_pass.run(
+                        start, stop, step, frames=frames,
+                        backend=backend, batch_size=batch_size,
+                        resilient=resilient, **kwargs)
+            self._finalize(moments_pass)
+        except BaseException:
+            # same leak guard as AnalysisBase.run: a failed pass must
+            # release the outer capture's phase window
+            obs.abandon_run_capture(cap)
+            raise
         # the multi-pass RunReport covers BOTH passes (the child runs
         # attach their own per-pass reports to internal analyses the
         # user never sees)

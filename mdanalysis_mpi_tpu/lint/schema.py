@@ -78,6 +78,8 @@ _TABLE_TYPES = {
     "STORE_COUNTERS": "counter",
     "FLEET_COUNTERS": "counter",
     "FLEET_GAUGES": "gauge",
+    "FLEET_OBS_COUNTERS": "counter",
+    "FLEET_OBS_GAUGES": "gauge",
 }
 
 _RECORD_TYPES = {"inc": "counter", "observe": "histogram",

@@ -21,10 +21,14 @@ never the reverse, so instrumentation can thread anywhere without
 cycles.
 """
 
+from mdanalysis_mpi_tpu.obs import flight as flight
+from mdanalysis_mpi_tpu.obs.flight import dump as flight_dump
 from mdanalysis_mpi_tpu.obs.metrics import (
     METRICS, MetricsRegistry, to_prometheus, unified_snapshot,
 )
-from mdanalysis_mpi_tpu.obs.report import finish_capture, start_capture
+from mdanalysis_mpi_tpu.obs.report import (
+    abandon_capture, finish_capture, start_capture,
+)
 from mdanalysis_mpi_tpu.obs.spans import (
     context as trace_context,
     disable as disable_tracing,
@@ -39,14 +43,17 @@ from mdanalysis_mpi_tpu.obs.spans import (
 )
 
 # run-capture helpers under their obs.* names (AnalysisBase.run calls
-# obs.start_run_capture / obs.finish_run_capture)
+# obs.start_run_capture / obs.finish_run_capture, and
+# obs.abandon_run_capture when the run raises in between)
 start_run_capture = start_capture
 finish_run_capture = finish_capture
+abandon_run_capture = abandon_capture
 
 __all__ = [
     "METRICS", "MetricsRegistry", "to_prometheus", "unified_snapshot",
     "span", "span_event", "trace_context", "enable_tracing",
     "disable_tracing", "tracing_enabled", "export_trace", "trace_path",
     "maybe_enable_from_env", "set_process_args", "start_run_capture",
-    "finish_run_capture",
+    "finish_run_capture", "abandon_run_capture", "flight",
+    "flight_dump",
 ]

@@ -259,9 +259,73 @@ FLEET_GAUGES = (
     "mdtpu_controller_epoch",
 )
 
+#: Fleet-observability counters (docs/OBSERVABILITY.md "Fleet
+#: federation"): host-side metric ships and trace-event batches
+#: piggybacked on heartbeats (drops disclosed, labeled ``site=``),
+#: flight-recorder dumps (labeled ``trigger=`` — obs/flight.py), and
+#: status-endpoint requests (labeled ``route=`` —
+#: service/statusd.py).  Recorded live at each site; zero-injected so
+#: a process that never federated still carries the schema.
+FLEET_OBS_COUNTERS = (
+    "mdtpu_fleet_obs_metrics_ships_total",
+    "mdtpu_fleet_obs_trace_events_total",
+    "mdtpu_fleet_obs_trace_dropped_total",
+    "mdtpu_flight_dumps_total",
+    "mdtpu_status_requests_total",
+)
+
+#: Fleet-observability gauges: how many hosts have a metrics snapshot
+#: merged into the controller's fleet view (0 = not federating).
+FLEET_OBS_GAUGES = (
+    "mdtpu_fleet_hosts_reporting",
+)
+
+
+def _merge_host_snapshot(snap: dict, hid: str, host_snap: dict) -> None:
+    """Fold one host's shipped snapshot into the fleet document (the
+    ``unified_snapshot(fleet=)`` merge rules, docs/OBSERVABILITY.md):
+
+    - **counters / histograms are summed** per label across hosts (the
+      fixed buckets exist exactly so histograms merge) — the
+      controller's own series contribute too, but the controller
+      records none of the host-side job/phase series, so a fleet job
+      counter IS the sum of the per-host registries;
+    - **gauges are labeled** ``host="<id>"`` per host — a gauge is a
+      point-in-time level, so summing would lie — while the
+      controller-local gauge keeps its unlabeled key, distinct.
+
+    A series whose type disagrees with the local one (schema drift
+    across mixed versions) is skipped, never folded wrong."""
+    for name, series in host_snap.items():
+        if not isinstance(series, dict) or "values" not in series:
+            continue
+        typ = series.get("type")
+        dst = snap.setdefault(name, {"type": typ, "values": {}})
+        if dst["type"] != typ:
+            continue
+        vals = dst["values"]
+        if typ == "counter":
+            for k, v in series["values"].items():
+                vals[k] = vals.get(k, 0) + v
+        elif typ == "gauge":
+            for k, v in series["values"].items():
+                vals[(k + "," if k else "") + f'host="{hid}"'] = v
+        elif typ == "histogram":
+            for k, h in series["values"].items():
+                cur = vals.get(k)
+                if cur is None:
+                    vals[k] = {"count": h["count"], "sum": h["sum"],
+                               "buckets": dict(h["buckets"])}
+                    continue
+                cur["count"] += h["count"]
+                cur["sum"] = round(cur["sum"] + h["sum"], 6)
+                for le, c in h["buckets"].items():
+                    cur["buckets"][le] = cur["buckets"].get(le, 0) + c
+
 
 def unified_snapshot(timers=None, cache=None, telemetry=None,
-                     registry: MetricsRegistry | None = None) -> dict:
+                     registry: MetricsRegistry | None = None,
+                     fleet: dict | None = None) -> dict:
     """One JSON document over the registry's live series PLUS the
     private trackers handed in:
 
@@ -272,7 +336,12 @@ def unified_snapshot(timers=None, cache=None, telemetry=None,
       hit/miss counters and byte gauges;
     - ``telemetry`` (a :class:`~mdanalysis_mpi_tpu.service.telemetry.
       ServiceTelemetry`) → the job lifecycle / coalesce / admission
-      counters and queue-depth gauges.
+      counters and queue-depth gauges;
+    - ``fleet`` (``{host_id: shipped snapshot}``, the fleet
+      controller's per-host metric payloads) → merged on top of the
+      LOCAL document per :func:`_merge_host_snapshot`: host counters
+      and histograms summed, host gauges labeled ``host=``,
+      controller-local series kept distinct.
 
     This is the ``metrics`` block bench legs embed and the schema
     ``tests/test_bench_contract.py`` pins.
@@ -281,10 +350,10 @@ def unified_snapshot(timers=None, cache=None, telemetry=None,
     for name in COMPILE_METRICS + BREAKER_COUNTERS + \
             SUPERVISION_COUNTERS + RELIABILITY_COUNTERS + \
             INTEGRITY_COUNTERS + SCRUB_COUNTERS + STORE_COUNTERS + \
-            FLEET_COUNTERS:
+            FLEET_COUNTERS + FLEET_OBS_COUNTERS:
         snap.setdefault(name, {"type": "counter", "values": {"": 0}})
     for name in BREAKER_GAUGES + LINT_GAUGES + INTEGRITY_GAUGES \
-            + FLEET_GAUGES:
+            + FLEET_GAUGES + FLEET_OBS_GAUGES:
         # 0 == closed (reliability/breaker.py STATE_VALUES): a process
         # that never tripped a breaker reports the healthy state;
         # likewise 0 lint rules/findings means "never linted here"
@@ -316,6 +385,11 @@ def unified_snapshot(timers=None, cache=None, telemetry=None,
         for key in _TELEMETRY_GAUGES:
             snap[f"mdtpu_{key}"] = {
                 "type": "gauge", "values": {"": t[key]}}
+    if fleet:
+        # hosts merge LAST, over the finished local document: the
+        # controller-local adapters above stay the controller's own
+        for hid in sorted(fleet):
+            _merge_host_snapshot(snap, hid, fleet[hid])
     return snap
 
 

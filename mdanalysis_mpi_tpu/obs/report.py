@@ -2,24 +2,29 @@
 ``results.observability``.
 
 ``AnalysisBase.run`` (and the multi-pass flagship's ``run`` override)
-captures the process-global phase timers before and after the run and
-attaches the DELTA — the run's own window into the timers, not the
-process's whole history — together with wall time, the dispatch count,
-the active ``scan_k``, and where the trace (if any) is being written.
-A plain JSON-friendly dict: ``.npz``/CLI serialization filters it out
-automatically (dicts are not arrays) and notebooks read it directly.
+captures phase totals for the run and attaches them together with wall
+time, the dispatch count, the active ``scan_k``, and where the trace
+(if any) is being written.  A plain JSON-friendly dict:
+``.npz``/CLI serialization filters it out automatically (dicts are not
+arrays) and notebooks read it directly.
 
-Caveat (documented, not fixable at this altitude): the deltas are a
-TIME-WINDOW slice of the process-global ``TIMERS``, so when runs
-overlap — a multi-worker scheduler serving two jobs at once — each
-report's phases/dispatch_count include whatever the OTHER run recorded
-inside the window.  Per-job attribution under concurrency is the span
-trace's job (job-id-stamped spans, docs/OBSERVABILITY.md); the report
-is exact whenever runs don't overlap (solo runs, the default
-1-worker scheduler).
+Attribution: when the run executes under a scheduler trace context
+(job/trace ids on the submitting thread — live even with tracing off),
+the report's phases come from the run's OWN phase window
+(``utils/timers.open_window``): every phase completion whose thread
+context carries the job's trace ids — including staging on the
+prefetch/pool threads, which re-apply the captured context — lands in
+the window, and nothing another concurrent job records can bleed in.
+``phase_attribution: "job"`` marks these reports.  A solo run with no
+trace context falls back to the process-global ``TIMERS`` delta
+(``phase_attribution: "process"``) — exact there by construction,
+since nothing else is recording.  (This replaces the PR-5
+time-window-slice caveat; the 2-worker regression test in
+``tests/test_obs.py`` pins the isolation.)
 
 Near-free by construction: capture is two small dict copies per run()
-call, nothing per frame or per block.
+call (plus one list append when a window opens), nothing per frame or
+per block.
 """
 
 from __future__ import annotations
@@ -29,10 +34,31 @@ import time
 
 def start_capture() -> dict:
     """Snapshot the run-scoped baselines (call at run() entry)."""
+    from mdanalysis_mpi_tpu.obs import spans as _spans
+    from mdanalysis_mpi_tpu.utils import timers as _timers
     from mdanalysis_mpi_tpu.utils.timers import TIMERS
 
     acc, calls = TIMERS.snapshot()
-    return {"t0": time.perf_counter(), "acc": acc, "calls": calls}
+    cap = {"t0": time.perf_counter(), "acc": acc, "calls": calls}
+    ids = _spans.current_trace_ids()
+    if ids:
+        # a scheduler (or any caller) stamped trace ids on this
+        # thread: attribute phases to THIS job via its own window
+        cap["window"] = _timers.open_window(ids)
+    return cap
+
+
+def abandon_capture(cap: dict) -> None:
+    """Release a :func:`start_capture` whose run raised before
+    :func:`finish_capture` could consume it — without this, every
+    failed job under a trace context would leak its phase window into
+    the process-global registry (run sites call this from their
+    except path)."""
+    from mdanalysis_mpi_tpu.utils import timers as _timers
+
+    window = cap.pop("window", None)
+    if window is not None:
+        _timers.close_window(window)
 
 
 def finish_capture(cap: dict, analysis: str, backend: str,
@@ -40,11 +66,20 @@ def finish_capture(cap: dict, analysis: str, backend: str,
     """Build the RunReport dict from a :func:`start_capture` baseline."""
     from mdanalysis_mpi_tpu.obs import spans as _spans
     from mdanalysis_mpi_tpu.parallel import executors as _executors
+    from mdanalysis_mpi_tpu.utils import timers as _timers
     from mdanalysis_mpi_tpu.utils.timers import TIMERS
 
     wall = time.perf_counter() - cap["t0"]
-    acc1, calls1 = TIMERS.snapshot()
-    acc0, calls0 = cap["acc"], cap["calls"]
+    window = cap.pop("window", None)
+    if window is not None:
+        _timers.close_window(window)
+        acc1, calls1 = window.snapshot()
+        acc0, calls0 = {}, {}
+        attribution = "job"
+    else:
+        acc1, calls1 = TIMERS.snapshot()
+        acc0, calls0 = cap["acc"], cap["calls"]
+        attribution = "process"
     phases = {}
     for name in acc1:
         ds = acc1[name] - acc0.get(name, 0.0)
@@ -63,6 +98,7 @@ def finish_capture(cap: dict, analysis: str, backend: str,
         # wall_s — that overlap is what the span trace makes visible
         # (docs/OBSERVABILITY.md)
         "phases": phases,
+        "phase_attribution": attribution,
         "dispatch_count": dispatches,
         "scan_k": _executors.LAST_SCAN_K,
         "tracing": _spans.enabled(),

@@ -19,7 +19,10 @@ Span model (nesting is by time containment per thread row, the Chrome
     serve_job → coalesced_pass → run → ...
 
 Instant events (``ph: "i"``) mark reliability incidents: ``retry``,
-``frame_drop``, ``executor_fallback``, ``fault_injected``.
+``frame_drop``, ``executor_fallback``, ``fault_injected``.  Structured
+``log_event`` lines mirror onto the same timeline (``cat: "log"``) so
+:func:`tail` shows breaker transitions and serving snapshots
+interleaved with phases in one monotonic order.
 
 Near-free when disabled — the contract the hot paths rely on:
 :func:`span` returns ONE shared no-op context manager (no allocation,
@@ -30,7 +33,24 @@ no clock read, no lock) unless tracing was enabled via
 Cross-thread/job attribution: :func:`context` merges fields (job ids,
 tenants, trace ids) into every span recorded on the current thread
 while active — the serving scheduler wraps each execution unit in one,
-so a coalesced pass's spans carry every member job.
+so a coalesced pass's spans carry every member job.  The context is
+live even while RECORDING is off (it is per execution unit, not per
+frame): run reports derive their per-job phase attribution from it
+(``utils/timers.py`` phase windows), so concurrent scheduler workers
+get exact per-job phase totals with tracing disabled.
+
+Buffer semantics: the event buffer is a RING — when it reaches
+``MDTPU_TRACE_MAX_EVENTS`` the OLDEST events are evicted (counted,
+disclosed in the exported ``otherData.dropped_events``), so
+:func:`tail` always holds the most recent window: the flight
+recorder's black box (``obs/flight.py``) and a long-lived fleet host's
+trace shipping both rely on "recent" staying current forever.
+
+Fleet federation (docs/OBSERVABILITY.md "Fleet federation"): a
+``fleet-host`` process calls :func:`enable_ship_buffer` and drains
+bounded batches with :func:`drain_ship` onto its heartbeat wire; the
+controller re-anchors them on its own timeline via the wall-clock
+epoch from :func:`clock_info` and writes ONE merged trace.
 """
 
 from __future__ import annotations
@@ -39,11 +59,13 @@ import json
 import os
 import threading
 import time
+from collections import deque
 
 
 class _TraceState:
     __slots__ = ("enabled", "path", "events", "max_events", "dropped",
-                 "t0", "named_tids", "proc_args")
+                 "t0", "wall0", "tid_names", "proc_args",
+                 "ship", "ship_max", "ship_dropped")
 
     def __init__(self):
         self.enabled = False
@@ -53,15 +75,29 @@ class _TraceState:
         #: row to its host (docs/OBSERVABILITY.md)
         self.proc_args: dict | None = None
         self.path: str | None = None
-        self.events: list[dict] = []
-        # bounded buffer: a long serving process with tracing left on
-        # must not grow memory without limit; overflow is counted and
-        # disclosed in the exported document instead of silently cut
+        self.events: deque = deque()
+        # bounded RING: a long serving process with tracing left on
+        # must not grow memory without limit; overflow evicts the
+        # OLDEST events (counted and disclosed in the exported
+        # document, never silent) so the tail stays the most recent
+        # window — the flight recorder's black box
         self.max_events = int(
             os.environ.get("MDTPU_TRACE_MAX_EVENTS", "500000"))
         self.dropped = 0
         self.t0 = time.perf_counter()
-        self.named_tids: set[int] = set()
+        # wall-clock anchor of t0: what lets a fleet controller
+        # re-anchor another process's (perf_counter-relative) event
+        # timestamps onto its own timeline when stitching a merged
+        # trace (clock_info / FleetController.export_fleet_trace)
+        self.wall0 = time.time()
+        self.tid_names: dict[int, str] = {}
+        # fleet-host ship queue (enable_ship_buffer): events copied
+        # here at record time, drained in bounded heartbeat batches;
+        # overflow drops the oldest and is counted separately
+        self.ship: deque | None = None
+        self.ship_max = int(
+            os.environ.get("MDTPU_TRACE_SHIP_MAX", "16384"))
+        self.ship_dropped = 0
 
 
 _STATE = _TraceState()
@@ -97,8 +133,11 @@ def disable(discard: bool = False) -> None:
         _STATE.path = None
         if discard:
             _STATE.events.clear()
-            _STATE.named_tids.clear()
+            _STATE.tid_names.clear()
             _STATE.dropped = 0
+            if _STATE.ship is not None:
+                _STATE.ship.clear()
+            _STATE.ship_dropped = 0
 
 
 def reset() -> None:
@@ -106,9 +145,13 @@ def reset() -> None:
     long-lived processes rotating trace files)."""
     with _LOCK:
         _STATE.events.clear()
-        _STATE.named_tids.clear()
+        _STATE.tid_names.clear()
         _STATE.dropped = 0
+        if _STATE.ship is not None:
+            _STATE.ship.clear()
+        _STATE.ship_dropped = 0
         _STATE.t0 = time.perf_counter()
+        _STATE.wall0 = time.time()
 
 
 def maybe_enable_from_env() -> None:
@@ -127,23 +170,40 @@ def n_events() -> int:
         return len(_STATE.events)
 
 
+def clock_info() -> tuple[float, float]:
+    """``(t0, wall0)``: the perf-counter trace epoch and the wall
+    clock it corresponds to.  Event ``ts`` values are microseconds
+    past ``t0``; ``wall0 + ts/1e6`` is the event's wall time — the
+    shared axis the fleet controller stitches host traces on."""
+    return _STATE.t0, _STATE.wall0
+
+
 def tail(limit: int = 50, trace_id: str | None = None) -> list[dict]:
-    """The most recent recorded events (copies), newest last —
-    optionally only those whose merged args carry ``trace_id`` in
-    their ``trace_ids``/``trace_id`` attribution.  Used by the serving
-    supervisor to capture a quarantined job's last span trace into its
-    diagnostics; empty when tracing is off."""
+    """The most recent recorded events (copies), newest last — spans,
+    instants and mirrored log events in one shared monotonic (append)
+    order.  With ``trace_id``, keeps events whose merged args carry it
+    in their ``trace_ids``/``trace_id`` attribution PLUS the globally
+    attributed instants (retries, breaker transitions, lease reaps,
+    fencing — incidents recorded outside any job context), so a
+    quarantined job's diagnostics show its phases interleaved with the
+    process-level incidents that surrounded them.  Used by the serving
+    supervisor and the flight recorder (``obs/flight.py``); empty when
+    tracing is off."""
     with _LOCK:
         events = list(_STATE.events)
     if trace_id is not None:
-        def _matches(ev):
+        def _keep(ev):
             args = ev.get("args") or {}
-            return (trace_id in (args.get("trace_ids") or ())
-                    or args.get("trace_id") == trace_id)
+            if (trace_id in (args.get("trace_ids") or ())
+                    or args.get("trace_id") == trace_id):
+                return True
+            # globally attributed instants/log marks: incidents that
+            # belong to no single job ride along for context
+            return (ev.get("ph") == "i"
+                    and not args.get("trace_ids")
+                    and not args.get("trace_id"))
 
-        events = [ev for ev in events if _matches(ev)]
-    else:
-        events = [ev for ev in events if ev.get("ph") != "M"]
+        events = [ev for ev in events if _keep(ev)]
     return [dict(ev) for ev in events[-limit:]]
 
 
@@ -170,24 +230,101 @@ def set_process_args(**args) -> None:
         _STATE.proc_args = dict(args) if args else None
 
 
+def process_args() -> dict | None:
+    """The current :func:`set_process_args` value (the flight recorder
+    stamps it into its dump header)."""
+    return dict(_STATE.proc_args) if _STATE.proc_args else None
+
+
 def _append(ev: dict, tid: int, thread_name: str) -> None:
     st = _STATE
     with _LOCK:
-        if len(st.events) >= st.max_events:
-            st.dropped += 1
-            return
-        if tid not in st.named_tids:
+        if tid not in st.tid_names:
             # Perfetto labels the row with the thread's name — how the
             # prefetch row ("mdtpu-stage"/"ThreadPoolExecutor-…") is
-            # told apart from MainThread in the UI
-            st.named_tids.add(tid)
-            st.events.append({"ph": "M", "name": "thread_name",
-                              "pid": _PID, "tid": tid,
-                              "args": {"name": thread_name}})
+            # told apart from MainThread in the UI.  Kept OUT of the
+            # ring (regenerated at export) so eviction can never
+            # unlabel a row, and pushed to the ship queue once so the
+            # controller's merged trace labels it too.
+            st.tid_names[tid] = thread_name
+            if st.ship is not None:
+                st.ship.append({"ph": "M", "name": "thread_name",
+                                "pid": _PID, "tid": tid,
+                                "args": {"name": thread_name}})
         st.events.append(ev)
+        if len(st.events) > st.max_events:
+            st.events.popleft()          # ring: evict oldest, counted
+            st.dropped += 1
+        if st.ship is not None:
+            if len(st.ship) >= st.ship_max:
+                st.ship.popleft()
+                st.ship_dropped += 1
+            st.ship.append(ev)
 
 
 _PID = os.getpid()
+
+
+def enable_ship_buffer() -> None:
+    """Start copying recorded events into the fleet ship queue
+    (``fleet-host`` processes; docs/OBSERVABILITY.md "Fleet
+    federation").  Idempotent."""
+    with _LOCK:
+        if _STATE.ship is None:
+            _STATE.ship = deque()
+
+
+def reship_thread_meta() -> None:
+    """Re-enqueue every known thread-name metadata event onto the
+    ship queue.  Metas normally ship once, on first sight of a tid —
+    a host reconnecting to a NEW controller (failover) must resend
+    them or the adopted controller's merged trace shows bare tids
+    where the row labels should be."""
+    with _LOCK:
+        ship = _STATE.ship
+        if ship is None:
+            return
+        for tid, name in _STATE.tid_names.items():
+            ship.append({"ph": "M", "name": "thread_name",
+                         "pid": _PID, "tid": tid,
+                         "args": {"name": name}})
+
+
+def drain_ship(limit: int = 2048) -> tuple[list[dict], int]:
+    """Pop up to ``limit`` queued events for shipping, plus the count
+    of events dropped from the ship queue since the last drain (the
+    disclosure that rides the heartbeat).  ``([], 0)`` when shipping
+    was never enabled."""
+    with _LOCK:
+        ship = _STATE.ship
+        if ship is None:
+            return [], 0
+        out = []
+        while ship and len(out) < limit:
+            out.append(ship.popleft())
+        dropped = _STATE.ship_dropped
+        _STATE.ship_dropped = 0
+    return out, dropped
+
+
+def requeue_ship(events: list[dict]) -> None:
+    """Put a drained batch BACK at the front of the ship queue (the
+    heartbeat send failed — the controller link is down; the events
+    re-ship on the next tick, subject to the queue bound)."""
+    if not events:
+        return
+    with _LOCK:
+        ship = _STATE.ship
+        if ship is None:
+            return
+        for ev in reversed(events):
+            ship.appendleft(ev)
+        while len(ship) > _STATE.ship_max:
+            # same drop-OLDEST policy as _append: the requeued batch
+            # is the queue's oldest end, so an outage long enough to
+            # overflow sacrifices stale events, never the newest
+            ship.popleft()
+            _STATE.ship_dropped += 1
 
 
 class _Span:
@@ -246,21 +383,36 @@ def span(name: str, **args):
     return _Span(name, args)
 
 
-def span_event(name: str, **args) -> None:
-    """Record an instant event (reliability incidents: retries, frame
-    drops, fallbacks, injected faults).  No-op when disabled."""
+def _instant(name: str, args: dict, cat: str) -> None:
     st = _STATE
-    if not st.enabled:
-        return
     th = threading.current_thread()
     tid = th.ident or 0
-    ev = {"ph": "i", "cat": "mdtpu", "name": name, "s": "t",
+    ev = {"ph": "i", "cat": cat, "name": name, "s": "t",
           "ts": round((time.perf_counter() - st.t0) * 1e6, 1),
           "pid": _PID, "tid": tid}
     merged = _merged_args(args)
     if merged:
         ev["args"] = merged
     _append(ev, tid, th.name)
+
+
+def span_event(name: str, **args) -> None:
+    """Record an instant event (reliability incidents: retries, frame
+    drops, fallbacks, injected faults).  No-op when disabled."""
+    if not _STATE.enabled:
+        return
+    _instant(name, args, "mdtpu")
+
+
+def log_mark(name: str, **args) -> None:
+    """Mirror one structured log event onto the span timeline
+    (``cat: "log"`` instant), so :func:`tail` and the flight recorder
+    show log lines interleaved with phases and incidents in one
+    monotonic order.  ``utils/log.log_event`` calls this with its
+    scalar fields; no-op when disabled."""
+    if not _STATE.enabled:
+        return
+    _instant(name, args, "log")
 
 
 class _Context:
@@ -285,33 +437,65 @@ class _Context:
 def context(**args):
     """Merge ``args`` into every span/event recorded on THIS thread
     inside the block — the serving layer's job/tenant attribution
-    channel.  No-op when disabled."""
-    if not _STATE.enabled:
-        return NOOP
+    channel.  Live even while tracing is OFF (it is entered per
+    execution unit, never per frame): the run report's per-job phase
+    windows key off :func:`current_trace_ids`, so concurrent scheduler
+    workers keep exact per-job phase attribution with recording
+    disabled."""
     return _Context(args)
 
 
 def current_context() -> dict | None:
-    """The calling thread's active context args (None when tracing is
-    off or no context is active) — capture this BEFORE handing work to
-    another thread, and re-apply it there with :func:`saved_context`.
-    The context is thread-local by design, so without this hand-off a
-    prefetch/pool thread's spans would silently lose the job/tenant
-    attribution the scheduler stamped on the submitting thread."""
-    if not _STATE.enabled:
-        return None
+    """The calling thread's active context args (None when no context
+    is active) — capture this BEFORE handing work to another thread,
+    and re-apply it there with :func:`saved_context`.  The context is
+    thread-local by design, so without this hand-off a prefetch/pool
+    thread's spans (and phase-window attribution) would silently lose
+    the job/tenant identity the scheduler stamped on the submitting
+    thread."""
     return getattr(_CTX, "args", None)
 
 
 def saved_context(args: dict | None):
     """Re-apply a :func:`current_context` capture on the current
-    (different) thread.  No-op when disabled or nothing was captured."""
-    if not _STATE.enabled or not args:
+    (different) thread.  No-op when nothing was captured."""
+    if not args:
         return NOOP
     return _Context(args)
 
 
+def current_trace_ids() -> frozenset | None:
+    """The trace ids attributed to the current thread's active
+    context, or None — what ``utils/timers.py`` phase windows match
+    against for per-job phase attribution."""
+    args = getattr(_CTX, "args", None)
+    if not args:
+        return None
+    ids = args.get("trace_ids")
+    if ids:
+        return frozenset(ids)
+    tid = args.get("trace_id")
+    return frozenset((tid,)) if tid else None
+
+
 _EXPORT_LOCK = threading.Lock()
+
+
+def document() -> dict:
+    """The recorded events as a Chrome trace-event document (thread
+    row labels regenerated from the tid table, drop count disclosed).
+    :func:`export` writes this; the fleet controller merges it with
+    host batches."""
+    with _LOCK:
+        events = list(_STATE.events)
+        tid_names = dict(_STATE.tid_names)
+        dropped = _STATE.dropped
+    meta = [{"ph": "M", "name": "thread_name", "pid": _PID,
+             "tid": tid, "args": {"name": name}}
+            for tid, name in tid_names.items()]
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms",
+            "otherData": {"tool": "mdanalysis_mpi_tpu",
+                          "dropped_events": dropped}}
 
 
 def export(path: str | None = None) -> str | None:
@@ -328,12 +512,7 @@ def export(path: str | None = None) -> str | None:
     path = path or _STATE.path
     if path is None:
         return None
-    with _LOCK:
-        events = list(_STATE.events)
-        dropped = _STATE.dropped
-    doc = {"traceEvents": events, "displayTimeUnit": "ms",
-           "otherData": {"tool": "mdanalysis_mpi_tpu",
-                         "dropped_events": dropped}}
+    doc = document()
     try:
         with _EXPORT_LOCK:
             tmp = path + ".tmp"

@@ -156,6 +156,13 @@ def batch_main(argv=None, universe=None) -> int:
                         "re-decoding the file; falls back to the job "
                         "file's trajectory (with a stderr note) when "
                         "DIR is not a store")
+    p.add_argument("--status-port", type=int, default=None,
+                   metavar="PORT",
+                   help="serve the live status endpoint (/status, "
+                        "/healthz, /metrics — docs/OBSERVABILITY.md) "
+                        "on PORT for the life of the batch (0 binds "
+                        "an ephemeral port; the bound address lands "
+                        "in the output JSON as status_addr)")
     p.add_argument("--journal", default=None, metavar="FILE",
                    help="crash-consistent job journal (append-only "
                         "JSONL, docs/RELIABILITY.md): every lifecycle "
@@ -285,6 +292,10 @@ def batch_main(argv=None, universe=None) -> int:
                           spec.get("poison_threshold", 2)),
                       supervise=bool(spec.get("supervise", True)),
                       journal=ns.journal)
+    status_addr = None
+    if ns.status_port is not None:
+        host, port = sched.serve_status(port=ns.status_port)
+        status_addr = f"{host}:{port}"
     warmup_stats = None
     if ns.warmup:
         warmup_stats = sched.warmup([j for j, _, _ in jobs])
@@ -397,6 +408,7 @@ def batch_main(argv=None, universe=None) -> int:
         "jobs": records, "wall_s": round(wall, 4),
         "serving": sched.telemetry.snapshot(cache=cache),
         "trace_out": trace_out,
+        "status_addr": status_addr,
         "interrupted": interrupted,
         "quarantined": [h.job.fingerprint for h in sched.quarantined],
     }

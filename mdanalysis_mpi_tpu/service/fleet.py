@@ -58,11 +58,20 @@ import threading
 import time
 
 from mdanalysis_mpi_tpu import obs
+from mdanalysis_mpi_tpu.obs import flight as _flight
+from mdanalysis_mpi_tpu.obs import spans as _spans
 from mdanalysis_mpi_tpu.reliability import breaker as _breaker
 from mdanalysis_mpi_tpu.service import journal as _journal
 from mdanalysis_mpi_tpu.service import placement as _placement
 from mdanalysis_mpi_tpu.service.telemetry import FleetTelemetry
 from mdanalysis_mpi_tpu.utils.log import get_logger
+from mdanalysis_mpi_tpu.utils.timers import TIMERS
+
+#: Controller-side cap on buffered (not yet exported) trace events
+#: per host — overflow evicts oldest, counted
+#: (``mdtpu_fleet_obs_trace_dropped_total{site="controller"}``).
+HOST_EVENTS_CAP = int(
+    os.environ.get("MDTPU_FLEET_TRACE_MAX_EVENTS", "200000"))
 
 #: Files the fleet keeps in its working directory: the epoch-stamped
 #: CRC journal, and the atomically-replaced controller address file
@@ -98,21 +107,24 @@ def _send_line(sock: socket.socket, lock: threading.Lock,
         return False
 
 
-def _write_addr_file(workdir: str, host: str, port: int,
-                     epoch: int) -> str:
-    """Atomically publish the active controller's address + epoch:
-    hosts must never read a torn address, and a standby's adoption
-    must flip every host in one rename.  The shared integrity helper
-    (tmp → fsync → os.replace) also counts and types a failed write —
-    an ENOSPC during failover surfaces as a typed
+def _write_addr_file(workdir: str, host: str, port: int, epoch: int,
+                     status_port: int | None = None) -> str:
+    """Atomically publish the active controller's address + epoch
+    (and, beside them, the live status endpoint's port — the
+    ``status`` CLI reads it from here): hosts must never read a torn
+    address, and a standby's adoption must flip every host in one
+    rename.  The shared integrity helper (tmp → fsync → os.replace)
+    also counts and types a failed write — an ENOSPC during failover
+    surfaces as a typed
     :class:`~mdanalysis_mpi_tpu.utils.integrity.ArtifactWriteError`
     out of the adoption, never a silently unpublished controller."""
     from mdanalysis_mpi_tpu.utils import integrity as _integrity
 
     path = os.path.join(workdir, ADDR_NAME)
-    data = json.dumps({"host": host, "port": port,
-                       "epoch": epoch}).encode()
-    _integrity.atomic_write_bytes(path, data,
+    info = {"host": host, "port": port, "epoch": epoch}
+    if status_port:
+        info["status_port"] = status_port
+    _integrity.atomic_write_bytes(path, json.dumps(info).encode(),
                                   artifact="controller_addr")
     return path
 
@@ -225,6 +237,8 @@ class FleetController:
                  respawn_hosts: bool = False, breakers=None,
                  telemetry: FleetTelemetry | None = None,
                  bind_host: str = "127.0.0.1", clock=time.monotonic,
+                 status: bool = True, trace: bool | None = None,
+                 obs_interval_s: float = 0.5,
                  _recovered: dict | None = None):
         self.workdir = str(workdir)
         os.makedirs(self.workdir, exist_ok=True)
@@ -250,6 +264,19 @@ class FleetController:
         self._shutdown = False
         self._wedged = False
         self._procs: list = []
+        # ---- fleet observability (docs/OBSERVABILITY.md "Fleet
+        #      federation"): per-host metric snapshots + trace-event
+        #      batches ingested off heartbeats, under their own lock
+        #      so a scrape never contends with dispatch ----
+        self._obs_lock = threading.Lock()
+        self._host_metrics: dict[str, dict] = {}
+        self._host_events: dict[str, list] = {}
+        self._host_pids: dict[str, int] = {}
+        #: spawned hosts trace + ship when True (None: follow the
+        #: controller process's own tracing state at spawn time)
+        self._trace_fleet = (obs.tracing_enabled() if trace is None
+                             else bool(trace))
+        self.obs_interval_s = float(obs_interval_s)
         self.journal = _journal.JobJournal(
             os.path.join(self.workdir, JOURNAL_NAME), epoch=self.epoch)
         # epoch record FIRST and durable: from this line on, every
@@ -261,9 +288,19 @@ class FleetController:
         obs.span_event("epoch_adopted", epoch=self.epoch)
         if _recovered:
             self._resubmit_recovered(_recovered)
-        # listener + address publication (bound-socket port handoff:
-        # the controller binds port 0 itself and hands the RESOLVED
-        # port to hosts via the address file — no free-port race)
+            # adoption black box (docs/OBSERVABILITY.md): what the
+            # standby saw at takeover, journaled beside the epoch
+            fpath = _flight.dump(
+                "adoption", self.workdir,
+                extra={"epoch": self.epoch,
+                       "recovered_jobs": sorted(_recovered["jobs"])})
+            if fpath:
+                self.journal.record("flight", None,
+                                    trigger="adoption", path=fpath)
+        # listener FIRST (bound-socket port handoff: the controller
+        # binds port 0 itself and hands the RESOLVED port to hosts via
+        # the address file — no free-port race), so self.address
+        # exists before the status server starts answering /status
         self._listener = socket.socket(socket.AF_INET,
                                        socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET,
@@ -271,8 +308,22 @@ class FleetController:
         self._listener.bind((bind_host, 0))
         self._listener.listen(64)
         self.address = self._listener.getsockname()
+        # live status endpoint (service/statusd.py): /status,
+        # /healthz, and the MERGED-fleet /metrics exposition — its
+        # port is published beside the command address below
+        self._statusd = None
+        if status:
+            from mdanalysis_mpi_tpu.service.statusd import StatusServer
+
+            self._statusd = StatusServer(
+                self.status,
+                metrics_fn=lambda: obs.to_prometheus(
+                    self.fleet_snapshot()),
+                health_fn=self.healthz, bind_host=bind_host)
         _write_addr_file(self.workdir, self.address[0],
-                         self.address[1], self.epoch)
+                         self.address[1], self.epoch,
+                         status_port=(self._statusd.address[1]
+                                      if self._statusd else None))
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True,
             name="mdtpu-fleet-accept")
@@ -328,10 +379,13 @@ class FleetController:
     def spawn_host(self, host_id: str | None = None,
                    backend: str = "serial", cache_mb: int = 0,
                    workers: int = 1, env: dict | None = None,
-                   hb_interval_s: float = 0.25):
+                   hb_interval_s: float = 0.25,
+                   obs_interval_s: float | None = None):
         """Start one ``fleet-host`` worker process against this
         fleet's workdir.  Returns the Popen handle (also tracked for
-        shutdown)."""
+        shutdown).  ``obs_interval_s`` is the host's metrics-piggyback
+        period (default: the controller's ``obs_interval_s``; ≤0
+        disables federation shipping from that host)."""
         with self._lock:
             if host_id is None:
                 host_id = f"host{self._host_seq}"
@@ -341,8 +395,15 @@ class FleetController:
                "--host-id", host_id, "--backend", backend,
                "--cache-mb", str(cache_mb),
                "--workers", str(workers),
-               "--hb-interval", str(hb_interval_s)]
+               "--hb-interval", str(hb_interval_s),
+               "--obs-interval",
+               str(self.obs_interval_s if obs_interval_s is None
+                   else obs_interval_s)]
         child_env = dict(os.environ)
+        if self._trace_fleet:
+            # hosts trace in memory and ship batches; the controller
+            # owns the one merged file (export_fleet_trace)
+            child_env.setdefault("MDTPU_FLEET_TRACE", "1")
         # the host must import THIS package however the controller was
         # launched (repo checkout, odd cwd): pin our root on the path
         pkg_root = os.path.dirname(os.path.dirname(
@@ -416,7 +477,7 @@ class FleetController:
                 elif hid is None:
                     continue          # no handshake yet
                 elif ev == "hb":
-                    self._host_beat(hid)
+                    self._host_beat(hid, msg)
                 elif ev == "done":
                     self._apply_done(hid, msg)
                 elif ev == "fenced":
@@ -442,6 +503,10 @@ class FleetController:
         hid = str(msg.get("host"))
         now = self._clock()
         rejoin = False
+        if msg.get("pid") is not None:
+            # the pid keys the host's rows in the merged fleet trace
+            with self._obs_lock:
+                self._host_pids[hid] = int(msg["pid"])
         with self._lock:
             prev = self._hosts.get(hid)
             rejoin = prev is not None
@@ -524,7 +589,7 @@ class FleetController:
         self._dispatch()
         return hid
 
-    def _host_beat(self, hid: str) -> None:
+    def _host_beat(self, hid: str, msg: dict | None = None) -> None:
         rejoined = False
         with self._lock:
             host = self._hosts.get(hid)
@@ -548,6 +613,120 @@ class FleetController:
                            epoch=self.epoch)
             self._log.warning("host %s rejoined after lease reap", hid)
             self._dispatch()
+        if msg is not None:
+            self._ingest_obs(hid, msg)
+
+    # ---- metrics federation + trace stitching
+    #      (docs/OBSERVABILITY.md "Fleet federation") ----
+
+    def _ingest_obs(self, hid: str, msg: dict) -> None:
+        """Fold one heartbeat's piggybacked federation payload in:
+        ``metrics`` is a changed-series subset of the host's
+        ``unified_snapshot`` (each series arrives WHOLE, so a lost
+        heartbeat costs staleness, never counts — latest wins);
+        ``trace`` is a bounded span batch, re-anchored from the host's
+        wall clock onto this process's trace timeline at ingest."""
+        metrics = msg.get("metrics")
+        trace = msg.get("trace")
+        if not metrics and not trace:
+            return
+        n_reporting = None
+        overflow = 0
+        with self._obs_lock:
+            if metrics:
+                self._host_metrics.setdefault(hid, {}).update(metrics)
+                n_reporting = len(self._host_metrics)
+            if trace:
+                ctrl_wall0 = _spans.clock_info()[1]
+                shift = (float(msg.get("wall0", ctrl_wall0))
+                         - ctrl_wall0) * 1e6
+                buf = self._host_events.setdefault(hid, [])
+                for ev in trace:
+                    if "ts" in ev:
+                        ev = dict(ev)
+                        ev["ts"] = round(ev["ts"] + shift, 1)
+                    buf.append(ev)
+                overflow = len(buf) - HOST_EVENTS_CAP
+                if overflow > 0:
+                    del buf[:overflow]
+        if n_reporting is not None:
+            obs.METRICS.set_gauge("mdtpu_fleet_hosts_reporting",
+                                  n_reporting)
+        if overflow > 0:
+            obs.METRICS.inc("mdtpu_fleet_obs_trace_dropped_total",
+                            overflow, site="controller")
+
+    def host_metrics(self) -> dict:
+        """``{host_id: latest merged metric series}`` (copies).  A
+        lost host's last-reported series stay — fleet counter totals
+        must not dip when a host dies."""
+        with self._obs_lock:
+            return {hid: dict(m)
+                    for hid, m in self._host_metrics.items()}
+
+    def host_trace_events(self) -> dict:
+        """``{host_id: [trace events]}`` buffered for the merged
+        export, timestamps already on this controller's timeline
+        (copies)."""
+        with self._obs_lock:
+            return {hid: [dict(ev) for ev in buf]
+                    for hid, buf in self._host_events.items()}
+
+    def fleet_snapshot(self) -> dict:
+        """ONE metrics document over the whole fleet
+        (``unified_snapshot(fleet=)`` merge rules: host counters and
+        histograms summed, host gauges labeled ``host=``,
+        controller-local series distinct) — what ``/metrics``
+        exposes."""
+        return obs.unified_snapshot(fleet=self.host_metrics())
+
+    def export_fleet_trace(self, path: str) -> str | None:
+        """Write ONE merged Chrome trace: this controller's own
+        events (when it is tracing) plus every host's shipped
+        batches, each process on its own pid row with a
+        ``process_name`` label, timestamps on a shared axis (host
+        batches were re-anchored at ingest; the whole document is
+        shifted non-negative for adoption cases).  Returns the path,
+        or None on a disclosed write failure."""
+        events: list[dict] = []
+        if obs.tracing_enabled():
+            events.extend(dict(ev) for ev
+                          in _spans.document()["traceEvents"])
+        events.append({"ph": "M", "name": "process_name",
+                       "pid": os.getpid(), "tid": 0,
+                       "args": {"name": "fleet-controller"}})
+        host_events = self.host_trace_events()
+        for hid in sorted(host_events):
+            evs = host_events[hid]
+            with self._obs_lock:
+                pid = self._host_pids.get(hid)
+            if pid is None and evs:
+                pid = evs[0].get("pid")
+            if pid is not None:
+                events.append({"ph": "M", "name": "process_name",
+                               "pid": pid, "tid": 0,
+                               "args": {"name": f"fleet-host {hid}"}})
+            events.extend(evs)
+        tss = [ev["ts"] for ev in events if "ts" in ev]
+        if tss and min(tss) < 0:
+            base = min(tss)
+            for ev in events:
+                if "ts" in ev:
+                    ev["ts"] = round(ev["ts"] - base, 1)
+        doc = {"traceEvents": events, "displayTimeUnit": "ms",
+               "otherData": {"tool": "mdanalysis_mpi_tpu",
+                             "controller_epoch": self.epoch,
+                             "fleet_hosts": sorted(host_events)}}
+        try:
+            from mdanalysis_mpi_tpu.utils import integrity as _integrity
+
+            _integrity.atomic_write_bytes(
+                path, json.dumps(doc).encode(), artifact="fleet_trace")
+        except OSError:
+            obs.METRICS.inc("mdtpu_obs_write_errors_total",
+                            sink="fleet_trace")
+            return None
+        return path
 
     def _note_fenced(self, hid: str, msg: dict) -> None:
         """A host refused a stale-epoch command (the zombie controller
@@ -880,6 +1059,17 @@ class FleetController:
         self._log.warning(
             "host %s lost (%s): %d job(s) migrating to %d survivor(s)",
             hid, reason, len(migrate), n_alive)
+        # black box for the loss (docs/OBSERVABILITY.md): recent
+        # timeline + fleet counters at the moment of the incident,
+        # journaled so the post-mortem can find it from the replay
+        fpath = _flight.dump(
+            "host_loss", self.workdir,
+            extra={"host": hid, "reason": reason,
+                   "migrated": [j.fp for j in migrate],
+                   "quarantined": [j.fp for j in quarantine]})
+        if fpath:
+            self.journal.record("flight", None, trigger="host_loss",
+                                path=fpath, host=hid)
         for job in migrate:
             self.telemetry.count("jobs_migrated")
             obs.METRICS.inc("mdtpu_jobs_migrated_total")
@@ -978,6 +1168,57 @@ class FleetController:
         with self._lock:
             return dict(self._jobs)
 
+    def status(self) -> dict:
+        """The ``/status`` document (service/statusd.py): queue
+        depth, per-host membership/leases, breaker states, epoch,
+        quarantine — what an operator greps per-host logs for
+        today, as one JSON fetch."""
+        now = self._clock()
+        with self._lock:
+            hosts = {
+                h.hid: {"alive": h.alive,
+                        "inflight": len(h.inflight),
+                        "lease_expires_in_s": round(h.deadline - now,
+                                                    3),
+                        "epoch": h.epoch}
+                for h in self._hosts.values()}
+            jobs = list(self._jobs.values())
+            pending = len(self._pending)
+            wedged = self._wedged
+        out = {
+            "role": "fleet-controller",
+            "epoch": self.epoch,
+            "wedged": wedged,
+            "workdir": self.workdir,
+            "addr": f"{self.address[0]}:{self.address[1]}",
+            "queue_depth": pending,
+            "hosts_alive": sum(1 for h in hosts.values()
+                               if h["alive"]),
+            "hosts_reporting": len(self._host_metrics),
+            "jobs_total": len(jobs),
+            "jobs_done": sum(1 for j in jobs if j.state == DONE),
+            "jobs_failed": sum(1 for j in jobs if j.state == FAILED),
+            "quarantined": [j.fp for j in jobs
+                            if j.state == QUARANTINED],
+            "hosts": hosts,
+            "breakers": {
+                (backend if mesh is None else f"{backend}@{mesh}"): st
+                for (backend, mesh), st
+                in self.breakers.states().items()},
+            "telemetry": self.telemetry.snapshot(),
+        }
+        return out
+
+    def healthz(self) -> dict:
+        """The ``/healthz`` document: ok while this controller is
+        neither wedged nor shut down (a wedged zombie answers 503 —
+        exactly what a load balancer probing for adoption wants)."""
+        with self._lock:
+            ok = not self._wedged and not self._shutdown
+            alive = sum(1 for h in self._hosts.values() if h.alive)
+        return {"ok": ok, "role": "fleet-controller",
+                "epoch": self.epoch, "hosts_alive": alive}
+
     def stats(self) -> dict:
         """Flat JSON snapshot: fleet telemetry + membership +
         placement (the fleet bench leg's fields)."""
@@ -1010,6 +1251,8 @@ class FleetController:
             self._listener.close()
         except OSError:
             pass
+        if self._statusd is not None:
+            self._statusd.close()
         for proc in procs:
             try:
                 proc.wait(timeout=5.0)
@@ -1082,13 +1325,32 @@ class _HostWorker:
     """One fleet host: local scheduler + controller link."""
 
     def __init__(self, workdir: str, host_id: str, backend: str,
-                 cache_mb: int, workers: int, hb_interval_s: float):
+                 cache_mb: int, workers: int, hb_interval_s: float,
+                 obs_interval_s: float = 0.5):
         from mdanalysis_mpi_tpu.service.scheduler import Scheduler
 
         self.workdir = workdir
         self.host_id = host_id
         self.backend = backend
         self.hb_interval_s = hb_interval_s
+        # federation shipping (docs/OBSERVABILITY.md "Fleet
+        # federation"): metrics piggyback period (≤0 disables all
+        # shipping from this host) + the last successfully shipped
+        # series, so each heartbeat carries only what changed
+        self.obs_interval_s = float(obs_interval_s)
+        self._obs_next = 0.0
+        self._last_shipped: dict = {}
+        # MDTPU_FLEET_TRACE (set by spawn_host when the fleet is
+        # tracing): record spans in memory and ship batches — the
+        # controller owns the one merged trace file
+        trace_knob = os.environ.get("MDTPU_FLEET_TRACE")
+        if trace_knob not in (None, "", "0", "false", "no") \
+                and not obs.tracing_enabled():
+            # repo-wide knob convention (utils/log.py): 0/false/no
+            # mean OFF, never "truthy string"
+            obs.enable_tracing(None)
+        if obs.tracing_enabled() and self.obs_interval_s > 0:
+            _spans.enable_ship_buffer()
         cache = None
         if backend in ("jax", "mesh"):
             # the `fleet-host` entry skips the top-level platform
@@ -1156,8 +1418,15 @@ class _HostWorker:
             return
         sock.settimeout(None)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # a (new) controller starts with no state for this host: the
+        # thread-row labels (shipped once per tid) must re-ship or an
+        # adopted controller's merged trace shows bare tids
+        _spans.reship_thread_meta()
         with self._lock:
             self._epoch = int(info.get("epoch", 0))
+            # ... and the next metrics piggyback must be the FULL
+            # snapshot (the delta base resets)
+            self._last_shipped = {}
             # the OLD socket stays open and its reader keeps running:
             # a zombie controller's late commands must be READ to be
             # fenced (and EOF cleans it up)
@@ -1234,6 +1503,12 @@ class _HostWorker:
             self._send({"ev": "fenced", "host": self.host_id,
                         "fp": fp, "from_epoch": msg.get("epoch")})
             return
+        # instant BEFORE any chaos delay or the run itself: a host
+        # killed while holding this job still leaves "the job reached
+        # host X" on the merged timeline (shipped by the heartbeat
+        # loop), so a migration shows one trace_id spanning both hosts
+        obs.span_event("fleet_job_received", fp=fp, trace_id=fp,
+                       host=self.host_id)
         spec = dict(msg.get("job") or {})
         if self._pause_spec:
             sub, _, secs = self._pause_spec.partition(":")
@@ -1282,6 +1557,11 @@ class _HostWorker:
         clean.pop("output", None)     # results travel the wire instead
         job, _cfg, _output = _build_job(clean, {}, u)
         job.fingerprint = fp
+        # the FLEET fingerprint is the job's trace identity: every
+        # span the local scheduler records for it carries the same
+        # trace_id on every host it ever runs on — what lets one
+        # migrated job read as one stitched timeline across pids
+        job.trace_id = fp
         return self.sched.submit(job), resident
 
     def _on_local_done(self, fp: str, token, resident: bool,
@@ -1313,6 +1593,39 @@ class _HostWorker:
             self._unacked[fp] = msg
         self._send(msg)
 
+    def _augment_hb(self, hb: dict):
+        """Piggyback the federation payload on one heartbeat
+        (docs/OBSERVABILITY.md "Fleet federation"): every tick drains
+        the bounded trace ship queue (drops disclosed); every
+        ``obs_interval_s`` attaches the changed-series subset of this
+        host's ``unified_snapshot``.  Returns ``(trace_events,
+        full_snapshot | None)`` so the caller can requeue the events
+        on a failed send and mark the snapshot shipped on a
+        successful one."""
+        if self.obs_interval_s <= 0:
+            return [], None
+        events, dropped = _spans.drain_ship()
+        if events:
+            hb["trace"] = events
+            hb["wall0"] = _spans.clock_info()[1]
+        if dropped:
+            obs.METRICS.inc("mdtpu_fleet_obs_trace_dropped_total",
+                            dropped, site="host")
+        snap = None
+        now = time.monotonic()
+        if now >= self._obs_next:
+            self._obs_next = now + self.obs_interval_s
+            snap = obs.unified_snapshot(
+                timers=TIMERS, telemetry=self.sched.telemetry,
+                cache=self.cache)
+            delta = {k: v for k, v in snap.items()
+                     if self._last_shipped.get(k) != v}
+            if delta:
+                hb["metrics"] = delta
+            else:
+                snap = None
+        return events, snap
+
     # ---- main loop ----
 
     def run(self) -> int:
@@ -1328,8 +1641,27 @@ class _HostWorker:
                     # failover: a newer controller published itself —
                     # switch, syncing in-flight + unacked completions
                     self._connect(info)
-            self._send({"ev": "hb", "host": self.host_id,
-                        "epoch": self._epoch})
+            hb = {"ev": "hb", "host": self.host_id,
+                  "epoch": self._epoch}
+            events, snap = self._augment_hb(hb)
+            if self._send(hb):
+                # ship accounting only on a SOCKET-accepted send: a
+                # failed heartbeat requeues its events, and counting
+                # at drain time would re-count them on every retry
+                if events:
+                    obs.METRICS.inc(
+                        "mdtpu_fleet_obs_trace_events_total",
+                        len(events), site="host")
+                if snap is not None:
+                    # delta base advances only on a SOCKET-accepted
+                    # ship; a dead link re-ships the full difference
+                    # after reconnect (and _connect resets the base)
+                    with self._lock:
+                        self._last_shipped = snap
+                    obs.METRICS.inc(
+                        "mdtpu_fleet_obs_metrics_ships_total")
+            elif events:
+                _spans.requeue_ship(events)
             # completion re-delivery until acked (idempotent on the
             # controller: token match → duplicate → re-ack)
             with self._lock:
@@ -1353,9 +1685,13 @@ def host_main(argv=None) -> int:
     p.add_argument("--cache-mb", type=int, default=0)
     p.add_argument("--workers", type=int, default=1)
     p.add_argument("--hb-interval", type=float, default=0.25)
+    p.add_argument("--obs-interval", type=float, default=0.5,
+                   help="metrics-federation piggyback period in "
+                        "seconds (<=0 disables shipping)")
     ns = p.parse_args(argv)
     worker = _HostWorker(ns.workdir, ns.host_id, ns.backend,
-                         ns.cache_mb, ns.workers, ns.hb_interval)
+                         ns.cache_mb, ns.workers, ns.hb_interval,
+                         obs_interval_s=ns.obs_interval)
     return worker.run()
 
 
@@ -1367,11 +1703,19 @@ def fleet_smoke(workdir=None, n_hosts: int = 2,
                 kill_mid_wave: bool = True) -> dict:
     """The dryrun serving leg at smoke scale: K tenants across
     ``n_hosts`` host processes, one ``kill -9`` mid-wave, exactly-once
-    audited against the journal.  Returns the outcome record
-    (``ok`` + the controller stats); raises nothing — failures land in
-    the record so the caller can print-and-exit."""
+    audited against the journal — PLUS the fleet-observability audit
+    (docs/OBSERVABILITY.md "Fleet federation"): the merged Chrome
+    trace shows distinct per-host pids and the migrated job's single
+    stitched ``trace_id`` on both, the ``/metrics`` scrape's
+    fleet-summed completion counter equals the journal's exactly-once
+    ledger, and the lost host left a flight-recorder dump.  Returns
+    the outcome record (``ok`` + the controller stats); raises nothing
+    — failures land in the record so the caller can print-and-exit."""
+    import glob as _glob
     import shutil
     import tempfile
+
+    from mdanalysis_mpi_tpu.service.statusd import fetch_status
 
     # ALWAYS a fresh subdirectory (under the caller's dir when given):
     # a reused journal would carry earlier smokes' identical
@@ -1383,10 +1727,23 @@ def fleet_smoke(workdir=None, n_hosts: int = 2,
     fixture = {"kind": "protein", "n_residues": 8, "n_frames": 10,
                "noise": 0.2, "seed": 3}
     record: dict = {"ok": False}
+    victim = None
+    stitched = None
     try:
-        with FleetController(workdir, host_ttl_s=2.0) as ctrl:
+        with FleetController(workdir, host_ttl_s=2.0,
+                             trace=True) as ctrl:
             for _ in range(n_hosts):
-                ctrl.spawn_host()
+                # the run-delay knob keeps received jobs in flight
+                # long enough that the kill below provably lands on
+                # working hosts (same knob as the bench fleet leg)
+                # 1.0 s run delay >> the ~0.15 s between a job's
+                # received-instant shipping and the kill below: the
+                # victim provably completes NOTHING before it dies,
+                # so no completed-counter increment can be stranded
+                # unshipped (which would break the scrape==ledger
+                # equality this smoke asserts)
+                ctrl.spawn_host(hb_interval_s=0.1,
+                                env={"MDTPU_FLEET_RUN_DELAY": "1.0"})
             if not ctrl.wait_hosts(n_hosts, timeout=60.0):
                 record["error"] = "hosts never joined"
                 return record
@@ -1395,22 +1752,114 @@ def fleet_smoke(workdir=None, n_hosts: int = 2,
                                  "tenant": f"t{i % 4}"})
                     for i in range(8)]
             if kill_mid_wave:
-                victim = sorted(ctrl.placement.hosts())[0]
+                # kill a host whose "job received" instant already
+                # made it back on a heartbeat: that job is provably
+                # in flight there (still inside its run delay), so
+                # the migration — and the stitched trace id — is
+                # deterministic, not a race against dispatch
+                deadline = time.monotonic() + 20.0
+                while victim is None and time.monotonic() < deadline:
+                    for hid, evs in ctrl.host_trace_events().items():
+                        if any(ev.get("name") == "fleet_job_received"
+                               for ev in evs):
+                            victim = hid
+                            break
+                    time.sleep(0.02)
+                if victim is None:          # shipping never arrived
+                    victim = sorted(ctrl.placement.hosts())[0]
                 ctrl.kill_host(victim)
             if not ctrl.drain(timeout=120.0):
                 record["error"] = "drain timed out"
                 return record
             record["jobs_done"] = sum(1 for j in jobs
                                       if j.state == DONE)
+            # ---- metrics federation: the fleet-summed completion
+            #      counter must equal this wave's ledger exactly ----
+            expected = record["jobs_done"]
+            deadline = time.monotonic() + 10.0
+            summed = -1
+            while time.monotonic() < deadline:
+                snap = ctrl.fleet_snapshot()
+                summed = sum(snap["mdtpu_jobs_completed_total"]
+                             ["values"].values())
+                if summed >= expected:
+                    break
+                time.sleep(0.05)
+            record["fleet_jobs_completed"] = summed
+            try:
+                text = fetch_status(workdir, route="/metrics")
+                line = next(
+                    ln for ln in text.splitlines()
+                    if ln.startswith("mdtpu_jobs_completed_total "))
+                record["scrape_jobs_completed"] = int(
+                    float(line.split()[-1]))
+            except Exception as exc:
+                record["error"] = (f"/metrics scrape failed: "
+                                   f"{type(exc).__name__}: {exc}")
+                return record
+            # ---- stitched trace: the migrated job's trace_id must
+            #      appear on BOTH the victim's and a survivor's pid ----
+            migrated = [j.fp for j in jobs if j.migrations > 0]
+            record["jobs_migrated"] = len(migrated)
+            deadline = time.monotonic() + 10.0
+            while migrated and stitched is None \
+                    and time.monotonic() < deadline:
+                per_fp: dict = {}
+                for hid, evs in ctrl.host_trace_events().items():
+                    for ev in evs:
+                        if ev.get("ph") == "M":
+                            continue
+                        args = ev.get("args") or {}
+                        for fp in migrated:
+                            if (args.get("trace_id") == fp
+                                    or fp in (args.get("trace_ids")
+                                              or ())):
+                                per_fp.setdefault(fp, set()).add(
+                                    ev.get("pid"))
+                stitched = next((fp for fp, pids in per_fp.items()
+                                 if len(pids) >= 2), None)
+                if stitched is None:
+                    time.sleep(0.1)
+            trace_path = os.path.join(workdir, "fleet_trace.json")
+            if ctrl.export_fleet_trace(trace_path) is None:
+                # disclosed write failure (ENOSPC etc.): a failure
+                # RECORD, never an exception out of the smoke
+                record["error"] = "merged trace export failed"
+                return record
+            with open(trace_path) as f:
+                doc = json.load(f)
+            pids = {ev["pid"] for ev in doc["traceEvents"]
+                    if ev.get("ph") != "M"}
+            record["trace_pids"] = len(pids)
+            record["trace_stitched_fp"] = stitched
             record["stats"] = ctrl.stats()
+        # ---- flight recorder: the lost host left its black box ----
+        flight_ok = False
+        for p in _glob.glob(os.path.join(workdir,
+                                         "flight_host_loss_*.json")):
+            with open(p) as f:
+                d = json.load(f)
+            if d.get("trigger") == "host_loss" \
+                    and d.get("extra", {}).get("host") == victim:
+                flight_ok = True
+        record["flight_dump"] = flight_ok
         meta = _journal.replay_fleet(
             os.path.join(workdir, JOURNAL_NAME))
         # audit THIS run's jobs only: a reused --workdir journal
         # legitimately carries earlier runs' finishes too
         record["exactly_once"] = all(
             meta["finishes"].get(j.fp) == 1 for j in jobs)
+        record["federation_match"] = (
+            record["fleet_jobs_completed"] == len(jobs)
+            and record.get("scrape_jobs_completed") == len(jobs))
         record["ok"] = (record["jobs_done"] == len(jobs)
-                        and record["exactly_once"])
+                        and record["exactly_once"]
+                        and record["federation_match"]
+                        and record["trace_pids"] >= n_hosts
+                        and (not kill_mid_wave
+                             or (record["jobs_migrated"] >= 1
+                                 and stitched is not None
+                                 and flight_ok)))
         return record
     finally:
         shutil.rmtree(workdir, ignore_errors=True)
@@ -1441,6 +1890,12 @@ def fleet_main(argv=None) -> int:
                         "for adoption)")
     p.add_argument("--backend", default="serial")
     p.add_argument("--cache-mb", type=int, default=0)
+    p.add_argument("--trace-out", default=None, metavar="FILE",
+                   help="write ONE merged Chrome trace of the whole "
+                        "fleet to FILE: hosts trace in memory and "
+                        "ship batches on their heartbeats, the "
+                        "controller stitches them per-pid "
+                        "(docs/OBSERVABILITY.md \"Fleet federation\")")
     ns = p.parse_args(argv)
 
     if ns.smoke:
@@ -1467,7 +1922,9 @@ def fleet_main(argv=None) -> int:
             defaults.setdefault(key, spec[key])
     t0 = time.perf_counter()
     try:
-        with FleetController(workdir) as ctrl:
+        with FleetController(
+                workdir,
+                trace=bool(ns.trace_out) or None) as ctrl:
             for _ in range(n_hosts):
                 ctrl.spawn_host(backend=ns.backend,
                                 cache_mb=ns.cache_mb)
@@ -1483,6 +1940,14 @@ def fleet_main(argv=None) -> int:
             out = {"jobs": records,
                    "wall_s": round(time.perf_counter() - t0, 4),
                    "drained": ok, "fleet": ctrl.stats()}
+            if ns.trace_out:
+                # let the last heartbeat batches land before merging
+                time.sleep(0.5)
+                out["trace_out"] = ctrl.export_fleet_trace(
+                    ns.trace_out)
+            if ctrl._statusd is not None:
+                addr = ctrl._statusd.address
+                out["status_addr"] = f"{addr[0]}:{addr[1]}"
         print(json.dumps(out))
         return 0 if ok and all(j.state == DONE for j in jobs) else 1
     finally:

@@ -63,6 +63,7 @@ import time
 import traceback as _traceback
 
 from mdanalysis_mpi_tpu import obs
+from mdanalysis_mpi_tpu.obs import flight as _flight
 from mdanalysis_mpi_tpu.reliability import breaker as _breaker
 from mdanalysis_mpi_tpu.reliability import faults as _faults
 from mdanalysis_mpi_tpu.service import coalesce as _coalesce
@@ -144,6 +145,11 @@ class Scheduler:
         residency) instead of letting the allocator OOM the process —
         counted as ``admission_shed_serial``.  ``None`` (default)
         disables the guard.
+    ``flight_dir``
+        Where the flight recorder (``obs/flight.py``,
+        docs/OBSERVABILITY.md) dumps its black box on quarantine and
+        worker fencing.  Default: ``MDTPU_FLIGHT_DIR``, else beside a
+        path-backed ``journal``, else off.
     """
 
     def __init__(self, n_workers: int = 1, cache=None,
@@ -154,7 +160,8 @@ class Scheduler:
                  supervision_interval_s: float = 0.05,
                  breakers=None, journal=None, clock=time.monotonic,
                  scrub: bool = False, scrub_interval_s: float = 5.0,
-                 mem_guard_bytes: int | None = None):
+                 mem_guard_bytes: int | None = None,
+                 flight_dir: str | None = None):
         self.cache = cache
         self.telemetry = telemetry or ServiceTelemetry()
         self.max_deferrals = max_deferrals
@@ -182,6 +189,13 @@ class Scheduler:
             hasattr(journal, "__fspath__")
         self.journal = (_journal.JobJournal(journal)
                         if self._owns_journal else journal)
+        # flight recorder (obs/flight.py): black-box dumps on
+        # quarantine and worker fencing; off with no resolvable dir
+        self._flight_dir = _flight.flight_dir(
+            flight_dir, journal if self._owns_journal else None)
+        # live status endpoint (service/statusd.py), opt-in via
+        # serve_status() / the batch CLI's --status-port
+        self._statusd = None
         self._fp_counts: dict = {}      # derived-fingerprint occurrence
         # scheduler-driven prefetch (docs/COLDSTART.md): a background
         # thread stages queued jobs' blocks into the shared cache
@@ -319,10 +333,76 @@ class Scheduler:
             st.join()
         self._teardown()
 
+    def status(self) -> dict:
+        """The ``/status`` document (service/statusd.py,
+        docs/OBSERVABILITY.md): queue depth, live leases, breaker
+        states, quarantine — one JSON fetch instead of a log grep."""
+        now = self._clock()
+        with self._cond:
+            queue_depth = len(self._queue) + len(self._parked)
+            inflight = self._inflight
+            active = self._active
+            workers_alive = sum(1 for t in self._workers
+                                if t.is_alive())
+            shutdown = self._shutdown
+            leases = [
+                {"worker": lease.worker.name,
+                 "jobs": len(lease.handles),
+                 "ttl_s": round(lease.ttl, 3),
+                 "expires_in_s": round(lease.deadline - now, 3)}
+                for lease in self._sup.leases.values()]
+            quarantined = [h.job.fingerprint or f"job-{h.job_id}"
+                           for h in self.quarantined]
+        out = {
+            "role": "scheduler",
+            "shutdown": shutdown,
+            "queue_depth": queue_depth,
+            "inflight": inflight,
+            "active_workers": active,
+            "workers_alive": workers_alive,
+            "leases": leases,
+            "quarantined": quarantined,
+            "telemetry": self.telemetry.snapshot(cache=self.cache),
+        }
+        if self.breakers is not None:
+            out["breakers"] = {
+                (backend if mesh is None else f"{backend}@{mesh}"): st
+                for (backend, mesh), st
+                in self.breakers.states().items()}
+        return out
+
+    def _healthz(self) -> dict:
+        with self._cond:
+            ok = (not self._shutdown
+                  and any(t.is_alive() for t in self._workers))
+        return {"ok": ok, "role": "scheduler"}
+
+    def serve_status(self, port: int = 0,
+                     bind_host: str = "127.0.0.1") -> tuple:
+        """Start the live status endpoint for this scheduler
+        (``/status``, ``/healthz``, ``/metrics`` —
+        service/statusd.py); returns the bound ``(host, port)``.
+        Idempotent; closed by :meth:`shutdown`."""
+        from mdanalysis_mpi_tpu.service.statusd import StatusServer
+
+        if self._statusd is None:
+            self._statusd = StatusServer(
+                self.status,
+                metrics_fn=lambda: obs.to_prometheus(
+                    obs.unified_snapshot(timers=TIMERS,
+                                         cache=self.cache,
+                                         telemetry=self.telemetry)),
+                health_fn=self._healthz,
+                bind_host=bind_host, port=port)
+        return self._statusd.address
+
     def _teardown(self) -> None:
         """Idempotent final cleanup, only once no worker can still
         need a heartbeat or a journal record."""
         _timers.remove_phase_hook(self._sup.heartbeat)
+        if self._statusd is not None:
+            self._statusd.close()
+            self._statusd = None
         if self.journal is not None and self._owns_journal:
             self.journal.close()
         # under the condition like every other mutation of the pool
@@ -771,16 +851,21 @@ class Scheduler:
         lease or live worker remains."""
         while True:
             with self._cond:
-                quarantines = self._reap_locked()
+                quarantines, fences = self._reap_locked()
                 alive = [t for t in self._workers if t.is_alive()]
                 stop = (self._shutdown and not self._sup.leases
                         and not self._pending_requeues and not alive)
-                if not stop and not quarantines:
+                if not stop and not quarantines and not fences:
                     self._cond.wait(self.supervision_interval_s)
-            # quarantine OUTSIDE the condition lock: it fires the
-            # handle's done-callbacks (the batch CLI writes an .npz
-            # there) and a durable journal fsync — holding the lock
+            # quarantine and flight dumps OUTSIDE the condition lock:
+            # quarantine fires the handle's done-callbacks (the batch
+            # CLI writes an .npz there) and a durable journal fsync,
+            # and a dump is an fsync'd file write — holding the lock
             # through disk I/O would stall every claim/submit/finish
+            for worker_name, n_jobs in fences:
+                _flight.dump("worker_fence", self._flight_dir,
+                             extra={"worker": worker_name,
+                                    "n_jobs": n_jobs})
             for h, incident in quarantines:
                 self._quarantine(h, incident)
             if stop:
@@ -793,12 +878,15 @@ class Scheduler:
                         "workers to claim this requeued job")
                 return
 
-    def _reap_locked(self) -> list:
-        """Reap due leases; returns ``(handle, incident)`` pairs that
-        crossed the poison threshold, for the caller to quarantine
-        AFTER releasing the condition lock (quarantine does disk
-        I/O: done-callbacks + a durable journal record)."""
+    def _reap_locked(self) -> tuple:
+        """Reap due leases; returns ``(quarantines, fences)`` —
+        ``(handle, incident)`` pairs that crossed the poison
+        threshold, and ``(worker_name, n_jobs)`` pairs for workers
+        fenced this pass — for the caller to quarantine / flight-dump
+        AFTER releasing the condition lock (both do disk I/O:
+        done-callbacks, a durable journal record, an fsync'd dump)."""
         quarantines = []
+        fences = []
         now = self._clock()
         for lease in self._sup.expired(now):
             worker = lease.worker
@@ -824,6 +912,7 @@ class Scheduler:
                 # forever: after one more TTL the requeue proceeds
                 # anyway (disclosed risk, docs/RELIABILITY.md).
                 self._sup.fenced.add(worker)
+                fences.append((worker.name, len(lease.handles)))
             for h in list(lease.handles):
                 if h.done():
                     continue
@@ -882,7 +971,7 @@ class Scheduler:
                     self._log.warning("respawned dead worker %s as %s",
                                       t.name, nt.name)
                     nt.start()
-        return quarantines
+        return quarantines, fences
 
     def _write_off_locked(self, worker: threading.Thread) -> None:
         """Replace a forever-wedged (fenced, grace-expired, still
@@ -939,6 +1028,16 @@ class Scheduler:
             "last_worker": incident.get("worker"),
             "fault_count": h._faults,
         }
+        # the black box rides the diagnostics (docs/OBSERVABILITY.md):
+        # recent timeline + counters at the moment of the quarantine
+        fpath = _flight.dump(
+            "quarantine", self._flight_dir,
+            extra={"job_id": h.job_id, "tenant": h.job.tenant,
+                   "fingerprint": h.job.fingerprint,
+                   "trace_id": h.job.trace_id,
+                   "reason": incident.get("reason")})
+        if fpath:
+            diagnostics["flight_recorder"] = fpath
         err = JobQuarantinedError(
             f"job {h.job_id} ({h.job.tenant}, "
             f"{type(h.job.analysis).__name__}) quarantined after "

@@ -1,0 +1,226 @@
+"""Live status endpoint: ``/metrics``, ``/healthz``, ``/status``
+(docs/OBSERVABILITY.md "Fleet federation").
+
+A tiny stdlib ``http.server`` tier an operator (or a Prometheus
+scraper) can hit while a controller or scheduler is serving:
+
+- ``/metrics`` — Prometheus text exposition of the process's unified
+  snapshot (for a fleet controller: the MERGED fleet document — host
+  counters summed, host gauges labeled);
+- ``/healthz`` — liveness JSON, HTTP 200 while healthy / 503 once
+  wedged or shut down;
+- ``/status`` — the operational JSON an operator greps logs for
+  today: queue depth, leases, breaker states, hosts alive, epoch,
+  quarantine.
+
+The :class:`~mdanalysis_mpi_tpu.service.fleet.FleetController` starts
+one by default and publishes its port beside ``controller.addr``
+(``status_port``); a standalone
+:class:`~mdanalysis_mpi_tpu.service.scheduler.Scheduler` opts in via
+``serve_status()`` / the batch CLI's ``--status-port``.  Requests are
+counted (``mdtpu_status_requests_total{route=}``).
+
+``python -m mdanalysis_mpi_tpu status [--json] [addr|workdir]`` is the
+one-shot fetch side — dispatched jax-free like ``lint``/``fleet``
+(this module imports only the standard library and ``obs``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from mdanalysis_mpi_tpu.obs import metrics as _metrics
+
+#: The routes the request counter labels by name; anything else
+#: counts as ``route="other"`` (a 404).
+ROUTES = ("/status", "/metrics", "/healthz")
+
+
+class StatusServer:
+    """One daemon HTTP thread serving the three routes off caller
+    snapshots.  ``status_fn`` → dict, ``metrics_fn`` → Prometheus
+    text, ``health_fn`` → dict with an ``"ok"`` bool (omitted: always
+    healthy).  Port 0 binds an ephemeral port; read it back from
+    :attr:`address`."""
+
+    def __init__(self, status_fn, metrics_fn=None, health_fn=None,
+                 bind_host: str = "127.0.0.1", port: int = 0):
+        self._status_fn = status_fn
+        self._metrics_fn = metrics_fn or (
+            lambda: _metrics.to_prometheus(_metrics.unified_snapshot()))
+        self._health_fn = health_fn or (lambda: {"ok": True})
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):     # quiet: obs, not stderr
+                pass
+
+            def do_GET(self):
+                route = self.path.split("?", 1)[0]
+                code, ctype, body = outer._respond(route)
+                _metrics.METRICS.inc(
+                    "mdtpu_status_requests_total",
+                    route=route if route in ROUTES else "other")
+                try:
+                    self.send_response(code)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except OSError:
+                    pass              # client went away mid-response
+
+        self._server = ThreadingHTTPServer((bind_host, port), _Handler)
+        self._server.daemon_threads = True
+        self.address = self._server.server_address
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="mdtpu-statusd")
+        self._thread.start()
+
+    def _respond(self, route: str) -> tuple[int, str, bytes]:
+        try:
+            if route == "/metrics":
+                return (200, "text/plain; version=0.0.4",
+                        self._metrics_fn().encode())
+            if route == "/healthz":
+                health = self._health_fn()
+                code = 200 if health.get("ok") else 503
+                return (code, "application/json",
+                        json.dumps(health).encode())
+            if route == "/status":
+                return (200, "application/json",
+                        json.dumps(self._status_fn(),
+                                   default=str).encode())
+            return (404, "application/json",
+                    json.dumps({"error": f"no route {route!r}",
+                                "routes": list(ROUTES)}).encode())
+        except Exception as exc:   # a snapshot bug must not kill the
+            #                        serving thread — disclose it
+            return (500, "application/json",
+                    json.dumps({"error": f"{type(exc).__name__}: "
+                                         f"{exc}"}).encode())
+
+    def close(self) -> None:
+        try:
+            self._server.shutdown()
+            self._server.server_close()
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# the one-shot `status` CLI
+# ---------------------------------------------------------------------------
+
+def _resolve_target(target: str) -> tuple[str, int]:
+    """``host:port`` / bare port / a fleet workdir holding
+    ``controller.addr`` (whose ``status_port`` the controller
+    published beside its command address)."""
+    if os.path.isdir(target):
+        from mdanalysis_mpi_tpu.service import fleet as _fleet
+
+        info = _fleet._read_addr_file(target)
+        if info is None:
+            raise SystemExit(
+                f"{target!r} holds no readable controller.addr — is a "
+                "fleet controller running against this workdir?")
+        port = info.get("status_port")
+        if not port:
+            raise SystemExit(
+                f"the controller at {target!r} published no status "
+                "port (status endpoint disabled)")
+        return info.get("host", "127.0.0.1"), int(port)
+    host, sep, port = target.rpartition(":")
+    if sep and port.isdigit():
+        return host or "127.0.0.1", int(port)
+    if target.isdigit():
+        return "127.0.0.1", int(target)
+    raise SystemExit(
+        f"cannot resolve {target!r}: pass host:port, a bare port, or "
+        "a fleet workdir containing controller.addr")
+
+
+def fetch_status(target: str, route: str = "/status",
+                 timeout: float = 5.0):
+    """GET one route from a running controller/scheduler endpoint.
+    Returns parsed JSON for the JSON routes, text for ``/metrics``."""
+    import urllib.request
+
+    host, port = _resolve_target(target)
+    with urllib.request.urlopen(f"http://{host}:{port}{route}",
+                                timeout=timeout) as resp:
+        body = resp.read().decode()
+    return body if route == "/metrics" else json.loads(body)
+
+
+def _fmt_scalar(v) -> str:
+    return json.dumps(v) if isinstance(v, str) else str(v)
+
+
+def _print_human(doc: dict) -> None:
+    role = doc.get("role", "?")
+    print(f"{role} status")
+    for key in sorted(doc):
+        val = doc[key]
+        if isinstance(val, (dict, list)):
+            continue
+        print(f"  {key:<28} {_fmt_scalar(val)}")
+    hosts = doc.get("hosts")
+    if isinstance(hosts, dict) and hosts:
+        print("  hosts:")
+        for hid in sorted(hosts):
+            h = hosts[hid]
+            flags = " ".join(f"{k}={_fmt_scalar(v)}"
+                             for k, v in sorted(h.items()))
+            print(f"    {hid:<12} {flags}")
+    leases = doc.get("leases")
+    if isinstance(leases, list) and leases:
+        print("  leases:")
+        for lease in leases:
+            flags = " ".join(f"{k}={_fmt_scalar(v)}"
+                             for k, v in sorted(lease.items()))
+            print(f"    {flags}")
+    breakers = doc.get("breakers")
+    if isinstance(breakers, dict) and breakers:
+        print("  breakers:")
+        for name in sorted(breakers):
+            print(f"    {name:<12} {breakers[name]}")
+    quarantined = doc.get("quarantined")
+    if quarantined:
+        print(f"  quarantined: {', '.join(map(str, quarantined))}")
+
+
+def status_main(argv=None) -> int:
+    """Entry point of the ``status`` subcommand: one-shot fetch of
+    ``/status`` from a running controller/scheduler (jax-free, like
+    ``lint``/``fleet``)."""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="mdanalysis_mpi_tpu status",
+        description="fetch /status from a running fleet controller "
+                    "or scheduler status endpoint "
+                    "(docs/OBSERVABILITY.md)")
+    p.add_argument("target", nargs="?", default=".",
+                   help="host:port, bare port, or a fleet workdir "
+                        "holding controller.addr (default: cwd)")
+    p.add_argument("--json", action="store_true",
+                   help="print the raw /status JSON instead of the "
+                        "human-readable table")
+    p.add_argument("--timeout", type=float, default=5.0)
+    ns = p.parse_args(argv)
+    try:
+        doc = fetch_status(ns.target, timeout=ns.timeout)
+    except OSError as exc:
+        print(json.dumps({"error": f"{type(exc).__name__}: {exc}",
+                          "target": ns.target}))
+        return 1
+    if ns.json:
+        print(json.dumps(doc, indent=2, sort_keys=True, default=str))
+    else:
+        _print_human(doc)
+    return 0

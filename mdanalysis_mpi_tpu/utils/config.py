@@ -246,6 +246,13 @@ def main(argv=None) -> int:
         from mdanalysis_mpi_tpu.io.store.cli import ingest_main
 
         return ingest_main(args[1:])
+    if args and args[0] == "status":
+        # one-shot fetch of /status from a running controller/
+        # scheduler endpoint (docs/OBSERVABILITY.md) — jax-free like
+        # lint/fleet: stdlib sockets only, never a platform re-pin
+        from mdanalysis_mpi_tpu.service.statusd import status_main
+
+        return status_main(args[1:])
     if args and args[0] == "lint":
         # repo-native static analysis (lint/ subsystem): concurrency
         # discipline, jit/jaxpr contracts, schema drift — docs/LINT.md.

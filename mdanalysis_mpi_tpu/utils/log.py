@@ -51,6 +51,16 @@ def log_event(event: str, **fields) -> None:
     ``MDTPU_LOG=INFO``).  JSON events carry ``ts``/``pid``/``thread``
     identity fields; explicit same-named ``fields`` win.
     """
+    # mirror onto the span timeline (one "log"-category instant, the
+    # SCALAR fields only — a serving snapshot's nested dicts stay in
+    # the JSON stream), so tail()/flight dumps show log lines
+    # interleaved with phases and incidents in one monotonic order
+    from mdanalysis_mpi_tpu.obs import spans as _spans
+
+    if _spans.enabled():
+        _spans.log_mark(event, **{
+            k: v for k, v in fields.items()
+            if isinstance(v, (str, int, float, bool))})
     mode = os.environ.get("MDTPU_LOG_JSON")
     # the repo-wide knob convention: 0/false/no mean OFF, never a file
     # named "0" in the cwd

@@ -66,6 +66,81 @@ def _fire_phase_hooks(name: str) -> None:
             pass
 
 
+# ---- per-job phase windows (obs.report attribution) ----
+#
+# A RunReport used to slice the process-global TIMERS by time window,
+# which bled concurrent jobs' phases into each other's reports
+# (the documented PR-5 caveat).  Windows fix that: run() opens one
+# keyed by the job's trace ids (obs.spans thread context — live even
+# with tracing off), and every phase completion whose thread context
+# intersects a window's ids accumulates there too.  Cross-thread
+# staging keeps its attribution because the executors re-apply the
+# captured context on prefetch/pool threads (spans.saved_context).
+# Cost when no run is capturing: one list truthiness check per phase.
+
+class PhaseWindow:
+    """One run's private phase accumulator, matched by trace ids."""
+
+    __slots__ = ("trace_ids", "_acc", "_calls", "_lock")
+
+    def __init__(self, trace_ids):
+        self.trace_ids = frozenset(trace_ids)
+        self._acc: dict[str, float] = {}
+        self._calls: dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def _add(self, name: str, seconds: float) -> None:
+        with self._lock:
+            self._acc[name] = self._acc.get(name, 0.0) + seconds
+            self._calls[name] = self._calls.get(name, 0) + 1
+
+    def snapshot(self) -> tuple[dict, dict]:
+        with self._lock:
+            return dict(self._acc), dict(self._calls)
+
+
+_WINDOWS: list[PhaseWindow] = []
+_WINDOWS_LOCK = threading.Lock()
+#: Backstop on concurrently open windows: capture sites close (or
+#: abandon) their window, but a window leaked past both paths must
+#: not grow the registry — and the per-phase scan — forever in a
+#: long-lived serving process.  Far above any real worker count.
+MAX_WINDOWS = 64
+
+
+def open_window(trace_ids) -> PhaseWindow:
+    """Start attributing matching phase completions to a new window
+    (``obs.report.start_capture`` calls this when the current thread
+    carries a trace context)."""
+    w = PhaseWindow(trace_ids)
+    with _WINDOWS_LOCK:
+        if len(_WINDOWS) >= MAX_WINDOWS:
+            _WINDOWS.pop(0)             # oldest — a leak, not live
+        _WINDOWS.append(w)
+    return w
+
+
+def close_window(window: PhaseWindow) -> None:
+    with _WINDOWS_LOCK:
+        try:
+            _WINDOWS.remove(window)
+        except ValueError:
+            pass
+
+
+def _attribute_window(name: str, seconds: float) -> None:
+    # caller checked `_WINDOWS` (the near-free miss path); re-check
+    # under the race anyway via the local copy
+    ids = _spans.current_trace_ids()
+    if not ids:
+        return
+    with _WINDOWS_LOCK:
+        windows = list(_WINDOWS)
+    for w in windows:
+        if w.trace_ids & ids:
+            w._add(name, seconds)
+
+
 class PhaseTimers:
     """Accumulating named wall-clock phase timers.
 
@@ -106,12 +181,16 @@ class PhaseTimers:
             with self._lock:
                 self._acc[name] = self._acc.get(name, 0.0) + dt
                 self._calls[name] = self._calls.get(name, 0) + 1
+            if _WINDOWS:
+                _attribute_window(name, dt)
 
     def add(self, name: str, seconds: float) -> None:
         """Record an externally measured duration under ``name``."""
         with self._lock:
             self._acc[name] = self._acc.get(name, 0.0) + seconds
             self._calls[name] = self._calls.get(name, 0) + 1
+        if _WINDOWS:
+            _attribute_window(name, seconds)
 
     def seconds(self, name: str) -> float:
         return self._acc.get(name, 0.0)

@@ -105,6 +105,17 @@ PINNED_METRICS = {
     "mdtpu_jobs_migrated_total": "counter",
     "mdtpu_controller_epoch": "gauge",
     "mdtpu_epoch_fenced_rejects_total": "counter",
+    # fleet observability (docs/OBSERVABILITY.md "Fleet federation"):
+    # heartbeat-piggybacked metric ships and trace batches (drops
+    # disclosed), flight-recorder dumps, status-endpoint requests,
+    # and the controller's hosts-reporting gauge — recorded live at
+    # each site, zero-injected everywhere else
+    "mdtpu_fleet_obs_metrics_ships_total": "counter",
+    "mdtpu_fleet_obs_trace_events_total": "counter",
+    "mdtpu_fleet_obs_trace_dropped_total": "counter",
+    "mdtpu_flight_dumps_total": "counter",
+    "mdtpu_status_requests_total": "counter",
+    "mdtpu_fleet_hosts_reporting": "gauge",
 }
 
 
@@ -215,6 +226,17 @@ def test_bench_json_contract(tmp_path):
                     "fleet_hosts_lost", "fleet_jobs_migrated",
                     "fleet_epoch_fenced_rejects",
                     "fleet_exactly_once",
+                    # fleet-observability federation sub-leg
+                    # (docs/OBSERVABILITY.md "Fleet federation"):
+                    # heartbeat-piggyback overhead vs a plain fleet
+                    # wave (<3% target at flagship scale), with the
+                    # ship/trace accounting — host-side, survives
+                    # the outage protocol
+                    "obs_federation_overhead_pct",
+                    "obs_federation_jobs_per_s",
+                    "obs_federation_plain_jobs_per_s",
+                    "obs_federation_metrics_ships",
+                    "obs_federation_trace_events",
                     # r9: observability — the host-leg tracing-on/off
                     # delta and the unified metrics block
                     # (docs/OBSERVABILITY.md)
@@ -273,6 +295,15 @@ def test_bench_json_contract(tmp_path):
         assert rec["fleet_exactly_once"] is True
         assert rec["fleet_wave2_home_hit_rate"] == 1.0
         assert rec["fleet_jobs_migrated"] >= 0
+        # federation sub-leg: both waves ran, the piggyback overhead
+        # is a sane percentage (<3% target at flagship scale; toy
+        # scale gets headroom), and the hosts really shipped metrics
+        # and trace batches
+        assert rec["obs_federation_jobs_per_s"] > 0
+        assert rec["obs_federation_plain_jobs_per_s"] > 0
+        assert 0 <= rec["obs_federation_overhead_pct"] <= 100
+        assert rec["obs_federation_metrics_ships"] >= 1
+        assert rec["obs_federation_trace_events"] >= 1
         # fault-wave sub-leg: the injected worker death was really
         # reaped, recovered jobs still flowed, and the recovery price
         # is recorded next to the clean wave
@@ -389,6 +420,10 @@ def test_bench_outage_records_host_legs(tmp_path):
         assert rec["fleet_loss_jobs_per_s"] > 0
         assert rec["fleet_hosts_lost"] == 1
         assert rec["fleet_exactly_once"] is True
+        # the federation sub-leg is host-side too: the piggyback
+        # overhead disclosure survives a tunnel-down artifact
+        assert rec["obs_federation_jobs_per_s"] > 0
+        assert rec["obs_federation_metrics_ships"] >= 1
         # the retry log shows what init actually did
         assert rec["init_log"] and rec["init_log"][0]["attempt"] == 1
         # the incremental file matches the emitted record's legs

@@ -396,8 +396,144 @@ def test_shard_guards_empty_window_and_non_series(tmp_path):
 
 def test_fleet_smoke_record(tmp_path):
     """The scripts/verify.sh dryrun smoke, in-process: ok=True with
-    the exactly-once audit passing."""
+    the exactly-once audit passing — PLUS the ISSUE-13 fleet
+    observability acceptance: the merged Chrome trace carried
+    distinct per-host pids and the migrated job's single stitched
+    trace_id, the /metrics scrape's fleet-summed completion counter
+    equals the journal ledger exactly, and the kill -9'd host left a
+    flight-recorder dump."""
     record = _fleet.fleet_smoke(workdir=str(tmp_path / "smoke"))
     assert record["ok"], record
     assert record["exactly_once"]
     assert record["stats"]["hosts_lost"] == 1
+    # metrics federation: host-summed completions == ledger, both
+    # in-process and through the real /metrics scrape
+    assert record["federation_match"]
+    assert record["fleet_jobs_completed"] == 8
+    assert record["scrape_jobs_completed"] == 8
+    # stitched trace: one kill -9 migration, one trace_id on two pids
+    assert record["jobs_migrated"] >= 1
+    assert record["trace_stitched_fp"] is not None
+    assert record["trace_pids"] >= 2
+    # the lost host's black box landed
+    assert record["flight_dump"] is True
+
+
+def test_federation_counters_gauges_and_scrape(tmp_path):
+    """Clean-wave federation correctness: the merged fleet counter
+    equals the per-host registries' sum AND the journal ledger; host
+    gauges arrive labeled; the /metrics scrape parses as Prometheus
+    exposition; /status and /healthz answer; the status CLI fetches
+    one-shot from the workdir."""
+    import io
+    import json as _json
+    import urllib.request
+    from contextlib import redirect_stdout
+
+    from mdanalysis_mpi_tpu.service.statusd import status_main
+
+    workdir = str(tmp_path / "fed")
+    with FleetController(workdir, host_ttl_s=2.0, trace=True) as ctrl:
+        ctrl.spawn_host(hb_interval_s=0.1)
+        assert ctrl.wait_hosts(1, timeout=60)
+        jobs = [ctrl.submit({"analysis": "rmsf", "fixture": FIXTURE,
+                             "tenant": f"t{i % 2}"})
+                for i in range(4)]
+        assert ctrl.drain(timeout=120)
+        assert all(j.state == _fleet.DONE for j in jobs)
+
+        # federation is async (heartbeat-piggybacked): poll the
+        # merged view until the host's counters landed
+        def summed():
+            snap = ctrl.fleet_snapshot()
+            return sum(snap["mdtpu_jobs_completed_total"]
+                       ["values"].values()), snap
+        _wait(lambda: summed()[0] >= len(jobs), timeout=10,
+              msg="host metrics to federate")
+        total, snap = summed()
+        assert total == len(jobs)
+        # the host's snapshot is the per-host registry: the merged
+        # counter IS its sum (controller contributes its zero)
+        per_host = ctrl.host_metrics()
+        assert sum(
+            hm["mdtpu_jobs_completed_total"]["values"][""]
+            for hm in per_host.values()) == len(jobs)
+        # host gauges arrive labeled host=, controller's distinct
+        assert any(k.endswith('host="host0"')
+                   for k in snap["mdtpu_queue_depth"]["values"])
+        assert snap["mdtpu_hosts_alive"]["values"][""] == 1
+        assert snap["mdtpu_fleet_hosts_reporting"]["values"][""] == 1
+
+        # endpoint: addr file publishes the status port beside the
+        # command address; the scrape parses as Prometheus text
+        info = _fleet._read_addr_file(workdir)
+        assert info["status_port"]
+        base = f"http://{info['host']}:{info['status_port']}"
+        text = urllib.request.urlopen(f"{base}/metrics",
+                                      timeout=5).read().decode()
+        for line in text.splitlines():
+            assert line.startswith("#") or " " in line
+        assert "# TYPE mdtpu_jobs_completed_total counter" in text
+        assert "mdtpu_jobs_completed_total 4" in text
+        status = _json.loads(urllib.request.urlopen(
+            f"{base}/status", timeout=5).read())
+        assert status["role"] == "fleet-controller"
+        assert status["epoch"] == 1
+        assert status["hosts_alive"] == 1
+        assert status["hosts"]["host0"]["alive"] is True
+        assert urllib.request.urlopen(f"{base}/healthz",
+                                      timeout=5).status == 200
+
+        # the one-shot CLI resolves the workdir -> status_port
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            rc = status_main([workdir, "--json"])
+        assert rc == 0
+        doc = _json.loads(buf.getvalue())
+        assert doc["role"] == "fleet-controller"
+        assert doc["jobs_done"] == 4
+
+        # the journal ledger agrees with the federated sum
+    meta = replay_fleet(os.path.join(workdir, _fleet.JOURNAL_NAME))
+    assert sum(meta["finishes"].values()) == len(jobs) == total
+
+
+def test_fleet_trace_merges_hosts_onto_shared_timeline(tmp_path):
+    """export_fleet_trace: valid Chrome JSON, every host on its own
+    real pid with a process_name row, fleet_host attribution on host
+    spans, non-negative timestamps."""
+    import json as _json
+
+    workdir = str(tmp_path / "trace")
+    with FleetController(workdir, host_ttl_s=2.0, trace=True) as ctrl:
+        for _ in range(2):
+            ctrl.spawn_host(hb_interval_s=0.1)
+        assert ctrl.wait_hosts(2, timeout=60)
+        jobs = [ctrl.submit({"analysis": "rmsf", "fixture": FIXTURE,
+                             "tenant": f"t{i}"}) for i in range(4)]
+        assert ctrl.drain(timeout=120)
+        assert all(j.state == _fleet.DONE for j in jobs)
+        # serve spans ship on heartbeat ticks: wait for both hosts
+        _wait(lambda: sum(
+            1 for evs in ctrl.host_trace_events().values()
+            if any(ev.get("name") == "serve_job" for ev in evs)) >= 2,
+            timeout=10, msg="both hosts' spans to arrive")
+        path = ctrl.export_fleet_trace(str(tmp_path / "fleet.json"))
+    with open(path) as f:
+        doc = _json.load(f)
+    evs = doc["traceEvents"]
+    pids = {ev["pid"] for ev in evs if ev.get("ph") != "M"}
+    assert len(pids) == 2                      # one per host process
+    labels = {ev["args"]["name"] for ev in evs
+              if ev.get("ph") == "M" and ev["name"] == "process_name"}
+    assert "fleet-controller" in labels
+    assert {"fleet-host host0", "fleet-host host1"} <= labels
+    runs = [ev for ev in evs if ev.get("name") == "serve_job"]
+    assert runs and all(
+        ev["args"]["fleet_host"] in ("host0", "host1") for ev in runs)
+    # every fleet job's spans carry its fingerprint as trace_id
+    fps = {j.fp for j in jobs}
+    seen = {tid for ev in runs
+            for tid in (ev["args"].get("trace_ids") or ())}
+    assert fps <= seen
+    assert all(ev["ts"] >= 0 for ev in evs if "ts" in ev)

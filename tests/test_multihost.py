@@ -160,6 +160,20 @@ class TestTwoProcessMesh:
         out = str(tmp_path / "results.npz")
         env = dict(os.environ,
                    JAX_PLATFORMS="cpu",
+                   # persistent compile cache OFF for the children:
+                   # with a shared on-disk cache, one process can HIT
+                   # an entry its sibling has to compile (suite/bench
+                   # runs seed entries asymmetrically, and even a
+                   # fresh shared dir goes asymmetric mid-run when the
+                   # first compiler's write lands before the sibling's
+                   # lookup) — the hitter then reaches the next gloo
+                   # collective tens of seconds before the compiler
+                   # and the pair deadlocks/aborts (observed as the
+                   # intermittent -6 / 420 s-timeout flake).  Both
+                   # children always compiling keeps them in lockstep;
+                   # the kernels here are tiny, so the symmetric cold
+                   # compile costs seconds.
+                   MDTPU_COMPILE_CACHE="0",
                    XLA_FLAGS="--xla_force_host_platform_device_count=4")
         # bound-socket port handoff (testing.handoff_port): the port is
         # HELD — bound, verifiably ours — through the whole test setup
@@ -169,30 +183,53 @@ class TestTwoProcessMesh:
         # PR-6 retry-once-on-a-fresh-port band-aid: the flake WAS the
         # free-port race (close-then-reuse left the whole child-script
         # formatting window open), not the collectives.
-        holder, port = handoff_port()
-        coord = f"127.0.0.1:{port}"
-        script = tmp_path / "child.py"
-        script.write_text(CHILD.format(repo=REPO, coord=coord,
-                                       out=out, n_res=N_RES,
-                                       n_frames=N_FRAMES))
-        holder.close()
-        procs = [subprocess.Popen(
-            [sys.executable, str(script), str(i)],
-            env=env, stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT) for i in range(2)]
+        # Bounded retries for the single-core livelock: on a starved
+        # host (1-2 cores), two processes spin-waiting in a gloo
+        # rendezvous can starve each other — and their own
+        # coordination heartbeat threads — so the pair either aborts
+        # (task declared unhealthy after the ~100 s heartbeat cutoff,
+        # rc -6) or livelocks outright.  That is OS-scheduler luck,
+        # not the PR-6 port race (the handoff above already fixed
+        # that) and not a parity bug: the SAME binaries pass in ~16 s
+        # when the scheduler cooperates.  A healthy attempt finishes
+        # well under the per-attempt timeout, so retries stay inside
+        # the tier-1 suite budget.
         outputs = []
-        for p in procs:
-            try:
-                stdout, _ = p.communicate(timeout=420)
-            except subprocess.TimeoutExpired:
-                for q in procs:
-                    q.kill()
-                    q.wait()
-                pytest.fail("2-process mesh run timed out")
-            outputs.append(stdout.decode(errors="replace"))
-        for i, p in enumerate(procs):
-            assert p.returncode == 0, (
-                f"process {i} failed:\n{outputs[i][-3000:]}")
+        for attempt in range(3):
+            holder, port = handoff_port()
+            coord = f"127.0.0.1:{port}"
+            script = tmp_path / "child.py"
+            script.write_text(CHILD.format(repo=REPO, coord=coord,
+                                           out=out, n_res=N_RES,
+                                           n_frames=N_FRAMES))
+            holder.close()
+            procs = [subprocess.Popen(
+                [sys.executable, str(script), str(i)],
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT) for i in range(2)]
+            outputs, timed_out = [], False
+            for p in procs:
+                try:
+                    # healthy attempts finish in ~16-30 s (compile
+                    # cache off); 120 s is 4x margin, and 3 livelocked
+                    # attempts still fit the tier-1 suite budget
+                    stdout, _ = p.communicate(timeout=120)
+                except subprocess.TimeoutExpired:
+                    timed_out = True
+                    for q in procs:
+                        q.kill()
+                        q.wait()
+                    stdout, _ = p.communicate()
+                outputs.append(stdout.decode(errors="replace"))
+            if not timed_out and all(p.returncode == 0 for p in procs):
+                break
+            if attempt == 2:
+                if timed_out:
+                    pytest.fail("2-process mesh run timed out on all "
+                                "3 attempts")
+                for i, p in enumerate(procs):
+                    assert p.returncode == 0, (
+                        f"process {i} failed:\n{outputs[i][-3000:]}")
 
         # oracles in-parent (single process, serial f64)
         from mdanalysis_mpi_tpu.testing import make_protein_universe

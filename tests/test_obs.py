@@ -552,3 +552,223 @@ def test_log_event_json_appends_to_file(tmp_path, monkeypatch):
     log_event("third")
     with open(path) as f:
         assert len(f.read().splitlines()) == 3
+
+
+# ---- fleet federation: merge rules + tail interleave + per-job
+#      phase attribution (ISSUE 13) ----
+
+
+def test_unified_snapshot_fleet_merge_rules():
+    """unified_snapshot(fleet=): counters/histograms are SUMMED
+    across hosts (fleet job counters == the sum of the per-host
+    registries), gauges are host-labeled, controller-local series
+    stay distinct, and the merged document still renders as
+    Prometheus text."""
+    from mdanalysis_mpi_tpu.obs.metrics import (
+        MetricsRegistry, to_prometheus,
+    )
+
+    reg = MetricsRegistry()   # the "controller": its own counter only
+    reg.inc("mdtpu_hosts_lost_total", reason="socket_eof")
+
+    def host_snap(completed, depth, lat):
+        r = MetricsRegistry()
+        r.inc("mdtpu_jobs_completed_total", completed)
+        r.set_gauge("mdtpu_queue_depth", depth)
+        r.observe("mdtpu_job_latency_seconds", lat)
+        r.inc("mdtpu_phase_seconds_total", 0.5, phase="stage")
+        return r.snapshot()
+
+    snap = unified_snapshot(registry=reg,
+                            fleet={"h0": host_snap(3, 2, 0.01),
+                                   "h1": host_snap(4, 7, 0.02)})
+    # counters: summed across hosts (controller contributes its
+    # zero-injected 0 — the fleet sum IS the per-host sum)
+    assert snap["mdtpu_jobs_completed_total"]["values"][""] == 7
+    assert snap["mdtpu_phase_seconds_total"]["values"][
+        'phase="stage"'] == 1.0
+    # controller-local series stay the controller's own
+    assert snap["mdtpu_hosts_lost_total"]["values"][
+        'reason="socket_eof"'] == 1
+    # gauges: one labeled series per host, never summed
+    assert snap["mdtpu_queue_depth"]["values"]['host="h0"'] == 2
+    assert snap["mdtpu_queue_depth"]["values"]['host="h1"'] == 7
+    # histograms: counts/sums/buckets fold (fixed buckets merge)
+    h = snap["mdtpu_job_latency_seconds"]["values"][""]
+    assert h["count"] == 2
+    assert h["sum"] == 0.03
+    assert h["buckets"]["+Inf"] == 2
+    text = to_prometheus(snap)
+    assert 'mdtpu_queue_depth{host="h0"} 2' in text
+    assert "mdtpu_jobs_completed_total 7" in text
+
+
+def test_tail_interleaves_job_spans_with_global_incidents():
+    """The quarantine/flight-recorder satellite: tail(trace_id=)
+    returns the job's spans AND the globally attributed incidents
+    (breaker transitions, fencing, mirrored log lines) in one shared
+    monotonic (append) order — another job's attributed events stay
+    out."""
+    from mdanalysis_mpi_tpu.utils.timers import TIMERS
+
+    obs.enable_tracing()
+    with obs.trace_context(trace_ids=["job-A"]):
+        with TIMERS.phase("stage"):
+            pass
+        obs.span_event("retry", site="read")
+    obs.span_event("breaker_transition", backend="jax",
+                   to_state="open")
+    log_event("serving", jobs_submitted=3)        # mirrored instant
+    with obs.trace_context(trace_ids=["job-B"]):
+        obs.span_event("retry", site="stage")
+    obs.disable_tracing()
+
+    t = ospans.tail(limit=50, trace_id="job-A")
+    names = [ev["name"] for ev in t]
+    assert names == ["stage", "retry", "breaker_transition",
+                     "serving"]        # append order, job-B excluded
+    mirrored = next(ev for ev in t if ev["name"] == "serving")
+    assert mirrored["cat"] == "log"
+    assert mirrored["args"]["jobs_submitted"] == 3
+
+
+def test_span_ring_evicts_oldest_and_counts_drops():
+    """The buffer is a RING: overflow evicts the OLDEST events
+    (counted, disclosed in the export) so the tail — the flight
+    recorder's black box — always holds the most recent window."""
+    obs.enable_tracing()
+    old_max = ospans._STATE.max_events
+    ospans._STATE.max_events = 5
+    try:
+        for i in range(9):
+            obs.span_event("tick", i=i)
+        t = ospans.tail(limit=10)
+        assert [ev["args"]["i"] for ev in t] == [4, 5, 6, 7, 8]
+        doc = ospans.document()
+        assert doc["otherData"]["dropped_events"] == 4
+    finally:
+        ospans._STATE.max_events = old_max
+        obs.disable_tracing(discard=True)
+
+
+def test_flight_dump_black_box_roundtrip(tmp_path):
+    """obs.flight.dump: atomic JSON with the recent interleaved
+    window, the process attribution, and a full metrics snapshot;
+    counted per trigger."""
+    obs.enable_tracing()
+    ospans.set_process_args(fleet_host="hX")
+    try:
+        obs.span_event("retry", site="read")
+        log_event("serving", jobs_submitted=1)
+        before = obs.METRICS.snapshot().get(
+            "mdtpu_flight_dumps_total", {"values": {}})["values"].get(
+            'trigger="quarantine"', 0)
+        path = obs.flight.dump("quarantine", str(tmp_path),
+                               extra={"job_id": 7})
+        with open(path) as f:
+            doc = json.load(f)
+        assert doc["trigger"] == "quarantine"
+        assert doc["extra"] == {"job_id": 7}
+        assert doc["process_args"] == {"fleet_host": "hX"}
+        names = [ev["name"] for ev in doc["events"]]
+        assert "retry" in names and "serving" in names
+        assert "mdtpu_retries_total" in doc["metrics"]
+        after = obs.METRICS.snapshot()[
+            "mdtpu_flight_dumps_total"]["values"][
+            'trigger="quarantine"']
+        assert after == before + 1
+        # no directory resolvable -> recorder off, never an error
+        assert obs.flight.dump("quarantine", None) is None
+    finally:
+        ospans.set_process_args()
+        obs.disable_tracing(discard=True)
+
+
+def test_run_reports_do_not_bleed_across_concurrent_workers(stack):
+    """The PR-5 caveat, fixed (satellite): two jobs overlapping on a
+    2-worker scheduler each get phase totals from their OWN
+    trace-context window — the slow tenant's staging sleeps must not
+    appear in the fast tenant's report.  Tracing stays OFF: the
+    attribution rides the always-on trace context, not recording."""
+
+    class _SlowReader(stack.MemoryReader):
+        def read_block(self, *a, **k):
+            time.sleep(0.04)
+            return super().read_block(*a, **k)
+
+        def stage_block(self, *a, **k):
+            time.sleep(0.04)
+            return super().stage_block(*a, **k)
+
+    assert not obs.tracing_enabled()
+    rng = np.random.default_rng(11)
+    top = stack.make_protein_topology(16)
+    frames = rng.normal(scale=8.0,
+                        size=(64, top.n_atoms, 3)).astype(np.float32)
+    u_slow = stack.Universe(top, _SlowReader(frames))
+    u_fast = _u(stack, n_frames=16)
+
+    sched = stack.Scheduler(n_workers=2, autostart=False)
+    h_slow = sched.submit(stack.RMSF(u_slow.select_atoms("name CA")),
+                          backend="jax", batch_size=8, tenant="slow")
+    h_fast = sched.submit(stack.RMSF(u_fast.select_atoms("name CA")),
+                          backend="jax", batch_size=8, tenant="fast")
+    sched.start()
+    assert sched.drain(timeout=120)
+    sched.shutdown()
+    assert h_slow.error is None and h_fast.error is None
+
+    r_slow = h_slow.job.analysis.results.observability
+    r_fast = h_fast.job.analysis.results.observability
+    # scheduler runs attribute per job via their trace context
+    assert r_slow["phase_attribution"] == "job"
+    assert r_fast["phase_attribution"] == "job"
+
+    def staged_seconds(report):
+        return sum(report["phases"].get(name, {}).get("seconds", 0.0)
+                   for name in ("stage", "read"))
+
+    # the slow tenant really slept in staging (8 blocks x >=0.08 s)
+    assert staged_seconds(r_slow) >= 0.3
+    # ... and NONE of it bled into the fast tenant's report (the old
+    # global-delta slice would book everything the slow job staged
+    # inside the fast job's time window)
+    assert staged_seconds(r_fast) < 0.15
+    # sanity: the fast report still saw its own dispatches
+    assert r_fast["dispatch_count"] >= 1
+
+
+def test_solo_run_report_keeps_process_attribution(stack):
+    """Outside any scheduler context the report falls back to the
+    process-global delta — exact for a solo run — and says so."""
+    u = _u(stack)
+    r = stack.RMSF(u.select_atoms("name CA")).run(backend="serial")
+    rep = r.results.observability
+    assert rep["phase_attribution"] == "process"
+    assert "execute" in rep["phases"]
+
+
+def test_scheduler_status_endpoint_serves_three_routes(stack):
+    """Scheduler.serve_status(): /status, /healthz and /metrics off
+    the live scheduler, counted per route."""
+    import urllib.request
+
+    sched = stack.Scheduler(n_workers=1)
+    host, port = sched.serve_status()
+    try:
+        doc = json.loads(urllib.request.urlopen(
+            f"http://{host}:{port}/status", timeout=5).read())
+        assert doc["role"] == "scheduler"
+        assert doc["workers_alive"] >= 1
+        assert doc["queue_depth"] == 0
+        health = urllib.request.urlopen(
+            f"http://{host}:{port}/healthz", timeout=5)
+        assert health.status == 200
+        text = urllib.request.urlopen(
+            f"http://{host}:{port}/metrics", timeout=5).read().decode()
+        assert "# TYPE mdtpu_jobs_submitted_total counter" in text
+        snap = obs.METRICS.snapshot()["mdtpu_status_requests_total"]
+        assert snap["values"]['route="/status"'] >= 1
+        assert snap["values"]['route="/metrics"'] >= 1
+    finally:
+        sched.shutdown()

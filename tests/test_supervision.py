@@ -691,3 +691,36 @@ def test_cli_kill9_journal_restart_completes_queue(tmp_path):
             backend="serial", stop=stop).results.rmsf
         with np.load(tmp_path / f"out_{stop}.npz") as z:
             np.testing.assert_allclose(z["rmsf"], oracle, atol=1e-4)
+
+
+def test_quarantine_attaches_flight_recorder_dump(tmp_path):
+    """ISSUE 13 flight recorder: a quarantined job's diagnostics
+    carry the path of an atomically written black-box dump (recent
+    events + metrics snapshot), and the dump is counted per
+    trigger."""
+    from mdanalysis_mpi_tpu import obs
+
+    u = _u()
+    sched = _sched(n_workers=1, poison_threshold=1, autostart=False,
+                   flight_dir=str(tmp_path))
+    h = sched.submit(AnalysisJob(
+        PoisonAnalysis(u.select_atoms("name CA")), backend="serial",
+        tenant="poison", fingerprint="poison-flight"))
+    sched.start()
+    assert sched.drain(timeout=60)
+    sched.shutdown()
+
+    assert h.state == JobState.QUARANTINED
+    with pytest.raises(JobQuarantinedError) as ei:
+        h.result(timeout=1)
+    path = ei.value.diagnostics.get("flight_recorder")
+    assert path and os.path.exists(path)
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["trigger"] == "quarantine"
+    assert doc["extra"]["tenant"] == "poison"
+    assert doc["extra"]["fingerprint"] == "poison-flight"
+    # the dump embeds the full pinned-schema metrics snapshot
+    assert doc["metrics"]["mdtpu_jobs_quarantined_total"]
+    snap = obs.METRICS.snapshot()["mdtpu_flight_dumps_total"]
+    assert snap["values"].get('trigger="quarantine"', 0) >= 1
