@@ -1162,6 +1162,111 @@ def obs_federation_leg() -> dict:
     return out
 
 
+def qos_serving_leg() -> dict:
+    """QoS + elasticity sub-leg (docs/RELIABILITY.md §7 "Overload and
+    elasticity"): a bursty multi-class wave — interactive + batch +
+    background tenants — against an AUTOSCALING one-slot-per-host
+    fleet.  The backlog scales hosts up (journaled ``scale_up``
+    records), the post-burst idle retires them drain-first back down
+    (``scale_down``); interactive p99 is measured against a DISCLOSED
+    SLO target (``qos_slo_target_s``, env ``BENCH_QOS_SLO``) while
+    batch throughput absorbs the slack; the background tail exceeds
+    the shed depth and is dropped by the ladder — counted, journaled,
+    never a class above it.  Host-side by construction (serial hosts,
+    jax-free children): survives the outage protocol like every leg
+    before first jax contact."""
+    import shutil
+    import tempfile
+
+    from mdanalysis_mpi_tpu.service import fleet as _fleet
+    from mdanalysis_mpi_tpu.service.fleet import (
+        DONE, SHED, FleetController,
+    )
+    from mdanalysis_mpi_tpu.service.journal import replay_fleet
+    from mdanalysis_mpi_tpu.service.qos import QosPolicy
+
+    slo_target = float(os.environ.get("BENCH_QOS_SLO", "20.0"))
+    fixture = {"kind": "protein", "n_residues": 10, "n_frames": 12,
+               "noise": 0.25, "seed": 11}
+    workdir = tempfile.mkdtemp(prefix="mdtpu-qos-leg-")
+    policy = QosPolicy(shed_queue_depth=8,
+                       shed_classes=("background",),
+                       slo_targets_s={"interactive": slo_target})
+    spawn = {"hb_interval_s": 0.1,
+             "env": {"MDTPU_FLEET_RUN_DELAY": "0.2"}}
+    try:
+        with FleetController(
+                workdir, host_ttl_s=5.0, host_slots=1, qos=policy,
+                autoscale=True, min_hosts=1, max_hosts=3,
+                scale_up_backlog=2, scale_down_idle_s=0.4,
+                scale_cooldown_s=0.2, retire_drain_s=5.0,
+                autoscale_spawn=spawn, status=False) as ctrl:
+            ctrl.spawn_host(**spawn)
+            if not ctrl.wait_hosts(1, timeout=120.0):
+                raise RuntimeError("qos leg: first host never joined")
+            t0 = time.perf_counter()
+            interactive = [ctrl.submit({"analysis": "rmsf",
+                                        "fixture": fixture,
+                                        "tenant": f"qi{i}",
+                                        "qos": "interactive"})
+                           for i in range(4)]
+            batch = [ctrl.submit({"analysis": "rmsf",
+                                  "fixture": fixture,
+                                  "tenant": f"qb{i}",
+                                  "qos": "batch"})
+                     for i in range(6)]
+            background = [ctrl.submit({"analysis": "rmsf",
+                                       "fixture": fixture,
+                                       "tenant": f"qg{i}",
+                                       "qos": "background"})
+                          for i in range(8)]
+            if not ctrl.drain(timeout=300.0):
+                raise RuntimeError("qos leg: drain timed out")
+            wall = time.perf_counter() - t0
+            # the fleet must also breathe back DOWN: wait out the
+            # post-burst idle window for at least one retirement
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline and \
+                    ctrl.telemetry.hosts_scaled_down < 1:
+                time.sleep(0.05)
+            snap = ctrl.telemetry.snapshot()
+        bad = [j for j in interactive + batch if j.state != DONE]
+        if bad:
+            raise RuntimeError(
+                f"qos leg: {len(bad)} interactive/batch job(s) not "
+                f"done ({bad[0].state}: {bad[0].error}) — only "
+                "background may shed")
+        lat = np.asarray(sorted(j.latency_s for j in interactive),
+                         dtype=np.float64)
+        p50 = float(np.percentile(lat, 50))
+        p99 = float(np.percentile(lat, 99))
+        meta = replay_fleet(os.path.join(workdir,
+                                         _fleet.JOURNAL_NAME))
+        events = [r["ev"] for r in meta["scale_events"]]
+        shed_bg = sum(1 for j in background if j.state == SHED)
+        return {
+            "qos_n_jobs": len(interactive) + len(batch)
+            + len(background),
+            "qos_slo_target_s": slo_target,
+            "qos_interactive_p50_s": round(p50, 4),
+            "qos_interactive_p99_s": round(p99, 4),
+            # the acceptance gate: p99 against the DISCLOSED target
+            "qos_interactive_slo_met": bool(p99 <= slo_target),
+            "qos_batch_jobs_per_s": round(len(batch) / wall, 2),
+            "qos_shed_background": shed_bg,
+            "qos_shed_above_background": sum(
+                1 for j in interactive + batch if j.state == SHED),
+            "qos_hosts_scaled_up": snap["hosts_scaled_up"],
+            "qos_hosts_scaled_down": snap["hosts_scaled_down"],
+            "qos_journal_scale_up": events.count("scale_up"),
+            "qos_journal_scale_down": events.count("scale_down"),
+            "qos_exactly_once": all(
+                n == 1 for n in meta["finishes"].values()),
+        }
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def serving_accel_leg(u_file, accel_backend: str, tdtype: str,
                       jax) -> dict:
     """Multi-tenant load on the accelerator backend with one SHARED
@@ -1358,6 +1463,22 @@ def main():
           f"{ofed.get('obs_federation_trace_events', 0)} trace "
           f"events)")
     _leg_done("obs federation leg", **ofed)
+
+    # QoS + elasticity sub-leg (docs/RELIABILITY.md §7): a bursty
+    # multi-class wave against an autoscaling fleet — interactive p99
+    # vs its disclosed SLO target, batch absorbing the slack,
+    # background shed by the ladder, hosts scaled up and back down —
+    # host-side, so it survives the outage protocol too
+    qos = qos_serving_leg()
+    _note(f"[bench] qos wave: interactive p99 "
+          f"{qos['qos_interactive_p99_s']}s vs "
+          f"{qos['qos_slo_target_s']}s target "
+          f"(met={qos['qos_interactive_slo_met']}), batch "
+          f"{qos['qos_batch_jobs_per_s']} jobs/s, "
+          f"{qos['qos_shed_background']} background shed, hosts "
+          f"+{qos['qos_hosts_scaled_up']}/"
+          f"-{qos['qos_hosts_scaled_down']}")
+    _leg_done("qos serving leg", **qos)
 
     u_file = open_flagship(N_ATOMS, N_FRAMES)
     src_label = ("file-backed XTC" if SOURCE == "file"

@@ -80,6 +80,8 @@ _TABLE_TYPES = {
     "FLEET_GAUGES": "gauge",
     "FLEET_OBS_COUNTERS": "counter",
     "FLEET_OBS_GAUGES": "gauge",
+    "QOS_COUNTERS": "counter",
+    "QOS_GAUGES": "gauge",
 }
 
 _RECORD_TYPES = {"inc": "counter", "observe": "histogram",

@@ -280,6 +280,26 @@ FLEET_OBS_GAUGES = (
     "mdtpu_fleet_hosts_reporting",
 )
 
+#: QoS + elasticity counters (docs/RELIABILITY.md §7 "Overload and
+#: elasticity"): overload sheds (labeled ``class=``), typed admission
+#: rejects (labeled ``reason=`` — queue_full/rate_limit/tenant_quota),
+#: and the autoscaler's host scale events.  Recorded live at the
+#: scheduler's/controller's incident sites; zero-injected so a process
+#: that never overloaded still carries the schema.
+QOS_COUNTERS = (
+    "mdtpu_jobs_shed_total",
+    "mdtpu_admission_rejects_total",
+    "mdtpu_hosts_scaled_up_total",
+    "mdtpu_hosts_scaled_down_total",
+)
+
+#: QoS gauges: per-class latency-SLO attainment (labeled ``class=`` —
+#: the fraction of completed jobs meeting the configured target,
+#: docs/RELIABILITY.md §7).  0 = no completed jobs in that class yet.
+QOS_GAUGES = (
+    "mdtpu_slo_attainment",
+)
+
 
 def _merge_host_snapshot(snap: dict, hid: str, host_snap: dict) -> None:
     """Fold one host's shipped snapshot into the fleet document (the
@@ -350,10 +370,10 @@ def unified_snapshot(timers=None, cache=None, telemetry=None,
     for name in COMPILE_METRICS + BREAKER_COUNTERS + \
             SUPERVISION_COUNTERS + RELIABILITY_COUNTERS + \
             INTEGRITY_COUNTERS + SCRUB_COUNTERS + STORE_COUNTERS + \
-            FLEET_COUNTERS + FLEET_OBS_COUNTERS:
+            FLEET_COUNTERS + FLEET_OBS_COUNTERS + QOS_COUNTERS:
         snap.setdefault(name, {"type": "counter", "values": {"": 0}})
     for name in BREAKER_GAUGES + LINT_GAUGES + INTEGRITY_GAUGES \
-            + FLEET_GAUGES + FLEET_OBS_GAUGES:
+            + FLEET_GAUGES + FLEET_OBS_GAUGES + QOS_GAUGES:
         # 0 == closed (reliability/breaker.py STATE_VALUES): a process
         # that never tripped a breaker reports the healthy state;
         # likewise 0 lint rules/findings means "never linted here"

@@ -27,9 +27,14 @@ requests instead of re-reading per request.
   :class:`~mdanalysis_mpi_tpu.parallel.executors.DeviceBlockCache`
   run uncached or wait instead of evicting a hot tenant's
   superblocks), per-job reliability integration.
+- :mod:`~mdanalysis_mpi_tpu.service.qos` — tenant QoS classes
+  (interactive/batch/background), the weighted-fair stride scheduler,
+  and the :class:`QosPolicy` admission/overload knobs shared by the
+  scheduler and the fleet controller (docs/RELIABILITY.md §7).
 - :mod:`~mdanalysis_mpi_tpu.service.telemetry` — serving telemetry:
-  queue depth, p50/p99 queue wait and latency, coalesce and cache-hit
-  rates (the bench serving leg's fields).
+  queue depth, p50/p99 queue wait and latency (pooled AND per QoS
+  class, with SLO attainment), coalesce and cache-hit rates (the
+  bench serving leg's fields).
 - :mod:`~mdanalysis_mpi_tpu.service.supervision` — job leases renewed
   by phase-entry heartbeats, zombie-worker fencing, and quarantine
   diagnostics capture (docs/RELIABILITY.md, "Serving supervision").
@@ -51,19 +56,23 @@ See docs/SERVICE.md for the job model and semantics, and
 
 from mdanalysis_mpi_tpu.service.fleet import FleetController, FleetJob
 from mdanalysis_mpi_tpu.service.jobs import (
-    AnalysisJob, JobDeadlineExpired, JobHandle, JobQuarantinedError,
+    AdmissionRejectedError, AnalysisJob, JobDeadlineExpired,
+    JobHandle, JobQuarantinedError, JobRuntimeExceeded, JobShedError,
     JobState, SchedulerShutdownError,
 )
 from mdanalysis_mpi_tpu.service.journal import JobJournal, replay_fleet
 from mdanalysis_mpi_tpu.service.placement import PlacementTable
+from mdanalysis_mpi_tpu.service.qos import QOS_CLASSES, QosPolicy
 from mdanalysis_mpi_tpu.service.scheduler import Scheduler
 from mdanalysis_mpi_tpu.service.telemetry import (
     FleetTelemetry, ServiceTelemetry,
 )
 
 __all__ = [
-    "AnalysisJob", "FleetController", "FleetJob", "FleetTelemetry",
-    "JobDeadlineExpired", "JobHandle", "JobJournal",
-    "JobQuarantinedError", "JobState", "PlacementTable", "Scheduler",
-    "SchedulerShutdownError", "ServiceTelemetry", "replay_fleet",
+    "AdmissionRejectedError", "AnalysisJob", "FleetController",
+    "FleetJob", "FleetTelemetry", "JobDeadlineExpired", "JobHandle",
+    "JobJournal", "JobQuarantinedError", "JobRuntimeExceeded",
+    "JobShedError", "JobState", "PlacementTable", "QOS_CLASSES",
+    "QosPolicy", "Scheduler", "SchedulerShutdownError",
+    "ServiceTelemetry", "replay_fleet",
 ]

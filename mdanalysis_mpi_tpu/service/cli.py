@@ -26,10 +26,27 @@ Job file schema (see docs/SERVICE.md)::
 
 Per-job fields: every ``AnalysisConfig`` knob (``analysis``,
 ``select``, ``start``/``stop``/``step``, ``nbins``, ...) plus the
-serving knobs ``priority``, ``deadline_s``, ``resilient`` (bool),
-``coalesce``, ``tenant``, and ``output`` (per-job ``.npz``).  All jobs
-share ONE Universe, so same-window requests coalesce into one staged
-pass.
+serving knobs ``qos`` (``interactive``/``batch``/``background`` —
+docs/RELIABILITY.md §7), ``priority``, ``deadline_s``, ``resilient``
+(bool), ``coalesce``, ``tenant``, and ``output`` (per-job ``.npz``).
+All jobs share ONE Universe, so same-window requests coalesce into
+one staged pass.
+
+A top-level ``"qos"`` block configures the scheduler's
+:class:`~mdanalysis_mpi_tpu.service.qos.QosPolicy` (weighted-fair
+class weights, per-class SLO targets, bounded submit, per-tenant rate
+limits/quotas, the overload shed ladder, runaway-job caps)::
+
+    {"qos": {"weights": {"interactive": 8, "batch": 3},
+             "slo_targets_s": {"interactive": 2.0},
+             "max_queue_depth": 512,
+             "shed_queue_depth": 256,
+             "shed_classes": ["background"],
+             "max_runtime_s": 3600}, ...}
+
+The output JSON's ``serving.qos`` sub-document breaks completion /
+expiry counts, queue-wait and latency percentiles, and SLO attainment
+out per class.
 """
 
 from __future__ import annotations
@@ -43,7 +60,7 @@ import time
 
 import numpy as np
 
-_JOB_FIELDS = ("priority", "deadline_s", "coalesce", "tenant",
+_JOB_FIELDS = ("qos", "priority", "deadline_s", "coalesce", "tenant",
                "trace_id")
 
 
@@ -284,6 +301,8 @@ def batch_main(argv=None, universe=None) -> int:
     # queue the whole file BEFORE starting workers: same-window
     # requests then coalesce maximally instead of being claimed one by
     # one as they arrive
+    from mdanalysis_mpi_tpu.service.qos import QosPolicy
+
     sched = Scheduler(n_workers=int(spec.get("workers", 1)),
                       cache=cache, autostart=False,
                       prefetch=bool(ns.prefetch),
@@ -291,6 +310,8 @@ def batch_main(argv=None, universe=None) -> int:
                       poison_threshold=int(
                           spec.get("poison_threshold", 2)),
                       supervise=bool(spec.get("supervise", True)),
+                      qos=(QosPolicy.from_spec(spec["qos"])
+                           if spec.get("qos") else None),
                       journal=ns.journal)
     status_addr = None
     if ns.status_port is not None:
@@ -299,15 +320,27 @@ def batch_main(argv=None, universe=None) -> int:
     warmup_stats = None
     if ns.warmup:
         warmup_stats = sched.warmup([j for j, _, _ in jobs])
+    from mdanalysis_mpi_tpu.service.jobs import AdmissionRejectedError
+
     handles = []
-    for job, _cfg, output in jobs:
-        h = sched.submit(job)
+    submitted = []
+    rejected = []
+    for job, cfg, output in jobs:
+        try:
+            h = sched.submit(job)
+        except AdmissionRejectedError as exc:
+            # typed backpressure (docs/RELIABILITY.md §7): the policy
+            # refused THIS submission (queue bound / tenant rate /
+            # quota) — its record says so, the other tenants still run
+            rejected.append((job, cfg, exc))
+            continue
         if output:
             # persist per job, at completion time, BEFORE the journal's
             # finish record: a crash mid-batch then never strands a
             # finished-but-unwritten job (see _output_writer)
             h.add_done_callback(_output_writer(output))
         handles.append(h)
+        submitted.append((job, cfg, output))
     if ns.prefetch:
         # synchronous first pass before workers start: wave-1 claims
         # then ride staged blocks; the background thread covers jobs
@@ -354,9 +387,17 @@ def batch_main(argv=None, universe=None) -> int:
             "tenant": js.get("tenant", "default"), "state": "failed",
             "error": f"{type(exc).__name__}: {exc}"})
         rc = 1
-    for handle, (job, cfg, output) in zip(handles, jobs):
+    for job, cfg, exc in rejected:
+        records.append({
+            "analysis": cfg.analysis, "tenant": job.tenant,
+            "qos": job.qos, "state": "rejected",
+            "reject_reason": exc.reason,
+            "error": f"{type(exc).__name__}: {exc}"})
+        rc = 1
+    for handle, (job, cfg, output) in zip(handles, submitted):
         rec = {"job_id": handle.job_id, "analysis": cfg.analysis,
-               "tenant": job.tenant, "state": handle.state,
+               "tenant": job.tenant, "qos": job.qos,
+               "state": handle.state,
                "coalesced": handle.coalesced,
                "queue_wait_s": (round(handle.queue_wait_s, 4)
                                 if handle.queue_wait_s is not None

@@ -86,8 +86,10 @@ ASSIGNED = "assigned"
 DONE = "done"
 FAILED = "failed"
 QUARANTINED = "quarantined"
+SHED = "shed"          # dropped by the controller's overload shed
+#                        ladder (docs/RELIABILITY.md §7)
 
-_TERMINAL = (DONE, FAILED, QUARANTINED)
+_TERMINAL = (DONE, FAILED, QUARANTINED, SHED)
 
 #: Fleet-only job-spec keys stripped before the host builds the
 #: analysis (everything else is the ``batch`` CLI's job schema).
@@ -144,15 +146,22 @@ def _read_addr_file(workdir: str) -> dict | None:
 class FleetJob:
     """Controller-side record + waitable handle for one fleet job."""
 
-    __slots__ = ("fp", "spec", "tenant", "state", "host",
+    __slots__ = ("fp", "spec", "tenant", "qos", "state", "host",
                  "assign_seq", "assign_epoch", "results", "error",
                  "migrations", "resident", "parent", "children",
-                 "shard_index", "_event")
+                 "shard_index", "submit_t", "done_t", "_event")
 
     def __init__(self, fp: str, spec: dict, tenant: str):
+        from mdanalysis_mpi_tpu.service.qos import validate_qos
+
         self.fp = fp
         self.spec = spec
         self.tenant = tenant
+        #: tenant QoS class (docs/RELIABILITY.md §7): weighted-fair
+        #: dispatch ordering across classes, shed eligibility under
+        #: overload.  Validated here so a typo'd class fails the
+        #: submission, not the audit.
+        self.qos = validate_qos(spec.get("qos"))
         self.state = QUEUED
         self.host: str | None = None
         self.assign_seq: int | None = None
@@ -164,7 +173,27 @@ class FleetJob:
         self.parent: FleetJob | None = None
         self.children: list[FleetJob] | None = None
         self.shard_index: int | None = None
+        #: submission/settle wall stamps (time.monotonic) — the
+        #: per-class latency the QoS bench leg reads off the
+        #: controller without a round trip per job
+        self.submit_t: float | None = None
+        self.done_t: float | None = None
         self._event = threading.Event()
+
+    def _settle(self) -> None:
+        """Mark terminal: stamp the completion time once, wake
+        waiters.  Every path that ends a job (apply, quarantine,
+        shed, merge) funnels here so latency accounting cannot
+        drift."""
+        if self.done_t is None:
+            self.done_t = time.monotonic()
+        self._event.set()
+
+    @property
+    def latency_s(self) -> float | None:
+        if self.submit_t is None or self.done_t is None:
+            return None
+        return self.done_t - self.submit_t
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -230,6 +259,36 @@ class FleetController:
         Replace a lost spawned host with a fresh process (capacity
         recovery).  Default False: placement DEGRADES to the
         survivors, which is the behavior the chaos suite pins.
+    ``host_slots``
+        Max jobs assigned-and-unfinished per host at once (None =
+        unbounded, the pre-QoS behavior).  With slots, surplus work
+        stays PENDING at the controller — which is what makes the
+        queue-depth overload signal, the shed ladder, and the
+        autoscaler's backlog signal real (an instantly-drained
+        controller queue can never look overloaded).
+    ``qos``
+        A :class:`~mdanalysis_mpi_tpu.service.qos.QosPolicy`
+        (docs/RELIABILITY.md §7): weighted-fair dispatch ordering of
+        the pending queue across tenant QoS classes, and the
+        controller-tier shed ladder (``shed_queue_depth`` /
+        ``shed_classes`` — lowest class first, journaled terminal
+        ``shed`` records, counted ``mdtpu_jobs_shed_total{class=}``).
+    ``autoscale`` / ``min_hosts`` / ``max_hosts`` /
+    ``scale_up_backlog`` / ``scale_down_idle_s`` /
+    ``scale_cooldown_s`` / ``retire_drain_s`` / ``autoscale_spawn``
+        Fleet elasticity (docs/RELIABILITY.md §7 "Autoscale state
+        machine"): the supervisor spawns a ``fleet-host`` when the
+        pending backlog reaches ``scale_up_backlog`` with every slot
+        in use (up to ``max_hosts``), and retires one — drain-first:
+        no new assignments, resident tenants re-place minimally, any
+        job still in flight after ``retire_drain_s`` migrates via the
+        journal-level exactly-once path — after ``scale_down_idle_s``
+        of empty backlog (down to ``min_hosts``).  Scale events are
+        journaled (``scale_up``/``scale_down``, epoch-stamped so a
+        zombie's are fenced) and counted
+        ``mdtpu_hosts_scaled_{up,down}_total``.  ``autoscale_spawn``
+        is the kwargs dict :meth:`spawn_host` gets for autoscaled
+        hosts (backend, cache_mb, env, ...).
     """
 
     def __init__(self, workdir, epoch: int = 1, host_ttl_s: float = 3.0,
@@ -239,7 +298,36 @@ class FleetController:
                  bind_host: str = "127.0.0.1", clock=time.monotonic,
                  status: bool = True, trace: bool | None = None,
                  obs_interval_s: float = 0.5,
+                 host_slots: int | None = None, qos=None,
+                 autoscale: bool = False, min_hosts: int = 1,
+                 max_hosts: int = 4, scale_up_backlog: int = 1,
+                 scale_down_idle_s: float = 3.0,
+                 scale_cooldown_s: float = 1.0,
+                 retire_drain_s: float = 10.0,
+                 autoscale_spawn: dict | None = None,
                  _recovered: dict | None = None):
+        from mdanalysis_mpi_tpu.service import qos as _qosmod
+
+        # ---- QoS + elasticity policy (docs/RELIABILITY.md §7) ----
+        self.host_slots = (None if host_slots is None
+                           else max(1, int(host_slots)))
+        self.qos = qos or _qosmod.QosPolicy()
+        self._stride = _qosmod.StrideScheduler(self.qos.weights)
+        self.autoscale = bool(autoscale)
+        self.min_hosts = max(0, int(min_hosts))
+        self.max_hosts = max(self.min_hosts, int(max_hosts))
+        self.scale_up_backlog = max(1, int(scale_up_backlog))
+        self.scale_down_idle_s = float(scale_down_idle_s)
+        self.scale_cooldown_s = float(scale_cooldown_s)
+        self.retire_drain_s = float(retire_drain_s)
+        self.autoscale_spawn = dict(autoscale_spawn or {})
+        self._scale_last = float("-inf")
+        self._idle_since: float | None = None
+        #: hosts mid-retirement: hid -> drain deadline.  A retiring
+        #: host takes no new assignments (it left placement) but
+        #: finishes what it holds; past the deadline the leftovers
+        #: migrate and the host is stopped anyway.
+        self._retiring: dict[str, float] = {}
         self.workdir = str(workdir)
         os.makedirs(self.workdir, exist_ok=True)
         self.epoch = int(epoch)
@@ -572,7 +660,7 @@ class FleetController:
                 "quarantine", job.fp,
                 reason="poison_migrations:host_replaced", durable=True)
             obs.METRICS.inc("mdtpu_jobs_quarantined_total")
-            job._event.set()
+            job._settle()
             if job.parent is not None:
                 self._merge_parent(job.parent)
         self.telemetry.count("hosts_rejoined" if rejoin
@@ -772,6 +860,7 @@ class FleetController:
                                f"#{self._job_seq}")
             self._job_seq += 1
             job = FleetJob(fingerprint, spec, tenant)
+            job.submit_t = time.monotonic()
             if shards > 1:
                 self._register_sharded_locked(job, shards)
                 dispatchable = job.children
@@ -786,7 +875,7 @@ class FleetController:
                 self._jobs[fingerprint] = job
                 dispatchable = [job]
         if shards > 1 and not dispatchable:
-            job._event.set()
+            job._settle()
             return job
         # journal the spec-bearing submit record BEFORE the job
         # becomes dispatchable: the supervisor tick can assign within
@@ -794,6 +883,7 @@ class FleetController:
         # `submit` would leave adopt() a claimed job with no spec —
         # unrecoverable work despite the journal contract
         for d in dispatchable:
+            d.submit_t = job.submit_t
             self.telemetry.count("jobs_submitted")
             self.journal.record("submit", d.fp, tenant=d.tenant,
                                 spec=d.spec)
@@ -801,6 +891,10 @@ class FleetController:
             for d in dispatchable:
                 self._pending.append(d.fp)
         self._dispatch()
+        # overload check after the enqueue (docs/RELIABILITY.md §7):
+        # a burst that outran every host slot sheds the lowest
+        # sheddable class NOW, not a supervisor tick later
+        self._shed_pending()
         return job
 
     def _register_sharded_locked(self, parent: FleetJob,
@@ -838,16 +932,55 @@ class FleetController:
         for child in parent.children:
             self._jobs[child.fp] = child
 
+    def _ordered_pending_locked(self) -> list[str]:
+        """The pending queue in weighted-fair class order
+        (docs/RELIABILITY.md §7): stride-pick a class, take its FIFO
+        head, repeat — so an interactive backlog is dispatched ~its
+        weight-share ahead of batch/background without ever starving
+        them.  One class present → plain FIFO, the pre-QoS order."""
+        by_class: dict[str, list[str]] = {}
+        for fp in self._pending:
+            job = self._jobs.get(fp)
+            qos_cls = job.qos if job is not None else "batch"
+            by_class.setdefault(qos_cls, []).append(fp)
+        if len(by_class) <= 1:
+            return list(self._pending)
+        ordered: list[str] = []
+        while True:
+            candidates = sorted(c for c, fps in by_class.items()
+                                if fps)
+            if not candidates:
+                return ordered
+            cls = self._stride.pick(candidates)
+            ordered.append(by_class[cls].pop(0))
+
+    def _slots_free_locked(self, host: "_Host") -> bool:
+        return (self.host_slots is None
+                or len(host.inflight) < self.host_slots)
+
     def _dispatch(self) -> None:
-        """Assign every pending job to its tenant's home host (sticky
-        placement).  Socket sends and journal records run OUTSIDE the
-        lock; a failed send loses the host (which re-pends the job)."""
+        """Assign pending jobs to their tenants' home hosts (sticky
+        placement), weighted-fair across QoS classes, bounded by
+        ``host_slots``.  Socket sends and journal records run OUTSIDE
+        the lock; a failed send loses the host (which re-pends the
+        job)."""
         if self._wedged:
             return
         sends = []
         with self._lock:
+            if self.host_slots is not None and self._pending:
+                free = sum(
+                    max(0, self.host_slots - len(h.inflight))
+                    for h in self._hosts.values()
+                    if h.alive and h.hid not in self._retiring)
+                if free == 0:
+                    # every slot busy: nothing can place, so skip the
+                    # O(pending) weighted-fair reorder entirely — a
+                    # standing backlog must not pay it (and distort
+                    # the stride passes) on every completion ack
+                    return
             still = []
-            for fp in self._pending:
+            for fp in self._ordered_pending_locked():
                 job = self._jobs.get(fp)
                 if job is None or job.state in _TERMINAL:
                     continue
@@ -859,8 +992,13 @@ class FleetController:
                        else f"{job.tenant}#s{job.shard_index}")
                 hid = self.placement.assign(key)
                 host = self._hosts.get(hid) if hid else None
-                if host is None or not host.alive:
-                    still.append(fp)     # degraded to zero hosts: park
+                if host is None or not host.alive \
+                        or not self._slots_free_locked(host):
+                    # degraded to zero hosts, or the sticky home is at
+                    # its slot cap: park — the backlog this creates is
+                    # exactly the autoscaler's and the shed ladder's
+                    # input signal
+                    still.append(fp)
                     continue
                 self._assign_seq += 1
                 job.state = ASSIGNED
@@ -961,7 +1099,7 @@ class FleetController:
         self.breakers.get(hid, mesh="fleet").record_success()
         if host is not None:
             _send_line(host.sock, host.send_lock, ack)
-        job._event.set()
+        job._settle()
         if job.parent is not None:
             self._merge_parent(job.parent)
         self._dispatch()
@@ -1017,7 +1155,7 @@ class FleetController:
                 else:
                     parent.state = DONE
                     parent.results = merged
-        parent._event.set()
+        parent._settle()
 
     # ---- host loss / migration ----
 
@@ -1030,6 +1168,8 @@ class FleetController:
                 # fleet — migration is the adopting standby's job
                 return
             host.alive = False
+            self._retiring.pop(hid, None)   # a killed retiring host
+            #                                 is a LOSS, not a retire
             self.placement.remove_host(hid)
             migrate, quarantine = [], []
             for fp in sorted(host.inflight):
@@ -1086,7 +1226,7 @@ class FleetController:
                                 reason=f"poison_migrations:{reason}",
                                 durable=True)
             obs.METRICS.inc("mdtpu_jobs_quarantined_total")
-            job._event.set()
+            job._settle()
             if job.parent is not None:
                 # a quarantined shard is its parent's LAST terminal
                 # child as far as _apply_done is concerned — without
@@ -1095,6 +1235,215 @@ class FleetController:
         if self.respawn_hosts and not self._shutdown:
             self.spawn_host()
         self._dispatch()
+
+    # ---- overload shedding (docs/RELIABILITY.md §7) ----
+
+    def _shed_pending(self) -> list[FleetJob]:
+        """One controller-tier shed pass: when the PENDING backlog
+        (jobs no host slot could take) exceeds
+        ``QosPolicy.shed_queue_depth``, drop the lowest sheddable
+        class first — newest first within a class, never a class
+        outside ``shed_classes`` — each with a journaled terminal
+        ``shed`` record (exactly-once ledger entry) and the
+        ``mdtpu_jobs_shed_total{class=}`` counter.  Journal writes
+        run OUTSIDE the lock."""
+        p = self.qos
+        if p.shed_queue_depth is None:
+            return []
+        sheds: list[FleetJob] = []
+        with self._lock:
+            if self._wedged or self._shutdown:
+                return []
+            depth = len(self._pending)
+            if depth <= p.shed_queue_depth:
+                return []
+            # capacity predicate (the fleet twin of the scheduler's
+            # _overloaded_locked): depth with ZERO alive hosts is the
+            # degraded-to-zero rung — the placement ladder PARKS
+            # there, never sheds — and depth with a free slot
+            # anywhere (or no slot bound at all) is a dispatch in
+            # flight, not overload.  Only a backlog every alive host
+            # slot cannot absorb is policy-sheddable.
+            alive = [h for h in self._hosts.values()
+                     if h.alive and h.hid not in self._retiring]
+            if not alive or self.host_slots is None or any(
+                    len(h.inflight) < self.host_slots
+                    for h in alive):
+                return []
+            for qos_cls in p.shed_ladder():
+                for fp in list(reversed(self._pending)):
+                    if len(self._pending) <= p.shed_queue_depth:
+                        break
+                    job = self._jobs.get(fp)
+                    if job is None or job.state in _TERMINAL \
+                            or job.qos != qos_cls:
+                        continue
+                    self._pending.remove(fp)
+                    job.state = SHED
+                    job.error = (
+                        f"shed by the overload controller (class "
+                        f"{qos_cls}: backlog {depth} > "
+                        f"{p.shed_queue_depth}); resubmit once the "
+                        "burst passes")
+                    sheds.append(job)
+        for job in sheds:
+            self.telemetry.count("jobs_shed")
+            obs.METRICS.inc("mdtpu_jobs_shed_total",
+                            **{"class": job.qos})
+            obs.span_event("job_shed", fp=job.fp, tenant=job.tenant,
+                           qos=job.qos)
+            # terminal record, durable: the exactly-once audit counts
+            # sheds like any other settled outcome, and a recovering
+            # controller must not re-own a job the policy dropped
+            self.journal.record("finish", job.fp, state=SHED,
+                                durable=True)
+            job._settle()
+            if job.parent is not None:
+                self._merge_parent(job.parent)
+        if sheds:
+            self._log.warning(
+                "overload: shed %d pending job(s) (classes %s) — "
+                "backlog over %d with every host slot in use",
+                len(sheds), sorted({j.qos for j in sheds}),
+                p.shed_queue_depth)
+        return sheds
+
+    # ---- autoscaling (docs/RELIABILITY.md §7) ----
+
+    def _autoscale_tick(self, now: float) -> None:
+        """One autoscaler pass, from signals the controller already
+        owns: the pending backlog (jobs no host slot could take —
+        the queue-depth signal) and per-host slot occupancy (the
+        lease-utilization signal).  Scale-up spawns; scale-down is
+        DRAIN-FIRST retirement (see :meth:`_retire_host`)."""
+        if not self.autoscale or self._shutdown or self._wedged:
+            return
+        spawn = False
+        retire_hid = None
+        finish = []
+        with self._lock:
+            alive = [h for h in self._hosts.values()
+                     if h.alive and h.hid not in self._retiring]
+            pending = len(self._pending)
+            # spawned-but-not-yet-joined children count as capacity
+            # in flight, or one burst would spawn max_hosts at once
+            joining = sum(
+                1 for pr in self._procs
+                if pr.poll() is None
+                and getattr(pr, "_mdtpu_host_id", None)
+                not in self._hosts)
+            # drain-finished (or drain-expired) retirements
+            for hid, deadline in list(self._retiring.items()):
+                host = self._hosts.get(hid)
+                if host is None or not host.alive:
+                    self._retiring.pop(hid, None)
+                    continue
+                if not host.inflight or now >= deadline:
+                    finish.append(hid)
+            if pending > 0:
+                self._idle_since = None
+            elif self._idle_since is None:
+                self._idle_since = now
+            cooled = now - self._scale_last >= self.scale_cooldown_s
+            if (pending >= self.scale_up_backlog
+                    and len(alive) + joining < self.max_hosts
+                    and cooled):
+                spawn = True
+                self._scale_last = now
+            elif (pending == 0 and not self._retiring and cooled
+                  and len(alive) > self.min_hosts
+                  and self._idle_since is not None
+                  and now - self._idle_since >= self.scale_down_idle_s):
+                # retire the emptiest host: fewest in-flight jobs →
+                # fewest tenants disturbed, shortest drain
+                retire_hid = min(alive,
+                                 key=lambda h: (len(h.inflight),
+                                                h.hid)).hid
+                self._scale_last = now
+        for hid in finish:
+            self._finish_retire(hid)
+        if spawn:
+            proc = self.spawn_host(**self.autoscale_spawn)
+            hid = proc._mdtpu_host_id
+            self.telemetry.count("hosts_scaled_up")
+            obs.METRICS.inc("mdtpu_hosts_scaled_up_total")
+            obs.span_event("host_scaled_up", host=hid,
+                           pending=pending)
+            self.journal.record("scale_up", None, host=hid,
+                                pending=pending)
+            self._log.warning(
+                "autoscale: spawned %s (backlog %d over %d host(s))",
+                hid, pending, len(alive))
+        elif retire_hid is not None:
+            self._retire_host(retire_hid)
+
+    def _retire_host(self, hid: str) -> None:
+        """Begin drain-first retirement: the host leaves placement NOW
+        (new work re-derives homes minimally — only ITS tenants move,
+        the rendezvous property), takes no new assignments, and keeps
+        running what it holds until empty or ``retire_drain_s``
+        expires."""
+        with self._lock:
+            host = self._hosts.get(hid)
+            if host is None or not host.alive \
+                    or hid in self._retiring:
+                return
+            self._retiring[hid] = self._clock() + self.retire_drain_s
+            self.placement.remove_host(hid)
+            inflight = len(host.inflight)
+        obs.span_event("host_retiring", host=hid, inflight=inflight)
+        self._log.warning(
+            "autoscale: retiring %s drain-first (%d job(s) still "
+            "in flight)", hid, inflight)
+
+    def _finish_retire(self, hid: str) -> None:
+        """Complete a retirement: migrate whatever the drain deadline
+        left in flight (the PR-10 journal-level exactly-once path —
+        requeue records, new assignment tokens, so the stopping
+        host's late completions fence out), stop the host process,
+        and journal the epoch-stamped ``scale_down`` record."""
+        migrate = []
+        with self._lock:
+            host = self._hosts.get(hid)
+            self._retiring.pop(hid, None)
+            if host is None or not host.alive:
+                return
+            host.alive = False     # before the stop: the socket EOF
+            #                        path must not double-lose it
+            for fp in sorted(host.inflight):
+                job = self._jobs.get(fp)
+                if job is None or job.state in _TERMINAL:
+                    continue
+                job.migrations += 1
+                job.state = QUEUED
+                job.host = None
+                job.assign_seq = None
+                job.assign_epoch = None
+                migrate.append(job)
+                self._pending.append(fp)
+            host.inflight.clear()
+            n_alive = sum(1 for h in self._hosts.values() if h.alive)
+        for job in migrate:
+            self.telemetry.count("jobs_migrated")
+            obs.METRICS.inc("mdtpu_jobs_migrated_total")
+            obs.span_event("job_migrated", host=hid, fp=job.fp,
+                           tenant=job.tenant)
+            self.journal.record("requeue", job.fp, from_host=hid,
+                                reason="scale_down")
+        _send_line(host.sock, host.send_lock,
+                   {"cmd": "stop", "epoch": self.epoch})
+        self.telemetry.count("hosts_scaled_down")
+        obs.METRICS.inc("mdtpu_hosts_scaled_down_total")
+        obs.METRICS.set_gauge("mdtpu_hosts_alive", n_alive)
+        obs.span_event("host_scaled_down", host=hid,
+                       migrated=len(migrate))
+        self.journal.record("scale_down", None, host=hid,
+                            migrated=len(migrate))
+        self._log.warning(
+            "autoscale: retired %s (%d alive, %d job(s) migrated "
+            "at the drain deadline)", hid, n_alive, len(migrate))
+        if migrate:
+            self._dispatch()
 
     # ---- supervisor ----
 
@@ -1122,6 +1471,10 @@ class FleetController:
                 if hid is not None:
                     self._lose_host(hid, "host_death")
             self._dispatch()
+            # QoS + elasticity ticks (docs/RELIABILITY.md §7): shed
+            # what capacity cannot absorb, then breathe the host set
+            self._shed_pending()
+            self._autoscale_tick(now)
 
     # ---- lifecycle ----
 
@@ -1184,6 +1537,12 @@ class FleetController:
                 for h in self._hosts.values()}
             jobs = list(self._jobs.values())
             pending = len(self._pending)
+            by_class: dict = {}
+            for fp in self._pending:
+                j = self._jobs.get(fp)
+                if j is not None:
+                    by_class[j.qos] = by_class.get(j.qos, 0) + 1
+            retiring = sorted(self._retiring)
             wedged = self._wedged
         out = {
             "role": "fleet-controller",
@@ -1192,6 +1551,9 @@ class FleetController:
             "workdir": self.workdir,
             "addr": f"{self.address[0]}:{self.address[1]}",
             "queue_depth": pending,
+            "queue_depth_by_class": by_class,
+            "autoscale": self.autoscale,
+            "hosts_retiring": retiring,
             "hosts_alive": sum(1 for h in hosts.values()
                                if h["alive"]),
             "hosts_reporting": len(self._host_metrics),
@@ -1699,6 +2061,97 @@ def host_main(argv=None) -> int:
 # dryrun smoke (scripts/verify.sh) + fleet CLI
 # ---------------------------------------------------------------------------
 
+def qos_elasticity_smoke(workdir) -> dict:
+    """The QoS + elasticity half of the dryrun smoke
+    (docs/RELIABILITY.md §7): ONE host with one slot, autoscale up to
+    3, a mixed-class burst whose background tail exceeds the shed
+    depth.  Asserbable outcomes: the backlog scales hosts UP, the
+    post-burst idle retires them back DOWN (drain-first), both as
+    epoch-stamped journaled ``scale_up``/``scale_down`` records;
+    background jobs shed with journaled terminal ``shed`` records
+    while every interactive/batch job completes.  Returns the phase's
+    fields for the smoke record."""
+    from mdanalysis_mpi_tpu.service.journal import replay_fleet as _rf
+    from mdanalysis_mpi_tpu.service.qos import QosPolicy
+
+    out: dict = {}
+    policy = QosPolicy(shed_queue_depth=4,
+                       shed_classes=("background",))
+    with FleetController(
+            workdir, host_ttl_s=5.0, host_slots=1, qos=policy,
+            autoscale=True, min_hosts=1, max_hosts=3,
+            scale_up_backlog=2, scale_down_idle_s=0.4,
+            scale_cooldown_s=0.2, retire_drain_s=5.0,
+            autoscale_spawn={"hb_interval_s": 0.1,
+                             "env": {"MDTPU_FLEET_RUN_DELAY": "0.3"}},
+            status=False) as ctrl:
+        ctrl.spawn_host(hb_interval_s=0.1,
+                        env={"MDTPU_FLEET_RUN_DELAY": "0.3"})
+        if not ctrl.wait_hosts(1, timeout=60.0):
+            out["error"] = "qos phase: first host never joined"
+            return out
+        fixture = {"kind": "protein", "n_residues": 6, "n_frames": 8,
+                   "noise": 0.2, "seed": 7}
+        jobs = []
+        # the burst: interactive + batch fill the slots and the
+        # backlog (scale-up signal); the background tail pushes the
+        # pending depth past shed_queue_depth=4 → the ladder drops
+        # background ONLY, newest first
+        for i in range(2):
+            jobs.append(ctrl.submit({"analysis": "rmsf",
+                                     "fixture": fixture,
+                                     "tenant": f"qi{i}",
+                                     "qos": "interactive"}))
+        for i in range(4):
+            jobs.append(ctrl.submit({"analysis": "rmsf",
+                                     "fixture": fixture,
+                                     "tenant": f"qb{i}",
+                                     "qos": "batch"}))
+        for i in range(6):
+            jobs.append(ctrl.submit({"analysis": "rmsf",
+                                     "fixture": fixture,
+                                     "tenant": f"qg{i}",
+                                     "qos": "background"}))
+        if not ctrl.drain(timeout=120.0):
+            out["error"] = "qos phase: drain timed out"
+            return out
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline and \
+                ctrl.telemetry.hosts_scaled_down < 1:
+            time.sleep(0.05)
+        snap = ctrl.telemetry.snapshot()
+        out["qos_scaled_up"] = snap["hosts_scaled_up"]
+        out["qos_scaled_down"] = snap["hosts_scaled_down"]
+        out["qos_shed"] = snap["jobs_shed"]
+        out["qos_shed_fps"] = [j.fp for j in jobs if j.state == SHED]
+        out["qos_shed_above_background"] = sum(
+            1 for j in jobs
+            if j.state == SHED and j.qos != "background")
+        out["qos_unserved"] = [
+            j.fp for j in jobs
+            if j.qos != "background" and j.state != DONE]
+    meta = _rf(os.path.join(str(workdir), JOURNAL_NAME))
+    events = [r["ev"] for r in meta["scale_events"]]
+    out["qos_journal_scale_up"] = events.count("scale_up")
+    out["qos_journal_scale_down"] = events.count("scale_down")
+    out["qos_journal_shed_records"] = sum(
+        1 for fp, rec in meta["jobs"].items()
+        if rec["state"] == "shed")
+    out["qos_exactly_once"] = all(
+        n == 1 for n in meta["finishes"].values())
+    out["qos_ok"] = (
+        out["qos_scaled_up"] >= 1
+        and out["qos_scaled_down"] >= 1
+        and out["qos_journal_scale_up"] >= 1
+        and out["qos_journal_scale_down"] >= 1
+        and out["qos_shed"] >= 1
+        and out["qos_journal_shed_records"] == len(out["qos_shed_fps"])
+        and out["qos_shed_above_background"] == 0
+        and not out["qos_unserved"]
+        and out["qos_exactly_once"])
+    return out
+
+
 def fleet_smoke(workdir=None, n_hosts: int = 2,
                 kill_mid_wave: bool = True) -> dict:
     """The dryrun serving leg at smoke scale: K tenants across
@@ -1708,9 +2161,13 @@ def fleet_smoke(workdir=None, n_hosts: int = 2,
     trace shows distinct per-host pids and the migrated job's single
     stitched ``trace_id`` on both, the ``/metrics`` scrape's
     fleet-summed completion counter equals the journal's exactly-once
-    ledger, and the lost host left a flight-recorder dump.  Returns
-    the outcome record (``ok`` + the controller stats); raises nothing
-    — failures land in the record so the caller can print-and-exit."""
+    ledger, and the lost host left a flight-recorder dump — PLUS the
+    QoS/elasticity phase (:func:`qos_elasticity_smoke`, its own
+    controller + journal in a sub-workdir): journaled
+    scale-up/scale-down events and shed records, zero sheds above the
+    configured class.  Returns the outcome record (``ok`` + the
+    controller stats); raises nothing — failures land in the record so
+    the caller can print-and-exit."""
     import glob as _glob
     import shutil
     import tempfile
@@ -1852,10 +2309,16 @@ def fleet_smoke(workdir=None, n_hosts: int = 2,
         record["federation_match"] = (
             record["fleet_jobs_completed"] == len(jobs)
             and record.get("scrape_jobs_completed") == len(jobs))
+        # ---- QoS + elasticity phase (docs/RELIABILITY.md §7): its
+        #      own controller + journal in a sub-workdir, so the main
+        #      wave's exactly-once ledger stays unambiguous ----
+        record.update(qos_elasticity_smoke(
+            os.path.join(workdir, "qos")))
         record["ok"] = (record["jobs_done"] == len(jobs)
                         and record["exactly_once"]
                         and record["federation_match"]
                         and record["trace_pids"] >= n_hosts
+                        and record.get("qos_ok", False)
                         and (not kill_mid_wave
                              or (record["jobs_migrated"] >= 1
                                  and stitched is not None

@@ -42,10 +42,55 @@ class JobState:
     #                              many times — parked with diagnostics
     ABORTED = "aborted"        # scheduler shut down / drained before a
     #                            worker could claim it
+    SHED = "shed"              # dropped by the overload controller
+    #                            (lowest QoS class first — policy, not
+    #                            accident; docs/RELIABILITY.md §7)
 
 
 class JobDeadlineExpired(RuntimeError):
     """The job's ``deadline_s`` elapsed while it was still queued."""
+
+
+class JobShedError(RuntimeError):
+    """The overload controller dropped this job (state ``shed``):
+    queue depth outran capacity while every worker/host was saturated,
+    and this job's QoS class is in the configured shed set
+    (docs/RELIABILITY.md §7 "Overload and elasticity").  Degradation
+    under overload is POLICY, not accident: the shed is typed here,
+    journaled as a terminal record, and counted
+    ``mdtpu_jobs_shed_total{class=}`` — a caller that sees this error
+    may resubmit once the burst passes (a ``--journal`` restart
+    re-runs shed jobs; they are not settled)."""
+
+    def __init__(self, message, qos: str = "background"):
+        super().__init__(message)
+        self.qos = qos
+
+
+class AdmissionRejectedError(RuntimeError):
+    """``submit()`` refused this job at the door (docs/RELIABILITY.md
+    §7 "Backpressure contract"): the queue bound, the tenant's rate
+    limit, or the tenant's inflight quota would be exceeded.  The job
+    was NEVER queued — no handle state, no journal record, no
+    namespace pin — so the caller can retry/back off without cleanup.
+    ``reason`` is one of ``queue_full`` / ``rate_limit`` /
+    ``tenant_quota`` (the ``mdtpu_admission_rejects_total{reason=}``
+    label)."""
+
+    def __init__(self, message, reason: str):
+        super().__init__(message)
+        self.reason = reason
+
+
+class JobRuntimeExceeded(RuntimeError):
+    """The job outran its lease-renewal/runtime cap
+    (``QosPolicy.max_lease_renewals`` / ``max_runtime_s``,
+    docs/RELIABILITY.md §7): a run that keeps renewing its lease via
+    phase-entry heartbeats would otherwise pin its worker — and, on a
+    fleet, its host and cache — forever.  Past the cap the lease stops
+    renewing, the supervisor reaps it, the wedged worker is fenced and
+    written off, and the job fails HERE instead of being requeued
+    (re-running a runaway is the same runaway)."""
 
 
 class SchedulerShutdownError(RuntimeError):
@@ -87,8 +132,20 @@ class AnalysisJob:
     ``backend`` / ``batch_size`` / ``executor_kwargs``
         Execution geometry, as ``run()`` takes it.  Also part of the
         coalesce key.
+    ``qos``
+        Tenant QoS class — ``"interactive"`` / ``"batch"`` (default) /
+        ``"background"`` (:data:`~mdanalysis_mpi_tpu.service.qos.
+        QOS_CLASSES`).  Claim ordering is weighted-fair ACROSS classes
+        (stride scheduling over ``QosPolicy.weights`` — no class with
+        queued work starves); under overload the shed ladder drops the
+        lowest sheddable class first and never touches classes outside
+        it (docs/RELIABILITY.md §7).  Deliberately NOT part of the
+        coalesce key: two tenants asking the same question at
+        different urgencies still share one staged pass (the pass runs
+        at the earliest claim among them).
     ``priority``
-        Higher runs earlier; ties break FIFO (submission order).
+        Higher runs earlier *within a QoS class*; ties break FIFO
+        (submission order).
     ``deadline_s``
         Soft QUEUE deadline in seconds from submission: a job still
         queued when it expires fails with :class:`JobDeadlineExpired`
@@ -139,6 +196,7 @@ class AnalysisJob:
     backend: str = "serial"
     batch_size: int | None = None
     executor_kwargs: dict = dataclasses.field(default_factory=dict)
+    qos: str = "batch"
     priority: int = 0
     deadline_s: float | None = None
     resilient: object = False
@@ -158,6 +216,11 @@ class AnalysisJob:
         # (dataclasses.astuple crash) and kill the claim
         if not isinstance(self.resilient, ReliabilityPolicy):
             self.resilient = bool(self.resilient)
+        # a typo'd class must fail the CONSTRUCTION, not silently ride
+        # the default weights until the shed ledger is audited
+        from mdanalysis_mpi_tpu.service.qos import validate_qos
+
+        self.qos = validate_qos(self.qos)
 
     def window_kwargs(self) -> dict:
         return dict(start=self.start, stop=self.stop, step=self.step,

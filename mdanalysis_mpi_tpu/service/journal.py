@@ -51,13 +51,16 @@ from mdanalysis_mpi_tpu.utils.log import get_logger
 #: Every terminal journal state a ``finish``/``quarantine`` record can
 #: carry.
 TERMINAL_STATES = ("done", "quarantined", "failed", "expired",
-                   "aborted")
+                   "aborted", "shed")
 
 #: Terminal states a recovering ``batch --journal`` process does NOT
 #: resubmit: the job ran to a settled verdict (its output is on disk,
 #: or it failed/expired deterministically, or it was quarantined as
 #: poison).  ``aborted`` is deliberately absent — an operator's ^C
-#: aborts the queue, and the re-run must run those jobs
+#: aborts the queue, and the re-run must run those jobs — and so is
+#: ``shed`` (docs/RELIABILITY.md §7): a shed is the overload
+#: controller's answer to a transient burst, and the restarted
+#: process must re-run the job now that the burst has passed
 #: (service/cli.py consumes this).
 SETTLED_STATES = ("done", "quarantined", "failed", "expired")
 
@@ -298,14 +301,19 @@ def replay_fleet(path) -> dict:
     the replayed job state.
 
     Returns ``{"jobs": {fp: record}, "epoch": last adopted epoch,
-    "stale_records": zombie appends rejected, "finishes": {fp: n}}``
-    — ``finishes`` counts ACCEPTED terminal records per job, the
-    exactly-once ledger the chaos tests audit.  Epoch-less records
+    "stale_records": zombie appends rejected, "finishes": {fp: n},
+    "scale_events": [record, ...]}`` — ``finishes`` counts ACCEPTED
+    terminal records per job, the exactly-once ledger the chaos tests
+    audit, and ``scale_events`` are the accepted (epoch-current)
+    ``scale_up``/``scale_down`` records the autoscaler journaled
+    (docs/RELIABILITY.md §7) — a zombie controller's scale records
+    are fenced exactly like its job records.  Epoch-less records
     (a pre-fleet journal) are treated as epoch 0: always current
     until the first ``epoch`` record appears.
     """
     jobs: dict = {}
     finishes: dict = {}
+    scale_events: list = []
     current = 0
     stale = 0
     for rec in _verified_records(path):
@@ -318,6 +326,9 @@ def replay_fleet(path) -> dict:
             continue
         if e is not None and e < current:
             stale += 1
+            continue
+        if rec.get("ev") in ("scale_up", "scale_down"):
+            scale_events.append(rec)
             continue
         _fold_record(jobs, rec)
         if rec.get("ev") == "submit" and rec.get("fp") in jobs:
@@ -335,4 +346,4 @@ def replay_fleet(path) -> dict:
             "epochs (< %d) — a zombie controller kept writing after "
             "adoption", path, stale, current)
     return {"jobs": jobs, "epoch": current, "stale_records": stale,
-            "finishes": finishes}
+            "finishes": finishes, "scale_events": scale_events}

@@ -68,9 +68,11 @@ from mdanalysis_mpi_tpu.reliability import breaker as _breaker
 from mdanalysis_mpi_tpu.reliability import faults as _faults
 from mdanalysis_mpi_tpu.service import coalesce as _coalesce
 from mdanalysis_mpi_tpu.service import journal as _journal
+from mdanalysis_mpi_tpu.service import qos as _qos
 from mdanalysis_mpi_tpu.service import supervision as _supervision
 from mdanalysis_mpi_tpu.service.jobs import (
-    AnalysisJob, JobDeadlineExpired, JobHandle, JobQuarantinedError,
+    AdmissionRejectedError, AnalysisJob, JobDeadlineExpired,
+    JobHandle, JobQuarantinedError, JobRuntimeExceeded, JobShedError,
     JobState, SchedulerShutdownError,
 )
 from mdanalysis_mpi_tpu.service.telemetry import ServiceTelemetry
@@ -150,6 +152,16 @@ class Scheduler:
         docs/OBSERVABILITY.md) dumps its black box on quarantine and
         worker fencing.  Default: ``MDTPU_FLIGHT_DIR``, else beside a
         path-backed ``journal``, else off.
+    ``qos``
+        A :class:`~mdanalysis_mpi_tpu.service.qos.QosPolicy`
+        (docs/RELIABILITY.md §7): weighted-fair claim ordering across
+        tenant QoS classes, bounded submit + per-tenant rate limits
+        and quotas (typed :class:`~mdanalysis_mpi_tpu.service.jobs.
+        AdmissionRejectedError`), the overload shed ladder (typed
+        :class:`~mdanalysis_mpi_tpu.service.jobs.JobShedError`, state
+        ``shed``), and the runaway-job lease caps.  None → a default
+        policy whose admission/shed/cap knobs are all OFF, so
+        pre-QoS callers see byte-identical behavior.
     """
 
     def __init__(self, n_workers: int = 1, cache=None,
@@ -161,9 +173,23 @@ class Scheduler:
                  breakers=None, journal=None, clock=time.monotonic,
                  scrub: bool = False, scrub_interval_s: float = 5.0,
                  mem_guard_bytes: int | None = None,
-                 flight_dir: str | None = None):
+                 flight_dir: str | None = None,
+                 qos: "_qos.QosPolicy | None" = None):
         self.cache = cache
-        self.telemetry = telemetry or ServiceTelemetry()
+        # ---- QoS + overload policy (docs/RELIABILITY.md §7) ----
+        self.qos = qos or _qos.QosPolicy()
+        self._stride = _qos.StrideScheduler(self.qos.weights)
+        self._buckets = (_qos.TenantBuckets(self.qos.tenant_rate_per_s,
+                                            self.qos.rate_burst(),
+                                            clock=clock)
+                         if self.qos.tenant_rate_per_s else None)
+        self._tenant_inflight: dict[str, int] = {}
+        self.telemetry = telemetry or ServiceTelemetry(
+            slo_targets_s=self.qos.slo_targets_s)
+        if telemetry is not None and qos is not None:
+            # a shared/injected telemetry still reports attainment
+            # against THIS scheduler's configured targets
+            self.telemetry.slo_targets_s.update(self.qos.slo_targets_s)
         self.max_deferrals = max_deferrals
         self.n_workers = max(1, int(n_workers))
         # ---- supervision state ----
@@ -340,6 +366,10 @@ class Scheduler:
         now = self._clock()
         with self._cond:
             queue_depth = len(self._queue) + len(self._parked)
+            by_class: dict = {}
+            for _, _, h in self._queue + self._parked:
+                by_class[h.job.qos] = by_class.get(h.job.qos, 0) + 1
+            overloaded = self._overloaded_locked()
             inflight = self._inflight
             active = self._active
             workers_alive = sum(1 for t in self._workers
@@ -357,6 +387,8 @@ class Scheduler:
             "role": "scheduler",
             "shutdown": shutdown,
             "queue_depth": queue_depth,
+            "queue_depth_by_class": by_class,
+            "overloaded": overloaded,
             "inflight": inflight,
             "active_workers": active,
             "workers_alive": workers_alive,
@@ -482,8 +514,16 @@ class Scheduler:
         with self._cond:
             if self._shutdown:
                 raise RuntimeError("scheduler is shut down")
+            # policy admission FIRST (docs/RELIABILITY.md §7
+            # "Backpressure contract"): a rejected submission leaves
+            # NO side effects — no handle state, no journal record,
+            # no namespace pin, no depth-gauge wobble — so the caller
+            # can back off and retry without cleanup
+            self._admission_check_locked(job)
             if job.fingerprint is None:
                 job.fingerprint = self._derive_fingerprint(job)
+            self._tenant_inflight[job.tenant] = \
+                self._tenant_inflight.get(job.tenant, 0) + 1
             handle._mark_queued()
             self._note_ns_submit(job)
             self._queue.append((-job.priority, next(self._seq), handle))
@@ -500,7 +540,48 @@ class Scheduler:
             self.journal.record(
                 "submit", job.fingerprint, tenant=job.tenant,
                 analysis=type(job.analysis).__name__)
+        # overload check AFTER the enqueue: a burst that pushed the
+        # queue past the shed threshold sheds the lowest sheddable
+        # class NOW (possibly this very job), not a supervisor tick
+        # later — the journal/disk I/O runs outside the lock
+        self._maybe_shed()
         return handle
+
+    def _admission_check_locked(self, job: AnalysisJob) -> None:
+        """Typed policy admission at the submission door
+        (docs/RELIABILITY.md §7).  Raises
+        :class:`AdmissionRejectedError` — counted
+        ``mdtpu_admission_rejects_total{reason=}`` — and consumes a
+        rate token only for submissions that pass every other check
+        (a queue-full reject must not also burn the tenant's
+        budget)."""
+        p = self.qos
+        reason = None
+        depth = len(self._queue) + len(self._parked)
+        if p.max_queue_depth is not None and depth >= p.max_queue_depth:
+            reason = "queue_full"
+            msg = (f"queue depth {depth} at its bound "
+                   f"{p.max_queue_depth}; back off and resubmit")
+        elif (p.tenant_quota is not None
+              and self._tenant_inflight.get(job.tenant, 0)
+              >= p.tenant_quota):
+            reason = "tenant_quota"
+            msg = (f"tenant {job.tenant!r} already has "
+                   f"{self._tenant_inflight[job.tenant]} jobs in "
+                   f"flight (quota {p.tenant_quota})")
+        elif self._buckets is not None \
+                and not self._buckets.try_take(job.tenant):
+            reason = "rate_limit"
+            msg = (f"tenant {job.tenant!r} exceeded its "
+                   f"{p.tenant_rate_per_s}/s submission rate")
+        if reason is None:
+            return
+        self.telemetry.count("admission_rejects")
+        obs.METRICS.inc("mdtpu_admission_rejects_total", reason=reason)
+        obs.span_event("admission_reject", tenant=job.tenant,
+                       qos=job.qos, reason=reason)
+        raise AdmissionRejectedError(
+            f"submission rejected ({reason}): {msg}", reason)
 
     def _derive_fingerprint(self, job: AnalysisJob) -> str:
         """Journal identity when the caller supplied none: the job's
@@ -672,7 +753,7 @@ class Scheduler:
         ALONE and never rides as a peer: its previous batch already
         sank a worker, and one poison tenant must not sink the merged
         pass twice."""
-        best = min(self._claimable_locked())
+        best = self._best_claimable_locked()
         try:
             key = best[2].job.coalesce_key()
         except Exception as exc:
@@ -705,6 +786,19 @@ class Scheduler:
         self._queue[:] = rest
         return claimed, None, self._grant_locked(claimed)
 
+    def _best_claimable_locked(self):
+        """The queue entry the next claim starts from: weighted-fair
+        ACROSS QoS classes (stride scheduling over the policy weights
+        — docs/RELIABILITY.md §7), best ``(-priority, seq)`` WITHIN
+        the picked class.  With one class present (every pre-QoS
+        workload) this is exactly the old ``min(queue)``."""
+        claimable = self._claimable_locked()
+        by_class: dict = {}
+        for entry in claimable:
+            by_class.setdefault(entry[2].job.qos, []).append(entry)
+        chosen = self._stride.pick(sorted(by_class))
+        return min(by_class[chosen])
+
     def _grant_locked(self, handles):
         """Grant this worker's lease over the claimed handles and
         return its ownership token (always minted, even with
@@ -716,7 +810,10 @@ class Scheduler:
                 h._owner = token
             return token
         ttl = self._lease_ttl(handles)
-        return self._sup.grant(handles, ttl).token
+        return self._sup.grant(
+            handles, ttl,
+            max_renewals=self.qos.max_lease_renewals,
+            max_runtime_s=self.qos.max_runtime_s).token
 
     def _lease_ttl(self, handles) -> float:
         """TTL for one claimed batch: the configured floor, widened by
@@ -802,6 +899,11 @@ class Scheduler:
         with self._cond:
             self._sup.drop_handle(handle)
             self._inflight -= 1
+            n = self._tenant_inflight.get(handle.job.tenant, 0) - 1
+            if n <= 0:
+                self._tenant_inflight.pop(handle.job.tenant, None)
+            else:
+                self._tenant_inflight[handle.job.tenant] = n
             self._cond.notify_all()
 
     def _process_batch(self, batch: list[JobHandle], token) -> bool:
@@ -851,11 +953,12 @@ class Scheduler:
         lease or live worker remains."""
         while True:
             with self._cond:
-                quarantines, fences = self._reap_locked()
+                quarantines, fences, capped = self._reap_locked()
                 alive = [t for t in self._workers if t.is_alive()]
                 stop = (self._shutdown and not self._sup.leases
                         and not self._pending_requeues and not alive)
-                if not stop and not quarantines and not fences:
+                if not stop and not quarantines and not fences \
+                        and not capped:
                     self._cond.wait(self.supervision_interval_s)
             # quarantine and flight dumps OUTSIDE the condition lock:
             # quarantine fires the handle's done-callbacks (the batch
@@ -868,6 +971,14 @@ class Scheduler:
                                     "n_jobs": n_jobs})
             for h, incident in quarantines:
                 self._quarantine(h, incident)
+            for h, incident in capped:
+                self._fail_capped(h, incident)
+            # overload tick (docs/RELIABILITY.md §7): the supervisor
+            # owns the shed ladder between submissions, so a queue
+            # that outran capacity mid-wave sheds without waiting for
+            # the next submit() to notice
+            if not stop:
+                self._maybe_shed()
             if stop:
                 # a worker death AFTER shutdown can requeue a handle
                 # no one will ever claim (respawn stops at shutdown):
@@ -879,20 +990,27 @@ class Scheduler:
                 return
 
     def _reap_locked(self) -> tuple:
-        """Reap due leases; returns ``(quarantines, fences)`` —
-        ``(handle, incident)`` pairs that crossed the poison
-        threshold, and ``(worker_name, n_jobs)`` pairs for workers
-        fenced this pass — for the caller to quarantine / flight-dump
-        AFTER releasing the condition lock (both do disk I/O:
-        done-callbacks, a durable journal record, an fsync'd dump)."""
+        """Reap due leases; returns ``(quarantines, fences, capped)``
+        — ``(handle, incident)`` pairs that crossed the poison
+        threshold, ``(worker_name, n_jobs)`` pairs for workers fenced
+        this pass, and ``(handle, incident)`` pairs whose lease hit
+        its RENEWAL CAP (docs/RELIABILITY.md §7: a runaway that
+        heartbeats forever; failed typed instead of requeued) — for
+        the caller to resolve AFTER releasing the condition lock (all
+        do disk I/O: done-callbacks, a durable journal record, an
+        fsync'd dump)."""
         quarantines = []
         fences = []
+        cap_fails = []
         now = self._clock()
         for lease in self._sup.expired(now):
             worker = lease.worker
             self._sup.leases.pop(worker, None)
             dead = not worker.is_alive()
-            reason = "worker_death" if dead else "lease_expired"
+            runaway = not dead and lease.capped(now)
+            reason = ("worker_death" if dead
+                      else "runtime_capped" if runaway
+                      else "lease_expired")
             death = self._sup.worker_deaths.pop(worker.name, None)
             self.telemetry.count("lease_expired")
             obs.METRICS.inc("mdtpu_lease_expired_total", reason=reason)
@@ -922,7 +1040,17 @@ class Scheduler:
                     h, reason=reason, worker=worker.name,
                     ttl=lease.ttl, death=death)
                 h._fault_log.append(incident)
-                if h._faults >= self.poison_threshold:
+                if runaway:
+                    # the renewal cap engaged: the job fails TYPED —
+                    # never a requeue (a runaway re-run is the same
+                    # runaway), never a poison count against a future
+                    # batch.  The fenced zombie is actively
+                    # heartbeating (that is what capped it), so its
+                    # next phase entry aborts it and the respawn loop
+                    # restores the pool slot; peers on other leases
+                    # are untouched.
+                    cap_fails.append((h, incident))
+                elif h._faults >= self.poison_threshold:
                     quarantines.append((h, incident))
                 elif dead:
                     self._requeue_supervised_locked(h)
@@ -971,7 +1099,7 @@ class Scheduler:
                     self._log.warning("respawned dead worker %s as %s",
                                       t.name, nt.name)
                     nt.start()
-        return quarantines, fences
+        return quarantines, fences, cap_fails
 
     def _write_off_locked(self, worker: threading.Thread) -> None:
         """Replace a forever-wedged (fenced, grace-expired, still
@@ -1055,6 +1183,120 @@ class Scheduler:
             self.journal.record("quarantine", h.job.fingerprint,
                                 reason=incident.get("reason"),
                                 durable=True)
+        self._finish(h)
+
+    def _fail_capped(self, h: JobHandle, incident: dict) -> None:
+        """Resolve a runaway handle whose lease hit its renewal cap
+        (docs/RELIABILITY.md §7): typed failure, durable journal
+        record via ``_finish``.  Called WITHOUT the condition lock
+        (done-callbacks and the journal fsync are disk I/O); safe
+        unlocked for the same reason ``_quarantine`` is — the handle
+        left its lease with ``_owner`` cleared at reap time, so the
+        runaway zombie's late ``_complete`` is already fenced off."""
+        if h.done():
+            return
+        p = self.qos
+        err = JobRuntimeExceeded(
+            f"job {h.job_id} ({h.job.tenant}, "
+            f"{type(h.job.analysis).__name__}) exceeded its runtime "
+            f"cap (max_runtime_s={p.max_runtime_s}, "
+            f"max_lease_renewals={p.max_lease_renewals}) after "
+            f"{incident.get('lease_ttl_s')}s-TTL renewals; releasing "
+            "its worker instead of renewing forever")
+        h._mark_failed(err)
+        obs.span_event("job_runtime_capped", job_id=h.job_id,
+                       tenant=h.job.tenant, qos=h.job.qos)
+        self._log.error(
+            "runtime cap: job %d (%s) failed typed after its lease "
+            "stopped renewing; worker released", h.job_id,
+            h.job.tenant)
+        self._finish(h)
+
+    # ---- overload shedding (docs/RELIABILITY.md §7) ----
+
+    def _overloaded_locked(self) -> bool:
+        """The overload predicate, from signals the scheduler already
+        owns: queued depth beyond ``shed_queue_depth`` while every
+        worker holds a lease (depth with idle workers is transient —
+        they are about to claim), or estimated staged bytes in flight
+        beyond ``shed_staged_bytes`` (the PR-9 memory-guard
+        accounting)."""
+        p = self.qos
+        if p.shed_queue_depth is not None:
+            depth = len(self._queue) + len(self._parked)
+            busy = (len(self._sup.leases) >= self.n_workers
+                    if self.supervise
+                    else self._active >= self.n_workers)
+            if depth > p.shed_queue_depth and busy:
+                return True
+        if p.shed_staged_bytes is not None \
+                and self._staged_inflight > p.shed_staged_bytes:
+            return True
+        return False
+
+    def _collect_sheds_locked(self) -> list[JobHandle]:
+        """Pull the entries the shed ladder drops this pass out of the
+        queue: lowest sheddable class first, newest first within a
+        class (the jobs that would wait longest), down to the
+        configured depth — and NEVER a class outside
+        ``shed_classes``, whatever the depth.  Prefetch-held entries
+        are skipped (their staging is mid-flight); they are
+        reconsidered once released."""
+        p = self.qos
+        if not self._overloaded_locked():
+            return []
+        target = p.shed_queue_depth or 0
+        sheds: list[JobHandle] = []
+        for qos_cls in p.shed_ladder():
+            for queue in (self._parked, self._queue):
+                candidates = sorted(
+                    (e for e in queue
+                     if e[2].job.qos == qos_cls
+                     and not e[2]._prefetch_hold),
+                    key=lambda e: e[1], reverse=True)   # newest first
+                for entry in candidates:
+                    depth = len(self._queue) + len(self._parked)
+                    if depth <= target:
+                        return sheds
+                    queue.remove(entry)
+                    self.telemetry.note_dequeue()
+                    sheds.append(entry[2])
+        return sheds
+
+    def _maybe_shed(self) -> list[JobHandle]:
+        """One overload-controller pass: collect under the lock,
+        resolve (done-callbacks + durable journal records) outside it.
+        Returns the handles shed."""
+        p = self.qos
+        if p.shed_queue_depth is None and p.shed_staged_bytes is None:
+            return []
+        with self._cond:
+            sheds = self._collect_sheds_locked()
+            if sheds:
+                self._cond.notify_all()
+        for h in sheds:
+            self._resolve_shed(h)
+        return sheds
+
+    def _resolve_shed(self, h: JobHandle) -> None:
+        if h.done():
+            return
+        qos_cls = h.job.qos
+        err = JobShedError(
+            f"job {h.job_id} ({h.job.tenant}, class {qos_cls}) shed "
+            "by the overload controller: queue depth outran capacity "
+            "and this class is in the configured shed set "
+            f"({self.qos.shed_classes}); resubmit once the burst "
+            "passes", qos=qos_cls)
+        h._mark_failed(err, JobState.SHED)
+        obs.METRICS.inc("mdtpu_jobs_shed_total",
+                        **{"class": qos_cls})
+        obs.span_event("job_shed", job_id=h.job_id,
+                       tenant=h.job.tenant, qos=qos_cls)
+        self._log.warning(
+            "overload: shed job %d (%s, class %s) — queue depth over "
+            "%s with all workers busy", h.job_id, h.job.tenant,
+            qos_cls, self.qos.shed_queue_depth)
         self._finish(h)
 
     @staticmethod
@@ -1165,15 +1407,34 @@ class Scheduler:
         runs under a per-run ReliabilityRuntime whose salvage state
         namespaces the cache keys (``validate=True``) — a plain
         prefetch would stage ``validate=False`` twins the run can
-        never hit, dead weight in a never-evicting shared cache."""
+        never hit, dead weight in a never-evicting shared cache.
+
+        Shed-pending jobs are not prefetched either
+        (docs/RELIABILITY.md §7): while the overload controller is
+        engaged, a job of a sheddable class is about to be dropped —
+        staging its blocks would burn decode/wire time AND park a
+        never-hit entry in a never-evicting shared cache.  Skips are
+        counted (``prefetch_skipped_shed``)."""
         staged = 0
         units_done = 0
+        shed_counted: set = set()
         while max_units is None or units_done < max_units:
             with self._cond:
+                overloaded = self._overloaded_locked()
+                if overloaded:
+                    for e in self._queue:
+                        h = e[2]
+                        if (self.qos.sheddable(h.job.qos)
+                                and id(h) not in shed_counted):
+                            shed_counted.add(id(h))
+                            self.telemetry.count(
+                                "prefetch_skipped_shed")
                 pending = [e[2] for e in sorted(self._queue)
                            if not e[2]._prefetch_hold
                            and not e[2].prefetched
                            and not e[2].job.resilient
+                           and not (overloaded and self.qos.sheddable(
+                               e[2].job.qos))
                            and e[2].job.backend in ("jax", "mesh")
                            and "block_cache" not in
                            e[2].job.executor_kwargs]

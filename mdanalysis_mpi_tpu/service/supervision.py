@@ -71,19 +71,43 @@ def derive_ttl(base_ttl_s: float, est_bytes: int,
 class Lease:
     """One worker's claim on one batch: the handles it still owes, the
     ownership token fencing zombie completions, and the renewable
-    deadline."""
+    deadline.
+
+    Renewal caps (docs/RELIABILITY.md §7): ``max_renewals`` /
+    ``hard_deadline`` bound a RUNAWAY batch — one that heartbeats
+    forever because it genuinely never finishes (an infinite stream
+    mis-submitted as a closed job, a pathological selection).  Past
+    either cap :meth:`heartbeat` stops extending the deadline, the
+    lease expires like any hang, and the reaper sees
+    :meth:`capped` — a typed expiry, not a requeue (re-running a
+    runaway is the same runaway)."""
 
     __slots__ = ("worker", "token", "handles", "ttl", "deadline",
-                 "granted_t")
+                 "granted_t", "renewals", "max_renewals",
+                 "hard_deadline")
 
     def __init__(self, worker: threading.Thread, handles, ttl: float,
-                 now: float):
+                 now: float, max_renewals: int | None = None,
+                 max_runtime_s: float | None = None):
         self.worker = worker
         self.token = object()
         self.handles = set(handles)
         self.ttl = float(ttl)
         self.granted_t = now
         self.deadline = now + self.ttl
+        self.renewals = 0
+        self.max_renewals = max_renewals
+        self.hard_deadline = (None if max_runtime_s is None
+                              else now + float(max_runtime_s))
+
+    def capped(self, now: float) -> bool:
+        """True when the lease ran out because a renewal CAP engaged
+        (the runaway shape), as opposed to a hang/death: the reaper
+        fails the handles typed instead of requeueing them."""
+        return ((self.max_renewals is not None
+                 and self.renewals >= self.max_renewals)
+                or (self.hard_deadline is not None
+                    and now >= self.hard_deadline))
 
 
 class LeaseTable:
@@ -108,9 +132,13 @@ class LeaseTable:
 
     # ---- called under the scheduler lock ----
 
-    def grant(self, handles, ttl: float) -> Lease:
+    def grant(self, handles, ttl: float,
+              max_renewals: int | None = None,
+              max_runtime_s: float | None = None) -> Lease:
         worker = threading.current_thread()
-        lease = Lease(worker, handles, ttl, self.clock())
+        lease = Lease(worker, handles, ttl, self.clock(),
+                      max_renewals=max_renewals,
+                      max_runtime_s=max_runtime_s)
         self.leases[worker] = lease
         for h in handles:
             h._owner = lease.token
@@ -155,7 +183,17 @@ class LeaseTable:
                 "not keep running its revoked batch")
         lease = self.leases.get(t)
         if lease is not None:
-            lease.deadline = self.clock() + lease.ttl
+            now = self.clock()
+            lease.renewals += 1
+            if lease.capped(now):
+                # renewal cap engaged (docs/RELIABILITY.md §7): stop
+                # extending — the lease expires at its CURRENT
+                # deadline and the reaper handles the typed expiry.
+                # Deliberately not raising here: the hot phase-entry
+                # path stays one dict lookup + compare, and the fence
+                # mechanism already owns aborting the thread.
+                return
+            lease.deadline = now + lease.ttl
 
 
 def capture_diagnostics(handle, *, reason: str, worker: str,

@@ -39,9 +39,21 @@ def percentile(samples, q: float) -> float | None:
 
 
 class ServiceTelemetry:
-    """Counters + latency distributions for one scheduler."""
+    """Counters + latency distributions for one scheduler.
 
-    def __init__(self):
+    ``slo_targets_s`` maps QoS class → latency-SLO target in seconds
+    (None = untargeted); the scheduler wires its
+    :class:`~mdanalysis_mpi_tpu.service.qos.QosPolicy` targets in so
+    the per-class attainment this object reports (and mirrors as
+    ``mdtpu_slo_attainment{class=}``) is measured against the policy
+    the operator actually configured."""
+
+    def __init__(self, slo_targets_s: dict | None = None):
+        from mdanalysis_mpi_tpu.service.qos import DEFAULT_SLO_TARGETS_S
+
+        self.slo_targets_s = dict(DEFAULT_SLO_TARGETS_S)
+        if slo_targets_s:
+            self.slo_targets_s.update(slo_targets_s)
         self._lock = threading.Lock()
         # job lifecycle
         self.submitted = 0
@@ -69,6 +81,12 @@ class ServiceTelemetry:
         self.prefetch_jobs = 0         # queued jobs whose blocks staged
         self.prefetch_blocks = 0       # blocks staged ahead of claim
         self.prefetch_skipped = 0      # skipped by admission/budget
+        self.prefetch_skipped_shed = 0  # skipped because the overload
+        #                                 controller is about to shed
+        #                                 the job (docs/RELIABILITY.md
+        #                                 §7 — staging a doomed job
+        #                                 wastes the wire AND parks a
+        #                                 never-hit cache entry)
         # serving supervision (docs/RELIABILITY.md)
         self.quarantined = 0           # jobs parked with diagnostics
         self.aborted = 0               # failed by shutdown/signal drain
@@ -78,9 +96,27 @@ class ServiceTelemetry:
         self.breaker_reroutes = 0      # units routed off a tripped
         #                                backend
         self.workers_respawned = 0     # dead worker threads replaced
+        # QoS + overload (docs/RELIABILITY.md §7)
+        self.jobs_shed = 0             # dropped by the shed ladder
+        self.admission_rejects = 0     # typed submit() refusals
+        #                                (queue_full/rate_limit/quota)
         # distributions (seconds), bounded — see MAX_SAMPLES
         self.queue_wait_samples: deque = deque(maxlen=MAX_SAMPLES)
         self.latency_samples: deque = deque(maxlen=MAX_SAMPLES)
+        # per-QoS-class accounting (the satellite fix: one
+        # undifferentiated pool hid which CLASS was expiring/waiting):
+        # class -> {counters, bounded sample deques}
+        self._by_class: dict[str, dict] = {}
+
+    def _class_locked(self, qos: str) -> dict:
+        st = self._by_class.get(qos)
+        if st is None:
+            st = {"completed": 0, "failed": 0, "expired": 0,
+                  "shed": 0, "slo_met": 0,
+                  "queue_wait": deque(maxlen=MAX_SAMPLES),
+                  "latency": deque(maxlen=MAX_SAMPLES)}
+            self._by_class[qos] = st
+        return st
 
     # ---- recording (scheduler-facing) ----
 
@@ -110,23 +146,52 @@ class ServiceTelemetry:
         from mdanalysis_mpi_tpu.obs.metrics import METRICS
         from mdanalysis_mpi_tpu.service.jobs import JobState
 
+        qos = getattr(handle.job, "qos", "batch")
+        slo_target = self.slo_targets_s.get(qos)
+        slo_attainment = None
         with self._lock:
+            cls = self._class_locked(qos)
             if handle.state == JobState.DONE:
                 self.completed += 1
+                cls["completed"] += 1
                 if handle.coalesced:
                     self.coalesced_jobs += 1
+                # attainment only exists for a class WITH a target: an
+                # untargeted class reporting 1.0 would be
+                # indistinguishable from a class genuinely meeting one
+                if slo_target is not None:
+                    if (handle.latency_s is not None
+                            and handle.latency_s <= slo_target):
+                        cls["slo_met"] += 1
+                    slo_attainment = cls["slo_met"] / cls["completed"]
             elif handle.state == JobState.EXPIRED:
                 self.expired += 1
+                cls["expired"] += 1
             elif handle.state == JobState.QUARANTINED:
                 self.quarantined += 1
+                cls["failed"] += 1
             elif handle.state == JobState.ABORTED:
                 self.aborted += 1
+                cls["failed"] += 1
+            elif handle.state == JobState.SHED:
+                self.jobs_shed += 1
+                cls["shed"] += 1
             else:
                 self.failed += 1
+                cls["failed"] += 1
             if handle.queue_wait_s is not None:
                 self.queue_wait_samples.append(handle.queue_wait_s)
+                cls["queue_wait"].append(handle.queue_wait_s)
             if handle.latency_s is not None:
                 self.latency_samples.append(handle.latency_s)
+                cls["latency"].append(handle.latency_s)
+        if slo_attainment is not None:
+            # per-class SLO attainment, live for /metrics scrapes —
+            # what fraction of this class's completed jobs met the
+            # configured latency target (docs/RELIABILITY.md §7)
+            METRICS.set_gauge("mdtpu_slo_attainment",
+                              round(slo_attainment, 4),
+                              **{"class": qos})
         # fixed-bucket histograms in the process-global metrics
         # registry (docs/OBSERVABILITY.md): unlike the bounded
         # percentile deques above, these see EVERY job for the life of
@@ -172,8 +237,11 @@ class ServiceTelemetry:
                 "prefetch_jobs": self.prefetch_jobs,
                 "prefetch_blocks": self.prefetch_blocks,
                 "prefetch_skipped": self.prefetch_skipped,
+                "prefetch_skipped_shed": self.prefetch_skipped_shed,
                 "jobs_quarantined": self.quarantined,
                 "jobs_aborted": self.aborted,
+                "jobs_shed": self.jobs_shed,
+                "admission_rejects": self.admission_rejects,
                 "lease_expired": self.lease_expired,
                 "jobs_requeued": self.jobs_requeued,
                 "breaker_reroutes": self.breaker_reroutes,
@@ -186,6 +254,29 @@ class ServiceTelemetry:
             done = self.completed
             out["coalesce_rate"] = (round(self.coalesced_jobs / done, 4)
                                     if done else None)
+            # per-QoS-class breakdown (docs/RELIABILITY.md §7): the
+            # deadline/queue-wait/latency view an operator needs to
+            # see WHICH class is missing its SLO, not one pooled p99
+            out["qos"] = {
+                qos: {
+                    "completed": cls["completed"],
+                    "failed": cls["failed"],
+                    "expired": cls["expired"],
+                    "shed": cls["shed"],
+                    "slo_target_s": self.slo_targets_s.get(qos),
+                    "slo_attainment": (
+                        round(cls["slo_met"] / cls["completed"], 4)
+                        if cls["completed"]
+                        and self.slo_targets_s.get(qos) is not None
+                        else None),
+                    "p50_queue_wait_s": percentile(cls["queue_wait"],
+                                                   50),
+                    "p99_queue_wait_s": percentile(cls["queue_wait"],
+                                                   99),
+                    "p50_latency_s": percentile(cls["latency"], 50),
+                    "p99_latency_s": percentile(cls["latency"], 99),
+                }
+                for qos, cls in sorted(self._by_class.items())}
         if cache is not None:
             lookups = cache.hits + cache.misses
             out["cache_hits"] = cache.hits
@@ -231,6 +322,11 @@ class FleetTelemetry:
         self.home_hits = 0             # jobs that found their tenant's
         #                                state resident on the home host
         self.home_misses = 0           # jobs that had to build it
+        # elasticity + overload (docs/RELIABILITY.md §7)
+        self.hosts_scaled_up = 0       # hosts spawned by the autoscaler
+        self.hosts_scaled_down = 0     # hosts drain-retired by it
+        self.jobs_shed = 0             # pending jobs dropped by the
+        #                                controller's shed ladder
 
     def count(self, counter: str, n: int = 1) -> None:
         with self._lock:
@@ -249,6 +345,9 @@ class FleetTelemetry:
                 "epoch_fenced_rejects": self.epoch_fenced_rejects,
                 "home_hits": self.home_hits,
                 "home_misses": self.home_misses,
+                "hosts_scaled_up": self.hosts_scaled_up,
+                "hosts_scaled_down": self.hosts_scaled_down,
+                "jobs_shed": self.jobs_shed,
             }
         lookups = out["home_hits"] + out["home_misses"]
         out["home_hit_rate"] = (round(out["home_hits"] / lookups, 4)

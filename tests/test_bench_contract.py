@@ -116,6 +116,16 @@ PINNED_METRICS = {
     "mdtpu_flight_dumps_total": "counter",
     "mdtpu_status_requests_total": "counter",
     "mdtpu_fleet_hosts_reporting": "gauge",
+    # QoS + elasticity (docs/RELIABILITY.md §7): overload sheds by
+    # class, typed admission rejects by reason, the autoscaler's
+    # journaled host scale events, and per-class SLO attainment —
+    # recorded live at the scheduler/controller incident sites,
+    # zero-injected everywhere else
+    "mdtpu_jobs_shed_total": "counter",
+    "mdtpu_admission_rejects_total": "counter",
+    "mdtpu_hosts_scaled_up_total": "counter",
+    "mdtpu_hosts_scaled_down_total": "counter",
+    "mdtpu_slo_attainment": "gauge",
 }
 
 
@@ -237,6 +247,20 @@ def test_bench_json_contract(tmp_path):
                     "obs_federation_plain_jobs_per_s",
                     "obs_federation_metrics_ships",
                     "obs_federation_trace_events",
+                    # QoS + elasticity sub-leg (docs/RELIABILITY.md
+                    # §7): bursty multi-class wave on an autoscaling
+                    # fleet — interactive p99 vs its disclosed SLO
+                    # target, batch throughput, background sheds,
+                    # journaled scale events; host-side, survives
+                    # the outage protocol
+                    "qos_slo_target_s",
+                    "qos_interactive_p99_s",
+                    "qos_interactive_slo_met",
+                    "qos_batch_jobs_per_s",
+                    "qos_shed_background",
+                    "qos_hosts_scaled_up",
+                    "qos_hosts_scaled_down",
+                    "qos_exactly_once",
                     # r9: observability — the host-leg tracing-on/off
                     # delta and the unified metrics block
                     # (docs/OBSERVABILITY.md)
@@ -304,6 +328,19 @@ def test_bench_json_contract(tmp_path):
         assert 0 <= rec["obs_federation_overhead_pct"] <= 100
         assert rec["obs_federation_metrics_ships"] >= 1
         assert rec["obs_federation_trace_events"] >= 1
+        # qos sub-leg: the fleet scaled up AND back down (journaled),
+        # interactive p99 held its disclosed SLO target while the
+        # background tail shed — and never a class above background
+        assert rec["qos_interactive_slo_met"] is True
+        assert rec["qos_interactive_p99_s"] > 0
+        assert rec["qos_batch_jobs_per_s"] > 0
+        assert rec["qos_shed_background"] >= 1
+        assert rec["qos_shed_above_background"] == 0
+        assert rec["qos_hosts_scaled_up"] >= 1
+        assert rec["qos_hosts_scaled_down"] >= 1
+        assert rec["qos_journal_scale_up"] >= 1
+        assert rec["qos_journal_scale_down"] >= 1
+        assert rec["qos_exactly_once"] is True
         # fault-wave sub-leg: the injected worker death was really
         # reaped, recovered jobs still flowed, and the recovery price
         # is recorded next to the clean wave
@@ -424,6 +461,12 @@ def test_bench_outage_records_host_legs(tmp_path):
         # overhead disclosure survives a tunnel-down artifact
         assert rec["obs_federation_jobs_per_s"] > 0
         assert rec["obs_federation_metrics_ships"] >= 1
+        # the qos sub-leg is host-side too: the shed/scale record and
+        # the SLO verdict survive a tunnel-down artifact
+        assert rec["qos_interactive_slo_met"] is True
+        assert rec["qos_shed_background"] >= 1
+        assert rec["qos_hosts_scaled_up"] >= 1
+        assert rec["qos_hosts_scaled_down"] >= 1
         # the retry log shows what init actually did
         assert rec["init_log"] and rec["init_log"][0]["attempt"] == 1
         # the incremental file matches the emitted record's legs

@@ -417,6 +417,19 @@ def test_fleet_smoke_record(tmp_path):
     assert record["trace_pids"] >= 2
     # the lost host's black box landed
     assert record["flight_dump"] is True
+    # QoS + elasticity phase (docs/RELIABILITY.md §7): the burst
+    # scaled hosts up, the idle retired one drain-first — both as
+    # epoch-stamped journaled scale events — and the background tail
+    # shed with journaled terminal records, never a class above it
+    assert record["qos_ok"], record
+    assert record["qos_scaled_up"] >= 1
+    assert record["qos_scaled_down"] >= 1
+    assert record["qos_journal_scale_up"] >= 1
+    assert record["qos_journal_scale_down"] >= 1
+    assert record["qos_shed"] >= 1
+    assert record["qos_journal_shed_records"] == record["qos_shed"]
+    assert record["qos_shed_above_background"] == 0
+    assert record["qos_exactly_once"]
 
 
 def test_federation_counters_gauges_and_scrape(tmp_path):
