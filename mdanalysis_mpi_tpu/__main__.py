@@ -14,7 +14,7 @@ import sys
 
 if not (len(sys.argv) > 1
         and sys.argv[1] in ("lint", "fleet", "fleet-host", "ingest",
-                            "status")):
+                            "status", "perf")):
     # platform re-pinning imports jax; the lint subcommand's fast AST
     # mode is contractually jax-free (<30 s, docs/LINT.md — pinned by
     # tests/test_lint.py via the CLI's `jax_imported` disclosure), and
@@ -24,6 +24,8 @@ if not (len(sys.argv) > 1
     # lost host should not pay a jax import for it); a jax/mesh host
     # re-pins inside host_main before its first dispatch instead.
     # `status` is one stdlib HTTP GET against a running endpoint.
+    # `perf` (docs/OBSERVABILITY.md) compares bench JSON artifacts —
+    # pure stdlib, never a platform re-pin.
     # `ingest` (docs/STORE.md) is a pure host decode pass — numpy and
     # the native codec, never jax.
     from mdanalysis_mpi_tpu.utils.platform import honor_cpu_request

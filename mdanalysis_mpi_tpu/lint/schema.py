@@ -22,6 +22,11 @@ driver scores.  This pass statically harvests all four and diffs them:
 - **MDT205 bench-key-drift** — an artifact key the bench contract
   test requires that ``bench.py`` never mentions: the pin outlived
   the field.
+- **MDT206 alert-rule-drift** — the ``obs/alerts.py`` seed-rule
+  catalog vs its pin (``PINNED_ALERT_RULES`` in the contract test):
+  duplicate or non-snake_case rule names, rules missing from the
+  pin, and pinned names no rule carries — rule drift caught exactly
+  like metric drift.
 """
 
 from __future__ import annotations
@@ -58,6 +63,13 @@ register(Rule(
     "bench-contract-pinned artifact key that bench.py never mentions",
     "the driver scores bench.py's JSON line; a pinned-but-unemitted "
     "key means the contract test and the artifact diverged"))
+register(Rule(
+    "MDT206", "alert-rule-drift", "schema",
+    "alert seed-rule catalog drift: duplicate/non-snake_case names, "
+    "or mismatch vs PINNED_ALERT_RULES",
+    "the alert rules are an operator contract like the metric "
+    "schema; an unpinned rename would silently retire a rule every "
+    "runbook references"))
 
 _METRIC_RE = re.compile(r"^mdtpu_\w+$")
 #: Doc tokens: a metric name possibly with ``{a,b,c}`` families.
@@ -82,6 +94,11 @@ _TABLE_TYPES = {
     "FLEET_OBS_GAUGES": "gauge",
     "QOS_COUNTERS": "counter",
     "QOS_GAUGES": "gauge",
+    "PROF_COUNTERS": "counter",
+    "PROF_GAUGES": "gauge",
+    "PROF_HISTOGRAMS": "histogram",
+    "ALERT_COUNTERS": "counter",
+    "ALERT_GAUGES": "gauge",
 }
 
 _RECORD_TYPES = {"inc": "counter", "observe": "histogram",
@@ -212,6 +229,33 @@ def harvest_span_names(pkg_root: str) -> dict[str, int]:
     return out
 
 
+def harvest_alert_rules(alerts_py: str) -> list[str]:
+    """The ``SEED_RULES`` catalog's rule names, in declaration order
+    (duplicates preserved — MDT206 flags them)."""
+    from mdanalysis_mpi_tpu.lint.core import parse_file
+
+    tree, _ = parse_file(alerts_py)
+    if tree is None:
+        return []
+    rules = _literal_assignments(tree).get("SEED_RULES", [])
+    if not isinstance(rules, list):
+        return []
+    return [r.get("name") for r in rules
+            if isinstance(r, dict) and isinstance(r.get("name"), str)]
+
+
+def harvest_pinned_alert_rules(contract_py: str) -> list[str]:
+    from mdanalysis_mpi_tpu.lint.core import parse_file
+
+    tree, _ = parse_file(contract_py)
+    if tree is None:
+        return []
+    pinned = _literal_assignments(tree).get("PINNED_ALERT_RULES", ())
+    if not isinstance(pinned, (list, tuple)):
+        return []
+    return [n for n in pinned if isinstance(n, str)]
+
+
 def harvest_bench_pins(contract_py: str) -> list[str]:
     """Artifact keys the contract test iterates over (``for key in
     ("metric", ...): assert key in rec``)."""
@@ -290,6 +334,41 @@ def check_repo(root: str, notes: list[str]) -> list[Finding]:
                 "MDT204", relpath(path, root), line, "span-model",
                 f"span/phase name `{name}` is not in the "
                 f"docs/OBSERVABILITY.md span model", detail=name))
+
+    # MDT206: the alert seed-rule catalog vs its pin — rule drift is
+    # caught like metric drift (ISSUE 15 satellite)
+    alerts_py = os.path.join(pkg, "obs", "alerts.py")
+    rel_alerts = "mdanalysis_mpi_tpu/obs/alerts.py"
+    if os.path.exists(alerts_py):
+        rule_names = harvest_alert_rules(alerts_py)
+        pinned_rules = harvest_pinned_alert_rules(contract_py)
+        seen: set[str] = set()
+        snake = re.compile(r"^[a-z][a-z0-9_]*$")
+        for name in rule_names:
+            if name in seen:
+                findings.append(Finding(
+                    "MDT206", rel_alerts, 0, "SEED_RULES",
+                    f"duplicate alert rule name `{name}`",
+                    detail=f"dup:{name}"))
+            seen.add(name)
+            if not snake.match(name):
+                findings.append(Finding(
+                    "MDT206", rel_alerts, 0, "SEED_RULES",
+                    f"alert rule name `{name}` is not snake_case",
+                    detail=f"case:{name}"))
+            if name not in pinned_rules:
+                findings.append(Finding(
+                    "MDT206", rel_contract, 0, "PINNED_ALERT_RULES",
+                    f"seed alert rule `{name}` is missing from "
+                    f"PINNED_ALERT_RULES", detail=name))
+        for name in pinned_rules:
+            if name not in seen:
+                findings.append(Finding(
+                    "MDT206", rel_alerts, 0, "SEED_RULES",
+                    f"pinned alert rule `{name}` is not in the "
+                    f"SEED_RULES catalog", detail=name))
+    else:
+        notes.append("MDT206 skipped: obs/alerts.py not found")
 
     if os.path.exists(bench_py):
         with open(bench_py, encoding="utf-8") as f:

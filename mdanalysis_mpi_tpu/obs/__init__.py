@@ -15,13 +15,29 @@ Three pieces over one package:
 - :mod:`~mdanalysis_mpi_tpu.obs.report` — the per-run ``RunReport``
   attached under ``results.observability``.
 
+Plus the ACTIVE layer (docs/OBSERVABILITY.md "Alerting & profiling"):
+
+- :mod:`~mdanalysis_mpi_tpu.obs.prof` — continuous sampling profiler
+  (flamegraph-collapsed stacks, per-dispatch latency histograms per
+  program geometry, RSS/staged-bytes/cache watermark sampling);
+- :mod:`~mdanalysis_mpi_tpu.obs.alerts` — declarative threshold /
+  rate / multi-window burn-rate rules evaluated over the unified (or
+  federated) snapshot on the supervisor tick;
+- :mod:`~mdanalysis_mpi_tpu.obs.baseline` — the perf-regression
+  sentinel over the bench record (``mdtpu perf``,
+  ``bench --check-baseline``).
+
 Import layering: this package imports ONLY the standard library — the
 rest of the repo (timers, executors, service, reliability) imports it,
 never the reverse, so instrumentation can thread anywhere without
 cycles.
 """
 
+from mdanalysis_mpi_tpu.obs import alerts as alerts
+from mdanalysis_mpi_tpu.obs import baseline as baseline
 from mdanalysis_mpi_tpu.obs import flight as flight
+from mdanalysis_mpi_tpu.obs import prof as prof
+from mdanalysis_mpi_tpu.obs.alerts import AlertEngine, AlertRule, seed_rules
 from mdanalysis_mpi_tpu.obs.flight import dump as flight_dump
 from mdanalysis_mpi_tpu.obs.metrics import (
     METRICS, MetricsRegistry, to_prometheus, unified_snapshot,
@@ -35,12 +51,21 @@ from mdanalysis_mpi_tpu.obs.spans import (
     enable as enable_tracing,
     enabled as tracing_enabled,
     export as export_trace,
-    maybe_enable_from_env,
     set_process_args,
     span,
     span_event,
     trace_path,
 )
+
+
+def maybe_enable_from_env() -> None:
+    """Honor the observability env knobs at every run/serve entry:
+    ``MDTPU_TRACE_OUT`` (span tracing) and ``MDTPU_PROF`` (the
+    continuous profiler).  One attribute read each once enabled."""
+    from mdanalysis_mpi_tpu.obs import spans as _spans
+
+    _spans.maybe_enable_from_env()
+    prof.maybe_enable_from_env()
 
 # run-capture helpers under their obs.* names (AnalysisBase.run calls
 # obs.start_run_capture / obs.finish_run_capture, and
@@ -55,5 +80,6 @@ __all__ = [
     "disable_tracing", "tracing_enabled", "export_trace", "trace_path",
     "maybe_enable_from_env", "set_process_args", "start_run_capture",
     "finish_run_capture", "abandon_run_capture", "flight",
-    "flight_dump",
+    "flight_dump", "prof", "alerts", "baseline", "AlertEngine",
+    "AlertRule", "seed_rules",
 ]

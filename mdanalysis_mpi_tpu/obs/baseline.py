@@ -1,0 +1,326 @@
+"""Perf-regression sentinel: the bench record, compared by machine.
+
+The repo's perf evidence (``BENCH_*.json``) has always been diffed by
+hand across rounds.  This module fingerprints a bench artifact's legs
+(shape + backend + leg name), snapshots them into a committed baseline
+file, and compares a fresh run against it with **noise-aware
+tolerances**, emitting a typed per-leg verdict — so every future PR
+(Pallas, store, streaming) gets an automatic regression verdict
+instead of a hand-read log (docs/OBSERVABILITY.md "Alerting &
+profiling").
+
+Verdicts (``compare``):
+
+``ok``
+    Within tolerance of the baseline (either direction).
+``regressed``
+    Worse than baseline by more than the leg's tolerance, in the
+    leg's bad direction (lower fps / higher overhead-pct).
+``improved``
+    Better than baseline by more than the tolerance — recorded, never
+    gated (an improvement is a prompt to refresh the baseline).
+``new``
+    Tracked leg present in the run, absent from the baseline.
+``missing``
+    Baselined leg absent (or null — e.g. an outage-truncated
+    artifact) in the run.
+
+Fingerprint discipline: a baseline only gates a run with the SAME
+shape fingerprint (atoms/frames/batch/transfer/source).  A mismatched
+fingerprint yields ``fingerprint_match: false`` and NO regressed
+verdicts — a toy-scale CI run can never false-fail against the
+flagship baseline.
+
+Surfaces:
+
+- ``python -m mdanalysis_mpi_tpu perf snapshot BENCH.json`` writes
+  the baseline file (default ``PERF_BASELINE.json``);
+- ``python -m mdanalysis_mpi_tpu perf diff BENCH.json`` renders the
+  verdict table (exit 1 when anything regressed — the CI gate);
+- ``python bench.py --check-baseline [FILE]`` embeds the same
+  verdicts in the artifact as ``baseline_check`` and fails the run
+  on a regression.
+
+Stdlib only, jax-free (dispatched like ``lint``/``status``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+DEFAULT_BASELINE = "PERF_BASELINE.json"
+
+#: The tracked legs: artifact key → (direction, tolerance, kind).
+#: ``higher`` legs are throughputs (regression = lower), ``lower``
+#: legs are overheads/latencies (regression = higher).  ``kind`` is
+#: ``rel`` (tolerance in % of the baseline value — throughputs, which
+#: are never 0 in a live artifact) or ``abs`` (tolerance in the leg's
+#: own units — the clamped overhead-percent legs, whose clean-run
+#: baseline is legitimately 0.0 and where a relative band would
+#: therefore be blind; a regression from 0 overhead to 50% MUST
+#: gate).  Tolerances are deliberately generous — they encode each
+#: leg's measured round-to-round noise (BASELINE.md), not wishful
+#: precision: a sentinel that cries wolf on timer jitter trains
+#: people to ignore it.
+LEG_FIELDS = {
+    # host flagship protocol
+    "serial_fps": ("higher", 25.0, "rel"),
+    "serial_file_fps": ("higher", 25.0, "rel"),
+    "decode_fps": ("higher", 30.0, "rel"),
+    "obs_traced_fps": ("higher", 30.0, "rel"),
+    "obs_overhead_pct": ("lower", 5.0, "abs"),
+    "prof_fps": ("higher", 30.0, "rel"),
+    "prof_overhead_pct": ("lower", 5.0, "abs"),
+    # serving tier
+    "serving_jobs_per_s": ("higher", 30.0, "rel"),
+    "serving_p99_latency_s": ("lower", 50.0, "rel"),
+    "serving_fault_recovery_jobs_per_s": ("higher", 40.0, "rel"),
+    "integrity_overhead_pct": ("lower", 5.0, "abs"),
+    "integrity_jobs_per_s": ("higher", 40.0, "rel"),
+    "integrity_fingerprint_gbps": ("higher", 40.0, "rel"),
+    # store + fleet tiers
+    "store_ingest_fps": ("higher", 40.0, "rel"),
+    "store_read_fps": ("higher", 40.0, "rel"),
+    "fleet_clean_jobs_per_s": ("higher", 40.0, "rel"),
+    "fleet_loss_jobs_per_s": ("higher", 50.0, "rel"),
+    "obs_federation_jobs_per_s": ("higher", 40.0, "rel"),
+    "obs_federation_overhead_pct": ("lower", 5.0, "abs"),
+    "qos_batch_jobs_per_s": ("higher", 40.0, "rel"),
+    # accelerator legs (present only in tunnel-up artifacts)
+    "value": ("higher", 25.0, "rel"),
+    "cold_value": ("higher", 30.0, "rel"),
+    "f32_steady_value": ("higher", 25.0, "rel"),
+    "put_gbps": ("higher", 40.0, "rel"),
+    "ms_per_dispatch": ("lower", 40.0, "rel"),
+}
+
+#: Shape fields the fingerprint binds a baseline to.
+_SHAPE_KEYS = ("atoms", "frames", "batch", "transfer", "source")
+
+BASELINE_VERSION = 1
+
+
+def fingerprint(doc: dict) -> dict:
+    """The shape identity comparisons are valid under: the artifact's
+    explicit ``shape`` block (bench emits one since this PR), with
+    the ``metric`` string as a degraded fallback for older
+    artifacts."""
+    shape = doc.get("shape")
+    if isinstance(shape, dict):
+        return {k: shape.get(k) for k in _SHAPE_KEYS}
+    return {"metric": doc.get("metric")}
+
+
+def _numeric(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool) \
+        and v == v                      # NaN is not a number we track
+
+
+def snapshot_baseline(doc: dict, fields: dict | None = None) -> dict:
+    """Build a baseline document from one bench artifact: every
+    tracked, numeric leg with its direction and tolerance."""
+    fields = fields or LEG_FIELDS
+    legs = {}
+    for name, (direction, tol, kind) in fields.items():
+        v = doc.get(name)
+        if _numeric(v):
+            leg = {"value": float(v), "direction": direction}
+            if kind == "abs":
+                leg["abs_tol"] = tol
+            else:
+                leg["rel_tol_pct"] = tol
+            legs[name] = leg
+    return {
+        "version": BASELINE_VERSION,
+        "created": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "fingerprint": fingerprint(doc),
+        "legs": legs,
+    }
+
+
+def load_baseline(path: str) -> dict:
+    with open(path, encoding="utf-8") as f:
+        base = json.load(f)
+    if not isinstance(base.get("legs"), dict):
+        raise ValueError(f"{path!r} is not a perf baseline "
+                         "(no 'legs' table)")
+    return base
+
+
+def write_baseline(base: dict, path: str) -> str:
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(base, f, indent=2, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
+def _verdict(name: str, current, leg: dict) -> dict:
+    """One leg's typed verdict record."""
+    baseline = leg["value"]
+    direction = leg.get("direction", "higher")
+    abs_tol = leg.get("abs_tol")
+    rel_tol = float(leg.get("rel_tol_pct", 20.0))
+    out = {"leg": name, "baseline": baseline,
+           "current": current if _numeric(current) else None,
+           "direction": direction, "delta_pct": None}
+    if abs_tol is not None:
+        out["abs_tol"] = float(abs_tol)
+    else:
+        out["rel_tol_pct"] = rel_tol
+    if not _numeric(current):
+        out["verdict"] = "missing"
+        return out
+    if baseline != 0:
+        out["delta_pct"] = round(
+            (current - baseline) / abs(baseline) * 100.0, 2)
+    # "worse" in the leg's own bad direction, in absolute units or
+    # baseline-relative percent depending on the tolerance kind
+    worse_abs = (baseline - current if direction == "higher"
+                 else current - baseline)
+    if abs_tol is not None:
+        worse, tol = worse_abs, float(abs_tol)
+    elif baseline == 0:
+        # relative tolerance with a zero baseline has no scale: a
+        # throughput of 0 only appears in degenerate/truncated legs —
+        # disclose, never gate either way
+        out["verdict"] = "ok" if current == 0 else "incomparable"
+        return out
+    else:
+        worse = worse_abs / abs(baseline) * 100.0
+        tol = rel_tol
+    if worse > tol:
+        out["verdict"] = "regressed"
+    elif -worse > tol:
+        out["verdict"] = "improved"
+    else:
+        out["verdict"] = "ok"
+    return out
+
+
+def compare(doc: dict, baseline: dict,
+            fields: dict | None = None) -> dict:
+    """Compare a fresh artifact against a baseline document.
+
+    Returns ``{fingerprint_match, verdicts, regressed, ok}`` —
+    ``ok`` is False only when the fingerprints match AND at least one
+    leg regressed (the gate ``bench --check-baseline`` and
+    ``perf diff`` exit on)."""
+    fields = fields or LEG_FIELDS
+    fp_run = fingerprint(doc)
+    fp_base = baseline.get("fingerprint")
+    match = fp_base == fp_run
+    verdicts = []
+    legs = baseline.get("legs", {})
+    for name in sorted(legs):
+        verdicts.append(_verdict(name, doc.get(name), legs[name]))
+    for name in sorted(fields):
+        if name not in legs and _numeric(doc.get(name)):
+            direction, tol, kind = fields[name]
+            rec = {"leg": name, "verdict": "new", "baseline": None,
+                   "current": float(doc[name]), "delta_pct": None,
+                   "direction": direction}
+            rec["abs_tol" if kind == "abs" else "rel_tol_pct"] = tol
+            verdicts.append(rec)
+    regressed = [v["leg"] for v in verdicts
+                 if v["verdict"] == "regressed"]
+    if not match:
+        # a different shape cannot regress against this baseline —
+        # disclose the mismatch instead of gating on apples-to-oranges
+        for v in verdicts:
+            if v["verdict"] in ("regressed", "improved"):
+                v["verdict"] = "incomparable"
+        regressed = []
+    return {
+        "baseline_fingerprint": fp_base,
+        "run_fingerprint": fp_run,
+        "fingerprint_match": match,
+        "verdicts": verdicts,
+        "regressed": regressed,
+        "ok": not regressed,
+    }
+
+
+# ---------------------------------------------------------------------------
+# the `perf` CLI (jax-free, dispatched like lint/status)
+# ---------------------------------------------------------------------------
+
+def _render_table(result: dict) -> str:
+    lines = []
+    if not result["fingerprint_match"]:
+        lines.append("! shape fingerprint mismatch — verdicts are "
+                     "informational only (no gate)")
+        lines.append(f"  baseline: {result['baseline_fingerprint']}")
+        lines.append(f"  run:      {result['run_fingerprint']}")
+    lines.append(f"{'leg':<36} {'verdict':<12} {'baseline':>12} "
+                 f"{'current':>12} {'delta%':>8} {'tol':>8}")
+    for v in result["verdicts"]:
+        tol = (f"{_fmt(v['abs_tol'])}abs" if "abs_tol" in v
+               else f"{_fmt(v.get('rel_tol_pct'))}%")
+        lines.append(
+            f"{v['leg']:<36} {v['verdict']:<12} "
+            f"{_fmt(v['baseline']):>12} {_fmt(v['current']):>12} "
+            f"{_fmt(v['delta_pct']):>8} {tol:>8}")
+    n_reg = len(result["regressed"])
+    lines.append(
+        f"-> {n_reg} regressed"
+        + (f" ({', '.join(result['regressed'])})" if n_reg else "")
+        + f", {sum(1 for v in result['verdicts'] if v['verdict'] == 'ok')}"
+          " ok")
+    return "\n".join(lines)
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.4g}"
+    return str(v)
+
+
+def perf_main(argv=None) -> int:
+    """Entry point of the ``perf`` subcommand."""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="mdanalysis_mpi_tpu perf",
+        description="perf-regression sentinel over the bench record "
+                    "(docs/OBSERVABILITY.md): snapshot a baseline "
+                    "from a bench artifact, diff a fresh run against "
+                    "it with noise-aware tolerances")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    ps = sub.add_parser("snapshot",
+                        help="write a baseline from a bench artifact")
+    ps.add_argument("artifact", help="BENCH_*.json (the single JSON "
+                                     "object bench.py prints)")
+    ps.add_argument("--out", default=DEFAULT_BASELINE,
+                    help=f"baseline path (default {DEFAULT_BASELINE})")
+    pd = sub.add_parser("diff",
+                        help="compare a bench artifact against the "
+                             "baseline; exit 1 on any regressed leg")
+    pd.add_argument("artifact")
+    pd.add_argument("--baseline", default=DEFAULT_BASELINE)
+    pd.add_argument("--json", action="store_true",
+                    help="print the raw comparison JSON instead of "
+                         "the table")
+    ns = p.parse_args(argv)
+
+    with open(ns.artifact, encoding="utf-8") as f:
+        doc = json.load(f)
+    if ns.cmd == "snapshot":
+        base = snapshot_baseline(doc)
+        path = write_baseline(base, ns.out)
+        print(json.dumps({"baseline": path,
+                          "legs": sorted(base["legs"]),
+                          "fingerprint": base["fingerprint"]}))
+        return 0
+    base = load_baseline(ns.baseline)
+    result = compare(doc, base)
+    if ns.json:
+        print(json.dumps(result, indent=2, sort_keys=True))
+    else:
+        print(_render_table(result))
+    return 0 if result["ok"] else 1

@@ -63,6 +63,7 @@ def dump(trigger: str, directory: str | None,
     if not directory:
         return None
     from mdanalysis_mpi_tpu.obs import metrics as _metrics
+    from mdanalysis_mpi_tpu.obs import prof as _prof
     from mdanalysis_mpi_tpu.obs import spans as _spans
 
     with _SEQ_LOCK:
@@ -82,6 +83,10 @@ def dump(trigger: str, directory: str | None,
         "events": _spans.tail(limit=limit),
         "tracing": _spans.enabled(),
         "metrics": _metrics.unified_snapshot(),
+        # the memory picture at the incident: sampler peaks when the
+        # continuous profiler ran, a one-shot RSS read when it did
+        # not (obs/prof.py watermark_block)
+        "profiler": _prof.watermark_block(),
     }
     try:
         # intra-package import: obs stays stdlib-only externally, and

@@ -300,6 +300,42 @@ QOS_GAUGES = (
     "mdtpu_slo_attainment",
 )
 
+#: Continuous-profiler counters (obs/prof.py — docs/OBSERVABILITY.md
+#: "Alerting & profiling"): sampler ticks, recorded live by the
+#: sampling thread; zero-injected so a process that never profiled
+#: still carries the schema.
+PROF_COUNTERS = (
+    "mdtpu_prof_samples_total",
+)
+
+#: Profiler watermark gauges: current/peak RSS as sampled by the
+#: profiler's watermark tick (0 = profiler never ran here).
+PROF_GAUGES = (
+    "mdtpu_prof_rss_bytes",
+    "mdtpu_prof_rss_peak_bytes",
+)
+
+#: Profiler histograms: per-dispatch kernel latency, labeled by
+#: program geometry (``geometry=`` — batch size × scan group length;
+#: obs/prof.py note_dispatch).  Zero-injected with an EMPTY series
+#: set: a histogram has no meaningful zero point, but the name/type
+#: must hold in every snapshot for the pinned schema.
+PROF_HISTOGRAMS = (
+    "mdtpu_dispatch_ms",
+)
+
+#: Alerting series (obs/alerts.py — docs/OBSERVABILITY.md "Alerting &
+#: profiling"): per-rule firing level (1 while any series of the rule
+#: fires) and the firing/resolved transition counter (labeled
+#: ``rule=``/``to=``).  Recorded live at each transition;
+#: zero-injected so a healthy process still carries the schema.
+ALERT_COUNTERS = (
+    "mdtpu_alert_transitions_total",
+)
+ALERT_GAUGES = (
+    "mdtpu_alerts_firing",
+)
+
 
 def _merge_host_snapshot(snap: dict, hid: str, host_snap: dict) -> None:
     """Fold one host's shipped snapshot into the fleet document (the
@@ -370,10 +406,16 @@ def unified_snapshot(timers=None, cache=None, telemetry=None,
     for name in COMPILE_METRICS + BREAKER_COUNTERS + \
             SUPERVISION_COUNTERS + RELIABILITY_COUNTERS + \
             INTEGRITY_COUNTERS + SCRUB_COUNTERS + STORE_COUNTERS + \
-            FLEET_COUNTERS + FLEET_OBS_COUNTERS + QOS_COUNTERS:
+            FLEET_COUNTERS + FLEET_OBS_COUNTERS + QOS_COUNTERS + \
+            PROF_COUNTERS + ALERT_COUNTERS:
         snap.setdefault(name, {"type": "counter", "values": {"": 0}})
+    for name in PROF_HISTOGRAMS:
+        # empty series set: a histogram carries no zero point, but
+        # the pinned schema needs the name/type in every snapshot
+        snap.setdefault(name, {"type": "histogram", "values": {}})
     for name in BREAKER_GAUGES + LINT_GAUGES + INTEGRITY_GAUGES \
-            + FLEET_GAUGES + FLEET_OBS_GAUGES + QOS_GAUGES:
+            + FLEET_GAUGES + FLEET_OBS_GAUGES + QOS_GAUGES \
+            + PROF_GAUGES + ALERT_GAUGES:
         # 0 == closed (reliability/breaker.py STATE_VALUES): a process
         # that never tripped a breaker reports the healthy state;
         # likewise 0 lint rules/findings means "never linted here"

@@ -104,4 +104,12 @@ def finish_capture(cap: dict, analysis: str, backend: str,
         "tracing": _spans.enabled(),
         "trace_out": _spans.trace_path(),
     }
+    from mdanalysis_mpi_tpu.obs import prof as _prof
+
+    if _prof.enabled():
+        # the continuous profiler's process-level summary rides the
+        # run report when sampling is on (docs/OBSERVABILITY.md
+        # "Alerting & profiling"); absent otherwise — the report must
+        # stay byte-identical for profiler-off runs
+        report["profiler"] = _prof.run_summary()
     return report

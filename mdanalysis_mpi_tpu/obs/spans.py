@@ -404,6 +404,21 @@ def span_event(name: str, **args) -> None:
     _instant(name, args, "mdtpu")
 
 
+def counter_event(name: str, **values) -> None:
+    """Record a Chrome counter event (``ph:"C"``) — Perfetto renders
+    the values as a stacked area row (the profiler's RSS/watermark
+    line, obs/prof.py).  No-op when disabled."""
+    if not _STATE.enabled:
+        return
+    st = _STATE
+    th = threading.current_thread()
+    tid = th.ident or 0
+    ev = {"ph": "C", "cat": "mdtpu", "name": name,
+          "ts": round((time.perf_counter() - st.t0) * 1e6, 1),
+          "pid": _PID, "tid": tid, "args": values}
+    _append(ev, tid, th.name)
+
+
 def log_mark(name: str, **args) -> None:
     """Mirror one structured log event onto the span timeline
     (``cat: "log"`` instant), so :func:`tail` and the flight recorder

@@ -35,8 +35,11 @@ remains the exact f64 oracle.
 
 from __future__ import annotations
 
+import time as _time
+
 import numpy as np
 
+from mdanalysis_mpi_tpu.obs import prof as _prof
 from mdanalysis_mpi_tpu.obs import spans as _spans
 from mdanalysis_mpi_tpu.parallel.partition import iter_batches, pad_batch
 from mdanalysis_mpi_tpu.reliability import faults as _faults
@@ -1215,6 +1218,9 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
 
     def consume(staged):
         nonlocal total
+        # continuous-profiler dispatch latency (obs/prof.py): one
+        # perf_counter pair per dispatch, only while sampling is on
+        _pt0 = _time.perf_counter() if _prof.enabled() else None
         with TIMERS.phase("dispatch", scan_k=1):
 
             def _dispatch():
@@ -1232,6 +1238,9 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
                 parts_list.append(out)
             else:
                 total = out
+        if _pt0 is not None:
+            _prof.note_dispatch((_time.perf_counter() - _pt0) * 1e3,
+                                geometry=f"bs{bs}_scan1")
 
     # ---- scan-folded dispatch bookkeeping (scan_active only) ----
     #
@@ -1265,10 +1274,12 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
         def consume_scan(stacked):
             """ONE dispatch for a whole HBM-resident K-block group."""
             nonlocal total
+            n_blocks = int(stacked[0].shape[0])
+            _pt0 = _time.perf_counter() if _prof.enabled() else None
             # span tag: this single dispatch covers a K-block scan
             # group (the dispatch-count shrink docs/DISPATCH.md claims)
             with TIMERS.phase("dispatch", scan_k=scan_k,
-                              blocks=int(stacked[0].shape[0])):
+                              blocks=n_blocks):
 
                 def _dispatch():
                     if _faults.plans():
@@ -1285,6 +1296,13 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
                     parts_list.append(out)
                 else:
                     total = out
+            if _pt0 is not None:
+                # program geometry = batch size × scan group length
+                # (the jitted scan shape — the uneven tail group is
+                # its own program and labels itself)
+                _prof.note_dispatch(
+                    (_time.perf_counter() - _pt0) * 1e3,
+                    geometry=f"bs{bs}_scan{n_blocks}")
 
         def _flush_hits_before(gi_limit):
             """Consume, in order, every not-yet-consumed HIT group that

@@ -58,6 +58,7 @@ import threading
 import time
 
 from mdanalysis_mpi_tpu import obs
+from mdanalysis_mpi_tpu.obs import alerts as _alerts
 from mdanalysis_mpi_tpu.obs import flight as _flight
 from mdanalysis_mpi_tpu.obs import spans as _spans
 from mdanalysis_mpi_tpu.reliability import breaker as _breaker
@@ -289,6 +290,14 @@ class FleetController:
         ``mdtpu_hosts_scaled_{up,down}_total``.  ``autoscale_spawn``
         is the kwargs dict :meth:`spawn_host` gets for autoscaled
         hosts (backend, cache_mb, env, ...).
+    ``alerts`` / ``alert_interval_s``
+        The alert rules engine (obs/alerts.py, docs/OBSERVABILITY.md
+        "Alerting & profiling") evaluated over the FEDERATED
+        snapshot on the supervisor tick: transitions are journaled
+        (``ev: "alert"``), the first firing of a rule drops one
+        flight-recorder black box into the workdir, and the firing
+        table rides ``/status``.  ``None`` → seed rules sharing this
+        controller's clock/journal/workdir; ``False`` → off.
     """
 
     def __init__(self, workdir, epoch: int = 1, host_ttl_s: float = 3.0,
@@ -305,6 +314,7 @@ class FleetController:
                  scale_cooldown_s: float = 1.0,
                  retire_drain_s: float = 10.0,
                  autoscale_spawn: dict | None = None,
+                 alerts=None, alert_interval_s: float = 1.0,
                  _recovered: dict | None = None):
         from mdanalysis_mpi_tpu.service import qos as _qosmod
 
@@ -374,6 +384,22 @@ class FleetController:
                             controller=os.getpid())
         obs.METRICS.set_gauge("mdtpu_controller_epoch", self.epoch)
         obs.span_event("epoch_adopted", epoch=self.epoch)
+        # ---- alert rules engine (obs/alerts.py): evaluated over the
+        #      FEDERATED snapshot on the supervisor tick — a class
+        #      burning its SLO budget anywhere in the fleet fires at
+        #      the controller; transitions are journaled (`ev:
+        #      "alert"`) and the first firing drops a black box into
+        #      the workdir.  None → seed rules; False → off. ----
+        if alerts is False:
+            self.alerts = None
+        elif isinstance(alerts, _alerts.AlertEngine):
+            self.alerts = alerts
+        else:
+            self.alerts = _alerts.AlertEngine(
+                rules=alerts, clock=clock, flight_dir=self.workdir,
+                journal=self.journal)
+        self.alert_interval_s = float(alert_interval_s)
+        self._alert_last = float("-inf")
         if _recovered:
             self._resubmit_recovered(_recovered)
             # adoption black box (docs/OBSERVABILITY.md): what the
@@ -744,10 +770,28 @@ class FleetController:
             obs.METRICS.inc("mdtpu_fleet_obs_trace_dropped_total",
                             overflow, site="controller")
 
+    def _prune_host_gauges(self, hid: str) -> None:
+        """Drop a LOST host's gauge-type series from its retained
+        snapshot.  Counters and histograms stay (fleet totals must
+        not dip on a crash), but a gauge is a point-in-time level of
+        a process that no longer exists — keeping it would freeze a
+        stale reading into the federated document forever, e.g. a bad
+        ``mdtpu_slo_attainment`` that holds a burn-rate alert firing
+        after every one of that host's jobs migrated and recovered."""
+        with self._obs_lock:
+            snap = self._host_metrics.get(hid)
+            if not snap:
+                return
+            for name in [n for n, s in snap.items()
+                         if isinstance(s, dict)
+                         and s.get("type") == "gauge"]:
+                del snap[name]
+
     def host_metrics(self) -> dict:
         """``{host_id: latest merged metric series}`` (copies).  A
-        lost host's last-reported series stay — fleet counter totals
-        must not dip when a host dies."""
+        lost host's last-reported counter/histogram series stay —
+        fleet totals must not dip when a host dies — while its gauges
+        are pruned at the loss (see :meth:`_prune_host_gauges`)."""
         with self._obs_lock:
             return {hid: dict(m)
                     for hid, m in self._host_metrics.items()}
@@ -1191,6 +1235,7 @@ class FleetController:
             host.inflight.clear()
             n_alive = sum(1 for h in self._hosts.values() if h.alive)
         self.telemetry.count("hosts_lost")
+        self._prune_host_gauges(hid)
         obs.METRICS.inc("mdtpu_hosts_lost_total", reason=reason)
         obs.METRICS.set_gauge("mdtpu_hosts_alive", n_alive)
         obs.span_event("host_lost", host=hid, reason=reason,
@@ -1432,6 +1477,11 @@ class FleetController:
                                 reason="scale_down")
         _send_line(host.sock, host.send_lock,
                    {"cmd": "stop", "epoch": self.epoch})
+        # a retired process's gauge levels are as dead as a crashed
+        # one's: prune them like _lose_host does, or a bad last-ship
+        # (queue depth, attainment) stays frozen in the federated
+        # snapshot and holds alerts firing forever
+        self._prune_host_gauges(hid)
         self.telemetry.count("hosts_scaled_down")
         obs.METRICS.inc("mdtpu_hosts_scaled_down_total")
         obs.METRICS.set_gauge("mdtpu_hosts_alive", n_alive)
@@ -1475,6 +1525,37 @@ class FleetController:
             # what capacity cannot absorb, then breathe the host set
             self._shed_pending()
             self._autoscale_tick(now)
+            # alert tick (obs/alerts.py): the rules read the MERGED
+            # fleet snapshot — the same document /metrics exposes
+            self._alert_tick(now)
+
+    def _alert_tick(self, now: float | None = None,
+                    force: bool = False) -> list:
+        """Evaluate the alert rules over the federated snapshot (the
+        supervisor calls this every tick; the interval bound keeps
+        the merge cost off the tick cadence).  Returns this tick's
+        transitions."""
+        if self.alerts is None:
+            return []
+        if now is None:
+            now = self._clock()
+        if not force and now - self._alert_last < self.alert_interval_s:
+            return []
+        self._alert_last = now
+        snap = self.fleet_snapshot()
+        # the controller's OWN backlog (jobs no host slot could take)
+        # is the fleet-tier saturation signal, and it lives in neither
+        # the host snapshots nor FleetTelemetry — overlay it as the
+        # unlabeled mdtpu_queue_depth series (hosts' depths arrive
+        # labeled host=, distinct) so queue_saturated sees the fleet
+        # actually saturating, not just each host's bounded local queue
+        with self._lock:
+            pending = len(self._pending)
+        snap.setdefault("mdtpu_queue_depth",
+                        {"type": "gauge", "values": {}})
+        if snap["mdtpu_queue_depth"]["type"] == "gauge":
+            snap["mdtpu_queue_depth"]["values"][""] = pending
+        return self.alerts.evaluate(snap, now=now)
 
     # ---- lifecycle ----
 
@@ -1568,6 +1649,10 @@ class FleetController:
                 for (backend, mesh), st
                 in self.breakers.states().items()},
             "telemetry": self.telemetry.snapshot(),
+            # firing/resolved alerts (obs/alerts.py) — what
+            # `mdtpu status --alerts` renders
+            "alerts": (self.alerts.status()
+                       if self.alerts is not None else None),
         }
         return out
 

@@ -63,7 +63,9 @@ import time
 import traceback as _traceback
 
 from mdanalysis_mpi_tpu import obs
+from mdanalysis_mpi_tpu.obs import alerts as _alerts
 from mdanalysis_mpi_tpu.obs import flight as _flight
+from mdanalysis_mpi_tpu.obs import prof as _prof
 from mdanalysis_mpi_tpu.reliability import breaker as _breaker
 from mdanalysis_mpi_tpu.reliability import faults as _faults
 from mdanalysis_mpi_tpu.service import coalesce as _coalesce
@@ -162,6 +164,17 @@ class Scheduler:
         ``shed``), and the runaway-job lease caps.  None → a default
         policy whose admission/shed/cap knobs are all OFF, so
         pre-QoS callers see byte-identical behavior.
+    ``alerts`` / ``alert_interval_s``
+        The alert rules engine (obs/alerts.py, docs/OBSERVABILITY.md
+        "Alerting & profiling"): evaluated over
+        ``unified_snapshot(timers=, cache=, telemetry=)`` on the
+        supervisor tick, at most every ``alert_interval_s`` seconds
+        on the scheduler's (injectable) clock.  ``None`` builds the
+        seed-rule engine sharing this scheduler's clock, flight dir
+        and journal; ``False`` disables alerting; an
+        :class:`~mdanalysis_mpi_tpu.obs.alerts.AlertEngine` (or a
+        rule list) is used as-is.  Firing/resolving alerts land in
+        the ``/status`` ``alerts`` block.
     """
 
     def __init__(self, n_workers: int = 1, cache=None,
@@ -174,7 +187,8 @@ class Scheduler:
                  scrub: bool = False, scrub_interval_s: float = 5.0,
                  mem_guard_bytes: int | None = None,
                  flight_dir: str | None = None,
-                 qos: "_qos.QosPolicy | None" = None):
+                 qos: "_qos.QosPolicy | None" = None,
+                 alerts=None, alert_interval_s: float = 1.0):
         self.cache = cache
         # ---- QoS + overload policy (docs/RELIABILITY.md §7) ----
         self.qos = qos or _qos.QosPolicy()
@@ -219,6 +233,21 @@ class Scheduler:
         # quarantine and worker fencing; off with no resolvable dir
         self._flight_dir = _flight.flight_dir(
             flight_dir, journal if self._owns_journal else None)
+        # ---- alert rules engine (obs/alerts.py, docs/OBSERVABILITY.md
+        #      "Alerting & profiling"): evaluated over the unified
+        #      snapshot on the supervisor tick, every
+        #      ``alert_interval_s``.  ``alerts`` is an AlertEngine, a
+        #      rule list, None (seed rules), or False (off). ----
+        if alerts is False:
+            self.alerts = None
+        elif isinstance(alerts, _alerts.AlertEngine):
+            self.alerts = alerts
+        else:
+            self.alerts = _alerts.AlertEngine(
+                rules=alerts, clock=clock,
+                flight_dir=self._flight_dir, journal=self.journal)
+        self.alert_interval_s = float(alert_interval_s)
+        self._alert_last = float("-inf")
         # live status endpoint (service/statusd.py), opt-in via
         # serve_status() / the batch CLI's --status-port
         self._statusd = None
@@ -261,6 +290,19 @@ class Scheduler:
             if self._workers:
                 return
             self._shutdown = False
+            # watermark sources for the continuous profiler
+            # (obs/prof.py): polled only while the sampler runs —
+            # registering is one dict write either way.  The fns are
+            # kept so teardown unregisters ONLY its own (a second
+            # scheduler taking the name over must not lose it when
+            # this one shuts down)
+            self._wm_sources = {
+                "staged_bytes": lambda: self._staged_inflight}
+            if self.cache is not None:
+                self._wm_sources["cache_bytes"] = \
+                    lambda: self.cache._bytes
+            for name, fn in self._wm_sources.items():
+                _prof.register_watermark(name, fn)
             for i in range(self.n_workers):
                 t = threading.Thread(target=self._worker_outer,
                                      daemon=True,
@@ -395,6 +437,10 @@ class Scheduler:
             "leases": leases,
             "quarantined": quarantined,
             "telemetry": self.telemetry.snapshot(cache=self.cache),
+            # firing/resolved alerts (obs/alerts.py) — what
+            # `mdtpu status --alerts` renders
+            "alerts": (self.alerts.status()
+                       if self.alerts is not None else None),
         }
         if self.breakers is not None:
             out["breakers"] = {
@@ -432,6 +478,8 @@ class Scheduler:
         """Idempotent final cleanup, only once no worker can still
         need a heartbeat or a journal record."""
         _timers.remove_phase_hook(self._sup.heartbeat)
+        for name, fn in getattr(self, "_wm_sources", {}).items():
+            _prof.unregister_watermark(name, fn)
         if self._statusd is not None:
             self._statusd.close()
             self._statusd = None
@@ -979,6 +1027,10 @@ class Scheduler:
             # the next submit() to notice
             if not stop:
                 self._maybe_shed()
+                # alert tick (obs/alerts.py): the rules engine reads
+                # the same unified snapshot /metrics exposes, at most
+                # every alert_interval_s on the injectable clock
+                self._alert_tick()
             if stop:
                 # a worker death AFTER shutdown can requeue a handle
                 # no one will ever claim (respawn stops at shutdown):
@@ -988,6 +1040,21 @@ class Scheduler:
                         "scheduler shut down with no remaining "
                         "workers to claim this requeued job")
                 return
+
+    def _alert_tick(self, force: bool = False) -> list:
+        """Evaluate the alert rules over this scheduler's unified
+        snapshot (the supervisor calls this every pass; the interval
+        bound keeps the snapshot cost off the 50 ms supervision
+        cadence).  Returns this tick's transitions."""
+        if self.alerts is None:
+            return []
+        now = self._clock()
+        if not force and now - self._alert_last < self.alert_interval_s:
+            return []
+        self._alert_last = now
+        snap = obs.unified_snapshot(timers=TIMERS, cache=self.cache,
+                                    telemetry=self.telemetry)
+        return self.alerts.evaluate(snap, now=now)
 
     def _reap_locked(self) -> tuple:
         """Reap due leases; returns ``(quarantines, fences, capped)``

@@ -192,6 +192,44 @@ def _print_human(doc: dict) -> None:
     quarantined = doc.get("quarantined")
     if quarantined:
         print(f"  quarantined: {', '.join(map(str, quarantined))}")
+    alerts = doc.get("alerts")
+    if isinstance(alerts, dict) and alerts.get("firing"):
+        print(f"  alerts firing: {len(alerts['firing'])} "
+              "(see --alerts)")
+
+
+def _fmt_at(v) -> str:
+    return "-" if v is None else f"{float(v):.3f}"
+
+
+def _print_alerts(doc: dict) -> None:
+    """The ``--alerts`` view: the firing table plus the recent
+    firing/resolved transition history from the ``/status``
+    ``alerts`` block (obs/alerts.py)."""
+    alerts = doc.get("alerts")
+    if not isinstance(alerts, dict):
+        print("no alerts block (alerting disabled on this endpoint)")
+        return
+    firing = alerts.get("firing") or []
+    print(f"{len(firing)} alert(s) firing "
+          f"({len(alerts.get('rules') or [])} rule(s) registered)")
+    if firing:
+        print(f"  {'rule':<24} {'series':<28} {'value':>10} "
+              f"{'since':>10}")
+        for a in firing:
+            print(f"  {a.get('rule', '?'):<24} "
+                  f"{a.get('series') or '-':<28} "
+                  f"{_fmt_at(a.get('value')):>10} "
+                  f"{_fmt_at(a.get('since')):>10}")
+    recent = alerts.get("recent") or []
+    if recent:
+        print("  recent transitions:")
+        for tr in recent[-16:]:
+            print(f"    {_fmt_at(tr.get('at')):>10}  "
+                  f"{tr.get('rule', '?'):<24} "
+                  f"{tr.get('state', '?'):<9} "
+                  f"{tr.get('series') or '-':<28} "
+                  f"value={_fmt_at(tr.get('value'))}")
 
 
 def status_main(argv=None) -> int:
@@ -211,6 +249,10 @@ def status_main(argv=None) -> int:
     p.add_argument("--json", action="store_true",
                    help="print the raw /status JSON instead of the "
                         "human-readable table")
+    p.add_argument("--alerts", action="store_true",
+                   help="render the firing/resolved alert table from "
+                        "the /status alerts block (obs/alerts.py, "
+                        "docs/OBSERVABILITY.md)")
     p.add_argument("--timeout", type=float, default=5.0)
     ns = p.parse_args(argv)
     try:
@@ -221,6 +263,8 @@ def status_main(argv=None) -> int:
         return 1
     if ns.json:
         print(json.dumps(doc, indent=2, sort_keys=True, default=str))
+    elif ns.alerts:
+        _print_alerts(doc)
     else:
         _print_human(doc)
     return 0

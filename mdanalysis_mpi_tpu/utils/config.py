@@ -253,6 +253,13 @@ def main(argv=None) -> int:
         from mdanalysis_mpi_tpu.service.statusd import status_main
 
         return status_main(args[1:])
+    if args and args[0] == "perf":
+        # perf-regression sentinel over the bench record
+        # (docs/OBSERVABILITY.md "Alerting & profiling") — pure JSON
+        # artifact compare, jax-free like lint/status
+        from mdanalysis_mpi_tpu.obs.baseline import perf_main
+
+        return perf_main(args[1:])
     if args and args[0] == "lint":
         # repo-native static analysis (lint/ subsystem): concurrency
         # discipline, jit/jaxpr contracts, schema drift — docs/LINT.md.

@@ -126,7 +126,33 @@ PINNED_METRICS = {
     "mdtpu_hosts_scaled_up_total": "counter",
     "mdtpu_hosts_scaled_down_total": "counter",
     "mdtpu_slo_attainment": "gauge",
+    # continuous profiler (obs/prof.py, docs/OBSERVABILITY.md
+    # "Alerting & profiling"): sampler ticks + RSS watermarks,
+    # recorded live by the sampling thread, and the per-dispatch
+    # kernel-latency histogram labeled by program geometry —
+    # zero-injected everywhere else
+    "mdtpu_prof_samples_total": "counter",
+    "mdtpu_prof_rss_bytes": "gauge",
+    "mdtpu_prof_rss_peak_bytes": "gauge",
+    "mdtpu_dispatch_ms": "histogram",
+    # alerting (obs/alerts.py): per-rule firing level and the
+    # firing/resolved transition counter, recorded live at each
+    # transition — zero-injected everywhere else
+    "mdtpu_alerts_firing": "gauge",
+    "mdtpu_alert_transitions_total": "counter",
 }
+
+#: The alert seed-rule catalog (obs/alerts.py SEED_RULES) — pinned so
+#: rule drift is caught like metric drift (`mdtpu lint` MDT206 diffs
+#: both directions statically; test_alert_seed_rules_pinned does it
+#: in-process).
+PINNED_ALERT_RULES = (
+    "slo_burn_rate",
+    "queue_saturated",
+    "shed_rate_high",
+    "data_corruption",
+    "breaker_flapping",
+)
 
 
 @pytest.mark.slow
@@ -145,6 +171,12 @@ def test_bench_json_contract(tmp_path):
         # at this scale, globbed away in the finally block below)
         BENCH_SOURCE="file",
         BENCH_PARTIAL_PATH=partial,
+        # pin the obs/prof env knobs OFF: an operator's ambient
+        # MDTPU_PROF=1 / MDTPU_TRACE_OUT would flip the overhead legs
+        # into their "already on" skip branches (None fields) and
+        # false-fail the assertions below
+        MDTPU_PROF="",
+        MDTPU_TRACE_OUT="",
     )
     try:
         proc = subprocess.run([sys.executable,
@@ -264,13 +296,44 @@ def test_bench_json_contract(tmp_path):
                     # r9: observability — the host-leg tracing-on/off
                     # delta and the unified metrics block
                     # (docs/OBSERVABILITY.md)
-                    "obs_overhead_pct", "obs_traced_fps", "metrics"):
+                    "obs_overhead_pct", "obs_traced_fps", "metrics",
+                    # continuous profiler (obs/prof.py): the sampling
+                    # on-vs-off delta on the same host protocol
+                    # (<3% target at flagship scale), the sample
+                    # count, and the bit-compat parity disclosure —
+                    # plus the shape fingerprint the perf-regression
+                    # sentinel (obs/baseline.py) binds baselines to
+                    "prof_overhead_pct", "prof_fps", "prof_samples",
+                    "prof_parity_ok", "shape"):
             assert key in rec, f"missing {key} in {sorted(rec)}"
         # observability overhead: tracing must be near-free on the
         # flagship host protocol (<3% target at flagship scale; this
         # toy-scale run allows timer noise headroom)
         assert 0 <= rec["obs_overhead_pct"] < 15
         assert rec["obs_traced_fps"] > 0
+        # continuous profiler: sampled the leg, changed nothing
+        # (bit-compat parity), overhead disclosed.  The <3% target
+        # reads at flagship scale (seconds-long legs); this toy run's
+        # tens-of-ms window under 2 ms sampling is all timer noise,
+        # so only sanity-bound the disclosure here
+        assert rec["prof_parity_ok"] is True
+        assert rec["prof_samples"] > 0
+        assert 0 <= rec["prof_overhead_pct"] <= 100
+        # the sentinel's shape fingerprint mirrors this run's env
+        assert rec["shape"]["atoms"] == 2000
+        assert rec["shape"]["frames"] == 96
+        # an artifact must round-trip the sentinel cleanly: a baseline
+        # snapshotted from this run compares `ok` against the same run
+        # (the --check-baseline clean-pass proof without a second
+        # slow subprocess)
+        from mdanalysis_mpi_tpu.obs import baseline as _baseline
+
+        base = _baseline.snapshot_baseline(rec)
+        cmp_res = _baseline.compare(rec, base)
+        assert cmp_res["fingerprint_match"] is True
+        assert cmp_res["regressed"] == [] and cmp_res["ok"] is True
+        assert all(v["verdict"] == "ok" for v in cmp_res["verdicts"]
+                   if v["verdict"] != "new")
         # integrity sub-leg: the persistence stack ran (jobs/s > 0),
         # its overhead is a sane percentage (<3% target at flagship
         # scale; toy-scale fsyncs get generous headroom), every
@@ -799,7 +862,25 @@ PINNED_LINT_RULES = (
     "MDT203",   # metric-undocumented
     "MDT204",   # span-undocumented
     "MDT205",   # bench-key-drift
+    "MDT206",   # alert-rule-drift (ISSUE 15: the seed catalog pin)
 )
+
+
+def test_alert_seed_rules_pinned():
+    """The alert seed-rule catalog matches its pin exactly — the
+    in-process twin of `mdtpu lint` MDT206 (names unique, snake_case,
+    no drift in either direction)."""
+    sys.path.insert(0, REPO)
+    import re
+
+    from mdanalysis_mpi_tpu.obs.alerts import SEED_RULES, seed_rules
+
+    names = [r["name"] for r in SEED_RULES]
+    assert names == list(PINNED_ALERT_RULES)
+    assert len(set(names)) == len(names)
+    assert all(re.match(r"^[a-z][a-z0-9_]*$", n) for n in names)
+    # the catalog VALIDATES: every seed spec builds a rule
+    assert [r.name for r in seed_rules()] == names
 
 
 def test_lint_rule_ids_pinned():
