@@ -764,9 +764,11 @@ def store_host_leg(u_file, heavy_sel, s_oracle, decode_fps) -> dict:
         div = float(np.abs(np.asarray(s_store.results.rmsf)
                            - np.asarray(s_oracle.results.rmsf)).max())
         parity = "PASS" if div <= 1e-3 else "FAIL"
-        rejects = METRICS.snapshot().get(
+        # the reject counter is reason-labeled (corrupt|unavailable):
+        # a clean pass must read 0 across every reason
+        rejects = sum(METRICS.snapshot().get(
             "mdtpu_store_chunk_crc_rejects_total",
-            {"values": {}})["values"].get("", 0)
+            {"values": {}})["values"].values())
         base.update(
             store_ingest_fps=round(summary["store_ingest_fps"], 2),
             store_read_fps=round(read_fps, 2),
@@ -781,6 +783,124 @@ def store_host_leg(u_file, heavy_sel, s_oracle, decode_fps) -> dict:
         return base
     finally:
         shutil.rmtree(store_dir, ignore_errors=True)
+
+
+def remote_store_host_leg(u_file, heavy_sel, s_oracle) -> dict:
+    """Remote chunk tier vs the degradation ladder (docs/STORE.md
+    "Remote backend") — host-side, before any jax contact.  Protocol:
+    one timed content-addressed ingest through an in-process
+    ``ChunkServer``, a second-tenant re-ingest proving dedup
+    (``remote_store_dedup_ratio`` must read 1.0: identical payloads
+    share CAS objects), a warm read wave through the per-host chunk
+    cache (``remote_store_cache_hit_rate`` from the live registry),
+    then a HARD OUTAGE wave — every remote request 503s, the breaker
+    must open, and the same reads must keep flowing from the warm
+    cache at ``remote_store_outage_read_fps``.  Parity is gated the
+    same way as the local store leg (serial AlignedRMSF vs the
+    file-reader oracle, 1e-3)."""
+    base = {"remote_store_ingest_fps": None,
+            "remote_store_read_fps": None,
+            "remote_store_dedup_ratio": None,
+            "remote_store_cache_hit_rate": None,
+            "remote_store_outage_read_fps": None,
+            "remote_store_breaker_opened": None,
+            "remote_store_parity": None}
+    if SOURCE != "file":
+        base["remote_store_note"] = ("BENCH_SOURCE=memory: no file "
+                                     "to ingest")
+        return base
+    import tempfile
+
+    from mdanalysis_mpi_tpu.io.store import (
+        ChunkCache, ChunkServer, HttpStoreBackend, ServerFault,
+        StoreReader, ingest,
+    )
+    from mdanalysis_mpi_tpu.io.store.manifest import load_manifest
+    from mdanalysis_mpi_tpu.obs import METRICS
+
+    def _counter(name):
+        return sum(METRICS.snapshot().get(
+            name, {"values": {}})["values"].values())
+
+    window = min(N_FRAMES,
+                 max(SERIAL_FRAMES,
+                     int(os.environ.get("BENCH_REMOTE_STORE_FRAMES",
+                                        "512"))))
+    with tempfile.TemporaryDirectory() as td, \
+            ChunkServer(os.path.join(td, "srv")) as srv:
+        cache = ChunkCache()
+        be = HttpStoreBackend(srv.url, store="bench", cache=cache,
+                              retries=1, backoff_s=0.01,
+                              breaker_threshold=1,
+                              breaker_cooldown_s=30.0)
+        summary = ingest(u_file.trajectory, backend=be,
+                         chunk_frames=BATCH, quant="int16",
+                         stop=window)
+        # a second tenant ingesting the same trajectory must move
+        # ZERO chunk bytes: every chunk dedups to tenant one's CAS
+        # objects (client-side exists() probe, docs/STORE.md)
+        be2 = HttpStoreBackend(srv.url, store="bench2", cache=cache,
+                               retries=1, backoff_s=0.01)
+        summary2 = ingest(u_file.trajectory, backend=be2,
+                          chunk_frames=BATCH, quant="int16",
+                          stop=window)
+        # warm-up pass populates the per-host chunk cache; the timed
+        # wave then runs on a FRESH reader (cold decoded-chunk LRU,
+        # warm ChunkCache) so every fetch really reads through the
+        # cache-first ladder rung
+        warmup = StoreReader(srv.url + "/stores/bench", backend=be)
+        for lo in range(0, window, BATCH):
+            warmup.stage_block(lo, min(lo + BATCH, window),
+                               sel=heavy_sel, quantize=True)
+        hits0, miss0 = (_counter("mdtpu_store_cache_hits_total"),
+                        _counter("mdtpu_store_cache_misses_total"))
+        reader = StoreReader(srv.url + "/stores/bench", backend=be)
+        t0 = time.perf_counter()
+        for lo in range(0, window, BATCH):
+            reader.stage_block(lo, min(lo + BATCH, window),
+                               sel=heavy_sel, quantize=True)
+        read_fps = window / (time.perf_counter() - t0)
+        hits = _counter("mdtpu_store_cache_hits_total") - hits0
+        miss = _counter("mdtpu_store_cache_misses_total") - miss0
+        hit_rate = (round(hits / (hits + miss), 4)
+                    if hits + miss else None)
+        # parity off the remote tier, same bar as the local store leg
+        u_remote = Universe(u_file.topology,
+                            StoreReader(srv.url + "/stores/bench",
+                                        backend=be))
+        s_remote = AlignedRMSF(u_remote, select=SELECT).run(
+            stop=SERIAL_FRAMES, backend="serial")
+        div = float(np.abs(np.asarray(s_remote.results.rmsf)
+                           - np.asarray(s_oracle.results.rmsf)).max())
+        parity = "PASS" if div <= 1e-3 else "FAIL"
+        # HARD OUTAGE: every remote request 503s from here on.  One
+        # mutable fetch trips the breaker (threshold=1), then the
+        # timed wave must keep serving from the warm cache
+        srv.inject(ServerFault("http_5xx", times=None))
+        srv.inject(ServerFault("http_5xx", method="HEAD", times=None))
+        srv.inject(ServerFault("http_5xx", method="PUT", times=None))
+        load_manifest(be)            # remote fails -> cached copy
+        opened = (be.breakers.get(be.endpoints[0], "remote").state
+                  == "open")
+        reader = StoreReader(srv.url + "/stores/bench", backend=be)
+        t0 = time.perf_counter()
+        for lo in range(0, window, BATCH):
+            reader.stage_block(lo, min(lo + BATCH, window),
+                               sel=heavy_sel, quantize=True)
+        outage_fps = window / (time.perf_counter() - t0)
+        base.update(
+            remote_store_ingest_fps=round(
+                summary["store_ingest_fps"], 2),
+            remote_store_read_fps=round(read_fps, 2),
+            remote_store_dedup_ratio=summary2.get("dedup_ratio"),
+            remote_store_cache_hit_rate=hit_rate,
+            remote_store_outage_read_fps=round(outage_fps, 2),
+            remote_store_breaker_opened=bool(opened),
+            remote_store_parity=parity,
+            remote_store_divergence=round(div, 8),
+            remote_store_chunks=summary["n_chunks"],
+            remote_store_window_frames=window)
+        return base
 
 
 def dispatch_stats(calls0: int, secs0: float, runs: int = 1) -> dict:
@@ -1621,6 +1741,22 @@ def main():
               f"{store['store_parity']}, "
               f"{store['store_chunk_crc_rejects']} CRC rejects)")
     _leg_done("store leg", **store)
+
+    # remote chunk-tier sub-leg (docs/STORE.md "Remote backend"):
+    # content-addressed ingest + dedup proof + warm-cache reads +
+    # a hard-outage wave riding the degradation ladder — host-side,
+    # so the record survives a tunnel-down artifact too
+    remote_store = remote_store_host_leg(u_file, heavy_idx, s_oracle)
+    if remote_store.get("remote_store_read_fps"):
+        _note(f"[bench] remote store: read "
+              f"{remote_store['remote_store_read_fps']} f/s (cache "
+              f"hit rate {remote_store['remote_store_cache_hit_rate']}"
+              f", dedup {remote_store['remote_store_dedup_ratio']}), "
+              f"outage {remote_store['remote_store_outage_read_fps']} "
+              f"f/s (breaker open: "
+              f"{remote_store['remote_store_breaker_opened']}, parity "
+              f"{remote_store['remote_store_parity']})")
+    _leg_done("remote store leg", **remote_store)
     clear_host_caches(u_file)
 
     n_chips = _wait_for_accelerator()

@@ -26,8 +26,11 @@ scaling.  This package owns the I/O tier instead:
   (counted: ``mdtpu_store_chunk_crc_rejects_total``), never silently
   wrong numbers;
 - **pluggable backend** (:class:`StoreBackend`): a local directory
-  now (:class:`LocalDirBackend`), an object store later — the reader
-  and ingester only ever see the four-method byte namespace.
+  (:class:`LocalDirBackend`) or the remote chunk service
+  (:class:`HttpStoreBackend` — content-addressed dedup, retry/hedge/
+  breaker network boundary, cache-first degradation; docs/STORE.md
+  "Remote backend") — the reader and ingester only ever see the
+  byte-namespace methods.
 """
 
 from mdanalysis_mpi_tpu.io.store.backend import (
@@ -38,9 +41,14 @@ from mdanalysis_mpi_tpu.io.store.manifest import (
     MANIFEST_NAME, is_store, load_manifest, store_meta,
 )
 from mdanalysis_mpi_tpu.io.store.reader import StoreReader
+from mdanalysis_mpi_tpu.io.store.remote import (
+    ChunkCache, ChunkServer, HttpStoreBackend, ServerFault,
+    is_store_url, open_remote_store,
+)
 
 __all__ = [
     "StoreBackend", "LocalDirBackend", "StoreReader", "ingest",
     "DEFAULT_CHUNK_FRAMES", "MANIFEST_NAME", "is_store",
-    "load_manifest", "store_meta",
+    "load_manifest", "store_meta", "HttpStoreBackend", "ChunkCache",
+    "ChunkServer", "ServerFault", "is_store_url", "open_remote_store",
 ]

@@ -1,13 +1,26 @@
 """Pluggable store backends: a named byte namespace.
 
 The reader and ingester address chunks by NAME only (``manifest.json``,
-``chunk-00000042.mdtc``); everything about *where* those bytes live is
-behind this four-method interface.  A local directory is the shipped
-backend; an object store (GCS/S3-style) implements the same four
-methods later — which is why the interface is bytes-in/bytes-out with
-no seek/stream surface: chunk granularity IS the access granularity
-(a chunk equals one staged block, so partial-chunk reads would only
-re-create the random-access problem the store exists to solve).
+``chunk-00000042.mdtc``, ``cas-<digest>.mdtc``); everything about
+*where* those bytes live is behind this interface.  A local directory
+is the shipped default; :class:`~mdanalysis_mpi_tpu.io.store.remote.
+HttpStoreBackend` speaks the same methods over a GET/PUT/HEAD/range
+chunk protocol.  The interface stays bytes-in/bytes-out with chunk
+granularity as the PRIMARY access granularity (a chunk equals one
+staged block); :meth:`StoreBackend.get_range` exists for transports
+where a byte sub-range is cheaper than the whole object (HTTP Range
+requests serving exactly the spans a shard child needs) and defaults
+to slicing a whole-object read, so every backend supports it.
+
+Error taxonomy at this boundary (the reader keys off it):
+
+- :class:`~mdanalysis_mpi_tpu.utils.integrity.StoreUnavailableError`
+  (an ``OSError``, retryable): the name could not be produced at all
+  — missing file/replica, unreachable endpoint.
+- :class:`~mdanalysis_mpi_tpu.utils.integrity.StoreCorruptError`
+  (an ``IntegrityError``, fatal): bytes were produced and are
+  provably wrong (digest/CRC mismatch).  Never re-fetched from the
+  same source as "transient".
 """
 
 from __future__ import annotations
@@ -18,17 +31,30 @@ from mdanalysis_mpi_tpu.utils import integrity as _integrity
 
 
 class StoreBackend:
-    """Abstract chunk-store backend (local dir now, object store
-    later).  Implementations must make :meth:`put_bytes` atomic —
-    a reader must never observe a torn chunk (the local backend
-    rides ``utils.integrity.atomic_write_bytes``'s
-    tmp → fsync → rename)."""
+    """Abstract chunk-store backend (local dir, HTTP chunk service).
+    Implementations must make :meth:`put_bytes` atomic — a reader
+    must never observe a torn chunk (the local backend rides
+    ``utils.integrity.atomic_write_bytes``'s tmp → fsync → rename) —
+    and must raise the typed split above from :meth:`get_bytes`:
+    missing name → ``StoreUnavailableError``, provably bad bytes →
+    ``StoreCorruptError``."""
 
     def put_bytes(self, name: str, data: bytes) -> None:
         raise NotImplementedError
 
     def get_bytes(self, name: str) -> bytes:
         raise NotImplementedError
+
+    def get_range(self, name: str, start: int, stop: int) -> bytes:
+        """Bytes ``[start, stop)`` of ``name`` — exactly
+        ``get_bytes(name)[start:stop]`` (a past-the-end ``stop``
+        clamps, like a slice).  Default: fetch whole, slice local;
+        transports with native ranged reads (HTTP ``Range``) override
+        to move only the span."""
+        if start < 0 or stop < start:
+            raise ValueError(
+                f"bad byte range [{start}, {stop}) for {name!r}")
+        return self.get_bytes(name)[start:stop]
 
     def exists(self, name: str) -> bool:
         raise NotImplementedError
@@ -68,8 +94,28 @@ class LocalDirBackend(StoreBackend):
             os.path.join(self.root, name), data, artifact="store")
 
     def get_bytes(self, name: str) -> bytes:
-        with open(os.path.join(self.root, name), "rb") as f:
-            return f.read()
+        try:
+            with open(os.path.join(self.root, name), "rb") as f:
+                return f.read()
+        except FileNotFoundError as exc:
+            # the retryable half of the split: the name is absent, not
+            # torn — on a replicated tier another source may have it
+            raise _integrity.StoreUnavailableError(
+                f"store object {name!r} missing under {self.root!r}",
+                name=name, source=self.root) from exc
+
+    def get_range(self, name: str, start: int, stop: int) -> bytes:
+        if start < 0 or stop < start:
+            raise ValueError(
+                f"bad byte range [{start}, {stop}) for {name!r}")
+        try:
+            with open(os.path.join(self.root, name), "rb") as f:
+                f.seek(start)
+                return f.read(stop - start)
+        except FileNotFoundError as exc:
+            raise _integrity.StoreUnavailableError(
+                f"store object {name!r} missing under {self.root!r}",
+                name=name, source=self.root) from exc
 
     def exists(self, name: str) -> bool:
         return os.path.exists(os.path.join(self.root, name))

@@ -12,7 +12,11 @@ import for it.
 ``--smoke`` runs the self-contained ingest→read verification gate
 (``scripts/verify.sh`` stage): write a tiny synthetic XTC, ingest it,
 prove read parity against the file reader, and prove a corrupt chunk
-is rejected typed — one JSON line, exit 0 on success.
+is rejected typed — then repeat the gate through the HTTP fixture
+backend (in-process ``ChunkServer``): content-addressed ingest, a
+two-tenant dedup proof (second ingest moves zero chunk bytes), remote
+read parity, and a corrupt-wire-body typed rejection.  One JSON line,
+exit 0 on success.
 """
 
 from __future__ import annotations
@@ -138,6 +142,68 @@ def _smoke() -> int:
             out["error"] = "corrupt chunk was served instead of rejected"
             print(json.dumps(out))
             return 1
+
+        # ---- remote leg: the same gate through the HTTP fixture ----
+        # (in-process ChunkServer, still jax-free: ingest→read-parity→
+        # corrupt-reject over the hardened network boundary, plus the
+        # two-tenant content-addressing dedup proof — docs/STORE.md
+        # "Remote backend")
+        from mdanalysis_mpi_tpu.io.store import (
+            ChunkCache, ChunkServer, HttpStoreBackend, ServerFault,
+        )
+
+        with ChunkServer(os.path.join(td, "chunkd")) as srv:
+            be1 = HttpStoreBackend(srv.url, store="tenant-a",
+                                   cache=ChunkCache(), timeout_s=5.0)
+            rsum = ingest(xtc, backend=be1, chunk_frames=8,
+                          quant="int16")
+            out["remote_n_chunks"] = rsum["n_chunks"]
+            # tenant B ingests the SAME trajectory: every chunk must
+            # dedup against tenant A's CAS objects — zero new bytes
+            wrote_before = srv.cas_bytes_written
+            be2 = HttpStoreBackend(srv.url, store="tenant-b",
+                                   cache=ChunkCache(), timeout_s=5.0)
+            rsum2 = ingest(xtc, backend=be2, chunk_frames=8,
+                           quant="int16")
+            out["remote_dedup_ratio"] = rsum2["dedup_ratio"]
+            if rsum2["dedup_ratio"] != 1.0 \
+                    or srv.cas_bytes_written != wrote_before:
+                out["error"] = ("second-tenant ingest moved chunk "
+                                "bytes instead of deduplicating")
+                print(json.dumps(out))
+                return 1
+            rr = StoreReader(srv.url + "/stores/tenant-a",
+                             backend=be1)
+            rgot, _ = rr.read_block(0, 24)
+            rerr = float(np.abs(rgot - ref).max())
+            out["remote_parity_max_err"] = round(rerr, 6)
+            if rerr > 5e-3:
+                out["error"] = (f"remote read diverged from file "
+                                f"read: {rerr}")
+                print(json.dumps(out))
+                return 1
+            # a corrupt remote body (flipped payload byte on the
+            # wire) must be rejected typed by the content address —
+            # and must NOT poison the cache with bad bytes
+            from mdanalysis_mpi_tpu.io.store.manifest import (
+                load_manifest,
+            )
+
+            chunk0 = load_manifest(be1)["chunks"][0]["file"]
+            srv.inject(ServerFault("corrupt", match=chunk0,
+                                   times=None))
+            be3 = HttpStoreBackend(srv.url, store="tenant-a",
+                                   cache=ChunkCache(), timeout_s=5.0,
+                                   retries=0)
+            try:
+                be3.get_bytes(chunk0)
+            except IntegrityError as exc:
+                out["remote_corrupt_rejected"] = type(exc).__name__
+            else:
+                out["error"] = ("corrupt remote body was served "
+                                "instead of rejected")
+                print(json.dumps(out))
+                return 1
     out["ok"] = True
     print(json.dumps(out))
     return 0

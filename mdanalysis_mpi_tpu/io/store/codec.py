@@ -21,6 +21,7 @@ of dequantizing garbage.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import zlib
 
@@ -123,3 +124,48 @@ def decode_chunk(blob: bytes, path: str = "<chunk>",
 
 def chunk_name(index: int) -> str:
     return f"chunk-{index:08d}.mdtc"
+
+
+# ---- content addressing (docs/STORE.md "Remote backend") ----
+
+#: Content-addressed chunk names: ``cas-<sha256 hex>.mdtc``.  The name
+#: IS the payload digest, so (a) identical chunks ingested by any
+#: tenant collapse to one immutable shared object (dedup), and (b) any
+#: holder — a network boundary included — can verify a CAS payload
+#: from the name alone, before the reader's CRC/fingerprint pass.
+CAS_PREFIX = "cas-"
+
+
+def payload_digest(blob: bytes) -> str:
+    """sha256 hex of a chunk payload — the content address."""
+    return hashlib.sha256(blob).hexdigest()
+
+
+def cas_chunk_name(digest: str) -> str:
+    return f"{CAS_PREFIX}{digest}.mdtc"
+
+
+def cas_digest(name: str) -> str | None:
+    """The digest a CAS name claims, or None for positional
+    (``chunk-``) and non-chunk names."""
+    if not name.startswith(CAS_PREFIX) or not name.endswith(".mdtc"):
+        return None
+    return name[len(CAS_PREFIX):-len(".mdtc")]
+
+
+def verify_cas(name: str, blob: bytes, source: str = "?") -> None:
+    """Digest-check a CAS payload against its own name; mismatch is
+    the fatal half of the taxonomy (bad bytes, never retried from the
+    same source).  Positional names pass through — their verification
+    lives in :func:`decode_chunk`."""
+    want = cas_digest(name)
+    if want is None:
+        return
+    got = payload_digest(blob)
+    if got != want:
+        _integrity.note_corrupt("store", name)
+        raise _integrity.integrity_error(
+            "store",
+            f"store chunk {name!r} from {source} fails its content "
+            f"address (sha256 {got[:16]}… != named {want[:16]}…) — "
+            f"corrupt payload", name)

@@ -41,11 +41,11 @@ from mdanalysis_mpi_tpu.io.store.manifest import (
 #: so a store-backed run's every stage call is one chunk slice.
 DEFAULT_CHUNK_FRAMES = 512
 
-def _count(metric: str) -> None:
+def _count(metric: str, value: int = 1) -> None:
     # lazy obs import, the utils/integrity.py convention
     from mdanalysis_mpi_tpu.obs import METRICS
 
-    METRICS.inc(metric)
+    METRICS.inc(metric, value)
 
 
 def norm_store_quant(quant) -> str:
@@ -59,14 +59,27 @@ def norm_store_quant(quant) -> str:
 
 def ingest(trajectory, out: str | None = None,
            chunk_frames: int | None = None, quant="int16",
-           backend=None, stop: int | None = None) -> dict:
+           backend=None, stop: int | None = None,
+           content_addressed: bool | None = None) -> dict:
     """Ingest ``trajectory`` (a path or an open ReaderBase) into a
     block store at ``out`` (or through an explicit ``backend``).
 
     ``stop`` bounds the ingested window to frames ``[0, stop)`` —
     the bench's cold-leg protocol ingests a measurement window, not
     the whole fixture.  Returns a summary dict (frame/chunk/byte
-    counts, ``store_ingest_fps``).
+    counts, ``store_ingest_fps``; in content-addressed mode also the
+    dedup ledger: ``dedup_chunks`` / ``dedup_bytes`` /
+    ``dedup_ratio``).
+
+    ``content_addressed``: key chunks by payload digest
+    (``cas-<sha256>.mdtc``) instead of position, so re-ingesting
+    identical payloads — a second tenant, a re-run — writes ZERO new
+    chunk bytes (the put is skipped when the object already exists;
+    the manifest still maps logical chunk → digest).  ``None`` (the
+    default) follows the backend's own ``content_addressed`` flag:
+    on for :class:`~mdanalysis_mpi_tpu.io.store.remote.
+    HttpStoreBackend`, off for local directories (where positional
+    names keep stores human-diffable).
     """
     owned = None
     if hasattr(trajectory, "read_block"):
@@ -84,13 +97,18 @@ def ingest(trajectory, out: str | None = None,
                 raise ValueError(
                     "ingest needs an output path or a backend")
             backend = LocalDirBackend(out)
-        return _ingest(reader, backend, chunk_frames, quant, stop)
+        if content_addressed is None:
+            content_addressed = bool(
+                getattr(backend, "content_addressed", False))
+        return _ingest(reader, backend, chunk_frames, quant, stop,
+                       content_addressed)
     finally:
         if owned is not None:
             owned.close()
 
 
-def _ingest(reader, backend, chunk_frames, quant, stop) -> dict:
+def _ingest(reader, backend, chunk_frames, quant, stop,
+            content_addressed: bool = False) -> dict:
     qmode = norm_store_quant(quant)
     cf = int(chunk_frames or DEFAULT_CHUNK_FRAMES)
     if cf < 1:
@@ -108,6 +126,8 @@ def _ingest(reader, backend, chunk_frames, quant, stop) -> dict:
     total_bytes = 0
     scale = None          # store-wide scale, seeded by chunk 0
     overflow_chunks = 0
+    dedup_chunks = 0
+    dedup_bytes = 0
     for ci, lo in enumerate(range(0, n_frames, cf)):
         hi = min(lo + cf, n_frames)
         block, boxes = reader.read_block(lo, hi)
@@ -135,11 +155,28 @@ def _ingest(reader, backend, chunk_frames, quant, stop) -> dict:
         if times is not None:
             arrays["times"] = np.ascontiguousarray(times, np.float32)
         blob, fps = codec.encode_chunk(arrays, meta)
-        name = codec.chunk_name(ci)
-        backend.put_bytes(name, blob)
+        if content_addressed:
+            # content addressing: the name IS the payload digest, so
+            # an identical chunk from ANY prior ingest (another
+            # tenant's copy of the same trajectory included) is
+            # already there — skip the put, count the bytes not moved
+            digest = codec.payload_digest(blob)
+            name = codec.cas_chunk_name(digest)
+            if backend.exists(name):
+                dedup_chunks += 1
+                dedup_bytes += len(blob)
+                _count("mdtpu_store_chunks_deduped_total")
+                _count("mdtpu_store_dedup_bytes_total", len(blob))
+            else:
+                backend.put_bytes(name, blob)
+        else:
+            name = codec.chunk_name(ci)
+            backend.put_bytes(name, blob)
         entry = {"i": ci, "start": lo, "stop": hi, "file": name,
                  "nbytes": len(blob),
                  "arrays": list(arrays), "fps": fps}
+        if content_addressed:
+            entry["digest"] = digest
         if "inv_scale" in meta:
             entry["inv_scale"] = meta["inv_scale"]
         entries.append(entry)
@@ -168,7 +205,7 @@ def _ingest(reader, backend, chunk_frames, quant, stop) -> dict:
         if name.startswith("chunk-") and name not in kept:
             backend.delete_bytes(name)
     wall = time.perf_counter() - t0
-    return {
+    summary = {
         "store": backend.describe(), "quant": qmode,
         "n_frames": int(n_frames), "n_chunks": len(entries),
         "chunk_frames": cf, "bytes": total_bytes,
@@ -177,3 +214,10 @@ def _ingest(reader, backend, chunk_frames, quant, stop) -> dict:
         "store_ingest_fps": (round(n_frames / wall, 2) if wall > 0
                              else None),
     }
+    if content_addressed:
+        summary["content_addressed"] = True
+        summary["dedup_chunks"] = dedup_chunks
+        summary["dedup_bytes"] = dedup_bytes
+        summary["dedup_ratio"] = (round(dedup_bytes / total_bytes, 4)
+                                  if total_bytes else 0.0)
+    return summary

@@ -103,6 +103,15 @@ def store_meta(path) -> dict | None:
     if not isinstance(path, (str, os.PathLike)):
         return None
     path = os.fspath(path)
+    if isinstance(path, str) and \
+            path.startswith(("http://", "https://")):
+        # remote store URL: fetch-and-verify through the hardened
+        # backend (None when the remote tier is briefly dark — the
+        # controller degrades to un-chunked sharding, never fails
+        # the submit on a routing lookup)
+        from mdanalysis_mpi_tpu.io.store import remote
+
+        return remote.remote_store_meta(path)
     # O(1) stat first: a cache hit must not pay the is_store sniff's
     # full O(chunks) json.load (the fleet controller calls this per
     # sharded submit, under its lock)

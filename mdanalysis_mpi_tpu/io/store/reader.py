@@ -19,9 +19,13 @@ changes is the cost:
 - **verified reads**: every chunk fetch re-computes the per-array
   fingerprints and compares them against the chunk's CRC-framed
   header AND the manifest's stage-time record (``codec.decode_chunk``)
-  — the SDC-scrub comparison moved to read time.  Mismatches raise a
-  typed :class:`~mdanalysis_mpi_tpu.utils.integrity.StoreCorruptError`
-  and count ``mdtpu_store_chunk_crc_rejects_total``.
+  — the SDC-scrub comparison moved to read time.  Rejects count
+  ``mdtpu_store_chunk_crc_rejects_total`` labeled by taxonomy half
+  (``reason="corrupt"`` → typed :class:`~mdanalysis_mpi_tpu.utils.
+  integrity.StoreCorruptError`, fatal bytes; ``reason="unavailable"``
+  → :class:`~mdanalysis_mpi_tpu.utils.integrity.
+  StoreUnavailableError`, a retryable OSError the policy layer may
+  heal from another source).
 """
 
 from __future__ import annotations
@@ -47,10 +51,10 @@ _RAW_CACHE_CHUNKS = 4
 _F32_CACHE_CHUNKS = 2
 
 
-def _count(metric: str) -> None:
+def _count(metric: str, **labels) -> None:
     from mdanalysis_mpi_tpu.obs import METRICS
 
-    METRICS.inc(metric)
+    METRICS.inc(metric, **labels)
 
 
 class StoreReader(ReaderBase):
@@ -138,14 +142,26 @@ class StoreReader(ReaderBase):
         except (_integrity.IntegrityError, OSError) as exc:
             from mdanalysis_mpi_tpu.obs import span_event
 
-            _count("mdtpu_store_chunk_crc_rejects_total")
+            # the taxonomy split drives the reject label AND the
+            # retry contract: "unavailable" (missing replica, remote
+            # outage) stays an OSError the policy layer retries /
+            # re-sources; "corrupt" (provably bad bytes) is fatal and
+            # never re-fetched from the same source as transient
+            reason = ("unavailable"
+                      if isinstance(exc,
+                                    _integrity.StoreUnavailableError)
+                      else "corrupt")
+            _count("mdtpu_store_chunk_crc_rejects_total",
+                   reason=reason)
             span_event("store_chunk_reject", chunk=ci,
-                       path=self._chunk_path(ci))
+                       path=self._chunk_path(ci), reason=reason)
+            if isinstance(exc, _integrity.StoreUnavailableError):
+                raise
             if isinstance(exc, _integrity.IntegrityError):
                 raise
-            # a chunk the manifest promises but the backend cannot
-            # produce (deleted, unreadable) is the truncation case
-            # taken to its limit — same typed taxonomy, so upper
+            # a chunk the manifest promises but the backend produced
+            # unreadably (torn file, permission) is the truncation
+            # case taken to its limit — same typed taxonomy, so upper
             # layers route it as corruption, not as a random OSError
             _integrity.note_corrupt("store", self._chunk_path(ci))
             raise _integrity.integrity_error(

@@ -18,6 +18,16 @@ def register(extension: str, opener) -> None:
 
 
 def open(path: str, n_atoms: int | None = None):
+    if isinstance(path, str) and \
+            path.startswith(("http://", "https://")):
+        # a remote chunk-store URL (docs/STORE.md "Remote backend")
+        # opens wherever a trajectory path is accepted, exactly like
+        # the store-directory branch below — a fleet job spec's
+        # trajectory can be "http://host:port/stores/NAME?mirror=..."
+        # and every read rides the hardened HTTP boundary
+        from mdanalysis_mpi_tpu.io.store.remote import open_remote_store
+
+        return open_remote_store(path, n_atoms=n_atoms)
     if os.path.isdir(path):
         # an ingested block store (docs/STORE.md) opens wherever a
         # trajectory path is accepted — Universe(top, store_dir),

@@ -88,6 +88,9 @@ _TABLE_TYPES = {
     "INTEGRITY_GAUGES": "gauge",
     "SCRUB_COUNTERS": "counter",
     "STORE_COUNTERS": "counter",
+    "STORE_REMOTE_COUNTERS": "counter",
+    "STORE_CACHE_COUNTERS": "counter",
+    "STORE_CACHE_GAUGES": "gauge",
     "FLEET_COUNTERS": "counter",
     "FLEET_GAUGES": "gauge",
     "FLEET_OBS_COUNTERS": "counter",

@@ -90,6 +90,13 @@ SEED_RULES = [
      "description": "any scrub/digest/store-CRC corruption count is "
                     "nonzero — silent data corruption is never a "
                     "wait-and-see signal"},
+    {"name": "store_remote_error_rate", "kind": "rate",
+     "metric": "mdtpu_store_remote_errors_total", "window_s": 60.0,
+     "threshold": 1.0, "for_ticks": 2,
+     "description": "the remote store tier is failing requests "
+                    "faster than 1/s over the trailing minute — "
+                    "reads are riding the degradation ladder "
+                    "(cache/mirror) instead of the remote"},
     {"name": "breaker_flapping", "kind": "rate",
      "metric": "mdtpu_breaker_transitions_total", "window_s": 60.0,
      "threshold": 0.2, "for_ticks": 1,

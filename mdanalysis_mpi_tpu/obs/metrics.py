@@ -242,6 +242,39 @@ STORE_COUNTERS = (
     "mdtpu_store_chunk_crc_rejects_total",
 )
 
+#: Remote-store-tier counters (io/store/remote.py — docs/STORE.md
+#: "Remote backend"): HTTP round trips (labeled ``verb=``), classified
+#: transport failures (labeled ``kind=`` — timeout / reset / truncated
+#: / http_5xx / corrupt), the retry/hedge envelope, degradation-ladder
+#: traffic (mirror reads, terminal unavailability), and the
+#: content-addressing dedup ledger (chunks skipped because the CAS
+#: object already existed, and the bytes those skips did not move).
+#: Recorded live at the network boundary; zero-injected so a process
+#: that never touched a remote store still carries the schema.
+STORE_REMOTE_COUNTERS = (
+    "mdtpu_store_remote_requests_total",
+    "mdtpu_store_remote_errors_total",
+    "mdtpu_store_remote_retries_total",
+    "mdtpu_store_remote_hedges_total",
+    "mdtpu_store_mirror_reads_total",
+    "mdtpu_store_unavailable_total",
+    "mdtpu_store_chunks_deduped_total",
+    "mdtpu_store_dedup_bytes_total",
+)
+
+#: Per-host read-through chunk-cache series (io/store/remote.py
+#: ChunkCache — step 2 of the degradation ladder): hit/miss counters
+#: and the resident-byte gauge.  Distinct from the staged BlockCache
+#: series (``mdtpu_cache_*``): this cache holds verified chunk BYTES
+#: below the decode boundary, not staged arrays.
+STORE_CACHE_COUNTERS = (
+    "mdtpu_store_cache_hits_total",
+    "mdtpu_store_cache_misses_total",
+)
+STORE_CACHE_GAUGES = (
+    "mdtpu_store_cache_bytes",
+)
+
 #: Fleet-tier series (service/fleet.py, docs/RELIABILITY.md §6):
 #: host-loss migration and epoch fencing, recorded live at the
 #: controller's incident sites (labeled ``reason=``) and zero-injected
@@ -406,6 +439,7 @@ def unified_snapshot(timers=None, cache=None, telemetry=None,
     for name in COMPILE_METRICS + BREAKER_COUNTERS + \
             SUPERVISION_COUNTERS + RELIABILITY_COUNTERS + \
             INTEGRITY_COUNTERS + SCRUB_COUNTERS + STORE_COUNTERS + \
+            STORE_REMOTE_COUNTERS + STORE_CACHE_COUNTERS + \
             FLEET_COUNTERS + FLEET_OBS_COUNTERS + QOS_COUNTERS + \
             PROF_COUNTERS + ALERT_COUNTERS:
         snap.setdefault(name, {"type": "counter", "values": {"": 0}})
@@ -414,8 +448,8 @@ def unified_snapshot(timers=None, cache=None, telemetry=None,
         # the pinned schema needs the name/type in every snapshot
         snap.setdefault(name, {"type": "histogram", "values": {}})
     for name in BREAKER_GAUGES + LINT_GAUGES + INTEGRITY_GAUGES \
-            + FLEET_GAUGES + FLEET_OBS_GAUGES + QOS_GAUGES \
-            + PROF_GAUGES + ALERT_GAUGES:
+            + STORE_CACHE_GAUGES + FLEET_GAUGES + FLEET_OBS_GAUGES \
+            + QOS_GAUGES + PROF_GAUGES + ALERT_GAUGES:
         # 0 == closed (reliability/breaker.py STATE_VALUES): a process
         # that never tripped a breaker reports the healthy state;
         # likewise 0 lint rules/findings means "never linted here"

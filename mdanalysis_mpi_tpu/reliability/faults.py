@@ -43,6 +43,17 @@ Sites (the complete set — grep for ``_faults.fire``):
     warmup-shaped no-op dispatch.  No payload — raise (device-loss,
     the default) keeps the breaker open; not firing lets the probe
     succeed and close it.
+``"remote"``
+    Remote-store request boundary
+    (``io/store/remote.py HttpStoreBackend._request``), fired before
+    every HTTP round trip.  No payload — raise (transient, the
+    default: the shape of a refused connection the client never even
+    started) / stall.  SERVER-side failures — timeouts, 5xx,
+    connection resets, truncated bodies, corrupt payloads — are
+    injected by the :class:`~mdanalysis_mpi_tpu.io.store.remote.
+    ChunkServer` fixture's own deterministic schedule instead (they
+    must traverse the real socket to exercise the client's error
+    mapping), so this site covers the client half only.
 ``"bitflip"``
     Silent-data-corruption injection on the host→device wire
     (``executors._run_batches._place``), fired AFTER the stage-time
@@ -110,6 +121,7 @@ _DEFAULT_EXC = {
     "worker": InjectedWorkerDeath,
     "probe": DeviceLossError,
     "bitflip": InjectedTransientError,
+    "remote": InjectedTransientError,
 }
 
 

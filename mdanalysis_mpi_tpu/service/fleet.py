@@ -1740,7 +1740,15 @@ def _store_meta(spec: dict) -> dict | None:
 def _build_universe(spec: dict):
     """Tenant state: a synthetic fixture (``fixture`` key — the chaos
     tests' deterministic shape, reproducible in every process from the
-    seed alone) or real files."""
+    seed alone) or real files.
+
+    A spec carrying BOTH ``fixture`` and ``trajectory`` combines them:
+    the fixture supplies the topology (reproducible from the seed, no
+    file shipping) while the trajectory — a store directory or a
+    remote store URL (docs/STORE.md) — supplies the coordinates
+    through ``trajectory_files.open``.  This is how store-backed fleet
+    jobs read exactly their ``shard_windows`` chunk ranges over the
+    hardened remote boundary."""
     fixture = spec.get("fixture")
     if fixture:
         from mdanalysis_mpi_tpu import testing as _testing
@@ -1748,10 +1756,17 @@ def _build_universe(spec: dict):
         kind = fixture.get("kind", "protein")
         kwargs = {k: v for k, v in fixture.items() if k != "kind"}
         if kind == "protein":
-            return _testing.make_protein_universe(**kwargs)
-        if kind == "md":
-            return _testing.make_md_universe(**kwargs)
-        raise ValueError(f"unknown fixture kind {kind!r}")
+            u = _testing.make_protein_universe(**kwargs)
+        elif kind == "md":
+            u = _testing.make_md_universe(**kwargs)
+        else:
+            raise ValueError(f"unknown fixture kind {kind!r}")
+        traj = spec.get("trajectory")
+        if traj:
+            from mdanalysis_mpi_tpu import Universe
+
+            return Universe(u.topology, traj)
+        return u
     from mdanalysis_mpi_tpu import Universe
 
     return Universe(spec["topology"], spec.get("trajectory"))

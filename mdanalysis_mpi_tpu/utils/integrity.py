@@ -39,7 +39,10 @@ Exception taxonomy: :class:`ArtifactWriteError` (an ``OSError``) is
 "persisted/resident bytes are wrong", with per-artifact subclasses
 (:class:`JournalCorruptError`, :class:`CheckpointCorruptError`,
 :class:`ResultCorruptError`) so callers can route without string
-matching.
+matching; :class:`StoreUnavailableError` (an ``OSError``) is "could
+not produce the bytes at all" — the retryable availability half of
+the store split (missing replica / unreachable remote), versus the
+fatal :class:`StoreCorruptError` bad-bytes half.
 """
 
 from __future__ import annotations
@@ -117,6 +120,25 @@ class StoreCorruptError(IntegrityError):
     manifest fingerprint (docs/STORE.md): the reader must refuse the
     chunk — dequantizing flipped bits produces silently wrong
     coordinates in every analysis downstream."""
+
+
+class StoreUnavailableError(OSError):
+    """A block-store chunk could not be PRODUCED — missing replica,
+    unreachable remote endpoint, breaker-open tier with a cold cache
+    and no mirror (docs/STORE.md degradation ladder).  The retryable
+    half of the store taxonomy: the bytes were never seen, so nothing
+    is known corrupt, and the policy layer's transient classifier
+    treats it like any flaky-I/O ``OSError`` (retry/backoff may heal
+    it).  Contrast :class:`StoreCorruptError` (a ``ValueError``):
+    bytes WERE produced and are provably wrong — re-fetching the same
+    source as "transient" is forbidden.  Carries ``name`` (the chunk
+    or manifest object) and ``source`` (backend description)."""
+
+    def __init__(self, message: str, name: str | None = None,
+                 source: str | None = None):
+        super().__init__(errno.EHOSTUNREACH, message)
+        self.name = name
+        self.source = source
 
 
 _EXC_BY_ARTIFACT = {

@@ -6,7 +6,9 @@
 #      jit contracts (AST tier), schema drift.  Jax-free, <30 s.
 #   2. The block-store ingest→read smoke (docs/STORE.md): write a
 #      tiny XTC, ingest it, prove read parity vs the file reader and
-#      typed corrupt-chunk rejection.  Jax-free, ~1 s.
+#      typed corrupt-chunk rejection — locally AND through the HTTP
+#      fixture backend (content-addressed ingest, two-tenant dedup
+#      proof, corrupt-wire-body rejection).  Jax-free, ~2 s.
 #   3. The fleet dryrun smoke (docs/RELIABILITY.md §6): 2 real host
 #      processes, one kill -9 mid-wave, exactly-once audited against
 #      the epoch-stamped journal.  Jax-free, ~10 s.
@@ -21,7 +23,7 @@ cd "$(dirname "$0")/.."
 echo "== [1/4] mdtpu lint (fast mode) =="
 python -m mdanalysis_mpi_tpu lint
 
-echo "== [2/4] block-store ingest→read smoke =="
+echo "== [2/4] block-store ingest→read smoke (local + HTTP fixture) =="
 python -m mdanalysis_mpi_tpu ingest --smoke
 
 echo "== [3/4] fleet dryrun smoke (kill -9 + exactly-once audit) =="

@@ -97,6 +97,24 @@ PINNED_METRICS = {
     "mdtpu_store_chunks_ingested_total": "counter",
     "mdtpu_store_chunks_read_total": "counter",
     "mdtpu_store_chunk_crc_rejects_total": "counter",
+    # remote store tier (docs/STORE.md "Remote backend"): HTTP round
+    # trips by verb, classified transport failures, the retry/hedge
+    # envelope, degradation-ladder traffic (mirror reads, terminal
+    # unavailability), the content-addressing dedup ledger, and the
+    # per-host read-through chunk cache — recorded live at the
+    # network boundary (io/store/remote.py), zero-injected
+    # everywhere else
+    "mdtpu_store_remote_requests_total": "counter",
+    "mdtpu_store_remote_errors_total": "counter",
+    "mdtpu_store_remote_retries_total": "counter",
+    "mdtpu_store_remote_hedges_total": "counter",
+    "mdtpu_store_mirror_reads_total": "counter",
+    "mdtpu_store_unavailable_total": "counter",
+    "mdtpu_store_chunks_deduped_total": "counter",
+    "mdtpu_store_dedup_bytes_total": "counter",
+    "mdtpu_store_cache_hits_total": "counter",
+    "mdtpu_store_cache_misses_total": "counter",
+    "mdtpu_store_cache_bytes": "gauge",
     # fleet tier (docs/RELIABILITY.md §6): host membership, host-loss
     # migration, and epoch fencing — recorded live by the controller
     # (service/fleet.py), zero-injected everywhere else
@@ -151,6 +169,7 @@ PINNED_ALERT_RULES = (
     "queue_saturated",
     "shed_rate_high",
     "data_corruption",
+    "store_remote_error_rate",
     "breaker_flapping",
 )
 
@@ -256,6 +275,19 @@ def test_bench_json_contract(tmp_path):
                     "store_ingest_fps", "store_read_fps",
                     "store_vs_decode", "store_divergence",
                     "store_parity", "store_chunk_crc_rejects",
+                    # r16: remote chunk-tier sub-leg (docs/STORE.md
+                    # "Remote backend") — content-addressed ingest,
+                    # two-tenant dedup proof, warm-cache read wave,
+                    # and a hard-outage wave riding the degradation
+                    # ladder with the breaker open; host-side,
+                    # survives outage
+                    "remote_store_ingest_fps",
+                    "remote_store_read_fps",
+                    "remote_store_dedup_ratio",
+                    "remote_store_cache_hit_rate",
+                    "remote_store_outage_read_fps",
+                    "remote_store_breaker_opened",
+                    "remote_store_parity",
                     # fleet serving sub-leg (docs/RELIABILITY.md §6):
                     # K tenants across 2 real host processes, clean
                     # wave vs one kill -9 mid-wave — host-side, so a
@@ -373,6 +405,17 @@ def test_bench_json_contract(tmp_path):
         assert 0 <= rec["store_divergence"] <= 1e-3
         assert rec["store_chunk_crc_rejects"] == 0
         assert rec["store_vs_decode"] > 0
+        # r16: remote chunk tier — identical payloads dedup fully on
+        # the second-tenant ingest, the warm wave reads through the
+        # per-host cache, the outage wave keeps flowing with the
+        # breaker open, and parity holds at the staging-dtype bar
+        assert rec["remote_store_ingest_fps"] > 0
+        assert rec["remote_store_read_fps"] > 0
+        assert rec["remote_store_dedup_ratio"] == 1.0
+        assert rec["remote_store_cache_hit_rate"] == 1.0
+        assert rec["remote_store_outage_read_fps"] > 0
+        assert rec["remote_store_breaker_opened"] is True
+        assert rec["remote_store_parity"] == "PASS"
         # fleet sub-leg: one host really was kill -9'd mid-wave, every
         # job still completed exactly once (journal-audited), and the
         # clean wave-2 ran fully home-resident (sticky routing)
@@ -515,6 +558,12 @@ def test_bench_outage_records_host_legs(tmp_path):
         # artifact still records the ingest/read rates and parity
         assert rec["store_read_fps"] > 0
         assert rec["store_parity"] == "PASS"
+        # r16: the remote chunk-tier sub-leg is host-side too — the
+        # dedup/cache/outage record survives a tunnel-down artifact
+        assert rec["remote_store_read_fps"] > 0
+        assert rec["remote_store_dedup_ratio"] == 1.0
+        assert rec["remote_store_breaker_opened"] is True
+        assert rec["remote_store_parity"] == "PASS"
         # r12: the fleet sub-leg is host-side (serial host processes)
         # — the kill -9 migration record survives the outage too
         assert rec["fleet_loss_jobs_per_s"] > 0

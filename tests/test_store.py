@@ -22,16 +22,17 @@ from mdanalysis_mpi_tpu.io.store import (
 )
 from mdanalysis_mpi_tpu.obs import METRICS
 from mdanalysis_mpi_tpu.utils.integrity import (
-    IntegrityError, StoreCorruptError,
+    IntegrityError, StoreCorruptError, StoreUnavailableError,
 )
 
 pytestmark = pytest.mark.store
 
 
 def _rejects() -> int:
-    return METRICS.snapshot().get(
+    # reason-labeled (corrupt|unavailable): sum across every reason
+    return sum(METRICS.snapshot().get(
         "mdtpu_store_chunk_crc_rejects_total",
-        {"values": {}})["values"].get("", 0)
+        {"values": {}})["values"].values())
 
 
 def _topology(n_atoms: int) -> Topology:
@@ -229,16 +230,20 @@ class TestVerifiedReads:
 
     def test_missing_chunk_rejected_typed_and_counted(self, tmp_path):
         # a chunk the manifest promises but the backend cannot produce
-        # is truncation taken to its limit: same typed taxonomy, same
-        # counter — never a raw FileNotFoundError
+        # is the RETRYABLE half of the taxonomy (docs/STORE.md): typed
+        # StoreUnavailableError (the bytes were never seen, so nothing
+        # is known corrupt), counted under reason="unavailable" —
+        # never a raw FileNotFoundError
         src, _ = _source(n_frames=32)
         out = str(tmp_path / "gone")
         ingest(src, out, chunk_frames=16, quant="int16")
         os.remove(os.path.join(out, "chunk-00000001.mdtc"))
         before = _rejects()
-        with pytest.raises(StoreCorruptError, match="unreadable"):
+        with pytest.raises(StoreUnavailableError):
             StoreReader(out).read_block(16, 32)
         assert _rejects() == before + 1
+        snap = METRICS.snapshot()["mdtpu_store_chunk_crc_rejects_total"]
+        assert any("unavailable" in k for k in snap["values"])
 
     def test_reingest_kills_manifest_first(self, tmp_path):
         # a crashed re-ingest must leave "not a store", never a valid

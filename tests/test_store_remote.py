@@ -1,0 +1,532 @@
+"""Fault-hardened remote store tier (io/store/remote — docs/STORE.md
+"Remote backend").
+
+Content addressing (two-tenant dedup proof: identical trajectories
+share immutable CAS chunks, the second ingest moves ZERO bytes),
+byte-range fuzz (ranged GETs are slice-exact against the local blob),
+and the hardened network boundary under the full server-side fault
+matrix — 5xx, stalls past the client deadline, connection resets,
+truncated bodies, corrupt payloads — each classified, retried,
+breaker-accounted, and ridden down the degradation ladder
+(remote → per-host chunk cache → local mirror → typed
+``StoreUnavailableError``) with read-time digest verification
+mandatory at every rung.
+
+The chaos leg is the acceptance scenario: a real fleet (2 host
+processes) running a job wave whose trajectory is a remote store URL;
+mid-run the remote goes hard-down, the per-worker breakers trip, the
+wave completes bit-close to the local-store oracle from cache+mirror,
+the per-host cache/remote counters federate through heartbeats, and
+the tier recovers once the faults clear.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from mdanalysis_mpi_tpu.core.topology import Topology
+from mdanalysis_mpi_tpu.core.universe import Universe
+from mdanalysis_mpi_tpu.io.memory import MemoryReader
+from mdanalysis_mpi_tpu.io.store import (
+    ChunkCache, ChunkServer, HttpStoreBackend, ServerFault,
+    StoreReader, ingest, store_meta,
+)
+from mdanalysis_mpi_tpu.io.store import codec
+from mdanalysis_mpi_tpu.io.store.manifest import load_manifest
+from mdanalysis_mpi_tpu.obs import METRICS
+from mdanalysis_mpi_tpu.reliability import faults
+from mdanalysis_mpi_tpu.utils.integrity import (
+    StoreCorruptError, StoreUnavailableError,
+)
+
+pytestmark = [pytest.mark.store, pytest.mark.reliability]
+
+
+def _source(n_frames=16, n_atoms=20, seed=0, scale=12.0):
+    rng = np.random.default_rng(seed)
+    base = rng.normal(scale=scale, size=(n_atoms, 3)).astype(np.float32)
+    frames = base[None] + rng.normal(
+        scale=0.4, size=(n_frames, n_atoms, 3)).astype(np.float32)
+    dims = np.tile(np.array([40.0, 40, 40, 90, 90, 90],
+                            dtype=np.float32), (n_frames, 1))
+    times = np.arange(n_frames, dtype=np.float64) * 2.0
+    return MemoryReader(frames, dimensions=dims, times=times), frames
+
+
+def _counter(name: str) -> float:
+    return sum(METRICS.snapshot().get(
+        name, {"values": {}})["values"].values())
+
+
+@pytest.fixture
+def srv(tmp_path):
+    with ChunkServer(str(tmp_path / "srv")) as s:
+        yield s
+
+
+def _backend(srv, store="t1", **kw):
+    kw.setdefault("cache", ChunkCache())
+    kw.setdefault("retries", 1)
+    kw.setdefault("backoff_s", 0.0)
+    kw.setdefault("timeout_s", 5.0)
+    return HttpStoreBackend(srv.url, store=store, **kw)
+
+
+# ---------------------------------------------------------------------------
+# content addressing + dedup
+# ---------------------------------------------------------------------------
+
+class TestContentAddressing:
+    def test_two_tenant_dedup_zero_new_bytes(self, srv):
+        src, frames = _source()
+        be1 = _backend(srv, "tenant-a")
+        s1 = ingest(src, backend=be1, chunk_frames=8, quant="int16")
+        assert s1["content_addressed"] is True
+        assert s1["dedup_chunks"] == 0
+        wrote = srv.cas_bytes_written
+        assert wrote > 0
+        # the SECOND tenant ingests the identical trajectory into its
+        # own namespace: every chunk resolves to an existing CAS
+        # object — the ingest moves zero chunk bytes over the wire
+        src2, _ = _source()
+        be2 = _backend(srv, "tenant-b", cache=be1.cache)
+        s2 = ingest(src2, backend=be2, chunk_frames=8, quant="int16")
+        assert s2["dedup_chunks"] == s1["n_chunks"]
+        assert s2["dedup_ratio"] == 1.0
+        assert srv.cas_bytes_written == wrote        # zero new bytes
+        # both tenants read their own manifest down to the same chunks
+        for be in (be1, be2):
+            got, _ = StoreReader(
+                srv.url, backend=be).read_block(0, 16)
+            tol = float(np.abs(frames).max()) * 1.05 / 32000.0
+            assert float(np.abs(got - frames).max()) <= tol + 1e-6
+
+    def test_manifest_entries_carry_digest_and_cas_names(self, srv):
+        src, _ = _source()
+        be = _backend(srv)
+        ingest(src, backend=be, chunk_frames=8, quant="int16")
+        man = load_manifest(be)
+        assert len(man["chunks"]) == 2
+        for entry in man["chunks"]:
+            assert entry["file"] == codec.cas_chunk_name(entry["digest"])
+            assert codec.cas_digest(entry["file"]) == entry["digest"]
+
+    def test_server_rejects_digest_mismatch_put(self, srv):
+        be = _backend(srv)
+        good = b"immutable chunk payload"
+        name = codec.cas_chunk_name(codec.payload_digest(good))
+        wrong = codec.cas_chunk_name("0" * 64)
+        with pytest.raises(StoreUnavailableError):
+            # the fixture answers 422 to a PUT whose body does not
+            # hash to the claimed address; the client treats the
+            # endpoint as refusing, not the payload as stored
+            be.put_bytes(wrong, good)
+        be.put_bytes(name, good)
+        assert be.exists(name)
+
+    def test_store_meta_over_url_and_chunk_aligned_shards(self, srv):
+        src, _ = _source(n_frames=16)
+        be = _backend(srv, "shared")
+        ingest(src, backend=be, chunk_frames=4, quant="int16")
+        meta = store_meta(srv.store_url("shared"))
+        assert meta is not None
+        assert meta["chunk_frames"] == 4 and meta["n_frames"] == 16
+        # an unreachable remote degrades the routing accessor to None
+        # (un-chunked sharding), never an exception at submit time
+        assert store_meta("http://127.0.0.1:9/stores/shared") is None
+
+
+# ---------------------------------------------------------------------------
+# ranged GETs
+# ---------------------------------------------------------------------------
+
+class TestByteRanges:
+    def test_range_fuzz_slice_exact(self, srv):
+        src, _ = _source()
+        be = _backend(srv)
+        ingest(src, backend=be, chunk_frames=8, quant="int16")
+        name = load_manifest(be)["chunks"][0]["file"]
+        blob = be.get_bytes(name)
+        rng = np.random.default_rng(11)
+        spans = [(0, 1), (0, len(blob)), (len(blob) - 1, len(blob)),
+                 (5, 5), (0, 10 * len(blob)),          # past-end clamp
+                 (len(blob) + 7, len(blob) + 9)]       # fully past end
+        spans += [tuple(sorted(rng.integers(0, len(blob) + 32, 2)))
+                  for _ in range(24)]
+        for start, stop in spans:
+            cold = _backend(srv)             # no whole-blob cache help
+            assert cold.get_range(name, int(start), int(stop)) \
+                == blob[int(start):int(stop)], (start, stop)
+        with pytest.raises(ValueError):
+            be.get_range(name, 5, 4)
+        with pytest.raises(ValueError):
+            be.get_range(name, -1, 4)
+
+    def test_range_served_from_cached_blob_without_remote(self, srv):
+        src, _ = _source()
+        be = _backend(srv)
+        ingest(src, backend=be, chunk_frames=8, quant="int16")
+        name = load_manifest(be)["chunks"][0]["file"]
+        blob = be.get_bytes(name)            # warms the chunk cache
+        srv.inject(ServerFault("http_5xx", times=None))
+        assert be.get_range(name, 3, 17) == blob[3:17]
+
+
+# ---------------------------------------------------------------------------
+# the fault matrix at the network boundary
+# ---------------------------------------------------------------------------
+
+class TestFaultMatrix:
+    def _ingested(self, srv, **kw):
+        src, frames = _source()
+        be = _backend(srv, **kw)
+        ingest(src, backend=be, chunk_frames=8, quant="int16")
+        name = load_manifest(be)["chunks"][0]["file"]
+        return be, name, frames
+
+    @pytest.mark.parametrize("fault", [
+        ServerFault("http_5xx", times=None),
+        ServerFault("reset", times=None),
+        ServerFault("truncate", times=None),
+    ])
+    def test_transport_faults_exhaust_typed(self, srv, fault):
+        be, name, _ = self._ingested(srv)
+        srv.inject(fault)
+        cold = _backend(srv)                 # cold cache, no mirror
+        with pytest.raises(StoreUnavailableError):
+            cold.get_bytes(name)
+
+    def test_stall_past_deadline_is_a_timeout(self, srv):
+        be, name, _ = self._ingested(srv)
+        srv.inject(ServerFault("stall", stall_s=1.0, times=None))
+        cold = _backend(srv, timeout_s=0.1, retries=0)
+        before = _counter("mdtpu_store_remote_errors_total")
+        with pytest.raises(StoreUnavailableError):
+            cold.get_bytes(name)
+        assert _counter("mdtpu_store_remote_errors_total") > before
+
+    def test_transient_5xx_healed_inside_retry_envelope(self, srv):
+        be, name, _ = self._ingested(srv)
+        srv.inject(ServerFault("http_5xx", times=2))
+        cold = _backend(srv, retries=2)
+        before = _counter("mdtpu_store_remote_retries_total")
+        assert cold.get_bytes(name) == be.get_bytes(name)
+        assert _counter("mdtpu_store_remote_retries_total") \
+            >= before + 2
+
+    def test_corrupt_body_rejected_never_cached_mirror_serves(
+            self, srv, tmp_path):
+        src, _ = _source()
+        mirror = str(tmp_path / "mirror")
+        ingest(src, mirror, chunk_frames=8, quant="int16",
+               content_addressed=True)
+        be = _backend(srv)
+        src2, _ = _source()
+        ingest(src2, backend=be, chunk_frames=8, quant="int16")
+        name = load_manifest(be)["chunks"][0]["file"]
+        good = be.get_bytes(name)
+        srv.inject(ServerFault("corrupt", match=name, times=None))
+        cache = ChunkCache()
+        hard = _backend(srv, cache=cache, mirror=mirror, retries=0)
+        before = _counter("mdtpu_store_remote_errors_total")
+        # the wire body fails its content address -> the mirror copy
+        # (same CAS name, verified on read) serves instead
+        assert hard.get_bytes(name) == good
+        assert _counter("mdtpu_store_remote_errors_total") > before
+        # and ONLY verified bytes entered the cache
+        assert cache.get(("cas", name)) == good
+
+    def test_all_sources_corrupt_is_fatal_not_unavailable(self, srv):
+        be, name, _ = self._ingested(srv)
+        srv.inject(ServerFault("corrupt", match=name, times=None))
+        cold = _backend(srv, retries=0)
+        with pytest.raises(StoreCorruptError):
+            cold.get_bytes(name)
+
+    def test_reader_reject_reasons_split(self, srv):
+        be, name, _ = self._ingested(srv)
+
+        def _reason(reason):
+            return METRICS.snapshot().get(
+                "mdtpu_store_chunk_crc_rejects_total",
+                {"values": {}})["values"].get(f'reason="{reason}"', 0)
+
+        cold = _backend(srv, retries=0)
+        r = StoreReader(srv.url, backend=cold)     # manifest healthy
+        srv.inject(ServerFault("http_5xx", times=None))
+        before = _reason("unavailable")
+        with pytest.raises(StoreUnavailableError):
+            r.read_block(0, 8)
+        assert _reason("unavailable") == before + 1
+        srv.clear_faults()
+        cold2 = _backend(srv, retries=0)
+        r2 = StoreReader(srv.url, backend=cold2)
+        srv.inject(ServerFault("corrupt", match=name, times=None))
+        before = _reason("corrupt")
+        with pytest.raises(StoreCorruptError):
+            r2.read_block(0, 8)
+        assert _reason("corrupt") == before + 1
+
+    def test_client_fault_site_enters_retry_envelope(self, srv):
+        be, name, _ = self._ingested(srv)
+        # the injected client-side transient is classified like any
+        # transport fault: healed inside the envelope...
+        with faults.inject(faults.FaultSpec("remote", "raise",
+                                            times=2)):
+            healed = _backend(srv, retries=2)
+            assert healed.get_bytes(name) == be.get_bytes(name)
+        # ...and typed StoreUnavailableError once attempts exhaust
+        with faults.inject(faults.FaultSpec("remote", "raise",
+                                            times=None)):
+            hard = _backend(srv, retries=0)
+            with pytest.raises(StoreUnavailableError):
+                hard.get_bytes(name)
+
+
+# ---------------------------------------------------------------------------
+# breaker + degradation ladder + hedging
+# ---------------------------------------------------------------------------
+
+class TestBreakerAndLadder:
+    def test_breaker_opens_cache_serves_then_half_open_recovers(
+            self, srv):
+        src, _ = _source()
+        seed_be = _backend(srv)
+        ingest(src, backend=seed_be, chunk_frames=8, quant="int16")
+        names = [c["file"] for c in load_manifest(seed_be)["chunks"]]
+        # a fresh reading backend: its cache holds ONLY chunk 0
+        be = _backend(srv, cache=ChunkCache(), retries=0,
+                      breaker_threshold=2, breaker_cooldown_s=0.2)
+        warm = be.get_bytes(names[0])
+        br = be.breakers.get(be.endpoints[0], "remote")
+        srv.inject(ServerFault("http_5xx", times=None))
+        srv.inject(ServerFault("http_5xx", method="HEAD", times=None))
+        for _ in range(2):                   # threshold failures
+            with pytest.raises(StoreUnavailableError):
+                be.get_bytes(names[1])
+        assert br.state == "open"
+        # OPEN: the warm cache answers without touching the remote
+        reqs = _counter("mdtpu_store_remote_requests_total")
+        assert be.get_bytes(names[0]) == warm
+        assert _counter("mdtpu_store_remote_requests_total") == reqs
+        before_unavail = _counter("mdtpu_store_unavailable_total")
+        with pytest.raises(StoreUnavailableError):
+            be.get_bytes(names[1])           # cold name, open breaker
+        assert _counter("mdtpu_store_unavailable_total") \
+            == before_unavail + 1
+        # recovery: faults clear, cooldown passes, the half-open HEAD
+        # probe admits one conversation and success re-closes
+        srv.clear_faults()
+        time.sleep(0.25)
+        assert br.state == "half_open"
+        assert be.get_bytes(names[1])
+        assert br.state == "closed"
+
+    def test_mutable_names_fall_back_to_cache_only_in_outage(
+            self, srv):
+        src, _ = _source()
+        ingest(src, backend=_backend(srv), chunk_frames=8,
+               quant="int16")
+        # the backend under test only READS: its cached manifest goes
+        # stale when another writer re-ingests the store
+        be = _backend(srv, cache=ChunkCache(), retries=0,
+                      breaker_threshold=1)
+        man1 = load_manifest(be)             # caches manifest.json
+        src2, _ = _source(seed=3)
+        ingest(src2, backend=_backend(srv), chunk_frames=4,
+               quant="int16")
+        # healthy remote: the re-ingested manifest is VISIBLE (the
+        # cache must not serve a stale mutable name)
+        assert load_manifest(be)["chunk_frames"] == 4
+        srv.inject(ServerFault("http_5xx", times=None))
+        srv.inject(ServerFault("http_5xx", method="HEAD", times=None))
+        # outage: the last-known cached manifest keeps reads flowing
+        assert load_manifest(be)["chunk_frames"] == 4
+        assert man1["chunk_frames"] == 8
+
+    def test_replica_404_fails_over_without_breaker_penalty(
+            self, srv, tmp_path):
+        with ChunkServer(str(tmp_path / "replica")) as srv2:
+            src, _ = _source()
+            seed = _backend(srv2, "t1")
+            ingest(src, backend=seed, chunk_frames=8, quant="int16")
+            name = load_manifest(seed)["chunks"][0]["file"]
+            be = HttpStoreBackend([srv.url, srv2.url], store="t1",
+                                  cache=ChunkCache(), retries=0)
+            # srv holds nothing: its 404 is a HEALTHY answer (the
+            # conversation completed) — the next replica serves and
+            # the first endpoint's breaker stays closed
+            assert be.get_bytes(name) == seed.get_bytes(name)
+            assert be.breakers.get(srv.url, "remote").state == "closed"
+
+    def test_hedged_read_beats_stalled_primary(self, srv, tmp_path):
+        with ChunkServer(str(tmp_path / "replica")) as srv2:
+            src, _ = _source()
+            be1 = _backend(srv, "t1")
+            ingest(src, backend=be1, chunk_frames=8, quant="int16")
+            src2, _ = _source()
+            be2 = _backend(srv2, "t1")
+            ingest(src2, backend=be2, chunk_frames=8, quant="int16")
+            name = load_manifest(be1)["chunks"][0]["file"]
+            srv.inject(ServerFault("stall", stall_s=0.6, times=None))
+            hedged = HttpStoreBackend(
+                [srv.url, srv2.url], store="t1", cache=ChunkCache(),
+                retries=0, timeout_s=5.0, hedge_s=0.05)
+            before = _counter("mdtpu_store_remote_hedges_total")
+            t0 = time.perf_counter()
+            assert hedged.get_bytes(name) == be2.get_bytes(name)
+            assert time.perf_counter() - t0 < 0.5
+            assert _counter("mdtpu_store_remote_hedges_total") \
+                == before + 1
+
+
+# ---------------------------------------------------------------------------
+# the chaos leg: fleet wave over a flaky remote
+# ---------------------------------------------------------------------------
+
+FIXTURE = {"kind": "protein", "n_residues": 10, "n_frames": 12,
+           "noise": 0.25, "seed": 5}
+
+
+def _fleet_counter(snap: dict, name: str) -> float:
+    return sum(snap.get(name, {"values": {}})["values"].values())
+
+
+def _wait(pred, timeout=30.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def test_fleet_wave_rides_ladder_through_remote_outage(tmp_path):
+    """THE acceptance scenario (ISSUE 16): a 2-host fleet wave whose
+    trajectory is a remote store URL; the remote goes hard-down
+    mid-run, per-worker breakers trip, every job completes bit-close
+    to the local-store oracle via the cache+mirror rungs, the
+    cache/remote counters federate through heartbeats, and the tier
+    serves remotely again once the faults clear."""
+    from mdanalysis_mpi_tpu.analysis import RMSD, RMSF
+    from mdanalysis_mpi_tpu.service.fleet import DONE, FleetController
+    from mdanalysis_mpi_tpu.testing import make_protein_universe
+
+    u = make_protein_universe(
+        **{k: v for k, v in FIXTURE.items() if k != "kind"})
+    mirror = str(tmp_path / "mirror")
+    ingest(u.trajectory, mirror, chunk_frames=4, quant="f32",
+           content_addressed=True)
+    with ChunkServer(str(tmp_path / "srv")) as srv:
+        be = HttpStoreBackend(srv.url, store="shared",
+                              cache=ChunkCache())
+        u2 = make_protein_universe(
+            **{k: v for k, v in FIXTURE.items() if k != "kind"})
+        summary = ingest(u2.trajectory, backend=be, chunk_frames=4,
+                         quant="f32")
+        assert summary["n_chunks"] == 3
+        assert summary["content_addressed"] is True
+        # the remote and the mirror hold the SAME immutable chunks:
+        # content addressing makes them interchangeable ladder rungs
+        for entry in load_manifest(be)["chunks"]:
+            assert os.path.exists(os.path.join(mirror, entry["file"]))
+
+        url = srv.store_url(
+            "shared", mirror=mirror, retries=1, timeout_s=2.0,
+            backoff_s=0.01, breaker_threshold=1,
+            breaker_cooldown_s=0.2)
+        u_oracle = Universe(u.topology, StoreReader(mirror))
+        sel = "protein and name CA"
+        rmsf_oracle = RMSF(u_oracle.select_atoms(sel)).run(
+            backend="serial").results.rmsf
+        rmsd_oracle = RMSD(u_oracle, select=sel).run(
+            backend="serial").results.rmsd
+
+        with FleetController(tmp_path, host_ttl_s=5.0) as ctrl:
+            for _ in range(2):
+                ctrl.spawn_host(hb_interval_s=0.1)
+            assert ctrl.wait_hosts(2, timeout=60.0)
+
+            def _wave(tag):
+                # fresh tenant names each wave: the worker builds the
+                # tenant universe anew, so every wave genuinely pulls
+                # its chunks through the backend (a resident tenant
+                # would serve wave 2 from its decoded-chunk LRU and
+                # never touch the boundary under test)
+                jobs = [ctrl.submit({
+                    "analysis": "rmsf", "fixture": FIXTURE,
+                    "trajectory": url,
+                    "tenant": f"{tag}{i % 3}"}) for i in range(4)]
+                sharded = ctrl.submit({
+                    "analysis": "rmsd", "fixture": FIXTURE,
+                    "trajectory": url, "tenant": f"{tag}0",
+                    "shards": 2})
+                assert ctrl.drain(timeout=120.0), \
+                    f"{tag} wave drain timed out"
+                assert all(j.state == DONE for j in jobs), tag
+                assert sharded.state == DONE, tag
+                for j in jobs:
+                    np.testing.assert_allclose(
+                        j.result_arrays()["rmsf"], rmsf_oracle,
+                        atol=1e-5)
+                np.testing.assert_allclose(
+                    sharded.result_arrays()["rmsd"], rmsd_oracle,
+                    atol=1e-5)
+                return sharded
+
+            # wave 1: healthy remote — and the sharded job's windows
+            # land on chunk boundaries routed from the REMOTE manifest
+            sharded = _wave("clean")
+            for child in sharded.children:
+                assert child.spec["start"] % 4 == 0
+            _wait(lambda: _fleet_counter(
+                ctrl.fleet_snapshot(),
+                "mdtpu_store_remote_requests_total") > 0,
+                msg="federated remote request counters")
+
+            # wave 2: the remote goes FLAKY then hard-down mid-fleet —
+            # the first conversations meet resets, truncated and
+            # corrupt bodies, then every request 503s; jobs must ride
+            # cache+mirror to completion
+            srv.inject(
+                ServerFault("reset", times=2),
+                ServerFault("truncate", times=2),
+                ServerFault("corrupt", match="cas-", times=2),
+                ServerFault("http_5xx", times=None),
+                ServerFault("http_5xx", method="HEAD", times=None))
+            # deltas, not absolutes: the fleet snapshot merges the
+            # CONTROLLER-process series too, and earlier tests in
+            # this pytest process have already moved those counters
+            snap0 = ctrl.fleet_snapshot()
+            errs0 = _fleet_counter(snap0,
+                                   "mdtpu_store_remote_errors_total")
+            _wave("outage")
+            _wait(lambda: (
+                _fleet_counter(ctrl.fleet_snapshot(),
+                               "mdtpu_store_remote_errors_total")
+                > errs0),
+                msg="federated remote error counters")
+            snap = ctrl.fleet_snapshot()
+
+            def _moved(name):
+                return (_fleet_counter(snap, name)
+                        - _fleet_counter(snap0, name))
+
+            # the breakers really tripped (the transition counter
+            # federates through the same heartbeats)
+            assert _moved("mdtpu_breaker_transitions_total") > 0
+            # the ladder really served: cache and/or mirror traffic
+            assert (_moved("mdtpu_store_cache_hits_total")
+                    + _moved("mdtpu_store_mirror_reads_total")) > 0
+            # ... and no job ever saw a terminal unavailability
+            assert _moved("mdtpu_store_unavailable_total") == 0
+
+            # wave 3: faults clear, breaker cooldowns (0.2 s) lapse —
+            # the remote serves again (request counter moves anew)
+            srv.clear_faults()
+            time.sleep(0.3)
+            req0 = srv.requests
+            _wave("recovered")
+            assert srv.requests > req0
