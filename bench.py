@@ -1444,6 +1444,150 @@ def qos_serving_leg() -> dict:
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def ensemble_host_leg() -> dict:
+    """Ensemble scale-out sub-leg (docs/ENSEMBLE.md): an N>=8-member
+    trajectory set — the last two members an identical replica pair —
+    through the full parallel path (thread-pooled CAS ingest into
+    member stores, then ONE fleet ensemble job fanned over real host
+    processes, cross-trajectory reductions merged at the controller)
+    against the serial loop-over-universes baseline: open each XTC
+    in-process, stream the same RMSF, pool the Welford carries with
+    the SAME reducers the controller uses.  Parity gates the claim:
+    the fleet-merged ensemble RMSF must match the serial oracle at
+    f32 tolerance or ``ensemble_trajectories_per_s`` /
+    ``ensemble_speedup`` are withheld (null, disclosed by
+    ``ensemble_parity_ok``).  The replica pair's dedup is disclosed
+    deterministically: the twin ingests LAST, sequentially, so every
+    one of its chunks hardlinks against the pool instead of racing
+    its twin for it (``ensemble_dedup_ratio`` = 1.0).  Host-side by
+    construction — runs before first jax contact, survives the
+    outage protocol."""
+    import shutil
+    import tempfile
+
+    from mdanalysis_mpi_tpu import Universe
+    from mdanalysis_mpi_tpu import testing as _testing
+    from mdanalysis_mpi_tpu.analysis import RMSF
+    from mdanalysis_mpi_tpu.io.store.parallel import ingest_many
+    from mdanalysis_mpi_tpu.io.xtc import write_xtc
+    from mdanalysis_mpi_tpu.service.ensemble import merge_moments
+    from mdanalysis_mpi_tpu.service.fleet import (
+        DONE, FleetController,
+    )
+
+    n_members = max(8, int(os.environ.get("BENCH_ENSEMBLE_MEMBERS",
+                                          "8")))
+    frames_per = int(os.environ.get("BENCH_ENSEMBLE_FRAMES", "768"))
+    fixture = {"kind": "protein", "n_residues": 24, "seed": 5}
+    proto = _testing.make_protein_universe(n_residues=24, seed=5)
+    n_at = len(proto.atoms)
+    workdir = tempfile.mkdtemp(prefix="mdtpu-ensemble-leg-")
+    rng = np.random.default_rng(23)
+    xtcs, all_frames = [], []
+    try:
+        for i in range(n_members):
+            if i == n_members - 1:
+                frames = all_frames[-1]      # the replica pair
+            else:
+                frames = rng.normal(
+                    scale=4.0, size=(frames_per, n_at, 3)) \
+                    .astype(np.float32)
+            all_frames.append(frames)
+            path = os.path.join(workdir, f"member{i}.xtc")
+            write_xtc(path, frames,
+                      dimensions=np.array([60.0, 60, 60, 90, 90, 90]),
+                      times=np.arange(frames_per, dtype=np.float32))
+            xtcs.append(path)
+        # serial loop-over-universes baseline: what an operator runs
+        # without the fleet — one universe at a time, from the files
+        t0 = time.perf_counter()
+        carries = []
+        for path in xtcs:
+            u = Universe(proto.topology, path)
+            r = RMSF(u.atoms).run().results
+            carries.append({"mean": np.asarray(r.mean),
+                            "m2": np.asarray(r.m2),
+                            "n_frames": float(r.n_frames)})
+        serial_wall = time.perf_counter() - t0
+        oracle = merge_moments(carries)
+        serial_tps = n_members / serial_wall
+
+        out_root = os.path.join(workdir, "stores")
+        # parallel CAS ingest pre-stage: the N-1 distinct members fan
+        # on the thread pool; the replica twin then ingests LAST,
+        # sequentially, so its dedup is deterministic (every chunk
+        # links against the pool) instead of racing its twin for it
+        t1 = time.perf_counter()
+        ingest_many(xtcs[:-1], out_root, jobs=n_members,
+                    chunk_frames=64, quant="f32")
+        twin = ingest_many(xtcs, out_root, jobs=1, chunk_frames=64,
+                           quant="f32")
+        ingest_wall = time.perf_counter() - t1
+        dedup_ratio = twin["members"][-1]["dedup_ratio"]
+
+        n_hosts = max(2, min(4, os.cpu_count() or 2))
+        with FleetController(os.path.join(workdir, "ctl"),
+                             host_ttl_s=10.0, host_slots=2,
+                             status=False) as ctrl:
+            for _ in range(n_hosts):
+                ctrl.spawn_host(hb_interval_s=0.1)
+            if not ctrl.wait_hosts(n_hosts, timeout=120.0):
+                raise RuntimeError(
+                    "ensemble leg: hosts never joined")
+            t2 = time.perf_counter()
+            job = ctrl.submit({
+                "analysis": "rmsf", "select": "all",
+                "fixture": fixture, "tenant": "ens",
+                "ensemble": [{"trajectory": x} for x in xtcs],
+                "ingest": {"out_root": out_root, "chunk_frames": 64,
+                           "quant": "f32"}})
+            if not ctrl.drain(timeout=600.0):
+                raise RuntimeError("ensemble leg: drain timed out")
+            fleet_wall = time.perf_counter() - t2
+        if job.state != DONE:
+            raise RuntimeError(
+                f"ensemble leg: parent {job.state}: {job.error}")
+        res = job.results
+        got = np.asarray(res["rmsf"], dtype=np.float64)
+        want = np.asarray(oracle["rmsf"], dtype=np.float64)
+        err = float(np.abs(got - want).max())
+        parity_ok = bool(
+            got.shape == want.shape
+            and err <= 1e-4 * max(1.0, float(np.abs(want).max())))
+        pw = np.asarray(res["pairwise_rmsd"])
+        wall = ingest_wall + fleet_wall
+        rec = {
+            "ensemble_members": n_members,
+            "ensemble_frames_per_member": frames_per,
+            "ensemble_hosts": n_hosts,
+            # the speedup is only meaningful against the cores the
+            # host processes actually had — a 1-CPU box SHOULD read
+            # sub-1.0 (process fan-out cannot beat serial there)
+            "ensemble_cpus": os.cpu_count(),
+            "ensemble_serial_tps": round(serial_tps, 3),
+            "ensemble_ingest_wall_s": round(ingest_wall, 3),
+            "ensemble_fleet_wall_s": round(fleet_wall, 3),
+            "ensemble_parity_ok": parity_ok,
+            "ensemble_parity_max_err": round(err, 8),
+            "ensemble_dedup_ratio": dedup_ratio,
+            "ensemble_replica_pair_rmsd": round(
+                float(pw[n_members - 2, n_members - 1]), 8),
+        }
+        if parity_ok:
+            rec["ensemble_trajectories_per_s"] = round(
+                n_members / wall, 3)
+            rec["ensemble_speedup"] = round(
+                (n_members / wall) / serial_tps, 3)
+        else:
+            # parity gates the perf claim: a wrong answer has no
+            # throughput (the store/fleet legs' rule)
+            rec["ensemble_trajectories_per_s"] = None
+            rec["ensemble_speedup"] = None
+        return rec
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def serving_accel_leg(u_file, accel_backend: str, tdtype: str,
                       jax) -> dict:
     """Multi-tenant load on the accelerator backend with one SHARED
@@ -1711,6 +1855,20 @@ def main():
           f"+{qos['qos_hosts_scaled_up']}/"
           f"-{qos['qos_hosts_scaled_down']}")
     _leg_done("qos serving leg", **qos)
+
+    # ensemble scale-out sub-leg (docs/ENSEMBLE.md): N-trajectory set
+    # through parallel CAS ingest + one fleet ensemble job with
+    # cross-trajectory reductions, parity-gated against the serial
+    # loop-over-universes oracle — host-side, so it survives the
+    # outage protocol too
+    ens = ensemble_host_leg()
+    _note(f"[bench] ensemble: {ens['ensemble_members']} members -> "
+          f"{ens['ensemble_trajectories_per_s']} traj/s "
+          f"({ens['ensemble_speedup']}x vs serial "
+          f"{ens['ensemble_serial_tps']} traj/s, parity "
+          f"{ens['ensemble_parity_ok']}, replica dedup "
+          f"{ens['ensemble_dedup_ratio']})")
+    _leg_done("ensemble leg", **ens)
 
     u_file = open_flagship(N_ATOMS, N_FRAMES)
     src_label = ("file-backed XTC" if SOURCE == "file"

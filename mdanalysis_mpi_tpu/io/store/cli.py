@@ -32,11 +32,22 @@ def _parser() -> argparse.ArgumentParser:
         description="re-chunk a trajectory into the native block "
                     "store: ingest-once, random-access, quantized "
                     "(docs/STORE.md)")
-    p.add_argument("trajectory", nargs="?", default=None,
-                   help="trajectory file to ingest (any registered "
-                        "format: XTC/DCD/TRR/...)")
+    p.add_argument("trajectory", nargs="*", default=[],
+                   help="trajectory file(s) to ingest (any registered "
+                        "format: XTC/DCD/TRR/...); several files + "
+                        "--out-root run the parallel ensemble ingest "
+                        "(docs/ENSEMBLE.md)")
     p.add_argument("--out", default=None, metavar="DIR",
-                   help="store directory (created if missing)")
+                   help="store directory (created if missing) — "
+                        "single-trajectory mode")
+    p.add_argument("--out-root", default=None, metavar="DIR",
+                   help="ensemble root: member stores land at "
+                        "DIR/m0000, DIR/m0001, ... with cas-* chunk "
+                        "bytes deduplicated across members through "
+                        "the shared DIR/cas hardlink pool")
+    p.add_argument("--jobs", type=int, default=None, metavar="N",
+                   help="parallel member ingests (default: one per "
+                        "trajectory, capped at the CPU count)")
     p.add_argument("--chunk-frames", type=int, default=None,
                    help="frames per chunk (default 512 — the flagship "
                         "staging batch; match your executor batch_size "
@@ -60,6 +71,25 @@ def ingest_main(argv=None) -> int:
     ns = _parser().parse_args(argv)
     if ns.smoke:
         return _smoke()
+    if ns.out_root is not None or len(ns.trajectory) > 1:
+        # parallel ensemble ingest: N members fanned on a thread
+        # pool, content-addressed dedup across members, aggregate
+        # dedup_ratio in the summary (docs/ENSEMBLE.md)
+        if not ns.trajectory or not ns.out_root:
+            print(json.dumps({
+                "error": "ensemble ingest needs >= 1 trajectory and "
+                         "--out-root DIR (use --out for a single "
+                         "store)"}))
+            return 2
+        from mdanalysis_mpi_tpu.io.store.parallel import ingest_many
+
+        summary = ingest_many(ns.trajectory, ns.out_root,
+                              jobs=ns.jobs,
+                              chunk_frames=ns.chunk_frames,
+                              quant=ns.quant, stop=ns.stop,
+                              force=ns.force)
+        print(json.dumps(summary))
+        return 0 if summary["ok"] else 1
     if not ns.trajectory or not ns.out:
         print(json.dumps({"error": "ingest needs a trajectory and "
                                    "--out DIR (or --smoke)"}))
@@ -77,7 +107,7 @@ def ingest_main(argv=None) -> int:
             "quant": existing["quant"],
             "chunk_frames": existing["chunk_frames"]}))
         return 0
-    summary = ingest(ns.trajectory, ns.out,
+    summary = ingest(ns.trajectory[0], ns.out,
                      chunk_frames=ns.chunk_frames, quant=ns.quant,
                      stop=ns.stop)
     print(json.dumps(summary))

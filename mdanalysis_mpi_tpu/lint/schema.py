@@ -102,6 +102,8 @@ _TABLE_TYPES = {
     "PROF_HISTOGRAMS": "histogram",
     "ALERT_COUNTERS": "counter",
     "ALERT_GAUGES": "gauge",
+    "ENSEMBLE_COUNTERS": "counter",
+    "ENSEMBLE_GAUGES": "gauge",
 }
 
 _RECORD_TYPES = {"inc": "counter", "observe": "histogram",

@@ -88,6 +88,7 @@ LEG_FIELDS = {
     "obs_federation_jobs_per_s": ("higher", 40.0, "rel"),
     "obs_federation_overhead_pct": ("lower", 5.0, "abs"),
     "qos_batch_jobs_per_s": ("higher", 40.0, "rel"),
+    "ensemble_trajectories_per_s": ("higher", 40.0, "rel"),
     # accelerator legs (present only in tunnel-up artifacts)
     "value": ("higher", 25.0, "rel"),
     "cold_value": ("higher", 30.0, "rel"),

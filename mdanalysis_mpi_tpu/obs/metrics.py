@@ -369,6 +369,25 @@ ALERT_GAUGES = (
     "mdtpu_alerts_firing",
 )
 
+#: Ensemble scale-out series (docs/ENSEMBLE.md): trajectory-set
+#: parents accepted, member children fanned out / settled (labeled
+#: ``state=``), cross-trajectory merges applied at the controller, the
+#: parallel ingest pre-stage's member ingests (io/store/parallel.py
+#: counts these), and the cross-member chunk dedup ratio the last
+#: merged ensemble disclosed.  Zero-injected so the pinned schema
+#: holds in processes that never ran an ensemble.
+ENSEMBLE_COUNTERS = (
+    "mdtpu_ensemble_jobs_total",
+    "mdtpu_ensemble_members_total",
+    "mdtpu_ensemble_members_completed_total",
+    "mdtpu_ensemble_merges_total",
+    "mdtpu_ensemble_ingest_members_total",
+    "mdtpu_ensemble_ingest_failures_total",
+)
+ENSEMBLE_GAUGES = (
+    "mdtpu_ensemble_dedup_ratio",
+)
+
 
 def _merge_host_snapshot(snap: dict, hid: str, host_snap: dict) -> None:
     """Fold one host's shipped snapshot into the fleet document (the
@@ -441,7 +460,7 @@ def unified_snapshot(timers=None, cache=None, telemetry=None,
             INTEGRITY_COUNTERS + SCRUB_COUNTERS + STORE_COUNTERS + \
             STORE_REMOTE_COUNTERS + STORE_CACHE_COUNTERS + \
             FLEET_COUNTERS + FLEET_OBS_COUNTERS + QOS_COUNTERS + \
-            PROF_COUNTERS + ALERT_COUNTERS:
+            PROF_COUNTERS + ALERT_COUNTERS + ENSEMBLE_COUNTERS:
         snap.setdefault(name, {"type": "counter", "values": {"": 0}})
     for name in PROF_HISTOGRAMS:
         # empty series set: a histogram carries no zero point, but
@@ -449,7 +468,8 @@ def unified_snapshot(timers=None, cache=None, telemetry=None,
         snap.setdefault(name, {"type": "histogram", "values": {}})
     for name in BREAKER_GAUGES + LINT_GAUGES + INTEGRITY_GAUGES \
             + STORE_CACHE_GAUGES + FLEET_GAUGES + FLEET_OBS_GAUGES \
-            + QOS_GAUGES + PROF_GAUGES + ALERT_GAUGES:
+            + QOS_GAUGES + PROF_GAUGES + ALERT_GAUGES \
+            + ENSEMBLE_GAUGES:
         # 0 == closed (reliability/breaker.py STATE_VALUES): a process
         # that never tripped a breaker reports the healthy state;
         # likewise 0 lint rules/findings means "never linted here"

@@ -56,15 +56,19 @@ def shard_windows(n_frames: int | None, start: int | None,
     a ``stop`` nor ``n_frames`` the window is unbounded and unsplittable.
 
     ``chunk_frames`` (a block store's chunk geometry, docs/STORE.md)
-    aligns shard boundaries to chunk multiples for unit-step windows,
-    so each shard child's reads cover whole chunks and no chunk is
-    fetched by two hosts: shards get balanced CHUNK counts (edge
-    chunks may be partial where the window itself starts/ends
-    mid-chunk).  The union/order contract is unchanged.  Non-unit
-    steps visit frames the chunk grid cannot describe, so alignment
-    is skipped there.
+    aligns shard boundaries to chunk multiples, so each shard child's
+    reads cover whole chunks and no chunk is fetched by two hosts:
+    shards get balanced CHUNK counts (edge chunks may be partial where
+    the window itself starts/ends mid-chunk).  The union/order
+    contract is unchanged.  Non-unit steps align too: the VISITED
+    chunks (``f // chunk_frames`` over the strided index sequence)
+    are what get balanced, and each shard's window regenerates
+    exactly its run of the visited sequence — a stride wider than a
+    chunk simply skips chunks no shard ever fetches.
     """
     step = 1 if step is None else int(step)
+    if step < 1:
+        raise ValueError(f"step must be >= 1, got {step}")
     lo = 0 if start is None else int(start)
     hi = stop if stop is not None else n_frames
     if hi is None:
@@ -73,16 +77,33 @@ def shard_windows(n_frames: int | None, start: int | None,
             "n_frames=")
     if n_frames is not None:
         hi = min(int(hi), int(n_frames))
-    if chunk_frames and step == 1 and hi > lo:
+    if chunk_frames and hi > lo:
         cf = int(chunk_frames)
-        chunks = range(lo // cf, (hi - 1) // cf + 1)
+        idx = range(lo, hi, step)
+        # distinct chunks the strided window VISITS, in order — for
+        # step == 1 this is every chunk overlapping [lo, hi); for a
+        # stride wider than a chunk it skips the untouched ones.
+        # f // cf is nondecreasing over idx, so each shard's frames
+        # are one contiguous run of the visited sequence and a single
+        # (first, last + step, step) window regenerates it exactly.
+        chunk_first: list[int] = []        # first visited frame per chunk
+        chunk_last: list[int] = []         # last visited frame per chunk
+        for ci in range(lo // cf, (hi - 1) // cf + 1):
+            # first/last multiple of `step` (offset from lo) landing
+            # in chunk ci's span, clipped to the window
+            c_lo, c_hi = max(ci * cf, lo), min((ci + 1) * cf, hi)
+            first = lo + -(-(c_lo - lo) // step) * step
+            if first >= c_hi:
+                continue                   # stride skipped this chunk
+            chunk_first.append(first)
+            chunk_last.append(lo + ((c_hi - 1 - lo) // step) * step)
         out = []
-        for block in static_blocks(len(chunks), n_shards):
+        for block in static_blocks(len(chunk_first), n_shards):
             if len(block) == 0:
                 out.append(None)
                 continue
-            c0, c1 = chunks[block.start], chunks[block.stop - 1]
-            out.append((max(lo, c0 * cf), min(hi, (c1 + 1) * cf), 1))
+            out.append((chunk_first[block.start],
+                        chunk_last[block.stop - 1] + step, step))
         return out
     idx = range(lo, hi, step)
     out = []

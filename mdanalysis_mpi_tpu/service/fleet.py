@@ -94,7 +94,12 @@ _TERMINAL = (DONE, FAILED, QUARANTINED, SHED)
 
 #: Fleet-only job-spec keys stripped before the host builds the
 #: analysis (everything else is the ``batch`` CLI's job schema).
-_FLEET_SPEC_KEYS = ("fixture", "shards")
+#: ``ensemble``/``ingest`` are the trajectory-set extension
+#: (docs/ENSEMBLE.md): the controller expands them into member +
+#: ingest children; the host reads ``ingest`` itself (pre-stage runs,
+#: replay-safe store ensure) before the analysis build ever sees the
+#: spec.
+_FLEET_SPEC_KEYS = ("fixture", "shards", "ensemble", "ingest")
 
 
 def _send_line(sock: socket.socket, lock: threading.Lock,
@@ -150,7 +155,8 @@ class FleetJob:
     __slots__ = ("fp", "spec", "tenant", "qos", "state", "host",
                  "assign_seq", "assign_epoch", "results", "error",
                  "migrations", "resident", "parent", "children",
-                 "shard_index", "submit_t", "done_t", "_event")
+                 "shard_index", "member_index", "placement_key",
+                 "ingest_children", "submit_t", "done_t", "_event")
 
     def __init__(self, fp: str, spec: dict, tenant: str):
         from mdanalysis_mpi_tpu.service.qos import validate_qos
@@ -174,6 +180,14 @@ class FleetJob:
         self.parent: FleetJob | None = None
         self.children: list[FleetJob] | None = None
         self.shard_index: int | None = None
+        #: ensemble extension (docs/ENSEMBLE.md): which member of a
+        #: trajectory-set parent this child is (None = not an
+        #: ensemble child), an explicit placement key overriding the
+        #: tenant/shard routing, and — on the PARENT — the ingest
+        #: pre-stage children whose dedup ledger the merge discloses
+        self.member_index: int | None = None
+        self.placement_key: str | None = None
+        self.ingest_children: list[FleetJob] | None = None
         #: submission/settle wall stamps (time.monotonic) — the
         #: per-class latency the QoS bench leg reads off the
         #: controller without a round trip per job
@@ -356,6 +370,12 @@ class FleetController:
         self._hosts: dict[str, _Host] = {}
         self._jobs: dict[str, FleetJob] = {}
         self._pending: list[str] = []
+        #: ensemble ingest gating (docs/ENSEMBLE.md "Ingest
+        #: pre-stage"): ingest-child fp → the member-analysis fp it
+        #: gates.  A gated member is registered + journaled at submit
+        #: but enters ``_pending`` only when its ingest child lands
+        #: DONE (a failed ingest fails the member typed instead).
+        self._gated: dict[str, str] = {}
         self._assign_seq = 0
         self._job_seq = 0
         self._host_seq = 0
@@ -689,6 +709,7 @@ class FleetController:
             job._settle()
             if job.parent is not None:
                 self._merge_parent(job.parent)
+            self._release_gated(job)
         self.telemetry.count("hosts_rejoined" if rejoin
                              else "hosts_joined")
         self.breakers.get(hid, mesh="fleet").record_success()
@@ -879,18 +900,37 @@ class FleetController:
     def submit(self, spec: dict, tenant: str = "default",
                fingerprint: str | None = None) -> FleetJob:
         """Queue one job spec (the ``batch`` CLI's job schema plus the
-        fleet fields ``fixture`` and ``shards``).  Returns a waitable
-        :class:`FleetJob`.  With ``shards=N`` the frame window is
-        split into N contiguous sub-windows (``parallel.partition.
-        shard_windows``) run as independent sub-jobs across the fleet,
-        and the parent's results are the frame-axis concatenation of
-        the shards' — time-series analyses only (per-frame rows), the
-        task-parallel decomposition of PAPERS.md 1801.07630."""
+        fleet fields ``fixture``, ``shards``, ``ensemble`` and
+        ``ingest``).  Returns a waitable :class:`FleetJob`.  With
+        ``shards=N`` the frame window is split into N contiguous
+        sub-windows (``parallel.partition.shard_windows``) run as
+        independent sub-jobs across the fleet, and the parent's
+        results are the frame-axis concatenation of the shards' —
+        time-series analyses only (per-frame rows), the task-parallel
+        decomposition of PAPERS.md 1801.07630.  With ``ensemble``
+        (an int member count or a list of per-member override dicts;
+        docs/ENSEMBLE.md) the job fans into N per-trajectory member
+        children — optionally preceded by a parallel store-first
+        ``ingest`` pre-stage — and the parent's results are the
+        cross-trajectory reduction (:func:`~mdanalysis_mpi_tpu.
+        service.ensemble.merge_member_results`)."""
         spec = dict(spec)
         tenant = str(spec.get("tenant", tenant))
         spec["tenant"] = tenant
         shards = int(spec.pop("shards", 0) or 0)
+        ensemble = spec.pop("ensemble", None)
+        if ensemble is not None and shards:
+            from mdanalysis_mpi_tpu.service.ensemble import (
+                EnsembleSpecError,
+            )
+
+            raise EnsembleSpecError(
+                "ensemble and shards are mutually exclusive on one "
+                "job (shard the members' windows in a follow-up pass "
+                "instead)")
         dispatchable: list[FleetJob] = []
+        enqueue: list[FleetJob] = []
+        quota_reject = False
         # fingerprint derivation AND registration under ONE lock
         # scope: two concurrent submits deriving the same auto
         # fingerprint would otherwise silently overwrite each other's
@@ -899,28 +939,72 @@ class FleetController:
         with self._lock:
             if self._shutdown:
                 raise RuntimeError("fleet controller is shut down")
-            if fingerprint is None:
-                fingerprint = (f"{tenant}|{spec.get('analysis', '?')}"
-                               f"#{self._job_seq}")
-            self._job_seq += 1
-            job = FleetJob(fingerprint, spec, tenant)
-            job.submit_t = time.monotonic()
-            if shards > 1:
-                self._register_sharded_locked(job, shards)
-                dispatchable = job.children
-                if not dispatchable:
-                    # an empty frame window shards into nothing: with
-                    # no child to ever complete, the parent would hang
-                    # drain()/wait() forever — fail it NOW, typed
-                    job.state = FAILED
-                    job.error = ("sharded window is empty (no frames "
-                                 "between start and stop)")
-            else:
-                self._jobs[fingerprint] = job
-                dispatchable = [job]
+            # tenant inflight quota counts LOGICAL jobs — parents and
+            # solo jobs, never children: a 10k-member ensemble is ONE
+            # unit against its tenant's quota, exactly like one
+            # trajectory (docs/ENSEMBLE.md "QoS accounting")
+            if self.qos.tenant_quota is not None:
+                live = sum(1 for j in self._jobs.values()
+                           if j.tenant == tenant
+                           and j.parent is None
+                           and j.state not in _TERMINAL)
+                quota_reject = live >= self.qos.tenant_quota
+            if not quota_reject:
+                if fingerprint is None:
+                    fingerprint = (
+                        f"{tenant}|{spec.get('analysis', '?')}"
+                        f"#{self._job_seq}")
+                self._job_seq += 1
+                job = FleetJob(fingerprint, spec, tenant)
+                job.submit_t = time.monotonic()
+                if shards > 1:
+                    self._register_sharded_locked(job, shards)
+                    dispatchable = enqueue = job.children
+                    if not dispatchable:
+                        # an empty frame window shards into nothing:
+                        # with no child to ever complete, the parent
+                        # would hang drain()/wait() forever — fail it
+                        # NOW, typed
+                        job.state = FAILED
+                        job.error = ("sharded window is empty (no "
+                                     "frames between start and stop)")
+                elif ensemble is not None:
+                    self._register_ensemble_locked(job, ensemble)
+                    dispatchable = (list(job.ingest_children or ())
+                                    + list(job.children))
+                    gated = set(self._gated.values())
+                    enqueue = [d for d in dispatchable
+                               if d.fp not in gated]
+                else:
+                    self._jobs[fingerprint] = job
+                    dispatchable = enqueue = [job]
+        if quota_reject:
+            from mdanalysis_mpi_tpu.service.jobs import (
+                AdmissionRejectedError,
+            )
+
+            self.telemetry.count("admission_rejects")
+            obs.METRICS.inc("mdtpu_admission_rejects_total",
+                            reason="tenant_quota")
+            obs.span_event("admission_reject", tenant=tenant,
+                           reason="tenant_quota")
+            raise AdmissionRejectedError(
+                f"tenant {tenant!r} is at its inflight quota of "
+                f"{self.qos.tenant_quota} logical job(s) — an "
+                "ensemble counts as one", reason="tenant_quota")
         if shards > 1 and not dispatchable:
             job._settle()
             return job
+        if ensemble is not None:
+            self.telemetry.count("ensembles_submitted")
+            self.telemetry.count("ensemble_members",
+                                 len(job.children))
+            obs.METRICS.inc("mdtpu_ensemble_jobs_total")
+            obs.METRICS.inc("mdtpu_ensemble_members_total",
+                            len(job.children))
+            obs.span_event("ensemble_submitted", fp=job.fp,
+                           tenant=tenant, members=len(job.children),
+                           ingest=len(job.ingest_children or ()))
         # journal the spec-bearing submit record BEFORE the job
         # becomes dispatchable: the supervisor tick can assign within
         # milliseconds, and a crash after its `assign` but before this
@@ -932,7 +1016,7 @@ class FleetController:
             self.journal.record("submit", d.fp, tenant=d.tenant,
                                 spec=d.spec)
         with self._lock:
-            for d in dispatchable:
+            for d in enqueue:
                 self._pending.append(d.fp)
         self._dispatch()
         # overload check after the enqueue (docs/RELIABILITY.md §7):
@@ -975,6 +1059,75 @@ class FleetController:
         self._jobs[parent.fp] = parent
         for child in parent.children:
             self._jobs[child.fp] = child
+
+    def _register_ensemble_locked(self, parent: FleetJob,
+                                  ensemble) -> None:
+        # caller holds self._lock.  Trajectory-set fan-out
+        # (docs/ENSEMBLE.md): one member-analysis child per
+        # trajectory, each routed by its OWN placement key (spreading
+        # the set over the fleet, like shards spread one window) —
+        # optionally preceded by a store-first ingest pre-stage child
+        # per member that GATES the member: the member is registered
+        # and journaled now but enters the pending queue only when
+        # its ingest child lands DONE (self._gated).
+        from mdanalysis_mpi_tpu.service.ensemble import (
+            EnsembleSpecError, expand_ensemble, member_store,
+        )
+
+        spec = dict(parent.spec)
+        spec["ensemble"] = ensemble
+        members = expand_ensemble(spec)     # typed EnsembleSpecError
+        ingest_cfg = spec.get("ingest")
+        if ingest_cfg is not None and (
+                not isinstance(ingest_cfg, dict)
+                or not ingest_cfg.get("out_root")):
+            raise EnsembleSpecError(
+                "ensemble ingest must be a dict with out_root (the "
+                f"member stores' root directory), got {ingest_cfg!r}")
+        parent.children = []
+        parent.ingest_children = []
+        for i, sub in enumerate(members):
+            sub["tenant"] = parent.tenant
+            src = sub.get("trajectory")
+            if ingest_cfg is not None and src:
+                dest = member_store(ingest_cfg["out_root"], i)
+                icfg = {"trajectory": src, "out": dest,
+                        "out_root": ingest_cfg["out_root"]}
+                for k in ("chunk_frames", "quant", "stop"):
+                    if ingest_cfg.get(k) is not None:
+                        icfg[k] = ingest_cfg[k]
+                ispec = {"tenant": parent.tenant, "ingest": icfg}
+                if "qos" in sub:
+                    ispec["qos"] = sub["qos"]
+                ij = FleetJob(f"{parent.fp}/i{i}", ispec,
+                              parent.tenant)
+                ij.member_index = i
+                ij.placement_key = f"{parent.tenant}@i{i}"
+                parent.ingest_children.append(ij)
+                # the member reads the ingested store, and KEEPS the
+                # ingest block on its journaled spec: a member
+                # re-dispatched after a controller restart can
+                # ensure-store idempotently instead of finding a
+                # missing directory
+                sub["trajectory"] = dest
+                sub["ingest"] = icfg
+                self._gated[ij.fp] = f"{parent.fp}/m{i}"
+            child = FleetJob(f"{parent.fp}/m{i}", sub, parent.tenant)
+            child.parent = parent
+            child.member_index = i
+            # members route by (tenant, trajectory): distinct
+            # trajectories spread across the fleet, while a re-submit
+            # of the same member lands back on the host that already
+            # holds its store resident
+            child.placement_key = (
+                f"{parent.tenant}@"
+                f"{sub.get('trajectory') or f'm{i}'}")
+            parent.children.append(child)
+        self._jobs[parent.fp] = parent
+        for child in parent.children:
+            self._jobs[child.fp] = child
+        for ij in parent.ingest_children:
+            self._jobs[ij.fp] = ij
 
     def _ordered_pending_locked(self) -> list[str]:
         """The pending queue in weighted-fair class order
@@ -1028,12 +1181,14 @@ class FleetController:
                 job = self._jobs.get(fp)
                 if job is None or job.state in _TERMINAL:
                     continue
-                # a sharded child routes by (tenant, shard): the whole
-                # point of trajectory sharding is spreading one
-                # tenant's window over the fleet, so the shards must
-                # not all ride the tenant's sticky home
-                key = (job.tenant if job.shard_index is None
-                       else f"{job.tenant}#s{job.shard_index}")
+                # a sharded child routes by (tenant, shard), an
+                # ensemble child by its (tenant, trajectory)
+                # placement_key: the whole point of either fan-out is
+                # spreading one tenant's work over the fleet, so the
+                # children must not all ride the tenant's sticky home
+                key = job.placement_key or (
+                    job.tenant if job.shard_index is None
+                    else f"{job.tenant}#s{job.shard_index}")
                 hid = self.placement.assign(key)
                 host = self._hosts.get(hid) if hid else None
                 if host is None or not host.alive \
@@ -1143,28 +1298,84 @@ class FleetController:
         self.breakers.get(hid, mesh="fleet").record_success()
         if host is not None:
             _send_line(host.sock, host.send_lock, ack)
+        if job.member_index is not None and job.parent is not None:
+            self.telemetry.count("ensemble_members_completed"
+                                 if job.state == DONE
+                                 else "ensemble_members_failed")
+            obs.METRICS.inc("mdtpu_ensemble_members_completed_total",
+                            state=job.state)
         job._settle()
         if job.parent is not None:
             self._merge_parent(job.parent)
+        self._release_gated(job)
         self._dispatch()
 
     def _merge_parent(self, parent: FleetJob) -> None:
-        """Complete a sharded parent once every child is terminal:
-        frame-axis concatenation of the shards' result arrays, in
-        shard order (partition-aware merge — the map-reduce half of
-        the task-parallel decomposition)."""
+        """Complete a fanned-out parent once every child is terminal.
+        Sharded parents get the frame-axis concatenation of the
+        shards' result arrays, in shard order (partition-aware merge
+        — the map-reduce half of the task-parallel decomposition);
+        ensemble parents get the cross-trajectory reduction
+        (:func:`~mdanalysis_mpi_tpu.service.ensemble.
+        merge_member_results`: pooled-Welford RMSF, frame-weighted
+        RDF, pairwise mean-structure RMSD, per-member fan-out) plus
+        the ingest pre-stage's dedup ledger."""
         import numpy as np
 
+        merged_ok = False
         with self._lock:
             children = list(parent.children or ())
             if parent.state in _TERMINAL or \
                     not all(c.done() for c in children):
                 return
             failed = [c for c in children if c.state != DONE]
+            ensemble = any(c.member_index is not None
+                           for c in children)
             if failed:
                 parent.state = FAILED
-                parent.error = (f"{len(failed)} shard(s) failed: "
-                                f"{failed[0].error}")
+                parent.error = (
+                    f"{len(failed)} "
+                    f"{'member' if ensemble else 'shard'}(s) failed: "
+                    f"{failed[0].error}")
+            elif ensemble:
+                from mdanalysis_mpi_tpu.service.ensemble import (
+                    merge_member_results,
+                )
+
+                ordered = sorted(children,
+                                 key=lambda c: c.member_index)
+                try:
+                    merged = merge_member_results(
+                        [(c.member_index, c.spec, c.results or {})
+                         for c in ordered])
+                except Exception as exc:      # malformed member data
+                    parent.state = FAILED
+                    parent.error = (f"ensemble merge failed: "
+                                    f"{type(exc).__name__}: {exc}")
+                else:
+                    # fold the ingest pre-stage's dedup ledger into
+                    # the parent's results — the replica-dedup
+                    # disclosure the bench leg and the chaos test
+                    # read off the merged job
+                    ing = [j for j in (parent.ingest_children or ())
+                           if j.state == DONE and j.results]
+                    if ing:
+                        tb = sum(float(j.results.get("bytes", 0)
+                                       or 0) for j in ing)
+                        db = sum(float(j.results.get("dedup_bytes",
+                                                     0) or 0)
+                                 for j in ing)
+                        merged["ensemble_ingest_members"] = len(ing)
+                        merged["ensemble_ingest_bytes"] = tb
+                        merged["ensemble_ingest_dedup_bytes"] = db
+                        merged["ensemble_ingest_dedup_chunks"] = sum(
+                            int(j.results.get("dedup_chunks", 0)
+                                or 0) for j in ing)
+                        merged["ensemble_dedup_ratio"] = (
+                            round(db / tb, 4) if tb else 0.0)
+                    parent.state = DONE
+                    parent.results = merged
+                    merged_ok = True
             else:
                 merged: dict = {}
                 ordered = sorted(children,
@@ -1199,7 +1410,57 @@ class FleetController:
                 else:
                     parent.state = DONE
                     parent.results = merged
+        if merged_ok:
+            self.telemetry.count("ensemble_merges")
+            obs.METRICS.inc("mdtpu_ensemble_merges_total")
+            ratio = (parent.results or {}).get(
+                "ensemble_dedup_ratio")
+            if ratio is not None:
+                obs.METRICS.set_gauge("mdtpu_ensemble_dedup_ratio",
+                                      float(ratio))
+            obs.span_event("ensemble_merged", fp=parent.fp,
+                           members=len(parent.children or ()))
         parent._settle()
+
+    def _release_gated(self, job: FleetJob) -> None:
+        """An ingest pre-stage child reached a terminal state: open
+        (or fail) the member-analysis job it gates.  DONE → the
+        member enters the pending queue and dispatches; any other
+        terminal (failed / shed / quarantined) → the member fails
+        typed NOW — its store never materialized, so dispatching it
+        would burn a host timeout to learn the same thing."""
+        dispatch = False
+        fail_member: FleetJob | None = None
+        with self._lock:
+            member_fp = self._gated.pop(job.fp, None)
+            if member_fp is None:
+                return
+            member = self._jobs.get(member_fp)
+            if member is None or member.state in _TERMINAL:
+                return
+            if job.state == DONE:
+                self._pending.append(member_fp)
+                dispatch = True
+            else:
+                member.state = FAILED
+                member.error = (f"ingest pre-stage {job.fp} "
+                                f"{job.state}: {job.error}")
+                fail_member = member
+        if dispatch:
+            self._dispatch()
+            return
+        # failing the member is itself a terminal transition: journal
+        # it durably (exactly-once on replay), count it, and let the
+        # parent merge observe the failure
+        self.journal.record("finish", fail_member.fp, state=FAILED,
+                            durable=True)
+        self.telemetry.count("jobs_failed")
+        self.telemetry.count("ensemble_members_failed")
+        obs.METRICS.inc("mdtpu_ensemble_members_completed_total",
+                        state=FAILED)
+        fail_member._settle()
+        if fail_member.parent is not None:
+            self._merge_parent(fail_member.parent)
 
     # ---- host loss / migration ----
 
@@ -1277,6 +1538,7 @@ class FleetController:
                 # child as far as _apply_done is concerned — without
                 # this, the parent never resolves and drain() hangs
                 self._merge_parent(job.parent)
+            self._release_gated(job)
         if self.respawn_hosts and not self._shutdown:
             self.spawn_host()
         self._dispatch()
@@ -1345,6 +1607,7 @@ class FleetController:
             job._settle()
             if job.parent is not None:
                 self._merge_parent(job.parent)
+            self._release_gated(job)
         if sheds:
             self._log.warning(
                 "overload: shed %d pending job(s) (classes %s) — "
@@ -1775,12 +2038,65 @@ def _build_universe(spec: dict):
 def _tenant_key(spec: dict) -> str:
     """The identity of a tenant's resident state on a host: its data
     source.  Wave 2 of a sticky tenant hits this key on its home host
-    — the host-level analog of a cache hit."""
+    — the host-level analog of a cache hit.  The trajectory is part of
+    the identity even WITH a fixture (a fixture+trajectory spec reads
+    coordinates from the trajectory — ensemble members share one
+    fixture topology over N different trajectories, and keying on the
+    fixture alone would serve every member the first member's
+    frames)."""
     fixture = spec.get("fixture")
-    src = fixture if fixture else {"topology": spec.get("topology"),
-                                   "trajectory": spec.get("trajectory")}
+    src = {"fixture": fixture,
+           "trajectory": spec.get("trajectory")} if fixture else \
+        {"topology": spec.get("topology"),
+         "trajectory": spec.get("trajectory")}
     return json.dumps({"tenant": spec.get("tenant"), "src": src},
                       sort_keys=True)
+
+
+def _ensure_member_store(icfg: dict) -> dict:
+    """Idempotent store-first member ingest (docs/ENSEMBLE.md "Ingest
+    pre-stage"): an existing verified store at ``icfg["out"]`` IS the
+    answer; otherwise decode ``icfg["trajectory"]`` into it — through
+    the ensemble's shared CAS hardlink pool when ``out_root`` rides
+    along, so replica members dedup chunk bytes across hosts that
+    share the filesystem.  Returns the ingest summary the controller
+    folds into the parent's dedup ledger."""
+    import os as _os
+
+    from mdanalysis_mpi_tpu.io.store import store_meta
+    from mdanalysis_mpi_tpu.io.store.ingest import ingest
+
+    out = icfg["out"]
+    try:
+        existing = None if icfg.get("force") else store_meta(out)
+    except Exception:
+        existing = None            # a torn half-store re-ingests
+    if existing is not None:
+        return {"store": out, "already_ingested": True,
+                "n_frames": existing["n_frames"],
+                "n_chunks": len(existing["chunks"]),
+                "bytes": 0, "dedup_bytes": 0, "dedup_chunks": 0}
+    backend = None
+    if icfg.get("out_root"):
+        from mdanalysis_mpi_tpu.io.store.parallel import (
+            POOL_DIR, PooledCasBackend,
+        )
+
+        backend = PooledCasBackend(
+            out, _os.path.join(_os.fspath(icfg["out_root"]),
+                               POOL_DIR))
+    if backend is not None:
+        summary = dict(ingest(icfg["trajectory"], backend=backend,
+                              chunk_frames=icfg.get("chunk_frames"),
+                              quant=icfg.get("quant", "int16"),
+                              stop=icfg.get("stop")))
+    else:
+        summary = dict(ingest(icfg["trajectory"], out,
+                              chunk_frames=icfg.get("chunk_frames"),
+                              quant=icfg.get("quant", "int16"),
+                              stop=icfg.get("stop")))
+    summary["store"] = out
+    return summary
 
 
 class _HostWorker:
@@ -1991,6 +2307,15 @@ class _HostWorker:
         token = (msg.get("assign"), msg.get("epoch"))
         with self._lock:
             self._inflight[fp] = token
+        if spec.get("ingest") and not spec.get("analysis"):
+            # a store-first ingest pre-stage child (docs/ENSEMBLE.md):
+            # pure host decode+pack, jax-free and scheduler-free —
+            # run it off the command loop so other tenants' jobs keep
+            # landing while the decode streams
+            threading.Thread(
+                target=self._run_ingest, args=(fp, token, spec),
+                daemon=True, name=f"mdtpu-ingest-{fp}").start()
+            return
         try:
             handle, resident = self._submit_local(fp, spec)
         except Exception as exc:
@@ -2002,9 +2327,28 @@ class _HostWorker:
             lambda h, fp=fp, token=token, resident=resident:
             self._on_local_done(fp, token, resident, h))
 
+    def _run_ingest(self, fp: str, token, spec: dict) -> None:
+        try:
+            summary = _ensure_member_store(spec["ingest"])
+        except Exception as exc:
+            self._finish(fp, token, state="failed",
+                         error=f"{type(exc).__name__}: {exc}",
+                         resident=False)
+            return
+        self._finish(fp, token, state="done", results=summary,
+                     resident=False)
+
     def _submit_local(self, fp: str, spec: dict):
         from mdanalysis_mpi_tpu.service.cli import _build_job
 
+        icfg = spec.get("ingest")
+        if icfg and icfg.get("out"):
+            # replay safety for ensemble members: a member
+            # re-dispatched after a controller restart (or a member
+            # adopted straight from the journal) may land without its
+            # ingest child having run on THIS host's filesystem —
+            # ensure the store idempotently before opening it
+            _ensure_member_store(icfg)
         key = _tenant_key(spec)
         with self._lock:
             u = self._universes.get(key)
@@ -2252,6 +2596,97 @@ def qos_elasticity_smoke(workdir) -> dict:
     return out
 
 
+def ensemble_smoke(workdir) -> dict:
+    """The ensemble scale-out phase of the dryrun smoke
+    (docs/ENSEMBLE.md): one 4-member trajectory-set job with a
+    store-first ingest pre-stage — members 2 and 3 are an identical
+    replica pair — through ONE single-slot host, so the pre-stage
+    ingests run in a deterministic serial order and the replica
+    pair's dedup is exact (2 of the 8 member chunks link instead of
+    writing).  Assertable outcomes: the parent merges DONE with
+    the pooled-Welford ``rmsf``, the replica pair's ``pairwise_rmsd``
+    entry is ~0 while distinct members' is not, the ingest ledger
+    discloses the dedup, and the journal audits exactly-once across
+    ingest children AND members."""
+    import numpy as np
+
+    from mdanalysis_mpi_tpu import testing as _testing
+    from mdanalysis_mpi_tpu.io.xtc import write_xtc
+    from mdanalysis_mpi_tpu.service.journal import replay_fleet as _rf
+
+    out: dict = {}
+    workdir = str(workdir)
+    os.makedirs(workdir, exist_ok=True)
+    fixture = {"kind": "protein", "n_residues": 6, "seed": 3}
+    n_atoms = len(_testing.make_protein_universe(
+        n_residues=6, seed=3).atoms)
+    rng = np.random.default_rng(11)
+    xtcs = []
+    frames_by_member = []
+    for i in range(4):
+        if i == 3:
+            frames = frames_by_member[2]     # the replica pair
+        else:
+            frames = rng.normal(scale=3.0, size=(8, n_atoms, 3)) \
+                .astype(np.float32)
+        frames_by_member.append(frames)
+        path = os.path.join(workdir, f"member{i}.xtc")
+        write_xtc(path, frames,
+                  dimensions=np.array([40.0, 40, 40, 90, 90, 90]),
+                  times=np.arange(8, dtype=np.float32))
+        xtcs.append(path)
+    with FleetController(os.path.join(workdir, "ctl"), host_ttl_s=5.0,
+                         host_slots=1, status=False) as ctrl:
+        ctrl.spawn_host(hb_interval_s=0.1)
+        if not ctrl.wait_hosts(1, timeout=60.0):
+            out["error"] = "ensemble phase: host never joined"
+            return out
+        job = ctrl.submit({
+            "analysis": "rmsf", "fixture": fixture, "tenant": "ens",
+            "ensemble": [{"trajectory": x} for x in xtcs],
+            "ingest": {"out_root": os.path.join(workdir, "stores"),
+                       "chunk_frames": 4}})
+        if not ctrl.drain(timeout=120.0):
+            out["error"] = "ensemble phase: drain timed out"
+            return out
+        out["ensemble_state"] = job.state
+        res = job.results or {}
+        snap = ctrl.telemetry.snapshot()
+        out["ensemble_members_completed"] = \
+            snap["ensemble_members_completed"]
+        out["ensemble_merges"] = snap["ensemble_merges"]
+    out["ensemble_error"] = job.error
+    out["ensemble_n_frames"] = res.get("n_frames")
+    out["ensemble_dedup_ratio"] = res.get("ensemble_dedup_ratio")
+    out["ensemble_dedup_chunks"] = res.get(
+        "ensemble_ingest_dedup_chunks")
+    pw = np.asarray(res.get("pairwise_rmsd", np.zeros((0, 0))))
+    out["ensemble_replica_rmsd"] = (float(pw[2, 3])
+                                    if pw.shape == (4, 4) else None)
+    out["ensemble_distinct_rmsd"] = (float(pw[0, 1])
+                                     if pw.shape == (4, 4) else None)
+    meta = _rf(os.path.join(workdir, "ctl", JOURNAL_NAME))
+    out["ensemble_exactly_once"] = all(
+        n == 1 for n in meta["finishes"].values()) and \
+        len(meta["finishes"]) == 8          # 4 ingests + 4 members
+    out["ensemble_ok"] = (
+        out["ensemble_state"] == DONE
+        and res.get("ensemble_members") == 4
+        and out["ensemble_n_frames"] == 32.0
+        and "rmsf" in res and "member0_rmsf" in res
+        # the replica pair's 2 chunks link instead of writing — ~1/4
+        # of the byte volume (zlib sizes vary slightly per member)
+        and out["ensemble_dedup_chunks"] == 2
+        and 0.15 < (out["ensemble_dedup_ratio"] or 0) < 0.35
+        and out["ensemble_replica_rmsd"] is not None
+        and out["ensemble_replica_rmsd"] < 1e-6
+        and out["ensemble_distinct_rmsd"] > 0.1
+        and out["ensemble_members_completed"] == 4
+        and out["ensemble_merges"] == 1
+        and out["ensemble_exactly_once"])
+    return out
+
+
 def fleet_smoke(workdir=None, n_hosts: int = 2,
                 kill_mid_wave: bool = True) -> dict:
     """The dryrun serving leg at smoke scale: K tenants across
@@ -2414,11 +2849,18 @@ def fleet_smoke(workdir=None, n_hosts: int = 2,
         #      wave's exactly-once ledger stays unambiguous ----
         record.update(qos_elasticity_smoke(
             os.path.join(workdir, "qos")))
+        # ---- phase 4: ensemble scale-out (docs/ENSEMBLE.md) — its
+        #      own controller + journal too: a 4-member trajectory-set
+        #      job with the CAS ingest pre-stage, merged reductions,
+        #      replica-pair dedup ----
+        record.update(ensemble_smoke(
+            os.path.join(workdir, "ensemble")))
         record["ok"] = (record["jobs_done"] == len(jobs)
                         and record["exactly_once"]
                         and record["federation_match"]
                         and record["trace_pids"] >= n_hosts
                         and record.get("qos_ok", False)
+                        and record.get("ensemble_ok", False)
                         and (not kill_mid_wave
                              or (record["jobs_migrated"] >= 1
                                  and stitched is not None
@@ -2432,7 +2874,8 @@ def fleet_main(argv=None) -> int:
     """Entry point of the ``fleet`` subcommand: ``--smoke`` runs the
     dryrun chaos smoke (scripts/verify.sh stage 2); otherwise a JSON
     job file (the ``batch`` schema plus ``hosts``/``fixture``/
-    ``shards`` fields) is served across spawned host processes."""
+    ``shards``/``ensemble``/``ingest`` fields — docs/ENSEMBLE.md) is
+    served across spawned host processes."""
     import argparse
 
     p = argparse.ArgumentParser(

@@ -327,6 +327,14 @@ class FleetTelemetry:
         self.hosts_scaled_down = 0     # hosts drain-retired by it
         self.jobs_shed = 0             # pending jobs dropped by the
         #                                controller's shed ladder
+        self.admission_rejects = 0     # typed submit() refusals
+        #                                (tenant logical-job quota)
+        # ensemble scale-out (docs/ENSEMBLE.md)
+        self.ensembles_submitted = 0   # ensemble parents accepted
+        self.ensemble_members = 0      # member children fanned out
+        self.ensemble_members_completed = 0
+        self.ensemble_members_failed = 0
+        self.ensemble_merges = 0       # cross-trajectory reductions
 
     def count(self, counter: str, n: int = 1) -> None:
         with self._lock:
@@ -348,6 +356,14 @@ class FleetTelemetry:
                 "hosts_scaled_up": self.hosts_scaled_up,
                 "hosts_scaled_down": self.hosts_scaled_down,
                 "jobs_shed": self.jobs_shed,
+                "admission_rejects": self.admission_rejects,
+                "ensembles_submitted": self.ensembles_submitted,
+                "ensemble_members": self.ensemble_members,
+                "ensemble_members_completed":
+                    self.ensemble_members_completed,
+                "ensemble_members_failed":
+                    self.ensemble_members_failed,
+                "ensemble_merges": self.ensemble_merges,
             }
         lookups = out["home_hits"] + out["home_misses"]
         out["home_hit_rate"] = (round(out["home_hits"] / lookups, 4)

@@ -11,7 +11,10 @@
 #      proof, corrupt-wire-body rejection).  Jax-free, ~2 s.
 #   3. The fleet dryrun smoke (docs/RELIABILITY.md §6): 2 real host
 #      processes, one kill -9 mid-wave, exactly-once audited against
-#      the epoch-stamped journal.  Jax-free, ~10 s.
+#      the epoch-stamped journal — then a 4-member ensemble phase
+#      (docs/ENSEMBLE.md): parallel CAS ingest pre-stage, replica-pair
+#      chunk dedup, cross-trajectory moment merge, its own
+#      exactly-once audit.  Jax-free, ~15 s.
 #   4. The tier-1 pytest line from ROADMAP.md, verbatim — including
 #      its DOTS_PASSED accounting, so a local run reads exactly like
 #      the driver's.

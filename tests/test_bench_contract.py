@@ -158,6 +158,18 @@ PINNED_METRICS = {
     # transition — zero-injected everywhere else
     "mdtpu_alerts_firing": "gauge",
     "mdtpu_alert_transitions_total": "counter",
+    # ensemble scale-out (docs/ENSEMBLE.md): logical ensemble jobs,
+    # their member/ingest children by outcome, controller merges, and
+    # the cross-member dedup-ratio gauge — recorded live by the fleet
+    # controller (service/fleet.py) and the parallel ingest driver
+    # (io/store/parallel.py), zero-injected everywhere else
+    "mdtpu_ensemble_jobs_total": "counter",
+    "mdtpu_ensemble_members_total": "counter",
+    "mdtpu_ensemble_members_completed_total": "counter",
+    "mdtpu_ensemble_merges_total": "counter",
+    "mdtpu_ensemble_ingest_members_total": "counter",
+    "mdtpu_ensemble_ingest_failures_total": "counter",
+    "mdtpu_ensemble_dedup_ratio": "gauge",
 }
 
 #: The alert seed-rule catalog (obs/alerts.py SEED_RULES) — pinned so
@@ -325,6 +337,20 @@ def test_bench_json_contract(tmp_path):
                     "qos_hosts_scaled_up",
                     "qos_hosts_scaled_down",
                     "qos_exactly_once",
+                    # r17: ensemble sub-leg (docs/ENSEMBLE.md): N
+                    # trajectories fanned across the fleet behind the
+                    # parallel CAS ingest pre-stage — parity-gated vs
+                    # the serial loop-over-universes oracle, replica
+                    # dedup disclosed, speedup next to the CPU count
+                    # that contextualizes it; host-side, survives
+                    # the outage protocol
+                    "ensemble_members", "ensemble_frames_per_member",
+                    "ensemble_hosts", "ensemble_cpus",
+                    "ensemble_serial_tps", "ensemble_ingest_wall_s",
+                    "ensemble_fleet_wall_s", "ensemble_parity_ok",
+                    "ensemble_parity_max_err", "ensemble_dedup_ratio",
+                    "ensemble_replica_pair_rmsd",
+                    "ensemble_trajectories_per_s", "ensemble_speedup",
                     # r9: observability — the host-leg tracing-on/off
                     # delta and the unified metrics block
                     # (docs/OBSERVABILITY.md)
@@ -447,6 +473,19 @@ def test_bench_json_contract(tmp_path):
         assert rec["qos_journal_scale_up"] >= 1
         assert rec["qos_journal_scale_down"] >= 1
         assert rec["qos_exactly_once"] is True
+        # ensemble sub-leg: all N members merged with pooled-moment
+        # parity against the serial loop-over-universes oracle, the
+        # replica pair deduped fully through the shared chunk pool,
+        # and the disclosed throughput/speedup read against the
+        # container's CPU count (1 core → sub-1.0 is honest)
+        assert rec["ensemble_members"] >= 8
+        assert rec["ensemble_parity_ok"] is True
+        assert rec["ensemble_parity_max_err"] <= 1e-4
+        assert rec["ensemble_dedup_ratio"] == 1.0
+        assert rec["ensemble_replica_pair_rmsd"] <= 1e-6
+        assert rec["ensemble_trajectories_per_s"] > 0
+        assert rec["ensemble_speedup"] > 0
+        assert rec["ensemble_cpus"] >= 1
         # fault-wave sub-leg: the injected worker death was really
         # reaped, recovered jobs still flowed, and the recovery price
         # is recorded next to the clean wave
@@ -579,6 +618,11 @@ def test_bench_outage_records_host_legs(tmp_path):
         assert rec["qos_shed_background"] >= 1
         assert rec["qos_hosts_scaled_up"] >= 1
         assert rec["qos_hosts_scaled_down"] >= 1
+        # the ensemble sub-leg is host-side too: the parity verdict
+        # and dedup disclosure survive a tunnel-down artifact
+        assert rec["ensemble_parity_ok"] is True
+        assert rec["ensemble_dedup_ratio"] == 1.0
+        assert rec["ensemble_trajectories_per_s"] > 0
         # the retry log shows what init actually did
         assert rec["init_log"] and rec["init_log"][0]["attempt"] == 1
         # the incremental file matches the emitted record's legs

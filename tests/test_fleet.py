@@ -139,6 +139,30 @@ class TestShardWindows:
         with pytest.raises(ValueError):
             shard_windows(None, 0, None, 1, 2)
 
+    def test_non_unit_step_chunk_alignment(self):
+        """Regression (r17 satellite): ``chunk_frames=`` silently
+        ignored non-unit steps — now the VISITED chunks are what get
+        balanced, each shard regenerates exactly its run of the
+        strided index sequence, and no chunk is fetched by two
+        shards."""
+        from mdanalysis_mpi_tpu.parallel.partition import shard_windows
+
+        wins = shard_windows(None, 2, 37, 3, 3, chunk_frames=8)
+        frames = [f for w in wins if w for f in range(*w)]
+        assert frames == list(range(2, 37, 3))
+        sets = [{f // 8 for f in range(*w)} for w in wins if w]
+        for i in range(len(sets)):
+            for j in range(i + 1, len(sets)):
+                assert sets[i].isdisjoint(sets[j])
+        # a stride wider than a chunk skips chunks no shard fetches
+        wins = shard_windows(None, 0, 64, 20, 2, chunk_frames=8)
+        assert [f for w in wins if w for f in range(*w)] \
+            == [0, 20, 40, 60]
+        # a degenerate step fails typed at the submit boundary, not
+        # as a downstream range() crash
+        with pytest.raises(ValueError):
+            shard_windows(None, 0, 10, 0, 2)
+
 
 class TestReplayFleetFencing:
     def test_stale_epoch_records_rejected(self, tmp_path):
@@ -394,6 +418,190 @@ def test_shard_guards_empty_window_and_non_series(tmp_path):
         assert "per-frame series" in bad.error
 
 
+def test_store_sharded_submit_with_stride(tmp_path):
+    """A store-backed sharded job with a non-unit step (r17
+    satellite regression): shard windows align to the store's chunk
+    geometry over the VISITED frames — the union walks exactly the
+    strided window — and the frame-axis merge equals the solo serial
+    oracle running the same stride."""
+    from mdanalysis_mpi_tpu import Universe
+    from mdanalysis_mpi_tpu.analysis import RMSD
+    from mdanalysis_mpi_tpu.io.store.ingest import ingest
+    from mdanalysis_mpi_tpu.io.xtc import write_xtc
+    from mdanalysis_mpi_tpu.testing import make_protein_universe
+
+    u0 = make_protein_universe(n_residues=6, seed=3)
+    rng = np.random.default_rng(9)
+    frames = rng.normal(scale=3.0, size=(24, len(u0.atoms), 3)) \
+        .astype(np.float32)
+    xtc = os.path.join(str(tmp_path), "t.xtc")
+    write_xtc(xtc, frames,
+              dimensions=np.array([40.0, 40, 40, 90, 90, 90]),
+              times=np.arange(24, dtype=np.float32))
+    store = os.path.join(str(tmp_path), "t.store")
+    ingest(xtc, store, chunk_frames=6, quant="f32")
+    fixture = {"kind": "protein", "n_residues": 6, "seed": 3}
+    with FleetController(tmp_path / "ctl", host_ttl_s=2.0) as ctrl:
+        _spawn(ctrl, 2)
+        job = ctrl.submit({"analysis": "rmsd", "fixture": fixture,
+                           "trajectory": store, "tenant": "s",
+                           "shards": 3, "start": 1, "step": 2})
+        assert ctrl.drain(timeout=120.0), "drain timed out"
+        assert job.state == DONE, job.error
+        wins = [(c.spec["start"], c.spec["stop"], c.spec["step"])
+                for c in sorted(job.children,
+                                key=lambda c: c.shard_index)]
+    # the children's windows union to exactly the strided sequence
+    assert [f for w in wins for f in range(*w) if f < 24] \
+        == list(range(1, 24, 2))
+    u = Universe(u0.topology, xtc)
+    solo = RMSD(u, select="protein and name CA").run(
+        backend="serial", start=1, step=2)
+    np.testing.assert_allclose(job.result_arrays()["rmsd"],
+                               solo.results.rmsd, atol=1e-5)
+
+
+def test_ensemble_kill9_merge_parity_and_dedup(tmp_path):
+    """THE ensemble chaos leg (r17 acceptance): a 6-member
+    trajectory-set job — the last member a replica of the first —
+    with the store-first ingest pre-stage across 2 real host
+    processes; one host kill -9'd after the pre-stage lands, while
+    the member analyses are in flight.  The parent must still merge
+    DONE with journal-level exactly-once across ingest children AND
+    members, the pooled ensemble RMSF and pairwise-RMSD matrix must
+    match the serial loop-over-universes oracle at f32 tolerance,
+    and the replica pair's dedup must land in the merged ingest
+    ledger (member 0's store is pre-seeded, so the fleet pre-stage
+    also proves per-member idempotence)."""
+    from mdanalysis_mpi_tpu import Universe
+    from mdanalysis_mpi_tpu.analysis import RMSF
+    from mdanalysis_mpi_tpu.io.store.parallel import ingest_many
+    from mdanalysis_mpi_tpu.io.xtc import write_xtc
+    from mdanalysis_mpi_tpu.service.ensemble import (
+        merge_moments, pairwise_rmsd,
+    )
+    from mdanalysis_mpi_tpu.testing import make_protein_universe
+
+    fixture = {"kind": "protein", "n_residues": 6, "seed": 3}
+    u0 = make_protein_universe(n_residues=6, seed=3)
+    rng = np.random.default_rng(7)
+    n_members, n_frames = 6, 12
+    xtcs, frames_by_member = [], []
+    for i in range(n_members):
+        if i == n_members - 1:
+            frames = frames_by_member[0]     # the replica pair
+        else:
+            frames = rng.normal(scale=3.0,
+                                size=(n_frames, len(u0.atoms), 3)) \
+                .astype(np.float32)
+        frames_by_member.append(frames)
+        path = os.path.join(str(tmp_path), f"member{i}.xtc")
+        write_xtc(path, frames,
+                  dimensions=np.array([40.0, 40, 40, 90, 90, 90]),
+                  times=np.arange(n_frames, dtype=np.float32))
+        xtcs.append(path)
+    out_root = os.path.join(str(tmp_path), "stores")
+    # pre-seed member 0's store: the fleet pre-stage then
+    # short-circuits it (idempotence, bytes 0 in the ledger) and the
+    # replica member dedups against the pool DETERMINISTICALLY even
+    # with two hosts racing the distinct members
+    seeded = ingest_many([xtcs[0]], out_root, jobs=1,
+                         chunk_frames=4, quant="f32")
+    assert seeded["ok"] and seeded["members"][0]["n_chunks"] == 3
+    with FleetController(tmp_path / "ctl", host_ttl_s=2.0) as ctrl:
+        _spawn(ctrl, 2, env={"MDTPU_FLEET_RUN_DELAY": "0.4"})
+        job = ctrl.submit({
+            "analysis": "rmsf", "select": "all", "fixture": fixture,
+            "tenant": "ens",
+            "ensemble": [{"trajectory": x} for x in xtcs],
+            "ingest": {"out_root": out_root, "chunk_frames": 4,
+                       "quant": "f32"}})
+        assert len(job.children) == n_members
+        assert len(job.ingest_children) == n_members
+        # let the pre-stage land, then kill a host while the member
+        # analyses (0.4 s each) are mid-flight
+        _wait(lambda: all(ij.state == DONE
+                          for ij in job.ingest_children),
+              timeout=60.0, msg="ingest pre-stage")
+        victim = sorted(ctrl.placement.hosts())[0]
+        assert ctrl.kill_host(victim)
+        assert ctrl.drain(timeout=120.0), "drain timed out"
+        assert job.state == DONE, job.error
+        assert ctrl.stats()["hosts_lost"] == 1
+        snap = ctrl.telemetry.snapshot()
+        assert snap["ensembles_submitted"] == 1
+        assert snap["ensemble_members"] == n_members
+        assert snap["ensemble_members_completed"] == n_members
+        assert snap["ensemble_members_failed"] == 0
+        assert snap["ensemble_merges"] == 1
+        child_fps = [c.fp for c in job.children] \
+            + [c.fp for c in job.ingest_children]
+        replica_ingest = job.ingest_children[-1].results
+    # exactly-once across ingest children AND members, kill -9
+    # notwithstanding
+    _journal_exactly_once(tmp_path / "ctl", child_fps)
+    res = job.results
+    assert res["ensemble_members"] == n_members
+    assert res["n_frames"] == float(n_members * n_frames)
+    # serial loop-over-universes oracle: one RMSF per member from
+    # the ORIGINAL files, pooled with the same Welford reducers
+    carries = []
+    for path in xtcs:
+        r = RMSF(Universe(u0.topology, path).atoms).run(
+            backend="serial").results
+        carries.append({"mean": np.asarray(r.mean),
+                        "m2": np.asarray(r.m2),
+                        "n_frames": float(r.n_frames)})
+    oracle = merge_moments(carries)
+    np.testing.assert_allclose(res["rmsf"], oracle["rmsf"],
+                               atol=1e-5)
+    np.testing.assert_allclose(
+        res["pairwise_rmsd"],
+        pairwise_rmsd([c["mean"] for c in carries]), atol=1e-5)
+    pw = np.asarray(res["pairwise_rmsd"])
+    assert pw[0, -1] < 1e-6          # replica pair: identical means
+    assert pw[0, 1] > 0.1            # distinct members: far apart
+    # per-member series fan-out rode the merge
+    np.testing.assert_allclose(res["member0_rmsf"],
+                               res[f"member{n_members - 1}_rmsf"],
+                               atol=1e-6)
+    # the merged ingest ledger: all 6 pre-stage children folded,
+    # member 0 idempotent (bytes 0), the replica's 3 chunks all
+    # hardlinked against the pool instead of writing
+    assert res["ensemble_ingest_members"] == n_members
+    assert res["ensemble_ingest_dedup_chunks"] >= 3
+    assert replica_ingest["dedup_chunks"] == 3
+    assert replica_ingest["dedup_bytes"] > 0
+    assert 0.0 < res["ensemble_dedup_ratio"] < 1.0
+
+
+def test_ensemble_counts_as_one_logical_job(tmp_path):
+    """QoS accounting (docs/ENSEMBLE.md): an N-member ensemble holds
+    ONE slot of its tenant's inflight quota — its children inherit
+    the parent's class instead of multiplying it — and the quota
+    reject is typed with the pinned reason."""
+    from mdanalysis_mpi_tpu.service.jobs import AdmissionRejectedError
+    from mdanalysis_mpi_tpu.service.qos import QosPolicy
+
+    with FleetController(tmp_path, host_ttl_s=2.0,
+                         qos=QosPolicy(tenant_quota=1)) as ctrl:
+        ens = ctrl.submit({"analysis": "rmsf", "fixture": FIXTURE,
+                           "tenant": "a", "qos": "batch",
+                           "ensemble": 3})
+        assert len(ens.children) == 3
+        assert all(c.spec.get("qos") == "batch" for c in ens.children)
+        # the tenant is at quota: ONE logical job, not three
+        with pytest.raises(AdmissionRejectedError) as ei:
+            ctrl.submit({"analysis": "rmsf", "fixture": FIXTURE,
+                         "tenant": "a"})
+        assert ei.value.reason == "tenant_quota"
+        # another tenant is unaffected by a's ensemble
+        other = ctrl.submit({"analysis": "rmsf", "fixture": FIXTURE,
+                             "tenant": "b"})
+        assert other.state != "failed"
+        assert ctrl.telemetry.snapshot()["admission_rejects"] == 1
+
+
 def test_fleet_smoke_record(tmp_path):
     """The scripts/verify.sh dryrun smoke, in-process: ok=True with
     the exactly-once audit passing — PLUS the ISSUE-13 fleet
@@ -430,6 +638,15 @@ def test_fleet_smoke_record(tmp_path):
     assert record["qos_journal_shed_records"] == record["qos_shed"]
     assert record["qos_shed_above_background"] == 0
     assert record["qos_exactly_once"]
+    # ensemble scale-out phase (docs/ENSEMBLE.md): the 4-member
+    # trajectory-set job merged DONE with the pooled RMSF, the
+    # replica pair deduped its chunks through the shared pool, and
+    # the journal audits exactly-once across ingests AND members
+    assert record["ensemble_ok"], record
+    assert record["ensemble_dedup_chunks"] == 2
+    assert record["ensemble_replica_rmsd"] < 1e-6
+    assert record["ensemble_distinct_rmsd"] > 0.1
+    assert record["ensemble_exactly_once"]
 
 
 def test_federation_counters_gauges_and_scrape(tmp_path):

@@ -318,11 +318,14 @@ class TestShardRouting:
         wins = shard_windows(100, 10, 90, 1, 2, chunk_frames=32)
         assert wins == [(10, 64, 1), (64, 90, 1)]
         assert [f for w in wins for f in range(*w)] == list(range(10, 90))
-        # non-unit steps skip alignment but keep the exact-union
-        # contract
+        # non-unit steps align to VISITED chunks (r17 regression:
+        # this silently skipped alignment before): same exact-union
+        # contract, and no chunk is fetched by two shards
         wins = shard_windows(100, 0, 100, 3, 2, chunk_frames=16)
         assert [f for w in wins for f in range(*w)] \
             == list(range(0, 100, 3))
+        sets = [{f // 16 for f in range(*w)} for w in wins]
+        assert sets[0].isdisjoint(sets[1])
         # unchanged default path
         assert shard_windows(10, None, None, None, 2) \
             == [(0, 5, 1), (5, 10, 1)]
