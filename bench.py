@@ -903,6 +903,78 @@ def remote_store_host_leg(u_file, heavy_sel, s_oracle) -> dict:
         return base
 
 
+def fused_host_leg(u_file, heavy_sel) -> dict:
+    """Planar fused-path sub-leg (ops/pallas_fused.py +
+    docs/DISPATCH.md "Fused engine") — host-side, before any jax
+    contact, so the fused record survives the outage protocol.  Two
+    host facts plus the parity gate:
+
+    1. planar ``(3, B, S)`` staging vs the interleaved schedule over
+       the same int16 window — the ONE extra host copy the planar path
+       pays (quantized bytes, stage time), disclosed as fps + overhead;
+    2. the kernel parity matrix, run by ``benchmarks/profile_fused.py
+       --parity-only`` in a JAX_PLATFORMS=cpu subprocess: CPU jax
+       needs no tunnel, so the gate holds even with the accelerator
+       down, and this parent process stays jax-free for the legs that
+       follow.
+
+    The on-chip fields (``fused_steady_value`` / ``fused_vs_generic``)
+    are recorded NULL here and filled by the fused A/B accelerator leg
+    — under the outage protocol they stay null by construction."""
+    import subprocess
+
+    base = {"fused_planar_stage_fps": None,
+            "fused_interleaved_stage_fps": None,
+            "fused_stage_overhead_pct": None,
+            "fused_interpret_parity": None,
+            "fused_interpret_divergence": None,
+            "fused_steady_value": None,
+            "fused_generic_steady_value": None,
+            "fused_vs_generic": None,
+            "fused_engine": None}
+    reader = u_file.trajectory
+    window = min(256, N_FRAMES)
+    # scale-hint warm call (the _measure_decode_fps rationale): blocks
+    # 2..N of a cold run stage through the hint-present kernel
+    reader.stage_block(0, min(8, window), sel=heavy_sel, quantize=True)
+    t0 = time.perf_counter()
+    reader.stage_block(0, window, sel=heavy_sel, quantize=True)
+    inter_fps = window / (time.perf_counter() - t0)
+    t0 = time.perf_counter()
+    reader.stage_block(0, window, sel=heavy_sel, quantize=True,
+                       layout="planar")
+    planar_fps = window / (time.perf_counter() - t0)
+    base.update(
+        fused_planar_stage_fps=round(planar_fps, 2),
+        fused_interleaved_stage_fps=round(inter_fps, 2),
+        fused_stage_overhead_pct=round(
+            max(0.0, inter_fps / planar_fps - 1.0) * 100, 2))
+    clear_host_caches(u_file)
+    # parity matrix in a sanitized-env child: force the CPU platform
+    # and drop XLA_FLAGS (an outage simulation poisons both — a real
+    # tunnel outage poisons neither, and a site hook that rewrites
+    # JAX_PLATFORMS is why the timeout guards rather than trusts)
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    try:
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "benchmarks", "profile_fused.py"),
+             "--parity-only"],
+            env=env, capture_output=True, text=True, timeout=420)
+        par = json.loads(proc.stdout.strip().splitlines()[-1])
+        base.update(
+            fused_interpret_parity=par["parity"],
+            fused_interpret_divergence=par["max_divergence"],
+            fused_parity_cases=par["cases"])
+    except Exception as exc:  # noqa: BLE001 — outage-safe: the parity
+        # gate must degrade to a disclosed null, never kill the leg
+        base["fused_parity_note"] = (
+            f"parity subprocess failed: {exc!r}"[:200])
+    return base
+
+
 def dispatch_stats(calls0: int, secs0: float, runs: int = 1) -> dict:
     """Dispatch telemetry for a timed leg, from TIMERS snapshots taken
     before it ran: batch-kernel dispatches per run, mean host ms per
@@ -1917,6 +1989,19 @@ def main():
     _leg_done("remote store leg", **remote_store)
     clear_host_caches(u_file)
 
+    # fused planar sub-leg (ops/pallas_fused.py + docs/DISPATCH.md):
+    # planar-vs-interleaved host staging + the interpret parity gate
+    # (CPU-jax subprocess) — host-side, so a tunnel-down artifact
+    # carries the fused record with its on-chip fields null
+    fused_host = fused_host_leg(u_file, heavy_idx)
+    _note(f"[bench] fused host: planar stage "
+          f"{fused_host['fused_planar_stage_fps']} f/s vs interleaved "
+          f"{fused_host['fused_interleaved_stage_fps']} f/s "
+          f"({fused_host['fused_stage_overhead_pct']}% overhead), "
+          f"interpret parity {fused_host['fused_interpret_parity']}")
+    _leg_done("fused host leg", **fused_host)
+    clear_host_caches(u_file)
+
     n_chips = _wait_for_accelerator()
     if WATCH:
         # the horizon-inflated fuse served its purpose (covering the
@@ -2124,6 +2209,59 @@ def main():
     # the hypervisor's fast-page window (cold-attempt rationale above)
     f32_cache.drop()
 
+    # --- fused engine A/B (ops/pallas_fused.py + docs/DISPATCH.md
+    # "Fused engine"): the quantized-native fused program vs the
+    # generic dequant schedule it replaces.  Same steady protocol in
+    # its own HBM cache (planar staging keys differ from the generic
+    # interleaved blocks); the generic comparator IS the headline
+    # steady leg (same dtype, same cache-resident protocol), so this
+    # costs exactly one extra staging pass.  Fills the on-chip fields
+    # the fused host leg recorded as null — a tunnel-down artifact
+    # keeps the nulls by construction. ---
+    if tdtype in ("int16", "int8", "delta"):
+        from mdanalysis_mpi_tpu.obs import METRICS as _metrics
+        from mdanalysis_mpi_tpu.ops.pallas_rmsf import default_engine
+
+        def _fused_blocks():
+            return sum(_metrics.snapshot().get(
+                "mdtpu_fused_blocks_total",
+                {"values": {}})["values"].values())
+
+        fused_cache = DeviceBlockCache(max_bytes=8 << 30)
+        blocks0 = _fused_blocks()
+        r = AlignedRMSF(u_file, select=SELECT, engine="fused").run(
+            backend=accel_backend, batch_size=BATCH,   # compile+populate
+            transfer_dtype=tdtype, block_cache=fused_cache)
+        jax.block_until_ready(r.results["rmsf"])
+        fused_walls = []
+        for _ in range(REPEATS):
+            t0 = time.perf_counter()
+            r = AlignedRMSF(u_file, select=SELECT, engine="fused").run(
+                backend=accel_backend, batch_size=BATCH,
+                transfer_dtype=tdtype, block_cache=fused_cache)
+            jax.block_until_ready(r.results["rmsf"])
+            fused_walls.append(time.perf_counter() - t0)
+        fused_fps = N_FRAMES / float(np.median(fused_walls)) / n_chips
+        fused_blocks = _fused_blocks() - blocks0
+        _note(f"[bench] fused steady ({default_engine()} form): "
+              f"{fused_fps:.1f} f/s/chip vs generic "
+              f"{fps_per_chip:.1f} "
+              f"({fused_fps / fps_per_chip:.2f}x, "
+              f"{fused_blocks} fused blocks)")
+        _leg_done("fused accel leg",
+                  fused_steady_value=round(fused_fps, 2),
+                  fused_generic_steady_value=round(fps_per_chip, 2),
+                  fused_vs_generic=round(fused_fps / fps_per_chip, 3),
+                  fused_engine=default_engine(),
+                  fused_blocks_dispatched=int(fused_blocks))
+        fused_cache.drop()
+    else:
+        # BENCH_TRANSFER=float32: no quantized block to fuse over —
+        # the nulls from the host leg stand, disclosed
+        _leg_done("fused accel leg (skipped: float32 staging)",
+                  fused_note="BENCH_TRANSFER=float32: fused engine "
+                             "is quantized-native")
+
     # --- r01-LINEAGE f32 leg, LAST among accelerator legs: every
     # device_put leaves an unreclaimable host-side mirror on this
     # tunneled client, so any wire-heavy leg that runs before the cold
@@ -2160,14 +2298,17 @@ def main():
               # put and dropped before this leg runs)
               f32_nocache_highrss_note=(
                   "since r6 runs after the f32 steady leg's full "
-                  "staging pass (higher RSS than the r5 protocol)"),
+                  "staging pass (higher RSS than the r5 protocol); "
+                  "since r18 the fused A/B leg's staging pass also "
+                  "precedes it"),
               # the accelerator legs in execution order, so artifact
               # readers can see the r5+ protocol (f32 no-cache leg
               # demoted to last, absorbing the high-RSS handicap; the
               # r6 f32 steady precision control slots after the int16
               # headline)
               accel_leg_order=["cold_compile", "cold", "steady",
-                               "f32_steady", "f32_nocache_highrss",
+                               "f32_steady", "fused_ab",
+                               "f32_nocache_highrss",
                                "serving_accel", "divergence_gate"])
 
     # serving telemetry, ACCELERATOR side: 2 tenants × 2 waves through

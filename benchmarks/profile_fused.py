@@ -1,0 +1,260 @@
+"""Planar fused-kernel profile: the measurement record behind PERF.md
+§8e's planar retry and docs/DISPATCH.md "Fused engine".
+
+Three records, platform disclosed (``jax.default_backend()``):
+
+1. **Interpret parity matrix** — the planar ``(3, B, S)`` fused kernel
+   (interpret mode) against the interleaved XLA fused form on the SAME
+   staged bytes, across quant tiers (int16 / int8 / delta), uneven
+   frame tails, padded selections, and the pass-1 average kernel.  The
+   tier's own quantization error cancels (identical staged input), so
+   the gate reads kernel divergence only: 5e-4 on means, 5e-3 on
+   second moments (the in-kernel QCP rotation vs the reference SVD).
+2. **Host planar staging** — ``stage_block(layout='planar')`` vs the
+   interleaved schedule over the same int16 window: the ONE extra host
+   copy the planar path pays (quantized bytes, stage time), disclosed
+   as fps + overhead percent.
+3. **Engine A/B** — steady-protocol AlignedRMSF ``engine='fused'`` vs
+   the generic dequant schedule it replaces, HBM/cache-resident blocks,
+   median of PROFILE_FUSED_REPS.  On a CPU platform this is the
+   host-form record (XLA fused form, or interpret Pallas under
+   ``MDTPU_RMSF_PALLAS=1``); the on-chip number lands at the next
+   tunnel window per the §8e evidence protocol.
+
+Writes PROFILE_FUSED.json (committed) and prints it.
+
+Usage: python benchmarks/profile_fused.py [--parity-only]
+  --parity-only: run ONLY the parity matrix and print one compact JSON
+  line (no artifact write) — bench.py's outage-safe fused host leg
+  drives this in a JAX_PLATFORMS=cpu subprocess, where CPU jax needs
+  no tunnel and the parent bench process stays jax-free.
+Scale knobs: PROFILE_FUSED_ATOMS / PROFILE_FUSED_FRAMES /
+PROFILE_FUSED_BATCH / PROFILE_FUSED_REPS.
+"""
+
+import json
+import os
+import statistics
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N_ATOMS = int(os.environ.get("PROFILE_FUSED_ATOMS", "20000"))
+N_FRAMES = int(os.environ.get("PROFILE_FUSED_FRAMES", "512"))
+BATCH = int(os.environ.get("PROFILE_FUSED_BATCH", "64"))
+N_REPS = int(os.environ.get("PROFILE_FUSED_REPS", "3"))
+
+
+def _note(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+# ---------------------------------------------------------------------------
+# parity matrix (shared with bench.py's --parity-only subprocess mode)
+# ---------------------------------------------------------------------------
+
+#: (B, n_real, tier, valid_b) — one tile / multi-tile masked tails /
+#: int8 tier / uneven S-tail with padded selection / exact-width S.
+PARITY_CASES = (
+    (16, 100, "int16", None),
+    (32, 250, "int16", 30),
+    (32, 250, "int8", None),
+    (48, 511, "int16", 47),
+    (16, 256, "int16", None),
+    (16, 100, "delta", None),
+)
+
+
+def _planar_case(pr, quantize_block, B, n_real, dtype, seed, valid_b):
+    """Rigid-rotated reference + noise, staged interleaved AND planar
+    (same idiom as tests/test_pallas_fused.py's matrix)."""
+    import jax.numpy as jnp
+
+    from mdanalysis_mpi_tpu.io.base import planar_repack
+
+    r = np.random.default_rng(seed)
+    idx = np.arange(n_real)
+    pidx, nr = pr.pad_selection(idx)
+    S = pidx.shape[0]
+    refc = r.normal(size=(n_real, 3)).astype(np.float64) * 4
+    refc -= refc.mean(axis=0)
+    masses = r.uniform(1, 12, size=n_real)
+    params = pr.build_params(
+        jnp.asarray(refc, jnp.float32),
+        jnp.asarray(refc.mean(axis=0), jnp.float32),
+        jnp.asarray(masses, jnp.float32), nr, S)
+    coords = np.zeros((B, S, 3), np.float64)
+    for b in range(B):
+        A = r.normal(size=(3, 3))
+        U, _, Vt = np.linalg.svd(A)
+        if np.linalg.det(U @ Vt) < 0:
+            U[:, -1] *= -1
+        coords[b] = (refc @ (U @ Vt).T
+                     + r.normal(size=(n_real, 3)) * 0.3
+                     + r.normal(size=3) * 10)[pidx]
+    q, inv = quantize_block(coords.astype(np.float32), dtype)
+    mask = np.zeros(B, np.float32)
+    mask[:B if valid_b is None else valid_b] = 1.0
+    return params, q, planar_repack(q), np.float32(inv), mask, nr, coords
+
+
+def parity_matrix() -> dict:
+    """Every PARITY_CASES entry, interpret planar vs interleaved XLA on
+    identical staged bytes; returns {parity, max_divergence, cases}."""
+    import jax.numpy as jnp
+
+    from mdanalysis_mpi_tpu.ops import pallas_fused as pf
+    from mdanalysis_mpi_tpu.ops import pallas_rmsf as pr
+    from mdanalysis_mpi_tpu.parallel.executors import (
+        quantize_block, quantize_block_delta)
+
+    worst = 0.0
+    ok = True
+    for B, n_real, dtype, valid_b in PARITY_CASES:
+        if dtype == "delta":
+            params, _, _, _, mask, nr, coords = _planar_case(
+                pr, quantize_block, B, n_real, "int16",
+                B + n_real, valid_b)
+            res, dkey, inv_abs, inv_res = quantize_block_delta(
+                coords.astype(np.float32), 1)
+            args = (jnp.asarray(res), jnp.asarray(dkey), inv_abs,
+                    inv_res, None, jnp.asarray(mask))
+            ref = pf.moments_delta_kernel_for("xla", nr)(params, *args)
+            got = pf.moments_delta_kernel_for("interpret", nr)(
+                params, *args)
+        else:
+            params, q, qp, inv, mask, nr, _ = _planar_case(
+                pr, quantize_block, B, n_real, dtype,
+                B + n_real, valid_b)
+            ref = pr.moments_kernel_for("xla", nr)(
+                params, jnp.asarray(q), inv, None, jnp.asarray(mask))
+            got = pf.moments_kernel_for("interpret", nr)(
+                params, jnp.asarray(qp), inv, None, jnp.asarray(mask))
+        t_r, mean_r, m2_r = (np.asarray(x) for x in ref)
+        t_g, mean_g, m2_g = (np.asarray(x) for x in got)
+        d_mean = float(np.abs(mean_g - mean_r).max())
+        d_m2 = float(np.abs(m2_g - m2_r).max())
+        case_ok = (float(t_r) == float(t_g)
+                   and d_mean <= 5e-4 and d_m2 <= 5e-3)
+        ok = ok and case_ok
+        worst = max(worst, d_mean, d_m2)
+        _note(f"[fused] parity {dtype} B={B} S*={n_real} "
+              f"valid={valid_b}: mean {d_mean:.2e} m2 {d_m2:.2e} "
+              f"{'ok' if case_ok else 'FAIL'}")
+    return {"parity": "PASS" if ok else "FAIL",
+            "max_divergence": worst, "cases": len(PARITY_CASES)}
+
+
+# ---------------------------------------------------------------------------
+# full profile
+# ---------------------------------------------------------------------------
+
+def _stage_pass(reader, sel, layout) -> float:
+    t0 = time.perf_counter()
+    for lo in range(0, N_FRAMES, BATCH):
+        reader.stage_block(lo, min(lo + BATCH, N_FRAMES), sel=sel,
+                           quantize=True, layout=layout)
+    return N_FRAMES / (time.perf_counter() - t0)
+
+
+def _steady_fps(u, engine, cache_cls, jax) -> float:
+    from mdanalysis_mpi_tpu.analysis import AlignedRMSF
+
+    cache = cache_cls(max_bytes=8 << 30)
+    r = AlignedRMSF(u, select="heavy", engine=engine).run(
+        backend="jax", batch_size=BATCH, transfer_dtype="int16",
+        block_cache=cache)              # compile + populate
+    jax.block_until_ready(r.results["rmsf"])
+    walls = []
+    for _ in range(N_REPS):
+        t0 = time.perf_counter()
+        r = AlignedRMSF(u, select="heavy", engine=engine).run(
+            backend="jax", batch_size=BATCH, transfer_dtype="int16",
+            block_cache=cache)
+        jax.block_until_ready(r.results["rmsf"])
+        walls.append(time.perf_counter() - t0)
+    cache.drop()
+    return N_FRAMES / float(statistics.median(walls))
+
+
+def main() -> int:
+    if "--parity-only" in sys.argv[1:]:
+        rec = parity_matrix()
+        print(json.dumps(rec))
+        return 0 if rec["parity"] == "PASS" else 1
+
+    import bench  # noqa: E402  (fixture helpers; honor_cpu_request)
+    import jax
+
+    from mdanalysis_mpi_tpu.core.universe import Universe
+    from mdanalysis_mpi_tpu.io.xtc import XTCReader
+    from mdanalysis_mpi_tpu.obs import METRICS
+    from mdanalysis_mpi_tpu.ops.pallas_rmsf import default_engine
+
+    rec = {
+        "metric": f"planar fused kernel vs generic dequant schedule, "
+                  f"{N_ATOMS}-atom {N_FRAMES}-frame heavy-atom "
+                  f"AlignedRMSF, batch {BATCH}, int16 staging, "
+                  f"median of {N_REPS} (docs/DISPATCH.md)",
+        "n_atoms": N_ATOMS, "n_frames": N_FRAMES, "batch": BATCH,
+        "reps": N_REPS,
+        "platform": jax.default_backend(),
+        "fused_engine": default_engine(),
+    }
+    rec.update(parity_matrix())
+
+    xtc = bench.ensure_flagship_xtc(N_ATOMS, N_FRAMES)
+    topo = bench.make_topology(N_ATOMS)
+    u = Universe(topo, XTCReader(xtc))
+    sel = u.select_atoms("heavy").indices
+
+    # host staging: planar vs interleaved, same int16 window
+    u.trajectory.stage_block(0, min(8, N_FRAMES), sel=sel,
+                             quantize=True)          # scale-hint warm
+    inter = statistics.median(
+        _stage_pass(u.trajectory, sel, "interleaved")
+        for _ in range(N_REPS))
+    planar = statistics.median(
+        _stage_pass(u.trajectory, sel, "planar") for _ in range(N_REPS))
+    rec["interleaved_stage_fps"] = round(inter, 1)
+    rec["planar_stage_fps"] = round(planar, 1)
+    rec["planar_stage_overhead_pct"] = round(
+        max(0.0, inter / planar - 1.0) * 100, 2)
+    _note(f"[fused] host staging: interleaved {inter:.1f} f/s, planar "
+          f"{planar:.1f} f/s ({rec['planar_stage_overhead_pct']}% "
+          "overhead)")
+    bench.clear_host_caches(u)
+
+    # engine A/B, steady protocol (cache-resident staged blocks)
+    from mdanalysis_mpi_tpu.parallel.executors import DeviceBlockCache
+
+    blocks0 = sum(METRICS.snapshot().get(
+        "mdtpu_fused_blocks_total", {"values": {}})["values"].values())
+    fused_fps = _steady_fps(u, "fused", DeviceBlockCache, jax)
+    fused_blocks = sum(METRICS.snapshot().get(
+        "mdtpu_fused_blocks_total",
+        {"values": {}})["values"].values()) - blocks0
+    generic_fps = _steady_fps(u, None, DeviceBlockCache, jax)
+    rec["fused_steady_fps"] = round(fused_fps, 1)
+    rec["generic_steady_fps"] = round(generic_fps, 1)
+    rec["fused_vs_generic"] = round(fused_fps / generic_fps, 3)
+    rec["fused_blocks_dispatched"] = int(fused_blocks)
+    _note(f"[fused] steady ({rec['platform']}, "
+          f"{rec['fused_engine']} form): fused {fused_fps:.1f} f/s vs "
+          f"generic {generic_fps:.1f} f/s "
+          f"({rec['fused_vs_generic']}x)")
+
+    rec["ok"] = bool(rec["parity"] == "PASS" and fused_blocks > 0)
+    out_path = os.path.join(REPO, "PROFILE_FUSED.json")
+    with open(out_path, "w") as f:
+        f.write(json.dumps(rec, indent=1) + "\n")
+    print(json.dumps(rec, indent=1))
+    return 0 if rec["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -282,6 +282,19 @@ QUANT_TARGETS = {"int16": 32000.0, "int8": 120.0}
 QUANT_INT_MAX = {"int16": 32767.0, "int8": 127.0}
 
 
+def planar_repack(q: np.ndarray) -> np.ndarray:
+    """Repack an interleaved ``(B, S, 3)`` staged block to the planar
+    ``(3, B, S)`` component-plane layout the fused Pallas kernel
+    consumes (ops/pallas_fused.py).  This is THE one host copy the
+    planar path pays — on quantized (int16/int8) bytes, behind the
+    staging boundary, counted in
+    ``mdtpu_fused_planar_repacks_total``."""
+    from mdanalysis_mpi_tpu import obs
+
+    obs.METRICS.inc("mdtpu_fused_planar_repacks_total")
+    return np.ascontiguousarray(np.moveaxis(q, 2, 0))
+
+
 def norm_quantize(quantize) -> str | None:
     """Normalize a staging-quantization request: ``False``/``None`` →
     None, ``True`` → ``"int16"`` (backward compatible), ``"int16"`` /
@@ -545,7 +558,8 @@ class ReaderBase:
         return None
 
     def stage_block(self, start: int, stop: int,
-                    sel: np.ndarray | None = None, quantize=False):
+                    sel: np.ndarray | None = None, quantize=False,
+                    layout: str = "interleaved"):
         """Staging primitive: ``read_block`` plus optional fused
         quantization → (block, boxes, inv_scale).
 
@@ -557,8 +571,19 @@ class ReaderBase:
         selection uses the exact per-block scale (bit-identical to the
         NumPy path); later blocks use the adaptive one-pass scale (see
         ``_quantize_staged``) — same resolution class, different bits.
+
+        ``layout``: ``"interleaved"`` (the default ``(B, S, 3)`` block)
+        or ``"planar"`` — the ``(3, B, S)`` component-plane form the
+        fused Pallas kernel consumes (ops/pallas_fused.py), produced by
+        one ``planar_repack`` on the quantized bytes.  Planar staging
+        requires a quantized dtype: the fused path exists to avoid host
+        float32 materialization, so float32 planar is a contract error.
         """
         qmode = norm_quantize(quantize)
+        if layout == "planar" and qmode is None:
+            raise ValueError(
+                "layout='planar' requires quantized staging "
+                "(int16/int8); float32 blocks stay interleaved")
         block, boxes = self.read_block(start, stop, sel=sel)
         if qmode is None:
             return block, boxes, None
@@ -566,9 +591,11 @@ class ReaderBase:
             from mdanalysis_mpi_tpu.parallel.executors import quantize_block
 
             q, inv_scale = quantize_block(block, "int8")
-            return q, boxes, inv_scale
-        q, inv_scale = self._quantize_staged(block, None,
-                                             sel_fp=sel_fingerprint(sel))
+        else:
+            q, inv_scale = self._quantize_staged(
+                block, None, sel_fp=sel_fingerprint(sel))
+        if layout == "planar":
+            q = planar_repack(q)
         return q, boxes, inv_scale
 
     def _quantize_staged(self, src: np.ndarray, sel, sel_fp=None):
@@ -612,25 +639,33 @@ class ReaderBase:
             return quantize_block(src if sel is None else src[:, sel])
 
     def stage_cached(self, start: int, stop: int,
-                     sel: np.ndarray | None = None, quantize=False):
+                     sel: np.ndarray | None = None, quantize=False,
+                     layout: str = "interleaved"):
         """``stage_block`` through the reader's :class:`HostStageCache`.
 
         The executors' staging entry point.  Cache key = (frame window,
-        selection content, transfer dtype); the stored blocks are
-        treated as immutable by all consumers (pad_batch passes full
-        batches through untouched and ``device_put`` only reads).
+        selection content, transfer dtype, layout); the stored blocks
+        are treated as immutable by all consumers (pad_batch passes
+        full batches through untouched and ``device_put`` only reads).
+        Planar blocks cache under a distinct key so the two layouts of
+        one window never alias (the scrub fingerprints hash whatever
+        bytes were staged, so both layouts scrub independently).
         """
         cap = _host_stage_cache_bytes()
         if cap <= 0:
-            return self.stage_block(start, stop, sel=sel, quantize=quantize)
+            return self.stage_block(start, stop, sel=sel, quantize=quantize,
+                                    layout=layout)
         cache = self.__dict__.get("_host_stage_cache")
         if cache is None or cache.max_bytes != cap:
             cache = HostStageCache(cap)
             self.__dict__["_host_stage_cache"] = cache
         key = (start, stop, sel_fingerprint(sel), norm_quantize(quantize))
+        if layout != "interleaved":
+            key = key + (layout,)
         staged = cache.get(key)
         if staged is None:
-            staged = self.stage_block(start, stop, sel=sel, quantize=quantize)
+            staged = self.stage_block(start, stop, sel=sel, quantize=quantize,
+                                      layout=layout)
             cache.put(key, staged, staged[0].nbytes)
         return staged
 

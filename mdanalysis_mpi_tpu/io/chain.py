@@ -151,7 +151,7 @@ class ChainReader(ReaderBase):
         return out, full
 
     def stage_block(self, start: int, stop: int, sel=None,
-                    quantize: bool = False):
+                    quantize: bool = False, layout: str = "interleaved"):
         self._check_children(self._readers)
         if not 0 <= start <= stop <= self.n_frames:
             raise IndexError(
@@ -163,9 +163,10 @@ class ChainReader(ReaderBase):
                 # window inside one child: its fused decode(+quantize)
                 # fast path applies unchanged
                 return self._readers[k0].stage_block(
-                    a, a + (stop - start), sel=sel, quantize=quantize)
+                    a, a + (stop - start), sel=sel, quantize=quantize,
+                    layout=layout)
         return ReaderBase.stage_block(self, start, stop, sel=sel,
-                                      quantize=quantize)
+                                      quantize=quantize, layout=layout)
 
     def add_auxiliary(self, name, aux, cutoff=None):
         """Auxiliaries align by ``ts.time``, and a chain's child files

@@ -110,16 +110,27 @@ class MemoryReader(ReaderBase):
         return self._coords[start:stop:step, sel], boxes
 
     def stage_block(self, start: int, stop: int, sel=None,
-                    quantize: bool = False):
+                    quantize: bool = False, layout: str = "interleaved"):
         """Gather (+quantize) straight from the backing array in C++ —
         no intermediate ``read_block`` copy.  In-memory trajectories are
         the staging fast path (the reference's RMSF.py:113 in-memory
         universe generalized to the TPU feed), so this one fused pass is
-        where the single staging core's cycles go."""
+        where the single staging core's cycles go.  Planar requests
+        stage through the same fused gather, then one ``planar_repack``
+        on the quantized bytes."""
         if not 0 <= start <= stop <= self.n_frames:
             raise IndexError(
                 f"block [{start},{stop}) out of range [0,{self.n_frames}]")
-        from mdanalysis_mpi_tpu.io.base import norm_quantize
+        from mdanalysis_mpi_tpu.io.base import norm_quantize, planar_repack
+
+        if layout == "planar":
+            if norm_quantize(quantize) is None:
+                raise ValueError(
+                    "layout='planar' requires quantized staging "
+                    "(int16/int8); float32 blocks stay interleaved")
+            q, boxes, inv_scale = self.stage_block(start, stop, sel=sel,
+                                                   quantize=quantize)
+            return planar_repack(q), boxes, inv_scale
 
         qmode = norm_quantize(quantize)
         if self.transformations:

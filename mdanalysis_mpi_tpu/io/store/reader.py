@@ -260,29 +260,37 @@ class StoreReader(ReaderBase):
     # ---- staging ----
 
     def stage_block(self, start: int, stop: int,
-                    sel: np.ndarray | None = None, quantize=False):
+                    sel: np.ndarray | None = None, quantize=False,
+                    layout: str = "interleaved"):
         """Staging primitive with the decode REMOVED: a request in the
         store's own wire format is served as raw quantized slices (see
-        module docs).  Everything else — f32 requests, cross-tier
-        requests, mixed-scale chunk spans, transformed readers — rides
-        the generic ``ReaderBase`` path over :meth:`read_block` (which
-        still never touches the original file)."""
+        module docs).  A ``layout='planar'`` request is honored inside
+        the fast path by transposing each raw chunk slice to component
+        planes — still zero float32 materialization (``_f32`` /
+        ``_chunk_f32`` untouched), the quantized bytes just land in the
+        ``(3, B, S)`` shape the fused Pallas kernel reads.  Everything
+        else — f32 requests, cross-tier requests, mixed-scale chunk
+        spans, transformed readers — rides the generic ``ReaderBase``
+        path over :meth:`read_block` (which still never touches the
+        original file)."""
         qmode = norm_quantize(quantize)
         if (qmode is not None and qmode == self._quant
                 and not self.transformations and start < stop):
-            fast = self._stage_direct(start, stop, sel)
+            fast = self._stage_direct(start, stop, sel, layout=layout)
             if fast is not None:
                 return fast
         return ReaderBase.stage_block(self, start, stop, sel=sel,
-                                      quantize=quantize)
+                                      quantize=quantize, layout=layout)
 
-    def _stage_direct(self, start: int, stop: int, sel):
+    def _stage_direct(self, start: int, stop: int, sel,
+                      layout: str = "interleaved"):
         """(q, boxes, inv_scale) from raw chunk slices, or None when
         the covered chunks do not share one scale (an ingest-margin
         overflow chunk — the caller requantizes through f32)."""
         if not 0 <= start <= stop <= self._nf:
             raise IndexError(
                 f"block [{start},{stop}) out of range [0,{self._nf}]")
+        planar = layout == "planar"
         cis = range(start // self._cf, (stop - 1) // self._cf + 1)
         loaded = [(ci, *self._load_raw(ci)) for ci in cis]
         inv_scales = {m["inv_scale"] for _, _, m in loaded}
@@ -295,14 +303,20 @@ class StoreReader(ReaderBase):
             lo = max(start, ci * self._cf) - ci * self._cf
             hi = min(stop, (ci + 1) * self._cf) - ci * self._cf
             c = arrays["coords"][lo:hi]
-            parts.append(c if sel is None else c[:, sel])
+            c = c if sel is None else c[:, sel]
+            if planar:
+                from mdanalysis_mpi_tpu.io.base import planar_repack
+
+                c = planar_repack(c)
+            parts.append(c)
             if "boxes" in arrays:
                 have_boxes = True
                 box_parts.append(arrays["boxes"][lo:hi])
             else:
                 box_parts.append(
                     np.zeros((hi - lo, 6), dtype=np.float32))
-        q = parts[0] if len(parts) == 1 else np.concatenate(parts)
+        q = (parts[0] if len(parts) == 1
+             else np.concatenate(parts, axis=1 if planar else 0))
         boxes = (None if not have_boxes
                  else box_parts[0] if len(box_parts) == 1
                  else np.concatenate(box_parts))

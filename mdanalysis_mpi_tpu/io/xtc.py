@@ -142,7 +142,7 @@ class XTCReader(ReaderBase):
         return coords, self._dims_from_raw(box)
 
     def stage_block(self, start: int, stop: int, sel=None,
-                    quantize: bool = False):
+                    quantize: bool = False, layout: str = "interleaved"):
         """Staging primitive with the decode fused in (overrides the
         read-then-quantize base path): on the int16 leg each frame goes
         XDR bits → scratch → selection int16 in one native call, cutting
@@ -150,11 +150,22 @@ class XTCReader(ReaderBase):
         (~3.6 MB/frame at the flagship config).  Scale-hint mechanics
         mirror ``ReaderBase._quantize_staged`` (adaptive one-pass with
         exact re-run on overflow, hints scoped per selection content).
+        Planar requests stage through this same fused decode, then one
+        ``planar_repack`` on the quantized bytes.
         """
         if not 0 <= start <= stop <= self.n_frames:
             raise IndexError(
                 f"block [{start},{stop}) out of range [0,{self.n_frames}]")
-        from mdanalysis_mpi_tpu.io.base import norm_quantize
+        from mdanalysis_mpi_tpu.io.base import norm_quantize, planar_repack
+
+        if layout == "planar":
+            if norm_quantize(quantize) is None:
+                raise ValueError(
+                    "layout='planar' requires quantized staging "
+                    "(int16/int8); float32 blocks stay interleaved")
+            q, boxes, inv_scale = self.stage_block(start, stop, sel=sel,
+                                                   quantize=quantize)
+            return planar_repack(q), boxes, inv_scale
 
         qmode = norm_quantize(quantize)
         if self.transformations or qmode == "int8":

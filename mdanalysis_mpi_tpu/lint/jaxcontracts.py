@@ -329,7 +329,7 @@ def _registered_programs():
     _, gfn, _, _, _ = m._build(a)
     block_jaxpr = jax.make_jaxpr(gfn)(params, *one)
     block_psums = count_psums(block_jaxpr)
-    return {
+    programs = {
         "mesh_block_rmsf": (block_jaxpr, None),
         "mesh_scan_rmsf_init": (
             jax.make_jaxpr(s_init)(params, *blk(4)), block_psums),
@@ -339,6 +339,28 @@ def _registered_programs():
                 *blk(3)),
             block_psums),
     }
+    # the fused planar program (ops/pallas_fused.py — the quantized-
+    # native kernel AlignedRMSF(engine='fused') registers): lowered in
+    # interpret mode so the walk works on CPU.  Single-device by
+    # contract (_mesh_quantized_native keeps it off the mesh), so any
+    # psum is a violation: expected total 0.
+    import jax.numpy as jnp
+
+    from mdanalysis_mpi_tpu.ops import pallas_fused as pfu
+    from mdanalysis_mpi_tpu.ops import pallas_rmsf as prm
+
+    _, nr = prm.pad_selection(np.asarray(ag.indices))
+    s_pad = prm.pad_selection(np.asarray(ag.indices))[0].shape[0]
+    fparams = prm.build_params(
+        jnp.zeros((nr, 3), jnp.float32), jnp.zeros((3,), jnp.float32),
+        jnp.ones((nr,), jnp.float32), nr, s_pad)
+    fused_k = pfu.moments_kernel_for("interpret", nr)
+    programs["jax_fused_planar_moments"] = (
+        jax.make_jaxpr(fused_k)(
+            fparams, jnp.zeros((3, 16, s_pad), jnp.int16),
+            jnp.float32(1.0), None, jnp.ones((16,), jnp.float32)),
+        0)
+    return programs
 
 
 def check_lowered_programs(notes: list[str]) -> list[Finding]:

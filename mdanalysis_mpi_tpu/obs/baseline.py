@@ -83,6 +83,11 @@ LEG_FIELDS = {
     # store + fleet tiers
     "store_ingest_fps": ("higher", 40.0, "rel"),
     "store_read_fps": ("higher", 40.0, "rel"),
+    # fused planar path (ops/pallas_fused.py, docs/DISPATCH.md):
+    # host-side planar staging plus the fused-engine steady rate —
+    # the latter lands only in tunnel-up artifacts, like `value`
+    "fused_planar_stage_fps": ("higher", 40.0, "rel"),
+    "fused_steady_value": ("higher", 30.0, "rel"),
     "fleet_clean_jobs_per_s": ("higher", 40.0, "rel"),
     "fleet_loss_jobs_per_s": ("higher", 50.0, "rel"),
     "obs_federation_jobs_per_s": ("higher", 40.0, "rel"),

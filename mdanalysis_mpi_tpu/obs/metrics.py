@@ -349,12 +349,27 @@ PROF_GAUGES = (
 )
 
 #: Profiler histograms: per-dispatch kernel latency, labeled by
-#: program geometry (``geometry=`` — batch size × scan group length;
-#: obs/prof.py note_dispatch).  Zero-injected with an EMPTY series
-#: set: a histogram has no meaningful zero point, but the name/type
-#: must hold in every snapshot for the pinned schema.
+#: program geometry (``geometry=`` — batch size × scan group length)
+#: and ``engine=`` (``generic`` dequant+align program vs ``fused``
+#: quantized-native planar program; obs/prof.py note_dispatch).
+#: Zero-injected with an EMPTY series set: a histogram has no
+#: meaningful zero point, but the name/type must hold in every
+#: snapshot for the pinned schema.
 PROF_HISTOGRAMS = (
     "mdtpu_dispatch_ms",
+)
+
+#: Fused-kernel counters (ops/pallas_fused.py + docs/DISPATCH.md):
+#: blocks dispatched through a fused quantized-native program, host
+#: planar repacks paid at the staging boundary (io/base.planar_repack
+#: — the fused path's ONE host copy), and trace-time fallbacks to the
+#: generic schedule (shape-ineligible planar tiles, mesh executors).
+#: Recorded live at the dispatch/staging sites; zero-injected so a
+#: process that never ran the fused engine still carries the schema.
+FUSED_COUNTERS = (
+    "mdtpu_fused_blocks_total",
+    "mdtpu_fused_planar_repacks_total",
+    "mdtpu_fused_fallbacks_total",
 )
 
 #: Alerting series (obs/alerts.py — docs/OBSERVABILITY.md "Alerting &
@@ -460,7 +475,8 @@ def unified_snapshot(timers=None, cache=None, telemetry=None,
             INTEGRITY_COUNTERS + SCRUB_COUNTERS + STORE_COUNTERS + \
             STORE_REMOTE_COUNTERS + STORE_CACHE_COUNTERS + \
             FLEET_COUNTERS + FLEET_OBS_COUNTERS + QOS_COUNTERS + \
-            PROF_COUNTERS + ALERT_COUNTERS + ENSEMBLE_COUNTERS:
+            PROF_COUNTERS + FUSED_COUNTERS + ALERT_COUNTERS + \
+            ENSEMBLE_COUNTERS:
         snap.setdefault(name, {"type": "counter", "values": {"": 0}})
     for name in PROF_HISTOGRAMS:
         # empty series set: a histogram carries no zero point, but

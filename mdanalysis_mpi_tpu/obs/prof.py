@@ -268,23 +268,32 @@ def _sampler() -> None:
                 **{k: round(v, 2) for k, v in mark_vals.items()})
 
 
-def note_dispatch(ms: float, geometry: str) -> None:
+def note_dispatch(ms: float, geometry: str,
+                  engine: str = "generic") -> None:
     """Record one kernel dispatch of ``ms`` milliseconds under its
-    program ``geometry`` (e.g. ``bs256_scan4``).  No-op when the
-    profiler is disabled — the executors' hot path relies on that."""
+    program ``geometry`` (e.g. ``bs256_scan4``) and ``engine``
+    (``generic`` for the stock dequant+align program, ``fused`` when a
+    quantized-native fused program — the planar Pallas kernel or its
+    XLA form — owned the dispatch).  Generic dispatches key the sample
+    window by bare geometry (stable dashboard keys); fused ones key by
+    ``geometry/engine`` so the two programs' latency distributions
+    never mix.  No-op when the profiler is disabled — the executors'
+    hot path relies on that."""
     if not _STATE.enabled:
         return
     from mdanalysis_mpi_tpu.obs.metrics import METRICS
 
+    key = geometry if engine == "generic" else f"{geometry}/{engine}"
     with _LOCK:
-        dq = _STATE.dispatch.get(geometry)
+        dq = _STATE.dispatch.get(key)
         if dq is None:
             dq = deque(maxlen=MAX_DISPATCH_SAMPLES)
-            _STATE.dispatch[geometry] = dq
+            _STATE.dispatch[key] = dq
         dq.append(float(ms))
         _STATE.n_dispatches += 1
     METRICS.observe("mdtpu_dispatch_ms", float(ms),
-                    buckets=DISPATCH_MS_BUCKETS, geometry=geometry)
+                    buckets=DISPATCH_MS_BUCKETS, geometry=geometry,
+                    engine=engine)
 
 
 def _percentile(samples: list, q: float) -> float | None:

@@ -1,0 +1,454 @@
+"""Planar-layout fused Pallas hot loop: dequant + QCP align + moment
+update in ONE HBM-resident pass (ROADMAP item 4, the §8e retry).
+
+PERF.md §8e measured *why* the first fused attempt lost: the
+interleaved ``(B, 3S)`` lane layout needs lane%3 masks and nine lane
+rolls — ~80 VPU ops per int16 element where a planar ``(3, S)``-plane
+layout needs ~17.  This module is the planar retry: staged blocks
+arrive as ``(3, B, S)`` planes (one repack at stage time, behind the
+staging boundary — :func:`mdanalysis_mpi_tpu.io.base.planar_repack`),
+and ONE kernel sweep per frame tile does
+
+- dequant: cast + per-frame scale (int16/int8; f32 planes ride with
+  ``inv = 1``, the delta tier reconstructs on device and feeds f32
+  planes),
+- per-frame COM + Kabsch correlation ``H`` (12 lane reductions),
+- the rotation solve IN KERNEL — QCP (Theobald 2005): largest
+  eigenvalue of the 4x4 key matrix by Newton on the characteristic
+  quartic, eigenvector by adjugate, quaternion → matrix.  Pure
+  elementwise f32 arithmetic on ``(bt, 1)`` registers, no SVD, no
+  gathers, no rolls (validated against ``kabsch_from_correlation`` to
+  ~1e-5 on aligned coordinates over randomized trials),
+- rotate + deviation moments accumulated into one ``(6, S)`` output
+  (rows 0-2 ``Σdev``, rows 3-5 ``Σdev²``) across the sequential grid.
+
+Each staged block is read ONCE from HBM; nothing dequantized is ever
+materialized.  Under the scan-fold dispatch the scan_k superblock is
+the natural kernel grid: ``lax.scan`` maps this kernel over the
+stacked group, so a K-group still costs one dispatch.
+
+The algebra is byte-identical to ops/pallas_rmsf._core (no-COM Kabsch
+correlation with the ``Σ ref_c`` rank-1 fixup; ref-shifted
+cancellation-safe moments) — that XLA form remains the no-Pallas
+fallback and the differential oracle.
+
+Shape envelope: the kernel keeps a full padded selection row resident
+in VMEM per frame tile, so it requires ``S % 128 == 0`` (the
+ATOM_TILE=256 selection padding guarantees it), ``B`` divisible by a
+sublane-aligned frame tile (16 for int16, 32 for int8, 8 for f32) and
+``S <= MDTPU_FUSED_SMAX`` (default 16384 atoms ≈ 10 MB of VMEM
+residency at bt=16).  Anything outside the envelope falls back to the
+identical-algebra XLA form on the SAME planar staging — counted in
+``mdtpu_fused_fallbacks_total``, never silently.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import numpy as np
+
+from mdanalysis_mpi_tpu.ops.pallas_rmsf import _core, _on_tpu
+
+# Frame-tile sublane granule per staged dtype (TPU min tile second-to-
+# minor dim) and the VMEM residency cap on the selection width.
+_SUBLANE = {"int16": 16, "int8": 32, "float32": 8}
+_NEWTON_ITERS = 40
+
+
+def _s_max() -> int:
+    return int(os.environ.get("MDTPU_FUSED_SMAX", "16384"))
+
+
+def _frame_tile(B: int, dtype_name: str):
+    """Largest sublane-aligned frame tile dividing ``B`` (≤ 32), or
+    None when ``B`` doesn't tile for this dtype."""
+    sub = _SUBLANE.get(dtype_name)
+    if sub is None:
+        return None
+    bt = (32 // sub) * sub
+    while bt >= sub:
+        if B % bt == 0:
+            return bt
+        bt -= sub
+    return None
+
+
+def _qcp_rotation(h, jnp):
+    """In-kernel QCP rotation solve: ``h`` is a length-9 list of
+    ``(bt, 1)`` correlation entries [h00..h22] (H = mobileᵀ·ref,
+    weights folded in); returns nine ``(bt, 1)`` rotation entries
+    R00..R22 with ``aligned = mobile @ R`` matching
+    ``kabsch_from_correlation`` (numerically validated, conjugate
+    quaternion orientation).  Elementwise f32 only — VPU-native."""
+    f = jnp.float32
+    one = f(1.0)
+    h00, h01, h02, h10, h11, h12, h20, h21, h22 = h
+
+    # Frobenius-normalize: raw λ⁴-scale terms overflow f32 at
+    # coordinate scales (|H| ~ 1e8 → λ⁴ ~ 1e32)
+    trHH_raw = (h00 * h00 + h01 * h01 + h02 * h02
+                + h10 * h10 + h11 * h11 + h12 * h12
+                + h20 * h20 + h21 * h21 + h22 * h22)
+    fro = jnp.maximum(jnp.sqrt(trHH_raw), f(1e-30))
+    s = one / fro
+    h00, h01, h02 = h00 * s, h01 * s, h02 * s
+    h10, h11, h12 = h10 * s, h11 * s, h12 * s
+    h20, h21, h22 = h20 * s, h21 * s, h22 * s
+
+    # QCP key matrix K (4x4 symmetric, Theobald's S-matrix)
+    k00 = h00 + h11 + h22
+    k01 = h12 - h21
+    k02 = h20 - h02
+    k03 = h01 - h10
+    k11 = h00 - h11 - h22
+    k12 = h01 + h10
+    k13 = h20 + h02
+    k22 = -h00 + h11 - h22
+    k23 = h12 + h21
+    k33 = -h00 - h11 + h22
+
+    # characteristic quartic P(λ) = λ⁴ + c2·λ² + c1·λ + c0
+    trHH = (h00 * h00 + h01 * h01 + h02 * h02
+            + h10 * h10 + h11 * h11 + h12 * h12
+            + h20 * h20 + h21 * h21 + h22 * h22)
+    detH = (h00 * (h11 * h22 - h12 * h21)
+            - h01 * (h10 * h22 - h12 * h20)
+            + h02 * (h10 * h21 - h11 * h20))
+    c2 = f(-2.0) * trHH
+    c1 = f(-8.0) * detH
+    # c0 = det(K), cofactor expansion along row 0
+    d0 = (k11 * (k22 * k33 - k23 * k23)
+          - k12 * (k12 * k33 - k23 * k13)
+          + k13 * (k12 * k23 - k22 * k13))
+    d1 = (k01 * (k22 * k33 - k23 * k23)
+          - k12 * (k02 * k33 - k23 * k03)
+          + k13 * (k02 * k23 - k22 * k03))
+    d2 = (k01 * (k12 * k33 - k23 * k13)
+          - k11 * (k02 * k33 - k23 * k03)
+          + k13 * (k02 * k13 - k12 * k03))
+    d3 = (k01 * (k12 * k23 - k22 * k13)
+          - k11 * (k02 * k23 - k22 * k03)
+          + k12 * (k02 * k13 - k12 * k03))
+    c0 = k00 * d0 - k01 * d1 + k02 * d2 - k03 * d3
+
+    # Newton from above: λmax ≤ Σσ_i(H) ≤ sqrt(3·tr(HᵀH))
+    lam = jnp.sqrt(f(3.0) * trHH) + f(1e-6)
+    for _ in range(_NEWTON_ITERS):
+        lam2 = lam * lam
+        p = lam2 * lam2 + c2 * lam2 + c1 * lam + c0
+        dp = f(4.0) * lam2 * lam + f(2.0) * c2 * lam + c1
+        dp = jnp.where(jnp.abs(dp) < f(1e-30), f(1e-30), dp)
+        lam = lam - p / dp
+
+    # eigenvector of K at λ via the adjugate of A = K − λI (symmetric:
+    # every nonzero row of the cofactor matrix is the eigenvector);
+    # pick the max-norm row for conditioning
+    a00 = k00 - lam
+    a11 = k11 - lam
+    a22 = k22 - lam
+    a33 = k33 - lam
+    a01, a02, a03, a12, a13, a23 = k01, k02, k03, k12, k13, k23
+
+    def det3(b00, b01, b02, b10, b11, b12, b20, b21, b22):
+        return (b00 * (b11 * b22 - b12 * b21)
+                - b01 * (b10 * b22 - b12 * b20)
+                + b02 * (b10 * b21 - b11 * b20))
+
+    rows = []
+    q0_0 = det3(a11, a12, a13, a12, a22, a23, a13, a23, a33)
+    q0_1 = -det3(a01, a12, a13, a02, a22, a23, a03, a23, a33)
+    q0_2 = det3(a01, a11, a13, a02, a12, a23, a03, a13, a33)
+    q0_3 = -det3(a01, a11, a12, a02, a12, a22, a03, a13, a23)
+    rows.append((q0_0, q0_1, q0_2, q0_3))
+    q1_0 = -det3(a01, a02, a03, a12, a22, a23, a13, a23, a33)
+    q1_1 = det3(a00, a02, a03, a02, a22, a23, a03, a23, a33)
+    q1_2 = -det3(a00, a01, a03, a02, a12, a23, a03, a13, a33)
+    q1_3 = det3(a00, a01, a02, a02, a12, a22, a03, a13, a23)
+    rows.append((q1_0, q1_1, q1_2, q1_3))
+    q2_0 = det3(a01, a02, a03, a11, a12, a13, a13, a23, a33)
+    q2_1 = -det3(a00, a02, a03, a01, a12, a13, a03, a23, a33)
+    q2_2 = det3(a00, a01, a03, a01, a11, a13, a03, a13, a33)
+    q2_3 = -det3(a00, a01, a02, a01, a11, a12, a03, a13, a23)
+    rows.append((q2_0, q2_1, q2_2, q2_3))
+    q3_0 = -det3(a01, a02, a03, a11, a12, a13, a12, a22, a23)
+    q3_1 = det3(a00, a02, a03, a01, a12, a13, a02, a22, a23)
+    q3_2 = -det3(a00, a01, a03, a01, a11, a13, a02, a12, a23)
+    q3_3 = det3(a00, a01, a02, a01, a11, a12, a02, a12, a22)
+    rows.append((q3_0, q3_1, q3_2, q3_3))
+
+    norms = [qa * qa + qb * qb + qc * qc + qd * qd
+             for qa, qb, qc, qd in rows]
+    qa, qb, qc, qd = rows[0]
+    nbest = norms[0]
+    for (ra, rb, rc, rd), n in zip(rows[1:], norms[1:]):
+        use = n > nbest
+        qa = jnp.where(use, ra, qa)
+        qb = jnp.where(use, rb, qb)
+        qc = jnp.where(use, rc, qc)
+        qd = jnp.where(use, rd, qd)
+        nbest = jnp.maximum(nbest, n)
+
+    nrm = jnp.sqrt(jnp.maximum(nbest, f(0.0)))
+    degenerate = nrm < f(1e-18)
+    invn = jnp.where(degenerate, f(0.0), one / jnp.maximum(nrm, f(1e-30)))
+    qw = jnp.where(degenerate, one, qa * invn)
+    qx = qb * invn
+    qy = qc * invn
+    qz = qd * invn
+
+    # quaternion → rotation, conjugate orientation (aligned = mobile @ R)
+    two = f(2.0)
+    r00 = qw * qw + qx * qx - qy * qy - qz * qz
+    r10 = two * (qx * qy - qw * qz)
+    r20 = two * (qx * qz + qw * qy)
+    r01 = two * (qx * qy + qw * qz)
+    r11 = qw * qw - qx * qx + qy * qy - qz * qz
+    r21 = two * (qy * qz - qw * qx)
+    r02 = two * (qx * qz - qw * qy)
+    r12 = two * (qy * qz + qw * qx)
+    r22 = qw * qw - qx * qx - qy * qy + qz * qz
+    return r00, r01, r02, r10, r11, r12, r20, r21, r22
+
+
+@functools.lru_cache(maxsize=None)
+def _build_planar(interpret: bool, bt: int, nb: int, S: int):
+    """The fused planar kernel for one (frame_tile, n_tiles, S) shape.
+
+    Grid ``(nb,)`` over frame tiles; the three coordinate planes of the
+    ``(3B, S)``-viewed block arrive as three same-array inputs whose
+    index maps pick plane ``i``'s rows for tile ``b`` (block row
+    ``i·nb + b``) — rank-2 blocks only, no rank-3 tiling constraints.
+    The ``(6, S)`` output accumulates across the sequential TPU grid.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    def kernel(x0_ref, x1_ref, x2_ref, inv_ref, w_ref, ref_ref, am_ref,
+               fm_ref, sref_ref, out_ref):
+        b = pl.program_id(0)
+        inv = inv_ref[...]                                # (bt, 1)
+        x0 = x0_ref[...].astype(jnp.float32) * inv        # (bt, S)
+        x1 = x1_ref[...].astype(jnp.float32) * inv
+        x2 = x2_ref[...].astype(jnp.float32) * inv
+        w = w_ref[...]                                    # (1, S)
+        r0 = ref_ref[0:1, :]                              # (1, S)
+        r1 = ref_ref[1:2, :]
+        r2 = ref_ref[2:3, :]
+        com0 = (x0 * w).sum(axis=1, keepdims=True)        # (bt, 1)
+        com1 = (x1 * w).sum(axis=1, keepdims=True)
+        com2 = (x2 * w).sum(axis=1, keepdims=True)
+        s0 = sref_ref[0:1, 0:1]                           # (1, 1)
+        s1 = sref_ref[0:1, 1:2]
+        s2 = sref_ref[0:1, 2:3]
+        # H = Σ x·refᵀ − com ⊗ Σref (the rank-1 no-COM fixup; see
+        # pallas_rmsf._core)
+        h = [(x0 * r0).sum(axis=1, keepdims=True) - com0 * s0,
+             (x0 * r1).sum(axis=1, keepdims=True) - com0 * s1,
+             (x0 * r2).sum(axis=1, keepdims=True) - com0 * s2,
+             (x1 * r0).sum(axis=1, keepdims=True) - com1 * s0,
+             (x1 * r1).sum(axis=1, keepdims=True) - com1 * s1,
+             (x1 * r2).sum(axis=1, keepdims=True) - com1 * s2,
+             (x2 * r0).sum(axis=1, keepdims=True) - com2 * s0,
+             (x2 * r1).sum(axis=1, keepdims=True) - com2 * s1,
+             (x2 * r2).sum(axis=1, keepdims=True) - com2 * s2]
+        (R00, R01, R02, R10, R11, R12,
+         R20, R21, R22) = _qcp_rotation(h, jnp)
+        xc0 = x0 - com0
+        xc1 = x1 - com1
+        xc2 = x2 - com2
+        am = am_ref[...]                                  # (1, S)
+        fm = fm_ref[...]                                  # (bt, 1)
+        d0 = xc0 * R00 + xc1 * R10 + xc2 * R20            # (bt, S)
+        d1 = xc0 * R01 + xc1 * R11 + xc2 * R21
+        d2 = xc0 * R02 + xc1 * R12 + xc2 * R22
+        dev0 = (d0 - r0) * am
+        dev1 = (d1 - r1) * am
+        dev2 = (d2 - r2) * am
+        dm0 = dev0 * fm
+        dm1 = dev1 * fm
+        dm2 = dev2 * fm
+
+        @pl.when(b == 0)
+        def _():
+            out_ref[...] = jnp.zeros_like(out_ref)
+
+        out_ref[0:1, :] += dm0.sum(axis=0, keepdims=True)
+        out_ref[1:2, :] += dm1.sum(axis=0, keepdims=True)
+        out_ref[2:3, :] += dm2.sum(axis=0, keepdims=True)
+        out_ref[3:4, :] += (dm0 * dev0).sum(axis=0, keepdims=True)
+        out_ref[4:5, :] += (dm1 * dev1).sum(axis=0, keepdims=True)
+        out_ref[5:6, :] += (dm2 * dev2).sum(axis=0, keepdims=True)
+
+    def _plane_spec(i):
+        return pl.BlockSpec((bt, S), lambda b, i=i: (i * nb + b, 0))
+
+    def call(qp3, inv_col, w_row, refp, am_row, fm_col, sref_row):
+        return pl.pallas_call(
+            kernel,
+            grid=(nb,),
+            in_specs=[
+                _plane_spec(0), _plane_spec(1), _plane_spec(2),
+                pl.BlockSpec((bt, 1), lambda b: (b, 0)),
+                pl.BlockSpec((1, S), lambda b: (0, 0)),
+                pl.BlockSpec((3, S), lambda b: (0, 0)),
+                pl.BlockSpec((1, S), lambda b: (0, 0)),
+                pl.BlockSpec((bt, 1), lambda b: (b, 0)),
+                pl.BlockSpec((1, 3), lambda b: (0, 0)),
+            ],
+            out_specs=pl.BlockSpec((6, S), lambda b: (0, 0)),
+            out_shape=jax.ShapeDtypeStruct((6, S), jnp.float32),
+            interpret=interpret,
+        )(qp3, qp3, qp3, inv_col, w_row, refp, am_row, fm_col, sref_row)
+
+    return call
+
+
+def _resolve_planar(engine: str, B: int, S: int, dtype_name: str):
+    """'pallas'/'interpret' when the planar kernel's shape envelope
+    holds, else 'xla' (identical algebra on the same planar block)."""
+    if engine in ("pallas", "interpret"):
+        bt = _frame_tile(B, dtype_name)
+        if (bt is not None and S > 0 and S % 128 == 0
+                and S <= _s_max()):
+            return engine, bt
+    return "xla", None
+
+
+def _core_planar(engine: str, qp, inv_scale, wN, refc_p, amask, sref,
+                 fmask):
+    """Planar fused core: ``(3, B, S)`` staged planes → (T, Σdev,
+    Σdev²) with the exact pallas_rmsf._core algebra.  Outside the
+    kernel's shape envelope the same planar block runs the XLA form
+    (device-side transpose; still no HOST f32 materialization) and the
+    decision is counted once per trace in
+    ``mdtpu_fused_fallbacks_total``."""
+    import jax.numpy as jnp
+
+    _, B, S = qp.shape
+    eng, bt = _resolve_planar(engine, B, S, qp.dtype.name)
+    inv_col = jnp.broadcast_to(
+        jnp.asarray(inv_scale, jnp.float32).reshape(-1, 1), (B, 1))
+    fm_col = fmask.astype(jnp.float32).reshape(B, 1)
+    if eng in ("pallas", "interpret"):
+        interpret = eng == "interpret" or not _on_tpu()
+        out = _build_planar(interpret, bt, B // bt, S)(
+            qp.reshape(3 * B, S), inv_col, wN.reshape(1, S),
+            refc_p.T, amask.reshape(1, S), fm_col, sref.reshape(1, 3))
+        sum_d = out[0:3].T
+        sumsq = out[3:6].T
+        t = fm_col.sum()
+        return t, sum_d, sumsq
+    from mdanalysis_mpi_tpu.obs import METRICS
+
+    METRICS.inc("mdtpu_fused_fallbacks_total")
+    return _core("xla", jnp.transpose(qp, (1, 2, 0)), inv_scale, wN,
+                 refc_p, amask, sref, fmask)
+
+
+def _moments_from_core(t, sum_d, sumsq, refc_p, ref_com, n_real):
+    import jax.numpy as jnp
+
+    tt = jnp.maximum(t, 1.0)
+    mean = ((refc_p + ref_com) + sum_d / tt)[:n_real]
+    m2 = jnp.maximum(sumsq - sum_d * sum_d / tt, 0.0)[:n_real]
+    return t, mean, m2
+
+
+@functools.lru_cache(maxsize=None)
+def moments_kernel_for(engine: str, n_real: int):
+    """Planar quantized-native moments kernel (executor convention
+    ``f(params, q_planar, inv_scale, boxes, mask)``).  The
+    ``staging_layout`` attribute is the executor's signal to stage
+    ``(3, B, S)`` planes (see executors._host_stage)."""
+
+    def aligned_moments_planar(params, q, inv_scale, boxes, mask):
+        del boxes
+        wN, refc_p, ref_com, amask, sref = params
+        t, sum_d, sumsq = _core_planar(engine, q, inv_scale, wN, refc_p,
+                                       amask, sref, mask)
+        return _moments_from_core(t, sum_d, sumsq, refc_p, ref_com,
+                                  n_real)
+
+    aligned_moments_planar.__name__ = (
+        f"aligned_moments_planar_{engine}_{n_real}")
+    aligned_moments_planar.staging_layout = "planar"
+    return aligned_moments_planar
+
+
+@functools.lru_cache(maxsize=None)
+def avg_kernel_for(engine: str, n_real: int):
+    """Planar quantized-native pass-1 average kernel ``(T, Σ aligned)``."""
+
+    def avg_sum_planar(params, q, inv_scale, boxes, mask):
+        del boxes
+        wN, refc_p, ref_com, amask, sref = params
+        t, sum_d, _ = _core_planar(engine, q, inv_scale, wN, refc_p,
+                                   amask, sref, mask)
+        return t, (sum_d + t * (refc_p + ref_com))[:n_real]
+
+    avg_sum_planar.__name__ = f"avg_sum_planar_{engine}_{n_real}"
+    avg_sum_planar.staging_layout = "planar"
+    return avg_sum_planar
+
+
+def _delta_reconstruct(res, key, inv_abs, inv_res, jnp):
+    """Device-side closed-loop DPCM reconstruction (the exact
+    executors._delta_wrapper expression) → f32 ``(B, S, 3)``."""
+    return (key.astype(jnp.float32) * inv_abs
+            + jnp.cumsum(res.astype(jnp.float32) * inv_res, axis=0))
+
+
+@functools.lru_cache(maxsize=None)
+def moments_delta_kernel_for(engine: str, n_real: int):
+    """Delta-native moments kernel (6-element staged tuple).  The
+    cross-frame cumsum reconstruction stays an XLA op (its sequential
+    frame dependency doesn't tile under the frame-grid kernel); the
+    align+reduce sweep then runs the planar kernel on f32 planes with
+    ``inv = 1`` — host staging stays the interleaved delta tuple."""
+
+    def aligned_moments_delta(params, res, key, inv_abs, inv_res, boxes,
+                              mask):
+        del boxes
+        import jax.numpy as jnp
+
+        wN, refc_p, ref_com, amask, sref = params
+        x = _delta_reconstruct(res, key, inv_abs, inv_res, jnp)
+        if engine in ("pallas", "interpret"):
+            t, sum_d, sumsq = _core_planar(
+                engine, jnp.transpose(x, (2, 0, 1)), 1.0, wN, refc_p,
+                amask, sref, mask)
+        else:
+            t, sum_d, sumsq = _core("xla", x, 1.0, wN, refc_p, amask,
+                                    sref, mask)
+        return _moments_from_core(t, sum_d, sumsq, refc_p, ref_com,
+                                  n_real)
+
+    aligned_moments_delta.__name__ = (
+        f"aligned_moments_delta_{engine}_{n_real}")
+    return aligned_moments_delta
+
+
+@functools.lru_cache(maxsize=None)
+def avg_delta_kernel_for(engine: str, n_real: int):
+    """Delta-native pass-1 average kernel (6-element staged tuple)."""
+
+    def avg_sum_delta(params, res, key, inv_abs, inv_res, boxes, mask):
+        del boxes
+        import jax.numpy as jnp
+
+        wN, refc_p, ref_com, amask, sref = params
+        x = _delta_reconstruct(res, key, inv_abs, inv_res, jnp)
+        if engine in ("pallas", "interpret"):
+            t, sum_d, _ = _core_planar(
+                engine, jnp.transpose(x, (2, 0, 1)), 1.0, wN, refc_p,
+                amask, sref, mask)
+        else:
+            t, sum_d, _ = _core("xla", x, 1.0, wN, refc_p, amask, sref,
+                                mask)
+        return t, (sum_d + t * (refc_p + ref_com))[:n_real]
+
+    avg_sum_delta.__name__ = f"avg_sum_delta_{engine}_{n_real}"
+    return avg_sum_delta

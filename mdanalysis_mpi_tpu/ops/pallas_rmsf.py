@@ -4,23 +4,21 @@
 Motivation: the steady-state flagship (AlignedRMSF over HBM-cached
 int16 blocks) sits on the HBM bandwidth wall (PERF.md §8b) — the
 generic path models ~48·S bytes/frame against a perfect-fusion floor
-of 12·S (read the int16 block exactly twice).  This module implements
-that floor: two sweeps over the *quantized* block with nothing but
-3x3-sized tensors materialized in between.
+of 12·S (read the int16 block exactly twice).  This module owns the
+fused-path CONTRACT — selection padding, params, engine routing, the
+XLA reference form — while the Pallas kernel itself lives in
+:mod:`mdanalysis_mpi_tpu.ops.pallas_fused` (planar layout, single
+sweep, 6·S floor).
 
-**Measured outcome (PERF.md §8e): the fused forms are CORRECT but
-SLOWER on TPU v5e** — the bandwidth they save is repaid in compute.
-The Pallas sweeps are VPU-bound (the interleaved-lane algebra below
-costs ~9 masked/rolled elementwise ops where a planar layout costs
-one; measured 13.8k f/s steady vs the generic path's 306.7k), and the
-XLA form's ``(B,S,3)x(S,3)->(B,3,3)`` contraction maps poorly to the
-MXU (150.5k f/s).  The generic dequant path already runs at ~91% of
-the chip's HBM wall per its own traffic model, so the headroom the
-floor promised is not reachable by fusion on this compiler/chip
-generation.  The path is kept: it is differential-tested, its algebra
-(no-COM Kabsch correlation, ref-shifted cancellation-safe moments) is
-independently useful, and the measured numbers document exactly why
-the generic path is the right default.
+**Measured outcome of the FIRST attempt (PERF.md §8e): correct but
+slower on TPU v5e** — the interleaved-lane Pallas sweeps were
+VPU-bound (~80 ops per int16 element; 13.8k f/s steady vs the generic
+path's 306.7k), and the XLA form's ``(B,S,3)x(S,3)->(B,3,3)``
+contraction maps poorly to the MXU (150.5k f/s).  §8e's addendum
+records what the planar retry changes; the XLA form stays as the
+no-Pallas fallback and as the differential oracle, and its measured
+numbers document why the generic path remains the hardware default
+until the planar kernel proves out on-chip.
 
 Algebra (why two sweeps suffice — the reference computes the same
 quantities per frame at RMSF.py:94-101/124-138):
@@ -40,23 +38,23 @@ quantities per frame at RMSF.py:94-101/124-138):
   ``mean = ref_c + ref_com + Σd/T``; both are exact algebra, not
   approximation (same Chan-merge family as ops/moments.py).
 
-Layout: a staged ``(B, S, 3)`` block reshapes *for free* to ``(B, 3S)``
-with atom triplets contiguous on the lane axis.  The kernels work on
-that interleaved layout directly — component selection by ``lane % 3``
-masks, and the per-frame 3x3 rotation applied with nine static
-``jnp.roll``s on the lane axis (shift ``j - i`` moves component-i lanes
-onto component-j lanes; triplets never straddle a block because the
-lane tile is a multiple of 3, so the rolls never mix atoms).  No
-transpose, no dequantized copy: HBM traffic is the two int16 reads.
+Layout history: the first Pallas attempt worked on the free
+``(B, 3S)`` *interleaved* reshape (lane%3 masks + nine lane rolls per
+rotation) and measured ~80 VPU ops per int16 element — the §8e table
+in PERF.md records the 13.8k f/s negative result and those sweep
+bodies are retired to git history (this file, up to PR-17).  The
+retry lives in :mod:`mdanalysis_mpi_tpu.ops.pallas_fused`: a
+**planar** ``(3, B, S)``-plane kernel (one repack at stage time,
+~17 VPU ops per element, rotation solved IN kernel via QCP) that
+additionally fuses the two sweeps into one.  Here,
+``engine='pallas'|'interpret'`` delegates to that planar kernel via a
+device-side transpose; ``engine='xla'`` remains the no-Pallas
+fallback and the differential oracle for both.
 
 Callers pad the *selection* (not the block) so ``S`` is a multiple of
 :data:`ATOM_TILE` — padding atoms replicate index 0 with zero weight,
 zero reference row and a zero atom-mask lane, making them exact
 no-ops in every accumulation (see :func:`pad_selection`).
-
-On non-TPU backends the Pallas sweeps run in interpret mode for the
-CPU test suite (``MDTPU_RMSF_PALLAS=1``); ``engine='xla'`` is the
-identical algebra as plain XLA ops — the differential oracle for both.
 """
 
 from __future__ import annotations
@@ -66,28 +64,6 @@ import functools
 import numpy as np
 
 ATOM_TILE = 256                 # selection-padding granule (atoms)
-FRAME_TILE = 16                 # frame-tile granule (int16 sublane tile)
-# Per-block tile TARGETS.  Blocks must be big enough to amortize the
-# per-grid-step DMA/loop overhead (measured on-chip: 768-lane x 16-frame
-# blocks ran the sweeps at ~12 GB/s, two orders under the HBM wall,
-# because the 24 KB DMAs are latency-bound) while the ~8 live f32
-# temporaries per block stay inside the ~16 MB of VMEM.
-LANE_TILE_TARGET = 6144         # 2048 atoms; multiple of 3*128
-FRAME_TILE_TARGET = 32
-
-
-def _tiles(B: int, L: int):
-    """Largest (frame_tile, lane_tile) dividing (B, L) under the
-    targets; both stay multiples of the hardware granules (16 sublanes
-    for int16, 384 lanes = 128 f32 lanes x 3 components so triplets
-    never straddle a block)."""
-    bt = FRAME_TILE_TARGET
-    while bt > FRAME_TILE and B % bt:
-        bt -= FRAME_TILE
-    lt = (LANE_TILE_TARGET // 384) * 384
-    while lt > 384 and L % lt:
-        lt -= 384
-    return bt, lt
 
 
 def pad_selection(idx: np.ndarray):
@@ -106,142 +82,6 @@ def pad_selection(idx: np.ndarray):
     return out, n
 
 
-@functools.lru_cache(maxsize=None)
-def _build_p1(interpret: bool, bt: int, lt: int):
-    """Sweep 1: interleaved int16 block → per-frame (Σ w·x, H).
-
-    Grid (nb, ns), lane tiles innermost; the (BT, 3) / (BT, 9) output
-    blocks accumulate across the ns sweep (sequential TPU grid)."""
-    import jax
-    import jax.numpy as jnp
-    from jax.experimental import pallas as pl
-
-    def kernel(q_ref, wb_ref, refb_ref, sxw_ref, h_ref):
-        s = pl.program_id(1)
-        x = q_ref[...].astype(jnp.float32)           # (BT, LT)
-        wb = wb_ref[...]                             # (1, LT)
-        refb = refb_ref[...]                         # (3, LT)
-        lane = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1) % 3
-
-        @pl.when(s == 0)
-        def _():
-            sxw_ref[...] = jnp.zeros_like(sxw_ref)
-            h_ref[...] = jnp.zeros_like(h_ref)
-
-        sxw_cols = []
-        h_cols = []
-        for i in range(3):
-            xi = x * (lane == i)
-            sxw_cols.append((xi * wb).sum(axis=1, keepdims=True))
-            for j in range(3):
-                h_cols.append(
-                    (xi * refb[j:j + 1]).sum(axis=1, keepdims=True))
-        sxw_ref[...] += jnp.concatenate(sxw_cols, axis=1)
-        h_ref[...] += jnp.concatenate(h_cols, axis=1)
-
-    def call(q2, wb, refb):
-        B, L = q2.shape
-        grid = (B // bt, L // lt)
-        return pl.pallas_call(
-            kernel,
-            grid=grid,
-            in_specs=[
-                pl.BlockSpec((bt, lt), lambda b, s: (b, s)),
-                pl.BlockSpec((1, lt), lambda b, s: (0, s)),
-                pl.BlockSpec((3, lt), lambda b, s: (0, s)),
-            ],
-            out_specs=[
-                pl.BlockSpec((bt, 3), lambda b, s: (b, 0)),
-                pl.BlockSpec((bt, 9), lambda b, s: (b, 0)),
-            ],
-            out_shape=[
-                jax.ShapeDtypeStruct((B, 3), jnp.float32),
-                jax.ShapeDtypeStruct((B, 9), jnp.float32),
-            ],
-            interpret=interpret,
-        )(q2, wb, refb)
-
-    return call
-
-
-@functools.lru_cache(maxsize=None)
-def _build_p2(interpret: bool, bt: int, lt: int):
-    """Sweep 2: rotate + accumulate deviation sums.
-
-    Grid (ns, nb), frame tiles innermost; the (2, LT) output block
-    (row 0 = Σd, row 1 = Σd²) accumulates across the nb sweep."""
-    import jax
-    import jax.numpy as jnp
-    from jax.experimental import pallas as pl
-
-    def kernel(q_ref, inv_ref, com_ref, r_ref, refi_ref, am_ref, fm_ref,
-               out_ref):
-        b = pl.program_id(1)
-        x = q_ref[...].astype(jnp.float32) * inv_ref[...]   # (BT,LT)*(BT,1)
-        lane = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1) % 3
-        com = com_ref[...]                                  # (BT, 3)
-        comlane = (com[:, 0:1] * (lane == 0)
-                   + com[:, 1:2] * (lane == 1)
-                   + com[:, 2:3] * (lane == 2))
-        xc = x - comlane
-        r = r_ref[...]                                      # (BT, 9)
-        d = jnp.zeros_like(x)
-        for i in range(3):
-            yi = xc * (lane == i)
-            for j in range(3):
-                # value at lane 3n+i moves to lane 3n+j; the lane tile
-                # (lt, a multiple of 3 by _tiles' 384-lane granule) keeps
-                # triplets inside one block, so the wrap-around lanes
-                # only ever carry zeros of yi.
-                # shift 0 must bypass roll: Mosaic rejects the
-                # zero-width slice jnp.roll's static path emits for it
-                rolled = yi if j == i else jnp.roll(yi, j - i, axis=1)
-                d += rolled * r[:, 3 * i + j:3 * i + j + 1]
-        dev = (d - refi_ref[...]) * am_ref[...]             # (BT, LT)
-        devm = dev * fm_ref[...]                            # frame mask 0/1
-
-        @pl.when(b == 0)
-        def _():
-            out_ref[...] = jnp.zeros_like(out_ref)
-
-        out_ref[0:1, :] += devm.sum(axis=0, keepdims=True)
-        out_ref[1:2, :] += (devm * dev).sum(axis=0, keepdims=True)
-
-    def call(q2, inv_col, com, r9, refi, aml, fm_col):
-        B, L = q2.shape
-        grid = (L // lt, B // bt)
-        return pl.pallas_call(
-            kernel,
-            grid=grid,
-            in_specs=[
-                pl.BlockSpec((bt, lt), lambda s, b: (b, s)),
-                pl.BlockSpec((bt, 1), lambda s, b: (b, 0)),
-                pl.BlockSpec((bt, 3), lambda s, b: (b, 0)),
-                pl.BlockSpec((bt, 9), lambda s, b: (b, 0)),
-                pl.BlockSpec((1, lt), lambda s, b: (0, s)),
-                pl.BlockSpec((1, lt), lambda s, b: (0, s)),
-                pl.BlockSpec((bt, 1), lambda s, b: (b, 0)),
-            ],
-            out_specs=pl.BlockSpec((2, lt), lambda s, b: (0, s)),
-            out_shape=jax.ShapeDtypeStruct((2, L), jnp.float32),
-            interpret=interpret,
-        )(q2, inv_col, com, r9, refi, aml, fm_col)
-
-    return call
-
-
-def _resolve_engine(engine: str, B: int, L: int) -> str:
-    """'pallas' needs the tile alignment the staging layer provides
-    (B % 16, padded selection); anything else falls back to the
-    identical-algebra XLA path at trace time (same fn identity, the
-    shape-keyed jit cache keeps both compiled forms)."""
-    if engine in ("pallas", "interpret"):
-        if B % FRAME_TILE == 0 and L % 384 == 0 and L > 0:
-            return engine
-        return "xla"
-    return "xla"
-
-
 def _core(engine: str, q, inv_scale, wN, refc_p, amask, sref, fmask):
     """Shared fused core: quantized block → (T, Σdev, Σdev²) with
     dev = (x−com)·R − ref_c, padded atoms zeroed.  q (B,S,3) int16 (or
@@ -255,44 +95,33 @@ def _core(engine: str, q, inv_scale, wN, refc_p, amask, sref, fmask):
     fixup applied between the sweeps, not inside them."""
     import jax.numpy as jnp
 
+    if engine in ("pallas", "interpret"):
+        # the planar fused kernel owns the Pallas path now (the retired
+        # interleaved sweeps measured ~5x more VPU ops; PERF.md §8e) —
+        # the transpose is a device op XLA folds into the staging copy
+        from mdanalysis_mpi_tpu.ops import pallas_fused as pf
+
+        return pf._core_planar(engine, jnp.transpose(q, (2, 0, 1)),
+                               inv_scale, wN, refc_p, amask, sref, fmask)
+
     from mdanalysis_mpi_tpu.ops.align import _HI, kabsch_from_correlation
 
     B, S, _ = q.shape
     # scalar (single-host) or (B,1,1) per-frame (multi-host) → (B,1)
     inv_col = jnp.broadcast_to(
         jnp.asarray(inv_scale, jnp.float32).reshape(-1, 1), (B, 1))
-    eng = _resolve_engine(engine, B, 3 * S)
     fm_col = fmask.astype(jnp.float32).reshape(B, 1)
-    if eng in ("pallas", "interpret"):
-        interpret = eng == "interpret" or not _on_tpu()
-        q2 = q.reshape(B, 3 * S)
-        wb = jnp.repeat(wN.reshape(1, S), 3, axis=1).reshape(1, 3 * S)
-        # interleaved-broadcast reference: refb[j, 3n+c] = ref_c[n, j]
-        refb = jnp.repeat(refc_p.T, 3, axis=1)
-        refi = refc_p.reshape(1, 3 * S)
-        aml = jnp.repeat(amask.reshape(1, S), 3, axis=1).reshape(1, 3 * S)
-        bt, lt = _tiles(B, 3 * S)
-        sxw, h9 = _build_p1(interpret, bt, lt)(q2, wb, refb)
-        com = sxw * inv_col
-        h = h9.reshape(B, 3, 3) * inv_col[:, :, None]
-        h = h - com[:, :, None] * sref[None, None, :]
-        r = kabsch_from_correlation(h)
-        sums = _build_p2(interpret, bt, lt)(
-            q2, inv_col, com, r.reshape(B, 9), refi, aml, fm_col)
-        sum_d = sums[0].reshape(S, 3)
-        sumsq = sums[1].reshape(S, 3)
-    else:
-        x = q.astype(jnp.float32) * inv_col[:, :, None]
-        com = jnp.einsum("bni,n->bi", x, wN, precision=_HI)
-        h = jnp.einsum("bni,nj->bij", x, refc_p, precision=_HI)
-        h = h - com[:, :, None] * sref[None, None, :]
-        r = kabsch_from_correlation(h)
-        d = jnp.einsum("bni,bij->bnj", x - com[:, None], r,
-                       precision=_HI) - refc_p
-        d = d * amask[None, :, None]
-        dm = d * fm_col[:, :, None]
-        sum_d = dm.sum(axis=0)
-        sumsq = (dm * d).sum(axis=0)
+    x = q.astype(jnp.float32) * inv_col[:, :, None]
+    com = jnp.einsum("bni,n->bi", x, wN, precision=_HI)
+    h = jnp.einsum("bni,nj->bij", x, refc_p, precision=_HI)
+    h = h - com[:, :, None] * sref[None, None, :]
+    r = kabsch_from_correlation(h)
+    d = jnp.einsum("bni,bij->bnj", x - com[:, None], r,
+                   precision=_HI) - refc_p
+    d = d * amask[None, :, None]
+    dm = d * fm_col[:, :, None]
+    sum_d = dm.sum(axis=0)
+    sumsq = (dm * d).sum(axis=0)
     t = fm_col.sum()
     return t, sum_d, sumsq
 
@@ -380,23 +209,42 @@ def quantized_batch(kind: str, engine, transfer_dtype: str, idx,
     and params contracts cannot diverge between pass 1 and pass 2 —
     identical padded selections are what let the HBM block cache serve
     both passes.  Returns None unless engine='fused' and the staging is
-    int16-native."""
+    quantized (int16/int8/delta).
+
+    Routing: ``default_engine()`` decides the form.  'pallas' (the
+    ``MDTPU_RMSF_PALLAS=1`` opt-in) takes the planar fused kernel
+    (ops/pallas_fused.py — staged blocks arrive as (3, B, S) planes,
+    ``staging_layout='planar'``); 'xla' keeps the interleaved XLA form
+    byte-compatible with the pre-planar schedule, so with the Pallas
+    engine off nothing about staging, cache keys or dispatch changes.
+    The delta tier reconstructs on device from its native 6-tuple
+    (staging stays interleaved) and then runs the selected form."""
     if engine != "fused":
         return None
-    if transfer_dtype != "int16":
-        # float32 staging is a documented silent fallback (no quantized
-        # block to fuse over — the generic path is already dequant-free);
-        # int8/delta with an explicit engine ask must fail loudly, same
-        # rationale as validate_engine
-        if transfer_dtype == "float32":
-            return None
+    if transfer_dtype == "float32":
+        # documented silent fallback: no quantized block to fuse over —
+        # the generic f32 path is already dequant-free
+        return None
+    if transfer_dtype not in ("int16", "int8", "delta"):
         raise ValueError(
-            f"engine='fused' supports transfer_dtype='int16' (or the "
-            f"float32 fallback), not {transfer_dtype!r}")
+            f"engine='fused' supports quantized staging "
+            f"(int16/int8/delta) or the float32 fallback, not "
+            f"{transfer_dtype!r}")
     idx_p, n_real = pad_selection(idx)
     params = build_params(ref_sel_c, ref_com, weights, n_real, len(idx_p))
-    kernel_for = {"moments": moments_kernel_for, "avg": avg_kernel_for}[kind]
-    return kernel_for(default_engine(), n_real), params, idx_p
+    eng = default_engine()
+    from mdanalysis_mpi_tpu.ops import pallas_fused as pf
+
+    if transfer_dtype == "delta":
+        kernel_for = {"moments": pf.moments_delta_kernel_for,
+                      "avg": pf.avg_delta_kernel_for}[kind]
+    elif eng == "pallas":
+        kernel_for = {"moments": pf.moments_kernel_for,
+                      "avg": pf.avg_kernel_for}[kind]
+    else:
+        kernel_for = {"moments": moments_kernel_for,
+                      "avg": avg_kernel_for}[kind]
+    return kernel_for(eng, n_real), params, idx_p
 
 
 @functools.lru_cache(maxsize=None)

@@ -341,7 +341,7 @@ def _block_nbytes(bs: int, sel_idx, n_atoms: int,
 
 def _staged_avals(bs: int, n_stage: int, quantize,
                   delta_anchors: int = 1, inv_per_frame: bool = False,
-                  shardings=None):
+                  shardings=None, layout: str = "interleaved"):
     """`jax.ShapeDtypeStruct`s of the staged tuple `_host_stage`
     produces for this geometry — the shape contract the AOT warmup
     surface lowers against (docs/COLDSTART.md).  MUST mirror
@@ -351,7 +351,9 @@ def _staged_avals(bs: int, n_stage: int, quantize,
     executors fall back to the jit path on key mismatch, so drift is a
     perf regression, not a crash).  ``shardings``: optional per-element
     NamedShardings (mesh path), applied positionally like
-    ``_put_staged`` targets."""
+    ``_put_staged`` targets.  ``layout='planar'`` (the fused Pallas
+    kernel's staging form, ops/pallas_fused.py) swaps the quantized
+    block aval to ``(3, bs, n_stage)`` component planes."""
     import jax
     import jax.numpy as jnp
 
@@ -367,7 +369,9 @@ def _staged_avals(bs: int, n_stage: int, quantize,
     elif quantize:
         inv = (S((bs, 1, 1), jnp.float32) if inv_per_frame
                else S((), jnp.float32))
-        avals = (S((bs, n_stage, 3), jnp.dtype(quantize)), inv,
+        q_shape = ((3, bs, n_stage) if layout == "planar"
+                   else (bs, n_stage, 3))
+        avals = (S(q_shape, jnp.dtype(quantize)), inv,
                  S((bs, 6), jnp.float32),
                  S((bs,), jnp.float32))
     else:
@@ -523,6 +527,25 @@ def _quantized_native(analysis, transfer_dtype: str):
     return get(transfer_dtype)
 
 
+def _mesh_quantized_native(analysis, transfer_dtype: str):
+    """Mesh-path variant of :func:`_quantized_native`: planar-staged
+    kernels (``staging_layout='planar'``, the fused Pallas path) are
+    single-device programs today — their ``(3, B, S)`` staging would
+    need a component-replicated/frame-sharded in_spec the mesh builder
+    doesn't carry — so the mesh keeps the interleaved-staging program
+    for them (the generic dequant path, or the interleaved XLA fused
+    form when the Pallas engine is off).  The demotion is counted, not
+    silent."""
+    qn = _quantized_native(analysis, transfer_dtype)
+    if (qn is not None and getattr(qn[0], "staging_layout",
+                                   "interleaved") != "interleaved"):
+        from mdanalysis_mpi_tpu import obs
+
+        obs.METRICS.inc("mdtpu_fused_fallbacks_total")
+        return None
+    return qn
+
+
 def _validate_transfer_dtype(transfer_dtype: str) -> None:
     if transfer_dtype not in ("float32", "int16", "int8", "delta"):
         raise ValueError(
@@ -578,7 +601,8 @@ _INTEGRITY_FINGERPRINTS = _os.environ.get(
     "MDTPU_INTEGRITY_FINGERPRINTS", "1") not in ("0", "false", "no")
 
 
-def quantize_block(block: np.ndarray, dtype: str = "int16"):
+def quantize_block(block: np.ndarray, dtype: str = "int16",
+                   layout: str = "interleaved"):
     """Quantize an (B, S, 3) float32 block to ``dtype`` + inverse scale.
 
     One symmetric scale per block.  ``int16``: resolution = max|x| /
@@ -592,13 +616,20 @@ def quantize_block(block: np.ndarray, dtype: str = "int16"):
     σ ≈ 0.03 Å) and gated by the same divergence checks as every other
     staging dtype; unfit for Å-precision observables on wide systems —
     the bench's divergence gate fails loudly rather than score it.
+
+    ``layout='planar'`` additionally repacks the quantized block to
+    ``(3, B, S)`` component planes (the fused Pallas kernel's staging
+    form) — the one host copy the planar path pays, on int16/int8
+    bytes rather than f32, behind the staging boundary.
     """
-    from mdanalysis_mpi_tpu.io.base import QUANT_TARGETS
+    from mdanalysis_mpi_tpu.io.base import QUANT_TARGETS, planar_repack
 
     target = QUANT_TARGETS[dtype]
     m = float(np.abs(block).max()) if block.size else 1.0
     scale = target / max(m, 1e-30)
     q = np.round(block * scale).astype(dtype)
+    if layout == "planar":
+        q = planar_repack(q)
     return q, np.float32(1.0 / scale)
 
 
@@ -955,7 +986,8 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
                  prestage: bool = False, fused_call=None,
                  delta_anchors: int = 1, reliability=None,
                  scan_k: int = 1, scan_calls: "_ScanCalls | None" = None,
-                 stage_only: bool = False):
+                 stage_only: bool = False, layout: str = "interleaved",
+                 engine: str = "generic"):
     """Shared batch loop: stage → kernel → DEVICE-side accumulation.
 
     ``scan_k > 1`` (with ``scan_calls``) activates the SCAN-FOLDED
@@ -1037,6 +1069,12 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
     # the same frames; the transformation tuple (set-once) namespaces
     # the cached entries
     xform_fp = getattr(reader, "transformations", ())
+    # planar staging (the fused Pallas path) caches transposed bytes a
+    # generic run must never be served; the suffix exists ONLY for
+    # planar so every interleaved key — scan_k=1 included — stays
+    # byte-identical to the pre-planar schedule
+    planar = layout == "planar"
+    key_suffix = ("planar",) if planar else ()
 
     def _key(ab):
         a, b = ab
@@ -1044,7 +1082,7 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
         # have salvage-dropped rows (and exact per-block quantize scales)
         # a non-resilient run sharing the cache must not be served
         return (reader_fp, tuple(frames[a:b]), bs, quantize, sel_fp,
-                xform_fp, delta_anchors, validate)
+                xform_fp, delta_anchors, validate) + key_suffix
 
     def _host_stage(batch_frames):
         """Pure host side of one batch: read+gather (+quantize) + pad.
@@ -1084,12 +1122,24 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
         # check (the fused decode→gather fast path is kept; only its
         # in-C quantize leg is deferred)
         q_fused = None if validate else q_inline
+        # planar staging rides the SAME fused read leg — the reader
+        # repacks its already-quantized bytes to (3, b, S) planes
+        # behind the staging boundary (io/base.planar_repack); the
+        # f32 legs below stay interleaved (salvage/fault sites see the
+        # layout they always did) and quantize_block repacks instead
+        staged_planar = False
         if contiguous and stage is not None:
             # fused native gather(+quantize); see stage selection above
             with _spans.span("read", n_frames=len(batch_frames)):
-                block, boxes, inv_scale = stage(
-                    batch_frames[0], batch_frames[-1] + 1, sel_idx,
-                    q_fused)
+                if planar and q_fused:
+                    block, boxes, inv_scale = stage(
+                        batch_frames[0], batch_frames[-1] + 1, sel_idx,
+                        q_fused, layout="planar")
+                    staged_planar = True
+                else:
+                    block, boxes, inv_scale = stage(
+                        batch_frames[0], batch_frames[-1] + 1, sel_idx,
+                        q_fused)
         else:
             with _spans.span("read", n_frames=len(batch_frames)):
                 block, boxes = _stage(reader, batch_frames, sel_idx)
@@ -1102,10 +1152,14 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
                 reader, sel_idx, batch_frames, block, boxes,
                 series=fold is None)
         if q_inline and inv_scale is None:
-            block, inv_scale = quantize_block(block, q_inline)
+            block, inv_scale = quantize_block(block, q_inline,
+                                              layout=layout)
+            staged_planar = planar
+        n_frames_staged = block.shape[1 if staged_planar else 0]
         if boxes is None:
-            boxes = np.zeros((block.shape[0], 6), dtype=np.float32)
-        padded, mask = pad_batch(block, pad_to)
+            boxes = np.zeros((n_frames_staged, 6), dtype=np.float32)
+        padded, mask = pad_batch(block, pad_to,
+                                 axis=1 if staged_planar else 0)
         boxes_p, _ = pad_batch(np.ascontiguousarray(boxes, np.float32),
                                pad_to)
         if quantize == "delta":
@@ -1216,12 +1270,18 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
             return _host_stage(batch_frames)
         return rt.op("stage", lambda: _host_stage(batch_frames))
 
+    def _note_fused_blocks(n: int):
+        if engine == "fused":
+            from mdanalysis_mpi_tpu import obs
+
+            obs.METRICS.inc("mdtpu_fused_blocks_total", n)
+
     def consume(staged):
         nonlocal total
         # continuous-profiler dispatch latency (obs/prof.py): one
         # perf_counter pair per dispatch, only while sampling is on
         _pt0 = _time.perf_counter() if _prof.enabled() else None
-        with TIMERS.phase("dispatch", scan_k=1):
+        with TIMERS.phase("dispatch", scan_k=1, engine=engine):
 
             def _dispatch():
                 if _faults.plans():
@@ -1238,9 +1298,10 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
                 parts_list.append(out)
             else:
                 total = out
+        _note_fused_blocks(1)
         if _pt0 is not None:
             _prof.note_dispatch((_time.perf_counter() - _pt0) * 1e3,
-                                geometry=f"bs{bs}_scan1")
+                                geometry=f"bs{bs}_scan1", engine=engine)
 
     # ---- scan-folded dispatch bookkeeping (scan_active only) ----
     #
@@ -1260,7 +1321,8 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
             # group length: a scan superblock must never be served to a
             # differently-grouped schedule (or to the per-block one)
             return (reader_fp, tuple(frames[a:b]), bs, quantize, sel_fp,
-                    xform_fp, delta_anchors, validate, "scan", len(g))
+                    xform_fp, delta_anchors, validate, "scan",
+                    len(g)) + key_suffix
 
         group_keys = [_group_key(g) for g in groups]
         group_hits = [cache.get(k) if cache is not None else None
@@ -1279,7 +1341,7 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
             # span tag: this single dispatch covers a K-block scan
             # group (the dispatch-count shrink docs/DISPATCH.md claims)
             with TIMERS.phase("dispatch", scan_k=scan_k,
-                              blocks=n_blocks):
+                              blocks=n_blocks, engine=engine):
 
                 def _dispatch():
                     if _faults.plans():
@@ -1296,13 +1358,14 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
                     parts_list.append(out)
                 else:
                     total = out
+            _note_fused_blocks(n_blocks)
             if _pt0 is not None:
                 # program geometry = batch size × scan group length
                 # (the jitted scan shape — the uneven tail group is
                 # its own program and labels itself)
                 _prof.note_dispatch(
                     (_time.perf_counter() - _pt0) * 1e3,
-                    geometry=f"bs{bs}_scan{n_blocks}")
+                    geometry=f"bs{bs}_scan{n_blocks}", engine=engine)
 
         def _flush_hits_before(gi_limit):
             """Consume, in order, every not-yet-consumed HIT group that
@@ -1582,7 +1645,8 @@ class JaxExecutor:
                  block_cache: DeviceBlockCache | None = None,
                  transfer_dtype: str = "float32",
                  prestage: bool = False, reliability=None,
-                 scan_k: "int | str | None" = None):
+                 scan_k: "int | str | None" = None,
+                 use_quantized_native: bool = True):
         _validate_transfer_dtype(transfer_dtype)
         self.batch_size = batch_size
         self.device = device
@@ -1594,18 +1658,31 @@ class JaxExecutor:
         # scan-folded dispatch group size: int, "auto" or None (None
         # defers to env MDTPU_SCAN_K, default auto — docs/DISPATCH.md)
         self.scan_k = scan_k
+        # False pins the generic dequant+align schedule even when the
+        # analysis registers a fused quantized-native program — the
+        # degradation chain's fused → generic rung (reliability/
+        # policy.py degradation_chain)
+        self.use_quantized_native = use_quantized_native
         if reliability is not None:
             self.reliability = reliability
 
     def _setup(self, analysis, reader):
         """Kernel/params/selection resolution shared by ``execute``,
         ``warmup`` and ``stage`` — one site, so the three paths cannot
-        disagree about what gets staged or dispatched."""
+        disagree about what gets staged or dispatched.  The trailing
+        (layout, engine) pair carries the quantized-native kernel's
+        staging form ('planar' for the fused Pallas path — the kernel
+        declares it via ``staging_layout``) and the dispatch engine
+        label ('fused' whenever a quantized-native program replaces
+        the generic dequant wrapper)."""
         quantize = _quant_mode(self.transfer_dtype)
-        qn = _quantized_native(analysis, self.transfer_dtype)
+        qn = (_quantized_native(analysis, self.transfer_dtype)
+              if self.use_quantized_native else None)
         if qn is not None:
             wrapped, params, sel_idx = qn
             base_fn = wrapped
+            layout = getattr(wrapped, "staging_layout", "interleaved")
+            engine = "fused"
         else:
             base_fn = analysis._batch_fn()
             if self.transfer_dtype == "delta":
@@ -1617,7 +1694,8 @@ class JaxExecutor:
             params, sel_idx = _wrap_for_transfer(
                 analysis._batch_params(), analysis._batch_select(),
                 reader.n_atoms, self.transfer_dtype)
-        return wrapped, base_fn, params, sel_idx, quantize
+            layout, engine = "interleaved", "generic"
+        return wrapped, base_fn, params, sel_idx, quantize, layout, engine
 
     def _scan_group_sizes(self, scan_k: int, n_blocks: int):
         """(init_sizes, fused_sizes): the distinct stacked-group shapes
@@ -1649,8 +1727,8 @@ class JaxExecutor:
             return 0
         bs = batch_size or self.batch_size
         try:
-            wrapped, base_fn, params, sel_idx, quantize = self._setup(
-                analysis, reader)
+            (wrapped, base_fn, params, sel_idx, quantize, layout,
+             _engine) = self._setup(analysis, reader)
         except NotImplementedError:
             return 0          # serial-only analysis: nothing to compile
         kernel = _jit_kernel(wrapped)
@@ -1660,7 +1738,7 @@ class JaxExecutor:
         if n_blocks == 0:
             return 0
         n_stage = reader.n_atoms if sel_idx is None else len(sel_idx)
-        avals = _staged_avals(bs, n_stage, quantize)
+        avals = _staged_avals(bs, n_stage, quantize, layout=layout)
         td = self.transfer_dtype
         n = 0
         if _cc.aot_compile(_op_label(base_fn, td, "jax", "kernel"),
@@ -1710,8 +1788,8 @@ class JaxExecutor:
             return 0
         bs = batch_size or self.batch_size
         try:
-            _w, _b, _params, sel_idx, quantize = self._setup(analysis,
-                                                             reader)
+            (_w, _b, _params, sel_idx, quantize, layout,
+             engine) = self._setup(analysis, reader)
         except NotImplementedError:
             return 0          # serial-only analysis: nothing to stage
         frames = list(frames)
@@ -1728,7 +1806,7 @@ class JaxExecutor:
             analysis, reader, frames, bs, None, sel_idx,
             device_put_fn=put, cache=self.block_cache, quantize=quantize,
             reliability=self.reliability, scan_k=scan_k,
-            stage_only=True)
+            stage_only=True, layout=layout, engine=engine)
 
     def execute(self, analysis, reader, frames, batch_size=None):
         import jax
@@ -1738,8 +1816,8 @@ class JaxExecutor:
                 f"{type(analysis).__name__} uses an atom-sharded ring "
                 "kernel (mesh collectives); run it with backend='mesh'")
         bs = batch_size or self.batch_size
-        wrapped, base_fn, params, sel_idx, quantize = self._setup(
-            analysis, reader)
+        (wrapped, base_fn, params, sel_idx, quantize, layout,
+         engine) = self._setup(analysis, reader)
         kernel = _jit_kernel(wrapped)
         fold = analysis._device_fold_fn
         step = _fused_step(wrapped, fold) if fold is not None else None
@@ -1768,7 +1846,7 @@ class JaxExecutor:
             td = self.transfer_dtype
             n_stage = (reader.n_atoms if sel_idx is None
                        else len(sel_idx))
-            avals = _staged_avals(bs, n_stage, quantize)
+            avals = _staged_avals(bs, n_stage, quantize, layout=layout)
             bound = False
             comp_k = _cc.aot_get(_cc.aot_key(
                 _op_label(base_fn, td, "jax", "kernel"),
@@ -1821,7 +1899,7 @@ class JaxExecutor:
             device_put_fn=put, cache=self.block_cache, quantize=quantize,
             prestage=self.prestage, reliability=self.reliability,
             scan_k=scan_k, scan_calls=scan_calls,
-            fused_call=fused_call)
+            fused_call=fused_call, layout=layout, engine=engine)
 
     @staticmethod
     def _bind_aot_scan(scan_calls: "_ScanCalls", comp_scan: dict,
@@ -2118,7 +2196,7 @@ class MeshExecutor:
             return 0
         bs = batch_size or self.batch_size
         try:
-            qn = (_quantized_native(analysis, self.transfer_dtype)
+            qn = (_mesh_quantized_native(analysis, self.transfer_dtype)
                   if analysis._batch_specs(self.axis_name) is None
                   else None)
             bs_factor, gfn, shardings, params_specs, gfn_fused = \
@@ -2181,7 +2259,7 @@ class MeshExecutor:
             return 0
         bs = batch_size or self.batch_size
         try:
-            qn = (_quantized_native(analysis, self.transfer_dtype)
+            qn = (_mesh_quantized_native(analysis, self.transfer_dtype)
                   if analysis._batch_specs(self.axis_name) is None
                   else None)
             bs_factor, _gfn, shardings, params_specs, _gf = self._build(
@@ -2224,7 +2302,7 @@ class MeshExecutor:
         import jax
 
         bs = batch_size or self.batch_size
-        qn = (_quantized_native(analysis, self.transfer_dtype)
+        qn = (_mesh_quantized_native(analysis, self.transfer_dtype)
               if analysis._batch_specs(self.axis_name) is None else None)
         bs_factor, gfn, shardings, params_specs, gfn_fused = self._build(
             analysis, qn_fn=qn[0] if qn is not None else None)
@@ -2277,6 +2355,7 @@ class MeshExecutor:
                 local_divisor=n_proc, local_index=jax.process_index(),
                 inv_per_frame=True, prestage=self.prestage,
                 fused_call=fused_call, reliability=self.reliability,
+                engine="fused" if qn is not None else "generic",
                 # delta at N controllers: each process quantizes its
                 # OWN slice with one anchor per LOCAL device; the
                 # (A, 1, 1) inv_abs shards with the keyframes, so no
@@ -2323,6 +2402,7 @@ class MeshExecutor:
             prestage=self.prestage, fused_call=fused_call,
             reliability=self.reliability,
             scan_k=scan_k, scan_calls=scan_calls,
+            engine="fused" if qn is not None else "generic",
             # delta: one absolute anchor per device shard (see _build)
             delta_anchors=(bs_factor if self.transfer_dtype == "delta"
                            else 1))

@@ -128,13 +128,15 @@ def iter_batches(start: int, stop: int, batch_size: int):
         a = b
 
 
-def pad_batch(batch: np.ndarray, batch_size: int):
-    """Pad a (b, ...) frame batch to ``batch_size`` along axis 0 and
+def pad_batch(batch: np.ndarray, batch_size: int, axis: int = 0):
+    """Pad a frame batch to ``batch_size`` along the frame ``axis`` and
     return (padded, mask) where mask is float32 (batch_size,) with 1.0
     for real frames.  Static shapes for XLA (SURVEY.md §7 hard parts);
     padding rows repeat the last frame (any finite values — the mask
-    zeroes their contribution)."""
-    b = batch.shape[0]
+    zeroes their contribution).  ``axis=1`` serves the planar staged
+    layout (3, b, S), whose frame axis sits behind the component
+    planes."""
+    b = batch.shape[axis]
     if b > batch_size:
         raise ValueError(f"batch of {b} frames exceeds batch_size {batch_size}")
     mask = np.zeros(batch_size, dtype=np.float32)
@@ -142,8 +144,10 @@ def pad_batch(batch: np.ndarray, batch_size: int):
     if b == batch_size:
         return batch, mask
     if b == 0:
-        pad = np.zeros((batch_size,) + batch.shape[1:], dtype=batch.dtype)
-        return pad, mask
+        shape = list(batch.shape)
+        shape[axis] = batch_size
+        return np.zeros(tuple(shape), dtype=batch.dtype), mask
+    last = np.take(batch, [-1], axis=axis)
     pad = np.concatenate(
-        [batch, np.repeat(batch[-1:], batch_size - b, axis=0)], axis=0)
+        [batch, np.repeat(last, batch_size - b, axis=axis)], axis=axis)
     return pad, mask

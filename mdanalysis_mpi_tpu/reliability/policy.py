@@ -520,6 +520,21 @@ def degradation_chain(base, runtime: ReliabilityRuntime):
                                  prestage=base.prestage,
                                  scan_k=base.scan_k,
                                  reliability=runtime))
+    if (isinstance(base, JaxExecutor)
+            and base.transfer_dtype in ("int16", "int8", "delta")
+            and getattr(base, "use_quantized_native", True)):
+        # fused → generic: a kernel fault inside a fused
+        # quantized-native program (the planar Pallas kernel or its
+        # XLA form, ops/pallas_fused.py) demotes to the stock
+        # dequant+align schedule on the same device before giving up
+        # the device entirely — the fused program is the most likely
+        # thing to be wrong on exotic hardware, not the device
+        chain.append(JaxExecutor(batch_size=base.batch_size,
+                                 transfer_dtype=base.transfer_dtype,
+                                 prestage=base.prestage,
+                                 scan_k=base.scan_k,
+                                 use_quantized_native=False,
+                                 reliability=runtime))
     if not isinstance(base, SerialExecutor):
         chain.append(SerialExecutor(reliability=runtime))
     return chain

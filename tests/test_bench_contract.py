@@ -153,6 +153,13 @@ PINNED_METRICS = {
     "mdtpu_prof_rss_bytes": "gauge",
     "mdtpu_prof_rss_peak_bytes": "gauge",
     "mdtpu_dispatch_ms": "histogram",
+    # fused quantized-native kernel path (ops/pallas_fused.py +
+    # docs/DISPATCH.md): blocks dispatched through a fused program,
+    # host planar repacks at the staging boundary, and trace-time
+    # fallbacks to the generic schedule — zero-injected everywhere else
+    "mdtpu_fused_blocks_total": "counter",
+    "mdtpu_fused_planar_repacks_total": "counter",
+    "mdtpu_fused_fallbacks_total": "counter",
     # alerting (obs/alerts.py): per-rule firing level and the
     # firing/resolved transition counter, recorded live at each
     # transition — zero-injected everywhere else
@@ -351,6 +358,20 @@ def test_bench_json_contract(tmp_path):
                     "ensemble_parity_max_err", "ensemble_dedup_ratio",
                     "ensemble_replica_pair_rmsd",
                     "ensemble_trajectories_per_s", "ensemble_speedup",
+                    # r18: fused planar sub-leg (ops/pallas_fused.py
+                    # + docs/DISPATCH.md "Fused engine") — host half
+                    # (planar-vs-interleaved staging fps + the
+                    # CPU-subprocess interpret parity gate) survives
+                    # the outage protocol; the on-chip A/B fields are
+                    # null in a tunnel-down artifact by construction
+                    "fused_planar_stage_fps",
+                    "fused_interleaved_stage_fps",
+                    "fused_stage_overhead_pct",
+                    "fused_interpret_parity",
+                    "fused_interpret_divergence",
+                    "fused_steady_value",
+                    "fused_generic_steady_value",
+                    "fused_vs_generic", "fused_engine",
                     # r9: observability — the host-leg tracing-on/off
                     # delta and the unified metrics block
                     # (docs/OBSERVABILITY.md)
@@ -387,6 +408,10 @@ def test_bench_json_contract(tmp_path):
         from mdanalysis_mpi_tpu.obs import baseline as _baseline
 
         base = _baseline.snapshot_baseline(rec)
+        # the sentinel tracks the fused legs: a baseline snapshotted
+        # from any artifact carrying them gates future regressions
+        assert "fused_planar_stage_fps" in base["legs"]
+        assert "fused_steady_value" in base["legs"]
         cmp_res = _baseline.compare(rec, base)
         assert cmp_res["fingerprint_match"] is True
         assert cmp_res["regressed"] == [] and cmp_res["ok"] is True
@@ -420,6 +445,21 @@ def test_bench_json_contract(tmp_path):
         assert 0 < rec["serving_accel_cache_hit_rate"] <= 1
         assert rec["serving_accel_coalesce_rate"] == 1.0
         assert "serving_accel" in rec["accel_leg_order"]
+        # r18: fused planar sub-leg — both staging layouts measured,
+        # the interpret parity matrix passed in the CPU subprocess,
+        # and (accelerator up on this CPU run) the A/B leg filled the
+        # on-chip fields: fused blocks really dispatched, the XLA
+        # fused form active (MDTPU_RMSF_PALLAS unset here)
+        assert rec["fused_planar_stage_fps"] > 0
+        assert rec["fused_interleaved_stage_fps"] > 0
+        assert rec["fused_interpret_parity"] == "PASS"
+        assert 0 <= rec["fused_interpret_divergence"] <= 5e-3
+        assert rec["fused_steady_value"] > 0
+        assert rec["fused_generic_steady_value"] > 0
+        assert rec["fused_vs_generic"] > 0
+        assert rec["fused_engine"] == "xla"
+        assert rec["fused_blocks_dispatched"] > 0
+        assert "fused_ab" in rec["accel_leg_order"]
         # store sub-leg: the ingest and the store read both ran, the
         # store read is parity-gated against the file-reader oracle
         # at the staging-dtype bar, no chunk failed its read-time
@@ -597,6 +637,17 @@ def test_bench_outage_records_host_legs(tmp_path):
         # artifact still records the ingest/read rates and parity
         assert rec["store_read_fps"] > 0
         assert rec["store_parity"] == "PASS"
+        # r18: the fused sub-leg's host half survives the outage —
+        # planar staging fps recorded, the interpret parity gate still
+        # holds (its CPU-jax subprocess sanitizes XLA_FLAGS/
+        # JAX_PLATFORMS, so no tunnel is needed), and the on-chip A/B
+        # fields are null by construction, never fabricated
+        assert rec["fused_planar_stage_fps"] > 0
+        assert rec["fused_interleaved_stage_fps"] > 0
+        assert rec["fused_interpret_parity"] == "PASS"
+        assert rec["fused_steady_value"] is None
+        assert rec["fused_vs_generic"] is None
+        assert rec["fused_engine"] is None
         # r16: the remote chunk-tier sub-leg is host-side too — the
         # dedup/cache/outage record survives a tunnel-down artifact
         assert rec["remote_store_read_fps"] > 0

@@ -466,7 +466,7 @@ def test_mdt110_real_mesh_scan_program_clean():
     notes = []
     findings = jaxcontracts.check_lowered_programs(notes)
     assert findings == []
-    assert any("3 programs" in n for n in notes)
+    assert any("4 programs" in n for n in notes)
 
 
 def test_mdt111_captured_constant_budget():

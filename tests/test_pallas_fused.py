@@ -1,0 +1,341 @@
+"""Planar-layout fused Pallas hot loop (ops/pallas_fused.py +
+docs/DISPATCH.md).
+
+The interpret-mode parity matrix for the one-pass dequant + QCP align +
+moment kernel: every quantized tier (int16 / int8 / delta / the f32
+fallback), uneven frame tails, padded selections, and the scan-fold
+dispatch at scan_k ∈ {1, 2, all} — each gated against the generic
+dequant→align→reduce schedule on the SAME staged bytes within the
+existing divergence gates (tests/test_pallas_rmsf.py).  Plus the
+store→stage→kernel leg proving the staged blocks never materialize
+host float32 (counter- and cache-asserted), the bit-identity contracts
+(scan_k=1 degeneration; the MDTPU_RMSF_PALLAS flag leaving the generic
+engine untouched), and the fused→generic→serial degradation chain on a
+persistent kernel fault.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+import mdanalysis_mpi_tpu.parallel.executors as ex  # noqa: E402
+from mdanalysis_mpi_tpu.analysis import AlignedRMSF  # noqa: E402
+from mdanalysis_mpi_tpu.core.topology import Topology  # noqa: E402
+from mdanalysis_mpi_tpu.core.universe import Universe  # noqa: E402
+from mdanalysis_mpi_tpu.io.base import planar_repack  # noqa: E402
+from mdanalysis_mpi_tpu.io.memory import MemoryReader  # noqa: E402
+from mdanalysis_mpi_tpu.io.store import ingest  # noqa: E402
+from mdanalysis_mpi_tpu.obs import METRICS  # noqa: E402
+from mdanalysis_mpi_tpu.ops import pallas_fused as pf  # noqa: E402
+from mdanalysis_mpi_tpu.ops import pallas_rmsf as pr  # noqa: E402
+from mdanalysis_mpi_tpu.parallel.executors import (  # noqa: E402
+    DeviceBlockCache, JaxExecutor, quantize_block, quantize_block_delta)
+from mdanalysis_mpi_tpu.reliability import faults  # noqa: E402
+from mdanalysis_mpi_tpu.reliability.faults import FaultSpec  # noqa: E402
+from mdanalysis_mpi_tpu.reliability.policy import (  # noqa: E402
+    ReliabilityPolicy, ReliabilityRuntime, degradation_chain)
+from mdanalysis_mpi_tpu.testing import make_protein_universe  # noqa: E402
+
+
+def _counter(name: str) -> float:
+    return sum(METRICS.snapshot().get(
+        name, {"values": {}})["values"].values())
+
+
+@pytest.fixture
+def pallas_env(monkeypatch):
+    monkeypatch.setenv("MDTPU_RMSF_PALLAS", "1")
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_faults():
+    yield
+    faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# kernel-level parity matrix (interpret mode vs the interleaved XLA core)
+# ---------------------------------------------------------------------------
+
+def _planar_case(B, n_real, dtype="int16", seed=0, valid_b=None):
+    """Rigid-rotated reference + noise, staged both interleaved and
+    planar: (params, q, qp, inv, mask, n_real)."""
+    r = np.random.default_rng(seed)
+    idx = np.arange(n_real)
+    pidx, nr = pr.pad_selection(idx)
+    S = pidx.shape[0]
+    refc = r.normal(size=(n_real, 3)).astype(np.float64) * 4
+    refc -= refc.mean(axis=0)
+    masses = r.uniform(1, 12, size=n_real)
+    params = pr.build_params(
+        jnp.asarray(refc, jnp.float32),
+        jnp.asarray(refc.mean(axis=0), jnp.float32),
+        jnp.asarray(masses, jnp.float32), nr, S)
+    coords = np.zeros((B, S, 3), np.float64)
+    for b in range(B):
+        A = r.normal(size=(3, 3))
+        U, _, Vt = np.linalg.svd(A)
+        if np.linalg.det(U @ Vt) < 0:
+            U[:, -1] *= -1
+        coords[b] = (refc @ (U @ Vt).T
+                     + r.normal(size=(n_real, 3)) * 0.3
+                     + r.normal(size=3) * 10)[pidx]
+    q, inv = quantize_block(coords.astype(np.float32), dtype)
+    mask = np.zeros(B, np.float32)
+    mask[:B if valid_b is None else valid_b] = 1.0
+    return params, q, planar_repack(q), np.float32(inv), mask, nr
+
+
+@pytest.mark.parametrize("B,n_real,dtype,valid_b", [
+    (16, 100, "int16", None),      # one tile
+    (32, 250, "int16", 30),        # two tiles, masked tail frames
+    (32, 250, "int8", None),       # int8 tier (bt = 32)
+    (48, 511, "int16", 47),        # 3 tiles, S = 512, uneven tail
+    (16, 256, "int16", None),      # exact-width selection (no padding)
+])
+def test_planar_interpret_matches_interleaved_xla(B, n_real, dtype,
+                                                  valid_b):
+    params, q, qp, inv, mask, nr = _planar_case(
+        B, n_real, dtype, seed=B + n_real, valid_b=valid_b)
+    t_x, mean_x, m2_x = pr.moments_kernel_for("xla", nr)(
+        params, jnp.asarray(q), inv, None, jnp.asarray(mask))
+    t_p, mean_p, m2_p = pf.moments_kernel_for("interpret", nr)(
+        params, jnp.asarray(qp), inv, None, jnp.asarray(mask))
+    assert float(t_x) == float(t_p)
+    np.testing.assert_allclose(np.asarray(mean_p), np.asarray(mean_x),
+                               atol=5e-4)
+    np.testing.assert_allclose(np.asarray(m2_p), np.asarray(m2_x),
+                               atol=5e-3)
+    # pass-1 average kernel, same staged planes
+    t_ax, s_ax = pr.avg_kernel_for("xla", nr)(
+        params, jnp.asarray(q), inv, None, jnp.asarray(mask))
+    t_ap, s_ap = pf.avg_kernel_for("interpret", nr)(
+        params, jnp.asarray(qp), inv, None, jnp.asarray(mask))
+    assert float(t_ax) == float(t_ap)
+    np.testing.assert_allclose(np.asarray(s_ap), np.asarray(s_ax),
+                               atol=5e-3)
+
+
+def test_shape_ineligible_planar_falls_back_counted():
+    """B=8 has no int16 frame tile (needs a multiple of 16): the same
+    planar block runs the XLA form, counted — and still exact."""
+    params, q, qp, inv, mask, nr = _planar_case(8, 37, "int16", seed=4)
+    c0 = _counter("mdtpu_fused_fallbacks_total")
+    t_x, mean_x, m2_x = pr.moments_kernel_for("xla", nr)(
+        params, jnp.asarray(q), inv, None, jnp.asarray(mask))
+    t_p, mean_p, m2_p = pf.moments_kernel_for("interpret", nr)(
+        params, jnp.asarray(qp), inv, None, jnp.asarray(mask))
+    assert _counter("mdtpu_fused_fallbacks_total") > c0
+    assert float(t_x) == float(t_p)
+    np.testing.assert_array_equal(np.asarray(mean_p), np.asarray(mean_x))
+    np.testing.assert_array_equal(np.asarray(m2_p), np.asarray(m2_x))
+
+
+def test_delta_kernel_interpret_matches_xla_form():
+    """The delta tier: device-side DPCM reconstruction feeding the
+    planar sweep (interpret) vs the same reconstruction feeding the
+    interleaved XLA core."""
+    params, _, _, _, mask, nr = _planar_case(16, 100, "int16", seed=9)
+    r = np.random.default_rng(9)
+    block = r.normal(scale=8.0, size=(16, 256, 3)).astype(np.float32)
+    res, dkey, inv_abs, inv_res = quantize_block_delta(block, 1)
+    args = (jnp.asarray(res), jnp.asarray(dkey), inv_abs, inv_res, None,
+            jnp.asarray(mask))
+    t_x, mean_x, m2_x = pf.moments_delta_kernel_for("xla", nr)(
+        params, *args)
+    t_p, mean_p, m2_p = pf.moments_delta_kernel_for("interpret", nr)(
+        params, *args)
+    assert float(t_x) == float(t_p)
+    np.testing.assert_allclose(np.asarray(mean_p), np.asarray(mean_x),
+                               atol=5e-4)
+    np.testing.assert_allclose(np.asarray(m2_p), np.asarray(m2_x),
+                               atol=5e-3)
+    t_ax, s_ax = pf.avg_delta_kernel_for("xla", nr)(params, *args)
+    t_ap, s_ap = pf.avg_delta_kernel_for("interpret", nr)(params, *args)
+    assert float(t_ax) == float(t_ap)
+    np.testing.assert_allclose(np.asarray(s_ap), np.asarray(s_ax),
+                               atol=5e-3)
+
+
+def test_planar_repack_layout_and_counter():
+    q = np.arange(24, dtype=np.int16).reshape(2, 4, 3)
+    c0 = _counter("mdtpu_fused_planar_repacks_total")
+    p = planar_repack(q)
+    assert p.shape == (3, 2, 4) and p.flags["C_CONTIGUOUS"]
+    for i in range(3):
+        np.testing.assert_array_equal(p[i], q[:, :, i])
+    assert _counter("mdtpu_fused_planar_repacks_total") == c0 + 1
+
+
+# ---------------------------------------------------------------------------
+# e2e: scan-fold dispatch × quantized tiers under MDTPU_RMSF_PALLAS=1
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def u56():
+    # 56 frames / batch 16 → 4 blocks (last short, mask-padded): tail
+    # coverage both at the block level and inside the scan groups
+    return make_protein_universe(n_residues=16, n_frames=56, noise=0.2)
+
+
+@pytest.fixture(scope="module")
+def oracle56(u56):
+    return AlignedRMSF(u56, select="name CA").run(backend="serial")
+
+
+@pytest.mark.parametrize("dtype,scan_k,want_k", [
+    ("int16", 1, 1),
+    ("int16", 2, 2),
+    ("int16", "auto", 4),
+    ("int8", 2, 2),      # B=16 has no int8 tile → planar-XLA fused form
+    ("delta", 2, 2),
+])
+def test_e2e_fused_scan_matrix(pallas_env, u56, oracle56, dtype, scan_k,
+                               want_k):
+    blocks0 = _counter("mdtpu_fused_blocks_total")
+    exe = JaxExecutor(batch_size=16, block_cache=DeviceBlockCache(),
+                      transfer_dtype=dtype, scan_k=scan_k)
+    fused = AlignedRMSF(u56, select="name CA", engine="fused").run(
+        backend=exe)
+    assert ex.LAST_SCAN_K == want_k
+    assert _counter("mdtpu_fused_blocks_total") > blocks0
+    generic = AlignedRMSF(u56, select="name CA").run(
+        backend="jax", batch_size=16, transfer_dtype=dtype)
+    # fused vs the generic schedule on the same wire format: kernel
+    # divergence only (the tier's own quantization error cancels).
+    # delta's DPCM-reconstructed coordinates sit further from the
+    # reference, where the in-kernel QCP rotation and the SVD Kabsch
+    # diverge more — amplified across the Chan fold
+    np.testing.assert_allclose(np.asarray(fused.results.rmsf),
+                               np.asarray(generic.results.rmsf),
+                               atol=5e-3 if dtype == "delta" else 5e-4)
+    atol = 5e-2 if dtype in ("int8", "delta") else 1e-3
+    np.testing.assert_allclose(np.asarray(fused.results.rmsf),
+                               oracle56.results.rmsf, atol=atol)
+
+
+def test_e2e_fused_f32_fallback_under_pallas_env(pallas_env, u56,
+                                                 oracle56):
+    """engine='fused' + float32 staging keeps the generic program even
+    with the Pallas flag on (the planar path is quantized-native)."""
+    r = AlignedRMSF(u56, select="name CA", engine="fused").run(
+        backend="jax", batch_size=16)
+    np.testing.assert_allclose(np.asarray(r.results.rmsf),
+                               oracle56.results.rmsf, atol=1e-3)
+
+
+def test_scan_k1_bit_identical_to_per_block_fused(pallas_env, u56):
+    """scan_k=1 under the fused engine IS the per-block schedule: same
+    staged planes, same kernel — bitwise-equal to a cacheless run."""
+    plain = AlignedRMSF(u56, select="name CA", engine="fused").run(
+        backend="jax", batch_size=16, transfer_dtype="int16",
+        block_cache=None)
+    k1 = AlignedRMSF(u56, select="name CA", engine="fused").run(
+        backend=JaxExecutor(batch_size=16, transfer_dtype="int16",
+                            block_cache=DeviceBlockCache(), scan_k=1))
+    assert ex.LAST_SCAN_K == 1
+    np.testing.assert_array_equal(np.asarray(plain.results.rmsf),
+                                  np.asarray(k1.results.rmsf))
+
+
+def test_pallas_flag_leaves_generic_engine_bit_identical(u56,
+                                                         monkeypatch):
+    """MDTPU_RMSF_PALLAS only routes the FUSED engine; a generic run
+    must produce bit-identical results with the flag on and off."""
+    monkeypatch.delenv("MDTPU_RMSF_PALLAS", raising=False)
+    off = AlignedRMSF(u56, select="name CA").run(
+        backend="jax", batch_size=16, transfer_dtype="int16")
+    monkeypatch.setenv("MDTPU_RMSF_PALLAS", "1")
+    on = AlignedRMSF(u56, select="name CA").run(
+        backend="jax", batch_size=16, transfer_dtype="int16")
+    np.testing.assert_array_equal(np.asarray(off.results.rmsf),
+                                  np.asarray(on.results.rmsf))
+
+
+# ---------------------------------------------------------------------------
+# store → stage → kernel: zero host-f32 materialization
+# ---------------------------------------------------------------------------
+
+def _topology(n_atoms):
+    names = np.tile(np.array(["CA", "HA"]), n_atoms // 2 + 1)[:n_atoms]
+    return Topology(names=names, resnames=np.full(n_atoms, "ALA"),
+                    resids=np.arange(n_atoms) // 2 + 1)
+
+
+def test_store_to_kernel_stages_planar_without_host_f32(tmp_path,
+                                                        pallas_env):
+    """The whole tentpole data path: int16 store chunks → raw-slice
+    planar staging → HBM → fused kernel.  The StoreReader's f32 decode
+    cache must stay empty apart from the analysis's single reference-
+    frame read (chunk 0) — no staged block ever decodes to host
+    float32 — while the chunk-read, planar-repack and fused-block
+    counters all advance."""
+    rng = np.random.default_rng(11)
+    base = rng.normal(scale=12.0, size=(60, 3)).astype(np.float32)
+    frames = base[None] + rng.normal(
+        scale=0.4, size=(48, 60, 3)).astype(np.float32)
+    out = str(tmp_path / "store16")
+    ingest(MemoryReader(frames), out, chunk_frames=16, quant="int16")
+    topo = _topology(60)
+    u = Universe(topo, out)
+    sr = u.trajectory
+    chunks0 = _counter("mdtpu_store_chunks_read_total")
+    repacks0 = _counter("mdtpu_fused_planar_repacks_total")
+    blocks0 = _counter("mdtpu_fused_blocks_total")
+    r = AlignedRMSF(u, select="name CA", engine="fused").run(
+        backend="jax", batch_size=16, transfer_dtype="int16")
+    # staged blocks rode the raw quantized fast path: chunk reads
+    # advanced, planes were repacked, the fused program consumed them —
+    # and the only f32 decode is the reference frame's chunk
+    assert _counter("mdtpu_store_chunks_read_total") >= chunks0 + 3
+    assert _counter("mdtpu_fused_planar_repacks_total") > repacks0
+    assert _counter("mdtpu_fused_blocks_total") > blocks0
+    assert set(sr._f32) <= {0}, (
+        f"staged blocks decoded host f32 chunks {sorted(sr._f32)}")
+    # parity vs the serial oracle on the SOURCE frames (gate covers the
+    # store's int16 codec error)
+    u_mem = Universe(topo, MemoryReader(frames))
+    oracle = AlignedRMSF(u_mem, select="name CA").run(backend="serial")
+    np.testing.assert_allclose(np.asarray(r.results.rmsf),
+                               oracle.results.rmsf, atol=1e-2)
+
+
+# ---------------------------------------------------------------------------
+# degradation: fused → generic → serial
+# ---------------------------------------------------------------------------
+
+def test_degradation_chain_inserts_generic_rung():
+    rt = ReliabilityRuntime(ReliabilityPolicy(checkpoint=False))
+    chain = degradation_chain(
+        JaxExecutor(batch_size=8, transfer_dtype="int16"), rt)
+    assert [type(e).__name__ for e in chain] == [
+        "JaxExecutor", "JaxExecutor", "SerialExecutor"]
+    assert chain[0].use_quantized_native
+    assert not chain[1].use_quantized_native
+    # a float32 base has no fused program to shed: straight to serial
+    chain_f32 = degradation_chain(
+        JaxExecutor(batch_size=8),
+        ReliabilityRuntime(ReliabilityPolicy(checkpoint=False)))
+    assert [type(e).__name__ for e in chain_f32] == [
+        "JaxExecutor", "SerialExecutor"]
+
+
+def test_fused_kernel_fault_completes_via_chain(pallas_env):
+    """Persistent kernel faults demote fused → generic → serial and
+    the run still completes against the oracle."""
+    u = make_protein_universe(n_residues=8, n_frames=24, noise=0.25,
+                              seed=3)
+    oracle = AlignedRMSF(u, select="name CA").run(backend="serial")
+    with faults.inject(FaultSpec("kernel", "raise", times=None)):
+        r = AlignedRMSF(u, select="name CA", engine="fused").run(
+            resilient=ReliabilityPolicy(backoff_s=0.001,
+                                        checkpoint=False),
+            backend="jax", batch_size=8, transfer_dtype="int16")
+    np.testing.assert_allclose(np.asarray(r.results.rmsf),
+                               oracle.results.rmsf, atol=1e-3)
+    hops = [(f, t) for f, t, _ in r.results.reliability["fallbacks"]]
+    # AlignedRMSF is two executor passes (average, then moments); each
+    # pass walks the full fused → generic → serial chain
+    assert hops == [("jax", "jax"), ("jax", "serial")] * 2

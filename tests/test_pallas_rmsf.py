@@ -268,11 +268,24 @@ def test_fused_wide_average_rejected():
     AverageStructure(u, select="name CA", select_only=True, engine="fused")
 
 
-def test_fused_rejects_int8_and_delta():
-    """engine='fused' with a wire format the fused kernels cannot
-    consume fails loudly instead of silently taking the unfused path."""
-    u = _rmsf_case(n_residues=5, n_frames=16)
+def test_e2e_fused_int8_and_delta():
+    """engine='fused' now consumes every quantized wire format — int8
+    and delta route to fused kernels (ops/pallas_fused.py delta
+    factories; int8 planar under MDTPU_RMSF_PALLAS) instead of the old
+    loud rejection.  int8's coarse quantization grid sets the gate."""
+    u = _rmsf_case(n_frames=32)
+    serial = AlignedRMSF(u, select="name CA").run(backend="serial")
     for dtype in ("int8", "delta"):
-        with pytest.raises(ValueError, match="fused"):
-            AlignedRMSF(u, select="name CA", engine="fused").run(
-                backend="jax", batch_size=16, transfer_dtype=dtype)
+        fused = AlignedRMSF(u, select="name CA", engine="fused").run(
+            backend="jax", batch_size=16, transfer_dtype=dtype)
+        generic = AlignedRMSF(u, select="name CA").run(
+            backend="jax", batch_size=16, transfer_dtype=dtype)
+        # the fused kernel reproduces the generic path on the SAME
+        # wire bytes tightly; the serial gap is the codec's own
+        # quantization error, identical for both paths
+        np.testing.assert_allclose(np.asarray(fused.results.rmsf),
+                                   np.asarray(generic.results.rmsf),
+                                   atol=5e-4, err_msg=dtype)
+        np.testing.assert_allclose(np.asarray(fused.results.rmsf),
+                                   serial.results.rmsf, atol=5e-2,
+                                   err_msg=dtype)

@@ -108,12 +108,29 @@ def test_note_dispatch_percentiles_and_histogram_per_geometry():
     assert stats["bs32_scan1"]["p50_ms"] == pytest.approx(3.0)
     assert stats["bs32_scan1"]["p99_ms"] == pytest.approx(100.0)
     assert stats["bs32_scan4"]["count"] == 1
-    # the live histogram is labeled by geometry with the ms buckets
+    # the live histogram is labeled by geometry + engine with the ms
+    # buckets (engine="generic" is the default dispatch program)
     snap = obs.unified_snapshot()["mdtpu_dispatch_ms"]
     assert snap["type"] == "histogram"
-    h = snap["values"]['geometry="bs32_scan1"']
+    h = snap["values"]['engine="generic",geometry="bs32_scan1"']
     assert h["count"] == 5
     assert h["buckets"]["5.0"] == 4              # 1..4 ms <= 5 ms
+
+
+def test_note_dispatch_fused_engine_keys_separately():
+    """A fused-program dispatch of the same geometry lands in its own
+    sample window (``geometry/engine``) and histogram series, so the
+    two programs' latency distributions never mix."""
+    oprof.enable(interval_s=10.0)
+    oprof.note_dispatch(2.0, geometry="bs32_scan1")
+    oprof.note_dispatch(4.0, geometry="bs32_scan1", engine="fused")
+    stats = oprof.dispatch_stats()
+    assert set(stats) == {"bs32_scan1", "bs32_scan1/fused"}
+    assert stats["bs32_scan1"]["count"] == 1
+    assert stats["bs32_scan1/fused"]["count"] == 1
+    snap = obs.unified_snapshot()["mdtpu_dispatch_ms"]
+    assert snap["values"]['engine="fused",geometry="bs32_scan1"'][
+        "count"] == 1
 
 
 def test_jax_dispatch_sites_record_geometry():
