@@ -1516,6 +1516,125 @@ def qos_serving_leg() -> dict:
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def streaming_host_leg() -> dict:
+    """Streaming-tier sub-leg (docs/STREAMING.md): a live writer
+    thread appends frames into an append-able store while a follow-
+    mode streaming tenant tails it through the in-process scheduler,
+    batch tenants sharing the same workers.  Three disclosures:
+
+    - live throughput + snapshot lag: frames reduced per second by
+      the streaming pass, and the max frames the feed was ahead of a
+      snapshot at its emit (``streaming_snapshot_lag_frames``);
+    - parity: the final streamed result must match the closed-file
+      oracle over the sealed store at 1e-5, or the throughput claim
+      is withheld (null, disclosed by ``streaming_parity``);
+    - isolation: the batch tenants' p99 latency next to a batch-only
+      baseline wave — the overhead must sit inside the DISCLOSED
+      envelope (``streaming_batch_p99_envelope_pct``, env
+      ``BENCH_STREAM_P99_ENVELOPE_PCT``).
+
+    Host-side by construction (serial backend, in-process scheduler,
+    no jax contact): survives the outage protocol."""
+    import shutil
+    import tempfile
+    import threading
+
+    from mdanalysis_mpi_tpu import Universe
+    from mdanalysis_mpi_tpu import testing as _testing
+    from mdanalysis_mpi_tpu.analysis import RMSF
+    from mdanalysis_mpi_tpu.io.store import LiveIngest, StoreReader
+    from mdanalysis_mpi_tpu.service import Scheduler
+
+    envelope = float(os.environ.get(
+        "BENCH_STREAM_P99_ENVELOPE_PCT", "500.0"))
+    n_frames, chunk = 64, 8
+    u_src = _testing.make_protein_universe(
+        n_residues=10, n_frames=n_frames, noise=0.3, seed=13)
+    frames, _ = u_src.trajectory.read_block(0, n_frames)
+
+    def batch_wave(sched) -> list:
+        # coalesce=False: N real serial passes, comparable between
+        # the baseline and the shared-scheduler wave
+        return [sched.submit(RMSF(u_src.select_atoms("name CA")),
+                             backend="serial", tenant=f"sb{i}",
+                             coalesce=False)
+                for i in range(8)]
+
+    def p99(handles) -> float:
+        lat = np.asarray(sorted(h.latency_s for h in handles),
+                         dtype=np.float64)
+        return float(np.percentile(lat, 99))
+
+    # wave 1: batch-only baseline
+    with Scheduler(n_workers=2) as sched:
+        base_handles = batch_wave(sched)
+        sched.drain()
+    base_p99 = p99(base_handles)
+
+    workdir = tempfile.mkdtemp(prefix="mdtpu-stream-leg-")
+    try:
+        live = LiveIngest(out=workdir, n_atoms=u_src.atoms.n_atoms,
+                          chunk_frames=chunk)
+
+        def writer():
+            for i in range(n_frames):
+                live.append(frames[i])
+                time.sleep(0.002)
+            live.seal()
+
+        sr = StoreReader(workdir, follow=True)
+        u_live = Universe(u_src.topology, sr)
+        streamer = RMSF(u_live.select_atoms("name CA"))
+        lags: list = []
+
+        def on_snapshot(snap):
+            lags.append(max(0, sr.n_frames - snap["frames"]))
+
+        # wave 2: the same batch set sharing workers with one live
+        # tenant tailing the growing store
+        t = threading.Thread(target=writer)
+        with Scheduler(n_workers=2) as sched:
+            t.start()
+            t0 = time.perf_counter()
+            hs = sched.submit(
+                streamer, backend="serial",
+                streaming={"window": chunk, "stall_timeout_s": 30.0,
+                           "poll_interval_s": 0.005,
+                           "snapshot_cb": on_snapshot})
+            wave_handles = batch_wave(sched)
+            res = hs.result(timeout=300)
+            stream_wall = time.perf_counter() - t0
+            sched.drain()
+        t.join()
+        wave_p99 = p99(wave_handles)
+
+        # closed-file oracle over the store the writer just sealed
+        u_closed = Universe(u_src.topology, StoreReader(workdir))
+        oracle = RMSF(u_closed.select_atoms("name CA")).run()
+        div = float(np.abs(
+            np.asarray(res.results.rmsf)
+            - np.asarray(oracle.results.rmsf)).max())
+        parity = bool(div <= 1e-5)
+        overhead = round(
+            (wave_p99 - base_p99) / max(base_p99, 1e-3) * 100.0, 1)
+        return {
+            "streaming_frames": n_frames,
+            "streaming_frames_per_s": (
+                round(n_frames / stream_wall, 2) if parity else None),
+            "streaming_snapshots": len(res.results.stream_snapshots),
+            "streaming_snapshot_lag_frames": max(lags, default=0),
+            "streaming_parity": parity,
+            "streaming_divergence": div,
+            "streaming_batch_baseline_p99_s": round(base_p99, 4),
+            "streaming_batch_p99_s": round(wave_p99, 4),
+            "streaming_batch_p99_overhead_pct": overhead,
+            "streaming_batch_p99_envelope_pct": envelope,
+            "streaming_envelope_met": bool(overhead <= envelope),
+        }
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def ensemble_host_leg() -> dict:
     """Ensemble scale-out sub-leg (docs/ENSEMBLE.md): an N>=8-member
     trajectory set — the last two members an identical replica pair —
@@ -1927,6 +2046,23 @@ def main():
           f"+{qos['qos_hosts_scaled_up']}/"
           f"-{qos['qos_hosts_scaled_down']}")
     _leg_done("qos serving leg", **qos)
+
+    # streaming-tier sub-leg (docs/STREAMING.md): a live writer feeds
+    # an append-able store while a follow-mode tenant streams partial
+    # snapshots through the scheduler next to batch tenants — live
+    # throughput, snapshot lag, parity vs the sealed-store oracle, and
+    # the batch p99 tax vs the disclosed envelope — host-side, so it
+    # survives the outage protocol too
+    strm = streaming_host_leg()
+    _note(f"[bench] streaming: {strm['streaming_frames']} live frames "
+          f"-> {strm['streaming_frames_per_s']} f/s over "
+          f"{strm['streaming_snapshots']} snapshots (max lag "
+          f"{strm['streaming_snapshot_lag_frames']} frames, parity "
+          f"{strm['streaming_parity']}), batch p99 tax "
+          f"{strm['streaming_batch_p99_overhead_pct']}% vs "
+          f"{strm['streaming_batch_p99_envelope_pct']}% envelope "
+          f"(met={strm['streaming_envelope_met']})")
+    _leg_done("streaming leg", **strm)
 
     # ensemble scale-out sub-leg (docs/ENSEMBLE.md): N-trajectory set
     # through parallel CAS ingest + one fleet ensemble job with

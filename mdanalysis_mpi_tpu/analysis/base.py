@@ -36,6 +36,24 @@ import numpy as np
 from mdanalysis_mpi_tpu.parallel.executors import get_executor
 
 
+class StreamFeedStalled(RuntimeError):
+    """A streaming run's feed stopped growing for longer than its
+    stall timeout while still unsealed (docs/STREAMING.md).
+
+    NOT a failure of the analysis: all progress so far is preserved on
+    the analysis object (``_stream_state`` carries the fold total and
+    the processed-frame cursor), so calling :meth:`AnalysisBase.
+    run_streaming` again RESUMES exactly where the feed stalled.  The
+    scheduler's streaming QoS class catches this to park the tenant —
+    a feed-stall park never counts toward poison/quarantine."""
+
+    def __init__(self, message: str, frames_done: int = 0,
+                 waited_s: float = 0.0):
+        super().__init__(message)
+        self.frames_done = int(frames_done)
+        self.waited_s = float(waited_s)
+
+
 def tree_add(a, b):
     """Elementwise pytree sum — the generic ``_device_fold_fn`` for
     analyses whose partials merge by addition."""
@@ -388,6 +406,229 @@ class AnalysisBase:
                       n_frames=self.n_frames, wall_s=round(wall, 4),
                       fps=round(self.n_frames / wall, 2) if wall > 0 else None)
         return self
+
+    def run_streaming(self, window: int | None = None,
+                      backend: str = "serial",
+                      batch_size: int | None = None,
+                      poll_interval_s: float = 0.02,
+                      flush_timeout_s: float = 0.25,
+                      stall_timeout_s: float = 30.0,
+                      snapshot_cb=None, clock=None, sleep=None,
+                      **executor_kwargs):
+        """Incremental run over a (possibly still growing) trajectory,
+        emitting a digest-stamped partial snapshot every ``window``
+        frames (docs/STREAMING.md).
+
+        The driver processes the frame prefix ``[0, n_frames)`` in
+        ``window``-sized slices as frames become available; on a
+        follow-mode :class:`~mdanalysis_mpi_tpu.io.store.StoreReader`
+        it re-polls the tail manifest between slices and keeps going
+        until the feed seals.  After every slice the checkpoint-shaped
+        carry is folded forward, ``_conclude`` refreshes
+        ``self.results``, and a snapshot record (frames-so-far, ingest
+        epoch, result digest via ``utils/integrity.py``, materialized
+        result arrays) is appended to ``results.stream_snapshots``
+        (and passed to ``snapshot_cb``).  Snapshots are MONOTONE:
+        snapshot *k* is exactly the closed-file result over its frame
+        prefix, so the final one matches ``run()`` over the sealed
+        trajectory.
+
+        Backends: ``"serial"`` streams every analysis exactly (the
+        accumulators live in the analysis object); batch backends fold
+        per-window partials with ``_device_fold_fn`` (reduction
+        analyses) or leaf-wise concatenation (per-frame series) —
+        NOTE each snapshot materializes results to the host, so
+        tunnel-sensitive deployments should snapshot sparsely.
+        Analyses that override ``run()`` (multi-pass:
+        ``AlignedRMSF``, ``PCA``) are streamed by disclosed
+        recompute-over-prefix: each snapshot re-runs the closed-file
+        path over ``[0, done)`` — O(n²/W) total work, exact results.
+
+        A feed that stops growing for ``stall_timeout_s`` while
+        unsealed raises :class:`StreamFeedStalled` with all progress
+        preserved; calling ``run_streaming`` again resumes.  Slices
+        flush early when the feed trickles (``flush_timeout_s`` since
+        the last snapshot with frames waiting).  ``clock``/``sleep``
+        are injectable for deterministic tests.  Returns ``self``.
+        """
+        import time
+
+        from mdanalysis_mpi_tpu import obs
+        from mdanalysis_mpi_tpu.utils import integrity as _integrity
+        from mdanalysis_mpi_tpu.utils.timers import TIMERS
+
+        clock = clock or time.monotonic
+        sleep = sleep or time.sleep
+        traj = self._universe.trajectory
+        window = int(window or getattr(traj, "chunk_frames", 0) or 64)
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        override = type(self).run is not AnalysisBase.run
+        executor = (None if override
+                    else get_executor(backend, **executor_kwargs))
+        backend_name = (backend if override else
+                        getattr(executor, "name",
+                                type(executor).__name__))
+        obs.maybe_enable_from_env()
+        st = getattr(self, "_stream_state", None)
+        if st is not None and st.get("backend") != backend_name:
+            raise ValueError(
+                f"streaming run started on backend "
+                f"{st['backend']!r}; resume must use it too, not "
+                f"{backend_name!r} (the fold carry is backend-shaped)")
+        cap = obs.start_run_capture()
+        try:
+            if st is None:
+                if not self._accepts_updating_groups:
+                    self._refuse_updating_groups()
+                st = {"backend": backend_name, "done": 0,
+                      "epoch": int(getattr(traj, "epoch", 0) or 0),
+                      "total": None, "seq": 0}
+                self.n_frames = 0
+                self._frame_indices = []
+                if not override:
+                    with TIMERS.phase("prepare"):
+                        self._prepare()
+                self.results.stream_snapshots = []
+                self._stream_state = st
+
+            def emit():
+                st["seq"] += 1
+                if not override:
+                    total = st["total"]
+                    self._last_total = total
+                    with TIMERS.phase("conclude"):
+                        self._conclude(total)
+                arrays = {}
+                for k, v in self.results.items():
+                    if k in ("stream_snapshots", "observability",
+                             "reliability"):
+                        continue
+                    try:
+                        a = np.asarray(_materialize(v))
+                    except Exception:
+                        continue
+                    if a.dtype != object:
+                        arrays[k] = a
+                snap = {
+                    "seq": st["seq"], "frames": st["done"],
+                    "epoch": st["epoch"],
+                    "analysis": type(self).__name__,
+                    "digest": _integrity.digest_arrays(arrays),
+                    "values": arrays,
+                }
+                self.results.stream_snapshots.append(snap)
+                st["last_emit"] = clock()
+                obs.METRICS.inc("mdtpu_stream_snapshots_total")
+                obs.METRICS.set_gauge(
+                    "mdtpu_stream_snapshot_age_seconds", 0.0)
+                obs.span_event("stream_snapshot",
+                               analysis=type(self).__name__,
+                               frames=st["done"], epoch=st["epoch"])
+                if snapshot_cb is not None:
+                    snapshot_cb(snap)
+
+            st.setdefault("last_emit", clock())
+            last_nf = st["done"]
+            last_growth = clock()
+            with obs.span("run", analysis=type(self).__name__,
+                          backend=backend_name, streaming=True):
+                while True:
+                    nf = traj.n_frames
+                    if nf > last_nf:
+                        last_nf = nf
+                        last_growth = clock()
+                    epoch = int(getattr(traj, "epoch", 0) or 0)
+                    if epoch > st["epoch"]:
+                        obs.METRICS.inc("mdtpu_stream_epochs_total",
+                                        epoch - st["epoch"])
+                        st["epoch"] = epoch
+                    sealed = bool(getattr(traj, "sealed", True))
+                    avail = nf - st["done"]
+                    if avail > 0 and (
+                            avail >= window or sealed
+                            or clock() - st["last_emit"]
+                            >= flush_timeout_s):
+                        lo = st["done"]
+                        hi = min(nf, lo + window)
+                        if override:
+                            st["done"] = hi
+                            self.run(stop=hi, backend=backend,
+                                     batch_size=batch_size,
+                                     **executor_kwargs)
+                        else:
+                            self.n_frames = hi
+                            self._frame_indices = list(range(hi))
+                            with TIMERS.phase("execute"):
+                                part = executor.execute(
+                                    self, traj, list(range(lo, hi)),
+                                    batch_size=batch_size)
+                            st["total"] = (
+                                part
+                                if not executor.per_call_partials
+                                or st["total"] is None
+                                else _fold_stream_partials(
+                                    self, st["total"], part))
+                            st["done"] = hi
+                        obs.METRICS.inc("mdtpu_stream_frames_total",
+                                        hi - lo)
+                        emit()
+                        continue
+                    if sealed and avail <= 0:
+                        break
+                    waited = clock() - last_growth
+                    obs.METRICS.set_gauge(
+                        "mdtpu_stream_snapshot_age_seconds",
+                        max(0.0, clock() - st["last_emit"]))
+                    if waited >= stall_timeout_s:
+                        obs.span_event("stream_stalled",
+                                       analysis=type(self).__name__,
+                                       frames=st["done"],
+                                       waited_s=round(waited, 3))
+                        raise StreamFeedStalled(
+                            f"feed for {type(self).__name__} stuck at "
+                            f"{st['done']} frames for {waited:.2f}s "
+                            f"(unsealed store, stall_timeout_s="
+                            f"{stall_timeout_s})",
+                            frames_done=st["done"], waited_s=waited)
+                    sleep(poll_interval_s)
+                    if hasattr(traj, "refresh"):
+                        traj.refresh()
+        except BaseException:
+            obs.abandon_run_capture(cap)
+            raise
+        # clean completion: the feed sealed and every frame is folded
+        # in — a fresh run_streaming call starts a new run from frame 0
+        self._stream_state = None
+        obs.METRICS.inc("mdtpu_runs_total", backend=backend_name)
+        self.results.observability = obs.finish_run_capture(
+            cap, analysis=type(self).__name__, backend=backend_name,
+            n_frames=self.n_frames)
+        if obs.trace_path():
+            obs.export_trace()
+        return self
+
+
+def _fold_stream_partials(analysis, total, part):
+    """Fold one streaming window's partials into the carry: the
+    analysis' own ``_device_fold_fn`` (reduction shapes), else
+    leaf-wise concatenation (per-frame series — the same axis the
+    executors concatenate per-batch series along)."""
+    fold = analysis._device_fold_fn
+    if fold is not None:
+        return fold(total, part)
+    import jax
+
+    def cat(a, b):
+        if hasattr(a, "ndim") and getattr(a, "ndim", 0) == 0:
+            return b                      # scalar leaf: latest wins
+        import jax.numpy as jnp
+
+        if isinstance(a, jax.Array) or isinstance(b, jax.Array):
+            return jnp.concatenate([a, b])
+        return np.concatenate([np.asarray(a), np.asarray(b)])
+
+    return jax.tree.map(cat, total, part)
 
 
 class AnalysisFromFunction(AnalysisBase):

@@ -33,14 +33,16 @@ scaling.  This package owns the I/O tier instead:
   byte-namespace methods.
 """
 
+from mdanalysis_mpi_tpu.io.store.append import LiveIngest, follow
 from mdanalysis_mpi_tpu.io.store.backend import (
     LocalDirBackend, StoreBackend,
 )
 from mdanalysis_mpi_tpu.io.store.ingest import DEFAULT_CHUNK_FRAMES, ingest
 from mdanalysis_mpi_tpu.io.store.manifest import (
-    MANIFEST_NAME, is_store, load_manifest, store_meta,
+    MANIFEST_NAME, TAIL_MANIFEST_NAME, is_store, load_any_manifest,
+    load_manifest, load_tail_manifest, store_meta,
 )
-from mdanalysis_mpi_tpu.io.store.reader import StoreReader
+from mdanalysis_mpi_tpu.io.store.reader import StoreEndOfFeed, StoreReader
 from mdanalysis_mpi_tpu.io.store.remote import (
     ChunkCache, ChunkServer, HttpStoreBackend, ServerFault,
     is_store_url, open_remote_store,
@@ -48,7 +50,10 @@ from mdanalysis_mpi_tpu.io.store.remote import (
 
 __all__ = [
     "StoreBackend", "LocalDirBackend", "StoreReader", "ingest",
-    "DEFAULT_CHUNK_FRAMES", "MANIFEST_NAME", "is_store",
-    "load_manifest", "store_meta", "HttpStoreBackend", "ChunkCache",
-    "ChunkServer", "ServerFault", "is_store_url", "open_remote_store",
+    "LiveIngest", "follow", "StoreEndOfFeed",
+    "DEFAULT_CHUNK_FRAMES", "MANIFEST_NAME", "TAIL_MANIFEST_NAME",
+    "is_store", "load_manifest", "load_any_manifest",
+    "load_tail_manifest", "store_meta", "HttpStoreBackend",
+    "ChunkCache", "ChunkServer", "ServerFault", "is_store_url",
+    "open_remote_store",
 ]

@@ -107,6 +107,92 @@ def ingest(trajectory, out: str | None = None,
             owned.close()
 
 
+def _seal_chunk(backend, ci, lo, block, boxes, times, qmode, scale,
+                content_addressed: bool):
+    """Quantize, frame and put ONE chunk — the seal step shared by the
+    closed-file ingest loop and the live appender
+    (:class:`~mdanalysis_mpi_tpu.io.store.append.LiveIngest`).
+    Returns ``(entry, scale, overflowed, dedup_bytes)``: the manifest
+    entry, the (possibly just-seeded) store-wide scale, whether this
+    chunk fell back to its own exact scale, and the bytes NOT written
+    thanks to content-addressed dedup (0 otherwise)."""
+    hi = lo + len(block)
+    arrays: dict = {}
+    meta = {"start": lo, "stop": hi, "quant": qmode}
+    overflow = False
+    if qmode == "f32":
+        arrays["coords"] = np.asarray(block, dtype=np.float32)
+    else:
+        target = QUANT_TARGETS[qmode]
+        m = float(np.abs(block).max()) if block.size else 1.0
+        if scale is None:
+            scale = target / (max(m, 1e-30) * ReaderBase.QUANT_MARGIN)
+        s = scale
+        if m * s > QUANT_INT_MAX[qmode]:
+            # range outgrew the store-wide margin: exact per-chunk
+            # scale (readers fall back to f32 requant across it)
+            s = target / max(m, 1e-30)
+            overflow = True
+        arrays["coords"] = np.round(block * s).astype(qmode)
+        meta["inv_scale"] = float(1.0 / s)
+    if boxes is not None:
+        arrays["boxes"] = np.ascontiguousarray(boxes, np.float32)
+    if times is not None:
+        arrays["times"] = np.ascontiguousarray(times, np.float32)
+    blob, fps = codec.encode_chunk(arrays, meta)
+    dedup = 0
+    digest = None
+    if content_addressed:
+        # content addressing: the name IS the payload digest, so an
+        # identical chunk from ANY prior ingest (another tenant's copy
+        # of the same trajectory included) is already there — skip the
+        # put, count the bytes not moved
+        digest = codec.payload_digest(blob)
+        name = codec.cas_chunk_name(digest)
+        if backend.exists(name):
+            dedup = len(blob)
+            _count("mdtpu_store_chunks_deduped_total")
+            _count("mdtpu_store_dedup_bytes_total", len(blob))
+        else:
+            backend.put_bytes(name, blob)
+    else:
+        name = codec.chunk_name(ci)
+        backend.put_bytes(name, blob)
+    entry = {"i": ci, "start": lo, "stop": hi, "file": name,
+             "nbytes": len(blob), "arrays": list(arrays), "fps": fps}
+    if digest is not None:
+        entry["digest"] = digest
+    if "inv_scale" in meta:
+        entry["inv_scale"] = meta["inv_scale"]
+    _count("mdtpu_store_chunks_ingested_total")
+    return entry, scale, overflow, dedup
+
+
+def build_manifest(reader_meta: dict, entries: list,
+                   overflow_chunks: int) -> dict:
+    """The manifest document for ``entries`` — shared by the one-shot
+    ingest (written once, last) and the live appender (rewritten as
+    the tail manifest after every chunk seal)."""
+    n_frames = entries[-1]["stop"] if entries else 0
+    return {
+        "format": FORMAT, "version": VERSION,
+        "n_frames": int(n_frames),
+        "n_atoms": int(reader_meta["n_atoms"]),
+        "chunk_frames": int(reader_meta["chunk_frames"]),
+        "quant": reader_meta["quant"],
+        "has_boxes": any("boxes" in e["arrays"] for e in entries),
+        "has_times": any("times" in e["arrays"] for e in entries),
+        "source": reader_meta.get("source"),
+        # chunks that fell back to their own exact scale: every
+        # stage request spanning one requantizes through f32 instead
+        # of serving raw slices — disclosed, never silent (the "no
+        # silent caps" rule), because it quietly costs the store its
+        # headline fast path
+        "scale_overflow_chunks": overflow_chunks,
+        "chunks": entries,
+    }
+
+
 def _ingest(reader, backend, chunk_frames, quant, stop,
             content_addressed: bool = False) -> dict:
     qmode = norm_store_quant(quant)
@@ -132,71 +218,21 @@ def _ingest(reader, backend, chunk_frames, quant, stop,
         hi = min(lo + cf, n_frames)
         block, boxes = reader.read_block(lo, hi)
         times = reader.frame_times(range(lo, hi))
-        arrays: dict = {}
-        meta = {"start": lo, "stop": hi, "quant": qmode}
-        if qmode == "f32":
-            arrays["coords"] = np.asarray(block, dtype=np.float32)
-        else:
-            target = QUANT_TARGETS[qmode]
-            m = float(np.abs(block).max()) if block.size else 1.0
-            if scale is None:
-                scale = target / (max(m, 1e-30)
-                                  * ReaderBase.QUANT_MARGIN)
-            s = scale
-            if m * s > QUANT_INT_MAX[qmode]:
-                # range outgrew the store-wide margin: exact per-chunk
-                # scale (readers fall back to f32 requant across it)
-                s = target / max(m, 1e-30)
-                overflow_chunks += 1
-            arrays["coords"] = np.round(block * s).astype(qmode)
-            meta["inv_scale"] = float(1.0 / s)
-        if boxes is not None:
-            arrays["boxes"] = np.ascontiguousarray(boxes, np.float32)
-        if times is not None:
-            arrays["times"] = np.ascontiguousarray(times, np.float32)
-        blob, fps = codec.encode_chunk(arrays, meta)
-        if content_addressed:
-            # content addressing: the name IS the payload digest, so
-            # an identical chunk from ANY prior ingest (another
-            # tenant's copy of the same trajectory included) is
-            # already there — skip the put, count the bytes not moved
-            digest = codec.payload_digest(blob)
-            name = codec.cas_chunk_name(digest)
-            if backend.exists(name):
-                dedup_chunks += 1
-                dedup_bytes += len(blob)
-                _count("mdtpu_store_chunks_deduped_total")
-                _count("mdtpu_store_dedup_bytes_total", len(blob))
-            else:
-                backend.put_bytes(name, blob)
-        else:
-            name = codec.chunk_name(ci)
-            backend.put_bytes(name, blob)
-        entry = {"i": ci, "start": lo, "stop": hi, "file": name,
-                 "nbytes": len(blob),
-                 "arrays": list(arrays), "fps": fps}
-        if content_addressed:
-            entry["digest"] = digest
-        if "inv_scale" in meta:
-            entry["inv_scale"] = meta["inv_scale"]
+        entry, scale, overflow, dedup = _seal_chunk(
+            backend, ci, lo, block, boxes, times, qmode, scale,
+            content_addressed)
+        if overflow:
+            overflow_chunks += 1
+        if dedup:
+            dedup_chunks += 1
+            dedup_bytes += dedup
         entries.append(entry)
-        total_bytes += len(blob)
-        _count("mdtpu_store_chunks_ingested_total")
-    man = {
-        "format": FORMAT, "version": VERSION,
-        "n_frames": int(n_frames), "n_atoms": int(reader.n_atoms),
-        "chunk_frames": cf, "quant": qmode,
-        "has_boxes": any("boxes" in e["arrays"] for e in entries),
-        "has_times": any("times" in e["arrays"] for e in entries),
-        "source": getattr(reader, "filename", None),
-        # chunks that fell back to their own exact scale: every
-        # stage request spanning one requantizes through f32 instead
-        # of serving raw slices — disclosed, never silent (the "no
-        # silent caps" rule), because it quietly costs the store its
-        # headline fast path
-        "scale_overflow_chunks": overflow_chunks,
-        "chunks": entries,
-    }
+        total_bytes += entry["nbytes"]
+    man = build_manifest(
+        {"n_atoms": reader.n_atoms, "chunk_frames": cf,
+         "quant": qmode, "source": getattr(reader, "filename", None)},
+        entries, overflow_chunks)
+    man["n_frames"] = int(n_frames)
     backend.put_bytes(MANIFEST_NAME, dump_manifest(man))
     # a re-ingest with fewer/larger chunks must not strand the old
     # geometry's files as unreferenced disk forever

@@ -39,7 +39,9 @@ from mdanalysis_mpi_tpu.core.timestep import Timestep
 from mdanalysis_mpi_tpu.io.base import ReaderBase, norm_quantize
 from mdanalysis_mpi_tpu.io.store import codec
 from mdanalysis_mpi_tpu.io.store.backend import LocalDirBackend
-from mdanalysis_mpi_tpu.io.store.manifest import load_manifest
+from mdanalysis_mpi_tpu.io.store.manifest import (
+    load_any_manifest, load_manifest,
+)
 from mdanalysis_mpi_tpu.utils import integrity as _integrity
 
 #: Decoded-chunk LRU depths: raw (quantized) chunks serve the staging
@@ -57,11 +59,29 @@ def _count(metric: str, **labels) -> None:
     METRICS.inc(metric, **labels)
 
 
+class StoreEndOfFeed(Exception):
+    """The follow-mode reader is caught up AND the feed has sealed —
+    there will never be more frames.  Typed apart from
+    :class:`~mdanalysis_mpi_tpu.utils.integrity.StoreCorruptError`
+    (bad bytes) and a plain timeout (feed alive but stalled): end of
+    feed is the streaming driver's CLEAN exit signal, not a fault."""
+
+
 class StoreReader(ReaderBase):
-    """Random-access reader over an ingested chunk store."""
+    """Random-access reader over an ingested chunk store.
+
+    ``follow=True`` opens a GROWING store (docs/STREAMING.md): the
+    live tail manifest is accepted when no closed manifest exists yet,
+    and :meth:`refresh` re-polls it, extending ``n_frames``
+    monotonically as the writer seals chunks.  Everything below
+    ``n_frames`` is immutable (sealed chunks never change), so the
+    decoded-chunk caches, the staging fast path and every consumer of
+    the ``stage_block``/``stage_cached`` boundary — executors,
+    prefetch, scan-fold dispatch, the quantized/planar paths — work on
+    a growing store untouched."""
 
     def __init__(self, path: str | None = None, n_atoms: int | None = None,
-                 backend=None):
+                 backend=None, follow: bool = False):
         if backend is None:
             if path is None:
                 raise ValueError("StoreReader needs a path or a backend")
@@ -69,7 +89,13 @@ class StoreReader(ReaderBase):
         self._backend = backend
         self._path = os.fspath(path) if path is not None \
             else backend.describe()
-        man = load_manifest(backend)
+        self._follow = bool(follow)
+        if follow:
+            man, sealed = load_any_manifest(backend)
+        else:
+            man, sealed = load_manifest(backend), True
+        self._sealed = sealed
+        self._epoch = int(man.get("epoch", 0))
         self._man = man
         self._nf = int(man["n_frames"])
         self._na = int(man["n_atoms"])
@@ -104,7 +130,96 @@ class StoreReader(ReaderBase):
         return self._cf
 
     def reopen(self) -> "StoreReader":
-        return StoreReader(self._path, backend=self._backend)
+        return StoreReader(self._path, backend=self._backend,
+                           follow=self._follow)
+
+    # ---- follow mode ----
+
+    @property
+    def follow(self) -> bool:
+        return self._follow
+
+    @property
+    def sealed(self) -> bool:
+        """True once the closed manifest exists — ``n_frames`` is
+        final.  Non-follow readers are sealed by construction."""
+        return self._sealed
+
+    @property
+    def epoch(self) -> int:
+        """Tail-manifest epoch last observed (0 for a closed store)."""
+        return self._epoch
+
+    def refresh(self) -> bool:
+        """Re-poll the manifests; returns True when new frames
+        appeared.  ``n_frames`` only ever GROWS — a tail that shrank
+        or rewound its epoch means the writer restarted underneath
+        this reader's immutable-prefix assumption, which is corruption
+        from where the reader stands, raised typed."""
+        if not self._follow or self._sealed:
+            return False
+        man, sealed = load_any_manifest(self._backend)
+        nf = int(man["n_frames"])
+        epoch = int(man.get("epoch", 0))
+        if nf < self._nf or (not sealed and epoch < self._epoch):
+            _integrity.note_corrupt("store", self._path)
+            raise _integrity.integrity_error(
+                "store",
+                f"store {self._path!r} shrank under a follow reader "
+                f"({self._nf} → {nf} frames, epoch {self._epoch} → "
+                f"{epoch}): the writer restarted; sealed chunks are "
+                "no longer trustworthy", self._path)
+        if self._nf == 0:
+            # nothing served yet: adopt geometry — a live store's
+            # empty epoch-1 tail may not know n_atoms until the
+            # writer's first chunk seals
+            self._na = int(man["n_atoms"])
+            self._cf = int(man["chunk_frames"])
+            self._quant = (None if man["quant"] == "f32"
+                           else man["quant"])
+        elif (int(man["n_atoms"]) != self._na
+                or int(man["chunk_frames"]) != self._cf
+                or man["quant"] != self._man["quant"]):
+            _integrity.note_corrupt("store", self._path)
+            raise _integrity.integrity_error(
+                "store",
+                f"store {self._path!r} changed geometry under a "
+                "follow reader (atoms/chunk_frames/quant)", self._path)
+        grew = nf > self._nf
+        self._man = man
+        self._entries = man["chunks"]
+        self._nf = nf
+        self._epoch = epoch if not sealed else self._epoch
+        self._sealed = sealed
+        return grew
+
+    def wait_frames(self, n: int, timeout_s: float = 5.0,
+                    poll_interval_s: float = 0.02,
+                    clock=None, sleep=None) -> int:
+        """Block until the store serves >= ``n`` frames; returns the
+        new ``n_frames``.  Raises :class:`StoreEndOfFeed` when the
+        feed seals with fewer (the feed is over — nothing to wait
+        for), and :class:`TimeoutError` when the feed is still open
+        but stopped growing for ``timeout_s`` (a stall the caller's
+        park/resume policy owns)."""
+        import time as _time
+
+        clock = clock or _time.monotonic
+        sleep = sleep or _time.sleep
+        deadline = clock() + timeout_s
+        while True:
+            self.refresh()
+            if self._nf >= n:
+                return self._nf
+            if self._sealed:
+                raise StoreEndOfFeed(
+                    f"store {self._path!r} sealed at {self._nf} "
+                    f"frames; {n} will never arrive")
+            if clock() >= deadline:
+                raise TimeoutError(
+                    f"store {self._path!r} stuck at {self._nf} frames "
+                    f"for {timeout_s}s waiting for {n}")
+            sleep(poll_interval_s)
 
     # ---- chunk access ----
 

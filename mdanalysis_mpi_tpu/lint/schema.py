@@ -104,6 +104,8 @@ _TABLE_TYPES = {
     "ALERT_GAUGES": "gauge",
     "ENSEMBLE_COUNTERS": "counter",
     "ENSEMBLE_GAUGES": "gauge",
+    "STREAM_COUNTERS": "counter",
+    "STREAM_GAUGES": "gauge",
 }
 
 _RECORD_TYPES = {"inc": "counter", "observe": "histogram",

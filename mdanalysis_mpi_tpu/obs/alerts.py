@@ -103,6 +103,14 @@ SEED_RULES = [
      "description": "circuit breakers are transitioning faster than "
                     "1 per 5 s over the trailing minute — a backend "
                     "is flapping, not failing cleanly"},
+    {"name": "stream_staleness", "kind": "threshold",
+     "metric": "mdtpu_stream_snapshot_age_seconds", "op": ">",
+     "threshold": 30.0, "for_ticks": 2,
+     "description": "a live tenant's newest partial snapshot is over "
+                    "30 s old for consecutive ticks — its feed "
+                    "stalled (producer dead, store unreachable) or "
+                    "the streaming pass cannot keep up "
+                    "(docs/STREAMING.md)"},
 ]
 
 _SNAKE_RE = re.compile(r"^[a-z][a-z0-9_]*$")

@@ -403,6 +403,24 @@ ENSEMBLE_GAUGES = (
     "mdtpu_ensemble_dedup_ratio",
 )
 
+#: Streaming-tier series (docs/STREAMING.md): frames reduced by live
+#: passes, partial snapshots emitted, tail-manifest epochs consumed,
+#: chunks sealed by live ingest, streaming parks (labeled ``reason=``:
+#: ``stall`` — feed went dry; ``shed`` — overload controller parked
+#: instead of killing), and the age of the newest snapshot (the
+#: ``stream_staleness`` seed alert watches this gauge).  Zero-injected
+#: so the pinned schema holds in processes that never streamed.
+STREAM_COUNTERS = (
+    "mdtpu_stream_frames_total",
+    "mdtpu_stream_snapshots_total",
+    "mdtpu_stream_epochs_total",
+    "mdtpu_stream_chunks_sealed_total",
+    "mdtpu_stream_parks_total",
+)
+STREAM_GAUGES = (
+    "mdtpu_stream_snapshot_age_seconds",
+)
+
 
 def _merge_host_snapshot(snap: dict, hid: str, host_snap: dict) -> None:
     """Fold one host's shipped snapshot into the fleet document (the
@@ -476,7 +494,7 @@ def unified_snapshot(timers=None, cache=None, telemetry=None,
             STORE_REMOTE_COUNTERS + STORE_CACHE_COUNTERS + \
             FLEET_COUNTERS + FLEET_OBS_COUNTERS + QOS_COUNTERS + \
             PROF_COUNTERS + FUSED_COUNTERS + ALERT_COUNTERS + \
-            ENSEMBLE_COUNTERS:
+            ENSEMBLE_COUNTERS + STREAM_COUNTERS:
         snap.setdefault(name, {"type": "counter", "values": {"": 0}})
     for name in PROF_HISTOGRAMS:
         # empty series set: a histogram carries no zero point, but
@@ -485,7 +503,7 @@ def unified_snapshot(timers=None, cache=None, telemetry=None,
     for name in BREAKER_GAUGES + LINT_GAUGES + INTEGRITY_GAUGES \
             + STORE_CACHE_GAUGES + FLEET_GAUGES + FLEET_OBS_GAUGES \
             + QOS_GAUGES + PROF_GAUGES + ALERT_GAUGES \
-            + ENSEMBLE_GAUGES:
+            + ENSEMBLE_GAUGES + STREAM_GAUGES:
         # 0 == closed (reliability/breaker.py STATE_VALUES): a process
         # that never tripped a breaker reports the healthy state;
         # likewise 0 lint rules/findings means "never linted here"

@@ -2687,6 +2687,97 @@ def ensemble_smoke(workdir) -> dict:
     return out
 
 
+def streaming_smoke(workdir) -> dict:
+    """The streaming-tier phase of the dryrun smoke
+    (docs/STREAMING.md): a live writer thread appends into an
+    append-able store — with one deliberate mid-feed stall longer
+    than the tenant's ``stall_timeout_s`` — while a follow-mode
+    streaming job tails it through an in-process scheduler.
+    Assertable outcomes: partial snapshots are MONOTONE in frames,
+    the final result matches the closed-file oracle over the sealed
+    store at 1e-5, the stall PARKED the tenant (``mdtpu_stream_parks_
+    total`` moved) without charging a fault, and the job still
+    finished DONE after resume."""
+    import threading
+
+    import numpy as np
+
+    from mdanalysis_mpi_tpu import obs
+    from mdanalysis_mpi_tpu import testing as _testing
+    from mdanalysis_mpi_tpu import Universe
+    from mdanalysis_mpi_tpu.analysis import RMSF
+    from mdanalysis_mpi_tpu.io.store import LiveIngest, StoreReader
+    from mdanalysis_mpi_tpu.service.qos import QosPolicy
+    from mdanalysis_mpi_tpu.service.scheduler import Scheduler
+
+    out: dict = {}
+    workdir = str(workdir)
+    os.makedirs(workdir, exist_ok=True)
+    store = os.path.join(workdir, "live-store")
+    n_frames, chunk = 24, 8
+    u_src = _testing.make_protein_universe(
+        n_residues=6, n_frames=n_frames, noise=0.3, seed=7)
+    frames, _ = u_src.trajectory.read_block(0, n_frames)
+    obs.maybe_enable_from_env()
+
+    def _parks() -> float:
+        series = obs.METRICS.snapshot().get(
+            "mdtpu_stream_parks_total", {})
+        return float(sum(series.get("values", {}).values()))
+
+    parks0 = _parks()
+    live = LiveIngest(out=store, n_atoms=u_src.atoms.n_atoms,
+                      chunk_frames=chunk)
+
+    def writer():
+        for i in range(n_frames):
+            live.append(frames[i])
+            if i == 15:
+                time.sleep(1.0)     # > stall_timeout_s: forces a park
+            else:
+                time.sleep(0.003)
+        live.seal()
+
+    sr = StoreReader(store, follow=True)
+    u_live = Universe(u_src.topology, sr)
+    streamer = RMSF(u_live.select_atoms("name CA"))
+    # daemon: joined below on the success path; must not pin a failed
+    # smoke's interpreter alive
+    t = threading.Thread(target=writer, daemon=True)
+    with Scheduler(n_workers=1,
+                   qos=QosPolicy(stream_park_delay_s=0.1)) as sched:
+        t.start()
+        h = sched.submit(
+            streamer, backend="serial",
+            streaming={"window": chunk, "stall_timeout_s": 0.25,
+                       "poll_interval_s": 0.01})
+        res = h.result(timeout=120)
+        sched.drain(timeout=60)
+    t.join()
+    snaps = res.results.stream_snapshots
+    seq = [s["frames"] for s in snaps]
+    out["streaming_frames"] = seq[-1] if seq else 0
+    out["streaming_snapshots"] = len(snaps)
+    out["streaming_monotone"] = seq == sorted(seq) and \
+        len(set(seq)) == len(seq)
+    out["streaming_parks"] = _parks() - parks0
+    out["streaming_faults"] = h._faults
+    out["streaming_state"] = str(h.state)
+    oracle = RMSF(Universe(u_src.topology, StoreReader(store))
+                  .select_atoms("name CA")).run()
+    out["streaming_divergence"] = float(np.abs(
+        np.asarray(res.results.rmsf)
+        - np.asarray(oracle.results.rmsf)).max())
+    out["streaming_ok"] = (
+        out["streaming_frames"] == n_frames
+        and out["streaming_snapshots"] >= 2
+        and out["streaming_monotone"]
+        and out["streaming_parks"] >= 1
+        and out["streaming_faults"] == 0
+        and out["streaming_divergence"] <= 1e-5)
+    return out
+
+
 def fleet_smoke(workdir=None, n_hosts: int = 2,
                 kill_mid_wave: bool = True) -> dict:
     """The dryrun serving leg at smoke scale: K tenants across
@@ -2855,12 +2946,19 @@ def fleet_smoke(workdir=None, n_hosts: int = 2,
         #      replica-pair dedup ----
         record.update(ensemble_smoke(
             os.path.join(workdir, "ensemble")))
+        # ---- phase 5: streaming tier (docs/STREAMING.md) — its own
+        #      in-process scheduler: a live writer with a deliberate
+        #      stall, a follow-mode tenant parked (not faulted) and
+        #      resumed to sealed-store parity ----
+        record.update(streaming_smoke(
+            os.path.join(workdir, "streaming")))
         record["ok"] = (record["jobs_done"] == len(jobs)
                         and record["exactly_once"]
                         and record["federation_match"]
                         and record["trace_pids"] >= n_hosts
                         and record.get("qos_ok", False)
                         and record.get("ensemble_ok", False)
+                        and record.get("streaming_ok", False)
                         and (not kill_mid_wave
                              or (record["jobs_migrated"] >= 1
                                  and stitched is not None

@@ -74,8 +74,8 @@ class AdmissionRejectedError(RuntimeError):
     was NEVER queued — no handle state, no journal record, no
     namespace pin — so the caller can retry/back off without cleanup.
     ``reason`` is one of ``queue_full`` / ``rate_limit`` /
-    ``tenant_quota`` (the ``mdtpu_admission_rejects_total{reason=}``
-    label)."""
+    ``tenant_quota`` / ``stream_envelope`` (the
+    ``mdtpu_admission_rejects_total{reason=}`` label)."""
 
     def __init__(self, message, reason: str):
         super().__init__(message)
@@ -134,8 +134,8 @@ class AnalysisJob:
         coalesce key.
     ``qos``
         Tenant QoS class — ``"interactive"`` / ``"batch"`` (default) /
-        ``"background"`` (:data:`~mdanalysis_mpi_tpu.service.qos.
-        QOS_CLASSES`).  Claim ordering is weighted-fair ACROSS classes
+        ``"streaming"`` / ``"background"``
+        (:data:`~mdanalysis_mpi_tpu.service.qos.QOS_CLASSES`).  Claim ordering is weighted-fair ACROSS classes
         (stride scheduling over ``QosPolicy.weights`` — no class with
         queued work starves); under overload the shed ladder drops the
         lowest sheddable class first and never touches classes outside
@@ -163,6 +163,19 @@ class AnalysisJob:
         backends).  Part of the coalesce key — jobs merge only with
         identical policies, so one tenant's retry budget is never
         silently applied to another's pass.
+    ``streaming``
+        ``None`` (default) — a normal bounded run.  A dict makes this
+        a LIVE job (docs/STREAMING.md): the worker calls
+        ``analysis.run_streaming(**streaming)`` instead of ``run()``,
+        tailing the job's trajectory (a follow-mode
+        :class:`~mdanalysis_mpi_tpu.io.store.reader.StoreReader`) and
+        emitting partial snapshots until the feed seals.  Keys are
+        ``run_streaming``'s keywords (``window``, ``stall_timeout_s``,
+        ``snapshot_cb``, ...).  Streaming jobs default their class to
+        ``"streaming"`` and never coalesce — a live pass has no fixed
+        window to merge on.  A feed stall PARKS the job (state back to
+        queued, resumed after ``QosPolicy.stream_park_delay_s``) and
+        is never a supervision fault.
     ``coalesce``
         ``False`` opts this job out of request coalescing (always a
         solo pass).
@@ -196,10 +209,11 @@ class AnalysisJob:
     backend: str = "serial"
     batch_size: int | None = None
     executor_kwargs: dict = dataclasses.field(default_factory=dict)
-    qos: str = "batch"
+    qos: str | None = None
     priority: int = 0
     deadline_s: float | None = None
     resilient: object = False
+    streaming: dict | None = None
     coalesce: bool = True
     tenant: str = "default"
     trace_id: str | None = None
@@ -216,6 +230,13 @@ class AnalysisJob:
         # (dataclasses.astuple crash) and kill the claim
         if not isinstance(self.resilient, ReliabilityPolicy):
             self.resilient = bool(self.resilient)
+        if self.streaming is not None:
+            self.streaming = dict(self.streaming)
+            # a live pass has no fixed window to merge on, and its
+            # snapshot cadence is per-tenant state — never coalesce
+            self.coalesce = False
+            if self.qos is None:
+                self.qos = "streaming"
         # a typo'd class must fail the CONSTRUCTION, not silently ride
         # the default weights until the shed ledger is audited
         from mdanalysis_mpi_tpu.service.qos import validate_qos
@@ -295,6 +316,11 @@ class JobHandle:
         # its batch already sank one worker, so its coalesced peers
         # must not ride (or be sunk by) it again
         self._solo_only = False
+        # park gate (streaming, docs/STREAMING.md): a stalled/shed
+        # live job goes back to queued with this set in the future —
+        # the claim path skips it until the clock passes it, so a
+        # parked tenant resumes instead of hot-spinning on a dry feed
+        self._resume_at = 0.0
         #: True once scheduler-driven prefetch staged this job's
         #: blocks into the shared cache (docs/COLDSTART.md)
         self.prefetched = False

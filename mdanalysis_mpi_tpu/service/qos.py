@@ -11,7 +11,9 @@ pure bookkeeping, importable by both the in-process
 tiers cannot drift on what a class, a weight, or a shed ladder means:
 
 - **Classes** (:data:`QOS_CLASSES`): ``interactive`` (latency SLO) >
-  ``batch`` (throughput) > ``background`` (scrubs, re-indexing —
+  ``batch`` (throughput) > ``streaming`` (live tenants tailing a
+  growing store — long-lived by design, parked rather than shed;
+  docs/STREAMING.md) > ``background`` (scrubs, re-indexing —
   sheddable).  Every job carries one; ``batch`` is the default, so a
   job file that never heard of QoS behaves exactly as before.
 - **Weighted-fair claim ordering** (:class:`StrideScheduler`): stride
@@ -38,7 +40,7 @@ import time
 #: Tenant QoS classes, highest urgency first.  The tuple order IS the
 #: shed ladder read backwards: overload sheds from the END (background
 #: first) and never reaches a class outside ``QosPolicy.shed_classes``.
-QOS_CLASSES = ("interactive", "batch", "background")
+QOS_CLASSES = ("interactive", "batch", "streaming", "background")
 
 #: Class every job gets when none is set — the pre-QoS behavior.
 DEFAULT_QOS = "batch"
@@ -49,14 +51,15 @@ _QOS_RANK = {c: i for i, c in enumerate(QOS_CLASSES)}
 #: class has queued work).  Deliberately NOT strict priority: a weight
 #: ratio bounds interactive's advantage so batch/background always
 #: advance.
-DEFAULT_WEIGHTS = {"interactive": 8, "batch": 3, "background": 1}
+DEFAULT_WEIGHTS = {"interactive": 8, "batch": 3, "streaming": 2,
+                   "background": 1}
 
 #: Default per-class latency-SLO targets (seconds, submission →
 #: completion; None = no target).  Surfaced as
 #: ``mdtpu_slo_attainment{class=}`` — these are DISCLOSED targets, not
 #: enforcement: a missed SLO is counted, never killed.
 DEFAULT_SLO_TARGETS_S = {"interactive": 1.0, "batch": 30.0,
-                         "background": None}
+                         "streaming": None, "background": None}
 
 
 def qos_rank(qos: str) -> int:
@@ -129,7 +132,22 @@ class QosPolicy:
         stops renewing, the supervisor reaps it, and the job fails
         with a typed :class:`~mdanalysis_mpi_tpu.service.jobs.
         JobRuntimeExceeded` (never requeued — a runaway re-run is the
-        same runaway).
+        same runaway).  ``streaming`` jobs are EXEMPT: a live tenant
+        is unbounded in runtime by design (docs/STREAMING.md); its
+        envelope is bounded in RESOURCES (``streaming_staged_bytes``)
+        instead.
+    ``streaming_staged_bytes``
+        The streaming class's sanctioned resource envelope
+        (docs/STREAMING.md "Serving live tenants"): the max estimated
+        staged bytes ONE streaming job's window may put in flight.  A
+        streaming submission whose window estimate exceeds it is
+        rejected typed (reason ``stream_envelope``) — the class trades
+        its runtime-cap exemption for this bound, never both ways.
+    ``stream_park_delay_s``
+        How long a streaming job parks after a feed stall before its
+        next resume attempt (default 0.5 s).  Parking is NOT a fault:
+        it never counts toward the poison threshold, and the shed
+        ladder parks streaming tenants instead of killing them.
     """
 
     weights: dict = dataclasses.field(
@@ -145,6 +163,8 @@ class QosPolicy:
     shed_staged_bytes: int | None = None
     max_lease_renewals: int | None = None
     max_runtime_s: float | None = None
+    streaming_staged_bytes: int | None = None
+    stream_park_delay_s: float = 0.5
 
     def __post_init__(self):
         w = dict(DEFAULT_WEIGHTS)

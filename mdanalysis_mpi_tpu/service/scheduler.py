@@ -272,6 +272,12 @@ class Scheduler:
         # just be re-claimed immediately — a busy-loop that never
         # yields to the runnable work it deferred behind)
         self._parked: list = []
+        # shed-parked live tenants (docs/STREAMING.md): the overload
+        # controller moves streaming entries HERE instead of killing
+        # them — out of the depth the shed predicate reads, re-admitted
+        # once overload passes.  Distinct from _parked (admission
+        # deferrals) because re-entry is load-gated, not progress-gated
+        self._stream_parked: list = []
         self._active = 0              # workers currently running a batch
         self._seq = itertools.count()
         self._cond = threading.Condition()
@@ -504,10 +510,15 @@ class Scheduler:
         process still emits its full JSON summary."""
         with self._cond:
             entries = self._queue + self._parked
-            self._queue.clear()
-            self._parked.clear()
             for _, _, h in entries:
                 self.telemetry.note_dequeue()
+            # shed-parked live tenants are unclaimed queued work too
+            # (leaving them would hang their waiters past shutdown) —
+            # but their dequeue was already noted at park time
+            entries += self._stream_parked
+            self._queue.clear()
+            self._parked.clear()
+            self._stream_parked.clear()
             self._cond.notify_all()
         aborted = []
         for _, _, h in entries:
@@ -617,6 +628,16 @@ class Scheduler:
             msg = (f"tenant {job.tenant!r} already has "
                    f"{self._tenant_inflight[job.tenant]} jobs in "
                    f"flight (quota {p.tenant_quota})")
+        elif (job.streaming is not None
+              and p.streaming_staged_bytes is not None
+              and self._stream_window_bytes(job)
+              > p.streaming_staged_bytes):
+            reason = "stream_envelope"
+            msg = (f"streaming window would stage "
+                   f"~{self._stream_window_bytes(job)} bytes, over "
+                   f"the streaming class's resource envelope "
+                   f"{p.streaming_staged_bytes} "
+                   "(docs/STREAMING.md); narrow the window")
         elif self._buckets is not None \
                 and not self._buckets.try_take(job.tenant):
             reason = "rate_limit"
@@ -630,6 +651,19 @@ class Scheduler:
                        qos=job.qos, reason=reason)
         raise AdmissionRejectedError(
             f"submission rejected ({reason}): {msg}", reason)
+
+    def _stream_window_bytes(self, job: AnalysisJob) -> int:
+        """Estimated staged bytes one streaming window puts in flight
+        — the quantity ``QosPolicy.streaming_staged_bytes`` bounds
+        (window frames x atoms x 12 B f32, the jax-free estimate
+        :meth:`_lease_ttl` uses)."""
+        try:
+            traj = job.trajectory
+            w = int((job.streaming or {}).get("window")
+                    or getattr(traj, "chunk_frames", 0) or 64)
+            return w * int(traj.n_atoms) * 12
+        except Exception:
+            return 0
 
     def _derive_fingerprint(self, job: AnalysisJob) -> str:
         """Journal identity when the caller supplied none: the job's
@@ -675,8 +709,27 @@ class Scheduler:
         """Queue entries a worker may claim now: prefetch-held handles
         are skipped — their staging completes (and releases the hold)
         before they become claimable, which is what "staged before the
-        job is claimed" means (docs/COLDSTART.md)."""
-        return [e for e in self._queue if not e[2]._prefetch_hold]
+        job is claimed" means (docs/COLDSTART.md).  Resume-gated
+        handles (a parked live tenant waiting out its
+        ``stream_park_delay_s``) are skipped until the clock passes
+        their gate — re-claiming one immediately would hot-spin on the
+        same dry feed it just stalled on."""
+        now = self._clock()
+        return [e for e in self._queue
+                if not e[2]._prefetch_hold and e[2]._resume_at <= now]
+
+    def _resume_wait_locked(self) -> float | None:
+        """Bound for the worker's idle wait: the soonest resume gate
+        among queued entries (None = nothing resume-gated; wait for a
+        notify).  Without this bound a queue holding ONLY parked live
+        tenants would leave every worker in an untimed wait no one
+        ever notifies — the resume would deadlock."""
+        now = self._clock()
+        gates = [e[2]._resume_at for e in self._queue
+                 if e[2]._resume_at > now]
+        if not gates:
+            return None
+        return max(0.0, min(gates) - now)
 
     def _worker_outer(self) -> None:
         """Thread target: records a dying worker's diagnostics for the
@@ -728,15 +781,26 @@ class Scheduler:
                         # entries get their turn now
                         self._unpark_locked()
                         break
+                    if self._stream_parked \
+                            and not self._overloaded_locked():
+                        # overload passed: shed-parked live tenants
+                        # re-enter the queue (their resume gates, not
+                        # this re-admission, pace the actual claims)
+                        self._stream_unpark_locked()
+                        if self._claimable_locked():
+                            break
                     # exit only when NOTHING is queued at all: a
                     # prefetch-held entry is still queued work — its
                     # hold is released (with a notify) by the prefetch
                     # routine's finally, so wait for it rather than
                     # stranding the job in 'queued' forever
                     if (self._shutdown and not self._parked
+                            and not self._stream_parked
                             and not self._queue):
                         return
-                    self._cond.wait()
+                    # timed when resume-gated entries exist — no other
+                    # thread notifies for a clock gate passing
+                    self._cond.wait(self._resume_wait_locked())
                 batch, poison, token = self._claim_batch_locked()
                 self._active += 1
                 # dequeue accounting at CLAIM time (not per-unit):
@@ -785,6 +849,18 @@ class Scheduler:
         if self._parked:
             self._queue.extend(self._parked)
             self._parked.clear()
+            self._cond.notify_all()
+
+    def _stream_unpark_locked(self) -> None:
+        """Re-admit shed-parked live tenants once overload passed.
+        They keep their resume gates: re-entry is to the QUEUE, the
+        claim path still waits the park delay out."""
+        if self._stream_parked:
+            self._queue.extend(self._stream_parked)
+            for _ in self._stream_parked:
+                # balance the note_dequeue the shed-park recorded
+                self.telemetry.note_requeue()
+            self._stream_parked.clear()
             self._cond.notify_all()
 
     def _claim_batch_locked(self):
@@ -858,6 +934,20 @@ class Scheduler:
                 h._owner = token
             return token
         ttl = self._lease_ttl(handles)
+        if handles and all(h.job.qos == "streaming" for h in handles):
+            # the streaming class's sanctioned lease
+            # (docs/STREAMING.md): unbounded runtime by design — its
+            # envelope is bounded in RESOURCES at admission
+            # (streaming_staged_bytes), so the runaway caps do not
+            # apply.  The TTL widens past the stall window too: a
+            # stalled feed enters no phases (no heartbeats) until the
+            # stall raises, and reaping a healthily-waiting tenant
+            # would charge a poison incident to a dry feed.
+            stall = max((float((h.job.streaming or {}).get(
+                "stall_timeout_s", 30.0)) for h in handles),
+                default=30.0)
+            return self._sup.grant(
+                handles, max(ttl, stall + self.lease_ttl_s)).token
         return self._sup.grant(
             handles, ttl,
             max_renewals=self.qos.max_lease_renewals,
@@ -1035,7 +1125,7 @@ class Scheduler:
                 # a worker death AFTER shutdown can requeue a handle
                 # no one will ever claim (respawn stops at shutdown):
                 # resolve it instead of hanging its waiters forever
-                if self._queue or self._parked:
+                if self._queue or self._parked or self._stream_parked:
                     self.abort_queued(
                         "scheduler shut down with no remaining "
                         "workers to claim this requeued job")
@@ -1301,19 +1391,26 @@ class Scheduler:
             return True
         return False
 
-    def _collect_sheds_locked(self) -> list[JobHandle]:
+    def _collect_sheds_locked(self) -> tuple:
         """Pull the entries the shed ladder drops this pass out of the
         queue: lowest sheddable class first, newest first within a
         class (the jobs that would wait longest), down to the
         configured depth — and NEVER a class outside
         ``shed_classes``, whatever the depth.  Prefetch-held entries
         are skipped (their staging is mid-flight); they are
-        reconsidered once released."""
+        reconsidered once released.
+
+        Streaming entries on the ladder are PARKED, not killed
+        (docs/STREAMING.md): moved to ``_stream_parked`` — out of the
+        depth the overload predicate reads, resume-gated — and
+        re-admitted by :meth:`_stream_unpark_locked` once overload
+        passes.  Returns ``(sheds, parks)``."""
         p = self.qos
         if not self._overloaded_locked():
-            return []
+            return [], []
         target = p.shed_queue_depth or 0
         sheds: list[JobHandle] = []
+        parks: list[JobHandle] = []
         for qos_cls in p.shed_ladder():
             for queue in (self._parked, self._queue):
                 candidates = sorted(
@@ -1324,26 +1421,54 @@ class Scheduler:
                 for entry in candidates:
                     depth = len(self._queue) + len(self._parked)
                     if depth <= target:
-                        return sheds
+                        return sheds, parks
                     queue.remove(entry)
                     self.telemetry.note_dequeue()
-                    sheds.append(entry[2])
-        return sheds
+                    if qos_cls == "streaming":
+                        entry[2]._resume_at = (
+                            self._clock() + p.stream_park_delay_s)
+                        self._stream_parked.append(entry)
+                        parks.append(entry[2])
+                    else:
+                        sheds.append(entry[2])
+        return sheds, parks
 
     def _maybe_shed(self) -> list[JobHandle]:
         """One overload-controller pass: collect under the lock,
         resolve (done-callbacks + durable journal records) outside it.
-        Returns the handles shed."""
+        Returns the handles shed.  Also the load-gate for shed-parked
+        live tenants: when the pass finds the overload over, they
+        re-enter the queue here (the supervisor tick calls this
+        between submissions)."""
         p = self.qos
         if p.shed_queue_depth is None and p.shed_staged_bytes is None:
             return []
         with self._cond:
-            sheds = self._collect_sheds_locked()
-            if sheds:
+            if self._stream_parked \
+                    and not self._overloaded_locked():
+                self._stream_unpark_locked()
+            sheds, parks = self._collect_sheds_locked()
+            if sheds or parks:
                 self._cond.notify_all()
+        for h in parks:
+            self._note_stream_park(h, "shed")
         for h in sheds:
             self._resolve_shed(h)
         return sheds
+
+    def _note_stream_park(self, h: JobHandle, reason: str,
+                          **extra) -> None:
+        """Disclose one streaming park (stall or shed) — counted
+        ``mdtpu_stream_parks_total{reason=}``, span event
+        ``stream_parked``.  Parks are NEVER supervision faults: the
+        handle's poison counter and fault log are untouched."""
+        obs.METRICS.inc("mdtpu_stream_parks_total", reason=reason)
+        obs.span_event("stream_parked", job_id=h.job_id,
+                       tenant=h.job.tenant, reason=reason, **extra)
+        self._log.info(
+            "parked streaming job %d (%s): %s; resume in %.2fs",
+            h.job_id, h.job.tenant, reason,
+            max(0.0, h._resume_at - self._clock()))
 
     def _resolve_shed(self, h: JobHandle) -> None:
         if h.done():
@@ -1500,6 +1625,10 @@ class Scheduler:
                            if not e[2]._prefetch_hold
                            and not e[2].prefetched
                            and not e[2].job.resilient
+                           # a live tenant's window grows under the
+                           # prefetch: the blocks staged now are stale
+                           # by its claim (docs/STREAMING.md)
+                           and e[2].job.streaming is None
                            and not (overloaded and self.qos.sheddable(
                                e[2].job.qos))
                            and e[2].job.backend in ("jax", "mesh")
@@ -1785,6 +1914,15 @@ class Scheduler:
         # run() inside to enable it would leave THIS unit's spans
         # without their job attribution
         obs.maybe_enable_from_env()
+        if unit.handles[0].job.streaming is not None:
+            # live tenants take their own serve path
+            # (docs/STREAMING.md): run_streaming tails the feed, a
+            # stall PARKS instead of failing, and the unit is always
+            # solo (streaming never coalesces) — no cache admission
+            # either: the envelope check at the submission door
+            # already bounded the window's staged bytes
+            self._run_streaming_unit(unit.handles[0], token)
+            return True
         run_now, reserved = self._admit(unit)
         if not run_now:
             return False
@@ -1937,6 +2075,83 @@ class Scheduler:
         if charged:
             with self._cond:
                 self._staged_inflight -= charged
+
+    def _run_streaming_unit(self, handle: JobHandle, token) -> None:
+        """Serve one live tenant (docs/STREAMING.md):
+        ``run_streaming`` tails the job's follow-mode trajectory and
+        emits partial snapshots until the feed seals.  A feed stall
+        parks the job — back to queued, resume-gated — and is NEVER a
+        supervision fault: a dry feed is the producer's pace, not
+        poison.  A resumed claim re-enters the analysis's own
+        checkpoint-shaped carry (``_stream_state``), so no frame is
+        re-reduced."""
+        from mdanalysis_mpi_tpu.analysis.base import StreamFeedStalled
+
+        job = handle.job
+        backend = self._route_backend(job)
+        if backend != job.backend:
+            self.telemetry.count("breaker_reroutes")
+        kwargs = dict(job.executor_kwargs)
+        if backend == "serial":
+            # same batch-kwarg filter as _run_unit (breaker reroute
+            # to the serial floor)
+            kwargs = {k: v for k, v in kwargs.items()
+                      if k == "reliability"}
+        handle._mark_running()
+        try:
+            with obs.trace_context(job_ids=[handle.job_id],
+                                   tenants=[job.tenant],
+                                   trace_ids=[job.trace_id]), \
+                    TIMERS.phase("serve_job", coalesced=False):
+                job.analysis.run_streaming(
+                    backend=backend, batch_size=job.batch_size,
+                    **job.streaming, **kwargs)
+        except StreamFeedStalled as exc:
+            # not a backend verdict either: the device did its work;
+            # the PRODUCER went quiet — the breaker stays untouched
+            self._park_stalled(handle, token, exc)
+        except Exception as exc:
+            self._note_backend_result(backend, exc)
+            self._complete(handle, token, exc=exc)
+        else:
+            self._note_backend_result(backend, None,
+                                      analyses=[job.analysis])
+            self._complete(handle, token)
+        if obs.trace_path():
+            obs.export_trace()       # same file-currency contract as
+            #                          _run_unit
+
+    def _park_stalled(self, handle: JobHandle, token, exc) -> bool:
+        """Owner-guarded park of a stalled live tenant: back to the
+        queue (state ``queued``), resume-gated
+        ``stream_park_delay_s`` out.  Guarded like :meth:`_complete` —
+        only the worker still owning the handle may park it, so a
+        reaped zombie's late stall cannot double-queue the job.  The
+        fault log and poison counter are deliberately untouched
+        (ISSUE: a stall must not count toward quarantine)."""
+        with self._cond:
+            if handle._owner is not token or handle.done():
+                return False
+            handle._owner = None
+            self._sup.drop_handle(handle)
+            handle.state = JobState.QUEUED
+            # the resumed pass re-enters this analysis's own carry;
+            # peers must never merge into it
+            handle._solo_only = True
+            now = self._clock()
+            handle._resume_at = now + self.qos.stream_park_delay_s
+            # wait clock restarts at the park (the requeue-accounting
+            # contract): the stalled attempt's run time is not queue
+            # wait, and the queue deadline measures from here
+            handle.requeued_t = now
+            self._queue.append((-handle.job.priority,
+                                next(self._seq), handle))
+            self.telemetry.note_requeue()
+            self._cond.notify_all()
+        self._note_stream_park(
+            handle, "stall", frames_done=exc.frames_done,
+            waited_s=round(exc.waited_s, 3))
+        return True
 
     def _run_solo(self, handle: JobHandle, kwargs: dict,
                   token) -> None:

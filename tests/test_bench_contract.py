@@ -177,6 +177,17 @@ PINNED_METRICS = {
     "mdtpu_ensemble_ingest_members_total": "counter",
     "mdtpu_ensemble_ingest_failures_total": "counter",
     "mdtpu_ensemble_dedup_ratio": "gauge",
+    # streaming tier (docs/STREAMING.md): live-ingest frames/chunks,
+    # snapshot emission + freshness, epoch promotions, and the
+    # park/resume counter for stalled or shed live tenants — recorded
+    # live by run_streaming / LiveIngest / the scheduler,
+    # zero-injected everywhere else
+    "mdtpu_stream_frames_total": "counter",
+    "mdtpu_stream_snapshots_total": "counter",
+    "mdtpu_stream_epochs_total": "counter",
+    "mdtpu_stream_chunks_sealed_total": "counter",
+    "mdtpu_stream_parks_total": "counter",
+    "mdtpu_stream_snapshot_age_seconds": "gauge",
 }
 
 #: The alert seed-rule catalog (obs/alerts.py SEED_RULES) — pinned so
@@ -190,6 +201,7 @@ PINNED_ALERT_RULES = (
     "data_corruption",
     "store_remote_error_rate",
     "breaker_flapping",
+    "stream_staleness",
 )
 
 
@@ -358,6 +370,21 @@ def test_bench_json_contract(tmp_path):
                     "ensemble_parity_max_err", "ensemble_dedup_ratio",
                     "ensemble_replica_pair_rmsd",
                     "ensemble_trajectories_per_s", "ensemble_speedup",
+                    # r19: streaming-tier sub-leg (docs/STREAMING.md):
+                    # live writer + follow-mode tenant next to batch
+                    # tenants — throughput/lag/snapshot disclosures,
+                    # parity vs the sealed-store oracle, and the batch
+                    # p99 tax vs the disclosed envelope; host-side,
+                    # survives the outage protocol
+                    "streaming_frames", "streaming_frames_per_s",
+                    "streaming_snapshots",
+                    "streaming_snapshot_lag_frames",
+                    "streaming_parity", "streaming_divergence",
+                    "streaming_batch_baseline_p99_s",
+                    "streaming_batch_p99_s",
+                    "streaming_batch_p99_overhead_pct",
+                    "streaming_batch_p99_envelope_pct",
+                    "streaming_envelope_met",
                     # r18: fused planar sub-leg (ops/pallas_fused.py
                     # + docs/DISPATCH.md "Fused engine") — host half
                     # (planar-vs-interleaved staging fps + the
@@ -513,6 +540,18 @@ def test_bench_json_contract(tmp_path):
         assert rec["qos_journal_scale_up"] >= 1
         assert rec["qos_journal_scale_down"] >= 1
         assert rec["qos_exactly_once"] is True
+        # streaming sub-leg: the live tenant emitted monotone partial
+        # snapshots while the feed grew, the final result matched the
+        # sealed-store oracle bit-for-bit at 1e-5, and the batch
+        # tenants' p99 tax stayed inside the disclosed envelope
+        assert rec["streaming_parity"] is True
+        assert rec["streaming_divergence"] <= 1e-5
+        assert rec["streaming_frames_per_s"] > 0
+        assert rec["streaming_snapshots"] >= 2
+        assert rec["streaming_frames"] >= 32
+        assert rec["streaming_envelope_met"] is True
+        assert (rec["streaming_batch_p99_overhead_pct"]
+                <= rec["streaming_batch_p99_envelope_pct"])
         # ensemble sub-leg: all N members merged with pooled-moment
         # parity against the serial loop-over-universes oracle, the
         # replica pair deduped fully through the shared chunk pool,
@@ -669,6 +708,12 @@ def test_bench_outage_records_host_legs(tmp_path):
         assert rec["qos_shed_background"] >= 1
         assert rec["qos_hosts_scaled_up"] >= 1
         assert rec["qos_hosts_scaled_down"] >= 1
+        # the streaming sub-leg is host-side too: the live-tenant
+        # parity verdict and the batch-tax disclosure survive a
+        # tunnel-down artifact
+        assert rec["streaming_parity"] is True
+        assert rec["streaming_frames_per_s"] > 0
+        assert rec["streaming_envelope_met"] is True
         # the ensemble sub-leg is host-side too: the parity verdict
         # and dedup disclosure survive a tunnel-down artifact
         assert rec["ensemble_parity_ok"] is True

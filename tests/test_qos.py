@@ -68,7 +68,7 @@ def test_validate_qos_rejects_typo_at_construction():
     # default is batch, the pre-QoS behavior
     assert AnalysisJob(RMSF(u.select_atoms("name CA"))).qos == "batch"
     assert validate_qos(None) == "batch"
-    assert [qos_rank(c) for c in QOS_CLASSES] == [0, 1, 2]
+    assert [qos_rank(c) for c in QOS_CLASSES] == [0, 1, 2, 3]
 
 
 def test_qos_policy_validates_and_defaults():
@@ -89,10 +89,13 @@ def test_qos_policy_validates_and_defaults():
 
 
 def test_stride_scheduler_weight_ratio_and_no_starvation():
+    # explicit 3-class universe: adding weight-2 "streaming" to the
+    # candidate set would shift the 8:3:1 shares this test pins
+    classes = ("interactive", "batch", "background")
     s = StrideScheduler({"interactive": 8, "batch": 3,
                          "background": 1})
-    picks = [s.pick(QOS_CLASSES) for _ in range(1200)]
-    counts = {c: picks.count(c) for c in QOS_CLASSES}
+    picks = [s.pick(classes) for _ in range(1200)]
+    counts = {c: picks.count(c) for c in classes}
     # stride converges to the exact weight shares (±1 per boundary)
     assert abs(counts["interactive"] - 800) <= 8
     assert abs(counts["batch"] - 300) <= 3
