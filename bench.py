@@ -1128,6 +1128,82 @@ def serving_fault_leg(u_mem) -> dict:
     }
 
 
+def usage_canary_leg(u_mem) -> dict:
+    """Tenant-observability sub-leg (docs/OBSERVABILITY.md "Usage
+    metering, exemplars & the synthetic canary"): the SAME serving
+    wave twice — metering OFF, then ON — so the artifact discloses the
+    metering tax (`usage_overhead_pct`, target <3%) next to the
+    per-tenant usage document the wave produced, plus ONE synthetic
+    canary probe through the full real path (throwaway store ingest →
+    read → stage → dispatch → digest vs the pinned oracle) with its
+    latency.  Serial backend + serial canary by construction: a
+    host-side leg, survives the outage protocol."""
+    from mdanalysis_mpi_tpu.analysis import RMSF
+    from mdanalysis_mpi_tpu.obs import unified_snapshot, usage
+    from mdanalysis_mpi_tpu.service import Scheduler
+    from mdanalysis_mpi_tpu.service.canary import CanaryProbe
+
+    window = SERIAL_FRAMES
+    n_jobs = 9
+
+    def wave():
+        sched = Scheduler(n_workers=1, autostart=False)
+        handles = [
+            sched.submit(RMSF(u_mem.select_atoms(SELECT)),
+                         backend="serial", stop=window,
+                         tenant=f"u{i % 3}")
+            for i in range(n_jobs)
+        ]
+        t0 = time.perf_counter()
+        sched.start()
+        sched.drain()
+        sched.shutdown()
+        wall = time.perf_counter() - t0
+        errs = [h for h in handles if h.error is not None]
+        if errs:
+            raise RuntimeError(f"usage leg: {len(errs)} jobs failed: "
+                               f"{errs[0].error!r}")
+        return len(handles) / wall
+
+    was_enabled = usage.enabled()
+    try:
+        usage.disable()
+        plain_jps = wave()
+        usage.enable()
+        metered_jps = wave()
+    finally:
+        (usage.enable if was_enabled else usage.disable)()
+    doc = usage.usage_doc(unified_snapshot())
+    top = doc["top"][0] if doc["top"] else None
+
+    # one synchronous serial canary probe (service/canary.py): the
+    # same probe the scheduler supervisor ticks in production, minus
+    # the jax dispatch path so the leg stays host-side
+    probe = CanaryProbe(Scheduler(n_workers=1), interval_s=0.0,
+                        backend="serial")
+    try:
+        outcome = probe.probe_once()
+        probe.scheduler.shutdown()
+    finally:
+        probe.close()
+    if outcome is None or not outcome["ok"]:
+        raise RuntimeError(f"usage leg: canary probe failed: {outcome}")
+    return {
+        "usage_plain_jobs_per_s": round(plain_jps, 2),
+        "usage_metered_jobs_per_s": round(metered_jps, 2),
+        # the metering tax on the same wave (can be sub-noise
+        # negative; the contract gate holds the ceiling, not a floor)
+        "usage_overhead_pct": round(
+            (plain_jps - metered_jps) / plain_jps * 100.0, 2),
+        "usage_overhead_target_pct": 3.0,
+        "usage_tenants": len(doc["tenants"]),
+        "usage_top_tenant": top,
+        "usage_canary_ok": outcome["ok"],
+        "usage_canary_latency_s": outcome["latency_s"],
+        "usage_canary_stage": outcome["stage"],
+    }
+
+
 def integrity_leg(u_mem) -> dict:
     """Integrity-overhead sub-leg (docs/RELIABILITY.md §5 "Integrity
     model"): the SAME serving host wave twice — plain, then with the
@@ -1250,6 +1326,10 @@ def fleet_serving_leg() -> dict:
                "noise": 0.25, "seed": 9}
     tenants = [f"ft{i}" for i in range(4)]
     workdir = tempfile.mkdtemp(prefix="mdtpu-fleet-leg-")
+    # earlier legs charged the same process-global usage ledger —
+    # reconcile THIS controller's journal against the delta
+    from mdanalysis_mpi_tpu.obs import unified_snapshot as _usnap
+    usage_base = _usnap()
     all_jobs = []
     try:
         with FleetController(workdir, host_ttl_s=2.0) as ctrl:
@@ -1288,6 +1368,12 @@ def fleet_serving_leg() -> dict:
             loss_jps = wave(kill=True)          # host-loss wave
             snap = ctrl.telemetry.snapshot()
             stats = ctrl.stats()
+            # usage-vs-journal reconciliation across the kill -9 wave
+            # (docs/OBSERVABILITY.md "Usage metering"): the federated
+            # per-tenant job meter must match the journal's
+            # exactly-once finish ledger EXACTLY, including the
+            # migrated jobs — a recorded gate, not just a test
+            usage_rec = ctrl.usage_reconcile(baseline=usage_base)
         wave2_n = mid["home_hits"] + mid["home_misses"] \
             - before["home_hits"] - before["home_misses"]
         wave2_hits = mid["home_hits"] - before["home_hits"]
@@ -1312,6 +1398,8 @@ def fleet_serving_leg() -> dict:
             "fleet_epoch_fenced_rejects": snap["epoch_fenced_rejects"],
             "fleet_exactly_once": exactly_once,
             "fleet_epoch": stats["epoch"],
+            "usage_ledger_reconciled": usage_rec["ok"],
+            "usage_ledger_jobs": sum(usage_rec["journal"].values()),
         }
     finally:
         shutil.rmtree(workdir, ignore_errors=True)
@@ -1992,6 +2080,17 @@ def main():
           f"with 1 worker death (clean "
           f"{fault_wave['serving_fault_clean_jobs_per_s']})")
     _leg_done("serving fault-wave leg", **fault_wave)
+
+    # usage-metering + canary sub-leg (docs/OBSERVABILITY.md): the
+    # metering tax on the same host wave plus one serial end-to-end
+    # canary probe — host-side, so it survives a tunnel-down artifact
+    usage_leg = usage_canary_leg(u_mem)
+    _note(f"[bench] usage metering: "
+          f"{usage_leg['usage_overhead_pct']}% tax "
+          f"(target <{usage_leg['usage_overhead_target_pct']}%), "
+          f"canary ok={usage_leg['usage_canary_ok']} in "
+          f"{usage_leg['usage_canary_latency_s']}s")
+    _leg_done("usage canary leg", **usage_leg)
 
     # integrity-overhead sub-leg (docs/RELIABILITY.md §5): the price
     # of CRC-framed journaling + digest-stamped atomic outputs on the

@@ -14,7 +14,7 @@ import sys
 
 if not (len(sys.argv) > 1
         and sys.argv[1] in ("lint", "fleet", "fleet-host", "ingest",
-                            "status", "perf")):
+                            "status", "usage", "perf")):
     # platform re-pinning imports jax; the lint subcommand's fast AST
     # mode is contractually jax-free (<30 s, docs/LINT.md — pinned by
     # tests/test_lint.py via the CLI's `jax_imported` disclosure), and

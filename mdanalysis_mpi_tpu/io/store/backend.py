@@ -39,6 +39,12 @@ class StoreBackend:
     missing name → ``StoreUnavailableError``, provably bad bytes →
     ``StoreCorruptError``."""
 
+    #: Where the LAST ``get_bytes`` on this thread was actually served
+    #: from, for usage attribution (obs/usage.py ``source=`` label):
+    #: ``local`` / ``remote`` / ``cache``.  Plain files are always
+    #: local; the HTTP backend overrides this per degradation rung.
+    usage_source = "local"
+
     def put_bytes(self, name: str, data: bytes) -> None:
         raise NotImplementedError
 

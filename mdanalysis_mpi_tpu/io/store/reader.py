@@ -285,6 +285,13 @@ class StoreReader(ReaderBase):
                 f"manifest but unreadable ({type(exc).__name__}: "
                 f"{exc})", self._chunk_path(ci)) from exc
         _count("mdtpu_store_chunks_read_total")
+        # usage charge site: one decoded chunk, attributed to the
+        # backend rung that actually served it (local / remote / cache)
+        from mdanalysis_mpi_tpu.obs import usage as _usage
+
+        _usage.charge_current_store(
+            source=getattr(self._backend, "usage_source", "local"),
+            chunks=1, nbytes=len(blob))
         with self._lock:
             hit = self._raw.get(ci)
             if hit is not None:

@@ -215,6 +215,15 @@ class HttpStoreBackend(StoreBackend):
             mirror = LocalDirBackend(os.fspath(mirror))
         self.mirror = mirror
         self._sleep = sleep
+        # per-thread source of the last get_bytes (usage attribution);
+        # thread-local because fleet workers share one backend
+        self._usage_src = threading.local()
+
+    @property
+    def usage_source(self) -> str:
+        """``cache`` / ``remote`` / ``local`` (mirror) — which ladder
+        rung served this thread's last :meth:`get_bytes`."""
+        return getattr(self._usage_src, "value", "remote")
 
     # ---- protocol plumbing ----
 
@@ -422,10 +431,12 @@ class HttpStoreBackend(StoreBackend):
         if immutable:
             hit = self.cache.get(key)
             if hit is not None:
+                self._usage_src.value = "cache"
                 return hit
         blob, corrupt = self._remote_get(name)
         if blob is not None:
             self.cache.put(key, blob)
+            self._usage_src.value = "remote"
             return blob
         # ---- degradation ladder (remote exhausted) ----
         if not immutable:
@@ -436,6 +447,7 @@ class HttpStoreBackend(StoreBackend):
             if hit is not None:
                 span_event("store_remote_degraded", step="cache",
                            chunk=name)
+                self._usage_src.value = "cache"
                 return hit
         if self.mirror is not None:
             try:
@@ -446,6 +458,7 @@ class HttpStoreBackend(StoreBackend):
                 span_event("store_remote_degraded", step="mirror",
                            chunk=name)
                 self.cache.put(key, blob)
+                self._usage_src.value = "local"
                 return blob
             except (_integrity.StoreUnavailableError, OSError):
                 pass

@@ -106,6 +106,10 @@ _TABLE_TYPES = {
     "ENSEMBLE_GAUGES": "gauge",
     "STREAM_COUNTERS": "counter",
     "STREAM_GAUGES": "gauge",
+    "USAGE_COUNTERS": "counter",
+    "CANARY_COUNTERS": "counter",
+    "CANARY_GAUGES": "gauge",
+    "CANARY_HISTOGRAMS": "histogram",
 }
 
 _RECORD_TYPES = {"inc": "counter", "observe": "histogram",

@@ -37,6 +37,7 @@ from mdanalysis_mpi_tpu.obs import alerts as alerts
 from mdanalysis_mpi_tpu.obs import baseline as baseline
 from mdanalysis_mpi_tpu.obs import flight as flight
 from mdanalysis_mpi_tpu.obs import prof as prof
+from mdanalysis_mpi_tpu.obs import usage as usage
 from mdanalysis_mpi_tpu.obs.alerts import AlertEngine, AlertRule, seed_rules
 from mdanalysis_mpi_tpu.obs.flight import dump as flight_dump
 from mdanalysis_mpi_tpu.obs.metrics import (
@@ -81,5 +82,5 @@ __all__ = [
     "maybe_enable_from_env", "set_process_args", "start_run_capture",
     "finish_run_capture", "abandon_run_capture", "flight",
     "flight_dump", "prof", "alerts", "baseline", "AlertEngine",
-    "AlertRule", "seed_rules",
+    "AlertRule", "seed_rules", "usage",
 ]

@@ -111,6 +111,15 @@ SEED_RULES = [
                     "stalled (producer dead, store unreachable) or "
                     "the streaming pass cannot keep up "
                     "(docs/STREAMING.md)"},
+    {"name": "canary_failing", "kind": "threshold",
+     "metric": "mdtpu_canary_consecutive_failures", "op": ">=",
+     "threshold": 2.0, "for_ticks": 2,
+     "description": "the synthetic canary probe (service/canary.py) "
+                    "has failed its last 2+ end-to-end runs for "
+                    "consecutive ticks — the serving path is broken "
+                    "even if no tenant traffic is arriving; the "
+                    "failure stage is on "
+                    "mdtpu_canary_failures_total{stage=}"},
 ]
 
 _SNAKE_RE = re.compile(r"^[a-z][a-z0-9_]*$")

@@ -83,6 +83,15 @@ class MetricsRegistry:
     def observe(self, name: str, value: float,
                 buckets: tuple = TIME_BUCKETS, **labels) -> None:
         key = label_key(labels)
+        # exemplar: the trace id attributed to the observing thread
+        # (PR-5 span context) — resolved outside the lock; the bucket
+        # this observation lands in remembers the LAST such id, so a
+        # p99 bucket links to an actual Chrome trace
+        tid = None
+        from mdanalysis_mpi_tpu.obs import spans as _spans
+        ids = _spans.current_trace_ids()
+        if ids:
+            tid = sorted(ids)[0]
         with self._lock:
             bk = self._buckets.setdefault(name, tuple(buckets))
             vals = self._values_locked(name, "histogram")
@@ -98,6 +107,12 @@ class MetricsRegistry:
                 if value <= le:
                     h["buckets"][i] += 1
             h["buckets"][-1] += 1                    # +Inf
+            if tid is not None:
+                # non-cumulative: keyed by the FIRST bucket the value
+                # fits (its natural bucket), latest observation wins
+                idx = next((i for i, le in enumerate(bk)
+                            if value <= le), len(bk))
+                h.setdefault("exemplars", {})[idx] = [tid, float(value)]
 
     def snapshot(self) -> dict:
         """Deep-copied JSON document of every series (see module
@@ -108,11 +123,17 @@ class MetricsRegistry:
                 if s["type"] == "histogram":
                     bk = self._buckets[name]
                     les = [repr(float(le)) for le in bk] + ["+Inf"]
-                    vals = {
-                        k: {"count": h["count"],
-                            "sum": round(h["sum"], 6),
-                            "buckets": dict(zip(les, h["buckets"]))}
-                        for k, h in s["values"].items()}
+                    vals = {}
+                    for k, h in s["values"].items():
+                        entry = {"count": h["count"],
+                                 "sum": round(h["sum"], 6),
+                                 "buckets": dict(zip(les, h["buckets"]))}
+                        ex = h.get("exemplars")
+                        if ex:
+                            entry["exemplars"] = {
+                                les[i]: {"trace_id": t, "value": v}
+                                for i, (t, v) in sorted(ex.items())}
+                        vals[k] = entry
                 else:
                     vals = dict(s["values"])
                 out[name] = {"type": s["type"], "values": vals}
@@ -421,6 +442,41 @@ STREAM_GAUGES = (
     "mdtpu_stream_snapshot_age_seconds",
 )
 
+#: Per-tenant usage-metering counters (obs/usage.py UsageLedger —
+#: docs/OBSERVABILITY.md "Usage metering, exemplars & canary").  Every
+#: series is labeled ``tenant=``/``class=`` (store meters add
+#: ``source=`` — local/remote/cache; the jobs meter adds
+#: ``outcome=``); the ledger mirrors its charges into the global
+#: registry so the PR-13 heartbeat piggyback federates them for free.
+#: Zero-injected so a process that never metered still carries the
+#: schema.
+USAGE_COUNTERS = (
+    "mdtpu_usage_frames_total",
+    "mdtpu_usage_staged_bytes_total",
+    "mdtpu_usage_cache_byte_seconds_total",
+    "mdtpu_usage_dispatch_seconds_total",
+    "mdtpu_usage_store_chunks_total",
+    "mdtpu_usage_store_bytes_total",
+    "mdtpu_usage_jobs_total",
+)
+
+#: Synthetic-canary black-box SLIs (service/canary.py — the reserved
+#: background-class pseudo-tenant probing the full serving path on the
+#: supervisor tick).  Failures are labeled ``stage=`` (submit / store /
+#: stage / kernel / oracle / timeout); the consecutive-failures gauge
+#: feeds the ``canary_failing`` seed alert.  Zero-injected so a
+#: process that never probed still carries the schema.
+CANARY_COUNTERS = (
+    "mdtpu_canary_probes_total",
+    "mdtpu_canary_failures_total",
+)
+CANARY_GAUGES = (
+    "mdtpu_canary_consecutive_failures",
+)
+CANARY_HISTOGRAMS = (
+    "mdtpu_canary_latency_seconds",
+)
+
 
 def _merge_host_snapshot(snap: dict, hid: str, host_snap: dict) -> None:
     """Fold one host's shipped snapshot into the fleet document (the
@@ -457,11 +513,16 @@ def _merge_host_snapshot(snap: dict, hid: str, host_snap: dict) -> None:
                 if cur is None:
                     vals[k] = {"count": h["count"], "sum": h["sum"],
                                "buckets": dict(h["buckets"])}
+                    if "exemplars" in h:
+                        vals[k]["exemplars"] = dict(h["exemplars"])
                     continue
                 cur["count"] += h["count"]
                 cur["sum"] = round(cur["sum"] + h["sum"], 6)
                 for le, c in h["buckets"].items():
                     cur["buckets"][le] = cur["buckets"].get(le, 0) + c
+                if "exemplars" in h:
+                    # per-bucket "last trace seen" — the host's is newer
+                    cur.setdefault("exemplars", {}).update(h["exemplars"])
 
 
 def unified_snapshot(timers=None, cache=None, telemetry=None,
@@ -494,16 +555,17 @@ def unified_snapshot(timers=None, cache=None, telemetry=None,
             STORE_REMOTE_COUNTERS + STORE_CACHE_COUNTERS + \
             FLEET_COUNTERS + FLEET_OBS_COUNTERS + QOS_COUNTERS + \
             PROF_COUNTERS + FUSED_COUNTERS + ALERT_COUNTERS + \
-            ENSEMBLE_COUNTERS + STREAM_COUNTERS:
+            ENSEMBLE_COUNTERS + STREAM_COUNTERS + USAGE_COUNTERS + \
+            CANARY_COUNTERS:
         snap.setdefault(name, {"type": "counter", "values": {"": 0}})
-    for name in PROF_HISTOGRAMS:
+    for name in PROF_HISTOGRAMS + CANARY_HISTOGRAMS:
         # empty series set: a histogram carries no zero point, but
         # the pinned schema needs the name/type in every snapshot
         snap.setdefault(name, {"type": "histogram", "values": {}})
     for name in BREAKER_GAUGES + LINT_GAUGES + INTEGRITY_GAUGES \
             + STORE_CACHE_GAUGES + FLEET_GAUGES + FLEET_OBS_GAUGES \
             + QOS_GAUGES + PROF_GAUGES + ALERT_GAUGES \
-            + ENSEMBLE_GAUGES + STREAM_GAUGES:
+            + ENSEMBLE_GAUGES + STREAM_GAUGES + CANARY_GAUGES:
         # 0 == closed (reliability/breaker.py STATE_VALUES): a process
         # that never tripped a breaker reports the healthy state;
         # likewise 0 lint rules/findings means "never linted here"
@@ -543,9 +605,13 @@ def unified_snapshot(timers=None, cache=None, telemetry=None,
     return snap
 
 
-def to_prometheus(snapshot: dict | None = None) -> str:
+def to_prometheus(snapshot: dict | None = None,
+                  exemplars: bool = False) -> str:
     """Render a snapshot (default: the global registry's) as
-    Prometheus text exposition."""
+    Prometheus text exposition.  ``exemplars=True`` opts into
+    OpenMetrics exemplar syntax on histogram bucket lines
+    (``... # {trace_id="..."} <value>``) — opt-in because classic
+    Prometheus scrapers reject the ``#`` continuation."""
     if snapshot is None:
         snapshot = METRICS.snapshot()
     lines: list[str] = []
@@ -554,9 +620,15 @@ def to_prometheus(snapshot: dict | None = None) -> str:
         lines.append(f"# TYPE {name} {m['type']}")
         for lk, v in sorted(m["values"].items()):
             if m["type"] == "histogram":
+                exm = v.get("exemplars") if exemplars else None
                 for le, c in v["buckets"].items():
                     lbl = (lk + "," if lk else "") + f'le="{le}"'
-                    lines.append(f"{name}_bucket{{{lbl}}} {c}")
+                    line = f"{name}_bucket{{{lbl}}} {c}"
+                    ex = exm.get(le) if exm else None
+                    if ex:
+                        line += (f' # {{trace_id="{ex["trace_id"]}"}}'
+                                 f' {ex["value"]}')
+                    lines.append(line)
                 suffix = f"{{{lk}}}" if lk else ""
                 lines.append(f"{name}_sum{suffix} {v['sum']}")
                 lines.append(f"{name}_count{suffix} {v['count']}")

@@ -41,6 +41,7 @@ import numpy as np
 
 from mdanalysis_mpi_tpu.obs import prof as _prof
 from mdanalysis_mpi_tpu.obs import spans as _spans
+from mdanalysis_mpi_tpu.obs import usage as _usage
 from mdanalysis_mpi_tpu.parallel.partition import iter_batches, pad_batch
 from mdanalysis_mpi_tpu.reliability import faults as _faults
 from mdanalysis_mpi_tpu.utils import compile_cache as _cc
@@ -1190,6 +1191,20 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
     # (``distributed.local_host_copy``) — fleet hosts get the same
     # scrub coverage as single-host caches (the PR-9 gap).
     fingerprinting = _INTEGRITY_FINGERPRINTS and cache is not None
+    # usage metering (obs/usage.py): cache residency is charged as
+    # byte-seconds over the insert→pass-end window — the interval this
+    # pass is responsible for; later passes that HIT the entry charge
+    # nothing (a hit stages no new bytes).  Appends are GIL-atomic, so
+    # prefetch/wire threads share the list without a lock.
+    cache_inserts: list = []
+
+    def _charge_cache_residency():
+        if cache_inserts:
+            now = _time.monotonic()
+            _usage.charge_current(cache_byte_seconds=sum(
+                nb * max(0.0, now - t) for nb, t in cache_inserts))
+            cache_inserts.clear()
+
     # scan-group accumulator: gi -> (blocks_chained, per-array crcs).
     # _stack_staged stacks each leaf along a new leading axis in block
     # order, so chaining the per-block CRCs at stage time equals the
@@ -1235,8 +1250,10 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
             # multi-host the staged slice is already 1/local_divisor of
             # the global batch, and a global sharded array keeps exactly
             # those bytes resident per host)
-            if cache.put(key, staged, nbytes) and fp is not None:
-                cache.note_fingerprint(key, fp, expect=staged)
+            if cache.put(key, staged, nbytes):
+                cache_inserts.append((nbytes, _time.monotonic()))
+                if fp is not None:
+                    cache.note_fingerprint(key, fp, expect=staged)
         return staged
 
     # trace-context hand-off: `prepare` runs on the prefetch thread,
@@ -1266,9 +1283,14 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
 
     def _stage_op(batch_frames):
         """_host_stage under the reliability retry/deadline envelope."""
-        if rt is None:
-            return _host_stage(batch_frames)
-        return rt.op("stage", lambda: _host_stage(batch_frames))
+        staged, nbytes = (_host_stage(batch_frames) if rt is None
+                          else rt.op("stage",
+                                     lambda: _host_stage(batch_frames)))
+        if nbytes > 0:
+            # usage charge site: freshly staged bytes (cache hits never
+            # reach here; salvage-shortened blocks carry nbytes == -1)
+            _usage.charge_current(staged_bytes=nbytes)
+        return staged, nbytes
 
     def _note_fused_blocks(n: int):
         if engine == "fused":
@@ -1398,12 +1420,14 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
             if (cache is not None and not cache.full
                     and all(nb >= 0 for _, nb in blocks)):
                 stacked = _stack_staged([s for s, _ in blocks])
-                if not cache.put(group_keys[gi], stacked,
-                                 sum(nb for _, nb in blocks)):
+                group_nb = sum(nb for _, nb in blocks)
+                if not cache.put(group_keys[gi], stacked, group_nb):
                     _delete_staged(stacked)   # rejected: don't leak HBM
-                elif fp is not None and fp_n == len(blocks):
-                    cache.note_fingerprint(group_keys[gi], fp,
-                                           expect=stacked)
+                else:
+                    cache_inserts.append((group_nb, _time.monotonic()))
+                    if fp is not None and fp_n == len(blocks):
+                        cache.note_fingerprint(group_keys[gi], fp,
+                                               expect=stacked)
             for s, _ in blocks:
                 _delete_staged(s)
 
@@ -1416,6 +1440,7 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
                 staged_blocks += 1
             if scan_active:
                 _note_block_done(bi, staged, nbytes)
+        _charge_cache_residency()
         return staged_blocks
 
     if prestage and _cold_pipeline_enabled():
@@ -1572,6 +1597,7 @@ def _run_batches(analysis, reader, frames, bs, call, sel_idx,
                     _note_block_done(bi, staged, nbytes)
         if scan_active:
             _flush_hits_before(len(groups))
+    _charge_cache_residency()
     if fold is not None:
         if fold_j is not None and total is not None:
             import jax

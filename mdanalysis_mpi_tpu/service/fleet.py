@@ -422,6 +422,19 @@ class FleetController:
         self._alert_last = float("-inf")
         if _recovered:
             self._resubmit_recovered(_recovered)
+            # pre-seed the usage job meter from the replayed finish
+            # ledger: a standby's ledger must account for terminals
+            # the dead controller already journaled, or
+            # usage_reconcile() would report phantom journal-only
+            # outcomes after takeover
+            for fp, n in _recovered.get("finishes", {}).items():
+                rec = _recovered["jobs"].get(fp, {})
+                spec = rec.get("spec") or {}
+                for _ in range(n):
+                    obs.usage.LEDGER.charge_job(
+                        rec.get("tenant") or "default",
+                        spec.get("qos") or "batch",
+                        rec.get("state", "done"))
             # adoption black box (docs/OBSERVABILITY.md): what the
             # standby saw at takeover, journaled beside the epoch
             fpath = _flight.dump(
@@ -453,7 +466,10 @@ class FleetController:
                 self.status,
                 metrics_fn=lambda: obs.to_prometheus(
                     self.fleet_snapshot()),
-                health_fn=self.healthz, bind_host=bind_host)
+                health_fn=self.healthz,
+                usage_fn=lambda: obs.usage.usage_doc(
+                    self.fleet_snapshot()),
+                bind_host=bind_host)
         _write_addr_file(self.workdir, self.address[0],
                          self.address[1], self.epoch,
                          status_port=(self._statusd.address[1]
@@ -832,6 +848,23 @@ class FleetController:
         controller-local series distinct) — what ``/metrics``
         exposes."""
         return obs.unified_snapshot(fleet=self.host_metrics())
+
+    def usage_reconcile(self, baseline: dict | None = None) -> dict:
+        """Audit the fleet-federated usage job meter against this
+        controller's journal (exactly-once finish ledger): every
+        accepted terminal record must appear as exactly one
+        ``mdtpu_usage_jobs_total`` charge with the same tenant and
+        outcome — exact across host kill -9 waves, because both sides
+        are written at the same journal-then-ack boundary.  Emits the
+        ``usage_reconciled`` span instant with the verdict."""
+        res = obs.usage.reconcile(
+            self.fleet_snapshot(),
+            _journal.replay_fleet(self.journal.path),
+            baseline=baseline)
+        obs.span_event("usage_reconciled", ok=res["ok"],
+                       jobs=sum(res["journal"].values()),
+                       diff=len(res["diff"]))
+        return res
 
     def export_fleet_trace(self, path: str) -> str | None:
         """Write ONE merged Chrome trace: this controller's own
@@ -1290,6 +1323,10 @@ class FleetController:
         # resent completion is rejected as duplicate)
         self.journal.record("finish", fp, state=job.state,
                             durable=True)
+        # usage: the job meter mirrors the journal's exactly-once
+        # finish ledger — one charge per accepted terminal record,
+        # same tenant/outcome (reconciled by usage_reconcile())
+        obs.usage.LEDGER.charge_job(job.tenant, job.qos, job.state)
         self.telemetry.count("jobs_completed" if job.state == DONE
                              else "jobs_failed")
         if job.resident is not None:
@@ -1454,6 +1491,8 @@ class FleetController:
         # parent merge observe the failure
         self.journal.record("finish", fail_member.fp, state=FAILED,
                             durable=True)
+        obs.usage.LEDGER.charge_job(fail_member.tenant,
+                                    fail_member.qos, FAILED)
         self.telemetry.count("jobs_failed")
         self.telemetry.count("ensemble_members_failed")
         obs.METRICS.inc("mdtpu_ensemble_members_completed_total",
@@ -1531,6 +1570,8 @@ class FleetController:
             self.journal.record("quarantine", job.fp,
                                 reason=f"poison_migrations:{reason}",
                                 durable=True)
+            obs.usage.LEDGER.charge_job(job.tenant, job.qos,
+                                        QUARANTINED)
             obs.METRICS.inc("mdtpu_jobs_quarantined_total")
             job._settle()
             if job.parent is not None:
@@ -1604,6 +1645,7 @@ class FleetController:
             # controller must not re-own a job the policy dropped
             self.journal.record("finish", job.fp, state=SHED,
                                 durable=True)
+            obs.usage.LEDGER.charge_job(job.tenant, job.qos, SHED)
             job._settle()
             if job.parent is not None:
                 self._merge_parent(job.parent)
@@ -2146,8 +2188,26 @@ class _HostWorker:
 
             cache = DeviceBlockCache(max_bytes=int(cache_mb) << 20)
         self.cache = cache
+        # MDTPU_CANARY_INTERVAL_S (set by spawn_host / the operator):
+        # each host probes its OWN serving path — a fleet canary that
+        # only ran on the controller would miss a single broken host
+        canary_knob = os.environ.get("MDTPU_CANARY_INTERVAL_S")
+        try:
+            canary_interval = float(canary_knob) if canary_knob else None
+        except ValueError:
+            canary_interval = None
         self.sched = Scheduler(n_workers=workers, cache=cache,
-                               prefetch=cache is not None)
+                               prefetch=cache is not None,
+                               canary_interval_s=(
+                                   canary_interval
+                                   if canary_interval and
+                                   canary_interval > 0 and
+                                   backend in ("jax", "mesh")
+                                   else None))
+        # the job-outcome usage meter mirrors the CONTROLLER's
+        # exactly-once journal ledger; the host-local scheduler must
+        # not also charge it or fleet federation would double-count
+        self.sched._usage_charge_jobs = False
         self._log = get_logger("mdtpu.fleet")
         self._lock = threading.Lock()
         self._universes: dict[str, object] = {}

@@ -70,11 +70,12 @@ class JobShedError(RuntimeError):
 class AdmissionRejectedError(RuntimeError):
     """``submit()`` refused this job at the door (docs/RELIABILITY.md
     §7 "Backpressure contract"): the queue bound, the tenant's rate
-    limit, or the tenant's inflight quota would be exceeded.  The job
-    was NEVER queued — no handle state, no journal record, no
-    namespace pin — so the caller can retry/back off without cleanup.
-    ``reason`` is one of ``queue_full`` / ``rate_limit`` /
-    ``tenant_quota`` / ``stream_envelope`` (the
+    limit, inflight quota, or dispatch-seconds budget would be
+    exceeded.  The job was NEVER queued — no handle state, no journal
+    record, no namespace pin — so the caller can retry/back off
+    without cleanup.  ``reason`` is one of ``queue_full`` /
+    ``rate_limit`` / ``tenant_quota`` / ``budget`` /
+    ``stream_envelope`` (the
     ``mdtpu_admission_rejects_total{reason=}`` label)."""
 
     def __init__(self, message, reason: str):

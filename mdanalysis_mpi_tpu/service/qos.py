@@ -112,6 +112,13 @@ class QosPolicy:
         Max jobs one tenant may have queued+running at once (reason
         ``tenant_quota``) — one 10k-job tenant must not monopolize
         the queue the instant it connects.
+    ``tenant_budget_dispatch_s``
+        Per-tenant dispatch-seconds budget fed from the LIVE usage
+        ledger (obs/usage.py, docs/OBSERVABILITY.md): a submission
+        from a tenant whose metered ``dispatch_s`` already reached
+        this bound is rejected typed (reason ``budget``) — counted
+        and journaled like every other admission reason.  The
+        synthetic canary's pseudo-tenant is exempt.
     ``shed_queue_depth`` / ``shed_classes``
         The overload controller's trigger and ladder: when the queued
         depth exceeds ``shed_queue_depth`` while the workers/hosts are
@@ -158,6 +165,7 @@ class QosPolicy:
     tenant_rate_per_s: float | None = None
     tenant_rate_burst: float | None = None
     tenant_quota: int | None = None
+    tenant_budget_dispatch_s: float | None = None
     shed_queue_depth: int | None = None
     shed_classes: tuple = ("background",)
     shed_staged_bytes: int | None = None

@@ -69,6 +69,7 @@ from mdanalysis_mpi_tpu.obs import prof as _prof
 from mdanalysis_mpi_tpu.reliability import breaker as _breaker
 from mdanalysis_mpi_tpu.reliability import faults as _faults
 from mdanalysis_mpi_tpu.service import coalesce as _coalesce
+from mdanalysis_mpi_tpu.service.canary import CANARY_TENANT
 from mdanalysis_mpi_tpu.service import journal as _journal
 from mdanalysis_mpi_tpu.service import qos as _qos
 from mdanalysis_mpi_tpu.service import supervision as _supervision
@@ -188,7 +189,8 @@ class Scheduler:
                  mem_guard_bytes: int | None = None,
                  flight_dir: str | None = None,
                  qos: "_qos.QosPolicy | None" = None,
-                 alerts=None, alert_interval_s: float = 1.0):
+                 alerts=None, alert_interval_s: float = 1.0,
+                 canary=None, canary_interval_s: float | None = None):
         self.cache = cache
         # ---- QoS + overload policy (docs/RELIABILITY.md §7) ----
         self.qos = qos or _qos.QosPolicy()
@@ -248,6 +250,22 @@ class Scheduler:
                 flight_dir=self._flight_dir, journal=self.journal)
         self.alert_interval_s = float(alert_interval_s)
         self._alert_last = float("-inf")
+        # ---- synthetic canary (service/canary.py,
+        #      docs/OBSERVABILITY.md): the reserved background-class
+        #      pseudo-tenant probing the full serving path on the
+        #      supervisor tick.  Off by default; pass an instance, or
+        #      True / canary_interval_s to build one bound here. ----
+        if canary is True or (canary is None and canary_interval_s):
+            from mdanalysis_mpi_tpu.service.canary import CanaryProbe
+            canary = CanaryProbe(
+                self, interval_s=canary_interval_s or 30.0)
+        self.canary = canary or None
+        #: standalone schedulers charge the per-tenant jobs meter
+        #: (obs/usage.py) at their own terminal sites; a fleet host's
+        #: local scheduler leaves it to the controller — the journal
+        #: writer — so the meter reconciles EXACTLY against the
+        #: journal's finish ledger.
+        self._usage_charge_jobs = True
         # live status endpoint (service/statusd.py), opt-in via
         # serve_status() / the batch CLI's --status-port
         self._statusd = None
@@ -447,7 +465,27 @@ class Scheduler:
             # `mdtpu status --alerts` renders
             "alerts": (self.alerts.status()
                        if self.alerts is not None else None),
+            # the synthetic canary's black-box view (service/canary.py)
+            "canary": (self.canary.status()
+                       if self.canary is not None else None),
         }
+        # histogram exemplars (docs/OBSERVABILITY.md): the last trace
+        # id each latency bucket saw — a p99 bucket links straight to
+        # an actual Chrome trace
+        snap = obs.METRICS.snapshot()
+        exemplars: dict = {}
+        for name in ("mdtpu_queue_wait_seconds",
+                     "mdtpu_job_latency_seconds", "mdtpu_dispatch_ms",
+                     "mdtpu_canary_latency_seconds"):
+            series = snap.get(name)
+            if not series:
+                continue
+            ex = {lk: v["exemplars"]
+                  for lk, v in series["values"].items()
+                  if v.get("exemplars")}
+            if ex:
+                exemplars[name] = ex
+        out["exemplars"] = exemplars
         if self.breakers is not None:
             out["breakers"] = {
                 (backend if mesh is None else f"{backend}@{mesh}"): st
@@ -477,6 +515,10 @@ class Scheduler:
                                          cache=self.cache,
                                          telemetry=self.telemetry)),
                 health_fn=self._healthz,
+                usage_fn=lambda: obs.usage.usage_doc(
+                    obs.unified_snapshot(timers=TIMERS,
+                                         cache=self.cache,
+                                         telemetry=self.telemetry)),
                 bind_host=bind_host, port=port)
         return self._statusd.address
 
@@ -489,6 +531,8 @@ class Scheduler:
         if self._statusd is not None:
             self._statusd.close()
             self._statusd = None
+        if self.canary is not None:
+            self.canary.close()
         if self.journal is not None and self._owns_journal:
             self.journal.close()
         # under the condition like every other mutation of the pool
@@ -616,18 +660,35 @@ class Scheduler:
         budget)."""
         p = self.qos
         reason = None
+        # the synthetic canary (service/canary.py) is exempt from the
+        # PER-TENANT checks — quota, budget, rate — by design: probe
+        # cadence must not depend on tenant policy, and a probe must
+        # never burn a real tenant's tokens.  The queue-full and
+        # streaming-envelope bounds still apply (they protect the
+        # process, not a tenant).
+        is_canary = job.tenant == CANARY_TENANT
         depth = len(self._queue) + len(self._parked)
         if p.max_queue_depth is not None and depth >= p.max_queue_depth:
             reason = "queue_full"
             msg = (f"queue depth {depth} at its bound "
                    f"{p.max_queue_depth}; back off and resubmit")
-        elif (p.tenant_quota is not None
+        elif (not is_canary and p.tenant_quota is not None
               and self._tenant_inflight.get(job.tenant, 0)
               >= p.tenant_quota):
             reason = "tenant_quota"
             msg = (f"tenant {job.tenant!r} already has "
                    f"{self._tenant_inflight[job.tenant]} jobs in "
                    f"flight (quota {p.tenant_quota})")
+        elif (not is_canary and p.tenant_budget_dispatch_s is not None
+              and obs.usage.LEDGER.dispatch_s_for(job.tenant)
+              >= p.tenant_budget_dispatch_s):
+            # fed from the LIVE usage ledger (obs/usage.py): dispatch
+            # wall-seconds this tenant has consumed, all classes
+            reason = "budget"
+            msg = (f"tenant {job.tenant!r} has consumed "
+                   f"{obs.usage.LEDGER.dispatch_s_for(job.tenant):.3f}s"
+                   f" of dispatch time, at/over its "
+                   f"{p.tenant_budget_dispatch_s}s budget")
         elif (job.streaming is not None
               and p.streaming_staged_bytes is not None
               and self._stream_window_bytes(job)
@@ -638,7 +699,7 @@ class Scheduler:
                    f"the streaming class's resource envelope "
                    f"{p.streaming_staged_bytes} "
                    "(docs/STREAMING.md); narrow the window")
-        elif self._buckets is not None \
+        elif not is_canary and self._buckets is not None \
                 and not self._buckets.try_take(job.tenant):
             reason = "rate_limit"
             msg = (f"tenant {job.tenant!r} exceeded its "
@@ -974,6 +1035,36 @@ class Scheduler:
                             else min(deadline, h.job.deadline_s))
         return _supervision.derive_ttl(self.lease_ttl_s, est, deadline)
 
+    def _usage_weights(self, handles) -> list:
+        """``[(tenant, class, frames), ...]`` for one unit — the
+        pro-rata split the trace context carries to every downstream
+        charge site (obs/usage.py: shared meters of a merged pass
+        split by member frame count, sums exact).  Frame counts reuse
+        the jax-free :meth:`_lease_ttl` estimate."""
+        out = []
+        for h in handles:
+            try:
+                n = len(h.job.analysis._frames(
+                    h.job.start, h.job.stop, h.job.step, h.job.frames))
+            except Exception:
+                n = 0
+            out.append((h.job.tenant, h.job.qos, n))
+        return out
+
+    def _charge_usage(self, weights: list, t0: float,
+                      frames: bool = False) -> None:
+        """Charge one served unit's dispatch wall-seconds (split
+        pro-rata) and, on success, each member's exact frame count."""
+        led = obs.usage.LEDGER
+        if not led.enabled or not weights:
+            return
+        led.charge_split(
+            weights, dispatch_s=max(0.0, time.monotonic() - t0))
+        if frames:
+            for tenant, qos, n in weights:
+                if n:
+                    led.charge(tenant, qos, frames=n)
+
     def _requeue(self, handles: list[JobHandle]) -> None:
         """Park admission-deferred handles; they re-enter the queue
         only after other work has actually run (see _worker) — putting
@@ -1034,6 +1125,14 @@ class Scheduler:
             # tests' exactly-once accounting can count them.
             self.journal.record("finish", handle.job.fingerprint,
                                 state=handle.state, durable=True)
+        # per-tenant jobs-by-outcome meter (obs/usage.py): charged by
+        # the journal writer — exactly one charge per terminal record,
+        # so usage.reconcile audits the meter against the journal's
+        # finish ledger.  A fleet host's local scheduler defers the
+        # charge to the controller (its journal writer).
+        if self._usage_charge_jobs:
+            obs.usage.LEDGER.charge_job(handle.job.tenant,
+                                        handle.job.qos, handle.state)
         with self._cond:
             self._sup.drop_handle(handle)
             self._inflight -= 1
@@ -1121,6 +1220,9 @@ class Scheduler:
                 # the same unified snapshot /metrics exposes, at most
                 # every alert_interval_s on the injectable clock
                 self._alert_tick()
+                # canary tick (service/canary.py): settle/launch the
+                # synthetic probe — non-blocking, at most one in flight
+                self._canary_tick()
             if stop:
                 # a worker death AFTER shutdown can requeue a handle
                 # no one will ever claim (respawn stops at shutdown):
@@ -1145,6 +1247,17 @@ class Scheduler:
         snap = obs.unified_snapshot(timers=TIMERS, cache=self.cache,
                                     telemetry=self.telemetry)
         return self.alerts.evaluate(snap, now=now)
+
+    def _canary_tick(self) -> None:
+        """Drive the attached synthetic canary on the supervisor
+        cadence.  A probe FAILURE is the canary's own signal; a tick
+        that raises must never kill the supervisor."""
+        if self.canary is None:
+            return
+        try:
+            self.canary.tick()
+        except Exception:
+            self._log.exception("canary tick failed")
 
     def _reap_locked(self) -> tuple:
         """Reap due leases; returns ``(quarantines, fences, capped)``
@@ -1417,7 +1530,12 @@ class Scheduler:
                     (e for e in queue
                      if e[2].job.qos == qos_cls
                      and not e[2]._prefetch_hold),
-                    key=lambda e: e[1], reverse=True)   # newest first
+                    # canary probes shed FIRST within a class — the
+                    # pseudo-tenant must never cost a real tenant a
+                    # shed slot — then newest first (the jobs that
+                    # would wait longest)
+                    key=lambda e: (e[2].job.tenant != CANARY_TENANT,
+                                   -e[1]))
                 for entry in candidates:
                     depth = len(self._queue) + len(self._parked)
                     if depth <= target:
@@ -1964,9 +2082,17 @@ class Scheduler:
             job_ids=[h.job_id for h in unit.handles],
             tenants=[h.job.tenant for h in unit.handles],
             trace_ids=[h.job.trace_id for h in unit.handles])
+        # per-tenant metering (obs/usage.py): the pro-rata weights
+        # ride the same thread context, so every downstream charge
+        # site (staging, cache residency, store reads) splits a
+        # merged pass's cost by member frame count
+        weights = self._usage_weights(unit.handles)
+        if obs.usage.LEDGER.enabled:
+            attrs["usage_weights"] = weights
         merged_span = (obs.span("coalesced_pass",
                                 n_jobs=len(unit.handles))
                        if unit.coalesced else contextlib.nullcontext())
+        t_run = time.monotonic()
         try:
             with obs.trace_context(**attrs), \
                     TIMERS.phase("serve_job", coalesced=unit.coalesced), \
@@ -1976,6 +2102,9 @@ class Scheduler:
                                   resilient=job.resilient,
                                   **job.window_kwargs(), **kwargs)
         except Exception as exc:
+            # the failed pass's wall time was still consumed on these
+            # tenants' behalf (frames charge only on success)
+            self._charge_usage(weights, t_run)
             self._note_backend_result(backend, exc)
             if unit.coalesced:
                 # one bad member must not fail the batch it merged
@@ -2004,6 +2133,7 @@ class Scheduler:
                 for h in unit.handles:
                     self._complete(h, token, exc=exc)
         else:
+            self._charge_usage(weights, t_run, frames=True)
             self._note_backend_result(
                 backend, None,
                 analyses=[h.job.analysis for h in unit.handles])
@@ -2098,10 +2228,14 @@ class Scheduler:
             kwargs = {k: v for k, v in kwargs.items()
                       if k == "reliability"}
         handle._mark_running()
+        weights = [(job.tenant, job.qos, 0)]
+        attrs = dict(job_ids=[handle.job_id], tenants=[job.tenant],
+                     trace_ids=[job.trace_id])
+        if obs.usage.LEDGER.enabled:
+            attrs["usage_weights"] = weights
+        t_run = time.monotonic()
         try:
-            with obs.trace_context(job_ids=[handle.job_id],
-                                   tenants=[job.tenant],
-                                   trace_ids=[job.trace_id]), \
+            with obs.trace_context(**attrs), \
                     TIMERS.phase("serve_job", coalesced=False):
                 job.analysis.run_streaming(
                     backend=backend, batch_size=job.batch_size,
@@ -2117,6 +2251,11 @@ class Scheduler:
             self._note_backend_result(backend, None,
                                       analyses=[job.analysis])
             self._complete(handle, token)
+        finally:
+            # streaming attempts charge dispatch wall time however
+            # they end (a parked stall still consumed the wall); frame
+            # counts are open-ended, left to the stream counters
+            self._charge_usage(weights, t_run)
         if obs.trace_path():
             obs.export_trace()       # same file-currency contract as
             #                          _run_unit
@@ -2167,19 +2306,25 @@ class Scheduler:
             kwargs = {k: v for k, v in kwargs.items()
                       if k == "reliability"}
         handle._mark_running()
+        weights = self._usage_weights([handle])
+        attrs = dict(job_ids=[handle.job_id], tenants=[job.tenant],
+                     trace_ids=[job.trace_id])
+        if obs.usage.LEDGER.enabled:
+            attrs["usage_weights"] = weights
+        t_run = time.monotonic()
         try:
-            with obs.trace_context(job_ids=[handle.job_id],
-                                   tenants=[job.tenant],
-                                   trace_ids=[job.trace_id]), \
+            with obs.trace_context(**attrs), \
                     TIMERS.phase("serve_job", coalesced=False):
                 job.analysis.run(backend=backend,
                                  batch_size=job.batch_size,
                                  resilient=job.resilient,
                                  **job.window_kwargs(), **kwargs)
         except Exception as exc:
+            self._charge_usage(weights, t_run)
             self._note_backend_result(backend, exc)
             self._complete(handle, token, exc=exc)
         else:
+            self._charge_usage(weights, t_run, frames=True)
             self._note_backend_result(backend, None,
                                       analyses=[job.analysis])
             self._complete(handle, token)

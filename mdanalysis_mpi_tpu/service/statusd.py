@@ -11,7 +11,10 @@ scraper) can hit while a controller or scheduler is serving:
   wedged or shut down;
 - ``/status`` — the operational JSON an operator greps logs for
   today: queue depth, leases, breaker states, hosts alive, epoch,
-  quarantine.
+  quarantine;
+- ``/usage`` — the tenant-facing usage document (obs/usage.py
+  ``usage_doc``): per-(tenant, class) monotone meters, per-class
+  rollups, and the top-N tenants by dispatch seconds.
 
 The :class:`~mdanalysis_mpi_tpu.service.fleet.FleetController` starts
 one by default and publishes its port beside ``controller.addr``
@@ -36,7 +39,7 @@ from mdanalysis_mpi_tpu.obs import metrics as _metrics
 
 #: The routes the request counter labels by name; anything else
 #: counts as ``route="other"`` (a 404).
-ROUTES = ("/status", "/metrics", "/healthz")
+ROUTES = ("/status", "/metrics", "/healthz", "/usage")
 
 
 class StatusServer:
@@ -47,11 +50,16 @@ class StatusServer:
     :attr:`address`."""
 
     def __init__(self, status_fn, metrics_fn=None, health_fn=None,
-                 bind_host: str = "127.0.0.1", port: int = 0):
+                 usage_fn=None, bind_host: str = "127.0.0.1",
+                 port: int = 0):
+        from mdanalysis_mpi_tpu.obs import usage as _usage
+
         self._status_fn = status_fn
         self._metrics_fn = metrics_fn or (
             lambda: _metrics.to_prometheus(_metrics.unified_snapshot()))
         self._health_fn = health_fn or (lambda: {"ok": True})
+        self._usage_fn = usage_fn or (
+            lambda: _usage.usage_doc(_metrics.unified_snapshot()))
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -94,6 +102,10 @@ class StatusServer:
             if route == "/status":
                 return (200, "application/json",
                         json.dumps(self._status_fn(),
+                                   default=str).encode())
+            if route == "/usage":
+                return (200, "application/json",
+                        json.dumps(self._usage_fn(),
                                    default=str).encode())
             return (404, "application/json",
                     json.dumps({"error": f"no route {route!r}",
@@ -267,4 +279,43 @@ def status_main(argv=None) -> int:
         _print_alerts(doc)
     else:
         _print_human(doc)
+    return 0
+
+
+def usage_main(argv=None) -> int:
+    """Entry point of the ``usage`` subcommand: one-shot fetch of
+    ``/usage`` from a running controller/scheduler (jax-free, like
+    ``status``) — the per-tenant meter table, per-class rollups, and
+    the top-N tenants by dispatch seconds."""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="mdanalysis_mpi_tpu usage",
+        description="fetch /usage (per-tenant usage meters) from a "
+                    "running fleet controller or scheduler status "
+                    "endpoint (docs/OBSERVABILITY.md)")
+    p.add_argument("target", nargs="?", default=".",
+                   help="host:port, bare port, or a fleet workdir "
+                        "holding controller.addr (default: cwd)")
+    p.add_argument("--json", action="store_true",
+                   help="print the raw /usage JSON instead of the "
+                        "human-readable table")
+    p.add_argument("--top", type=int, default=None, metavar="N",
+                   help="limit the ranked tenant table to the top N "
+                        "by dispatch seconds")
+    p.add_argument("--timeout", type=float, default=5.0)
+    ns = p.parse_args(argv)
+    try:
+        doc = fetch_status(ns.target, route="/usage",
+                           timeout=ns.timeout)
+    except OSError as exc:
+        print(json.dumps({"error": f"{type(exc).__name__}: {exc}",
+                          "target": ns.target}))
+        return 1
+    if ns.json:
+        print(json.dumps(doc, indent=2, sort_keys=True, default=str))
+    else:
+        from mdanalysis_mpi_tpu.obs import usage as _usage
+
+        print(_usage.render_usage(doc, top=ns.top))
     return 0

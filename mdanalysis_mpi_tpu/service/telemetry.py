@@ -17,6 +17,7 @@ JSON-lines event stream.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 from collections import deque
 
@@ -196,12 +197,20 @@ class ServiceTelemetry:
         # registry (docs/OBSERVABILITY.md): unlike the bounded
         # percentile deques above, these see EVERY job for the life of
         # the process — the long-horizon serving distribution
-        if handle.queue_wait_s is not None:
-            METRICS.observe("mdtpu_queue_wait_seconds",
-                            handle.queue_wait_s)
-        if handle.latency_s is not None:
-            METRICS.observe("mdtpu_job_latency_seconds",
-                            handle.latency_s)
+        # observed under the finishing job's trace context so each
+        # latency bucket remembers this job's trace id as its exemplar
+        # (obs/metrics.py; note_finish runs after the serving context
+        # exited, so the id is re-applied here)
+        from mdanalysis_mpi_tpu.obs import spans as _spans
+        tid = getattr(handle.job, "trace_id", None)
+        with _spans.context(trace_id=tid) if tid \
+                else contextlib.nullcontext():
+            if handle.queue_wait_s is not None:
+                METRICS.observe("mdtpu_queue_wait_seconds",
+                                handle.queue_wait_s)
+            if handle.latency_s is not None:
+                METRICS.observe("mdtpu_job_latency_seconds",
+                                handle.latency_s)
 
     def count(self, counter: str, n: int = 1) -> None:
         """Increment a named counter (the scheduler's single entry

@@ -253,6 +253,13 @@ def main(argv=None) -> int:
         from mdanalysis_mpi_tpu.service.statusd import status_main
 
         return status_main(args[1:])
+    if args and args[0] == "usage":
+        # one-shot fetch of /usage (per-tenant usage meters) from a
+        # running controller/scheduler endpoint — jax-free like
+        # status: stdlib sockets only, never a platform re-pin
+        from mdanalysis_mpi_tpu.service.statusd import usage_main
+
+        return usage_main(args[1:])
     if args and args[0] == "perf":
         # perf-regression sentinel over the bench record
         # (docs/OBSERVABILITY.md "Alerting & profiling") — pure JSON

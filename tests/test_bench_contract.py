@@ -188,6 +188,25 @@ PINNED_METRICS = {
     "mdtpu_stream_chunks_sealed_total": "counter",
     "mdtpu_stream_parks_total": "counter",
     "mdtpu_stream_snapshot_age_seconds": "gauge",
+    # tenant-facing usage metering (obs/usage.py): monotone per-tenant
+    # meters mirrored from the ledger on every charge — labeled
+    # tenant=/class= (+ source= for the store split, outcome= for the
+    # exactly-once job meter the journal reconciliation audits)
+    "mdtpu_usage_frames_total": "counter",
+    "mdtpu_usage_staged_bytes_total": "counter",
+    "mdtpu_usage_cache_byte_seconds_total": "counter",
+    "mdtpu_usage_dispatch_seconds_total": "counter",
+    "mdtpu_usage_store_chunks_total": "counter",
+    "mdtpu_usage_store_bytes_total": "counter",
+    "mdtpu_usage_jobs_total": "counter",
+    # synthetic canary (service/canary.py): black-box end-to-end
+    # probes of the serving path from a reserved background-class
+    # pseudo-tenant; the consecutive-failures gauge feeds the
+    # canary_failing seed alert
+    "mdtpu_canary_probes_total": "counter",
+    "mdtpu_canary_failures_total": "counter",
+    "mdtpu_canary_consecutive_failures": "gauge",
+    "mdtpu_canary_latency_seconds": "histogram",
 }
 
 #: The alert seed-rule catalog (obs/alerts.py SEED_RULES) — pinned so
@@ -202,6 +221,7 @@ PINNED_ALERT_RULES = (
     "store_remote_error_rate",
     "breaker_flapping",
     "stream_staleness",
+    "canary_failing",
 )
 
 
@@ -299,6 +319,22 @@ def test_bench_json_contract(tmp_path):
                     "integrity_overhead_pct",
                     "integrity_jobs_per_s",
                     "integrity_fingerprint_gbps",
+                    # r20: tenant-observability sub-leg
+                    # (docs/OBSERVABILITY.md "Usage metering,
+                    # exemplars & the synthetic canary") — the
+                    # metering tax next to the per-tenant usage doc
+                    # the wave produced, plus one serial end-to-end
+                    # canary probe; host-side, survives outage
+                    "usage_plain_jobs_per_s",
+                    "usage_metered_jobs_per_s",
+                    "usage_overhead_pct",
+                    "usage_overhead_target_pct",
+                    "usage_tenants", "usage_top_tenant",
+                    "usage_canary_ok", "usage_canary_latency_s",
+                    "usage_canary_stage",
+                    # r20: the fleet leg's exact usage-vs-journal
+                    # reconciliation across the kill -9 wave
+                    "usage_ledger_reconciled", "usage_ledger_jobs",
                     # r13: block-store sub-leg (docs/STORE.md) — cold
                     # ingest + cold store reads vs the file-decode
                     # rate, parity-gated, with read-time CRC-reject
@@ -453,6 +489,24 @@ def test_bench_json_contract(tmp_path):
         assert 0 <= rec["integrity_overhead_pct"] <= 100
         assert rec["integrity_fingerprint_gbps"] > 0
         assert rec["integrity_outputs_verified"] == 8
+        # r20: usage-metering sub-leg — both waves ran, the metering
+        # tax is disclosed against its <3% ceiling (toy-scale timer
+        # noise gets headroom and can go negative; the ceiling reads
+        # at flagship scale), the wave's tenants appear in the usage
+        # doc, the serial canary probe passed end-to-end, and the
+        # fleet leg's usage ledger reconciled EXACTLY against its
+        # journal across the kill -9 wave
+        assert rec["usage_plain_jobs_per_s"] > 0
+        assert rec["usage_metered_jobs_per_s"] > 0
+        assert rec["usage_overhead_pct"] <= 100
+        assert rec["usage_overhead_target_pct"] == 3.0
+        assert rec["usage_tenants"] >= 3
+        assert rec["usage_top_tenant"] is not None
+        assert rec["usage_canary_ok"] is True
+        assert rec["usage_canary_latency_s"] > 0
+        assert rec["usage_canary_stage"] is None
+        assert rec["usage_ledger_reconciled"] is True
+        assert rec["usage_ledger_jobs"] >= 1
         # the metrics block carries the pinned schema: names AND types
         for name, typ in PINNED_METRICS.items():
             assert name in rec["metrics"], f"missing metric {name}"
@@ -719,6 +773,15 @@ def test_bench_outage_records_host_legs(tmp_path):
         assert rec["ensemble_parity_ok"] is True
         assert rec["ensemble_dedup_ratio"] == 1.0
         assert rec["ensemble_trajectories_per_s"] > 0
+        # r20: the usage-metering + canary sub-leg is host-side too
+        # (serial waves, serial canary backend): the metering-tax
+        # disclosure, the usage doc, the canary verdict, and the
+        # fleet leg's exact ledger reconciliation all survive a
+        # tunnel-down artifact
+        assert rec["usage_metered_jobs_per_s"] > 0
+        assert rec["usage_overhead_target_pct"] == 3.0
+        assert rec["usage_canary_ok"] is True
+        assert rec["usage_ledger_reconciled"] is True
         # the retry log shows what init actually did
         assert rec["init_log"] and rec["init_log"][0]["attempt"] == 1
         # the incremental file matches the emitted record's legs

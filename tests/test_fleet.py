@@ -225,6 +225,12 @@ def test_host_kill9_migration_exactly_once_parity(tmp_path):
     of a trajectory-sharded one) completes exactly once on the
     survivors, and every tenant's numbers match the solo serial
     oracle."""
+    from mdanalysis_mpi_tpu.obs import unified_snapshot
+
+    # usage charges land in the process-global registry: snapshot it
+    # BEFORE the controller so earlier tests' job charges subtract
+    # out of the reconciliation (the bench does the same)
+    usage_base = unified_snapshot()
     with FleetController(tmp_path, host_ttl_s=2.0) as ctrl:
         _spawn(ctrl, 2, env={"MDTPU_FLEET_RUN_DELAY": "0.3"})
         jobs = [ctrl.submit({"analysis": "rmsf", "fixture": FIXTURE,
@@ -244,6 +250,17 @@ def test_host_kill9_migration_exactly_once_parity(tmp_path):
         assert all(j.state == DONE for j in jobs)
         assert sharded.state == DONE
         child_fps = [c.fp for c in sharded.children]
+        # per-tenant usage (obs/usage.py): the jobs meter reconciles
+        # EXACTLY against the journal's finish ledger across the
+        # kill -9 — every accepted terminal record is one charge,
+        # migrations never double-charge, the lost host's work
+        # charges on whoever finished it
+        rec = ctrl.usage_reconcile(baseline=usage_base)
+        assert rec["ok"] is True, rec["diff"]
+        assert sum(rec["journal"].values()) == len(jobs) + len(child_fps)
+        assert rec["usage"] == rec["journal"]
+        for i in range(3):
+            assert rec["usage"].get(f"t{i}/done", 0) >= 1
     _journal_exactly_once(tmp_path, [j.fp for j in jobs] + child_fps)
     oracle = _oracle_rmsf()
     for j in jobs:
